@@ -1,0 +1,18 @@
+"""rl_tpu: a TPU-native reinforcement-learning framework.
+
+Brand-new design with the capabilities of TorchRL (pytorch/rl), built
+idiomatically for JAX/XLA on TPU: named-pytree data model (ArrayDict), spec
+trees, pure-functional environments vectorized with ``vmap``, single-program
+``lax.scan`` collectors, device-resident replay, a full loss library with
+``associative_scan`` value estimators, mesh/pjit parallelism over ICI/DCN,
+and an LLM/RLHF stack with ring attention.
+
+Blueprint: SURVEY.md (structural analysis of the reference with file:line
+citations). Performance targets: BASELINE.md.
+"""
+
+__version__ = "0.1.0"
+
+from .data import ArrayDict, Composite
+
+__all__ = ["ArrayDict", "Composite", "__version__"]

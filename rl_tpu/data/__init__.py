@@ -1,0 +1,31 @@
+from .arraydict import ArrayDict
+from .specs import (
+    Binary,
+    Bounded,
+    Categorical,
+    Composite,
+    MultiCategorical,
+    MultiOneHot,
+    NonTensor,
+    OneHot,
+    Spec,
+    Unbounded,
+    make_composite_from_arraydict,
+    stack_specs,
+)
+
+__all__ = [
+    "ArrayDict",
+    "Spec",
+    "Bounded",
+    "Unbounded",
+    "Categorical",
+    "MultiCategorical",
+    "OneHot",
+    "MultiOneHot",
+    "Binary",
+    "NonTensor",
+    "Composite",
+    "stack_specs",
+    "make_composite_from_arraydict",
+]

@@ -1,0 +1,385 @@
+"""ArrayDict: the framework's data model.
+
+Every interface in this framework speaks ArrayDict — a nested, immutable
+mapping of names to ``jax.Array`` leaves, registered as a JAX pytree. It is
+the TPU-native equivalent of the reference's TensorDict (the external
+``tensordict`` package; see reference torchrl docs and
+torchrl/data/tensor_specs.py for how specs and data interlock): envs consume
+and produce ArrayDicts, policies declare ``in_keys``/``out_keys`` over them,
+replay buffers store them, and losses read/write them.
+
+Design differences from TensorDict, chosen for JAX/XLA:
+
+- **Immutable.** All mutators return a new ArrayDict. This is what makes it a
+  well-behaved pytree under ``jit``/``vmap``/``scan`` and lets XLA alias
+  buffers aggressively (donation works on whole ArrayDicts).
+- **Inferred batch shape.** TensorDict stores an explicit ``batch_size``;
+  under ``vmap`` a stored shape would go stale (vmap strips one leading axis
+  from every leaf but cannot rewrite static metadata). We instead *infer*
+  ``batch_shape`` as the longest common leading prefix of all leaf shapes, so
+  it is correct inside any transform by construction.
+- **Keys are strings; nesting is real.** ``d["a", "b"]`` traverses nested
+  ArrayDicts, like TensorDict's nested keys.
+"""
+
+from __future__ import annotations
+
+import operator
+from collections.abc import Mapping
+from typing import Any, Callable, Iterator
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["ArrayDict", "NESTED_SEP"]
+
+NESTED_SEP = "."
+
+_LeafT = Any  # jax.Array | np.ndarray | python scalar (encoded on insert)
+
+
+def _is_leaf(x: Any) -> bool:
+    return not isinstance(x, (ArrayDict, Mapping))
+
+
+class ArrayDict(Mapping):
+    """Immutable nested mapping of names to arrays, registered as a pytree.
+
+    >>> td = ArrayDict(obs=jnp.zeros((4, 3)), reward=jnp.zeros((4,)))
+    >>> td.batch_shape
+    (4,)
+    >>> td2 = td.replace(reward=td["reward"] + 1.0)
+    """
+
+    __slots__ = ("_data",)
+
+    def __init__(self, data: Mapping[str, Any] | None = None, /, **kwargs: Any):
+        merged: dict[str, Any] = {}
+        if data is not None:
+            merged.update(data)
+        merged.update(kwargs)
+        out: dict[str, Any] = {}
+        for k, v in merged.items():
+            if not isinstance(k, str):
+                raise TypeError(f"ArrayDict keys must be str, got {type(k)}")
+            if isinstance(v, ArrayDict):
+                out[k] = v
+            elif isinstance(v, Mapping):
+                out[k] = ArrayDict(v)
+            else:
+                out[k] = v
+        # Sorted keys give a canonical flatten order (stable across
+        # construction order, required for pytree-structure equality).
+        object.__setattr__(self, "_data", dict(sorted(out.items())))
+
+    # -- construction helpers -------------------------------------------------
+
+    @classmethod
+    def _unsafe(cls, data: dict[str, Any]) -> "ArrayDict":
+        """Wrap an already-canonical dict without re-validation (hot path)."""
+        self = object.__new__(cls)
+        object.__setattr__(self, "_data", data)
+        return self
+
+    @classmethod
+    def from_flat(cls, flat: Mapping[Any, Any]) -> "ArrayDict":
+        """Build from a mapping whose keys may be tuples or 'a.b' paths."""
+        out = cls()
+        for k, v in flat.items():
+            out = out.set(k, v)
+        return out
+
+    # -- Mapping protocol -----------------------------------------------------
+
+    def __getitem__(self, key: Any) -> Any:
+        if isinstance(key, str):
+            if NESTED_SEP in key:
+                return self[tuple(key.split(NESTED_SEP))]
+            return self._data[key]
+        if isinstance(key, tuple) and key and all(isinstance(k, str) for k in key):
+            node: Any = self
+            for k in key:
+                node = node[k]
+            return node
+        # everything else is tensor-style indexing over the batch dims
+        return self.apply(operator.itemgetter(key))
+
+    def __iter__(self) -> Iterator[str]:
+        return iter(self._data)
+
+    def __len__(self) -> int:
+        return len(self._data)
+
+    def __contains__(self, key: Any) -> bool:
+        try:
+            self[key] if isinstance(key, (str, tuple)) else None
+        except KeyError:
+            return False
+        return isinstance(key, (str, tuple))
+
+    def keys(self, nested: bool = False, leaves_only: bool = False):
+        if not nested:
+            return self._data.keys()
+        out = []
+        for k, v in self._data.items():
+            if isinstance(v, ArrayDict):
+                if not leaves_only:
+                    out.append((k,))
+                out.extend((k, *sub) for sub in v.keys(True, leaves_only))
+            else:
+                out.append((k,))
+        return out
+
+    def items(self, nested: bool = False, leaves_only: bool = False):
+        if not nested:
+            return self._data.items()
+        return [(k, self[k]) for k in self.keys(True, leaves_only)]
+
+    def values(self):
+        return self._data.values()
+
+    # -- functional mutators --------------------------------------------------
+
+    def set(self, key: str | tuple, value: Any) -> "ArrayDict":
+        """Return a copy with ``key`` set (creating nested nodes as needed)."""
+        if isinstance(key, str):
+            if NESTED_SEP in key:
+                key = tuple(key.split(NESTED_SEP))
+            else:
+                key = (key,)
+        if not key:
+            raise KeyError("empty key")
+        head, *rest = key
+        data = dict(self._data)
+        if rest:
+            child = data.get(head)
+            if not isinstance(child, ArrayDict):
+                child = ArrayDict()
+            data[head] = child.set(tuple(rest), value)
+        else:
+            if isinstance(value, Mapping) and not isinstance(value, ArrayDict):
+                value = ArrayDict(value)
+            data[head] = value
+        return ArrayDict._unsafe(dict(sorted(data.items())))
+
+    def replace(self, **kwargs: Any) -> "ArrayDict":
+        out = self
+        for k, v in kwargs.items():
+            out = out.set(k, v)
+        return out
+
+    def update(self, other: Mapping[str, Any] | None = None, **kw: Any) -> "ArrayDict":
+        """Recursive merge: nested ArrayDicts merge key-wise, leaves overwrite."""
+        out = self
+        items = list((other or {}).items()) + list(kw.items())
+        for k, v in items:
+            cur = out._data.get(k) if isinstance(k, str) and NESTED_SEP not in k else None
+            if isinstance(cur, ArrayDict) and isinstance(v, Mapping):
+                out = out.set(k, cur.update(v))
+            else:
+                out = out.set(k, v)
+        return out
+
+    def delete(self, key: str | tuple) -> "ArrayDict":
+        if isinstance(key, str):
+            key = tuple(key.split(NESTED_SEP)) if NESTED_SEP in key else (key,)
+        head, *rest = key
+        data = dict(self._data)
+        if rest:
+            child = data[head]
+            if not isinstance(child, ArrayDict):
+                # Guard: a jax.Array also has a .delete() (buffer free!).
+                raise KeyError(key)
+            data[head] = child.delete(tuple(rest))
+        else:
+            del data[head]
+        return ArrayDict._unsafe(data)
+
+    def select(self, *keys: str | tuple, strict: bool = True) -> "ArrayDict":
+        out = ArrayDict()
+        for k in keys:
+            try:
+                out = out.set(k, self[k])
+            except KeyError:
+                if strict:
+                    raise
+        return out
+
+    def exclude(self, *keys: str | tuple) -> "ArrayDict":
+        out = self
+        for k in keys:
+            try:
+                out = out.delete(k)
+            except KeyError:
+                pass
+        return out
+
+    def rename_key(self, old: str | tuple, new: str | tuple) -> "ArrayDict":
+        val = self[old]
+        return self.delete(old).set(new, val)
+
+    def flatten_keys(self, sep: str = NESTED_SEP) -> "ArrayDict":
+        out: dict[str, Any] = {}
+        for path in self.keys(nested=True, leaves_only=True):
+            out[sep.join(path)] = self[path]
+        return ArrayDict._unsafe(dict(sorted(out.items())))
+
+    def unflatten_keys(self, sep: str = NESTED_SEP) -> "ArrayDict":
+        out = ArrayDict()
+        for k, v in self._data.items():
+            out = out.set(tuple(k.split(sep)), v)
+        return out
+
+    # -- shape ----------------------------------------------------------------
+
+    @property
+    def batch_shape(self) -> tuple[int, ...]:
+        """Longest common leading prefix of all leaf shapes."""
+        shapes = [np.shape(v) for v in self.leaves()]
+        if not shapes:
+            return ()
+        prefix = shapes[0]
+        for s in shapes[1:]:
+            n = 0
+            for a, b in zip(prefix, s):
+                if a != b:
+                    break
+                n += 1
+            prefix = prefix[:n]
+            if not prefix:
+                break
+        return tuple(prefix)
+
+    shape = batch_shape
+
+    @property
+    def batch_ndim(self) -> int:
+        return len(self.batch_shape)
+
+    def numel(self) -> int:
+        return int(np.prod(self.batch_shape)) if self.batch_shape else 1
+
+    def leaves(self) -> list[_LeafT]:
+        out = []
+        for v in self._data.values():
+            if isinstance(v, ArrayDict):
+                out.extend(v.leaves())
+            else:
+                out.append(v)
+        return out
+
+    def apply(self, fn: Callable[[Any], Any]) -> "ArrayDict":
+        """Apply ``fn`` to every leaf, returning a new ArrayDict."""
+        data = {
+            k: (v.apply(fn) if isinstance(v, ArrayDict) else fn(v))
+            for k, v in self._data.items()
+        }
+        return ArrayDict._unsafe(data)
+
+    def named_apply(self, fn: Callable[[tuple, Any], Any]) -> "ArrayDict":
+        def rec(node: "ArrayDict", prefix: tuple) -> "ArrayDict":
+            data = {
+                k: (
+                    rec(v, prefix + (k,))
+                    if isinstance(v, ArrayDict)
+                    else fn(prefix + (k,), v)
+                )
+                for k, v in node._data.items()
+            }
+            return ArrayDict._unsafe(data)
+
+        return rec(self, ())
+
+    def reshape(self, *shape: int) -> "ArrayDict":
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        nb = self.batch_ndim
+        return self.apply(lambda x: jnp.reshape(x, shape + jnp.shape(x)[nb:]))
+
+    def flatten_batch(self) -> "ArrayDict":
+        return self.reshape(-1)
+
+    def squeeze(self, axis: int = 0) -> "ArrayDict":
+        return self.apply(lambda x: jnp.squeeze(x, axis=axis))
+
+    def unsqueeze(self, axis: int = 0) -> "ArrayDict":
+        return self.apply(lambda x: jnp.expand_dims(x, axis=axis))
+
+    def expand(self, *sizes: int) -> "ArrayDict":
+        sizes = tuple(sizes[0]) if len(sizes) == 1 and isinstance(sizes[0], (tuple, list)) else sizes
+        nb = self.batch_ndim
+
+        def _exp(x):
+            tail = jnp.shape(x)[nb:]
+            return jnp.broadcast_to(x, tuple(sizes) + tail)
+
+        return self.apply(_exp)
+
+    # -- combination ----------------------------------------------------------
+
+    @staticmethod
+    def stack(dicts: list["ArrayDict"], axis: int = 0) -> "ArrayDict":
+        return jax.tree.map(lambda *xs: jnp.stack(xs, axis=axis), *dicts)
+
+    @staticmethod
+    def concat(dicts: list["ArrayDict"], axis: int = 0) -> "ArrayDict":
+        return jax.tree.map(lambda *xs: jnp.concatenate(xs, axis=axis), *dicts)
+
+    # -- conversion -----------------------------------------------------------
+
+    def to_dict(self) -> dict:
+        return {
+            k: (v.to_dict() if isinstance(v, ArrayDict) else v)
+            for k, v in self._data.items()
+        }
+
+    def astype(self, dtype) -> "ArrayDict":
+        return self.apply(lambda x: jnp.asarray(x, dtype=dtype))
+
+    def device_put(self, device_or_sharding) -> "ArrayDict":
+        return jax.device_put(self, device_or_sharding)
+
+    # -- dunder ---------------------------------------------------------------
+
+    def __repr__(self) -> str:
+        def fmt(v: Any) -> str:
+            if isinstance(v, ArrayDict):
+                return repr(v)
+            if hasattr(v, "shape") and hasattr(v, "dtype"):
+                return f"Array{tuple(v.shape)}[{v.dtype}]"
+            return repr(v)
+
+        inner = ", ".join(f"{k}: {fmt(v)}" for k, v in self._data.items())
+        return f"ArrayDict(batch_shape={self.batch_shape}, {{{inner}}})"
+
+    def __eq__(self, other: Any) -> Any:
+        if not isinstance(other, ArrayDict):
+            return NotImplemented
+        if jax.tree_util.tree_structure(self) != jax.tree_util.tree_structure(other):
+            return False
+        return jax.tree.map(lambda a, b: a == b, self, other)
+
+    def __hash__(self):
+        raise TypeError("ArrayDict is unhashable (contains arrays)")
+
+    def __setattr__(self, *a):
+        raise AttributeError("ArrayDict is immutable; use .set/.replace")
+
+
+def _flatten_with_keys(td: ArrayDict):
+    children = [(jax.tree_util.DictKey(k), v) for k, v in td._data.items()]
+    return children, tuple(td._data.keys())
+
+
+def _flatten(td: ArrayDict):
+    return list(td._data.values()), tuple(td._data.keys())
+
+
+def _unflatten(keys: tuple, children) -> ArrayDict:
+    return ArrayDict._unsafe(dict(zip(keys, children)))
+
+
+jax.tree_util.register_pytree_with_keys(
+    ArrayDict, _flatten_with_keys, _unflatten, flatten_func=_flatten
+)

@@ -1,0 +1,563 @@
+"""Spec tree: typed descriptions of env/model inputs and outputs.
+
+TPU-native analog of the reference's TensorSpec family
+(reference: torchrl/data/tensor_specs.py:607 ``TensorSpec``, :2259 ``Bounded``,
+:3053 ``Unbounded``, :1695 ``OneHot``, :3808 ``Categorical``, :4398 ``Binary``,
+:4600 ``MultiCategorical``, :2738 ``NonTensor``, :5042 ``Composite``).
+
+Differences by design:
+
+- Specs are **static metadata**, not pytrees: they are consulted at trace time
+  (``jax.eval_shape``, ``ShapeDtypeStruct`` construction, sharding layout) and
+  never cross into compiled programs.
+- Each spec can carry a ``jax.sharding.PartitionSpec`` so the spec tree doubles
+  as the sharding annotation source for ``pjit`` — the reference's
+  ``device`` attribute generalized to a mesh axis mapping.
+- ``rand`` takes an explicit PRNG key (functional randomness).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .arraydict import ArrayDict
+
+__all__ = [
+    "Spec",
+    "Bounded",
+    "Unbounded",
+    "Categorical",
+    "MultiCategorical",
+    "OneHot",
+    "MultiOneHot",
+    "Binary",
+    "NonTensor",
+    "Composite",
+    "stack_specs",
+    "make_composite_from_arraydict",
+]
+
+
+def _canon_shape(shape) -> tuple[int, ...]:
+    if shape is None:
+        return ()
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s) for s in shape)
+
+
+@dataclasses.dataclass(frozen=True)
+class Spec:
+    """Abstract leaf spec: shape, dtype, optional sharding annotation."""
+
+    shape: tuple[int, ...] = ()
+    dtype: Any = jnp.float32
+    sharding: Any = None  # jax.sharding.PartitionSpec | None
+
+    def __post_init__(self):
+        object.__setattr__(self, "shape", _canon_shape(self.shape))
+
+    # -- core protocol (mirrors TensorSpec: rand/zero/is_in/project/encode) ---
+
+    def rand(self, key: jax.Array, batch_shape: tuple[int, ...] = ()) -> jax.Array:
+        raise NotImplementedError
+
+    def zero(self, batch_shape: tuple[int, ...] = ()) -> jax.Array:
+        return jnp.zeros(_canon_shape(batch_shape) + self.shape, self.dtype)
+
+    def is_in(self, val) -> bool:
+        """Static + value check: shape/dtype statically, domain numerically."""
+        val = jnp.asarray(val)
+        if not self._shape_ok(val.shape):
+            return False
+        if val.dtype != jnp.dtype(self.dtype):
+            return False
+        return bool(self._domain_ok(val))
+
+    def project(self, val: jax.Array) -> jax.Array:
+        """Map an arbitrary value into the spec's domain (clip/renorm)."""
+        return jnp.asarray(val, self.dtype)
+
+    def encode(self, val) -> jax.Array:
+        """Encode a raw (host) value into spec form (e.g. index -> one-hot)."""
+        return jnp.asarray(val, self.dtype)
+
+    # -- structure ------------------------------------------------------------
+
+    def to_sds(self, batch_shape: tuple[int, ...] = ()) -> jax.ShapeDtypeStruct:
+        return jax.ShapeDtypeStruct(
+            _canon_shape(batch_shape) + self.shape, self.dtype, sharding=self.sharding
+        )
+
+    def expand(self, *batch_shape: int) -> "Spec":
+        bs = _canon_shape(batch_shape[0] if len(batch_shape) == 1 and isinstance(batch_shape[0], (tuple, list)) else batch_shape)
+        return dataclasses.replace(self, shape=bs + self.shape)
+
+    def with_sharding(self, pspec) -> "Spec":
+        return dataclasses.replace(self, sharding=pspec)
+
+    def _shape_ok(self, shape: tuple[int, ...]) -> bool:
+        n = len(self.shape)
+        return tuple(shape[len(shape) - n:] if n else ()) == self.shape
+
+    def _domain_ok(self, val: jax.Array) -> Any:
+        return True
+
+    @property
+    def ndim(self) -> int:
+        return len(self.shape)
+
+
+@dataclasses.dataclass(frozen=True)
+class Bounded(Spec):
+    """Box space with per-element bounds (reference tensor_specs.py:2259)."""
+
+    low: Any = -1.0
+    high: Any = 1.0
+
+    def __post_init__(self):
+        super().__post_init__()
+        object.__setattr__(self, "low", np.broadcast_to(np.asarray(self.low, self.dtype), self.shape).copy())
+        object.__setattr__(self, "high", np.broadcast_to(np.asarray(self.high, self.dtype), self.shape).copy())
+
+    def rand(self, key, batch_shape=()):
+        bs = _canon_shape(batch_shape)
+        if jnp.issubdtype(self.dtype, jnp.integer):
+            return jax.random.randint(
+                key, bs + self.shape, jnp.asarray(self.low), jnp.asarray(self.high) + 1, self.dtype
+            )
+        u = jax.random.uniform(key, bs + self.shape, self.dtype)
+        return u * (self.high - self.low) + self.low
+
+    def project(self, val):
+        return jnp.clip(jnp.asarray(val, self.dtype), jnp.asarray(self.low), jnp.asarray(self.high))
+
+    def _domain_ok(self, val):
+        return jnp.all(val >= jnp.asarray(self.low)) & jnp.all(val <= jnp.asarray(self.high))
+
+    def __eq__(self, other):
+        return (
+            type(other) is Bounded
+            and self.shape == other.shape
+            and self.dtype == other.dtype
+            and np.array_equal(self.low, other.low)
+            and np.array_equal(self.high, other.high)
+        )
+
+    __hash__ = None
+
+
+@dataclasses.dataclass(frozen=True)
+class Unbounded(Spec):
+    """Unbounded continuous/discrete space (reference tensor_specs.py:3053)."""
+
+    def rand(self, key, batch_shape=()):
+        bs = _canon_shape(batch_shape)
+        if jnp.issubdtype(self.dtype, jnp.integer):
+            info = jnp.iinfo(self.dtype)
+            return jax.random.randint(key, bs + self.shape, info.min // 2, info.max // 2, self.dtype)
+        if self.dtype == jnp.bool_:
+            return jax.random.bernoulli(key, 0.5, bs + self.shape)
+        return jax.random.normal(key, bs + self.shape, self.dtype)
+
+
+@dataclasses.dataclass(frozen=True)
+class Categorical(Spec):
+    """Integer categorical in [0, n) (reference tensor_specs.py:3808).
+
+    ``shape`` excludes the class dimension (scalar action => shape=()).
+    n = -1 means "unknown cardinality" (matches reference semantics).
+    """
+
+    n: int = -1
+    dtype: Any = jnp.int32
+
+    def rand(self, key, batch_shape=()):
+        return jax.random.randint(key, _canon_shape(batch_shape) + self.shape, 0, max(self.n, 1), self.dtype)
+
+    def project(self, val):
+        val = jnp.asarray(val, self.dtype)
+        if self.n < 0:  # unknown cardinality: domain is unconstrained
+            return val
+        return jnp.clip(val, 0, self.n - 1)
+
+    def _domain_ok(self, val):
+        if self.n < 0:
+            return True
+        return jnp.all((val >= 0) & (val < self.n))
+
+    @property
+    def num_actions(self) -> int:
+        return self.n
+
+
+@dataclasses.dataclass(frozen=True)
+class MultiCategorical(Spec):
+    """Vector of categoricals with per-position cardinalities (ref :4600)."""
+
+    nvec: tuple[int, ...] = ()
+    dtype: Any = jnp.int32
+
+    def __post_init__(self):
+        object.__setattr__(self, "nvec", tuple(int(n) for n in self.nvec))
+        if not self.shape:
+            object.__setattr__(self, "shape", (len(self.nvec),))
+        super().__post_init__()
+        if self.shape[-1] != len(self.nvec):
+            raise ValueError("shape[-1] must equal len(nvec)")
+
+    def rand(self, key, batch_shape=()):
+        bs = _canon_shape(batch_shape)
+        u = jax.random.uniform(key, bs + self.shape)
+        return jnp.asarray(u * jnp.asarray(self.nvec), self.dtype)
+
+    def project(self, val):
+        return jnp.clip(jnp.asarray(val, self.dtype), 0, jnp.asarray(self.nvec) - 1)
+
+    def _domain_ok(self, val):
+        return jnp.all((val >= 0) & (val < jnp.asarray(self.nvec)))
+
+
+@dataclasses.dataclass(frozen=True)
+class OneHot(Spec):
+    """One-hot encoded categorical (reference tensor_specs.py:1695).
+
+    ``shape[-1]`` is the number of classes.
+    """
+
+    n: int = 0
+    dtype: Any = jnp.float32
+
+    def __post_init__(self):
+        if not self.shape:
+            object.__setattr__(self, "shape", (self.n,))
+        super().__post_init__()
+        if self.n == 0:
+            object.__setattr__(self, "n", int(self.shape[-1]))
+        if self.shape[-1] != self.n:
+            raise ValueError("shape[-1] must equal n")
+
+    def rand(self, key, batch_shape=()):
+        bs = _canon_shape(batch_shape)
+        idx = jax.random.randint(key, bs + self.shape[:-1], 0, self.n)
+        return jax.nn.one_hot(idx, self.n, dtype=self.dtype)
+
+    def project(self, val):
+        idx = jnp.argmax(jnp.asarray(val), axis=-1)
+        return jax.nn.one_hot(idx, self.n, dtype=self.dtype)
+
+    def encode(self, val):
+        val = jnp.asarray(val)
+        if val.shape and val.shape[-1] == self.n and not jnp.issubdtype(val.dtype, jnp.integer):
+            return jnp.asarray(val, self.dtype)
+        return jax.nn.one_hot(val, self.n, dtype=self.dtype)
+
+    def to_categorical_spec(self) -> Categorical:
+        return Categorical(shape=self.shape[:-1], n=self.n)
+
+    def _domain_ok(self, val):
+        ones = jnp.sum(val != 0, axis=-1) == 1
+        vals = (val == 0) | (val == 1)
+        return jnp.all(ones) & jnp.all(vals)
+
+
+@dataclasses.dataclass(frozen=True)
+class MultiOneHot(Spec):
+    """Concatenation of one-hot blocks (reference tensor_specs.py:3298)."""
+
+    nvec: tuple[int, ...] = ()
+    dtype: Any = jnp.float32
+
+    def __post_init__(self):
+        object.__setattr__(self, "nvec", tuple(int(n) for n in self.nvec))
+        if not self.shape:
+            object.__setattr__(self, "shape", (sum(self.nvec),))
+        super().__post_init__()
+        if self.shape[-1] != sum(self.nvec):
+            raise ValueError("shape[-1] must equal sum(nvec)")
+
+    def rand(self, key, batch_shape=()):
+        bs = _canon_shape(batch_shape)
+        keys = jax.random.split(key, len(self.nvec))
+        parts = []
+        for k, n in zip(keys, self.nvec):
+            idx = jax.random.randint(k, bs + self.shape[:-1], 0, n)
+            parts.append(jax.nn.one_hot(idx, n, dtype=self.dtype))
+        return jnp.concatenate(parts, axis=-1)
+
+    def _domain_ok(self, val):
+        ok = True
+        off = 0
+        for n in self.nvec:
+            blk = val[..., off : off + n]
+            ok = ok & jnp.all(jnp.sum(blk != 0, axis=-1) == 1)
+            off += n
+        return ok
+
+    def to_categorical_spec(self) -> MultiCategorical:
+        return MultiCategorical(shape=self.shape[:-1] + (len(self.nvec),), nvec=self.nvec)
+
+
+@dataclasses.dataclass(frozen=True)
+class Binary(Spec):
+    """Binary vector (reference tensor_specs.py:4398)."""
+
+    dtype: Any = jnp.bool_
+
+    def rand(self, key, batch_shape=()):
+        return jax.random.bernoulli(key, 0.5, _canon_shape(batch_shape) + self.shape).astype(self.dtype)
+
+    def _domain_ok(self, val):
+        return jnp.all((val == 0) | (val == 1))
+
+
+@dataclasses.dataclass(frozen=True)
+class NonTensor(Spec):
+    """Arbitrary python payload leaf (strings, objects) — LLM text etc.
+
+    Reference tensor_specs.py:2738. Values never enter compiled programs;
+    they live host-side and are excluded from jit inputs.
+    """
+
+    example: Any = None
+
+    def rand(self, key, batch_shape=()):
+        return self.example
+
+    def zero(self, batch_shape=()):
+        return self.example
+
+    def is_in(self, val) -> bool:
+        return True
+
+    def to_sds(self, batch_shape=()):
+        return None
+
+
+class Composite(Spec):
+    """Nested dict-of-specs with a batch shape — THE env contract object.
+
+    Reference tensor_specs.py:5042. ``shape`` here is the batch shape shared
+    by all children (children's own shapes are *feature* shapes appended to
+    it, matching the reference convention).
+    """
+
+    def __init__(self, specs: dict[str, Spec] | None = None, shape=(), **kw: Spec):
+        merged = dict(specs or {})
+        merged.update(kw)
+        out = {}
+        for k, v in merged.items():
+            if isinstance(v, dict):
+                # Plain-dict children are feature-level groups: they inherit
+                # the batch shape at rand/zero time, so their own shape stays
+                # empty (avoids double-applying the batch dims).
+                v = Composite(v)
+            if not isinstance(v, Spec):
+                raise TypeError(f"Composite values must be Spec, got {type(v)} for {k!r}")
+            out[k] = v
+        object.__setattr__(self, "_specs", dict(sorted(out.items())))
+        object.__setattr__(self, "shape", _canon_shape(shape))
+        object.__setattr__(self, "dtype", None)
+        object.__setattr__(self, "sharding", None)
+
+    # -- mapping --------------------------------------------------------------
+
+    def __getitem__(self, key: str | tuple) -> Spec:
+        if isinstance(key, tuple):
+            node: Spec = self
+            for k in key:
+                node = node[k]
+            return node
+        if "." in key:
+            return self[tuple(key.split("."))]
+        return self._specs[key]
+
+    def __contains__(self, key) -> bool:
+        try:
+            self[key]
+            return True
+        except (KeyError, TypeError):
+            return False
+
+    def __iter__(self):
+        return iter(self._specs)
+
+    def keys(self, nested: bool = False, leaves_only: bool = False):
+        if not nested:
+            return self._specs.keys()
+        out = []
+        for k, v in self._specs.items():
+            if isinstance(v, Composite):
+                if not leaves_only:
+                    out.append((k,))
+                out.extend((k, *s) for s in v.keys(True, leaves_only))
+            else:
+                out.append((k,))
+        return out
+
+    def items(self):
+        return self._specs.items()
+
+    def values(self):
+        return self._specs.values()
+
+    def set(self, key: str | tuple, spec: Spec) -> "Composite":
+        if isinstance(key, str):
+            key = tuple(key.split(".")) if "." in key else (key,)
+        head, *rest = key
+        specs = dict(self._specs)
+        if rest:
+            child = specs.get(head)
+            if not isinstance(child, Composite):
+                child = Composite(shape=self.shape)
+            specs[head] = child.set(tuple(rest), spec)
+        else:
+            specs[head] = spec
+        return Composite(specs, shape=self.shape)
+
+    def delete(self, key: str | tuple) -> "Composite":
+        if isinstance(key, str):
+            key = tuple(key.split(".")) if "." in key else (key,)
+        head, *rest = key
+        specs = dict(self._specs)
+        if rest:
+            specs[head] = specs[head].delete(tuple(rest))
+        else:
+            del specs[head]
+        return Composite(specs, shape=self.shape)
+
+    def update(self, other: "Composite") -> "Composite":
+        specs = dict(self._specs)
+        for k, v in other.items():
+            if isinstance(specs.get(k), Composite) and isinstance(v, Composite):
+                specs[k] = specs[k].update(v)
+            else:
+                specs[k] = v
+        return Composite(specs, shape=self.shape)
+
+    def select(self, *keys) -> "Composite":
+        out = Composite(shape=self.shape)
+        for k in keys:
+            out = out.set(k, self[k])
+        return out
+
+    # -- spec protocol over the tree ------------------------------------------
+
+    def rand(self, key, batch_shape=()) -> ArrayDict:
+        bs = _canon_shape(batch_shape) + self.shape
+        ks = jax.random.split(key, max(len(self._specs), 1))
+        return ArrayDict(
+            {k: v.rand(kk, bs) for (k, v), kk in zip(self._specs.items(), ks)}
+        )
+
+    def zero(self, batch_shape=()) -> ArrayDict:
+        bs = _canon_shape(batch_shape) + self.shape
+        return ArrayDict({k: v.zero(bs) for k, v in self._specs.items()})
+
+    def is_in(self, val: ArrayDict) -> bool:
+        if not isinstance(val, (ArrayDict, dict)):
+            return False
+        for k, spec in self._specs.items():
+            if k not in val:
+                return False
+            if not spec.is_in(val[k]):
+                return False
+        return True
+
+    def project(self, val: ArrayDict) -> ArrayDict:
+        out = val
+        for k, spec in self._specs.items():
+            out = out.set(k, spec.project(val[k]))
+        return out
+
+    def encode(self, val) -> ArrayDict:
+        out = ArrayDict()
+        for k, spec in self._specs.items():
+            if k in val:
+                out = out.set(k, spec.encode(val[k]))
+        return out
+
+    def to_sds(self, batch_shape=()) -> ArrayDict:
+        bs = _canon_shape(batch_shape) + self.shape
+        return ArrayDict(
+            {
+                k: v.to_sds(bs)
+                for k, v in self._specs.items()
+                if not isinstance(v, NonTensor)
+            }
+        )
+
+    def expand(self, *batch_shape) -> "Composite":
+        # Children keep feature shapes; only the shared batch shape grows.
+        bs = _canon_shape(batch_shape[0] if len(batch_shape) == 1 and isinstance(batch_shape[0], (tuple, list)) else batch_shape)
+        return Composite(dict(self._specs), shape=bs)
+
+    def with_sharding(self, pspec) -> "Composite":
+        # Not a dataclass: dataclasses.replace would route kwargs into
+        # __init__'s **kw and drop children. Apply to every child instead.
+        return Composite(
+            {k: v.with_sharding(pspec) for k, v in self._specs.items()},
+            shape=self.shape,
+        )
+
+    def __repr__(self):
+        inner = ", ".join(f"{k}: {v!r}" for k, v in self._specs.items())
+        return f"Composite(shape={self.shape}, {{{inner}}})"
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, Composite)
+            and self.shape == other.shape
+            and dict(self._specs) == dict(other._specs)
+        )
+
+    __hash__ = None
+
+    @property
+    def ndim(self):
+        return len(self.shape)
+
+
+def stack_specs(specs: list[Spec], axis: int = 0) -> Spec:
+    """Stack homogeneous specs along a new batch axis (reference Stacked:1496).
+
+    Heterogeneous stacking (ragged multi-agent) is represented instead by a
+    Composite with per-agent keys — masking, not ragged lazy-stacks, is the
+    TPU-friendly form.
+    """
+    first = specs[0]
+    if any(type(s) is not type(first) for s in specs):
+        raise ValueError("stack_specs requires homogeneous specs; use Composite per-key for heterogeneous groups")
+    if isinstance(first, Composite):
+        # Children hold feature shapes; only the shared batch shape grows.
+        for k in first.keys():
+            if any(s[k] != first[k] for s in specs[1:]):
+                raise ValueError("stack_specs requires identical child specs")
+        return Composite(
+            dict(first.items()),
+            shape=first.shape[:axis] + (len(specs),) + first.shape[axis:],
+        )
+    if any(s != first for s in specs):
+        raise ValueError("stack_specs requires identical leaf specs")
+    new_shape = first.shape[:axis] + (len(specs),) + first.shape[axis:]
+    return dataclasses.replace(first, shape=new_shape)
+
+
+def make_composite_from_arraydict(td: ArrayDict, unsqueeze_null_shapes: bool = True) -> Composite:
+    """Infer a Composite spec from example data (reference envs/utils.py:928)."""
+
+    def leaf_spec(v) -> Spec:
+        if not hasattr(v, "dtype"):
+            return NonTensor(example=v)
+        v = jnp.asarray(v)
+        return Unbounded(shape=v.shape, dtype=v.dtype)
+
+    specs = {}
+    for k, v in td.items():
+        specs[k] = make_composite_from_arraydict(v) if isinstance(v, ArrayDict) else leaf_spec(v)
+    return Composite(specs)

@@ -1,0 +1,21 @@
+import logging
+
+from .seeding import fold_seed, key_chain, seed_generator
+from .timing import record_function, set_profiling_enabled, timeit
+
+logger = logging.getLogger("rl_tpu")
+if not logger.handlers:
+    _h = logging.StreamHandler()
+    _h.setFormatter(logging.Formatter("%(asctime)s [%(name)s][%(levelname)s] %(message)s"))
+    logger.addHandler(_h)
+    logger.setLevel(logging.INFO)
+
+__all__ = [
+    "logger",
+    "timeit",
+    "record_function",
+    "set_profiling_enabled",
+    "seed_generator",
+    "key_chain",
+    "fold_seed",
+]

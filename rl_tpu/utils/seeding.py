@@ -1,0 +1,33 @@
+"""Seed utilities (reference torchrl/_utils.py:543 ``seed_generator``).
+
+The reference hash-chains integer seeds handed to each worker; in JAX the
+idiomatic form is `jax.random.split`/`fold_in` over typed PRNG keys. Both are
+provided: ``seed_generator`` for host-side integer seeds (worker processes,
+non-JAX envs), ``key_chain``/``fold_seed`` for in-program keys.
+"""
+
+from __future__ import annotations
+
+import jax
+
+__all__ = ["seed_generator", "key_chain", "fold_seed"]
+
+
+def seed_generator(seed: int) -> int:
+    """Hash-chain successor of an integer seed (deterministic, avalanching)."""
+    import numpy as np
+
+    max_seed_val = (2**32) - 1
+    rng = np.random.default_rng(seed % max_seed_val)
+    return int(rng.integers(0, max_seed_val, dtype=np.uint32))
+
+
+def key_chain(seed_or_key, n: int):
+    """Split a seed/key into n independent keys."""
+    key = jax.random.key(seed_or_key) if isinstance(seed_or_key, int) else seed_or_key
+    return jax.random.split(key, n)
+
+
+def fold_seed(key, data: int):
+    """Deterministically derive a sub-key (worker id, step index, …)."""
+    return jax.random.fold_in(key, data)

@@ -1,0 +1,94 @@
+"""Global named-timer registry (reference torchrl/_utils.py:221 ``timeit``).
+
+Usable as decorator, context manager, or explicit start/stop. On TPU, wall
+timing of jitted calls measures dispatch unless the result is blocked on, so
+``timeit`` optionally calls ``block_until_ready`` on the wrapped function's
+output. ``jax.profiler`` spans are layered via :func:`record_function`.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+import time
+from collections import defaultdict
+from typing import Any, Callable
+
+import jax
+
+__all__ = ["timeit", "record_function", "set_profiling_enabled"]
+
+_PROFILING = False
+
+
+def set_profiling_enabled(mode: bool = True) -> None:
+    global _PROFILING
+    _PROFILING = mode
+
+
+class timeit:
+    """Named accumulating timer.
+
+    >>> with timeit("rollout"):
+    ...     ...
+    >>> timeit.print()
+    """
+
+    _REG: dict[str, list[float]] = defaultdict(lambda: [0.0, 0.0, 0])
+    # name -> [total_s, last_s, count]
+
+    def __init__(self, name: str, block: bool = False):
+        self.name = name
+        self.block = block
+
+    def __call__(self, fn: Callable) -> Callable:
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with self:
+                out = fn(*args, **kwargs)
+                if self.block:
+                    jax.block_until_ready(out)
+                return out
+
+        return wrapper
+
+    def __enter__(self):
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        dt = time.perf_counter() - self.t0
+        rec = timeit._REG[self.name]
+        rec[0] += dt
+        rec[1] = dt
+        rec[2] += 1
+        return False
+
+    @classmethod
+    def todict(cls, percall: bool = True) -> dict[str, float]:
+        if percall:
+            return {k: v[0] / max(v[2], 1) for k, v in cls._REG.items()}
+        return {k: v[0] for k, v in cls._REG.items()}
+
+    @classmethod
+    def print(cls, prefix: str = "") -> None:  # noqa: A003
+        for k, v in sorted(cls._REG.items()):
+            print(f"{prefix}{k}: total={v[0]:.4f}s count={v[2]} percall={v[0] / max(v[2], 1):.4f}s")
+
+    @classmethod
+    def erase(cls) -> None:
+        cls._REG.clear()
+
+
+@contextlib.contextmanager
+def record_function(name: str):
+    """``jax.profiler`` trace span, active only when profiling is enabled.
+
+    Analog of the reference's ``_maybe_record_function``
+    (torchrl/_utils.py:470) over ``torch.profiler.record_function``.
+    """
+    if _PROFILING:
+        with jax.profiler.TraceAnnotation(name):
+            yield
+    else:
+        yield

@@ -1,0 +1,39 @@
+"""Test configuration: run the whole suite on a virtual 8-device CPU mesh.
+
+Mirrors the reference's distributed-tests-without-a-cluster strategy
+(reference test/test_distributed.py spawns process groups on one machine);
+here we instead ask XLA for 8 host devices so every sharding/pjit test runs
+the real partitioner without TPU hardware.
+"""
+
+import os
+
+# XLA_FLAGS must be set before the CPU client initializes (first device use).
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+# This image's sitecustomize registers the TPU ('axon') PJRT plugin and pins
+# JAX_PLATFORMS=axon before any user code runs, so an env-var override here is
+# too late — but jax.config wins over the env and backends init lazily.
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return jax.random.key(0)
+
+
+@pytest.fixture
+def mesh8():
+    import numpy as np
+    from jax.sharding import Mesh
+
+    devs = np.asarray(jax.devices()).reshape(4, 2)
+    return Mesh(devs, ("data", "model"))

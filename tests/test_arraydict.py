@@ -1,0 +1,191 @@
+"""ArrayDict unit tests (strategy mirrors reference test/test_specs.py style:
+construction, indexing, pytree round-trips, transform-safety)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from rl_tpu.data import ArrayDict
+
+
+def make_td(b=4):
+    return ArrayDict(
+        obs=jnp.arange(b * 3, dtype=jnp.float32).reshape(b, 3),
+        reward=jnp.ones((b,)),
+        next=ArrayDict(obs=jnp.zeros((b, 3)), done=jnp.zeros((b,), bool)),
+    )
+
+
+class TestConstruction:
+    def test_basic(self):
+        td = make_td()
+        assert set(td.keys()) == {"next", "obs", "reward"}
+        assert isinstance(td["next"], ArrayDict)
+
+    def test_dict_coercion(self):
+        td = ArrayDict({"a": jnp.zeros(3), "b": {"c": jnp.ones(3)}})
+        assert isinstance(td["b"], ArrayDict)
+        assert td["b", "c"].shape == (3,)
+
+    def test_canonical_key_order(self):
+        a = ArrayDict(x=jnp.zeros(2), y=jnp.ones(2))
+        b = ArrayDict(y=jnp.ones(2), x=jnp.zeros(2))
+        assert jax.tree_util.tree_structure(a) == jax.tree_util.tree_structure(b)
+
+    def test_non_str_key_raises(self):
+        with pytest.raises(TypeError):
+            ArrayDict({1: jnp.zeros(2)})
+
+
+class TestAccess:
+    def test_nested_tuple_and_dotted(self):
+        td = make_td()
+        assert td["next", "obs"].shape == (4, 3)
+        assert td["next.obs"].shape == (4, 3)
+
+    def test_batch_indexing(self):
+        td = make_td()
+        row = td[0]
+        assert row["obs"].shape == (3,)
+        assert row["next", "done"].shape == ()
+        sl = td[1:3]
+        assert sl.batch_shape == (2,)
+
+    def test_fancy_indexing(self):
+        td = make_td()
+        idx = jnp.array([0, 2])
+        assert td[idx].batch_shape == (2,)
+
+    def test_contains(self):
+        td = make_td()
+        assert "obs" in td
+        assert ("next", "done") in td
+        assert "nope" not in td
+
+
+class TestBatchShape:
+    def test_inferred(self):
+        td = make_td()
+        assert td.batch_shape == (4,)
+
+    def test_common_prefix(self):
+        td = ArrayDict(a=jnp.zeros((2, 3, 4)), b=jnp.zeros((2, 3)))
+        assert td.batch_shape == (2, 3)
+
+    def test_vmap_consistency(self):
+        td = make_td()
+
+        def inner(t):
+            # inside vmap the leading batch axis is stripped
+            return t.batch_shape
+
+        shapes = jax.vmap(lambda t: t["obs"].sum())(td.select("obs"))
+        assert shapes.shape == (4,)
+
+    def test_empty(self):
+        assert ArrayDict().batch_shape == ()
+
+
+class TestMutators:
+    def test_set_immutable(self):
+        td = make_td()
+        td2 = td.set("extra", jnp.zeros(4))
+        assert "extra" not in td and "extra" in td2
+
+    def test_set_nested_creates(self):
+        td = ArrayDict()
+        td = td.set(("a", "b", "c"), jnp.ones(2))
+        assert td["a", "b", "c"].shape == (2,)
+
+    def test_update_recursive(self):
+        td = make_td()
+        td2 = td.update(ArrayDict(next=ArrayDict(reward=jnp.zeros(4))))
+        assert ("next", "reward") in td2
+        assert ("next", "obs") in td2  # merged, not replaced
+
+    def test_select_exclude(self):
+        td = make_td()
+        assert set(td.select("obs").keys()) == {"obs"}
+        assert set(td.exclude("obs").keys()) == {"next", "reward"}
+        assert set(td.select(("next", "obs")).keys()) == {"next"}
+
+    def test_rename(self):
+        td = make_td().rename_key("reward", ("next", "r"))
+        assert ("next", "r") in td and "reward" not in td
+
+    def test_flatten_unflatten_keys(self):
+        td = make_td()
+        flat = td.flatten_keys()
+        assert "next.obs" in flat.keys()
+        rt = flat.unflatten_keys()
+        assert jax.tree_util.tree_structure(rt) == jax.tree_util.tree_structure(td)
+
+    def test_setattr_blocked(self):
+        with pytest.raises(AttributeError):
+            make_td().foo = 1
+
+
+class TestShapeOps:
+    def test_reshape(self):
+        td = make_td(6).reshape(2, 3)
+        assert td.batch_shape == (2, 3)
+        assert td["obs"].shape == (2, 3, 3)
+
+    def test_squeeze_unsqueeze(self):
+        td = make_td().unsqueeze(0)
+        assert td.batch_shape == (1, 4)
+        assert td.squeeze(0).batch_shape == (4,)
+
+    def test_expand(self):
+        td = make_td().unsqueeze(0).expand(5, 4)
+        assert td.batch_shape == (5, 4)
+
+    def test_stack_concat(self):
+        tds = [make_td(), make_td()]
+        st = ArrayDict.stack(tds)
+        assert st.batch_shape == (2, 4)
+        ct = ArrayDict.concat(tds)
+        assert ct.batch_shape == (8,)
+
+
+class TestPytree:
+    def test_roundtrip(self):
+        td = make_td()
+        leaves, treedef = jax.tree_util.tree_flatten(td)
+        td2 = jax.tree_util.tree_unflatten(treedef, leaves)
+        assert set(td2.keys()) == set(td.keys())
+        np.testing.assert_array_equal(td2["obs"], td["obs"])
+
+    def test_jit(self):
+        td = make_td()
+
+        @jax.jit
+        def f(t):
+            return t.replace(reward=t["reward"] * 2)
+
+        out = f(td)
+        np.testing.assert_array_equal(out["reward"], 2 * np.ones(4))
+
+    def test_scan_carry(self):
+        td = make_td()
+
+        def body(carry, _):
+            return carry.replace(reward=carry["reward"] + 1), carry["reward"].sum()
+
+        final, ys = jax.lax.scan(body, td, None, length=3)
+        np.testing.assert_array_equal(final["reward"], 4 * np.ones(4))
+        assert ys.shape == (3,)
+
+    def test_key_paths(self):
+        td = make_td()
+        paths = jax.tree_util.tree_flatten_with_path(td)[0]
+        names = ["/".join(str(p) for p in path) for path, _ in paths]
+        assert any("obs" in n for n in names)
+
+    def test_apply_named_apply(self):
+        td = make_td()
+        z = td.apply(jnp.zeros_like)
+        assert float(z["obs"].sum()) == 0.0
+        named = td.named_apply(lambda path, x: x if path[-1] != "reward" else x + 1)
+        np.testing.assert_array_equal(named["reward"], 2 * np.ones(4))

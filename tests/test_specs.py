@@ -1,0 +1,249 @@
+"""Spec-family tests (modeled on reference test/test_specs.py coverage:
+rand/zero/is_in/project round-trips per spec type, composite nesting)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from rl_tpu.data import (
+    ArrayDict,
+    Binary,
+    Bounded,
+    Categorical,
+    Composite,
+    MultiCategorical,
+    MultiOneHot,
+    NonTensor,
+    OneHot,
+    Unbounded,
+    make_composite_from_arraydict,
+    stack_specs,
+)
+
+KEY = jax.random.key(0)
+
+LEAF_SPECS = [
+    Bounded(shape=(3,), low=-1.0, high=2.0),
+    Bounded(shape=(2, 2), low=0, high=5, dtype=jnp.int32),
+    Unbounded(shape=(4,)),
+    Unbounded(shape=(), dtype=jnp.int32),
+    Categorical(n=7),
+    Categorical(shape=(3,), n=4),
+    MultiCategorical(nvec=(3, 4, 5)),
+    OneHot(n=6),
+    MultiOneHot(nvec=(2, 3)),
+    Binary(shape=(5,)),
+]
+
+
+@pytest.mark.parametrize("spec", LEAF_SPECS, ids=lambda s: type(s).__name__ + str(s.shape))
+class TestLeafProtocol:
+    def test_rand_is_in(self, spec):
+        x = spec.rand(KEY)
+        assert spec.is_in(x), f"{spec} rejected own rand sample {x}"
+
+    def test_rand_batched(self, spec):
+        x = spec.rand(KEY, (10,))
+        assert x.shape == (10, *spec.shape)
+        assert spec.is_in(x)
+
+    def test_zero_is_in(self, spec):
+        z = spec.zero((2,))
+        assert z.shape == (2, *spec.shape)
+
+    def test_project_idempotent(self, spec):
+        x = spec.rand(KEY, (4,))
+        np.testing.assert_array_equal(spec.project(x), x)
+
+    def test_to_sds(self, spec):
+        sds = spec.to_sds((8,))
+        assert sds.shape == (8, *spec.shape)
+        assert sds.dtype == jnp.dtype(spec.dtype)
+
+    def test_expand(self, spec):
+        e = spec.expand(6)
+        assert e.shape == (6, *spec.shape)
+
+
+class TestDomains:
+    def test_bounded_rejects_oob(self):
+        spec = Bounded(shape=(2,), low=0.0, high=1.0)
+        assert not spec.is_in(jnp.array([0.5, 1.5]))
+        np.testing.assert_allclose(spec.project(jnp.array([-1.0, 2.0])), [0.0, 1.0])
+
+    def test_bounded_int_rand_covers_range(self):
+        spec = Bounded(shape=(100,), low=0, high=3, dtype=jnp.int32)
+        x = spec.rand(KEY)
+        assert set(np.unique(np.asarray(x))) <= {0, 1, 2, 3}
+        assert x.max() == 3  # high is inclusive for ints
+
+    def test_categorical_rejects(self):
+        spec = Categorical(n=3)
+        assert not spec.is_in(jnp.array(5, jnp.int32))
+        assert spec.is_in(jnp.array(2, jnp.int32))
+        assert spec.project(jnp.array(5, jnp.int32)) == 2
+
+    def test_onehot_encode_project(self):
+        spec = OneHot(n=4)
+        enc = spec.encode(jnp.array(2))
+        np.testing.assert_array_equal(enc, [0, 0, 1, 0])
+        assert spec.is_in(enc)
+        assert not spec.is_in(jnp.array([1.0, 1.0, 0.0, 0.0]))
+        proj = spec.project(jnp.array([0.1, 0.9, 0.3, 0.2]))
+        np.testing.assert_array_equal(proj, [0, 1, 0, 0])
+
+    def test_onehot_to_categorical(self):
+        assert OneHot(n=4).to_categorical_spec() == Categorical(shape=(), n=4)
+
+    def test_multionehot_blocks(self):
+        spec = MultiOneHot(nvec=(2, 3))
+        x = spec.rand(KEY)
+        assert x.shape == (5,)
+        assert spec.is_in(x)
+        assert not spec.is_in(jnp.ones(5))
+
+    def test_multicategorical(self):
+        spec = MultiCategorical(nvec=(3, 4))
+        x = spec.rand(KEY, (50,))
+        assert spec.is_in(x)
+        assert not spec.is_in(jnp.full((2,), 9, jnp.int32))
+
+    def test_binary(self):
+        spec = Binary(shape=(3,), dtype=jnp.int32)
+        assert spec.is_in(jnp.array([0, 1, 0], jnp.int32))
+        assert not spec.is_in(jnp.array([0, 2, 0], jnp.int32))
+
+    def test_nontensor(self):
+        spec = NonTensor(example="hello")
+        assert spec.rand(KEY) == "hello"
+        assert spec.is_in("anything")
+        assert spec.to_sds() is None
+
+
+class TestComposite:
+    def make(self):
+        return Composite(
+            observation=Bounded(shape=(3,), low=-1, high=1),
+            action=Categorical(n=4),
+            nested=Composite(x=Unbounded(shape=(2,))),
+        )
+
+    def test_rand_zero_is_in(self):
+        spec = self.make()
+        td = spec.rand(KEY, (5,))
+        assert isinstance(td, ArrayDict)
+        assert td["observation"].shape == (5, 3)
+        assert td["nested", "x"].shape == (5, 2)
+        assert spec.is_in(td)
+        assert spec.is_in(spec.zero((2,)))
+
+    def test_batch_shape_propagates(self):
+        spec = Composite({"a": Unbounded(shape=(2,))}, shape=(4,))
+        td = spec.rand(KEY)
+        assert td["a"].shape == (4, 2)
+        assert spec.expand(3, 4).shape == (3, 4)
+
+    def test_missing_key_not_in(self):
+        spec = self.make()
+        td = spec.rand(KEY).exclude("action")
+        assert not spec.is_in(td)
+
+    def test_set_delete_update(self):
+        spec = self.make()
+        spec2 = spec.set(("nested", "y"), Binary(shape=(1,)))
+        assert ("nested", "y") in spec2
+        spec3 = spec2.delete("action")
+        assert "action" not in spec3
+        spec4 = spec.update(Composite(action=Categorical(n=9)))
+        assert spec4["action"].n == 9
+        assert "observation" in spec4
+
+    def test_project(self):
+        spec = self.make()
+        bad = ArrayDict(
+            observation=jnp.full((3,), 5.0),
+            action=jnp.array(99, jnp.int32),
+            nested=ArrayDict(x=jnp.zeros(2)),
+        )
+        fixed = spec.project(bad)
+        assert spec.is_in(fixed)
+
+    def test_to_sds_tree(self):
+        spec = self.make()
+        sds = spec.to_sds((7,))
+        assert sds["observation"].shape == (7, 3)
+
+    def test_keys_nested(self):
+        spec = self.make()
+        assert ("nested", "x") in spec.keys(nested=True, leaves_only=True)
+
+    def test_eq(self):
+        assert self.make() == self.make()
+        assert self.make() != self.make().delete("action")
+
+
+class TestStackAndInfer:
+    def test_stack_specs_leaf(self):
+        s = stack_specs([Unbounded(shape=(3,))] * 4)
+        assert s.shape == (4, 3)
+
+    def test_stack_specs_composite(self):
+        c = Composite(a=Unbounded(shape=(2,)))
+        s = stack_specs([c, c])
+        # Batch shape grows; child feature shapes stay put.
+        assert s.shape == (2,)
+        assert s["a"].shape == (2,)
+        assert s.rand(KEY)["a"].shape == (2, 2)
+
+    def test_stack_heterogeneous_raises(self):
+        with pytest.raises(ValueError):
+            stack_specs([Unbounded(shape=(2,)), Unbounded(shape=(3,))])
+
+    def test_make_composite_from_arraydict(self):
+        td = ArrayDict(obs=jnp.zeros((4, 3)), nested=ArrayDict(r=jnp.zeros(4)))
+        spec = make_composite_from_arraydict(td)
+        assert spec["obs"].shape == (4, 3)
+        assert spec.is_in(td)
+
+
+class TestRegressions:
+    """Pinned fixes from review: shape double-counting, sharding, projection."""
+
+    def test_nested_dict_batch_shape_once(self):
+        spec = Composite({"a": {"x": Unbounded(shape=(3,))}}, shape=(4,))
+        assert spec.rand(KEY)["a", "x"].shape == (4, 3)
+        assert spec.zero()["a", "x"].shape == (4, 3)
+
+    def test_to_sds_includes_own_batch_shape(self):
+        spec = Composite({"a": Unbounded(shape=(3,))}, shape=(4,))
+        assert spec.to_sds()["a"].shape == (4, 3)
+        assert spec.to_sds((2,))["a"].shape == (2, 4, 3)
+
+    def test_composite_with_sharding(self):
+        from jax.sharding import PartitionSpec
+
+        spec = Composite(a=Unbounded(shape=(3,)))
+        sh = spec.with_sharding(PartitionSpec("data"))
+        assert sh["a"].sharding == PartitionSpec("data")
+
+    def test_categorical_unknown_n_project_passthrough(self):
+        vals = jnp.array([0, 1, 2], jnp.int32)
+        np.testing.assert_array_equal(Categorical().project(vals), vals)
+
+    def test_seed_generator(self):
+        from rl_tpu.utils import seed_generator
+
+        s1 = seed_generator(42)
+        assert s1 == seed_generator(42) != seed_generator(s1)
+
+    def test_arraydict_delete_through_leaf_keyerror(self):
+        td = ArrayDict(a=jnp.zeros(3))
+        with pytest.raises(KeyError):
+            td.delete(("a", "sub"))
+        # exclude() swallows the KeyError and must not free the buffer
+        out = td.exclude(("a", "sub"))
+        assert float(out["a"].sum()) == 0.0
+
+    def test_arraydict_eq_structure_mismatch(self):
+        assert (ArrayDict(x=jnp.zeros(3)) == ArrayDict(y=jnp.zeros(3))) is False
