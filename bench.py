@@ -1,0 +1,77 @@
+"""Benchmark entry point (run by the driver on real TPU hardware).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Metric: PPO env-steps/sec on a single chip — the fused
+collect+GAE+ClipPPO+Adam program (BASELINE.md config #1 path). The
+reference publishes no absolute numbers (BASELINE.md: relative CI tracking
+only), so ``vs_baseline`` is measured against the BASELINE.md north-star
+target of 1M env-steps/s on a v5e-64 pod, i.e. 15625 env-steps/s/chip:
+``vs_baseline = value / 15625``.
+"""
+
+import json
+import time
+
+import jax
+
+from rl_tpu.collectors import Collector
+from rl_tpu.envs import CartPoleEnv, RewardSum, TransformedEnv, VmapEnv
+from rl_tpu.modules import MLP, Categorical, ProbabilisticActor, TDModule, ValueOperator
+from rl_tpu.objectives import ClipPPOLoss
+from rl_tpu.trainers import OnPolicyConfig, OnPolicyProgram
+
+NUM_ENVS = 2048
+FRAMES_PER_BATCH = 65536  # 32 steps x 2048 envs
+TRAIN_STEPS = 8
+PER_CHIP_TARGET = 1_000_000 / 64  # BASELINE.md: 1M steps/s on v5e-64
+
+
+def main():
+    env = TransformedEnv(VmapEnv(CartPoleEnv(), NUM_ENVS), RewardSum())
+    actor = ProbabilisticActor(
+        TDModule(MLP(out_features=2, num_cells=(64, 64)), ["observation"], ["logits"]),
+        Categorical,
+        dist_keys=("logits",),
+    )
+    critic = ValueOperator(MLP(out_features=1, num_cells=(64, 64)))
+    loss = ClipPPOLoss(actor, critic, normalize_advantage=True)
+    loss.make_value_estimator(gamma=0.99, lmbda=0.95)
+    coll = Collector(
+        env, lambda p, td, k: actor(p["actor"], td, k), frames_per_batch=FRAMES_PER_BATCH
+    )
+    program = OnPolicyProgram(
+        coll, loss, OnPolicyConfig(num_epochs=4, minibatch_size=8192)
+    )
+
+    ts = program.init(jax.random.key(0))
+    # NOTE: no donate_argnums — the axon TPU backend rejects donated inputs on
+    # a freshly-compiled executable (INVALID_ARGUMENT); donation gains little
+    # at this model size.
+    step = jax.jit(program.train_step)
+
+    # warmup/compile
+    ts, metrics = step(ts)
+    jax.block_until_ready(metrics)
+
+    t0 = time.perf_counter()
+    for _ in range(TRAIN_STEPS):
+        ts, metrics = step(ts)
+    jax.block_until_ready(metrics)
+    dt = time.perf_counter() - t0
+
+    steps_per_sec = TRAIN_STEPS * FRAMES_PER_BATCH / dt
+    print(
+        json.dumps(
+            {
+                "metric": "ppo_cartpole_env_steps_per_sec_per_chip",
+                "value": round(steps_per_sec, 1),
+                "unit": "env_steps/s",
+                "vs_baseline": round(steps_per_sec / PER_CHIP_TARGET, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,3 @@
+from .single import Collector, CollectorState
+
+__all__ = ["Collector", "CollectorState"]

@@ -1,0 +1,156 @@
+"""Single-program collector: the rollout hot loop as one XLA computation.
+
+Redesign of the reference's ``Collector`` hot loop (reference:
+torchrl/collectors/_single.py:297, ``rollout``:2014 — a Python for-loop of
+policy call + ``env.step_and_maybe_reset`` + device casts per step). Here the
+whole loop is a ``lax.scan`` inside one jit ("Anakin" architecture,
+Podracer/PAPERS.md): no per-step dispatch, no device casts, no worker
+processes for pure-JAX envs.
+
+The collector is functional: ``init(key)`` builds the carried
+:class:`CollectorState`; ``collect(params, cstate)`` returns
+``(batch, cstate)`` where ``batch`` is a time-major ``[T, B, …]`` ArrayDict
+in the reference's ``{…, "next": …}`` layout. Iteration stays in Python (the
+reference's ``for batch in collector``) via :meth:`__iter__`-style usage or
+an explicit loop around a jitted ``collect``.
+
+``policy`` is ``(params, td, key) -> td`` (a TDModule/ProbabilisticActor
+partial-applied or any callable); ``None`` collects random actions
+(``init_random_frames`` analog is a RandomPolicy phase).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+from ..data import ArrayDict
+from ..envs.base import EnvBase
+
+__all__ = ["Collector", "CollectorState"]
+
+CollectorState = ArrayDict  # {"env": env_state, "carry": td, "rng": key, "step_count", "traj_ids"}
+
+
+class Collector:
+    """Collect fixed-size batches by scanning the env+policy.
+
+    Args:
+        env: (possibly vmapped/transformed) environment.
+        policy: ``(params, td, key) -> td`` writing "action" (+extras), or
+            ``None`` for random actions.
+        frames_per_batch: total env frames per yielded batch
+            (= scan_length × num_envs).
+        total_frames: optional budget; :meth:`done` reports exhaustion
+            (the reference's ``total_frames``).
+        postproc: optional ``batch -> batch`` (e.g. MultiStep) applied
+            inside the same jit.
+    """
+
+    def __init__(
+        self,
+        env: EnvBase,
+        policy: Callable | None = None,
+        frames_per_batch: int = 1024,
+        total_frames: int | None = None,
+        postproc: Callable[[ArrayDict], ArrayDict] | None = None,
+        policy_state: ArrayDict | None = None,
+    ):
+        self.env = env
+        self.policy = policy
+        self.policy_state = policy_state
+        num_envs = int(jnp.prod(jnp.asarray(env.batch_shape))) if env.batch_shape else 1
+        if frames_per_batch % num_envs:
+            raise ValueError(
+                f"frames_per_batch={frames_per_batch} not divisible by num_envs={num_envs}"
+            )
+        self.num_envs = num_envs
+        self.scan_length = frames_per_batch // num_envs
+        self.frames_per_batch = frames_per_batch
+        self.total_frames = total_frames
+        self.postproc = postproc
+
+    # -- functional API -------------------------------------------------------
+
+    def init(self, key: jax.Array) -> CollectorState:
+        from ..utils.seeding import ensure_typed_key
+
+        reset_key, carry_key = jax.random.split(ensure_typed_key(key))
+        env_state, td = self.env.reset(reset_key)
+        if self.policy_state is not None:
+            # stateful-policy carry (exploration annealing, OU noise, RNN
+            # hidden): lives in the carry td, stripped from recorded batches
+            td = td.set("exploration", self.policy_state)
+        traj_ids = (
+            jnp.arange(self.num_envs).reshape(self.env.batch_shape)
+            if self.env.batch_shape
+            else jnp.asarray(0)
+        )
+        return ArrayDict(
+            env=env_state,
+            carry=td,
+            rng=carry_key,
+            step_count=jnp.asarray(0, jnp.int64 if jax.config.jax_enable_x64 else jnp.int32),
+            traj_count=jnp.asarray(self.num_envs),
+            traj_ids=traj_ids,
+        )
+
+    def collect(self, params: Any, cstate: CollectorState) -> tuple[ArrayDict, CollectorState]:
+        """One batch. Jit/pjit this (or a composition containing it)."""
+
+        def body(carry, step_key):
+            env_state, td, traj_ids, traj_count = carry
+            if self.policy is None:
+                td = self.env.rand_action(td, step_key)
+            else:
+                td = self.policy(params, td, step_key)
+            env_state, full_td, carry_td = self.env.step_and_reset(
+                env_state, td.exclude("exploration")
+            )
+            if "exploration" in td:
+                carry_td = carry_td.set("exploration", td["exploration"])
+            done = full_td["next", "done"]
+            # new trajectory ids where episodes ended (reference traj_ids
+            # bookkeeping, collectors/utils.py)
+            n_done = jnp.sum(done.astype(jnp.int32))
+            new_ids = traj_count + jnp.cumsum(done.astype(jnp.int32)).reshape(done.shape) - 1
+            traj_ids_next = jnp.where(done, new_ids, traj_ids)
+            full_td = full_td.set("collector", ArrayDict(traj_ids=traj_ids))
+            return (env_state, carry_td, traj_ids_next, traj_count + n_done), full_td
+
+        scan_key, next_rng = jax.random.split(cstate["rng"])
+        keys = jax.random.split(scan_key, self.scan_length)
+        (env_state, carry_td, traj_ids, traj_count), batch = jax.lax.scan(
+            body,
+            (cstate["env"], cstate["carry"], cstate["traj_ids"], cstate["traj_count"]),
+            keys,
+        )
+        if self.postproc is not None:
+            batch = self.postproc(batch)
+        new_state = ArrayDict(
+            env=env_state,
+            carry=carry_td,
+            rng=next_rng,
+            step_count=cstate["step_count"] + self.frames_per_batch,
+            traj_count=traj_count,
+            traj_ids=traj_ids,
+        )
+        return batch, new_state
+
+    # -- ergonomic python-loop API -------------------------------------------
+
+    def frames_collected(self, cstate: CollectorState) -> int:
+        return int(cstate["step_count"])
+
+    def done(self, cstate: CollectorState) -> bool:
+        return self.total_frames is not None and self.frames_collected(cstate) >= self.total_frames
+
+    def iterate(self, params: Any, key: jax.Array, jit: bool = True):
+        """Generator over batches (the reference's ``for data in collector``)."""
+        collect = jax.jit(self.collect) if jit else self.collect
+        cstate = self.init(key)
+        while not self.done(cstate):
+            batch, cstate = collect(params, cstate)
+            yield batch

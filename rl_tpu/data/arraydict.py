@@ -112,11 +112,17 @@ class ArrayDict(Mapping):
         return len(self._data)
 
     def __contains__(self, key: Any) -> bool:
-        try:
-            self[key] if isinstance(key, (str, tuple)) else None
-        except KeyError:
+        is_path = isinstance(key, str) or (
+            isinstance(key, tuple) and bool(key) and all(isinstance(k, str) for k in key)
+        )
+        if not is_path:
             return False
-        return isinstance(key, (str, tuple))
+        try:
+            self[key]
+        except (KeyError, TypeError):
+            # TypeError: path traverses through an array leaf
+            return False
+        return True
 
     def keys(self, nested: bool = False, leaves_only: bool = False):
         if not nested:

@@ -1,0 +1,348 @@
+"""Functional environment core.
+
+TPU-native redesign of the reference's ``EnvBase``
+(reference: torchrl/envs/common.py:404; public ``step``:2340, ``reset``:3108,
+``rollout``:3449, ``step_and_maybe_reset``:4090, ``step_mdp``:3869).
+
+The reference is stateful (`env.step(td)` mutates module state); here every
+environment is a **pure function of an explicit state**, which is what lets
+XLA stage entire rollouts:
+
+- ``reset(key) -> (state, td)``
+- ``step(state, td_with_action) -> (state, td)`` where the returned ``td``
+  holds the *pre-step* content plus a ``"next"`` sub-dict — the same data
+  layout the reference's collectors emit, so losses/value estimators read
+  batches identically.
+- ``step_and_reset`` fuses step with masked auto-reset (the
+  ``step_and_maybe_reset`` analog): sub-envs that finished are re-seeded via
+  ``jnp.where`` masking instead of host-side partial resets.
+- ``rollout`` is a ``lax.scan`` over time, vectorization is ``jax.vmap`` via
+  :class:`VmapEnv` — no worker processes (the ParallelEnv replacement for
+  pure-JAX envs; host envs get a separate pool in rl_tpu.collectors).
+
+Randomness: the env state carries a PRNG key at ``state["rng"]``; stochastic
+``_step``/``_reset`` impls split from it functionally.
+
+Conventions vs the reference: reward/done are scalar-shaped ``()`` leaves
+(not ``(1,)``) — the natural JAX form; specs document it.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+from ..data import ArrayDict, Composite, Spec, Unbounded
+from ..data.specs import Binary
+
+__all__ = ["EnvBase", "VmapEnv", "EnvState", "rollout", "step_mdp"]
+
+EnvState = ArrayDict  # alias: env state is just an ArrayDict carrying "rng"
+
+DONE_KEYS = ("done", "terminated", "truncated")
+
+
+class EnvBase:
+    """Abstract pure-functional environment.
+
+    Subclasses implement :meth:`_reset` and :meth:`_step` and define the spec
+    properties. Both hooks receive/return ArrayDicts and must be jit-safe
+    (traced shapes only, ``lax`` control flow).
+
+    Subclass contract:
+
+    - ``_reset(key) -> (state, obs_td)``: fresh episode state + observations.
+      ``state`` must NOT include "rng" (the base manages it).
+    - ``_step(state, action, key) -> (state, obs_td, reward, terminated,
+      truncated)``: one transition. ``reward`` scalar f32, flags scalar bool.
+    """
+
+    # -- specs (subclass responsibility) --------------------------------------
+
+    @property
+    def observation_spec(self) -> Composite:
+        raise NotImplementedError
+
+    @property
+    def action_spec(self) -> Spec:
+        raise NotImplementedError
+
+    @property
+    def reward_spec(self) -> Spec:
+        return Unbounded(shape=(), dtype=jnp.float32)
+
+    @property
+    def done_spec(self) -> Composite:
+        return Composite(
+            done=Binary(shape=()),
+            terminated=Binary(shape=()),
+            truncated=Binary(shape=()),
+        )
+
+    @property
+    def state_spec(self) -> Composite:
+        """Spec of the env's carry state (excluding "rng"); optional."""
+        return Composite()
+
+    @property
+    def full_specs(self) -> Composite:
+        """The complete env contract (reference ``env.specs``, common.py:3430)."""
+        return Composite(
+            observation=self.observation_spec,
+            action=Composite(action=self.action_spec),
+            reward=Composite(reward=self.reward_spec),
+            done=self.done_spec,
+            state=self.state_spec,
+        )
+
+    @property
+    def batch_shape(self) -> tuple[int, ...]:
+        return ()
+
+    # -- subclass hooks -------------------------------------------------------
+
+    def _reset(self, key: jax.Array) -> tuple[ArrayDict, ArrayDict]:
+        raise NotImplementedError
+
+    def _step(
+        self, state: ArrayDict, action: Any, key: jax.Array
+    ) -> tuple[ArrayDict, ArrayDict, jax.Array, jax.Array, jax.Array]:
+        raise NotImplementedError
+
+    # -- public API -----------------------------------------------------------
+
+    def reset(self, key: jax.Array) -> tuple[EnvState, ArrayDict]:
+        """Start an episode: returns (state, td) with observations + done flags."""
+        from ..utils.seeding import ensure_typed_key
+
+        reset_key, carry_key = jax.random.split(ensure_typed_key(key))
+        state, obs = self._reset(reset_key)
+        state = state.set("rng", carry_key)
+        zero = jnp.zeros(self.batch_shape, jnp.bool_)
+        td = obs.update(
+            ArrayDict(done=zero, terminated=zero, truncated=zero)
+        )
+        return state, td
+
+    def step(self, state: EnvState, td: ArrayDict) -> tuple[EnvState, ArrayDict]:
+        """One transition. ``td`` must hold "action"; the result carries the
+        input content plus ``"next"`` = {obs…, reward, done, terminated,
+        truncated} (the reference's step output layout, common.py:2340)."""
+        key = state["rng"]
+        step_key, carry_key = jax.random.split(key)
+        new_state, obs, reward, terminated, truncated = self._step(
+            state.exclude("rng"), td["action"], step_key
+        )
+        new_state = new_state.set("rng", carry_key)
+        next_td = obs.update(
+            ArrayDict(
+                reward=jnp.asarray(reward, jnp.float32),
+                terminated=jnp.asarray(terminated, jnp.bool_),
+                truncated=jnp.asarray(truncated, jnp.bool_),
+            )
+        )
+        next_td = next_td.set("done", next_td["terminated"] | next_td["truncated"])
+        return new_state, td.set("next", next_td)
+
+    @property
+    def _rng_path(self) -> tuple[str, ...]:
+        """Where the carried PRNG key lives in the env state."""
+        return ("rng",)
+
+    def _spec_state(self, state: EnvState) -> ArrayDict:
+        """The slice of ``state`` described by :attr:`state_spec` (wrappers
+        strip their bookkeeping)."""
+        return state.exclude("rng")
+
+    def step_and_reset(
+        self, state: EnvState, td: ArrayDict
+    ) -> tuple[EnvState, ArrayDict, ArrayDict]:
+        """Step, then auto-reset wherever the episode ended.
+
+        Returns ``(carry_state, full_td, carry_td)``: ``full_td`` is the
+        transition for storage (its "next" holds the terminal observation);
+        ``carry_td`` holds the observation to act on next (post-reset where
+        done). Masked-``where`` equivalent of the reference's
+        ``step_and_maybe_reset`` (common.py:4090) — fixed-shape, vmap-safe.
+        """
+        new_state, full_td = self.step(state, td)
+        rng_path = self._rng_path
+        rng = new_state[rng_path]
+        if rng.shape == ():
+            reset_key, carry_key = jax.random.split(rng)
+        else:
+            # batched carry keys (a wrapped VmapEnv): advance each, derive a
+            # single reset key (reset() re-splits it per sub-env)
+            pairs = jax.vmap(jax.random.split)(rng.reshape(-1))
+            carry_key = pairs[:, 1].reshape(rng.shape)
+            reset_key = pairs[0, 0]
+        reset_state, reset_td = self.reset(reset_key)
+
+        done = full_td["next", "done"]
+        carry_td = where_done(done, reset_td, step_mdp(full_td))
+        carry_state = where_done(
+            done, reset_state.delete(rng_path), new_state.delete(rng_path)
+        )
+        carry_state = carry_state.set(rng_path, carry_key)
+        return carry_state, full_td, carry_td
+
+    # -- conveniences ---------------------------------------------------------
+
+    def rand_action(self, td: ArrayDict, key: jax.Array) -> ArrayDict:
+        return td.set("action", self.action_spec.rand(key, self.batch_shape))
+
+    def rollout(
+        self,
+        key: jax.Array,
+        policy: Callable[[ArrayDict, jax.Array], ArrayDict] | None = None,
+        max_steps: int = 100,
+        auto_reset: bool = True,
+        break_when_any_done: bool = False,
+    ) -> ArrayDict:
+        return rollout(
+            self,
+            key,
+            policy,
+            max_steps=max_steps,
+            auto_reset=auto_reset,
+            break_when_any_done=break_when_any_done,
+        )
+
+
+def where_done(done: jax.Array, on_done, on_not_done):
+    """Leaf-wise ``where`` with ``done`` broadcast over trailing feature dims."""
+
+    def pick(a, b):
+        d = done.reshape(done.shape + (1,) * (a.ndim - done.ndim))
+        return jnp.where(d, a, b)
+
+    return jax.tree.map(pick, on_done, on_not_done)
+
+
+def step_mdp(td: ArrayDict) -> ArrayDict:
+    """Project the "next" content to the root for the following step.
+
+    Reference: ``EnvBase.step_mdp`` (common.py:3869) / ``_StepMDP``
+    (envs/utils.py:79): next-observations and done flags move to the root,
+    action/reward are dropped.
+    """
+    nxt = td["next"]
+    return nxt.exclude("reward")
+
+
+def rollout(
+    env: EnvBase,
+    key: jax.Array,
+    policy: Callable[[ArrayDict, jax.Array], ArrayDict] | None = None,
+    max_steps: int = 100,
+    auto_reset: bool = True,
+    break_when_any_done: bool = False,
+    init: tuple[EnvState, ArrayDict] | None = None,
+    policy_state: ArrayDict | None = None,
+) -> ArrayDict:
+    """Unrolled interaction as a single ``lax.scan`` (reference common.py:3449).
+
+    The result has time as the leading batch axis: ``out.batch_shape ==
+    (max_steps, *env.batch_shape)``, with the reference's ``{…, "next": …}``
+    per-step layout. ``policy`` maps ``(td, key) -> td`` adding "action" (and
+    any extras, e.g. "log_prob"); ``None`` takes random actions.
+
+    ``policy_state`` seeds stateful-policy carry (exploration annealing, OU
+    noise, RNN hidden state) under td["exploration"]/td["policy_carry"]; it is
+    carried across steps and stripped from the recorded batch. The policy must
+    keep its structure fixed (scan requirement).
+
+    ``break_when_any_done=True`` stops *recording* once any sub-env is done
+    (steps after the first done are masked invalid via "mask"); the scan
+    length stays static — the jit-compatible form of the reference's
+    ``_rollout_stop_early``.
+    """
+    from ..utils.seeding import ensure_typed_key
+
+    if policy is None:
+        policy = lambda td, k: env.rand_action(td, k)  # noqa: E731
+
+    reset_key, scan_key = jax.random.split(ensure_typed_key(key))
+    if init is not None:
+        state, td = init
+    else:
+        state, td = env.reset(reset_key)
+    if policy_state is not None:
+        td = td.set("exploration", policy_state)
+
+    def body(carry, step_key):
+        state, td, alive = carry
+        td = policy(td, step_key)
+        td_env = td.exclude("exploration")
+        if auto_reset:
+            state, full_td, carry_td = env.step_and_reset(state, td_env)
+        else:
+            state, full_td = env.step(state, td_env)
+            carry_td = step_mdp(full_td)
+        if "exploration" in td:
+            carry_td = carry_td.set("exploration", td["exploration"])
+        full_td = full_td.set("mask", alive)
+        alive = alive & ~jnp.any(full_td["next", "done"]) if break_when_any_done else alive
+        return (state, carry_td, alive), full_td
+
+    keys = jax.random.split(scan_key, max_steps)
+    (_, _, _), steps = jax.lax.scan(body, (state, td, jnp.asarray(True)), keys)
+    if not break_when_any_done:
+        steps = steps.exclude("mask")
+    return steps
+
+
+class VmapEnv(EnvBase):
+    """Vectorize a scalar env over a leading batch axis with ``jax.vmap``.
+
+    The replacement for the reference's ``SerialEnv``/``ParallelEnv``
+    (batched_envs.py:1433,1805) for pure-JAX envs: N identical envs stepped
+    as one XLA program — no worker processes, no shared-memory buffers.
+    """
+
+    def __init__(self, env: EnvBase, num_envs: int):
+        if env.batch_shape != ():
+            raise ValueError("VmapEnv wraps scalar (unbatched) envs")
+        self.env = env
+        self.num_envs = num_envs
+
+    @property
+    def batch_shape(self) -> tuple[int, ...]:
+        return (self.num_envs,)
+
+    @property
+    def observation_spec(self) -> Composite:
+        return self.env.observation_spec
+
+    @property
+    def action_spec(self) -> Spec:
+        return self.env.action_spec
+
+    @property
+    def reward_spec(self) -> Spec:
+        return self.env.reward_spec
+
+    @property
+    def done_spec(self) -> Composite:
+        return self.env.done_spec
+
+    @property
+    def state_spec(self) -> Composite:
+        return self.env.state_spec
+
+    def reset(self, key: jax.Array) -> tuple[EnvState, ArrayDict]:
+        from ..utils.seeding import ensure_typed_key
+
+        keys = jax.random.split(ensure_typed_key(key), self.num_envs)
+        return jax.vmap(self.env.reset)(keys)
+
+    def step(self, state: EnvState, td: ArrayDict) -> tuple[EnvState, ArrayDict]:
+        return jax.vmap(self.env.step)(state, td)
+
+    def step_and_reset(self, state, td):
+        return jax.vmap(self.env.step_and_reset)(state, td)
+
+    def rand_action(self, td: ArrayDict, key: jax.Array) -> ArrayDict:
+        return td.set("action", self.action_spec.rand(key, (self.num_envs,)))

@@ -1,0 +1,4 @@
+from .cartpole import CartPoleEnv
+from .pendulum import PendulumEnv
+
+__all__ = ["PendulumEnv", "CartPoleEnv"]

@@ -1,0 +1,81 @@
+"""CartPole balance, pure JAX (classic Gym CartPole-v1 dynamics).
+
+Discrete-action counterpart to :mod:`pendulum` for the DQN/PPO recipes
+(BASELINE.md config #1). Euler integration, 500-step truncation,
+termination on |x| > 2.4 or |theta| > 12 deg.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...data import ArrayDict, Bounded, Categorical, Composite, Unbounded
+from ..base import EnvBase
+
+__all__ = ["CartPoleEnv"]
+
+
+class CartPoleEnv(EnvBase):
+    gravity = 9.8
+    masscart = 1.0
+    masspole = 0.1
+    length = 0.5  # half pole length
+    force_mag = 10.0
+    tau = 0.02
+    theta_threshold = 12 * 2 * jnp.pi / 360
+    x_threshold = 2.4
+
+    def __init__(self, max_episode_steps: int = 500):
+        self.max_episode_steps = max_episode_steps
+
+    @property
+    def observation_spec(self) -> Composite:
+        high = jnp.array(
+            [self.x_threshold * 2, 1e5, self.theta_threshold * 2, 1e5],
+            jnp.float32,
+        )
+        return Composite(observation=Bounded(shape=(4,), low=-high, high=high))
+
+    @property
+    def action_spec(self):
+        return Categorical(n=2)
+
+    @property
+    def state_spec(self) -> Composite:
+        return Composite(
+            physics=Unbounded(shape=(4,)),
+            step_count=Unbounded(shape=(), dtype=jnp.int32),
+        )
+
+    def _reset(self, key):
+        physics = jax.random.uniform(key, (4,), minval=-0.05, maxval=0.05)
+        state = ArrayDict(physics=physics, step_count=jnp.asarray(0, jnp.int32))
+        return state, ArrayDict(observation=physics)
+
+    def _step(self, state, action, key):
+        x, x_dot, theta, theta_dot = state["physics"]
+        force = jnp.where(action == 1, self.force_mag, -self.force_mag)
+        costheta, sintheta = jnp.cos(theta), jnp.sin(theta)
+
+        total_mass = self.masscart + self.masspole
+        polemass_length = self.masspole * self.length
+        temp = (force + polemass_length * theta_dot**2 * sintheta) / total_mass
+        thetaacc = (self.gravity * sintheta - costheta * temp) / (
+            self.length * (4.0 / 3.0 - self.masspole * costheta**2 / total_mass)
+        )
+        xacc = temp - polemass_length * thetaacc * costheta / total_mass
+
+        x = x + self.tau * x_dot
+        x_dot = x_dot + self.tau * xacc
+        theta = theta + self.tau * theta_dot
+        theta_dot = theta_dot + self.tau * thetaacc
+        physics = jnp.stack([x, x_dot, theta, theta_dot])
+
+        count = state["step_count"] + 1
+        terminated = (
+            (jnp.abs(x) > self.x_threshold) | (jnp.abs(theta) > self.theta_threshold)
+        )
+        truncated = count >= self.max_episode_steps
+        new_state = ArrayDict(physics=physics, step_count=count)
+        return new_state, ArrayDict(observation=physics), jnp.asarray(1.0), terminated, truncated

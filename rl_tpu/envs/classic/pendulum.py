@@ -1,0 +1,87 @@
+"""Pendulum swing-up, pure JAX.
+
+Functional re-design of the reference's pure-torch ``PendulumEnv``
+(reference: torchrl/envs/custom/pendulum.py) with classic Gym dynamics:
+state (theta, theta_dot), action torque in [-2, 2], reward
+-(theta^2 + 0.1*thdot^2 + 0.001*u^2), 200-step truncation.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...data import ArrayDict, Bounded, Composite, Unbounded
+from ..base import EnvBase
+
+__all__ = ["PendulumEnv"]
+
+
+def _angle_normalize(x):
+    return ((x + jnp.pi) % (2 * jnp.pi)) - jnp.pi
+
+
+class PendulumEnv(EnvBase):
+    max_speed = 8.0
+    max_torque = 2.0
+    dt = 0.05
+    g = 10.0
+    m = 1.0
+    length = 1.0
+
+    def __init__(self, max_episode_steps: int = 200):
+        self.max_episode_steps = max_episode_steps
+
+    @property
+    def observation_spec(self) -> Composite:
+        return Composite(
+            observation=Bounded(
+                shape=(3,),
+                low=jnp.array([-1.0, -1.0, -self.max_speed]),
+                high=jnp.array([1.0, 1.0, self.max_speed]),
+            )
+        )
+
+    @property
+    def action_spec(self):
+        return Bounded(shape=(1,), low=-self.max_torque, high=self.max_torque)
+
+    @property
+    def state_spec(self) -> Composite:
+        return Composite(
+            theta=Unbounded(shape=()),
+            theta_dot=Unbounded(shape=()),
+            step_count=Unbounded(shape=(), dtype=jnp.int32),
+        )
+
+    def _obs(self, theta, theta_dot) -> ArrayDict:
+        return ArrayDict(
+            observation=jnp.stack([jnp.cos(theta), jnp.sin(theta), theta_dot])
+        )
+
+    def _reset(self, key):
+        k1, k2 = jax.random.split(key)
+        theta = jax.random.uniform(k1, (), minval=-jnp.pi, maxval=jnp.pi)
+        theta_dot = jax.random.uniform(k2, (), minval=-1.0, maxval=1.0)
+        state = ArrayDict(
+            theta=theta, theta_dot=theta_dot, step_count=jnp.asarray(0, jnp.int32)
+        )
+        return state, self._obs(theta, theta_dot)
+
+    def _step(self, state, action, key):
+        th, thdot = state["theta"], state["theta_dot"]
+        u = jnp.clip(jnp.squeeze(action, -1), -self.max_torque, self.max_torque)
+
+        cost = _angle_normalize(th) ** 2 + 0.1 * thdot**2 + 0.001 * u**2
+        newthdot = thdot + (
+            3.0 * self.g / (2.0 * self.length) * jnp.sin(th)
+            + 3.0 / (self.m * self.length**2) * u
+        ) * self.dt
+        newthdot = jnp.clip(newthdot, -self.max_speed, self.max_speed)
+        newth = th + newthdot * self.dt
+
+        count = state["step_count"] + 1
+        new_state = ArrayDict(theta=newth, theta_dot=newthdot, step_count=count)
+        truncated = count >= self.max_episode_steps
+        terminated = jnp.asarray(False)
+        return new_state, self._obs(newth, newthdot), -cost, terminated, truncated

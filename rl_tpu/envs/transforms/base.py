@@ -1,0 +1,206 @@
+"""Composable env transforms, functional form.
+
+Redesign of the reference's transform stack (reference:
+torchrl/envs/transforms/_base.py — ``Transform``:178 with hooks ``_call``:510
+(post-step), ``inv``:622 (pre-step), ``_reset``:350, spec transformers :715+;
+``TransformedEnv``:940; ``Compose``:1642).
+
+The reference's transforms are stateful nn.Modules; here a transform is a
+pure object whose mutable state (frame buffers, counters, running sums) is an
+explicit ArrayDict carried inside the env state under ``("transforms", name)``
+— so a TransformedEnv is still a pure ``state -> state`` function and whole
+rollouts stay inside one XLA program.
+
+Hook map (reference -> here):
+  ``_reset``            -> ``reset(tstate, td) -> (tstate, td)``
+  ``_call`` (post-step) -> ``step(tstate, next_td) -> (tstate, next_td)``
+  ``inv`` (pre-step)    -> ``inv(td) -> td``
+  ``transform_*_spec``  -> same names
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+
+from ...data import ArrayDict, Composite, Spec
+from ..base import EnvBase, EnvState
+
+__all__ = ["Transform", "TransformedEnv", "Compose"]
+
+
+class Transform:
+    """Base transform: identity everywhere. Subclasses override hooks."""
+
+    @property
+    def name(self) -> str:
+        return type(self).__name__
+
+    # -- state ----------------------------------------------------------------
+
+    def init(self, reset_td: ArrayDict) -> ArrayDict:
+        """Initial carry state, built from a reset output (shape inference)."""
+        return ArrayDict()
+
+    # -- data hooks -----------------------------------------------------------
+
+    def reset(self, tstate: ArrayDict, td: ArrayDict) -> tuple[ArrayDict, ArrayDict]:
+        """Applied to reset output (fresh ``tstate`` from :meth:`init`)."""
+        return tstate, td
+
+    def step(self, tstate: ArrayDict, next_td: ArrayDict) -> tuple[ArrayDict, ArrayDict]:
+        """Applied to the "next" content produced by the base env's step."""
+        return tstate, next_td
+
+    def inv(self, td: ArrayDict) -> ArrayDict:
+        """Applied to the input (action) before the base env's step."""
+        return td
+
+    # -- spec hooks -----------------------------------------------------------
+
+    def transform_observation_spec(self, spec: Composite) -> Composite:
+        return spec
+
+    def transform_action_spec(self, spec: Spec) -> Spec:
+        return spec
+
+    def transform_reward_spec(self, spec: Spec) -> Spec:
+        return spec
+
+    def transform_done_spec(self, spec: Composite) -> Composite:
+        return spec
+
+    def __repr__(self) -> str:
+        return f"{type(self).__name__}()"
+
+
+class Compose(Transform):
+    """Chain of transforms applied in order (reference _base.py:1642)."""
+
+    def __init__(self, *transforms: Transform):
+        self.transforms = list(transforms)
+
+    def init(self, reset_td: ArrayDict) -> ArrayDict:
+        out = ArrayDict()
+        td = reset_td
+        for i, t in enumerate(self.transforms):
+            ts = t.init(td)
+            ts, td = t.reset(ts, td)
+            out = out.set(f"t{i}", ts)
+        return out
+
+    def reset(self, tstate, td):
+        out = ArrayDict()
+        for i, t in enumerate(self.transforms):
+            ts, td = t.reset(tstate[f"t{i}"], td)
+            out = out.set(f"t{i}", ts)
+        return out, td
+
+    def step(self, tstate, next_td):
+        out = ArrayDict()
+        for i, t in enumerate(self.transforms):
+            ts, next_td = t.step(tstate[f"t{i}"], next_td)
+            out = out.set(f"t{i}", ts)
+        return out, next_td
+
+    def inv(self, td):
+        for t in reversed(self.transforms):
+            td = t.inv(td)
+        return td
+
+    def transform_observation_spec(self, spec):
+        for t in self.transforms:
+            spec = t.transform_observation_spec(spec)
+        return spec
+
+    def transform_action_spec(self, spec):
+        for t in reversed(self.transforms):
+            spec = t.transform_action_spec(spec)
+        return spec
+
+    def transform_reward_spec(self, spec):
+        for t in self.transforms:
+            spec = t.transform_reward_spec(spec)
+        return spec
+
+    def transform_done_spec(self, spec):
+        for t in self.transforms:
+            spec = t.transform_done_spec(spec)
+        return spec
+
+    def append(self, t: Transform) -> "Compose":
+        return Compose(*self.transforms, t)
+
+    def __repr__(self):
+        return f"Compose({', '.join(map(repr, self.transforms))})"
+
+
+class TransformedEnv(EnvBase):
+    """An env with a transform pipeline (reference _base.py:940).
+
+    State layout: ``{"env": base_state, "transforms": per-transform state}``.
+    ``init()``-time spec transformation means the declared specs always match
+    the transformed data, so ``check_env_specs`` validates the whole stack.
+    """
+
+    def __init__(self, env: EnvBase, transform: Transform | Sequence[Transform]):
+        if isinstance(transform, (list, tuple)):
+            transform = Compose(*transform)
+        self.env = env
+        self.transform = transform
+        # Run spec transformation eagerly: transforms that cache spec-derived
+        # layout (feature ndims etc.) are initialized before any data flows.
+        self.transform.transform_observation_spec(env.observation_spec)
+
+    @property
+    def base_env(self) -> EnvBase:
+        return self.env
+
+    @property
+    def batch_shape(self):
+        return self.env.batch_shape
+
+    @property
+    def observation_spec(self) -> Composite:
+        return self.transform.transform_observation_spec(self.env.observation_spec)
+
+    @property
+    def action_spec(self) -> Spec:
+        return self.transform.transform_action_spec(self.env.action_spec)
+
+    @property
+    def reward_spec(self) -> Spec:
+        return self.transform.transform_reward_spec(self.env.reward_spec)
+
+    @property
+    def done_spec(self) -> Composite:
+        return self.transform.transform_done_spec(self.env.done_spec)
+
+    @property
+    def state_spec(self) -> Composite:
+        return self.env.state_spec
+
+    def reset(self, key: jax.Array):
+        base_state, td = self.env.reset(key)
+        tstate = self.transform.init(td)
+        tstate, td = self.transform.reset(tstate, td)
+        return ArrayDict(env=base_state, transforms=tstate), td
+
+    def step(self, state: EnvState, td: ArrayDict):
+        td_in = self.transform.inv(td)
+        base_state, out = self.env.step(state["env"], td_in)
+        tstate, next_td = self.transform.step(state["transforms"], out["next"])
+        # keep the (un-inv'ed) input content at the root
+        out = td.set("next", next_td)
+        return ArrayDict(env=base_state, transforms=tstate), out
+
+    @property
+    def _rng_path(self) -> tuple[str, ...]:
+        return ("env",) + self.env._rng_path
+
+    def _spec_state(self, state):
+        return self.env._spec_state(state["env"])
+
+    def rand_action(self, td, key):
+        return td.set("action", self.action_spec.rand(key, self.batch_shape))

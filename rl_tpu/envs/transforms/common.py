@@ -1,0 +1,410 @@
+"""Core transform library, first wave.
+
+Functional re-designs of the most-used reference transforms
+(reference: torchrl/envs/transforms/transforms.py via transforms/__init__.py):
+ObservationNorm, RewardScaling, RewardClipping, RewardSum, StepCounter,
+InitTracker, CatFrames, FlattenObservation, DTypeCast/DoubleToFloat,
+RenameTransform, CatTensors, UnsqueezeTransform, SqueezeTransform,
+ActionScaling/TanhAction (action domain mapping).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+
+from ...data import ArrayDict, Binary, Bounded, Composite, Spec, Unbounded
+from .base import Transform
+
+__all__ = [
+    "ObservationNorm",
+    "RewardScaling",
+    "RewardClipping",
+    "RewardSum",
+    "StepCounter",
+    "InitTracker",
+    "CatFrames",
+    "FlattenObservation",
+    "DTypeCast",
+    "DoubleToFloat",
+    "RenameTransform",
+    "CatTensors",
+    "UnsqueezeTransform",
+    "SqueezeTransform",
+    "ActionScaling",
+]
+
+
+def _obs_keys(spec_or_td, in_keys):
+    if in_keys is not None:
+        return [k if isinstance(k, tuple) else (k,) for k in in_keys]
+    return list(spec_or_td.keys(nested=True, leaves_only=True))
+
+
+class _KeyedTransform(Transform):
+    """Shared machinery for transforms acting on a set of observation keys."""
+
+    def __init__(self, in_keys=None):
+        self.in_keys = in_keys
+
+    def _keys(self, td_or_spec):
+        return _obs_keys(td_or_spec, self.in_keys)
+
+    def _apply_leaf(self, x):
+        raise NotImplementedError
+
+    def _apply(self, td: ArrayDict) -> ArrayDict:
+        for k in self._keys(td):
+            if k in td:
+                td = td.set(k, self._apply_leaf(td[k]))
+        return td
+
+    def reset(self, tstate, td):
+        return tstate, self._apply(td)
+
+    def step(self, tstate, next_td):
+        return tstate, self._apply(next_td)
+
+
+class ObservationNorm(_KeyedTransform):
+    """Affine observation normalization (reference ObservationNorm):
+    ``out = (obs - loc) / scale`` (standard form) or ``obs * scale + loc``."""
+
+    def __init__(self, loc, scale, in_keys=None, standard_normal: bool = True):
+        super().__init__(in_keys)
+        self.loc = jnp.asarray(loc)
+        self.scale = jnp.asarray(scale)
+        self.standard_normal = standard_normal
+
+    def _apply_leaf(self, x):
+        if self.standard_normal:
+            return (x - self.loc) / self.scale
+        return x * self.scale + self.loc
+
+    def transform_observation_spec(self, spec):
+        for k in self._keys(spec):
+            leaf = spec[k]
+            spec = spec.set(k, Unbounded(shape=leaf.shape, dtype=leaf.dtype))
+        return spec
+
+
+class RewardScaling(Transform):
+    """``reward <- reward * scale + loc`` (reference RewardScaling)."""
+
+    def __init__(self, loc: float = 0.0, scale: float = 1.0):
+        self.loc = loc
+        self.scale = scale
+
+    def step(self, tstate, next_td):
+        return tstate, next_td.set("reward", next_td["reward"] * self.scale + self.loc)
+
+
+class RewardClipping(Transform):
+    """Clamp rewards into [clamp_min, clamp_max] (reference RewardClipping)."""
+
+    def __init__(self, clamp_min: float = -1.0, clamp_max: float = 1.0):
+        self.clamp_min = clamp_min
+        self.clamp_max = clamp_max
+
+    def step(self, tstate, next_td):
+        r = jnp.clip(next_td["reward"], self.clamp_min, self.clamp_max)
+        return tstate, next_td.set("reward", r)
+
+
+class RewardSum(Transform):
+    """Accumulate episode return into "episode_reward" (reference RewardSum).
+
+    The running sum is carried in transform state and reset on episode end
+    (done-masked, so it composes with auto-reset).
+    """
+
+    def init(self, reset_td):
+        zero = jnp.zeros(reset_td["done"].shape, jnp.float32)
+        return ArrayDict(episode_reward=zero)
+
+    def reset(self, tstate, td):
+        return tstate, td.set("episode_reward", tstate["episode_reward"])
+
+    def step(self, tstate, next_td):
+        total = tstate["episode_reward"] + next_td["reward"]
+        out = next_td.set("episode_reward", total)
+        # zero the carry where the episode ended so the next episode restarts
+        carry = jnp.where(next_td["done"], 0.0, total)
+        return ArrayDict(episode_reward=carry), out
+
+    def transform_observation_spec(self, spec):
+        return spec.set("episode_reward", Unbounded(shape=()))
+
+
+class StepCounter(Transform):
+    """Count steps since reset into "step_count"; optionally truncate at
+    ``max_steps`` (reference StepCounter)."""
+
+    def __init__(self, max_steps: int | None = None):
+        self.max_steps = max_steps
+
+    def init(self, reset_td):
+        zero = jnp.zeros(reset_td["done"].shape, jnp.int32)
+        return ArrayDict(step_count=zero)
+
+    def reset(self, tstate, td):
+        return tstate, td.set("step_count", tstate["step_count"])
+
+    def step(self, tstate, next_td):
+        count = tstate["step_count"] + 1
+        out = next_td.set("step_count", count)
+        if self.max_steps is not None:
+            trunc = out["truncated"] | (count >= self.max_steps)
+            out = out.set("truncated", trunc).set("done", out["terminated"] | trunc)
+        carry = jnp.where(out["done"], 0, count)
+        return ArrayDict(step_count=carry), out
+
+    def transform_observation_spec(self, spec):
+        return spec.set("step_count", Unbounded(shape=(), dtype=jnp.int32))
+
+
+class InitTracker(Transform):
+    """"is_init" flag: True on the first step of an episode (reference
+    InitTracker) — consumed by RNN reset handling."""
+
+    def init(self, reset_td):
+        return ArrayDict()
+
+    def reset(self, tstate, td):
+        return tstate, td.set("is_init", jnp.ones(td["done"].shape, jnp.bool_))
+
+    def step(self, tstate, next_td):
+        # the step after a done is an init step (auto-reset convention)
+        return tstate, next_td.set("is_init", next_td["done"])
+
+    def transform_observation_spec(self, spec):
+        return spec.set("is_init", Binary(shape=()))
+
+
+class CatFrames(Transform):
+    """Stack the last N observations along a new/existing axis (reference
+    CatFrames). Buffer carried in transform state; done-reset aware."""
+
+    def __init__(self, n: int = 4, in_key: str = "observation", axis: int = -1):
+        if axis != -1:
+            raise NotImplementedError("CatFrames currently stacks on the last axis")
+        self.n = n
+        self.in_key = in_key
+
+    def init(self, reset_td):
+        obs = reset_td[self.in_key]
+        buf = jnp.repeat(obs[..., None], self.n, axis=-1)
+        return ArrayDict(frames=buf)
+
+    def _flat(self, buf):
+        return buf.reshape(buf.shape[:-2] + (buf.shape[-2] * buf.shape[-1],))
+
+    def reset(self, tstate, td):
+        obs = td[self.in_key]
+        buf = jnp.repeat(obs[..., None], self.n, axis=-1)
+        return ArrayDict(frames=buf), td.set(self.in_key, self._flat(buf))
+
+    def step(self, tstate, next_td):
+        obs = next_td[self.in_key]
+        buf = jnp.concatenate(
+            [tstate["frames"][..., 1:], obs[..., None]], axis=-1
+        )
+        return ArrayDict(frames=buf), next_td.set(self.in_key, self._flat(buf))
+
+    def transform_observation_spec(self, spec):
+        leaf = spec[self.in_key]
+        new_shape = leaf.shape[:-1] + (leaf.shape[-1] * self.n,)
+        if isinstance(leaf, Bounded):
+            # buffer layout is (..., D, N) flattened -> each element's N
+            # frames are contiguous, so bounds repeat element-wise
+            low = jnp.repeat(jnp.asarray(leaf.low), self.n)
+            high = jnp.repeat(jnp.asarray(leaf.high), self.n)
+            return spec.set(self.in_key, Bounded(shape=new_shape, low=low, high=high, dtype=leaf.dtype))
+        return spec.set(self.in_key, dataclasses.replace(leaf, shape=new_shape))
+
+
+class FlattenObservation(_KeyedTransform):
+    """Flatten the last ``ndims`` observation dims to 1-D (reference
+    FlattenObservation). ``ndims`` is explicit (e.g. 3 for HWC images)
+    because batch dims are not knowable from data alone."""
+
+    def __init__(self, ndims: int, in_keys=None):
+        super().__init__(in_keys)
+        if ndims < 1:
+            raise ValueError("ndims must be >= 1")
+        self.ndims = ndims
+
+    def _apply_leaf(self, x):
+        return x.reshape(x.shape[: x.ndim - self.ndims] + (-1,))
+
+    def transform_observation_spec(self, spec):
+        for k in self._keys(spec):
+            leaf = spec[k]
+            keep = leaf.shape[: len(leaf.shape) - self.ndims]
+            flat = int(jnp.prod(jnp.asarray(leaf.shape[len(leaf.shape) - self.ndims :])))
+            spec = spec.set(k, Unbounded(shape=keep + (flat,), dtype=leaf.dtype))
+        return spec
+
+
+class DTypeCast(_KeyedTransform):
+    """Cast observation leaves to a dtype (reference DTypeCastTransform)."""
+
+    def __init__(self, dtype_in, dtype_out, in_keys=None):
+        super().__init__(in_keys)
+        self.dtype_in = jnp.dtype(dtype_in)
+        self.dtype_out = jnp.dtype(dtype_out)
+
+    def _apply_leaf(self, x):
+        return x.astype(self.dtype_out) if x.dtype == self.dtype_in else x
+
+    def transform_observation_spec(self, spec):
+        for k in self._keys(spec):
+            leaf = spec[k]
+            if jnp.dtype(leaf.dtype) == self.dtype_in and not isinstance(leaf, Composite):
+                spec = spec.set(k, dataclasses.replace(leaf, dtype=self.dtype_out))
+        return spec
+
+
+class DoubleToFloat(DTypeCast):
+    """float64 -> float32 (reference DoubleToFloat)."""
+
+    def __init__(self, in_keys=None):
+        super().__init__(jnp.float64, jnp.float32, in_keys)
+
+
+class RenameTransform(Transform):
+    """Rename observation keys (reference RenameTransform)."""
+
+    def __init__(self, in_keys, out_keys):
+        self.in_keys = [k if isinstance(k, tuple) else (k,) for k in in_keys]
+        self.out_keys = [k if isinstance(k, tuple) else (k,) for k in out_keys]
+
+    def _apply(self, td):
+        for src, dst in zip(self.in_keys, self.out_keys):
+            if src in td:
+                td = td.rename_key(src, dst)
+        return td
+
+    def reset(self, tstate, td):
+        return tstate, self._apply(td)
+
+    def step(self, tstate, next_td):
+        return tstate, self._apply(next_td)
+
+    def transform_observation_spec(self, spec):
+        for src, dst in zip(self.in_keys, self.out_keys):
+            if src in spec:
+                leaf = spec[src]
+                spec = spec.delete(src).set(dst, leaf)
+        return spec
+
+
+class CatTensors(Transform):
+    """Concatenate several observation keys into one (reference CatTensors).
+
+    Per-key feature ndims come from the env's spec (cached when the
+    TransformedEnv is built), so batched envs with scalar observation keys
+    concatenate correctly instead of flattening batch dims.
+    """
+
+    def __init__(self, in_keys, out_key: str = "observation_vector", del_keys: bool = True):
+        self.in_keys = [k if isinstance(k, tuple) else (k,) for k in in_keys]
+        self.out_key = out_key
+        self.del_keys = del_keys
+        self._feature_ndims: dict | None = None
+
+    def _apply(self, td):
+        if self._feature_ndims is None:
+            raise RuntimeError(
+                "CatTensors must be attached via TransformedEnv (spec pass not run)"
+            )
+        parts = []
+        for k in self.in_keys:
+            x = td[k]
+            nf = self._feature_ndims[k]
+            nb = x.ndim - nf
+            parts.append(x.reshape(x.shape[:nb] + (-1,)))
+        td = td.set(self.out_key, jnp.concatenate(parts, axis=-1))
+        if self.del_keys:
+            td = td.exclude(*self.in_keys)
+        return td
+
+    def reset(self, tstate, td):
+        return tstate, self._apply(td)
+
+    def step(self, tstate, next_td):
+        return tstate, self._apply(next_td)
+
+    def transform_observation_spec(self, spec):
+        total = 0
+        dtype = None
+        self._feature_ndims = {}
+        for k in self.in_keys:
+            leaf = spec[k]
+            self._feature_ndims[k] = len(leaf.shape)
+            total += int(jnp.prod(jnp.asarray(leaf.shape))) if leaf.shape else 1
+            dtype = leaf.dtype
+        if self.del_keys:
+            for k in self.in_keys:
+                spec = spec.delete(k)
+        return spec.set(self.out_key, Unbounded(shape=(total,), dtype=dtype))
+
+
+class UnsqueezeTransform(_KeyedTransform):
+    """Insert a size-1 trailing dim (reference UnsqueezeTransform)."""
+
+    def __init__(self, axis: int = -1, in_keys=None):
+        super().__init__(in_keys)
+        self.axis = axis
+
+    def _apply_leaf(self, x):
+        return jnp.expand_dims(x, self.axis)
+
+    def transform_observation_spec(self, spec):
+        for k in self._keys(spec):
+            leaf = spec[k]
+            ax = self.axis if self.axis >= 0 else len(leaf.shape) + 1 + self.axis
+            new_shape = leaf.shape[:ax] + (1,) + leaf.shape[ax:]
+            spec = spec.set(k, dataclasses.replace(leaf, shape=new_shape))
+        return spec
+
+
+class SqueezeTransform(_KeyedTransform):
+    """Remove a size-1 dim (reference SqueezeTransform)."""
+
+    def __init__(self, axis: int = -1, in_keys=None):
+        super().__init__(in_keys)
+        self.axis = axis
+
+    def _apply_leaf(self, x):
+        return jnp.squeeze(x, self.axis)
+
+    def transform_observation_spec(self, spec):
+        for k in self._keys(spec):
+            leaf = spec[k]
+            ax = self.axis if self.axis >= 0 else len(leaf.shape) + self.axis
+            new_shape = leaf.shape[:ax] + leaf.shape[ax + 1 :]
+            spec = spec.set(k, dataclasses.replace(leaf, shape=new_shape))
+        return spec
+
+
+class ActionScaling(Transform):
+    """Map policy actions in [-1, 1] to the env's [low, high] box.
+
+    The inverse-direction transform (reference ActionScaling /
+    ``TanhModule``'s spec-driven rescale): applied in ``inv`` before the
+    base env's step; the declared action_spec becomes [-1, 1].
+    """
+
+    def __init__(self, low, high):
+        self.low = jnp.asarray(low)
+        self.high = jnp.asarray(high)
+
+    def inv(self, td):
+        a = td["action"]
+        scaled = self.low + (a + 1.0) * 0.5 * (self.high - self.low)
+        return td.set("action", scaled)
+
+    def transform_action_spec(self, spec):
+        return Bounded(shape=spec.shape, low=-1.0, high=1.0, dtype=spec.dtype)

@@ -1,0 +1,125 @@
+"""Env utilities: spec conformance harness and exploration-type control.
+
+``check_env_specs`` is the universal env test, mirroring the reference's
+public conformance harness (reference: torchrl/envs/utils.py:686) — every
+env (user or built-in) is validated by rolling it and checking every output
+against the declared specs.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import enum
+
+import jax
+import jax.numpy as jnp
+
+from ..data import ArrayDict, Composite
+from .base import EnvBase, rollout
+
+__all__ = ["check_env_specs", "ExplorationType", "exploration_type", "set_exploration_type"]
+
+
+def check_env_specs(env: EnvBase, key: jax.Array | None = None, num_steps: int = 8) -> None:
+    """Assert that an env's runtime behavior matches its declared specs.
+
+    Checks (raising AssertionError with a precise message on mismatch):
+    - reset output contains every observation key, in-spec, plus done flags;
+    - step output "next" is in observation+reward+done spec;
+    - state (minus "rng") matches state_spec when one is declared;
+    - a scanned rollout keeps all outputs in-spec (catches shape drift
+      between the eager step and the traced step);
+    - jit(reset) and jit(step) produce identical structures to eager.
+    """
+    key = jax.random.key(0) if key is None else key
+    k_reset, k_act, k_roll = jax.random.split(key, 3)
+    bs = env.batch_shape
+
+    obs_spec = env.observation_spec.expand(bs) if bs else env.observation_spec
+    done_spec = env.done_spec.expand(bs) if bs else env.done_spec
+
+    # -- reset ---------------------------------------------------------------
+    state, td = env.reset(k_reset)
+    for path in env.observation_spec.keys(nested=True, leaves_only=True):
+        assert path in td, f"reset output missing observation key {path}"
+    assert obs_spec.is_in(td.select(*obs_spec.keys())), (
+        f"reset observations violate spec:\n{td}\nvs {obs_spec}"
+    )
+    for k in ("done", "terminated", "truncated"):
+        assert k in td, f"reset output missing {k!r}"
+        assert td[k].shape == bs, f"reset {k} shape {td[k].shape} != batch {bs}"
+
+    if len(env.state_spec.keys()) and bs == ():
+        st = env._spec_state(state)
+        assert env.state_spec.is_in(st), f"state violates state_spec: {st}"
+
+    # -- single step ---------------------------------------------------------
+    td = env.rand_action(td, k_act)
+    assert env.action_spec.is_in(
+        td["action"].reshape((-1,) + env.action_spec.shape)[0]
+        if bs
+        else td["action"]
+    ), "rand_action violates action_spec"
+    state2, out = env.step(state, td)
+    nxt = out["next"]
+    assert obs_spec.is_in(nxt.select(*obs_spec.keys())), "step next-obs violate spec"
+    assert nxt["reward"].shape == bs + env.reward_spec.shape, (
+        f"reward shape {nxt['reward'].shape} != {bs + env.reward_spec.shape}"
+    )
+    assert done_spec.is_in(nxt.select("done", "terminated", "truncated")), (
+        "done flags violate done_spec"
+    )
+    # input content must be preserved at the root
+    for path in env.observation_spec.keys(nested=True, leaves_only=True):
+        assert path in out, f"step dropped root key {path}"
+
+    # -- jit equivalence -----------------------------------------------------
+    _, jtd = jax.jit(env.reset)(k_reset)
+    assert set(jtd.keys()) == set(td.exclude("action").keys()), "jit(reset) structure drift"
+    _, jout = jax.jit(env.step)(state, td)
+    assert set(jout["next"].keys()) == set(nxt.keys()), "jit(step) structure drift"
+
+    # -- scanned rollout -----------------------------------------------------
+    steps = rollout(env, k_roll, max_steps=num_steps)
+    assert steps.batch_shape[: 1 + len(bs)] == (num_steps,) + bs, (
+        f"rollout batch shape {steps.batch_shape} != {(num_steps,) + bs}"
+    )
+    for path in env.observation_spec.keys(nested=True, leaves_only=True):
+        leaf_spec = env.observation_spec[path]
+        n = steps["next"][path].size // max(
+            int(jnp.prod(jnp.array(leaf_spec.shape, jnp.int32))) if leaf_spec.shape else 1, 1
+        )
+        vals = steps["next"][path].reshape((n,) + leaf_spec.shape)
+        assert leaf_spec.is_in(vals), f"rollout obs {path} violates spec"
+
+
+class ExplorationType(enum.Enum):
+    """How stochastic policies emit actions (reference envs/utils.py)."""
+
+    RANDOM = "random"  # sample from the distribution
+    MODE = "mode"  # distribution mode
+    MEAN = "mean"  # distribution mean
+    DETERMINISTIC = "deterministic"
+
+
+_EXPLORATION = [ExplorationType.RANDOM]
+
+
+def exploration_type() -> ExplorationType:
+    return _EXPLORATION[-1]
+
+
+@contextlib.contextmanager
+def set_exploration_type(t: ExplorationType):
+    """Context manager selecting exploration behavior of probabilistic modules.
+
+    NOTE: this is *trace-time* state — changing it inside a jitted function
+    has no effect after compilation; enter the context before tracing (the
+    same caveat applies to the reference's ``set_exploration_type`` with
+    ``torch.compile``).
+    """
+    _EXPLORATION.append(t)
+    try:
+        yield
+    finally:
+        _EXPLORATION.pop()

@@ -1,0 +1,160 @@
+"""Exploration wrappers (reference:
+torchrl/modules/tensordict_module/exploration.py — ``EGreedyModule``:38,
+``AdditiveGaussianModule``:252, ``OrnsteinUhlenbeckProcessModule``:428,
+``RandomPolicy``:771).
+
+Annealing state (step counters, OU noise) is functional: these modules carry
+it inside the ArrayDict under ("exploration", name) so rollouts remain pure.
+Each wraps an inner policy `(params, td, key) -> td` and post-processes the
+action under ExplorationType.RANDOM (other modes pass through).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..data import ArrayDict, Spec
+from ..envs.utils import ExplorationType, exploration_type
+
+__all__ = ["EGreedyModule", "AdditiveGaussianModule", "OrnsteinUhlenbeckModule", "RandomPolicy"]
+
+
+def _anneal(eps_init, eps_end, steps, t):
+    frac = jnp.clip(t.astype(jnp.float32) / steps, 0.0, 1.0)
+    return eps_init + (eps_end - eps_init) * frac
+
+
+class EGreedyModule:
+    """ε-greedy over discrete actions, ε annealed over ``annealing_num_steps``.
+
+    State key: ("exploration", "eg_step"). ``spec`` supplies random actions
+    (categorical or one-hot — whatever the env expects).
+    """
+
+    def __init__(
+        self,
+        spec: Spec,
+        eps_init: float = 1.0,
+        eps_end: float = 0.1,
+        annealing_num_steps: int = 1000,
+    ):
+        self.spec = spec
+        self.eps_init = eps_init
+        self.eps_end = eps_end
+        self.annealing_num_steps = annealing_num_steps
+
+    def init_state(self) -> ArrayDict:
+        return ArrayDict(eg_step=jnp.asarray(0, jnp.int32))
+
+    def __call__(self, td: ArrayDict, key: jax.Array) -> ArrayDict:
+        if exploration_type() != ExplorationType.RANDOM:
+            return td
+        estate = td["exploration"] if "exploration" in td else self.init_state()
+        t = estate["eg_step"]
+        eps = _anneal(self.eps_init, self.eps_end, self.annealing_num_steps, t)
+        k1, k2 = jax.random.split(key)
+        batch = td["action"].shape[: td["action"].ndim - len(self.spec.shape)]
+        explore = jax.random.bernoulli(k1, eps, batch)
+        rand_action = self.spec.rand(k2, batch)
+        d = explore.reshape(explore.shape + (1,) * (td["action"].ndim - explore.ndim))
+        action = jnp.where(d, rand_action.astype(td["action"].dtype), td["action"])
+        return td.set("action", action).set("exploration", estate.set("eg_step", t + 1))
+
+
+class AdditiveGaussianModule:
+    """Additive annealed Gaussian action noise (reference :252).
+
+    State key: ("exploration", "ag_step").
+    """
+
+    def __init__(
+        self,
+        spec: Spec,
+        sigma_init: float = 1.0,
+        sigma_end: float = 0.1,
+        annealing_num_steps: int = 1000,
+        mean: float = 0.0,
+    ):
+        self.spec = spec
+        self.sigma_init = sigma_init
+        self.sigma_end = sigma_end
+        self.annealing_num_steps = annealing_num_steps
+        self.mean = mean
+
+    def init_state(self) -> ArrayDict:
+        return ArrayDict(ag_step=jnp.asarray(0, jnp.int32))
+
+    def __call__(self, td: ArrayDict, key: jax.Array) -> ArrayDict:
+        if exploration_type() != ExplorationType.RANDOM:
+            return td
+        estate = td["exploration"] if "exploration" in td else self.init_state()
+        t = estate["ag_step"]
+        sigma = _anneal(self.sigma_init, self.sigma_end, self.annealing_num_steps, t)
+        noise = self.mean + sigma * jax.random.normal(key, td["action"].shape)
+        action = self.spec.project(td["action"] + noise)
+        return td.set("action", action).set("exploration", estate.set("ag_step", t + 1))
+
+
+class OrnsteinUhlenbeckModule:
+    """OU-process action noise (reference :428): temporally-correlated noise
+    ``n <- n + θ(μ - n)dt + σ√dt ε``, reset where is_init.
+
+    State keys: ("exploration", "ou_noise"), ("exploration", "ou_step").
+    """
+
+    def __init__(
+        self,
+        spec: Spec,
+        theta: float = 0.15,
+        mu: float = 0.0,
+        sigma: float = 0.2,
+        dt: float = 1e-2,
+        sigma_init: float | None = None,
+        sigma_end: float | None = None,
+        annealing_num_steps: int = 1000,
+    ):
+        self.spec = spec
+        self.theta = theta
+        self.mu = mu
+        self.sigma = sigma
+        self.sigma_init = sigma_init if sigma_init is not None else sigma
+        self.sigma_end = sigma_end if sigma_end is not None else sigma
+        self.annealing_num_steps = annealing_num_steps
+        self.dt = dt
+
+    def init_state(self, action_shape) -> ArrayDict:
+        return ArrayDict(
+            ou_noise=jnp.zeros(action_shape),
+            ou_step=jnp.asarray(0, jnp.int32),
+        )
+
+    def __call__(self, td: ArrayDict, key: jax.Array) -> ArrayDict:
+        if exploration_type() != ExplorationType.RANDOM:
+            return td
+        action = td["action"]
+        estate = td["exploration"] if "exploration" in td else self.init_state(action.shape)
+        noise, t = estate["ou_noise"], estate["ou_step"]
+        if "is_init" in td:
+            flag = td["is_init"]
+            flag = flag.reshape(flag.shape + (1,) * (noise.ndim - flag.ndim))
+            noise = jnp.where(flag, 0.0, noise)
+        sigma = _anneal(self.sigma_init, self.sigma_end, self.annealing_num_steps, t)
+        eps = jax.random.normal(key, action.shape)
+        noise = noise + self.theta * (self.mu - noise) * self.dt + sigma * jnp.sqrt(self.dt) * eps
+        out = self.spec.project(action + noise)
+        estate = ArrayDict(ou_noise=noise, ou_step=t + 1)
+        return td.set("action", out).set("exploration", estate)
+
+
+class RandomPolicy:
+    """Uniform-random policy from a spec (reference :771)."""
+
+    def __init__(self, spec: Spec):
+        self.spec = spec
+        self.in_keys: list = []
+        self.out_keys = [("action",)]
+
+    def __call__(self, td: ArrayDict, key: jax.Array) -> ArrayDict:
+        batch = td["done"].shape if "done" in td else ()
+        return td.set("action", self.spec.rand(key, batch))

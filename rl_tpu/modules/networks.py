@@ -1,0 +1,160 @@
+"""Generic network builders (flax.linen).
+
+Counterparts of the reference's model zoo (reference:
+torchrl/modules/models/models.py — ``MLP``:29, ``ConvNet``:305,
+``DuelingMlpDQNet``:819, ``DuelingCnnDQNet``:936; exploration.py —
+``NoisyLinear``:29).
+
+TPU notes: default dtype is float32 with bfloat16 compute available via
+``dtype=``; Dense layers map straight onto the MXU — prefer widths that are
+multiples of 128 for full tiling.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+__all__ = ["MLP", "ConvNet", "DuelingMLP", "NoisyDense", "NormalParamExtractor"]
+
+
+def _activation(name_or_fn) -> Callable:
+    if callable(name_or_fn):
+        return name_or_fn
+    return {
+        "relu": nn.relu,
+        "tanh": jnp.tanh,
+        "elu": nn.elu,
+        "gelu": nn.gelu,
+        "silu": nn.silu,
+        "swish": nn.silu,
+        "leaky_relu": nn.leaky_relu,
+    }[name_or_fn]
+
+
+class MLP(nn.Module):
+    """Configurable MLP (reference MLP, models.py:29).
+
+    ``out_features`` is the final width; ``num_cells`` the hidden widths.
+    ``activate_last_layer`` mirrors the reference flag.
+    """
+
+    out_features: int
+    num_cells: Sequence[int] = (64, 64)
+    activation: Any = "tanh"
+    activate_last_layer: bool = False
+    layer_norm: bool = False
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        act = _activation(self.activation)
+        for width in self.num_cells:
+            x = nn.Dense(width, dtype=self.dtype)(x)
+            if self.layer_norm:
+                x = nn.LayerNorm(dtype=self.dtype)(x)
+            x = act(x)
+        x = nn.Dense(self.out_features, dtype=self.dtype)(x)
+        if self.activate_last_layer:
+            x = act(x)
+        return x
+
+
+class ConvNet(nn.Module):
+    """Conv feature extractor (reference ConvNet, models.py:305): conv stack
+    then flatten. Input layout NHWC (TPU-native; the reference is NCHW)."""
+
+    channels: Sequence[int] = (32, 64, 64)
+    kernel_sizes: Sequence[int] = (8, 4, 3)
+    strides: Sequence[int] = (4, 2, 1)
+    activation: Any = "relu"
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        act = _activation(self.activation)
+        for ch, k, s in zip(self.channels, self.kernel_sizes, self.strides):
+            x = nn.Conv(ch, (k, k), strides=(s, s), dtype=self.dtype)(x)
+            x = act(x)
+        return x.reshape(x.shape[:-3] + (-1,))
+
+
+class DuelingMLP(nn.Module):
+    """Dueling Q-head: Q = V + A - mean(A) (reference DuelingMlpDQNet,
+    models.py:819)."""
+
+    num_actions: int
+    num_cells: Sequence[int] = (64, 64)
+    activation: Any = "relu"
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        act = _activation(self.activation)
+        for width in self.num_cells:
+            x = nn.Dense(width, dtype=self.dtype)(x)
+            x = act(x)
+        value = nn.Dense(1, dtype=self.dtype)(x)
+        adv = nn.Dense(self.num_actions, dtype=self.dtype)(x)
+        return value + adv - adv.mean(axis=-1, keepdims=True)
+
+
+class NoisyDense(nn.Module):
+    """Factorized-noise linear layer (reference NoisyLinear, exploration.py:29
+    — Fortunato et al. 2017). Noise is resampled from an explicit rng
+    collection ("noise") each call during exploration; deterministic mode
+    uses mean weights."""
+
+    features: int
+    sigma_init: float = 0.1
+    deterministic: bool = False
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        in_f = x.shape[-1]
+        bound = 1.0 / jnp.sqrt(in_f)
+        w_mu = self.param("w_mu", nn.initializers.uniform(2 * bound), (in_f, self.features), self.dtype)
+        b_mu = self.param("b_mu", nn.initializers.uniform(2 * bound), (self.features,), self.dtype)
+        w_sigma = self.param(
+            "w_sigma",
+            nn.initializers.constant(self.sigma_init / jnp.sqrt(in_f)),
+            (in_f, self.features),
+            self.dtype,
+        )
+        b_sigma = self.param(
+            "b_sigma",
+            nn.initializers.constant(self.sigma_init / jnp.sqrt(in_f)),
+            (self.features,),
+            self.dtype,
+        )
+        if self.deterministic or not self.has_rng("noise"):
+            return x @ w_mu + b_mu
+        key = self.make_rng("noise")
+        k1, k2 = jax.random.split(key)
+
+        def f(e):
+            return jnp.sign(e) * jnp.sqrt(jnp.abs(e))
+
+        eps_in = f(jax.random.normal(k1, (in_f,), self.dtype))
+        eps_out = f(jax.random.normal(k2, (self.features,), self.dtype))
+        w = w_mu + w_sigma * jnp.outer(eps_in, eps_out)
+        b = b_mu + b_sigma * eps_out
+        return x @ w + b
+
+
+class NormalParamExtractor(nn.Module):
+    """Split trailing features into (loc, scale) with positive scale mapping
+    (reference tensordict NormalParamExtractor semantics: scale =
+    softplus(raw) biased so scale(0) = 1)."""
+
+    scale_lb: float = 1e-4
+
+    @nn.compact
+    def __call__(self, x):
+        loc, raw = jnp.split(x, 2, axis=-1)
+        scale = jax.nn.softplus(raw + 0.54132485) + self.scale_lb  # softplus(0.5413)≈1
+        return loc, scale

@@ -1,0 +1,268 @@
+"""Declarative key-routed modules over ArrayDicts.
+
+The framework's equivalent of ``TensorDictModule`` (external tensordict
+package) and the actor wrappers of the reference
+(reference: torchrl/modules/tensordict_module/actors.py — ``Actor``:36,
+``ProbabilisticActor``:146, ``ValueOperator``:427, ``QValueModule``:500,
+``QValueActor``:1108, ``ActorValueOperator``:1415).
+
+A :class:`TDModule` binds a flax module (or plain function) to named inputs
+and outputs: reading ``in_keys`` from an ArrayDict, writing ``out_keys``
+back. Parameters stay external (functional flax style): ``init(key, td)``
+returns the param pytree; ``__call__(params, td, key=None)`` applies it.
+This is what lets losses/collectors treat policies uniformly and what makes
+param surgery (target nets, ensembles via vmap) trivial.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..data import ArrayDict
+from ..envs.utils import ExplorationType, exploration_type
+from .distributions import Categorical, Distribution, MaskedCategorical, OneHotCategorical
+
+__all__ = [
+    "TDModule",
+    "TDSequential",
+    "ProbabilisticActor",
+    "ValueOperator",
+    "QValueModule",
+    "QValueActor",
+    "ActorValueOperator",
+]
+
+
+def _norm_keys(keys) -> list[tuple[str, ...]]:
+    return [k if isinstance(k, tuple) else (k,) for k in keys]
+
+
+class TDModule:
+    """Wrap a flax module / callable with declared in/out keys."""
+
+    def __init__(self, module: Any, in_keys: Sequence, out_keys: Sequence):
+        self.module = module
+        self.in_keys = _norm_keys(in_keys)
+        self.out_keys = _norm_keys(out_keys)
+        self._is_flax = isinstance(module, nn.Module)
+
+    # -- params ---------------------------------------------------------------
+
+    def init(self, key: jax.Array, td: ArrayDict) -> Any:
+        if not self._is_flax:
+            return {}
+        inputs = [td[k] for k in self.in_keys]
+        variables = self.module.init(key, *inputs)
+        return variables.get("params", {})
+
+    # -- application ----------------------------------------------------------
+
+    def _run(self, params, inputs: list, key: jax.Array | None):
+        if self._is_flax:
+            rngs = {"noise": key} if key is not None else None
+            return self.module.apply({"params": params}, *inputs, rngs=rngs)
+        return self.module(*inputs)
+
+    def __call__(self, params, td: ArrayDict, key: jax.Array | None = None) -> ArrayDict:
+        inputs = [td[k] for k in self.in_keys]
+        out = self._run(params, inputs, key)
+        if not isinstance(out, tuple):
+            out = (out,)
+        if len(out) != len(self.out_keys):
+            raise ValueError(
+                f"{type(self.module).__name__} returned {len(out)} outputs for "
+                f"out_keys {self.out_keys}"
+            )
+        for k, v in zip(self.out_keys, out):
+            td = td.set(k, v)
+        return td
+
+
+class TDSequential(TDModule):
+    """Chain of TDModules sharing one ArrayDict namespace (TensorDictSequential
+    analog). Params are a dict keyed ``"m{i}"``."""
+
+    def __init__(self, *modules: TDModule):
+        self.modules = list(modules)
+        self.in_keys = [k for m in modules for k in m.in_keys]
+        self.out_keys = [k for m in modules for k in m.out_keys]
+
+    def init(self, key, td):
+        params = {}
+        keys = jax.random.split(key, len(self.modules))
+        for i, (m, k) in enumerate(zip(self.modules, keys)):
+            params[f"m{i}"] = m.init(k, td)
+            td = m(params[f"m{i}"], td, k)
+        return params
+
+    def __call__(self, params, td, key=None):
+        keys = (
+            jax.random.split(key, len(self.modules))
+            if key is not None
+            else [None] * len(self.modules)
+        )
+        for i, (m, k) in enumerate(zip(self.modules, keys)):
+            td = m(params[f"m{i}"], td, k)
+        return td
+
+
+class ProbabilisticActor(TDModule):
+    """Policy: network -> distribution -> action under the active
+    ExplorationType (reference ProbabilisticActor, actors.py:146).
+
+    ``module`` maps observations to distribution parameters named by
+    ``dist_keys`` (e.g. ("loc", "scale") or ("logits",)); ``dist_class`` is
+    constructed with those as kwargs plus ``dist_kwargs`` (bounds, masks).
+    Writes ``action`` and (``return_log_prob``) ``sample_log_prob``.
+    """
+
+    def __init__(
+        self,
+        module: TDModule,
+        dist_class: type[Distribution],
+        dist_keys: Sequence = ("loc", "scale"),
+        out_key="action",
+        dist_kwargs: dict | None = None,
+        return_log_prob: bool = True,
+    ):
+        self.inner = module
+        self.dist_class = dist_class
+        self.dist_keys = _norm_keys(dist_keys)
+        self.out_key = out_key if isinstance(out_key, tuple) else (out_key,)
+        self.dist_kwargs = dist_kwargs or {}
+        self.return_log_prob = return_log_prob
+        self.in_keys = module.in_keys
+        self.out_keys = [self.out_key] + ([("sample_log_prob",)] if return_log_prob else [])
+
+    def init(self, key, td):
+        return self.inner.init(key, td)
+
+    def get_dist(self, params, td: ArrayDict, key=None) -> tuple[Distribution, ArrayDict]:
+        td = self.inner(params, td, key)
+        kwargs = {k[-1]: td[k] for k in self.dist_keys}
+        return self.dist_class(**kwargs, **self.dist_kwargs), td
+
+    def __call__(self, params, td, key=None):
+        dist, td = self.get_dist(params, td, key)
+        mode = exploration_type()
+        if mode == ExplorationType.RANDOM:
+            if key is None:
+                raise ValueError("ExplorationType.RANDOM requires a PRNG key")
+            action = dist.sample(key)
+        elif mode == ExplorationType.MEAN:
+            action = dist.mean
+        else:  # MODE / DETERMINISTIC
+            action = dist.deterministic_sample
+        td = td.set(self.out_key, action)
+        if self.return_log_prob:
+            td = td.set("sample_log_prob", dist.log_prob(action))
+        return td
+
+    def log_prob(self, params, td: ArrayDict) -> jax.Array:
+        """log π(td["action"]) — the loss-side evaluation path."""
+        dist, _ = self.get_dist(params, td)
+        return dist.log_prob(td[self.out_key])
+
+
+class ValueOperator(TDModule):
+    """V(s) head writing "state_value" (reference ValueOperator, actors.py:427)."""
+
+    def __init__(self, module: Any, in_keys=("observation",), out_keys=("state_value",)):
+        super().__init__(module, in_keys, out_keys)
+
+
+class QValueModule:
+    """Greedy head over "action_value" (reference QValueModule, actors.py:500):
+    writes argmax "action" + "chosen_action_value". Works with categorical or
+    one-hot action encodings."""
+
+    def __init__(self, one_hot: bool = False, action_value_key="action_value"):
+        self.one_hot = one_hot
+        self.avk = action_value_key if isinstance(action_value_key, tuple) else (action_value_key,)
+        self.in_keys = [self.avk]
+        self.out_keys = [("action",), ("chosen_action_value",)]
+
+    def init(self, key, td):
+        return {}
+
+    def __call__(self, params, td: ArrayDict, key=None) -> ArrayDict:
+        q = td[self.avk]
+        idx = jnp.argmax(q, axis=-1)
+        chosen = jnp.take_along_axis(q, idx[..., None], axis=-1)[..., 0]
+        action = jax.nn.one_hot(idx, q.shape[-1], dtype=q.dtype) if self.one_hot else idx
+        return td.set("action", action).set("chosen_action_value", chosen)
+
+
+class QValueActor(TDSequential):
+    """Q-net + greedy head (reference QValueActor, actors.py:1108)."""
+
+    def __init__(self, module: Any, in_keys=("observation",), one_hot: bool = False):
+        qnet = module if isinstance(module, TDModule) else TDModule(module, in_keys, ("action_value",))
+        super().__init__(qnet, QValueModule(one_hot=one_hot))
+
+
+class ActorValueOperator:
+    """Shared-trunk actor-critic (reference ActorValueOperator, actors.py:1415):
+    ``common`` maps obs -> "hidden"; actor and value heads read "hidden".
+    ``get_policy_operator()``/``get_value_operator()`` expose standalone views
+    sharing the same params tree {"common","actor","value"}."""
+
+    def __init__(self, common: TDModule, actor: ProbabilisticActor, value: ValueOperator):
+        self.common = common
+        self.actor = actor
+        self.value = value
+        self.in_keys = common.in_keys
+        self.out_keys = common.out_keys + actor.out_keys + value.out_keys
+
+    def init(self, key, td):
+        k1, k2, k3 = jax.random.split(key, 3)
+        pc = self.common.init(k1, td)
+        td = self.common(pc, td)
+        return {
+            "common": pc,
+            "actor": self.actor.init(k2, td),
+            "value": self.value.init(k3, td),
+        }
+
+    def __call__(self, params, td, key=None):
+        td = self.common(params["common"], td)
+        td = self.actor(params["actor"], td, key)
+        return self.value(params["value"], td)
+
+    def get_policy_operator(self) -> "_SubOperator":
+        return _SubOperator(self, use_value=False)
+
+    def get_value_operator(self) -> "_SubOperator":
+        return _SubOperator(self, use_actor=False)
+
+
+class _SubOperator:
+    """A view over ActorValueOperator params running trunk + one head."""
+
+    def __init__(self, parent: ActorValueOperator, use_actor=True, use_value=True):
+        self.parent = parent
+        self.use_actor = use_actor
+        self.use_value = use_value
+        self.in_keys = parent.common.in_keys
+        head = parent.actor if use_actor else parent.value
+        self.out_keys = head.out_keys
+
+    def __call__(self, params, td, key=None):
+        td = self.parent.common(params["common"], td)
+        if self.use_actor:
+            td = self.parent.actor(params["actor"], td, key)
+        if self.use_value:
+            td = self.parent.value(params["value"], td)
+        return td
+
+    def get_dist(self, params, td, key=None):
+        td = self.parent.common(params["common"], td)
+        return self.parent.actor.get_dist(params["actor"], td, key)
+
+    def log_prob(self, params, td):
+        dist, _ = self.get_dist(params, td)
+        return dist.log_prob(td[self.parent.actor.out_key])

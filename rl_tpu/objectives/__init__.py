@@ -1,0 +1,41 @@
+from .common import (
+    ActorCriticLossMixin,
+    HardUpdate,
+    LossModule,
+    SoftUpdate,
+    hold_out,
+    masked_mean,
+)
+from .ppo import A2CLoss, ClipPPOLoss, KLPENPPOLoss, PPOLoss, ReinforceLoss
+from .value import (
+    GAE,
+    TD0Estimator,
+    TD1Estimator,
+    TDLambdaEstimator,
+    ValueEstimatorBase,
+    ValueEstimators,
+    VTrace,
+    make_value_estimator,
+)
+
+__all__ = [
+    "LossModule",
+    "ActorCriticLossMixin",
+    "SoftUpdate",
+    "HardUpdate",
+    "hold_out",
+    "masked_mean",
+    "PPOLoss",
+    "ClipPPOLoss",
+    "KLPENPPOLoss",
+    "A2CLoss",
+    "ReinforceLoss",
+    "ValueEstimators",
+    "ValueEstimatorBase",
+    "TD0Estimator",
+    "TD1Estimator",
+    "TDLambdaEstimator",
+    "GAE",
+    "VTrace",
+    "make_value_estimator",
+]

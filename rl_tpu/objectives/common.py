@@ -1,0 +1,159 @@
+"""Loss-module protocol and target-network updaters.
+
+Functional redesign of the reference's ``LossModule``
+(reference: torchrl/objectives/common.py:77 — ``convert_to_functional``:341
+extracts params into a TensorDict and clones target params :916) and the
+target updaters (reference: torchrl/objectives/utils.py — ``SoftUpdate``:531,
+``HardUpdate``:590).
+
+Here params are *already* functional (plain pytrees), so the reference's
+param-extraction machinery disappears: a loss is constructed from modules,
+``init_params(key, td)`` builds ``{"actor": …, "critic": …, "target_…": …}``,
+and ``loss(params, batch, key) -> (scalar, metrics)`` is a pure function you
+can ``jax.grad`` / ``pjit`` directly. Target-network updates are pure pytree
+lerps.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ..data import ArrayDict
+
+__all__ = ["LossModule", "SoftUpdate", "HardUpdate", "masked_mean", "hold_out"]
+
+
+def masked_mean(x: jax.Array, mask: jax.Array | None) -> jax.Array:
+    """Mean over valid elements (mask broadcast from batch dims)."""
+    if mask is None:
+        return jnp.mean(x)
+    m = jnp.broadcast_to(
+        mask.reshape(mask.shape + (1,) * (x.ndim - mask.ndim)), x.shape
+    ).astype(x.dtype)
+    return jnp.sum(x * m) / jnp.clip(jnp.sum(m), 1.0)
+
+
+def hold_out(tree):
+    """Stop gradients through a param tree (reference hold_out_net, utils.py:626)."""
+    return jax.tree.map(jax.lax.stop_gradient, tree)
+
+
+class LossModule:
+    """Base: a named collection of sub-module params + a pure forward.
+
+    Subclasses define:
+    - ``init_params(key, example_td) -> dict`` (including target copies);
+    - ``__call__(params, batch, key=None) -> (loss, metrics_ArrayDict)``.
+
+    ``target_keys`` names the entries of the params dict that are targets
+    (excluded from optimization, updated by Soft/HardUpdate).
+    """
+
+    target_keys: tuple[str, ...] = ()
+
+    def init_params(self, key: jax.Array, td: ArrayDict) -> dict:
+        raise NotImplementedError
+
+    def __call__(self, params: dict, batch: ArrayDict, key: jax.Array | None = None):
+        raise NotImplementedError
+
+    # -- optimization helpers -------------------------------------------------
+
+    def trainable(self, params: dict) -> dict:
+        return {k: v for k, v in params.items() if k not in self.target_keys}
+
+    def merge(self, trainable: dict, params: dict) -> dict:
+        out = dict(params)
+        out.update(trainable)
+        return out
+
+    def grad(self, params: dict, batch: ArrayDict, key=None):
+        """(value, grads-over-trainable, metrics) in one pass."""
+
+        def f(tr):
+            loss, metrics = self(self.merge(tr, params), batch, key)
+            return loss, metrics
+
+        (loss, metrics), grads = jax.value_and_grad(f, has_aux=True)(
+            self.trainable(params)
+        )
+        return loss, grads, metrics
+
+
+class ActorCriticLossMixin(LossModule):
+    """Shared machinery for actor-critic losses: param init, default GAE
+    estimator, advantage back-fill, critic value extraction, masking."""
+
+    actor: Any
+    critic: Any
+    mask_key: str | None = "mask"
+
+    def make_value_estimator(self, gamma: float = 0.99, lmbda: float = 0.95, **kw):
+        from .value import GAE
+
+        self.value_estimator = GAE(
+            lambda p, td: self.critic(p, td), gamma=gamma, lmbda=lmbda, **kw
+        )
+        return self
+
+    def init_params(self, key: jax.Array, td: ArrayDict) -> dict:
+        ka, kc = jax.random.split(key)
+        return {"actor": self.actor.init(ka, td), "critic": self.critic.init(kc, td)}
+
+    def _mask(self, batch: ArrayDict):
+        if self.mask_key and self.mask_key in batch:
+            return batch[self.mask_key]
+        return None
+
+    def _ensure_advantage(self, params: dict, batch: ArrayDict) -> ArrayDict:
+        if "advantage" not in batch:
+            if getattr(self, "value_estimator", None) is None:
+                self.make_value_estimator()
+            batch = self.value_estimator(params["critic"], batch)
+        return batch
+
+    def _value(self, params: dict, batch: ArrayDict) -> jax.Array:
+        from .value import _squeeze_value
+
+        return _squeeze_value(self.critic(params["critic"], batch)["state_value"])
+
+
+class SoftUpdate:
+    """Polyak averaging of target params (reference SoftUpdate, utils.py:531):
+    ``target <- (1-tau) * target + tau * source``."""
+
+    def __init__(self, loss: LossModule, tau: float = 0.005, eps: float | None = None):
+        if eps is not None:
+            tau = 1.0 - eps
+        self.loss = loss
+        self.tau = tau
+
+    def __call__(self, params: dict) -> dict:
+        out = dict(params)
+        for tk in self.loss.target_keys:
+            sk = tk.removeprefix("target_")
+            out[tk] = optax.incremental_update(params[sk], params[tk], self.tau)
+        return out
+
+
+class HardUpdate:
+    """Periodic hard copy (reference HardUpdate, utils.py:590). Jit-safe:
+    the copy is a ``where`` on ``step % period == 0``."""
+
+    def __init__(self, loss: LossModule, value_network_update_interval: int = 1000):
+        self.loss = loss
+        self.period = value_network_update_interval
+
+    def __call__(self, params: dict, step: jax.Array) -> dict:
+        do = (step % self.period) == 0
+        out = dict(params)
+        for tk in self.loss.target_keys:
+            sk = tk.removeprefix("target_")
+            out[tk] = jax.tree.map(
+                lambda s, t: jnp.where(do, s, t), params[sk], params[tk]
+            )
+        return out

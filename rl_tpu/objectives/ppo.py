@@ -1,0 +1,275 @@
+"""PPO family + A2C + REINFORCE losses.
+
+Functional redesigns of the reference's on-policy losses (reference:
+torchrl/objectives/ppo.py — ``PPOLoss``:108, ``ClipPPOLoss``:1078,
+``KLPENPPOLoss``:1455; a2c.py:41 ``A2CLoss``; reinforce.py:32
+``ReinforceLoss``).
+
+Each loss is a pure ``(params, batch, key) -> (scalar, metrics)`` where
+``params = {"actor": …, "critic": …}``; metrics mirror the reference's named
+loss outputs ("loss_objective", "loss_critic", "loss_entropy", "entropy",
+"ESS", "clip_fraction", "kl_approx").
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..data import ArrayDict
+from .common import ActorCriticLossMixin, masked_mean
+
+__all__ = ["PPOLoss", "ClipPPOLoss", "KLPENPPOLoss", "A2CLoss", "ReinforceLoss"]
+
+
+def _masked_ess(log_weight: jax.Array, mask) -> jax.Array:
+    """Effective sample size fraction over *valid* elements only."""
+    lw = jax.lax.stop_gradient(log_weight)
+    if mask is not None:
+        m = jnp.broadcast_to(
+            mask.reshape(mask.shape + (1,) * (lw.ndim - mask.ndim)), lw.shape
+        )
+        lw = jnp.where(m, lw, -jnp.inf)
+        n = jnp.clip(jnp.sum(m.astype(jnp.float32)), 1.0)
+    else:
+        n = lw.size
+    ess = jnp.exp(
+        2 * jax.scipy.special.logsumexp(lw) - jax.scipy.special.logsumexp(2 * lw)
+    )
+    return ess / n
+
+
+class PPOLoss(ActorCriticLossMixin):
+    """Vanilla PPO (no clipping — the A2C-with-IS objective; reference
+    ppo.py:108).
+
+    ``actor`` is a :class:`rl_tpu.modules.ProbabilisticActor` (or view with
+    ``get_dist``/``log_prob``); ``critic`` a ``ValueOperator``-style callable.
+    """
+
+    def __init__(
+        self,
+        actor,
+        critic,
+        entropy_coeff: float = 0.01,
+        critic_coeff: float = 1.0,
+        loss_critic_type: str = "smooth_l1",
+        normalize_advantage: bool = False,
+        clip_value: float | None = None,
+        mask_key: str | None = "mask",
+    ):
+        self.actor = actor
+        self.critic = critic
+        self.entropy_coeff = entropy_coeff
+        self.critic_coeff = critic_coeff
+        self.loss_critic_type = loss_critic_type
+        self.normalize_advantage = normalize_advantage
+        self.clip_value = clip_value
+        self.mask_key = mask_key
+        self.value_estimator = None
+
+    # -- pieces ---------------------------------------------------------------
+
+    def _log_weight(self, params, batch):
+        dist, _ = self.actor.get_dist(params["actor"], batch)
+        log_prob = dist.log_prob(batch["action"])
+        log_weight = log_prob - jax.lax.stop_gradient(batch["sample_log_prob"])
+        return log_weight, dist, log_prob
+
+    def _entropy(self, dist, log_prob):
+        try:
+            return dist.entropy()
+        except NotImplementedError:
+            # single-sample estimate (the reference falls back the same way)
+            return -log_prob
+
+    def _advantage(self, batch, mask):
+        adv = batch["advantage"]
+        if self.normalize_advantage:
+            mu = masked_mean(adv, mask)
+            sd = jnp.sqrt(jnp.clip(masked_mean((adv - mu) ** 2, mask), 1e-12))
+            adv = (adv - mu) / jnp.clip(sd, 1e-6)
+        return adv
+
+    def _critic_error(self, value, target):
+        if self.loss_critic_type == "l2":
+            return (value - target) ** 2
+        diff = value - target  # smooth_l1
+        return jnp.where(jnp.abs(diff) < 1.0, 0.5 * diff * diff, jnp.abs(diff) - 0.5)
+
+    def loss_critic(self, params, batch, mask):
+        value = self._value(params, batch)
+        target = jax.lax.stop_gradient(batch["value_target"])
+        err = self._critic_error(value, target)
+        if self.clip_value is not None and "state_value" in batch:
+            # PPO-style value clipping around the behavior-time value
+            old = jax.lax.stop_gradient(batch["state_value"])
+            clipped = old + jnp.clip(value - old, -self.clip_value, self.clip_value)
+            err = jnp.maximum(err, self._critic_error(clipped, target))
+        return masked_mean(err, mask)
+
+    def _objective(self, log_weight, adv, mask):
+        return -masked_mean(jnp.exp(log_weight) * adv, mask), ArrayDict()
+
+    def __call__(self, params, batch: ArrayDict, key=None):
+        batch = self._ensure_advantage(params, batch)
+        mask = self._mask(batch)
+        adv = self._advantage(batch, mask)
+        log_weight, dist, log_prob = self._log_weight(params, batch)
+        loss_obj, extra = self._objective(log_weight, adv, mask)
+        entropy = self._entropy(dist, log_prob)
+        loss_entropy = -self.entropy_coeff * masked_mean(entropy, mask)
+        loss_critic = self.critic_coeff * self.loss_critic(params, batch, mask)
+        total = loss_obj + loss_entropy + loss_critic
+
+        metrics = ArrayDict(
+            loss_objective=loss_obj,
+            loss_critic=loss_critic,
+            loss_entropy=loss_entropy,
+            entropy=masked_mean(jax.lax.stop_gradient(entropy), mask),
+            kl_approx=masked_mean(jax.lax.stop_gradient(-log_weight), mask),
+            ESS=_masked_ess(log_weight, mask),
+        ).update(extra)
+        return total, metrics
+
+
+class ClipPPOLoss(PPOLoss):
+    """PPO with clipped surrogate objective (reference ppo.py:1078)."""
+
+    def __init__(self, actor, critic, clip_epsilon: float = 0.2, **kwargs):
+        super().__init__(actor, critic, **kwargs)
+        self.clip_epsilon = clip_epsilon
+
+    def _objective(self, log_weight, adv, mask):
+        ratio = jnp.exp(log_weight)
+        clipped = jnp.clip(ratio, 1.0 - self.clip_epsilon, 1.0 + self.clip_epsilon)
+        gain = jnp.minimum(ratio * adv, clipped * adv)
+        clip_fraction = masked_mean(
+            jax.lax.stop_gradient((jnp.abs(ratio - 1.0) > self.clip_epsilon)).astype(
+                jnp.float32
+            ),
+            mask,
+        )
+        return -masked_mean(gain, mask), ArrayDict(clip_fraction=clip_fraction)
+
+
+class KLPENPPOLoss(PPOLoss):
+    """KL-penalized PPO (reference ppo.py:1455): adaptive β penalty on
+    KL(π_old ‖ π_new), estimated from stored log-probs.
+
+    β adaptation is functional: the updated β is returned in the metrics
+    ("beta") and the caller feeds it back via the ``beta`` argument —
+    jit-safe in a scanned training loop.
+    """
+
+    def __init__(
+        self,
+        actor,
+        critic,
+        dtarg: float = 0.01,
+        beta: float = 1.0,
+        increment: float = 2.0,
+        decrement: float = 0.5,
+        **kwargs,
+    ):
+        super().__init__(actor, critic, **kwargs)
+        self.dtarg = dtarg
+        self.beta_init = beta
+        self.increment = increment
+        self.decrement = decrement
+
+    def __call__(self, params, batch, key=None, beta: jax.Array | None = None):
+        beta = jnp.asarray(self.beta_init if beta is None else beta, jnp.float32)
+        batch = self._ensure_advantage(params, batch)
+        mask = self._mask(batch)
+        adv = self._advantage(batch, mask)
+        log_weight, dist, log_prob = self._log_weight(params, batch)
+        kl = masked_mean(-log_weight, mask)  # E_old[log old - log new]
+        loss_obj = -masked_mean(jnp.exp(log_weight) * adv, mask) + beta * kl
+        entropy = self._entropy(dist, log_prob)
+        loss_entropy = -self.entropy_coeff * masked_mean(entropy, mask)
+        loss_critic = self.critic_coeff * self.loss_critic(params, batch, mask)
+        total = loss_obj + loss_entropy + loss_critic
+
+        new_beta = jnp.where(
+            kl > 1.5 * self.dtarg,
+            beta * self.increment,
+            jnp.where(kl < self.dtarg / 1.5, beta * self.decrement, beta),
+        )
+        metrics = ArrayDict(
+            loss_objective=loss_obj,
+            loss_critic=loss_critic,
+            loss_entropy=loss_entropy,
+            entropy=masked_mean(jax.lax.stop_gradient(entropy), mask),
+            kl=jax.lax.stop_gradient(kl),
+            beta=jax.lax.stop_gradient(new_beta),
+        )
+        return total, metrics
+
+
+class A2CLoss(ActorCriticLossMixin):
+    """Advantage actor-critic (reference a2c.py:41): policy-gradient with the
+    advantage as baseline-corrected weight, no importance ratio."""
+
+    def __init__(
+        self,
+        actor,
+        critic,
+        entropy_coeff: float = 0.01,
+        critic_coeff: float = 0.5,
+        mask_key: str | None = "mask",
+    ):
+        self.actor = actor
+        self.critic = critic
+        self.entropy_coeff = entropy_coeff
+        self.critic_coeff = critic_coeff
+        self.mask_key = mask_key
+        self.value_estimator = None
+
+    def __call__(self, params, batch, key=None):
+        batch = self._ensure_advantage(params, batch)
+        mask = self._mask(batch)
+        dist, _ = self.actor.get_dist(params["actor"], batch)
+        log_prob = dist.log_prob(batch["action"])
+        adv = jax.lax.stop_gradient(batch["advantage"])
+        loss_obj = -masked_mean(log_prob * adv, mask)
+        try:
+            entropy = dist.entropy()
+        except NotImplementedError:
+            entropy = -log_prob
+        loss_entropy = -self.entropy_coeff * masked_mean(entropy, mask)
+
+        value = self._value(params, batch)
+        target = jax.lax.stop_gradient(batch["value_target"])
+        loss_critic = self.critic_coeff * masked_mean((value - target) ** 2, mask)
+        total = loss_obj + loss_entropy + loss_critic
+        return total, ArrayDict(
+            loss_objective=loss_obj,
+            loss_critic=loss_critic,
+            loss_entropy=loss_entropy,
+            entropy=masked_mean(jax.lax.stop_gradient(entropy), mask),
+        )
+
+
+class ReinforceLoss(ActorCriticLossMixin):
+    """REINFORCE with value baseline (reference reinforce.py:32)."""
+
+    def __init__(self, actor, critic, critic_coeff: float = 1.0, mask_key=None):
+        self.actor = actor
+        self.critic = critic
+        self.critic_coeff = critic_coeff
+        self.mask_key = mask_key
+        self.value_estimator = None
+
+    def __call__(self, params, batch, key=None):
+        batch = self._ensure_advantage(params, batch)
+        mask = self._mask(batch)
+        log_prob = self.actor.log_prob(params["actor"], batch)
+        adv = jax.lax.stop_gradient(batch["advantage"])
+        loss_obj = -masked_mean(log_prob * adv, mask)
+        value = self._value(params, batch)
+        target = jax.lax.stop_gradient(batch["value_target"])
+        loss_critic = self.critic_coeff * masked_mean((value - target) ** 2, mask)
+        return loss_obj + loss_critic, ArrayDict(
+            loss_objective=loss_obj, loss_critic=loss_critic
+        )

@@ -1,0 +1,197 @@
+"""Value estimators over ArrayDict batches.
+
+Class layer over :mod:`rl_tpu.ops.value` mirroring the reference's estimator
+registry (reference: torchrl/objectives/value/advantages.py —
+``ValueEstimatorBase``:99, ``TD0Estimator``:951, ``TD1Estimator``:1234,
+``TDLambdaEstimator``:1530, ``GAE``:1860, ``VTrace``:2473; enum registry
+torchrl/objectives/utils.py:48).
+
+Batches are time-major rollout ArrayDicts (layout produced by
+:func:`rl_tpu.envs.rollout`): root holds obs/action/log-probs, ``"next"``
+holds outcomes. Estimators write "advantage" and "value_target" at the root.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+from ..data import ArrayDict
+from ..ops import value as F
+
+__all__ = [
+    "ValueEstimators",
+    "ValueEstimatorBase",
+    "TD0Estimator",
+    "TD1Estimator",
+    "TDLambdaEstimator",
+    "GAE",
+    "VTrace",
+    "make_value_estimator",
+]
+
+
+class ValueEstimators(enum.Enum):
+    TD0 = "td0"
+    TD1 = "td1"
+    TDLambda = "td_lambda"
+    GAE = "gae"
+    VTrace = "vtrace"
+
+
+class ValueEstimatorBase:
+    """Computes V(s), V(s') with a value network then applies a kernel.
+
+    ``value_network`` is a callable ``(params, td) -> td`` writing
+    "state_value" (a :class:`rl_tpu.modules.ValueOperator`). Values with a
+    trailing singleton dim are squeezed to match scalar rewards.
+    """
+
+    def __init__(self, value_network: Callable, gamma: float = 0.99, shifted: bool = True):
+        self.value_network = value_network
+        self.gamma = gamma
+        self.shifted = shifted  # reserved: single fwd pass over [s_0..s_T]
+
+    def _values(self, params, batch: ArrayDict) -> tuple[jax.Array, jax.Array]:
+        root = self.value_network(params, batch)
+        nxt = self.value_network(params, batch["next"])
+        return _squeeze_value(root["state_value"]), _squeeze_value(nxt["state_value"])
+
+    def _kernel(self, value, next_value, batch) -> tuple[jax.Array, jax.Array]:
+        raise NotImplementedError
+
+    def __call__(self, params, batch: ArrayDict) -> ArrayDict:
+        value, next_value = self._values(params, batch)
+        value = jax.lax.stop_gradient(value)
+        next_value = jax.lax.stop_gradient(next_value)
+        adv, target = self._kernel(value, next_value, batch)
+        return batch.set("advantage", adv).set("value_target", target).set(
+            "state_value", value
+        )
+
+
+def _squeeze_value(v: jax.Array) -> jax.Array:
+    return v[..., 0] if v.ndim and v.shape[-1] == 1 else v
+
+
+class GAE(ValueEstimatorBase):
+    """GAE(γ, λ) with optional advantage standardization (reference :1860)."""
+
+    def __init__(
+        self,
+        value_network,
+        gamma: float = 0.99,
+        lmbda: float = 0.95,
+        average_gae: bool = False,
+    ):
+        super().__init__(value_network, gamma)
+        self.lmbda = lmbda
+        self.average_gae = average_gae
+
+    def _kernel(self, value, next_value, batch):
+        adv, target = F.generalized_advantage_estimate(
+            self.gamma,
+            self.lmbda,
+            value,
+            next_value,
+            batch["next", "reward"],
+            batch["next", "done"],
+            batch["next", "terminated"],
+        )
+        if self.average_gae:
+            adv = (adv - adv.mean()) / jnp.clip(adv.std(), 1e-6)
+        return adv, target
+
+
+class TD0Estimator(ValueEstimatorBase):
+    def _kernel(self, value, next_value, batch):
+        target = F.td0_return_estimate(
+            self.gamma,
+            next_value,
+            batch["next", "reward"],
+            batch["next", "terminated"],
+        )
+        return target - value, target
+
+
+class TD1Estimator(ValueEstimatorBase):
+    def _kernel(self, value, next_value, batch):
+        target = F.td1_return_estimate(
+            self.gamma,
+            next_value,
+            batch["next", "reward"],
+            batch["next", "done"],
+            batch["next", "terminated"],
+        )
+        return target - value, target
+
+
+class TDLambdaEstimator(ValueEstimatorBase):
+    def __init__(self, value_network, gamma: float = 0.99, lmbda: float = 0.95):
+        super().__init__(value_network, gamma)
+        self.lmbda = lmbda
+
+    def _kernel(self, value, next_value, batch):
+        target = F.td_lambda_return_estimate(
+            self.gamma,
+            self.lmbda,
+            next_value,
+            batch["next", "reward"],
+            batch["next", "done"],
+            batch["next", "terminated"],
+        )
+        return target - value, target
+
+
+class VTrace(ValueEstimatorBase):
+    """V-trace with importance ratios from ("sample_log_prob" vs the current
+    policy's log-prob of the stored action) (reference :2473)."""
+
+    def __init__(
+        self,
+        value_network,
+        actor_log_prob: Callable,
+        gamma: float = 0.99,
+        rho_clip: float = 1.0,
+        c_clip: float = 1.0,
+    ):
+        super().__init__(value_network, gamma)
+        self.actor_log_prob = actor_log_prob  # (actor_params, td) -> log π(a|s)
+        self.rho_clip = rho_clip
+        self.c_clip = c_clip
+
+    def __call__(self, params, batch: ArrayDict, actor_params=None) -> ArrayDict:
+        value, next_value = self._values(params, batch)
+        value = jax.lax.stop_gradient(value)
+        next_value = jax.lax.stop_gradient(next_value)
+        log_pi = self.actor_log_prob(actor_params, batch)
+        log_rhos = jax.lax.stop_gradient(log_pi - batch["sample_log_prob"])
+        adv, target = F.vtrace_advantage_estimate(
+            self.gamma,
+            log_rhos,
+            value,
+            next_value,
+            batch["next", "reward"],
+            batch["next", "done"],
+            batch["next", "terminated"],
+            rho_clip=self.rho_clip,
+            c_clip=self.c_clip,
+        )
+        return batch.set("advantage", adv).set("value_target", target).set(
+            "state_value", value
+        )
+
+
+def make_value_estimator(kind: ValueEstimators, value_network, **kwargs):
+    """Estimator factory (reference ``make_value_estimator``)."""
+    table = {
+        ValueEstimators.TD0: TD0Estimator,
+        ValueEstimators.TD1: TD1Estimator,
+        ValueEstimators.TDLambda: TDLambdaEstimator,
+        ValueEstimators.GAE: GAE,
+        ValueEstimators.VTrace: VTrace,
+    }
+    return table[kind](value_network, **kwargs)

@@ -1,0 +1,3 @@
+from .math import safeatanh, safetanh
+
+__all__ = ["safetanh", "safeatanh"]

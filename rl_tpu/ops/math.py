@@ -1,0 +1,49 @@
+"""Numerically-safe math primitives.
+
+``safetanh``/``safeatanh``: clamped tanh/atanh with well-defined gradients at
+the clamp boundary. TPU-native equivalent of the reference's C++ custom
+autograd functions (reference: torchrl/csrc/utils.cpp:1-48, used by
+``SafeTanhTransform``, modules/distributions/continuous.py:137): here a
+``jax.custom_jvp`` pair replaces the custom backward — no native code needed,
+matching clamping semantics (eps pulled inside the open interval (-1, 1)).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["safetanh", "safeatanh"]
+
+
+@jax.custom_jvp
+def safetanh(x, eps: float = 1e-6):
+    lim = 1.0 - eps
+    return jnp.clip(jnp.tanh(x), -lim, lim)
+
+
+@safetanh.defjvp
+def _safetanh_jvp(primals, tangents):
+    x, eps = primals
+    dx, _ = tangents
+    lim = 1.0 - eps
+    y = jnp.tanh(x)
+    yc = jnp.clip(y, -lim, lim)
+    # gradient of tanh, as if unclamped (the reference backward does the same:
+    # d/dx clamp(tanh) uses 1 - y^2 with the clamped y)
+    return yc, (1.0 - yc * yc) * dx
+
+
+@jax.custom_jvp
+def safeatanh(y, eps: float = 1e-6):
+    lim = 1.0 - eps
+    return jnp.arctanh(jnp.clip(y, -lim, lim))
+
+
+@safeatanh.defjvp
+def _safeatanh_jvp(primals, tangents):
+    y, eps = primals
+    dy, _ = tangents
+    lim = 1.0 - eps
+    yc = jnp.clip(y, -lim, lim)
+    return jnp.arctanh(yc), dy / (1.0 - yc * yc)

@@ -1,0 +1,13 @@
+from .mocks import (
+    ContinuousActionMock,
+    CountingEnv,
+    MultiKeyCountingEnv,
+    NestedCountingEnv,
+)
+
+__all__ = [
+    "CountingEnv",
+    "NestedCountingEnv",
+    "MultiKeyCountingEnv",
+    "ContinuousActionMock",
+]

@@ -1,0 +1,150 @@
+"""Mock environments with analytically-known rollouts.
+
+The framework's public test kit, mirroring the reference's mock-first test
+strategy (reference: torchrl/testing/mocking_classes.py — ``CountingEnv``
+:1168, ``NestedCountingEnv``:1492, ``MultiKeyCountingEnv``:1992,
+``StateLessCountingEnv``:432): every layer above envs is tested against
+these, no real sims required.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..data import ArrayDict, Bounded, Categorical, Composite, Unbounded
+from ..envs.base import EnvBase
+
+__all__ = ["CountingEnv", "NestedCountingEnv", "MultiKeyCountingEnv", "ContinuousActionMock"]
+
+
+class CountingEnv(EnvBase):
+    """Observation counts steps; episode terminates at ``max_count``.
+
+    After a reset the count is 0; each step increments it and yields
+    reward 1.0. The expected rollout is exactly ``arange``, so collector /
+    value-estimator / replay correctness is checkable in closed form.
+    """
+
+    def __init__(self, max_count: int = 5):
+        self.max_count = max_count
+
+    @property
+    def observation_spec(self) -> Composite:
+        return Composite(
+            observation=Bounded(shape=(1,), low=0.0, high=float(self.max_count))
+        )
+
+    @property
+    def action_spec(self):
+        return Categorical(n=2)
+
+    def _reset(self, key):
+        state = ArrayDict(count=jnp.asarray(0, jnp.int32))
+        return state, ArrayDict(observation=jnp.zeros((1,), jnp.float32))
+
+    def _step(self, state, action, key):
+        count = state["count"] + 1
+        obs = ArrayDict(observation=count[None].astype(jnp.float32))
+        terminated = count >= self.max_count
+        return (
+            ArrayDict(count=count),
+            obs,
+            jnp.asarray(1.0),
+            terminated,
+            jnp.asarray(False),
+        )
+
+
+class NestedCountingEnv(CountingEnv):
+    """CountingEnv with observations nested under ("data", "states")."""
+
+    @property
+    def observation_spec(self) -> Composite:
+        return Composite(
+            data=Composite(
+                states=Bounded(shape=(1,), low=0.0, high=float(self.max_count))
+            )
+        )
+
+    def _reset(self, key):
+        state, obs = super()._reset(key)
+        return state, ArrayDict(data=ArrayDict(states=obs["observation"]))
+
+    def _step(self, state, action, key):
+        state, obs, r, term, trunc = super()._step(state, action, key)
+        return state, ArrayDict(data=ArrayDict(states=obs["observation"])), r, term, trunc
+
+
+class MultiKeyCountingEnv(CountingEnv):
+    """Several observation keys of different shapes/dtypes advancing together."""
+
+    @property
+    def observation_spec(self) -> Composite:
+        mc = float(self.max_count)
+        return Composite(
+            obs_vec=Bounded(shape=(3,), low=0.0, high=mc),
+            obs_int=Bounded(shape=(), low=0, high=self.max_count, dtype=jnp.int32),
+            nested=Composite(obs_img=Bounded(shape=(2, 2), low=0.0, high=mc)),
+        )
+
+    def _multi_obs(self, count):
+        c = count.astype(jnp.float32)
+        return ArrayDict(
+            obs_vec=jnp.full((3,), c),
+            obs_int=count,
+            nested=ArrayDict(obs_img=jnp.full((2, 2), c)),
+        )
+
+    def _reset(self, key):
+        state = ArrayDict(count=jnp.asarray(0, jnp.int32))
+        return state, self._multi_obs(state["count"])
+
+    def _step(self, state, action, key):
+        count = state["count"] + 1
+        return (
+            ArrayDict(count=count),
+            self._multi_obs(count),
+            jnp.asarray(1.0),
+            count >= self.max_count,
+            jnp.asarray(False),
+        )
+
+
+class ContinuousActionMock(EnvBase):
+    """Continuous-action mock: obs random-walks by the action, reward = -|obs|.
+
+    Model for testing continuous-control losses (SAC/TD3/DDPG paths).
+    """
+
+    def __init__(self, obs_dim: int = 4, act_dim: int = 2, max_episode_steps: int = 10):
+        self.obs_dim = obs_dim
+        self.act_dim = act_dim
+        self.max_episode_steps = max_episode_steps
+
+    @property
+    def observation_spec(self) -> Composite:
+        return Composite(observation=Unbounded(shape=(self.obs_dim,)))
+
+    @property
+    def action_spec(self):
+        return Bounded(shape=(self.act_dim,), low=-1.0, high=1.0)
+
+    def _reset(self, key):
+        obs = jax.random.normal(key, (self.obs_dim,))
+        state = ArrayDict(obs=obs, step_count=jnp.asarray(0, jnp.int32))
+        return state, ArrayDict(observation=obs)
+
+    def _step(self, state, action, key):
+        drift = jnp.pad(action, (0, self.obs_dim - self.act_dim))
+        obs = state["obs"] + 0.1 * drift + 0.01 * jax.random.normal(key, (self.obs_dim,))
+        count = state["step_count"] + 1
+        reward = -jnp.abs(obs).sum()
+        new_state = ArrayDict(obs=obs, step_count=count)
+        return (
+            new_state,
+            ArrayDict(observation=obs),
+            reward,
+            jnp.asarray(False),
+            count >= self.max_episode_steps,
+        )

@@ -1,0 +1,3 @@
+from .on_policy import OnPolicyConfig, OnPolicyProgram
+
+__all__ = ["OnPolicyConfig", "OnPolicyProgram"]

@@ -1,0 +1,148 @@
+"""Fused on-policy training program (PPO/A2C): collect → advantages →
+epochs×minibatch SGD, all inside ONE jitted step.
+
+This is the TPU-inverted form of the reference's trainer loop (reference:
+torchrl/trainers/trainers.py:1354 ``Trainer.train`` — a Python loop over
+collector batches with hook dispatch per step; and
+sota-implementations/ppo/ppo_mujoco.py). XLA sees the entire
+rollout+GAE+loss+optimizer computation as one program: the MuJoCo-PPO
+"north star" from BASELINE.md runs this exact program over a device mesh.
+
+The hook-based :class:`rl_tpu.trainers.Trainer` (host-side orchestration,
+logging, checkpointing) wraps this program; this module is the pure core.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ..data import ArrayDict
+from ..collectors.single import Collector
+from ..objectives.common import LossModule
+
+__all__ = ["OnPolicyConfig", "OnPolicyProgram"]
+
+
+@dataclasses.dataclass
+class OnPolicyConfig:
+    num_epochs: int = 4
+    minibatch_size: int = 256
+    max_grad_norm: float = 0.5
+    learning_rate: float = 3e-4
+    anneal_lr_to: float | None = None  # optional final LR for linear anneal
+    total_steps: int | None = None  # needed for annealing
+
+
+class OnPolicyProgram:
+    """Bundles collector + loss + optax into a jitted ``train_step``.
+
+    Usage::
+
+        program = OnPolicyProgram(collector, loss, config)
+        ts = program.init(key)
+        step = jax.jit(program.train_step)   # or pjit over a mesh
+        for _ in range(n):
+            ts, metrics = step(ts)
+    """
+
+    def __init__(
+        self,
+        collector: Collector,
+        loss: LossModule,
+        config: OnPolicyConfig = OnPolicyConfig(),
+        advantage: Callable[[dict, ArrayDict], ArrayDict] | None = None,
+    ):
+        self.collector = collector
+        self.loss = loss
+        self.config = config
+        if advantage is None:
+            if loss.value_estimator is None:
+                loss.make_value_estimator()
+            advantage = lambda params, b: loss.value_estimator(params["critic"], b)  # noqa: E731
+        self.advantage = advantage
+
+        frames = collector.frames_per_batch
+        if frames % config.minibatch_size:
+            raise ValueError(
+                f"frames_per_batch={frames} not divisible by minibatch_size={config.minibatch_size}"
+            )
+        self.num_minibatches = frames // config.minibatch_size
+
+        if config.anneal_lr_to is not None and config.total_steps:
+            schedule = optax.linear_schedule(
+                config.learning_rate, config.anneal_lr_to, config.total_steps
+            )
+        else:
+            schedule = config.learning_rate
+        self.optimizer = optax.chain(
+            optax.clip_by_global_norm(config.max_grad_norm),
+            optax.adam(schedule),
+        )
+
+    # -- state ----------------------------------------------------------------
+
+    def init(self, key: jax.Array, example_td: ArrayDict | None = None) -> dict:
+        k_params, k_coll, k_rng = jax.random.split(key, 3)
+        cstate = self.collector.init(k_coll)
+        td = example_td if example_td is not None else cstate["carry"]
+        params = self.loss.init_params(k_params, td)
+        opt_state = self.optimizer.init(self.loss.trainable(params))
+        # plain-dict pytree: flax param dicts must stay un-coerced
+        return {"params": params, "opt": opt_state, "collector": cstate, "rng": k_rng}
+
+    # -- the fused step -------------------------------------------------------
+
+    def train_step(self, ts: dict) -> tuple[dict, ArrayDict]:
+        params = ts["params"]
+        batch, cstate = self.collector.collect(params, ts["collector"])
+        batch = self.advantage(params, batch)
+        flat = batch.flatten_batch()
+        n = flat.batch_shape[0]
+
+        def epoch_body(carry, epoch_key):
+            params, opt_state = carry
+            perm = jax.random.permutation(epoch_key, n)
+            mb_idx = perm.reshape(self.num_minibatches, self.config.minibatch_size)
+
+            def mb_body(carry, idx):
+                params, opt_state = carry
+                mb = flat[idx]
+                loss_val, grads, metrics = self.loss.grad(params, mb)
+                updates, opt_state = self.optimizer.update(
+                    grads, opt_state, self.loss.trainable(params)
+                )
+                new_trainable = optax.apply_updates(self.loss.trainable(params), updates)
+                params = self.loss.merge(new_trainable, params)
+                return (params, opt_state), metrics.set("loss", loss_val)
+
+            (params, opt_state), metrics = jax.lax.scan(mb_body, (params, opt_state), mb_idx)
+            return (params, opt_state), metrics
+
+        all_keys = jax.random.split(ts["rng"], self.config.num_epochs + 1)
+        rng, epoch_keys = all_keys[0], all_keys[1:]
+        (params, opt_state), metrics = jax.lax.scan(
+            epoch_body, (params, ts["opt"]), epoch_keys
+        )
+        mean_metrics = jax.tree.map(lambda x: x.mean(), metrics)
+        mean_metrics = mean_metrics.set("episode_reward_mean", _episode_reward(batch))
+        mean_metrics = mean_metrics.set("reward_mean", jnp.mean(batch["next", "reward"]))
+        new_ts = {"params": params, "opt": opt_state, "collector": cstate, "rng": rng}
+        return new_ts, mean_metrics
+
+
+def _episode_reward(batch: ArrayDict) -> jax.Array:
+    if ("next", "episode_reward") in batch:
+        # mean terminal episode return where episodes completed (RewardSum);
+        # NaN when no episode finished in this batch (long-episode envs with
+        # short collection windows) — 0 would read as a real return
+        er = batch["next", "episode_reward"]
+        done = batch["next", "done"]
+        total = jnp.sum(jnp.where(done, er, 0.0))
+        count = jnp.sum(done.astype(jnp.float32))
+        return jnp.where(count > 0, total / jnp.clip(count, 1.0), jnp.nan)
+    return jnp.mean(batch["next", "reward"])

@@ -10,7 +10,18 @@ from __future__ import annotations
 
 import jax
 
-__all__ = ["seed_generator", "key_chain", "fold_seed"]
+__all__ = ["seed_generator", "key_chain", "fold_seed", "ensure_typed_key"]
+
+
+def ensure_typed_key(key):
+    """Accept new-style typed keys, legacy uint32[2] keys, or python ints."""
+    import jax.numpy as jnp
+
+    if isinstance(key, int):
+        return jax.random.key(key)
+    if jnp.issubdtype(key.dtype, jax.dtypes.prng_key):
+        return key
+    return jax.random.wrap_key_data(jnp.asarray(key, jnp.uint32))
 
 
 def seed_generator(seed: int) -> int:

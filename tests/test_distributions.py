@@ -1,0 +1,174 @@
+"""Distribution tests (strategy mirrors reference test/test_distributions.py:
+sampling domains, log_prob consistency against numerical references, mode/mean,
+jit/vmap compatibility)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from rl_tpu.modules import (
+    Categorical,
+    Delta,
+    MaskedCategorical,
+    Normal,
+    OneHotCategorical,
+    Ordinal,
+    TanhDelta,
+    TanhNormal,
+    TruncatedNormal,
+)
+
+KEY = jax.random.key(0)
+
+
+class TestNormal:
+    def test_log_prob_matches_scipy_form(self):
+        d = Normal(loc=jnp.array([0.5, -1.0]), scale=jnp.array([1.0, 2.0]))
+        x = jnp.array([0.0, 0.0])
+        expected = (
+            -0.5 * ((0.0 - 0.5) ** 2) - 0.5 * np.log(2 * np.pi)
+            + -0.5 * ((0.0 + 1.0) / 2.0) ** 2 - np.log(2.0) - 0.5 * np.log(2 * np.pi)
+        )
+        np.testing.assert_allclose(float(d.log_prob(x)), expected, rtol=1e-5)
+
+    def test_sample_stats(self):
+        d = Normal(loc=jnp.array([2.0]), scale=jnp.array([0.5]))
+        s = d.sample(KEY, (20000,))
+        assert abs(float(s.mean()) - 2.0) < 0.02
+        assert abs(float(s.std()) - 0.5) < 0.02
+
+    def test_entropy(self):
+        d = Normal(loc=jnp.zeros(3), scale=jnp.ones(3))
+        np.testing.assert_allclose(
+            float(d.entropy()), 3 * 0.5 * (1 + np.log(2 * np.pi)), rtol=1e-6
+        )
+
+
+class TestTanhNormal:
+    def test_sample_in_bounds(self):
+        d = TanhNormal(loc=jnp.zeros(2), scale=5 * jnp.ones(2), low=-2.0, high=1.0)
+        s = d.sample(KEY, (1000,))
+        assert float(s.min()) >= -2.0 and float(s.max()) <= 1.0
+
+    def test_log_prob_integrates_to_one(self):
+        # numerical integral of exp(log_prob) over the support ≈ 1
+        d = TanhNormal(loc=jnp.array([0.3]), scale=jnp.array([0.7]))
+        xs = jnp.linspace(-0.999, 0.999, 4001)[:, None]
+        lp = jax.vmap(d.log_prob)(xs)
+        integral = float(jnp.trapezoid(jnp.exp(lp), xs[:, 0]))
+        assert abs(integral - 1.0) < 1e-2
+
+    def test_mode_finite_at_extremes(self):
+        d = TanhNormal(loc=jnp.array([100.0]), scale=jnp.array([1.0]))
+        assert np.isfinite(np.asarray(d.mode)).all()
+        assert np.isfinite(float(d.log_prob(d.mode)))
+
+    def test_log_prob_roundtrip_gradients(self):
+        d = TanhNormal(loc=jnp.array([0.0]), scale=jnp.array([1.0]))
+
+        def f(loc):
+            dd = TanhNormal(loc=loc, scale=jnp.array([1.0]))
+            return dd.log_prob(jnp.array([0.5]))
+
+        g = jax.grad(lambda l: f(l).sum())(jnp.array([0.0]))
+        assert np.isfinite(np.asarray(g)).all()
+
+
+class TestTruncatedNormal:
+    def test_samples_in_range(self):
+        d = TruncatedNormal(loc=jnp.array([2.0]), scale=jnp.array([1.0]), low=-1.0, high=1.0)
+        s = d.sample(KEY, (500,))
+        assert float(s.min()) >= -1.0 and float(s.max()) <= 1.0
+
+    def test_log_prob_out_of_range(self):
+        d = TruncatedNormal(loc=jnp.array([0.0]), scale=jnp.array([1.0]))
+        assert float(d.log_prob(jnp.array([2.0]))) == -np.inf
+
+    def test_renormalization(self):
+        d = TruncatedNormal(loc=jnp.array([0.0]), scale=jnp.array([1.0]), low=-1.0, high=1.0)
+        xs = jnp.linspace(-0.999, 0.999, 2001)[:, None]
+        lp = jax.vmap(d.log_prob)(xs)
+        integral = float(jnp.trapezoid(jnp.exp(lp), xs[:, 0]))
+        assert abs(integral - 1.0) < 1e-2
+
+
+class TestDelta:
+    def test_log_prob(self):
+        d = Delta(param=jnp.array([1.0, 2.0]))
+        assert float(d.log_prob(jnp.array([1.0, 2.0]))) == 0.0
+        assert float(d.log_prob(jnp.array([1.0, 2.5]))) == -np.inf
+
+    def test_tanh_delta_bounds(self):
+        d = TanhDelta(param=jnp.array([50.0]), low=-3.0, high=3.0)
+        assert abs(float(d.mode[0]) - 3.0) < 1e-3
+
+
+class TestCategoricals:
+    def test_categorical_log_prob(self):
+        logits = jnp.log(jnp.array([0.1, 0.2, 0.7]))
+        d = Categorical(logits=logits)
+        np.testing.assert_allclose(float(d.log_prob(jnp.array(2))), np.log(0.7), rtol=1e-5)
+        assert int(d.mode) == 2
+
+    def test_categorical_sample_freq(self):
+        logits = jnp.log(jnp.array([0.2, 0.8]))
+        s = Categorical(logits=logits).sample(KEY, (10000,))
+        assert abs(float((s == 1).mean()) - 0.8) < 0.02
+
+    def test_onehot(self):
+        logits = jnp.array([0.0, 5.0, 0.0])
+        d = OneHotCategorical(logits=logits)
+        s = d.sample(KEY)
+        assert s.shape == (3,)
+        assert float(s.sum()) == 1.0
+        np.testing.assert_array_equal(np.asarray(d.mode), [0, 1, 0])
+        np.testing.assert_allclose(
+            float(d.log_prob(d.mode)), float(jax.nn.log_softmax(logits)[1]), rtol=1e-6
+        )
+
+    def test_masked_never_samples_masked(self):
+        logits = jnp.array([10.0, 0.0, 0.0])
+        mask = jnp.array([False, True, True])
+        d = MaskedCategorical(logits=logits, mask=mask)
+        s = d.sample(KEY, (1000,))
+        assert not bool((s == 0).any())
+        assert int(d.mode) != 0
+
+    def test_masked_entropy_no_nan(self):
+        d = MaskedCategorical(
+            logits=jnp.zeros(4), mask=jnp.array([True, False, False, True])
+        )
+        assert np.isfinite(float(d.entropy()))
+        np.testing.assert_allclose(float(d.entropy()), np.log(2.0), rtol=1e-4)
+
+    def test_ordinal_prefers_ordered(self):
+        # strongly positive logits -> highest class most probable
+        d = Ordinal(logits=5.0 * jnp.ones(5))
+        assert int(d.mode) == 4
+        d2 = Ordinal(logits=-5.0 * jnp.ones(5))
+        assert int(d2.mode) == 0
+
+
+class TestTransformCompat:
+    def test_distributions_are_pytrees(self):
+        d = Normal(loc=jnp.zeros(2), scale=jnp.ones(2))
+        leaves = jax.tree_util.tree_leaves(d)
+        assert len(leaves) == 2
+
+    def test_jit_through_dist(self):
+        @jax.jit
+        def f(loc, key):
+            d = TanhNormal(loc=loc, scale=jnp.ones_like(loc))
+            a = d.sample(key)
+            return d.log_prob(a)
+
+        out = f(jnp.zeros(3), KEY)
+        assert np.isfinite(float(out))
+
+    def test_vmap_batch_of_dists(self):
+        locs = jnp.arange(4.0)[:, None]
+        f = jax.vmap(lambda l: Normal(loc=l, scale=jnp.ones(1)).log_prob(l))
+        np.testing.assert_allclose(
+            np.asarray(f(locs)), -0.5 * np.log(2 * np.pi) * np.ones(4), rtol=1e-6
+        )

@@ -1,0 +1,167 @@
+"""Env-core tests (strategy mirrors reference test/envs/: mock-first,
+spec conformance via check_env_specs, analytic rollout values, auto-reset
+semantics, vmap batching)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from rl_tpu.envs import (
+    CartPoleEnv,
+    PendulumEnv,
+    VmapEnv,
+    check_env_specs,
+    rollout,
+    step_mdp,
+)
+from rl_tpu.testing import (
+    ContinuousActionMock,
+    CountingEnv,
+    MultiKeyCountingEnv,
+    NestedCountingEnv,
+)
+
+KEY = jax.random.key(0)
+
+ALL_ENVS = [
+    CountingEnv,
+    NestedCountingEnv,
+    MultiKeyCountingEnv,
+    ContinuousActionMock,
+    PendulumEnv,
+    CartPoleEnv,
+]
+
+
+@pytest.mark.parametrize("env_cls", ALL_ENVS, ids=lambda c: c.__name__)
+class TestConformance:
+    def test_check_env_specs(self, env_cls):
+        check_env_specs(env_cls(), KEY)
+
+    def test_check_env_specs_vmapped(self, env_cls):
+        check_env_specs(VmapEnv(env_cls(), 3), KEY)
+
+
+class TestStepSemantics:
+    def test_step_layout(self):
+        env = CountingEnv()
+        state, td = env.reset(KEY)
+        td = env.rand_action(td, KEY)
+        _, out = env.step(state, td)
+        # reference layout: root holds inputs, "next" holds outcomes
+        assert "action" in out
+        assert ("next", "observation") in out
+        assert ("next", "reward") in out
+        assert float(out["next", "observation"][0]) == 1.0
+
+    def test_step_mdp(self):
+        env = CountingEnv()
+        state, td = env.reset(KEY)
+        td = env.rand_action(td, KEY)
+        _, out = env.step(state, td)
+        nxt = step_mdp(out)
+        assert "reward" not in nxt
+        assert "action" not in nxt
+        assert float(nxt["observation"][0]) == 1.0
+
+    def test_counting_env_analytic(self):
+        env = CountingEnv(max_count=100)
+        steps = rollout(env, KEY, max_steps=10)
+        np.testing.assert_allclose(
+            np.asarray(steps["next", "observation"]).squeeze(-1),
+            np.arange(1, 11, dtype=np.float32),
+        )
+        np.testing.assert_allclose(np.asarray(steps["next", "reward"]), np.ones(10))
+
+    def test_rng_advances(self):
+        env = ContinuousActionMock()
+        state, td = env.reset(KEY)
+        td = env.rand_action(td, KEY)
+        s1, _ = env.step(state, td)
+        assert not np.array_equal(
+            jax.random.key_data(state["rng"]), jax.random.key_data(s1["rng"])
+        )
+
+
+class TestAutoReset:
+    def test_step_and_reset_on_done(self):
+        env = CountingEnv(max_count=3)
+        state, td = env.reset(KEY)
+        for expected in [1.0, 2.0, 3.0]:
+            td = env.rand_action(td, KEY)
+            state, full_td, td = env.step_and_reset(state, td)
+            assert float(full_td["next", "observation"][0]) == expected
+        # after the 3rd step the episode was done -> carry obs reset to 0
+        assert bool(full_td["next", "done"])
+        assert float(td["observation"][0]) == 0.0
+        assert int(state["count"]) == 0
+
+    def test_rollout_autoreset_wraps(self):
+        env = CountingEnv(max_count=3)
+        steps = rollout(env, KEY, max_steps=7)
+        obs = np.asarray(steps["next", "observation"]).squeeze(-1)
+        np.testing.assert_allclose(obs, [1, 2, 3, 1, 2, 3, 1])
+        done = np.asarray(steps["next", "done"])
+        np.testing.assert_array_equal(done, [0, 0, 1, 0, 0, 1, 0])
+
+    def test_rollout_no_autoreset(self):
+        env = CountingEnv(max_count=3)
+        steps = rollout(env, KEY, max_steps=5, auto_reset=False)
+        obs = np.asarray(steps["next", "observation"]).squeeze(-1)
+        # without reset the count keeps increasing past done
+        np.testing.assert_allclose(obs, [1, 2, 3, 4, 5])
+
+    def test_vmap_independent_resets(self):
+        env = VmapEnv(CountingEnv(max_count=3), 4)
+        steps = rollout(env, KEY, max_steps=6)
+        obs = np.asarray(steps["next", "observation"]).squeeze(-1)
+        assert obs.shape == (6, 4)
+        for col in obs.T:
+            np.testing.assert_allclose(col, [1, 2, 3, 1, 2, 3])
+
+
+class TestRollout:
+    def test_policy_extras_recorded(self):
+        env = CountingEnv()
+
+        def policy(td, key):
+            return td.set("action", jnp.zeros((), jnp.int32)).set(
+                "logits", jnp.ones(2)
+            )
+
+        steps = rollout(env, KEY, policy, max_steps=4)
+        assert steps["logits"].shape == (4, 2)
+
+    def test_rollout_jits(self):
+        env = VmapEnv(PendulumEnv(), 8)
+        f = jax.jit(lambda k: rollout(env, k, max_steps=16))
+        steps = f(KEY)
+        assert steps["next", "observation"].shape == (16, 8, 3)
+        # second call hits the cache, result deterministic per key
+        steps2 = f(KEY)
+        np.testing.assert_allclose(
+            np.asarray(steps["next", "reward"]), np.asarray(steps2["next", "reward"])
+        )
+
+    def test_break_when_any_done_masks(self):
+        env = CountingEnv(max_count=3)
+        steps = rollout(env, KEY, max_steps=6, break_when_any_done=True)
+        mask = np.asarray(steps["mask"])
+        np.testing.assert_array_equal(mask, [1, 1, 1, 0, 0, 0])
+
+    def test_pendulum_physics(self):
+        # hanging start with no torque -> cost bounded, speeds bounded
+        env = PendulumEnv()
+        policy = lambda td, k: td.set("action", jnp.zeros((1,)))  # noqa: E731
+        steps = rollout(env, KEY, policy, max_steps=50)
+        obs = np.asarray(steps["next", "observation"])
+        assert np.all(np.abs(obs[:, 2]) <= env.max_speed + 1e-6)
+        assert np.all(np.asarray(steps["next", "reward"]) <= 0.0)
+
+    def test_cartpole_terminates(self):
+        env = CartPoleEnv()
+        # constant-left policy destabilizes the pole quickly
+        policy = lambda td, k: td.set("action", jnp.zeros((), jnp.int32))  # noqa: E731
+        steps = rollout(env, KEY, policy, max_steps=100, auto_reset=False)
+        assert bool(np.asarray(steps["next", "terminated"]).any())

@@ -1,0 +1,241 @@
+"""Module/actor tests (strategy mirrors reference test files for
+tensordict_module actors: key routing, exploration-type behavior, shared-trunk
+operators, q-value heads, exploration wrappers)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from rl_tpu.data import ArrayDict, Bounded, Categorical as CategoricalSpec
+from rl_tpu.envs import ExplorationType, rollout, set_exploration_type
+from rl_tpu.modules import (
+    MLP,
+    ActorValueOperator,
+    AdditiveGaussianModule,
+    Categorical,
+    ConvNet,
+    DuelingMLP,
+    EGreedyModule,
+    NormalParamExtractor,
+    OrnsteinUhlenbeckModule,
+    ProbabilisticActor,
+    QValueActor,
+    RandomPolicy,
+    TanhNormal,
+    TDModule,
+    TDSequential,
+    ValueOperator,
+)
+from rl_tpu.testing import ContinuousActionMock, CountingEnv
+
+KEY = jax.random.key(0)
+
+
+def obs_td(b=4, d=3):
+    return ArrayDict(observation=jnp.ones((b, d)))
+
+
+class TestTDModule:
+    def test_flax_module_routing(self):
+        m = TDModule(MLP(out_features=2), ["observation"], ["out"])
+        td = obs_td()
+        params = m.init(KEY, td)
+        out = m(params, td)
+        assert out["out"].shape == (4, 2)
+        assert "observation" in out
+
+    def test_plain_callable(self):
+        m = TDModule(lambda x: x * 2, ["observation"], ["doubled"])
+        out = m({}, obs_td())
+        np.testing.assert_allclose(np.asarray(out["doubled"]), 2.0)
+
+    def test_tuple_outputs(self):
+        seq = TDSequential(
+            TDModule(MLP(out_features=8), ["observation"], ["hidden"]),
+            TDModule(NormalParamExtractor(), ["hidden"], ["loc", "scale"]),
+        )
+        td = obs_td()
+        params = seq.init(KEY, td)
+        out = seq(params, td)
+        assert out["loc"].shape == (4, 4)
+        assert float(out["scale"].min()) > 0
+
+    def test_out_key_count_mismatch_raises(self):
+        m = TDModule(lambda x: (x, x), ["observation"], ["only_one"])
+        with pytest.raises(ValueError):
+            m({}, obs_td())
+
+    def test_nested_keys(self):
+        m = TDModule(lambda x: x + 1, [("nested", "obs")], [("nested", "out")])
+        td = ArrayDict(nested=ArrayDict(obs=jnp.zeros(3)))
+        out = m({}, td)
+        assert ("nested", "out") in out
+
+
+class TestProbabilisticActor:
+    def make_actor(self):
+        net = TDSequential(
+            TDModule(MLP(out_features=4), ["observation"], ["params_raw"]),
+            TDModule(NormalParamExtractor(), ["params_raw"], ["loc", "scale"]),
+        )
+        return ProbabilisticActor(
+            net, TanhNormal, dist_keys=("loc", "scale"), dist_kwargs={"low": -2.0, "high": 2.0}
+        )
+
+    def test_sample_and_log_prob(self):
+        actor = self.make_actor()
+        td = obs_td()
+        params = actor.init(KEY, td)
+        out = actor(params, td, KEY)
+        assert out["action"].shape == (4, 2)
+        assert out["sample_log_prob"].shape == (4,)
+        assert float(jnp.abs(out["action"]).max()) <= 2.0
+
+    def test_exploration_modes(self):
+        actor = self.make_actor()
+        td = obs_td()
+        params = actor.init(KEY, td)
+        with set_exploration_type(ExplorationType.MODE):
+            a1 = actor(params, td)["action"]
+            a2 = actor(params, td)["action"]
+        np.testing.assert_array_equal(np.asarray(a1), np.asarray(a2))
+        k1, k2 = jax.random.split(KEY)
+        s1 = actor(params, td, k1)["action"]
+        s2 = actor(params, td, k2)["action"]
+        assert not np.array_equal(np.asarray(s1), np.asarray(s2))
+
+    def test_random_requires_key(self):
+        actor = self.make_actor()
+        params = actor.init(KEY, obs_td())
+        with pytest.raises(ValueError):
+            actor(params, obs_td())
+
+    def test_loss_side_log_prob(self):
+        actor = self.make_actor()
+        td = obs_td()
+        params = actor.init(KEY, td)
+        out = actor(params, td, KEY)
+        lp = actor.log_prob(params, out)
+        np.testing.assert_allclose(np.asarray(lp), np.asarray(out["sample_log_prob"]), rtol=1e-4)
+
+    def test_discrete_actor(self):
+        net = TDModule(MLP(out_features=5), ["observation"], ["logits"])
+        actor = ProbabilisticActor(net, Categorical, dist_keys=("logits",))
+        td = obs_td()
+        params = actor.init(KEY, td)
+        out = actor(params, td, KEY)
+        assert out["action"].shape == (4,)
+        assert out["action"].dtype in (jnp.int32, jnp.int64)
+
+
+class TestQValue:
+    def test_qvalue_actor(self):
+        actor = QValueActor(MLP(out_features=6), one_hot=False)
+        td = obs_td()
+        params = actor.init(KEY, td)
+        out = actor(params, td)
+        assert out["action"].shape == (4,)
+        assert out["chosen_action_value"].shape == (4,)
+        q = out["action_value"]
+        np.testing.assert_allclose(
+            np.asarray(out["chosen_action_value"]), np.asarray(q.max(-1)), rtol=1e-6
+        )
+
+    def test_dueling(self):
+        actor = QValueActor(DuelingMLP(num_actions=3), one_hot=True)
+        td = obs_td()
+        params = actor.init(KEY, td)
+        out = actor(params, td)
+        assert out["action"].shape == (4, 3)
+        np.testing.assert_allclose(np.asarray(out["action"].sum(-1)), 1.0)
+
+
+class TestActorValueOperator:
+    def test_shared_trunk(self):
+        common = TDModule(MLP(out_features=16), ["observation"], ["hidden"])
+        actor_net = TDSequential(
+            TDModule(MLP(out_features=4), ["hidden"], ["raw"]),
+            TDModule(NormalParamExtractor(), ["raw"], ["loc", "scale"]),
+        )
+        actor = ProbabilisticActor(actor_net, TanhNormal)
+        value = ValueOperator(MLP(out_features=1), in_keys=["hidden"])
+        av = ActorValueOperator(common, actor, value)
+        td = obs_td()
+        params = av.init(KEY, td)
+        out = av(params, td, KEY)
+        assert out["action"].shape == (4, 2)
+        assert out["state_value"].shape == (4, 1)
+
+        pol = av.get_policy_operator()
+        pout = pol(params, td, KEY)
+        assert "action" in pout and "state_value" not in pout
+        vout = av.get_value_operator()(params, td)
+        assert "state_value" in vout and "action" not in vout
+
+
+class TestExplorationModules:
+    def test_egreedy_anneals(self):
+        spec = CategoricalSpec(n=4)
+        eg = EGreedyModule(spec, eps_init=1.0, eps_end=0.0, annealing_num_steps=10)
+        td = ArrayDict(action=jnp.zeros((64,), jnp.int32), exploration=eg.init_state())
+        out = eg(td, KEY)
+        # eps=1 at step 0: essentially all actions replaced by random
+        frac_random = float((out["action"] != 0).mean())
+        assert frac_random > 0.5
+        assert int(out["exploration", "eg_step"]) == 1
+        # at the end of annealing eps=0: no exploration
+        late = td.set("exploration", ArrayDict(eg_step=jnp.asarray(10, jnp.int32)))
+        out2 = eg(late, KEY)
+        assert float((out2["action"] != 0).mean()) == 0.0
+
+    def test_egreedy_passthrough_in_mode(self):
+        spec = CategoricalSpec(n=4)
+        eg = EGreedyModule(spec)
+        td = ArrayDict(action=jnp.zeros((8,), jnp.int32))
+        with set_exploration_type(ExplorationType.MODE):
+            out = eg(td, KEY)
+        np.testing.assert_array_equal(np.asarray(out["action"]), 0)
+
+    def test_additive_gaussian_respects_bounds(self):
+        spec = Bounded(shape=(2,), low=-1.0, high=1.0)
+        ag = AdditiveGaussianModule(spec, sigma_init=10.0)
+        td = ArrayDict(action=jnp.zeros((16, 2)), exploration=ag.init_state())
+        out = ag(td, KEY)
+        assert float(jnp.abs(out["action"]).max()) <= 1.0
+        assert float(jnp.abs(out["action"]).sum()) > 0
+
+    def test_ou_correlated_and_resets(self):
+        spec = Bounded(shape=(2,), low=-5.0, high=5.0)
+        ou = OrnsteinUhlenbeckModule(spec, sigma=1.0)
+        td = ArrayDict(
+            action=jnp.zeros((2,)),
+            is_init=jnp.asarray(False),
+            exploration=ou.init_state((2,)),
+        )
+        keys = jax.random.split(KEY, 10)
+        noises = []
+        for k in keys:
+            td = ou(td.set("action", jnp.zeros((2,))), k)
+            noises.append(np.asarray(td["exploration", "ou_noise"]))
+        assert np.abs(noises[-1]).sum() > 0
+        # reset on is_init
+        td = td.set("is_init", jnp.asarray(True))
+        td = ou(td.set("action", jnp.zeros((2,))), KEY)
+        # noise was zeroed before the new increment -> small magnitude
+        assert np.abs(np.asarray(td["exploration", "ou_noise"])).max() < 1.0
+
+    def test_random_policy_rollout(self):
+        env = CountingEnv()
+        policy = RandomPolicy(env.action_spec)
+        steps = rollout(env, KEY, lambda td, k: policy(td, k), max_steps=5)
+        assert steps["action"].shape == (5,)
+
+
+class TestConvNet:
+    def test_conv_shapes(self):
+        net = ConvNet()
+        x = jnp.zeros((2, 84, 84, 4))
+        params = net.init(KEY, x)["params"]
+        out = net.apply({"params": params}, x)
+        assert out.ndim == 2 and out.shape[0] == 2

@@ -1,0 +1,155 @@
+"""Regression tests pinning review findings: exploration-state carry,
+CatTensors batched scalars, legacy PRNGKey acceptance, __contains__ through
+leaves, masked ESS."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from rl_tpu.collectors import Collector
+from rl_tpu.data import ArrayDict, Bounded, Categorical as CategoricalSpec, Composite, Unbounded
+from rl_tpu.envs import CartPoleEnv, CatTensors, TransformedEnv, VmapEnv, rollout
+from rl_tpu.envs.base import EnvBase
+from rl_tpu.modules import EGreedyModule, OrnsteinUhlenbeckModule
+from rl_tpu.objectives.ppo import _masked_ess
+from rl_tpu.testing import CountingEnv
+
+KEY = jax.random.key(0)
+
+
+class TestExplorationCarry:
+    def test_egreedy_anneals_through_collector(self):
+        env = VmapEnv(CountingEnv(max_count=100), 2)
+        eg = EGreedyModule(CategoricalSpec(n=2), eps_init=1.0, eps_end=0.0, annealing_num_steps=8)
+
+        def policy(params, td, key):
+            td = td.set("action", jnp.zeros((2,), jnp.int32))
+            return eg(td, key)
+
+        coll = Collector(env, policy, frames_per_batch=32, policy_state=eg.init_state())
+        batch, cstate = coll.collect({}, coll.init(KEY))
+        # step counter advanced through the whole batch (16 scan steps)
+        assert int(cstate["carry"]["exploration", "eg_step"]) == 16
+        # early steps explore (eps=1), late steps don't (eps=0 after 8)
+        acts = np.asarray(batch["action"])  # [T=16, B=2]
+        assert acts[:4].sum() > 0
+        assert acts[-4:].sum() == 0
+
+    def test_ou_noise_correlated_through_rollout(self):
+        env = VmapEnv(
+            _ContinuousNoTermEnv(), 2
+        )
+        ou = OrnsteinUhlenbeckModule(Bounded(shape=(1,), low=-5, high=5), sigma=1.0)
+
+        def policy(td, key):
+            return ou(td.set("action", jnp.zeros((2, 1))), key)
+
+        steps = rollout(
+            env, KEY, policy, max_steps=20, policy_state=ou.init_state((2, 1))
+        )
+        # actions = pure OU noise: must be autocorrelated (white noise is not)
+        a = np.asarray(steps["action"])[:, 0, 0]
+        ac = np.corrcoef(a[:-1], a[1:])[0, 1]
+        assert ac > 0.5, f"OU noise not correlated (r={ac:.2f}) - state not carried"
+        # exploration keys are not recorded in the batch
+        assert "exploration" not in steps
+
+
+class _ContinuousNoTermEnv(EnvBase):
+    @property
+    def observation_spec(self):
+        return Composite(observation=Unbounded(shape=(1,)))
+
+    @property
+    def action_spec(self):
+        return Bounded(shape=(1,), low=-5.0, high=5.0)
+
+    def _reset(self, key):
+        return ArrayDict(x=jnp.zeros(())), ArrayDict(observation=jnp.zeros((1,)))
+
+    def _step(self, state, action, key):
+        return (
+            state,
+            ArrayDict(observation=state["x"][None]),
+            jnp.asarray(0.0),
+            jnp.asarray(False),
+            jnp.asarray(False),
+        )
+
+
+class TestCatTensorsBatched:
+    def test_batched_scalar_keys(self):
+        class ScalarObsEnv(CountingEnv):
+            @property
+            def observation_spec(self):
+                return Composite(
+                    a=Unbounded(shape=()),
+                    b=Unbounded(shape=()),
+                )
+
+            def _reset(self, key):
+                state = ArrayDict(count=jnp.asarray(0, jnp.int32))
+                return state, ArrayDict(a=jnp.asarray(0.0), b=jnp.asarray(0.0))
+
+            def _step(self, state, action, key):
+                count = state["count"] + 1
+                c = count.astype(jnp.float32)
+                return (
+                    ArrayDict(count=count),
+                    ArrayDict(a=c, b=-c),
+                    jnp.asarray(1.0),
+                    count >= self.max_count,
+                    jnp.asarray(False),
+                )
+
+        env = TransformedEnv(VmapEnv(ScalarObsEnv(), 5), CatTensors(in_keys=["a", "b"]))
+        state, td = env.reset(KEY)
+        assert td["observation_vector"].shape == (5, 2)
+        td = env.rand_action(td, KEY)
+        _, out = env.step(state, td)
+        v = np.asarray(out["next", "observation_vector"])
+        assert v.shape == (5, 2)
+        np.testing.assert_allclose(v[:, 0], 1.0)
+        np.testing.assert_allclose(v[:, 1], -1.0)
+
+    def test_unattached_raises(self):
+        t = CatTensors(in_keys=["a"])
+        with pytest.raises(RuntimeError):
+            t._apply(ArrayDict(a=jnp.zeros(3)))
+
+
+class TestLegacyKeys:
+    def test_legacy_prngkey_accepted(self):
+        env = CartPoleEnv()
+        legacy = jax.random.PRNGKey(0)
+        state, td = env.reset(legacy)
+        td = env.rand_action(td, jax.random.key(1))
+        state, full_td, carry = env.step_and_reset(state, td)
+        assert np.isfinite(float(full_td["next", "reward"]))
+
+    def test_int_seed_accepted_by_rollout(self):
+        env = CountingEnv()
+        steps = rollout(env, 7, max_steps=3)
+        assert steps.batch_shape == (3,)
+
+
+class TestContains:
+    def test_path_through_leaf_is_false(self):
+        td = ArrayDict(obs=jnp.zeros((4, 3)))
+        assert ("obs", "x") not in td
+
+    def test_non_str_tuple_is_false(self):
+        td = ArrayDict(obs=jnp.zeros((4, 3)))
+        assert (0, 1) not in td
+
+
+class TestMaskedESS:
+    def test_mask_excludes_invalid(self):
+        # invalid half contains one dominating log-weight spike
+        lw = jnp.concatenate([jnp.zeros(10), jnp.asarray([20.0]), jnp.zeros(9)])
+        mask = jnp.concatenate([jnp.ones(10, bool), jnp.zeros(10, bool)])
+        # valid half is perfectly uniform -> ESS fraction == 1
+        np.testing.assert_allclose(float(_masked_ess(lw, mask)), 1.0, rtol=1e-5)
+        # unmasked, the spike crushes ESS to ~1/20
+        assert float(_masked_ess(lw, None)) < 0.1
