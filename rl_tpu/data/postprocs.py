@@ -1,0 +1,118 @@
+"""Post-collection batch processors.
+
+``MultiStep``: n-step return folding (reference:
+torchrl/data/postprocs/postprocs.py:85 ``MultiStep``): rewrites each
+transition's reward to the discounted n-step sum and its "next" observation
+to the state n steps ahead (stopping at episode boundaries), so one-step TD
+losses train on n-step targets unchanged.
+
+Applied inside the collector's jit (``Collector(postproc=MultiStep(...))``),
+operating on time-major ``[T, ...]`` rollout batches.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .arraydict import ArrayDict
+
+__all__ = ["MultiStep", "DensifyReward"]
+
+
+class MultiStep:
+    """n-step reward folding over a time-major batch.
+
+    For each t: ``R_t = Σ_{k<n} γ^k r_{t+k}`` (sum stops after a done);
+    ("next", obs/done/terminated) become those of the step where the sum
+    stopped (t+n-1 or the terminal step); writes "steps_to_next_obs" (the k
+    actually folded) and keeps the original reward at
+    ("next", "original_reward") — matching reference key conventions.
+    """
+
+    def __init__(self, gamma: float = 0.99, n_steps: int = 3):
+        self.gamma = gamma
+        self.n_steps = n_steps
+
+    def __call__(self, batch: ArrayDict) -> ArrayDict:
+        T = batch.batch_shape[0]
+        nxt = batch["next"]
+        reward = nxt["reward"]
+        done = nxt["done"]
+
+        nd = (~done).astype(jnp.float32)
+        # alive at iteration k = 1 if steps t..t+k-1 are all not-done
+        folded = reward
+        alive = jnp.ones_like(nd)
+        # index of the transition supplying the "next" content
+        base = jnp.broadcast_to(
+            jnp.arange(T).reshape((T,) + (1,) * (done.ndim - 1)), done.shape
+        )
+        src = base
+        steps = jnp.ones_like(done, jnp.int32)
+        avail = jnp.ones_like(nd)
+        for k in range(1, self.n_steps):
+            # window extends only while step t+k exists (the batch end is a
+            # cut, zero-padded by _shift_back) and t+k-1 was not done
+            avail = _shift_back(avail, 1)
+            alive = alive * _shift_back(nd, k - 1) * avail
+            r_k = _shift_back(reward, k)
+            folded = folded + (self.gamma**k) * alive * r_k
+            adv_src = _shift_back(base, k, fill_last=True)  # = min(t+k, T-1)
+            src = jnp.where(alive > 0, adv_src, src)
+            steps = steps + (alive > 0).astype(jnp.int32)
+
+        def gather_t(x):
+            if x.ndim < src.ndim:
+                return x
+            s = src.reshape(src.shape + (1,) * (x.ndim - src.ndim))
+            s = jnp.broadcast_to(s, src.shape + x.shape[src.ndim :])
+            return jnp.take_along_axis(x, s.astype(jnp.int32), axis=0)
+
+        new_next = nxt.apply(gather_t)
+        new_next = new_next.set("reward", folded)
+        new_next = new_next.set("original_reward", reward)
+        out = batch.set("next", new_next).set("steps_to_next_obs", steps)
+        return out
+
+
+def _shift_back(x: jax.Array, k: int, fill_last: bool = False) -> jax.Array:
+    """x[t] <- x[t+k] along axis 0, padding the tail."""
+    if k == 0:
+        return x
+    pad_val = x[-1:] if fill_last else jnp.zeros_like(x[:1])
+    tail = jnp.repeat(pad_val, k, axis=0)
+    return jnp.concatenate([x[k:], tail], axis=0)
+
+
+class DensifyReward:
+    """Spread a sparse terminal reward uniformly over the episode
+    (reference postprocs.py:299)."""
+
+    def __init__(self, reward_key=("next", "reward"), done_key=("next", "done")):
+        self.reward_key = reward_key
+        self.done_key = done_key
+
+    def __call__(self, batch: ArrayDict) -> ArrayDict:
+        # segment-mean of the episode's total reward, assigned to every step:
+        # total_t = reward-to-go_t + reward-so-far_{t} - r_t (both scans cut
+        # at episode boundaries), length_t likewise; dense = total / length.
+        from ..ops.value import linear_recurrence_forward, linear_recurrence_reverse
+
+        reward = batch[self.reward_key]
+        done = batch[self.done_key].astype(jnp.float32)
+        not_done = 1.0 - done
+        ones = jnp.ones_like(reward)
+
+        rtg = linear_recurrence_reverse(not_done, reward)
+        steps_to_go = linear_recurrence_reverse(not_done, ones)
+        # forward pass: a_t gates on the PREVIOUS step's done (episode starts
+        # after a done), so shift the not_done gate by one
+        prev_nd = jnp.concatenate([jnp.zeros_like(not_done[:1]), not_done[:-1]], axis=0)
+        so_far = linear_recurrence_forward(prev_nd, reward)
+        steps_so_far = linear_recurrence_forward(prev_nd, ones)
+
+        totals = rtg + so_far - reward
+        lengths = steps_to_go + steps_so_far - 1.0
+        dense = totals / jnp.clip(lengths, 1.0)
+        return batch.set(self.reward_key, dense)

@@ -1,0 +1,27 @@
+from .buffer import ReplayBuffer
+from .samplers import (
+    PrioritizedSampler,
+    RandomSampler,
+    Sampler,
+    SamplerWithoutReplacement,
+    SliceSampler,
+)
+from .storages import DeviceStorage, ListStorage, MemmapStorage, Storage
+from .writers import ImmutableDatasetWriter, MaxValueWriter, RoundRobinWriter, Writer
+
+__all__ = [
+    "ReplayBuffer",
+    "Storage",
+    "DeviceStorage",
+    "MemmapStorage",
+    "ListStorage",
+    "Sampler",
+    "RandomSampler",
+    "SamplerWithoutReplacement",
+    "PrioritizedSampler",
+    "SliceSampler",
+    "Writer",
+    "RoundRobinWriter",
+    "MaxValueWriter",
+    "ImmutableDatasetWriter",
+]

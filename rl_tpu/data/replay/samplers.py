@@ -1,0 +1,230 @@
+"""Replay samplers, including on-device prioritized sampling.
+
+Redesign of the reference sampler suite (reference:
+torchrl/data/replay_buffers/samplers.py — ``Sampler``:106,
+``RandomSampler``:181, ``SamplerWithoutReplacement``:580,
+``PrioritizedSampler``:942 (C++ segment trees), ``SliceSampler``:1696).
+
+**PER without segment trees.** The reference's prioritized sampler does
+O(log N) point queries on a host C++ sum-tree — a pointer-chasing,
+host-resident structure that is the wrong shape for TPU. Here sampling is a
+parallel prefix-sum + batched ``searchsorted`` over the whole priority
+array: O(N log N) work but fully vectorized on the VPU with zero host
+round-trips, and it lives inside the same XLA program as the train step.
+At reference-scale capacities (1e5-1e6) this is bandwidth-trivial next to
+the gradient step. Priority *updates* are pure scatters.
+
+Sampler state (annealed β, without-replacement permutations, PER
+priorities) is functional and threads through jit like storage state.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from ..arraydict import ArrayDict
+
+__all__ = [
+    "Sampler",
+    "RandomSampler",
+    "SamplerWithoutReplacement",
+    "PrioritizedSampler",
+    "SliceSampler",
+]
+
+
+class Sampler:
+    """Abstract sampler: ``init(capacity)`` builds state; ``sample`` returns
+    (indices, info, new_state); hooks for writes/priority updates."""
+
+    def init(self, capacity: int) -> ArrayDict:
+        return ArrayDict()
+
+    def sample(
+        self, sstate: ArrayDict, key: jax.Array, batch_size: int, size: jax.Array, capacity: int
+    ) -> tuple[jax.Array, ArrayDict, ArrayDict]:
+        raise NotImplementedError
+
+    def on_write(self, sstate: ArrayDict, idx: jax.Array, items: ArrayDict) -> ArrayDict:
+        return sstate
+
+    def update_priority(self, sstate: ArrayDict, idx: jax.Array, priority: jax.Array) -> ArrayDict:
+        return sstate
+
+
+class RandomSampler(Sampler):
+    """Uniform with replacement (reference samplers.py:181)."""
+
+    def sample(self, sstate, key, batch_size, size, capacity):
+        idx = jax.random.randint(key, (batch_size,), 0, jnp.maximum(size, 1))
+        return idx, ArrayDict(), sstate
+
+
+class SamplerWithoutReplacement(Sampler):
+    """Epoch-style without-replacement sampling (reference samplers.py:580).
+
+    Keeps a per-epoch random offset + permutation seed; when a pass over the
+    data completes, reshuffles. Jit-safe via counter arithmetic: position
+    ``p`` in the epoch maps through a pseudorandom permutation derived from
+    the epoch seed (feistel-free: regenerated `jax.random.permutation` of a
+    fixed capacity, masked to size).
+    """
+
+    def init(self, capacity: int) -> ArrayDict:
+        return ArrayDict(
+            pos=jnp.asarray(0, jnp.int32),
+            epoch=jnp.asarray(0, jnp.int32),
+            epoch_key=jax.random.key(0),  # placeholder; replaced on 1st sample
+        )
+
+    def sample(self, sstate, key, batch_size, size, capacity):
+        from ...utils.seeding import ensure_typed_key
+
+        key = ensure_typed_key(key)
+        pos = sstate["pos"]
+        # new epoch when the remaining data can't fill this batch, and always
+        # on the first sample (the init key is a placeholder, not the
+        # caller's randomness)
+        need_reshuffle = (pos + batch_size > size) | (sstate["epoch"] == 0)
+        epoch_key = jax.lax.select(need_reshuffle, key, sstate["epoch_key"])
+        pos = jnp.where(need_reshuffle, 0, pos)
+        # random permutation of [0, capacity); keep only values < size, in
+        # permutation order, via scatter-by-rank (OOB targets are dropped)
+        perm = jax.random.permutation(epoch_key, capacity)
+        valid = perm < size
+        rank = jnp.cumsum(valid) - 1
+        target = jnp.where(valid, rank, capacity)
+        filled_order = (
+            jnp.zeros((capacity,), perm.dtype).at[target].set(perm, mode="drop")
+        )
+        wanted = (pos + jnp.arange(batch_size)) % jnp.maximum(size, 1)
+        idx = filled_order[wanted]
+        new_state = ArrayDict(
+            pos=pos + batch_size,
+            epoch=sstate["epoch"] + need_reshuffle.astype(jnp.int32),
+            epoch_key=epoch_key,
+        )
+        return idx, ArrayDict(), new_state
+
+
+class PrioritizedSampler(Sampler):
+    """Proportional PER (Schaul et al. 2016; reference samplers.py:942).
+
+    ``P(i) ∝ p_i^α``; importance weights ``w_i = (N·P(i))^{-β}`` normalized
+    by ``max w`` (reference convention: weights relative to the minimum
+    priority). β anneals linearly to 1 over ``beta_annealing_steps`` if set.
+    """
+
+    def __init__(
+        self,
+        alpha: float = 0.6,
+        beta: float = 0.4,
+        eps: float = 1e-8,
+        beta_annealing_steps: int | None = None,
+    ):
+        self.alpha = alpha
+        self.beta0 = beta
+        self.eps = eps
+        self.beta_annealing_steps = beta_annealing_steps
+
+    def init(self, capacity: int) -> ArrayDict:
+        return ArrayDict(
+            priorities=jnp.zeros((capacity,), jnp.float32),
+            max_priority=jnp.asarray(1.0, jnp.float32),
+            step=jnp.asarray(0, jnp.int32),
+        )
+
+    def _beta(self, step):
+        if self.beta_annealing_steps is None:
+            return jnp.asarray(self.beta0, jnp.float32)
+        frac = jnp.clip(step.astype(jnp.float32) / self.beta_annealing_steps, 0.0, 1.0)
+        return self.beta0 + (1.0 - self.beta0) * frac
+
+    def sample(self, sstate, key, batch_size, size, capacity):
+        prio = sstate["priorities"]
+        mask = jnp.arange(capacity) < size
+        p_alpha = jnp.where(mask, jnp.power(prio + self.eps, self.alpha), 0.0)
+        csum = jnp.cumsum(p_alpha)
+        total = csum[-1]
+        u = jax.random.uniform(key, (batch_size,)) * total
+        idx = jnp.clip(jnp.searchsorted(csum, u, side="right"), 0, capacity - 1)
+
+        probs = p_alpha / jnp.clip(total, 1e-12)
+        beta = self._beta(sstate["step"])
+        n = jnp.maximum(size.astype(jnp.float32), 1.0)
+        weights = jnp.power(n * jnp.clip(probs[idx], 1e-12), -beta)
+        # normalize by the max possible weight (min priority) for stability
+        min_prob = jnp.min(jnp.where(mask, probs, jnp.inf))
+        max_w = jnp.power(n * jnp.clip(min_prob, 1e-12), -beta)
+        weights = weights / jnp.clip(max_w, 1e-12)
+        info = ArrayDict(_weight=weights, index=idx)
+        return idx, info, sstate.set("step", sstate["step"] + 1)
+
+    def on_write(self, sstate, idx, items):
+        # new samples get max priority (reference behavior)
+        prio = sstate["priorities"].at[idx].set(sstate["max_priority"])
+        return sstate.set("priorities", prio)
+
+    def update_priority(self, sstate, idx, priority):
+        priority = jnp.abs(priority) + self.eps
+        prio = sstate["priorities"].at[idx].set(priority)
+        max_p = jnp.maximum(sstate["max_priority"], jnp.max(priority))
+        return sstate.replace(priorities=prio, max_priority=max_p)
+
+
+class SliceSampler(Sampler):
+    """Trajectory-slice sampling for sequence training (reference
+    samplers.py:1696): sample windows of ``slice_len`` consecutive steps that
+    do not cross episode boundaries.
+
+    Requires the buffer to store ``("collector","traj_ids")`` (written by the
+    Collector). Sampling: draw start indices, accept those whose window stays
+    within one trajectory id, resampling rejects via a fixed number of
+    parallel candidates (jit-safe, no dynamic loop): draw ``oversample``
+    candidates per slot and pick the first valid one.
+    """
+
+    def __init__(self, slice_len: int, traj_key=("collector", "traj_ids"), oversample: int = 8):
+        self.slice_len = slice_len
+        self.traj_key = traj_key
+        self.oversample = oversample
+
+    def init(self, capacity: int) -> ArrayDict:
+        return ArrayDict(traj_ids=jnp.full((capacity,), -1, jnp.int32))
+
+    def on_write(self, sstate, idx, items):
+        if self.traj_key in items:
+            tid = items[self.traj_key].astype(jnp.int32)
+        else:
+            tid = jnp.zeros(jnp.shape(idx), jnp.int32)
+        return sstate.set("traj_ids", sstate["traj_ids"].at[idx].set(tid))
+
+    def sample(self, sstate, key, batch_size, size, capacity):
+        num_slices = batch_size // self.slice_len
+        tids = sstate["traj_ids"]
+        hi = jnp.maximum(size - self.slice_len + 1, 1)
+        starts = jax.random.randint(
+            key, (num_slices, self.oversample), 0, hi
+        )
+
+        window = jnp.arange(self.slice_len)
+
+        def valid(start):
+            w = tids[start + window]
+            return jnp.all(w == w[0]) & (w[0] >= 0)
+
+        ok = jax.vmap(jax.vmap(valid))(starts)  # [num_slices, oversample]
+        first = jnp.argmax(ok, axis=1)
+        chosen = jnp.take_along_axis(starts, first[:, None], axis=1)[:, 0]
+        any_ok = jnp.any(ok, axis=1)
+        # fall back to the first candidate when none valid (short buffers);
+        # consumers MUST mask those steps out via "mask" (losses here read it
+        # by default through their mask_key)
+        chosen = jnp.where(any_ok, chosen, starts[:, 0])
+        idx = (chosen[:, None] + window[None, :]).reshape(-1)
+        step_mask = jnp.repeat(any_ok, self.slice_len)
+        info = ArrayDict(valid_slices=any_ok, mask=step_mask)
+        return idx, info, sstate

@@ -1,0 +1,172 @@
+"""Replay storages.
+
+TPU-native redesign of the reference's storage layer (reference:
+torchrl/data/replay_buffers/storages.py — ``Storage``:171, ``ListStorage``
+:362, ``TensorStorage``:636, ``LazyTensorStorage``:1335,
+``LazyMemmapStorage``:1587).
+
+The north-star storages are re-designed around XLA:
+
+- :class:`DeviceStorage` (LazyTensorStorage analog): a preallocated ArrayDict
+  ring on device. All ops are functional (`state -> state`) and jit-safe, so
+  a replay buffer can live *inside* a fused off-policy train step; with
+  buffer donation XLA updates it in place (``.at[idx].set`` on a donated
+  carry compiles to dynamic-update-slice, no copy).
+- :class:`MemmapStorage` (LazyMemmapStorage analog): host-side numpy memmap
+  ring for capacities beyond HBM; not jit-traceable (host boundary), used by
+  host collectors/offline datasets.
+- :class:`ListStorage`: host python list (arbitrary payloads, LLM text).
+
+Storage *state* is separated from the storage *object*: the object holds
+static config; the state (an ArrayDict: {"data", "cursor", "size"}) threads
+through jitted code. Lazy layout inference happens on first write, like the
+reference's lazy storages.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..arraydict import ArrayDict
+
+__all__ = ["Storage", "DeviceStorage", "MemmapStorage", "ListStorage"]
+
+
+class Storage:
+    """Abstract storage. ``init`` from an example item; ``set``/``get`` by
+    index; ``__len__``-style size lives in the state."""
+
+    def __init__(self, capacity: int):
+        self.capacity = capacity
+
+    def init(self, example: ArrayDict) -> Any:
+        raise NotImplementedError
+
+    def set(self, state: Any, idx: jax.Array, items: ArrayDict) -> Any:
+        raise NotImplementedError
+
+    def get(self, state: Any, idx: jax.Array) -> ArrayDict:
+        raise NotImplementedError
+
+    def size(self, state: Any) -> jax.Array:
+        raise NotImplementedError
+
+
+class DeviceStorage(Storage):
+    """Preallocated device ring buffer of ArrayDicts (jit-safe).
+
+    ``init(example)`` allocates ``[capacity, *feature]`` zeros per leaf from
+    one example item (batch dims of the example are ignored — layout is
+    per-item, reference LazyTensorStorage semantics of allocating on first
+    write). Optional ``sharding`` places the capacity axis over a mesh axis
+    for pod-scale device-resident replay.
+    """
+
+    def __init__(self, capacity: int, sharding: Any = None):
+        super().__init__(capacity)
+        self.sharding = sharding
+
+    def init(self, example: ArrayDict) -> ArrayDict:
+        def alloc(x):
+            x = jnp.asarray(x)
+            buf = jnp.zeros((self.capacity,) + x.shape, x.dtype)
+            if self.sharding is not None:
+                buf = jax.device_put(buf, self.sharding)
+            return buf
+
+        return ArrayDict(
+            data=example.apply(alloc),
+            cursor=jnp.asarray(0, jnp.int32),
+            size=jnp.asarray(0, jnp.int32),
+        )
+
+    def set(self, state: ArrayDict, idx: jax.Array, items: ArrayDict) -> ArrayDict:
+        data = jax.tree.map(lambda buf, x: buf.at[idx].set(x), state["data"], items)
+        return state.set("data", data)
+
+    def get(self, state: ArrayDict, idx: jax.Array) -> ArrayDict:
+        return state["data"].apply(lambda buf: buf[idx])
+
+    def size(self, state: ArrayDict) -> jax.Array:
+        return state["size"]
+
+
+class MemmapStorage(Storage):
+    """Disk-backed host ring buffer (reference LazyMemmapStorage,
+    storages.py:1587): one ``.npy`` memmap per leaf under ``scratch_dir``.
+
+    Host-side only (not jit-traceable); the state is a small python dict
+    ``{"cursor": int, "size": int}`` — the memmaps mutate in place.
+    """
+
+    def __init__(self, capacity: int, scratch_dir: str | None = None):
+        super().__init__(capacity)
+        import tempfile
+
+        self.scratch_dir = scratch_dir or tempfile.mkdtemp(prefix="rl_tpu_memmap_")
+        self._maps: dict[tuple, np.memmap] = {}
+
+    def init(self, example: ArrayDict) -> dict:
+        os.makedirs(self.scratch_dir, exist_ok=True)
+        self._maps = {}
+        for path in example.keys(nested=True, leaves_only=True):
+            x = np.asarray(example[path])
+            fname = os.path.join(self.scratch_dir, "_".join(path) + ".dat")
+            self._maps[path] = np.memmap(
+                fname, dtype=x.dtype, mode="w+", shape=(self.capacity,) + x.shape
+            )
+        return {"cursor": 0, "size": 0}
+
+    def set(self, state: dict, idx, items: ArrayDict) -> dict:
+        idx = np.asarray(idx)
+        for path, mm in self._maps.items():
+            mm[idx] = np.asarray(items[path])
+        return state
+
+    def get(self, state: dict, idx) -> ArrayDict:
+        idx = np.asarray(idx)
+        out = ArrayDict()
+        for path, mm in self._maps.items():
+            out = out.set(path, jnp.asarray(mm[idx]))
+        return out
+
+    def size(self, state: dict) -> int:
+        return state["size"]
+
+    def flush(self):
+        for mm in self._maps.values():
+            mm.flush()
+
+
+class ListStorage(Storage):
+    """Host list storage for arbitrary payloads (reference ListStorage,
+    storages.py:362). Not jit-traceable."""
+
+    def __init__(self, capacity: int):
+        super().__init__(capacity)
+        self._items: list = []
+
+    def init(self, example: ArrayDict | None = None) -> dict:
+        self._items = []
+        return {"cursor": 0, "size": 0}
+
+    def set(self, state: dict, idx, items) -> dict:
+        idx = np.atleast_1d(np.asarray(idx))
+        seq = items if isinstance(items, (list, tuple)) else [items[i] for i in range(idx.size)]
+        for i, item in zip(idx, seq):
+            while len(self._items) <= i:
+                self._items.append(None)
+            self._items[int(i)] = item
+        return state
+
+    def get(self, state: dict, idx) -> list:
+        idx = np.atleast_1d(np.asarray(idx))
+        return [self._items[int(i)] for i in idx]
+
+    def size(self, state: dict) -> int:
+        return state["size"]

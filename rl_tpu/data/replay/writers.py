@@ -1,0 +1,81 @@
+"""Replay writers (reference: torchrl/data/replay_buffers/writers.py —
+``Writer``:43, ``RoundRobinWriter``:148, ``TensorDictMaxValueWriter``:416,
+``ImmutableDatasetWriter``:121).
+
+A writer decides *where* incoming items land. Functional: ``assign`` maps
+(writer_state, n_items, buffer_size/cursor) -> target indices + new state.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..arraydict import ArrayDict
+
+__all__ = ["Writer", "RoundRobinWriter", "MaxValueWriter", "ImmutableDatasetWriter"]
+
+
+class Writer:
+    def init(self, capacity: int) -> ArrayDict:
+        return ArrayDict()
+
+    def assign(
+        self, wstate: ArrayDict, bstate: ArrayDict, items: ArrayDict, n: int, capacity: int
+    ) -> tuple[jax.Array, ArrayDict, ArrayDict]:
+        """Returns (indices [n] — entries may be ``capacity`` to drop,
+        new writer state, new buffer state with cursor/size advanced)."""
+        raise NotImplementedError
+
+
+class RoundRobinWriter(Writer):
+    """Ring-cursor writer (reference writers.py:148)."""
+
+    def assign(self, wstate, bstate, items, n, capacity):
+        cursor = bstate["cursor"]
+        idx = (cursor + jnp.arange(n)) % capacity
+        new_b = bstate.replace(
+            cursor=(cursor + n) % capacity,
+            size=jnp.minimum(bstate["size"] + n, capacity),
+        )
+        return idx, wstate, new_b
+
+
+class MaxValueWriter(Writer):
+    """Top-k retention by a rank key (reference TensorDictMaxValueWriter,
+    writers.py:416): an incoming item replaces the current minimum-valued
+    slot only if it ranks higher; fills empty slots first.
+
+    Jit-safe: the replacement decision is a ``where`` on values. Processes
+    items one-by-one via ``lax.scan`` (correct multi-eviction semantics).
+    """
+
+    def __init__(self, rank_key="value"):
+        self.rank_key = rank_key if isinstance(rank_key, tuple) else (rank_key,)
+
+    def init(self, capacity: int) -> ArrayDict:
+        return ArrayDict(values=jnp.full((capacity,), -jnp.inf, jnp.float32))
+
+    def assign(self, wstate, bstate, items, n, capacity):
+        vals_in = items[self.rank_key].reshape(n).astype(jnp.float32)
+
+        def body(carry, v):
+            values, size = carry
+            # fill empty slot if any, else candidate = argmin slot
+            slot = jnp.where(size < capacity, size, jnp.argmin(values))
+            accept = (size < capacity) | (v > values[slot])
+            tgt = jnp.where(accept, slot, capacity)  # capacity = dropped
+            values = values.at[tgt].set(v, mode="drop")
+            size = jnp.minimum(size + accept.astype(jnp.int32), capacity)
+            return (values, size), tgt
+
+        (values, size), idx = jax.lax.scan(body, (wstate["values"], bstate["size"]), vals_in)
+        new_b = bstate.replace(size=size, cursor=jnp.minimum(size, capacity - 1))
+        return idx, ArrayDict(values=values), new_b
+
+
+class ImmutableDatasetWriter(Writer):
+    """Refuses writes (offline datasets; reference writers.py:121)."""
+
+    def assign(self, wstate, bstate, items, n, capacity):
+        raise RuntimeError("ImmutableDatasetWriter: this buffer is read-only")

@@ -18,7 +18,16 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-__all__ = ["MLP", "ConvNet", "DuelingMLP", "NoisyDense", "NormalParamExtractor"]
+__all__ = [
+    "MLP",
+    "ConcatMLP",
+    "ConvNet",
+    "DuelingMLP",
+    "NoisyDense",
+    "NormalParamExtractor",
+    "init_ensemble",
+    "apply_ensemble",
+]
 
 
 def _activation(name_or_fn) -> Callable:
@@ -61,6 +70,50 @@ class MLP(nn.Module):
         if self.activate_last_layer:
             x = act(x)
         return x
+
+
+class ConcatMLP(nn.Module):
+    """MLP over the concatenation of several inputs — the Q(s, a) critic body
+    (reference DDPGQNet-style usage, models.py:1081+)."""
+
+    out_features: int
+    num_cells: Sequence[int] = (256, 256)
+    activation: Any = "relu"
+    layer_norm: bool = False
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, *xs):
+        x = jnp.concatenate([jnp.asarray(v, self.dtype) for v in xs], axis=-1)
+        return MLP(
+            out_features=self.out_features,
+            num_cells=self.num_cells,
+            activation=self.activation,
+            layer_norm=self.layer_norm,
+            dtype=self.dtype,
+        )(x)
+
+
+def init_ensemble(module: Any, key: jax.Array, n: int, *example_inputs):
+    """Initialize ``n`` independent parameter sets of one flax module,
+    stacked on a leading axis — the TPU-native form of the reference's
+    ``convert_to_functional(..., expand_dim=n)`` critic ensembles
+    (reference objectives/common.py:341): a single vmapped apply replaces
+    n sequential module calls.
+    """
+    keys = jax.random.split(key, n)
+
+    def one(k):
+        return module.init(k, *example_inputs)["params"]
+
+    return jax.vmap(one)(keys)
+
+
+def apply_ensemble(module: Any, stacked_params, *inputs):
+    """Apply a module under every stacked param set: output leading axis n."""
+    return jax.vmap(
+        lambda p: module.apply({"params": p}, *inputs)
+    )(stacked_params)
 
 
 class ConvNet(nn.Module):
@@ -144,6 +197,30 @@ class NoisyDense(nn.Module):
         w = w_mu + w_sigma * jnp.outer(eps_in, eps_out)
         b = b_mu + b_sigma * eps_out
         return x @ w + b
+
+
+class TanhPolicy(nn.Module):
+    """Deterministic policy head: MLP -> tanh -> affine into [low, high]
+    (reference TanhModule, tensordict_module/actors.py:2066 — the DDPG/TD3
+    actor shape)."""
+
+    action_dim: int
+    num_cells: Sequence[int] = (256, 256)
+    activation: Any = "relu"
+    low: float = -1.0
+    high: float = 1.0
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        out = MLP(
+            out_features=self.action_dim,
+            num_cells=self.num_cells,
+            activation=self.activation,
+            dtype=self.dtype,
+        )(x)
+        t = jnp.tanh(out)
+        return (t + 1.0) * 0.5 * (self.high - self.low) + self.low
 
 
 class NormalParamExtractor(nn.Module):

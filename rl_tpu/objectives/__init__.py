@@ -6,7 +6,13 @@ from .common import (
     hold_out,
     masked_mean,
 )
+from .cql import CQLLoss, DiscreteCQLLoss
+from .ddpg import DDPGLoss, TD3Loss
+from .dqn import DistributionalDQNLoss, DQNLoss
+from .iql import IQLLoss
+from .redq import REDQLoss
 from .ppo import A2CLoss, ClipPPOLoss, KLPENPPOLoss, PPOLoss, ReinforceLoss
+from .sac import DiscreteSACLoss, SACLoss
 from .value import (
     GAE,
     TD0Estimator,
@@ -25,6 +31,16 @@ __all__ = [
     "HardUpdate",
     "hold_out",
     "masked_mean",
+    "DQNLoss",
+    "DistributionalDQNLoss",
+    "SACLoss",
+    "DiscreteSACLoss",
+    "DDPGLoss",
+    "TD3Loss",
+    "IQLLoss",
+    "CQLLoss",
+    "DiscreteCQLLoss",
+    "REDQLoss",
     "PPOLoss",
     "ClipPPOLoss",
     "KLPENPPOLoss",

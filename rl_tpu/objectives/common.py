@@ -24,7 +24,23 @@ import optax
 
 from ..data import ArrayDict
 
-__all__ = ["LossModule", "SoftUpdate", "HardUpdate", "masked_mean", "hold_out"]
+__all__ = [
+    "LossModule",
+    "SoftUpdate",
+    "HardUpdate",
+    "masked_mean",
+    "hold_out",
+    "bootstrap_discount",
+]
+
+
+def bootstrap_discount(batch: ArrayDict, gamma: float) -> jax.Array:
+    """Per-sample bootstrap discount: ``gamma**n`` when the batch carries
+    n-step-folded transitions (MultiStep writes "steps_to_next_obs",
+    rl_tpu/data/postprocs.py), else scalar ``gamma``."""
+    if "steps_to_next_obs" in batch:
+        return jnp.power(gamma, batch["steps_to_next_obs"].astype(jnp.float32))
+    return jnp.asarray(gamma, jnp.float32)
 
 
 def masked_mean(x: jax.Array, mask: jax.Array | None) -> jax.Array:

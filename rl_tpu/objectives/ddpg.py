@@ -1,0 +1,154 @@
+"""DDPG and TD3 losses.
+
+Functional redesigns (reference: torchrl/objectives/ddpg.py:27 ``DDPGLoss``;
+td3.py:27 ``TD3Loss``). Deterministic actors are TDModules writing "action"
+(e.g. a :class:`rl_tpu.modules.TanhPolicy`); critics are flax
+``(obs, action) -> [..,1]`` modules, ensembled for TD3.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..data import ArrayDict
+from ..modules.networks import apply_ensemble, init_ensemble
+from .common import bootstrap_discount, LossModule, hold_out
+
+__all__ = ["DDPGLoss", "TD3Loss"]
+
+
+class DDPGLoss(LossModule):
+    """Deterministic policy gradient with target actor+critic
+    (reference ddpg.py:27)."""
+
+    target_keys = ("target_actor", "target_qvalue")
+
+    def __init__(self, actor, qvalue_module, gamma: float = 0.99, loss_function: str = "l2"):
+        self.actor = actor  # TDModule: obs -> "action"
+        self.qvalue_module = qvalue_module
+        self.gamma = gamma
+        self.loss_function = loss_function
+
+    def init_params(self, key, td):
+        ka, kq = jax.random.split(key)
+        actor_params = self.actor.init(ka, td)
+        action = self.actor(actor_params, td)["action"]
+        qvalue = init_ensemble(self.qvalue_module, kq, 1, td["observation"], action)
+        return {
+            "actor": actor_params,
+            "qvalue": qvalue,
+            "target_actor": jax.tree.map(jnp.copy, actor_params),
+            "target_qvalue": jax.tree.map(jnp.copy, qvalue),
+        }
+
+    def _q(self, qparams, obs, action):
+        return apply_ensemble(self.qvalue_module, qparams, obs, action)[..., 0]
+
+    def __call__(self, params, batch: ArrayDict, key=None):
+        # critic
+        next_a = self.actor(hold_out(params["target_actor"]), batch["next"])["action"]
+        next_q = self._q(hold_out(params["target_qvalue"]), batch["next", "observation"], next_a)[0]
+        reward = batch["next", "reward"]
+        not_term = 1.0 - batch["next", "terminated"].astype(jnp.float32)
+        target = jax.lax.stop_gradient(reward + bootstrap_discount(batch, self.gamma) * not_term * next_q)
+        q = self._q(params["qvalue"], batch["observation"], batch["action"])[0]
+        td_error = q - target
+        if self.loss_function == "smooth_l1":
+            loss_value = jnp.mean(
+                jnp.where(jnp.abs(td_error) < 1.0, 0.5 * td_error**2, jnp.abs(td_error) - 0.5)
+            )
+        else:
+            loss_value = jnp.mean(td_error**2)
+
+        # actor
+        a_pi = self.actor(params["actor"], batch)["action"]
+        q_pi = self._q(hold_out(params["qvalue"]), batch["observation"], a_pi)[0]
+        loss_actor = -jnp.mean(q_pi)
+
+        total = loss_value + loss_actor
+        return total, ArrayDict(
+            loss_value=loss_value,
+            loss_actor=loss_actor,
+            td_error=jax.lax.stop_gradient(jnp.abs(td_error)),
+            pred_value=jax.lax.stop_gradient(q.mean()),
+        )
+
+
+class TD3Loss(LossModule):
+    """Twin-delayed DDPG (reference td3.py:27): twin critics, target-policy
+    smoothing noise, min-of-targets. The actor-update delay is implemented by
+    ``OffPolicyConfig(policy_delay=2)`` (rl_tpu/trainers/off_policy.py),
+    which zeroes actor grads on non-delay steps.
+    """
+
+    target_keys = ("target_actor", "target_qvalue")
+
+    def __init__(
+        self,
+        actor,
+        qvalue_module,
+        action_low,
+        action_high,
+        num_qvalue_nets: int = 2,
+        gamma: float = 0.99,
+        policy_noise: float = 0.2,
+        noise_clip: float = 0.5,
+    ):
+        self.actor = actor
+        self.qvalue_module = qvalue_module
+        self.num_qvalue_nets = num_qvalue_nets
+        self.gamma = gamma
+        self.policy_noise = policy_noise
+        self.noise_clip = noise_clip
+        self.action_low = jnp.asarray(action_low)
+        self.action_high = jnp.asarray(action_high)
+
+    def init_params(self, key, td):
+        ka, kq = jax.random.split(key)
+        actor_params = self.actor.init(ka, td)
+        action = self.actor(actor_params, td)["action"]
+        qvalue = init_ensemble(
+            self.qvalue_module, kq, self.num_qvalue_nets, td["observation"], action
+        )
+        return {
+            "actor": actor_params,
+            "qvalue": qvalue,
+            "target_actor": jax.tree.map(jnp.copy, actor_params),
+            "target_qvalue": jax.tree.map(jnp.copy, qvalue),
+        }
+
+    def _q(self, qparams, obs, action):
+        return apply_ensemble(self.qvalue_module, qparams, obs, action)[..., 0]
+
+    def __call__(self, params, batch: ArrayDict, key=None):
+        if key is None:
+            raise ValueError("TD3Loss requires a PRNG key (target policy smoothing)")
+        next_a = self.actor(hold_out(params["target_actor"]), batch["next"])["action"]
+        noise = jnp.clip(
+            self.policy_noise * jax.random.normal(key, next_a.shape),
+            -self.noise_clip,
+            self.noise_clip,
+        )
+        next_a = jnp.clip(next_a + noise, self.action_low, self.action_high)
+        next_q = self._q(hold_out(params["target_qvalue"]), batch["next", "observation"], next_a)
+        next_v = jnp.min(next_q, axis=0)
+        reward = batch["next", "reward"]
+        not_term = 1.0 - batch["next", "terminated"].astype(jnp.float32)
+        target = jax.lax.stop_gradient(reward + bootstrap_discount(batch, self.gamma) * not_term * next_v)
+
+        qs = self._q(params["qvalue"], batch["observation"], batch["action"])
+        td_error = qs - target[None]
+        loss_qvalue = jnp.mean(jnp.sum(td_error**2, axis=0))
+
+        a_pi = self.actor(params["actor"], batch)["action"]
+        # reference uses the first critic for the actor objective
+        q_pi = self._q(hold_out(params["qvalue"]), batch["observation"], a_pi)[0]
+        loss_actor = -jnp.mean(q_pi)
+
+        total = loss_qvalue + loss_actor
+        return total, ArrayDict(
+            loss_qvalue=loss_qvalue,
+            loss_actor=loss_actor,
+            td_error=jax.lax.stop_gradient(jnp.abs(td_error).mean(axis=0)),
+        )

@@ -1,0 +1,170 @@
+"""DQN family losses.
+
+Functional redesign of the reference's DQN losses (reference:
+torchrl/objectives/dqn.py — ``DQNLoss``:34, ``DistributionalDQNLoss``:389).
+
+Batch layout: flat transitions ``{observation…, action, "next": {…, reward,
+done, terminated}}`` (what a replay buffer of collector output holds).
+Writes "td_error" into the metrics for PER priority updates.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..data import ArrayDict
+from .common import bootstrap_discount, LossModule, hold_out, masked_mean
+
+__all__ = ["DQNLoss", "DistributionalDQNLoss"]
+
+
+def _gather_action_values(q: jax.Array, action: jax.Array) -> jax.Array:
+    if action.ndim == q.ndim:  # one-hot encoded
+        return jnp.sum(q * action, axis=-1)
+    return jnp.take_along_axis(q, action[..., None].astype(jnp.int32), axis=-1)[..., 0]
+
+
+class DQNLoss(LossModule):
+    """TD(0) Q-learning with target network and optional double-DQN
+    (reference dqn.py:34).
+
+    ``qnet`` is a TDModule (or QValueActor's net) writing "action_value".
+    """
+
+    target_keys = ("target_qvalue",)
+
+    def __init__(
+        self,
+        qnet,
+        gamma: float = 0.99,
+        double_dqn: bool = True,
+        loss_function: str = "l2",
+    ):
+        self.qnet = qnet
+        self.gamma = gamma
+        self.double_dqn = double_dqn
+        self.loss_function = loss_function
+
+    def init_params(self, key: jax.Array, td: ArrayDict) -> dict:
+        params = self.qnet.init(key, td)
+        return {"qvalue": params, "target_qvalue": jax.tree.map(jnp.copy, params)}
+
+    def _q(self, params, td: ArrayDict) -> jax.Array:
+        return self.qnet(params, td)["action_value"]
+
+    def __call__(self, params, batch: ArrayDict, key=None):
+        q = self._q(params["qvalue"], batch)
+        chosen = _gather_action_values(q, batch["action"])
+
+        next_q_target = self._q(hold_out(params["target_qvalue"]), batch["next"])
+        if self.double_dqn:
+            next_q_online = self._q(hold_out(params["qvalue"]), batch["next"])
+            next_a = jnp.argmax(next_q_online, axis=-1)
+        else:
+            next_a = jnp.argmax(next_q_target, axis=-1)
+        next_v = jnp.take_along_axis(next_q_target, next_a[..., None], axis=-1)[..., 0]
+
+        reward = batch["next", "reward"]
+        not_term = 1.0 - batch["next", "terminated"].astype(jnp.float32)
+        target = jax.lax.stop_gradient(reward + bootstrap_discount(batch, self.gamma) * not_term * next_v)
+
+        td_error = chosen - target
+        if self.loss_function == "smooth_l1":
+            loss = jnp.where(
+                jnp.abs(td_error) < 1.0, 0.5 * td_error**2, jnp.abs(td_error) - 0.5
+            )
+        else:
+            loss = td_error**2
+        weight = batch["_weight"] if "_weight" in batch else None
+        total = masked_mean(loss * (weight if weight is not None else 1.0), None)
+        metrics = ArrayDict(
+            loss_qvalue=total,
+            td_error=jax.lax.stop_gradient(jnp.abs(td_error)),
+            q_mean=jax.lax.stop_gradient(chosen.mean()),
+        )
+        return total, metrics
+
+
+class DistributionalDQNLoss(LossModule):
+    """C51 categorical DQN (reference dqn.py:389): the qnet outputs logits
+    over ``n_atoms`` support points per action; the target distribution is
+    projected onto the support (Bellemare et al. 2017)."""
+
+    target_keys = ("target_qvalue",)
+
+    def __init__(
+        self,
+        qnet,
+        support: jax.Array,
+        gamma: float = 0.99,
+        double_dqn: bool = False,
+    ):
+        self.qnet = qnet  # writes "action_value_logits" [..., n_actions, n_atoms]
+        self.support = support
+        self.gamma = gamma
+        self.double_dqn = double_dqn
+
+    def init_params(self, key, td):
+        params = self.qnet.init(key, td)
+        return {"qvalue": params, "target_qvalue": jax.tree.map(jnp.copy, params)}
+
+    def _logits(self, params, td):
+        return self.qnet(params, td)["action_value_logits"]
+
+    def __call__(self, params, batch: ArrayDict, key=None):
+        z = self.support  # [n_atoms]
+        n_atoms = z.shape[0]
+        dz = z[1] - z[0]
+
+        logits = self._logits(params["qvalue"], batch)
+        action = batch["action"]
+        if action.ndim == logits.ndim - 1:  # one-hot
+            action = jnp.argmax(action, axis=-1)
+        chosen_logits = jnp.take_along_axis(
+            logits, action[..., None, None].astype(jnp.int32).repeat(n_atoms, -1), axis=-2
+        )[..., 0, :]
+        log_p = jax.nn.log_softmax(chosen_logits, axis=-1)
+
+        t_logits = self._logits(hold_out(params["target_qvalue"]), batch["next"])
+        t_probs = jax.nn.softmax(t_logits, axis=-1)
+        t_q = jnp.sum(t_probs * z, axis=-1)  # [..., n_actions]
+        if self.double_dqn:
+            o_logits = self._logits(hold_out(params["qvalue"]), batch["next"])
+            o_q = jnp.sum(jax.nn.softmax(o_logits, -1) * z, -1)
+            next_a = jnp.argmax(o_q, axis=-1)
+        else:
+            next_a = jnp.argmax(t_q, axis=-1)
+        next_p = jnp.take_along_axis(
+            t_probs, next_a[..., None, None].repeat(n_atoms, -1), axis=-2
+        )[..., 0, :]
+
+        reward = batch["next", "reward"][..., None]
+        not_term = (1.0 - batch["next", "terminated"].astype(jnp.float32))[..., None]
+        disc = bootstrap_discount(batch, self.gamma)
+        disc = disc[..., None] if jnp.ndim(disc) else disc
+        tz = jnp.clip(reward + disc * not_term * z, z[0], z[-1])
+        # project tz-weighted next_p onto the fixed support
+        b = (tz - z[0]) / dz
+        lo = jnp.clip(jnp.floor(b), 0, n_atoms - 1)
+        hi = jnp.clip(jnp.ceil(b), 0, n_atoms - 1)
+        # distribute mass (handle lo==hi)
+        w_hi = b - lo
+        w_lo = 1.0 - w_hi
+        m = jnp.zeros_like(next_p)
+
+        def scatter(m, idx, w):
+            return jax.vmap(lambda mm, ii, ww: mm.at[ii.astype(jnp.int32)].add(ww))(
+                m.reshape(-1, n_atoms), idx.reshape(-1, n_atoms), w.reshape(-1, n_atoms)
+            ).reshape(m.shape)
+
+        m = scatter(m, lo, next_p * w_lo)
+        m = scatter(m, hi, next_p * w_hi)
+        m = jax.lax.stop_gradient(m)
+
+        loss = -jnp.sum(m * log_p, axis=-1)
+        weight = batch["_weight"] if "_weight" in batch else 1.0
+        total = jnp.mean(loss * weight)
+        return total, ArrayDict(
+            loss_qvalue=total, td_error=jax.lax.stop_gradient(loss)
+        )

@@ -1,0 +1,100 @@
+"""IQL — implicit Q-learning (offline RL).
+
+Functional redesign (reference: torchrl/objectives/iql.py:30 ``IQLLoss``,
+:572 ``DiscreteIQLLoss``): expectile value regression, TD Q-learning against
+V(s'), advantage-weighted actor regression. No actions from the policy ever
+query the critic (offline-safe).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..data import ArrayDict
+from ..modules.networks import apply_ensemble, init_ensemble
+from .common import bootstrap_discount, LossModule, hold_out
+
+__all__ = ["IQLLoss"]
+
+
+class IQLLoss(LossModule):
+    target_keys = ("target_qvalue",)
+
+    def __init__(
+        self,
+        actor,
+        qvalue_module,
+        value_module,
+        num_qvalue_nets: int = 2,
+        gamma: float = 0.99,
+        expectile: float = 0.7,
+        temperature: float = 3.0,
+        max_adv_weight: float = 100.0,
+    ):
+        self.actor = actor
+        self.qvalue_module = qvalue_module  # (obs, action) -> [.., 1]
+        self.value_module = value_module  # obs -> [.., 1]
+        self.num_qvalue_nets = num_qvalue_nets
+        self.gamma = gamma
+        self.expectile = expectile
+        self.temperature = temperature
+        self.max_adv_weight = max_adv_weight
+
+    def init_params(self, key, td):
+        ka, kq, kv = jax.random.split(key, 3)
+        actor_params = self.actor.init(ka, td)
+        dist, _ = self.actor.get_dist(actor_params, td)
+        action = dist.mode
+        qvalue = init_ensemble(
+            self.qvalue_module, kq, self.num_qvalue_nets, td["observation"], action
+        )
+        value = self.value_module.init(kv, td["observation"])["params"]
+        return {
+            "actor": actor_params,
+            "qvalue": qvalue,
+            "value": value,
+            "target_qvalue": jax.tree.map(jnp.copy, qvalue),
+        }
+
+    def _q(self, qparams, obs, action):
+        return apply_ensemble(self.qvalue_module, qparams, obs, action)[..., 0]
+
+    def _v(self, vparams, obs):
+        return self.value_module.apply({"params": vparams}, obs)[..., 0]
+
+    def __call__(self, params, batch: ArrayDict, key=None):
+        obs = batch["observation"]
+        action = batch["action"]
+
+        # -- value loss: expectile regression of min target-Q --------------------
+        q_t = jnp.min(self._q(hold_out(params["target_qvalue"]), obs, action), axis=0)
+        v = self._v(params["value"], obs)
+        diff = jax.lax.stop_gradient(q_t) - v
+        w = jnp.where(diff > 0, self.expectile, 1.0 - self.expectile)
+        loss_value = jnp.mean(w * diff**2)
+
+        # -- q loss: TD against V(s') -------------------------------------------
+        next_v = self._v(hold_out(params["value"]), batch["next", "observation"])
+        reward = batch["next", "reward"]
+        not_term = 1.0 - batch["next", "terminated"].astype(jnp.float32)
+        target = jax.lax.stop_gradient(reward + bootstrap_discount(batch, self.gamma) * not_term * next_v)
+        qs = self._q(params["qvalue"], obs, action)
+        td_error = qs - target[None]
+        loss_qvalue = jnp.mean(jnp.sum(td_error**2, axis=0))
+
+        # -- actor loss: advantage-weighted regression ---------------------------
+        adv = jax.lax.stop_gradient(q_t - v)
+        weight = jnp.minimum(jnp.exp(self.temperature * adv), self.max_adv_weight)
+        dist, _ = self.actor.get_dist(params["actor"], batch)
+        log_prob = dist.log_prob(action)
+        loss_actor = -jnp.mean(jax.lax.stop_gradient(weight) * log_prob)
+
+        total = loss_value + loss_qvalue + loss_actor
+        return total, ArrayDict(
+            loss_value=loss_value,
+            loss_qvalue=loss_qvalue,
+            loss_actor=loss_actor,
+            td_error=jax.lax.stop_gradient(jnp.abs(td_error).mean(axis=0)),
+            advantage_mean=adv.mean(),
+        )

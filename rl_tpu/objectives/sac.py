@@ -1,0 +1,216 @@
+"""SAC losses (continuous + discrete).
+
+Functional redesign of the reference's SAC (reference:
+torchrl/objectives/sac.py — ``SACLoss``:60 (v2, no value net),
+``DiscreteSACLoss``:985). Critic ensembles are vmapped stacked params
+(see rl_tpu.modules.init_ensemble) instead of the reference's
+``convert_to_functional(expand_dim=N)``.
+
+params = {"actor", "qvalue" (stacked n), "target_qvalue", "log_alpha"};
+target_keys = ("target_qvalue",). Entropy coefficient α is learned against
+``target_entropy`` (default -dim(A), reference convention "auto").
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..data import ArrayDict
+from ..modules.networks import apply_ensemble, init_ensemble
+from .common import bootstrap_discount, LossModule, hold_out
+
+__all__ = ["SACLoss", "DiscreteSACLoss"]
+
+
+class SACLoss(LossModule):
+    """Soft actor-critic, v2 form (reference sac.py:60)."""
+
+    target_keys = ("target_qvalue",)
+
+    def __init__(
+        self,
+        actor,
+        qvalue_module,
+        num_qvalue_nets: int = 2,
+        gamma: float = 0.99,
+        target_entropy: float | str = "auto",
+        alpha_init: float = 1.0,
+        fixed_alpha: bool = False,
+        action_dim: int | None = None,
+    ):
+        self.actor = actor
+        self.qvalue_module = qvalue_module  # flax module: (obs, action) -> [.., 1]
+        self.num_qvalue_nets = num_qvalue_nets
+        self.gamma = gamma
+        self.alpha_init = alpha_init
+        self.fixed_alpha = fixed_alpha
+        self._target_entropy = target_entropy
+        self._action_dim = action_dim
+
+    def target_entropy(self, action_dim: int) -> float:
+        if self._target_entropy == "auto":
+            return -float(action_dim)
+        return float(self._target_entropy)
+
+    def init_params(self, key: jax.Array, td: ArrayDict) -> dict:
+        ka, kq = jax.random.split(key)
+        actor_params = self.actor.init(ka, td)
+        # an example action to shape the critics
+        dist, out = self.actor.get_dist(actor_params, td)
+        action = dist.mode
+        qvalue = init_ensemble(
+            self.qvalue_module, kq, self.num_qvalue_nets, td["observation"], action
+        )
+        if self._action_dim is None:
+            self._action_dim = action.shape[-1]
+        return {
+            "actor": actor_params,
+            "qvalue": qvalue,
+            "target_qvalue": jax.tree.map(jnp.copy, qvalue),
+            "log_alpha": jnp.asarray(jnp.log(self.alpha_init), jnp.float32),
+        }
+
+    def _q(self, qparams, obs, action) -> jax.Array:
+        q = apply_ensemble(self.qvalue_module, qparams, obs, action)
+        return q[..., 0]  # [n, batch]
+
+    def __call__(self, params, batch: ArrayDict, key=None):
+        if key is None:
+            raise ValueError("SACLoss requires a PRNG key (reparameterized sampling)")
+        k_next, k_pi = jax.random.split(key)
+        alpha = jnp.exp(
+            jax.lax.stop_gradient(params["log_alpha"])
+            if not self.fixed_alpha
+            else jnp.asarray(jnp.log(self.alpha_init))
+        )
+
+        # -- critic loss -------------------------------------------------------
+        next_dist, _ = self.actor.get_dist(hold_out(params["actor"]), batch["next"])
+        next_a = next_dist.sample(k_next)
+        next_lp = next_dist.log_prob(next_a)
+        next_q = self._q(hold_out(params["target_qvalue"]), batch["next", "observation"], next_a)
+        next_v = jnp.min(next_q, axis=0) - alpha * next_lp
+        reward = batch["next", "reward"]
+        not_term = 1.0 - batch["next", "terminated"].astype(jnp.float32)
+        target = jax.lax.stop_gradient(reward + bootstrap_discount(batch, self.gamma) * not_term * next_v)
+
+        qs = self._q(params["qvalue"], batch["observation"], batch["action"])
+        td_error = qs - target[None]
+        weight = batch["_weight"] if "_weight" in batch else 1.0
+        loss_qvalue = 0.5 * jnp.mean(jnp.sum(td_error**2, axis=0) * weight)
+
+        # -- actor loss --------------------------------------------------------
+        dist, _ = self.actor.get_dist(params["actor"], batch)
+        a_pi = dist.rsample(k_pi)
+        lp_pi = dist.log_prob(a_pi)
+        q_pi = self._q(hold_out(params["qvalue"]), batch["observation"], a_pi)
+        loss_actor = jnp.mean(alpha * lp_pi - jnp.min(q_pi, axis=0))
+
+        # -- alpha loss --------------------------------------------------------
+        t_ent = self.target_entropy(self._action_dim or a_pi.shape[-1])
+        if self.fixed_alpha:
+            loss_alpha = jnp.asarray(0.0)
+        else:
+            loss_alpha = -params["log_alpha"] * jnp.mean(
+                jax.lax.stop_gradient(lp_pi + t_ent)
+            )
+
+        total = loss_qvalue + loss_actor + loss_alpha
+        metrics = ArrayDict(
+            loss_qvalue=loss_qvalue,
+            loss_actor=loss_actor,
+            loss_alpha=loss_alpha,
+            alpha=alpha,
+            entropy=jax.lax.stop_gradient(-lp_pi.mean()),
+            td_error=jax.lax.stop_gradient(jnp.abs(td_error).mean(axis=0)),
+        )
+        return total, metrics
+
+
+class DiscreteSACLoss(LossModule):
+    """Discrete-action SAC (reference sac.py:985): expectation over the full
+    categorical instead of sampling; qnet maps obs -> per-action values."""
+
+    target_keys = ("target_qvalue",)
+
+    def __init__(
+        self,
+        actor,
+        qvalue_module,
+        num_actions: int,
+        num_qvalue_nets: int = 2,
+        gamma: float = 0.99,
+        target_entropy_weight: float = 0.98,
+        alpha_init: float = 1.0,
+    ):
+        self.actor = actor  # ProbabilisticActor with Categorical dist
+        self.qvalue_module = qvalue_module  # flax: obs -> [.., num_actions]
+        self.num_actions = num_actions
+        self.num_qvalue_nets = num_qvalue_nets
+        self.gamma = gamma
+        # reference: target entropy = weight * log(num_actions)
+        self.target_entropy = target_entropy_weight * float(jnp.log(num_actions))
+        self.alpha_init = alpha_init
+
+    def init_params(self, key, td):
+        ka, kq = jax.random.split(key)
+        actor_params = self.actor.init(ka, td)
+        qvalue = init_ensemble(
+            self.qvalue_module, kq, self.num_qvalue_nets, td["observation"]
+        )
+        return {
+            "actor": actor_params,
+            "qvalue": qvalue,
+            "target_qvalue": jax.tree.map(jnp.copy, qvalue),
+            "log_alpha": jnp.asarray(jnp.log(self.alpha_init), jnp.float32),
+        }
+
+    def _q(self, qparams, obs):
+        return apply_ensemble(self.qvalue_module, qparams, obs)  # [n, B, A]
+
+    def __call__(self, params, batch: ArrayDict, key=None):
+        alpha = jnp.exp(jax.lax.stop_gradient(params["log_alpha"]))
+
+        next_dist, _ = self.actor.get_dist(hold_out(params["actor"]), batch["next"])
+        next_probs = next_dist.probs
+        next_logp = jnp.log(jnp.clip(next_probs, 1e-8))
+        next_q = self._q(hold_out(params["target_qvalue"]), batch["next", "observation"])
+        next_v = jnp.sum(next_probs[None] * (next_q - alpha * next_logp[None]), axis=-1)
+        next_v = jnp.min(next_v, axis=0)
+        reward = batch["next", "reward"]
+        not_term = 1.0 - batch["next", "terminated"].astype(jnp.float32)
+        target = jax.lax.stop_gradient(reward + bootstrap_discount(batch, self.gamma) * not_term * next_v)
+
+        qs = self._q(params["qvalue"], batch["observation"])
+        action = batch["action"]
+        if action.ndim == qs.ndim - 1:  # one-hot [B, A]
+            chosen = jnp.sum(qs * action[None], axis=-1)
+        else:
+            chosen = jnp.take_along_axis(
+                qs, action[None, ..., None].astype(jnp.int32).repeat(1, -1), axis=-1
+            )[..., 0]
+        td_error = chosen - target[None]
+        weight = batch["_weight"] if "_weight" in batch else 1.0
+        loss_qvalue = 0.5 * jnp.mean(jnp.sum(td_error**2, axis=0) * weight)
+
+        dist, _ = self.actor.get_dist(params["actor"], batch)
+        probs = dist.probs
+        logp = jnp.log(jnp.clip(probs, 1e-8))
+        q_pi = jnp.min(self._q(hold_out(params["qvalue"]), batch["observation"]), axis=0)
+        loss_actor = jnp.mean(jnp.sum(probs * (alpha * logp - q_pi), axis=-1))
+
+        entropy = -jnp.sum(probs * logp, axis=-1)
+        loss_alpha = -params["log_alpha"] * jnp.mean(
+            jax.lax.stop_gradient(self.target_entropy - entropy)
+        )
+
+        total = loss_qvalue + loss_actor + loss_alpha
+        return total, ArrayDict(
+            loss_qvalue=loss_qvalue,
+            loss_actor=loss_actor,
+            loss_alpha=loss_alpha,
+            alpha=alpha,
+            entropy=jax.lax.stop_gradient(entropy.mean()),
+            td_error=jax.lax.stop_gradient(jnp.abs(td_error).mean(axis=0)),
+        )

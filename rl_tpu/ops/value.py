@@ -27,6 +27,7 @@ from jax import lax
 
 __all__ = [
     "linear_recurrence_reverse",
+    "linear_recurrence_forward",
     "generalized_advantage_estimate",
     "td0_return_estimate",
     "td0_advantage_estimate",
@@ -57,6 +58,18 @@ def linear_recurrence_reverse(a: jax.Array, b: jax.Array) -> jax.Array:
 
     ya, yb = lax.associative_scan(combine, (a, b), axis=0, reverse=True)
     del ya
+    return yb
+
+
+def linear_recurrence_forward(a: jax.Array, b: jax.Array) -> jax.Array:
+    """Solve ``y_t = b_t + a_t * y_{t-1}`` (with ``y_{-1} = 0``) along axis 0."""
+
+    def combine(f, g):
+        fa, fb = f
+        ga, gb = g
+        return fa * ga, ga * fb + gb
+
+    _, yb = lax.associative_scan(combine, (a, b), axis=0)
     return yb
 
 

@@ -1,3 +1,9 @@
+from .off_policy import OffPolicyConfig, OffPolicyProgram
 from .on_policy import OnPolicyConfig, OnPolicyProgram
 
-__all__ = ["OnPolicyConfig", "OnPolicyProgram"]
+__all__ = [
+    "OnPolicyConfig",
+    "OnPolicyProgram",
+    "OffPolicyConfig",
+    "OffPolicyProgram",
+]

@@ -1,0 +1,271 @@
+"""Replay-stack tests (strategy mirrors reference test/rb/: per-storage,
+per-sampler, per-writer behavior + buffer composition, PER statistics,
+slice validity, jit-in-train-step usage)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from rl_tpu.data import (
+    ArrayDict,
+    DeviceStorage,
+    ListStorage,
+    MaxValueWriter,
+    MemmapStorage,
+    MultiStep,
+    PrioritizedSampler,
+    RandomSampler,
+    ReplayBuffer,
+    SamplerWithoutReplacement,
+    SliceSampler,
+)
+
+KEY = jax.random.key(0)
+
+
+def item(v: float):
+    return ArrayDict(obs=jnp.full((3,), v), reward=jnp.asarray(v))
+
+
+def items(n, start=0.0):
+    return ArrayDict(
+        obs=jnp.arange(start, start + n)[:, None] * jnp.ones((1, 3)),
+        reward=jnp.arange(start, start + n, dtype=jnp.float32),
+    )
+
+
+class TestDeviceStorage:
+    def test_roundtrip(self):
+        st = DeviceStorage(8)
+        state = st.init(item(0.0))
+        state = st.set(state, jnp.array([0, 1]), items(2, 5.0))
+        got = st.get(state, jnp.array([1, 0]))
+        np.testing.assert_allclose(np.asarray(got["reward"]), [6.0, 5.0])
+
+    def test_jit_write_read(self):
+        st = DeviceStorage(16)
+
+        @jax.jit
+        def write(state, xs):
+            return st.set(state, jnp.arange(4), xs)
+
+        state = write(st.init(item(0.0)), items(4))
+        np.testing.assert_allclose(np.asarray(st.get(state, jnp.array([3]))["reward"]), [3.0])
+
+
+class TestBufferBasics:
+    def test_add_extend_sample(self):
+        rb = ReplayBuffer(DeviceStorage(64), batch_size=8)
+        state = rb.init(item(0.0))
+        state = rb.add(state, item(1.0))
+        state = rb.extend(state, items(10))
+        assert int(rb.size(state)) == 11
+        batch, state = rb.sample(state, KEY)
+        assert batch["obs"].shape == (8, 3)
+        assert "index" in batch
+
+    def test_ring_overwrite(self):
+        rb = ReplayBuffer(DeviceStorage(4), batch_size=4)
+        state = rb.init(item(0.0))
+        state = rb.extend(state, items(6))  # values 0..5, capacity 4
+        assert int(rb.size(state)) == 4
+        stored = np.sort(np.asarray(state["storage", "data", "reward"]))
+        np.testing.assert_allclose(stored, [2, 3, 4, 5])
+
+    def test_sample_only_filled(self):
+        rb = ReplayBuffer(DeviceStorage(100), batch_size=64)
+        state = rb.init(item(0.0))
+        state = rb.extend(state, items(3, 7.0))
+        batch, _ = rb.sample(state, KEY)
+        vals = set(np.asarray(batch["reward"]).tolist())
+        assert vals <= {7.0, 8.0, 9.0}
+
+    def test_transform_applied(self):
+        rb = ReplayBuffer(
+            DeviceStorage(16),
+            transform=lambda b: b.set("reward", b["reward"] * 2),
+            batch_size=4,
+        )
+        state = rb.init(item(0.0))
+        state = rb.extend(state, items(4, 1.0))
+        batch, _ = rb.sample(state, KEY)
+        assert float(np.asarray(batch["reward"]).min()) >= 2.0
+
+    def test_fused_write_sample_jit(self):
+        rb = ReplayBuffer(DeviceStorage(32), batch_size=8)
+        state = rb.init(item(0.0))
+
+        @jax.jit
+        def step(state, xs, key):
+            state = rb.extend(state, xs, n=4)
+            return rb.sample(state, key)
+
+        batch, state = step(state, items(4), KEY)
+        assert batch["obs"].shape == (8, 3)
+
+
+class TestWithoutReplacement:
+    def test_epoch_covers_all(self):
+        rb = ReplayBuffer(DeviceStorage(16), SamplerWithoutReplacement(), batch_size=5)
+        state = rb.init(item(0.0))
+        state = rb.extend(state, items(15))
+        seen = []
+        key = KEY
+        for _ in range(3):  # 3 batches of 5 = one epoch over 15
+            key, k = jax.random.split(key)
+            batch, state = rb.sample(state, k)
+            seen.extend(np.asarray(batch["reward"]).tolist())
+        assert sorted(seen) == list(range(15)), f"epoch did not cover data: {sorted(seen)}"
+
+
+class TestPER:
+    def test_high_priority_sampled_more(self):
+        rb = ReplayBuffer(
+            DeviceStorage(32), PrioritizedSampler(alpha=1.0, beta=1.0), batch_size=256
+        )
+        state = rb.init(item(0.0))
+        state = rb.extend(state, items(10))
+        # priority 10 on index 3, 0.1 elsewhere
+        prio = jnp.full((10,), 0.1).at[3].set(10.0)
+        state = rb.update_priority(state, jnp.arange(10), prio)
+        batch, state = rb.sample(state, KEY)
+        frac3 = float((np.asarray(batch["index"]) == 3).mean())
+        # expected ~10/(10+0.9)=0.917
+        assert frac3 > 0.7, frac3
+
+    def test_weights_correct_shape_and_range(self):
+        rb = ReplayBuffer(DeviceStorage(32), PrioritizedSampler(), batch_size=16)
+        state = rb.init(item(0.0))
+        state = rb.extend(state, items(8))
+        batch, state = rb.sample(state, KEY)
+        w = np.asarray(batch["_weight"])
+        assert w.shape == (16,)
+        assert (w > 0).all() and (w <= 1.0 + 1e-5).all()
+
+    def test_new_items_get_max_priority(self):
+        sampler = PrioritizedSampler(alpha=1.0, beta=0.4)
+        rb = ReplayBuffer(DeviceStorage(16), sampler, batch_size=8)
+        state = rb.init(item(0.0))
+        state = rb.extend(state, items(4))
+        state = rb.update_priority(state, jnp.arange(4), jnp.full((4,), 5.0))
+        state = rb.extend(state, items(1, 99.0))  # should get max_priority >= 5
+        p = np.asarray(state["sampler", "priorities"])
+        assert p[4] >= 5.0
+
+    def test_per_inside_jit_train_loop(self):
+        rb = ReplayBuffer(DeviceStorage(64), PrioritizedSampler(), batch_size=8)
+        state = rb.init(item(0.0))
+
+        @jax.jit
+        def loop(state, key):
+            state = rb.extend(state, items(16), n=16)
+            batch, state = rb.sample(state, key)
+            # td-error-like priority update
+            state = rb.update_priority(state, batch["index"], batch["reward"] + 1.0)
+            return state
+
+        state = loop(state, KEY)
+        assert int(rb.size(state)) == 16
+
+
+class TestSliceSampler:
+    def test_slices_within_trajectories(self):
+        rb = ReplayBuffer(
+            DeviceStorage(64), SliceSampler(slice_len=4), batch_size=16
+        )
+        example = ArrayDict(
+            obs=jnp.zeros(3),
+            collector=ArrayDict(traj_ids=jnp.asarray(0, jnp.int32)),
+        )
+        state = rb.init(example)
+        # two trajectories: ids 0 (steps 0-9) and 1 (steps 10-19)
+        data = ArrayDict(
+            obs=jnp.arange(20)[:, None] * jnp.ones((1, 3)),
+            collector=ArrayDict(
+                traj_ids=jnp.concatenate([jnp.zeros(10, jnp.int32), jnp.ones(10, jnp.int32)])
+            ),
+        )
+        state = rb.extend(state, data)
+        batch, _ = rb.sample(state, KEY)
+        ids = np.asarray(batch["collector", "traj_ids"]).reshape(4, 4)
+        for row in ids:
+            assert len(set(row.tolist())) == 1, f"slice crosses trajectories: {row}"
+        obs = np.asarray(batch["obs"])[:, 0].reshape(4, 4)
+        for row in obs:
+            np.testing.assert_allclose(np.diff(row), 1.0)
+
+
+class TestMaxValueWriter:
+    def test_topk_retention(self):
+        rb = ReplayBuffer(
+            DeviceStorage(4), RandomSampler(), MaxValueWriter(rank_key="reward"),
+            batch_size=4,
+        )
+        state = rb.init(item(0.0))
+        vals = [5.0, 1.0, 7.0, 3.0, 6.0, 0.5, 9.0]
+        for v in vals:
+            state = rb.add(state, item(v))
+        stored = np.sort(np.asarray(state["storage", "data", "reward"]))
+        np.testing.assert_allclose(stored, [3.0, 5.0, 6.0, 7.0, 9.0][-4:])
+
+
+class TestMemmapAndList:
+    def test_memmap_roundtrip(self, tmp_path):
+        st = MemmapStorage(8, scratch_dir=str(tmp_path))
+        state = st.init(item(0.0))
+        state = st.set(state, np.array([0, 1]), items(2, 3.0))
+        got = st.get(state, np.array([1]))
+        np.testing.assert_allclose(np.asarray(got["reward"]), [4.0])
+
+    def test_memmap_buffer(self, tmp_path):
+        rb = ReplayBuffer(MemmapStorage(16, scratch_dir=str(tmp_path)), batch_size=4)
+        state = rb.init(item(0.0))
+        state = rb.extend(state, items(8))
+        batch, state = rb.sample(state, KEY)
+        assert batch["obs"].shape == (4, 3)
+
+    def test_list_storage(self):
+        st = ListStorage(4)
+        state = st.init()
+        state = st.set(state, np.array([0, 1]), ["hello", "world"])
+        assert st.get(state, np.array([1, 0])) == ["world", "hello"]
+
+
+class TestMultiStep:
+    def test_three_step_fold(self):
+        T = 6
+        batch = ArrayDict(
+            obs=jnp.arange(T, dtype=jnp.float32),
+            next=ArrayDict(
+                obs=jnp.arange(1, T + 1, dtype=jnp.float32),
+                reward=jnp.ones(T),
+                done=jnp.zeros(T, bool),
+                terminated=jnp.zeros(T, bool),
+            ),
+        )
+        out = MultiStep(gamma=0.5, n_steps=3)(batch)
+        r = np.asarray(out["next", "reward"])
+        # full window: 1 + 0.5 + 0.25 = 1.75; tail shrinks
+        np.testing.assert_allclose(r[:3], 1.75)
+        np.testing.assert_allclose(r[-1], 1.0)
+        np.testing.assert_allclose(np.asarray(out["next", "obs"])[0], 3.0)
+        np.testing.assert_allclose(np.asarray(out["steps_to_next_obs"])[:3], 3)
+
+    def test_stops_at_done(self):
+        T = 5
+        done = jnp.asarray([False, True, False, False, False])
+        batch = ArrayDict(
+            next=ArrayDict(
+                obs=jnp.arange(1, T + 1, dtype=jnp.float32),
+                reward=jnp.ones(T),
+                done=done,
+                terminated=done,
+            )
+        )
+        out = MultiStep(gamma=1.0, n_steps=3)(batch)
+        r = np.asarray(out["next", "reward"])
+        np.testing.assert_allclose(r, [2.0, 1.0, 3.0, 2.0, 1.0])
+        # t=0 folds only through the done at t=1 -> next obs from t=1
+        np.testing.assert_allclose(np.asarray(out["next", "obs"])[0], 2.0)
+        np.testing.assert_allclose(np.asarray(out["next", "original_reward"]), 1.0)

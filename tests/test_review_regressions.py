@@ -153,3 +153,116 @@ class TestMaskedESS:
         np.testing.assert_allclose(float(_masked_ess(lw, mask)), 1.0, rtol=1e-5)
         # unmasked, the spike crushes ESS to ~1/20
         assert float(_masked_ess(lw, None)) < 0.1
+
+
+class TestOffPolicyReviewFixes:
+    def test_unbatched_env_buffer_layout(self):
+        from rl_tpu.data import DeviceStorage, ReplayBuffer
+        from rl_tpu.modules import MLP, TDModule
+        from rl_tpu.objectives import DQNLoss
+        from rl_tpu.trainers import OffPolicyConfig, OffPolicyProgram
+
+        env = CountingEnv(max_count=5)  # batch_shape == ()
+        qnet = TDModule(MLP(out_features=2), ["observation"], ["action_value"])
+        loss = DQNLoss(qnet)
+        coll = Collector(env, lambda p, td, k: td.set("action", jnp.argmax(qnet(p["qvalue"], td)["action_value"], -1)), frames_per_batch=16)
+        program = OffPolicyProgram(coll, loss, ReplayBuffer(DeviceStorage(128)), OffPolicyConfig(batch_size=8))
+        ts = program.init(KEY)
+        assert ts["buffer"]["storage", "data", "observation"].shape == (128, 1)
+        ts, m = jax.jit(program.train_step)(ts)
+        assert np.isfinite(float(m["loss"]))
+
+    def test_env_major_flatten_keeps_trajectories_contiguous(self):
+        from rl_tpu.data import DeviceStorage, ReplayBuffer, SliceSampler
+        from rl_tpu.modules import MLP, TDModule
+        from rl_tpu.objectives import DQNLoss
+        from rl_tpu.trainers import OffPolicyConfig, OffPolicyProgram
+
+        env = VmapEnv(CountingEnv(max_count=100), 4)
+        qnet = TDModule(MLP(out_features=2), ["observation"], ["action_value"])
+        loss = DQNLoss(qnet)
+        coll = Collector(env, lambda p, td, k: td.set("action", jnp.zeros((4,), jnp.int32)), frames_per_batch=32)
+        buffer = ReplayBuffer(DeviceStorage(256), SliceSampler(slice_len=4))
+        program = OffPolicyProgram(coll, loss, buffer, OffPolicyConfig(batch_size=16))
+        ts = program.init(KEY)
+        batch, cstate = program.collector.collect(ts["params"], ts["collector"])
+        flat = program._flatten(batch)
+        tids = np.asarray(flat["collector", "traj_ids"])
+        # env-major: each env's 8 steps contiguous -> long constant runs
+        assert (tids[:8] == tids[0]).all()
+        bstate = program.buffer.extend(ts["buffer"], flat, n=32)
+        mb, _ = program.buffer.sample(bstate, KEY, 16)
+        assert bool(np.asarray(mb["valid_slices"]).all()), "no valid slices found"
+
+    def test_policy_delay_masks_actor_updates(self):
+        from rl_tpu.data import DeviceStorage, ReplayBuffer
+        from rl_tpu.modules import ConcatMLP, TanhPolicy, TDModule
+        from rl_tpu.objectives import TD3Loss
+        from rl_tpu.testing import ContinuousActionMock
+        from rl_tpu.trainers import OffPolicyConfig, OffPolicyProgram
+
+        env = VmapEnv(ContinuousActionMock(obs_dim=4, act_dim=2), 2)
+        actor = TDModule(TanhPolicy(action_dim=2), ["observation"], ["action"])
+        loss = TD3Loss(actor, ConcatMLP(out_features=1, num_cells=(16, 16)), action_low=-1.0, action_high=1.0)
+        coll = Collector(env, lambda p, td, k: actor(p["actor"], td, k), frames_per_batch=8)
+        # utd=1, delay=2 -> actor params change only every other train step
+        program = OffPolicyProgram(coll, loss, ReplayBuffer(DeviceStorage(64)),
+                                   OffPolicyConfig(batch_size=8, utd_ratio=1, policy_delay=2))
+        ts = program.init(KEY)
+        step = jax.jit(program.train_step)
+        a0 = jax.tree.leaves(ts["params"]["actor"])[1].copy()
+        ts, _ = step(ts)  # update_count 0 -> 0 % 2 == 0 -> actor updates
+        a1 = jax.tree.leaves(ts["params"]["actor"])[1].copy()
+        ts, _ = step(ts)  # update_count 1 -> masked
+        a2 = jax.tree.leaves(ts["params"]["actor"])[1].copy()
+        assert float(jnp.abs(a1 - a0).max()) > 0
+        np.testing.assert_array_equal(np.asarray(a1), np.asarray(a2))
+
+    def test_without_replacement_uses_caller_key(self):
+        from rl_tpu.data import ArrayDict as AD, DeviceStorage, ReplayBuffer, SamplerWithoutReplacement
+
+        rb = ReplayBuffer(DeviceStorage(32), SamplerWithoutReplacement(), batch_size=8)
+        st = rb.init(AD(x=jnp.zeros(())))
+        st = rb.extend(st, AD(x=jnp.arange(32.0)))
+        b1, _ = rb.sample(st, jax.random.key(1))
+        b2, _ = rb.sample(st, jax.random.key(2))
+        assert not np.array_equal(np.asarray(b1["index"]), np.asarray(b2["index"]))
+
+    def test_multistep_nstep_discount_in_dqn(self):
+        from rl_tpu.data import MultiStep
+        from rl_tpu.modules import TDModule
+        from rl_tpu.objectives import DQNLoss
+
+        T = 6
+        batch = ArrayDict(
+            observation=jnp.zeros((T, 1)),
+            action=jnp.zeros((T,), jnp.int32),
+            next=ArrayDict(
+                observation=jnp.zeros((T, 1)),
+                reward=jnp.zeros(T),
+                done=jnp.zeros(T, bool),
+                terminated=jnp.zeros(T, bool),
+            ),
+        )
+        folded = MultiStep(gamma=0.5, n_steps=3)(batch)
+        qnet = TDModule(lambda obs: jnp.full(obs.shape[:-1] + (2,), 1.0), ["observation"], ["action_value"])
+        loss = DQNLoss(qnet, gamma=0.5, double_dqn=False)
+        _, metrics = loss({"qvalue": {}, "target_qvalue": {}}, folded)
+        td = np.asarray(metrics["td_error"])
+        # rewards 0, q=1: target = 0.5^n * 1; full windows n=3 -> |1 - 0.125|
+        np.testing.assert_allclose(td[:3], 1 - 0.125, rtol=1e-5)
+        np.testing.assert_allclose(td[-1], 1 - 0.5, rtol=1e-5)
+
+    def test_densify_reward_uniform(self):
+        from rl_tpu.data import DensifyReward
+
+        batch = ArrayDict(
+            next=ArrayDict(
+                reward=jnp.asarray([0.0, 0.0, 1.0, 0.0, 2.0]),
+                done=jnp.asarray([False, False, True, False, True]),
+            )
+        )
+        out = DensifyReward()(batch)
+        np.testing.assert_allclose(
+            np.asarray(out["next", "reward"]), [1 / 3, 1 / 3, 1 / 3, 1.0, 1.0], rtol=1e-5
+        )
