@@ -1,0 +1,15 @@
+from .checkpoint import (
+    ArrayTreeAdapter,
+    Checkpoint,
+    GlobalRNGState,
+    JSONAdapter,
+    PickleAdapter,
+)
+
+__all__ = [
+    "Checkpoint",
+    "ArrayTreeAdapter",
+    "JSONAdapter",
+    "PickleAdapter",
+    "GlobalRNGState",
+]

@@ -1,0 +1,185 @@
+"""Checkpoint registry with adapters and schema migrations.
+
+Redesign of the reference checkpoint subsystem (reference:
+torchrl/checkpoint/_checkpoint.py — ``Checkpoint``:692 registry with
+``register``:760/``save``:800/``load``:895/``register_migration``:1007;
+adapters ``StateDictCheckpointAdapter``:423, ``JSONCheckpointAdapter``:541;
+``GlobalRNGState``:596), rebuilt on **orbax** for sharding-aware async array
+checkpointing (the TPU story: a restore re-shards arrays onto whatever mesh
+the restoring program uses).
+
+Components register by name with an adapter:
+- :class:`ArrayTreeAdapter` — pytrees of jax arrays (params, opt state,
+  buffer states) via orbax; sharding-aware.
+- :class:`JSONAdapter` — counters/config scalars.
+- :class:`PickleAdapter` — host-side python state (last resort).
+
+``GlobalRNGState`` captures numpy+python RNG (JAX keys are ordinary arrays —
+they live inside the train state and need no special capture, unlike the
+reference's torch/cuda RNG).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import random
+from typing import Any, Callable
+
+import jax
+import numpy as np
+
+__all__ = [
+    "Checkpoint",
+    "ArrayTreeAdapter",
+    "JSONAdapter",
+    "PickleAdapter",
+    "GlobalRNGState",
+]
+
+SCHEMA_VERSION = 1
+
+
+class ArrayTreeAdapter:
+    """Orbax-backed pytree-of-arrays adapter (sharding-aware restore)."""
+
+    def save(self, path: str, obj: Any) -> None:
+        import orbax.checkpoint as ocp
+
+        with ocp.PyTreeCheckpointer() as ckptr:
+            ckptr.save(os.path.abspath(path), obj, force=True)
+
+    def load(self, path: str, template: Any | None = None) -> Any:
+        import orbax.checkpoint as ocp
+
+        with ocp.PyTreeCheckpointer() as ckptr:
+            if template is not None:
+                return ckptr.restore(os.path.abspath(path), item=template)
+            return ckptr.restore(os.path.abspath(path))
+
+
+class JSONAdapter:
+    def save(self, path: str, obj: Any) -> None:
+        os.makedirs(path, exist_ok=True)
+        with open(os.path.join(path, "data.json"), "w") as f:
+            json.dump(obj, f)
+
+    def load(self, path: str, template: Any | None = None) -> Any:
+        with open(os.path.join(path, "data.json")) as f:
+            return json.load(f)
+
+
+class PickleAdapter:
+    def save(self, path: str, obj: Any) -> None:
+        os.makedirs(path, exist_ok=True)
+        with open(os.path.join(path, "data.pkl"), "wb") as f:
+            pickle.dump(obj, f)
+
+    def load(self, path: str, template: Any | None = None) -> Any:
+        with open(os.path.join(path, "data.pkl"), "rb") as f:
+            return pickle.load(f)
+
+
+class GlobalRNGState:
+    """Host RNG capture (reference GlobalRNGState:596, minus torch/cuda)."""
+
+    @staticmethod
+    def get() -> dict:
+        np_state = np.random.get_state()
+        return {
+            "python": list(random.getstate()[1]) + [random.getstate()[0], random.getstate()[2]],
+            "numpy": [np_state[0], np_state[1].tolist(), *np_state[2:]],
+        }
+
+    @staticmethod
+    def set(state: dict) -> None:
+        py = state["python"]
+        random.setstate((py[-2], tuple(py[:-2]), py[-1]))
+        np_s = state["numpy"]
+        np.random.set_state((np_s[0], np.asarray(np_s[1], dtype=np.uint32), *np_s[2:]))
+
+
+class Checkpoint:
+    """Named-component checkpoint registry (reference Checkpoint:692).
+
+    >>> ckpt = Checkpoint("ckpts/run1")
+    >>> ckpt.register("train_state", lambda: ts, lambda v: restore(v))
+    >>> ckpt.save(step=1000)
+    >>> ckpt.load(step=1000)
+    """
+
+    def __init__(self, root: str, capture_rng: bool = True):
+        self.root = root
+        self.capture_rng = capture_rng
+        self._components: dict[str, tuple[Callable, Callable, Any]] = {}
+        self._migrations: dict[int, Callable[[str], None]] = {}
+
+    def register(
+        self,
+        name: str,
+        getter: Callable[[], Any],
+        setter: Callable[[Any], None],
+        adapter: Any | None = None,
+        template: Callable[[], Any] | None = None,
+    ) -> None:
+        """``getter`` supplies the object at save; ``setter`` receives the
+        restored object at load. Adapter defaults to ArrayTreeAdapter."""
+        self._components[name] = (getter, setter, adapter or ArrayTreeAdapter(), template)
+
+    def register_migration(self, from_version: int, fn: Callable[[str], None]) -> None:
+        """Migrate an on-disk checkpoint written at ``from_version`` forward
+        one schema step (reference register_migration:1007)."""
+        self._migrations[from_version] = fn
+
+    def _dir(self, step: int) -> str:
+        return os.path.join(self.root, f"step_{step}")
+
+    def save(self, step: int) -> str:
+        d = self._dir(step)
+        os.makedirs(d, exist_ok=True)
+        meta = {"schema_version": SCHEMA_VERSION, "step": step, "components": list(self._components)}
+        if self.capture_rng:
+            JSONAdapter().save(os.path.join(d, "_rng"), GlobalRNGState.get())
+        for name, (getter, _, adapter, _t) in self._components.items():
+            adapter.save(os.path.join(d, name), getter())
+        with open(os.path.join(d, "meta.json"), "w") as f:
+            json.dump(meta, f)
+        return d
+
+    def load(self, step: int) -> None:
+        d = self._dir(step)
+        with open(os.path.join(d, "meta.json")) as f:
+            meta = json.load(f)
+        version = meta.get("schema_version", 0)
+        migrated = False
+        while version < SCHEMA_VERSION:
+            if version not in self._migrations:
+                raise RuntimeError(
+                    f"checkpoint at schema v{version}, current v{SCHEMA_VERSION}, "
+                    f"no migration registered for v{version}"
+                )
+            self._migrations[version](d)
+            version += 1
+            migrated = True
+        if migrated:
+            # persist the new schema version so non-idempotent migrations
+            # never re-apply on a later load
+            meta["schema_version"] = version
+            with open(os.path.join(d, "meta.json"), "w") as f:
+                json.dump(meta, f)
+        if self.capture_rng and os.path.exists(os.path.join(d, "_rng")):
+            GlobalRNGState.set(JSONAdapter().load(os.path.join(d, "_rng")))
+        for name, (_, setter, adapter, template) in self._components.items():
+            tmpl = template() if template is not None else None
+            setter(adapter.load(os.path.join(d, name), tmpl))
+
+    def latest_step(self) -> int | None:
+        if not os.path.isdir(self.root):
+            return None
+        steps = [
+            int(n.removeprefix("step_"))
+            for n in os.listdir(self.root)
+            if n.startswith("step_")
+        ]
+        return max(steps) if steps else None

@@ -1,0 +1,28 @@
+from .mesh import (
+    AXIS_CONTEXT,
+    AXIS_DATA,
+    AXIS_EXPERT,
+    AXIS_MODEL,
+    make_mesh,
+    replicated,
+    shard_batch,
+    shard_train_state,
+    sharded,
+)
+from .ring_attention import attention_reference, ring_attention
+from .ulysses import ulysses_attention
+
+__all__ = [
+    "AXIS_DATA",
+    "AXIS_MODEL",
+    "AXIS_CONTEXT",
+    "AXIS_EXPERT",
+    "make_mesh",
+    "replicated",
+    "sharded",
+    "shard_batch",
+    "shard_train_state",
+    "ring_attention",
+    "attention_reference",
+    "ulysses_attention",
+]

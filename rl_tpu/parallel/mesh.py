@@ -1,0 +1,112 @@
+"""Device-mesh construction and sharding helpers.
+
+The framework's standard mesh axes (the ICI/DCN layout every distributed
+component speaks):
+
+- ``data``: batch/env data parallelism (gradient psum rides this axis);
+- ``model``: tensor parallelism (Megatron-style param sharding);
+- ``context``: sequence/context parallelism (ring attention KV rotation);
+- ``expert``: MoE expert parallelism (reserved).
+
+Replaces the reference's process-group plumbing
+(reference: torchrl/collectors/distributed/generic.py:490 init_process_group,
+torchrl/trainers/_distributed.py:63 ``_DDPProcessGroup``): on TPU the mesh +
+named shardings let XLA insert the collectives the reference issues manually
+via NCCL/gloo.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+__all__ = [
+    "AXIS_DATA",
+    "AXIS_MODEL",
+    "AXIS_CONTEXT",
+    "AXIS_EXPERT",
+    "make_mesh",
+    "replicated",
+    "sharded",
+    "shard_batch",
+    "shard_train_state",
+]
+
+AXIS_DATA = "data"
+AXIS_MODEL = "model"
+AXIS_CONTEXT = "context"
+AXIS_EXPERT = "expert"
+
+
+def make_mesh(
+    data: int = -1,
+    model: int = 1,
+    context: int = 1,
+    expert: int = 1,
+    devices=None,
+) -> Mesh:
+    """Build the standard mesh. ``data=-1`` absorbs the remaining devices.
+
+    Axis order is (data, context, expert, model): the innermost (fastest
+    ICI neighbors) axis is ``model``, where the most latency-sensitive
+    collectives (TP all-reduces) live.
+    """
+    devices = devices if devices is not None else jax.devices()
+    n = len(devices)
+    fixed = model * context * expert
+    if data == -1:
+        if n % fixed:
+            raise ValueError(f"{n} devices not divisible by model*context*expert={fixed}")
+        data = n // fixed
+    total = data * fixed
+    if total > n:
+        raise ValueError(f"mesh needs {total} devices, have {n}")
+    arr = np.asarray(devices[:total]).reshape(data, context, expert, model)
+    return Mesh(arr, (AXIS_DATA, AXIS_CONTEXT, AXIS_EXPERT, AXIS_MODEL))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def sharded(mesh: Mesh, *axes: str | None) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec(*axes))
+
+
+def shard_batch(batch, mesh: Mesh, axis: str = AXIS_DATA, batch_dim: int = 0):
+    """Place a pytree of arrays with ``batch_dim`` sharded over ``axis``."""
+
+    def put(x):
+        spec = [None] * x.ndim
+        if x.ndim > batch_dim:
+            spec[batch_dim] = axis
+        return jax.device_put(x, NamedSharding(mesh, PartitionSpec(*spec)))
+
+    return jax.tree.map(put, batch)
+
+
+def shard_train_state(ts: dict, mesh: Mesh, num_envs: int, env_axis: str = AXIS_DATA) -> dict:
+    """Standard data-parallel placement of a Program train state:
+    params/opt/rng replicated; collector env state sharded over envs.
+
+    This is the whole "DistributedDataParallel" setup — XLA derives the
+    gradient ``psum`` from these placements (no wrapper module, reference
+    trainers/_distributed.py:138 DDP-wrap becomes a no-op).
+    """
+    repl = replicated(mesh)
+    env_sharded = NamedSharding(mesh, PartitionSpec(env_axis))
+
+    def put_collector(x):
+        if hasattr(x, "shape") and x.ndim >= 1 and x.shape[0] == num_envs:
+            return jax.device_put(x, env_sharded)
+        return jax.device_put(x, repl)
+
+    out = {}
+    for k, v in ts.items():
+        if k == "collector":
+            out[k] = jax.tree.map(put_collector, v)
+        else:
+            out[k] = jax.device_put(v, repl)
+    return out

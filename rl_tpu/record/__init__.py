@@ -1,0 +1,21 @@
+from .loggers import (
+    CSVLogger,
+    Logger,
+    MLFlowLogger,
+    MultiLogger,
+    NullLogger,
+    TensorboardLogger,
+    WandbLogger,
+    get_logger,
+)
+
+__all__ = [
+    "Logger",
+    "CSVLogger",
+    "TensorboardLogger",
+    "WandbLogger",
+    "MLFlowLogger",
+    "NullLogger",
+    "MultiLogger",
+    "get_logger",
+]

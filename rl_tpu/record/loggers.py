@@ -1,0 +1,212 @@
+"""Experiment loggers.
+
+Redesign of the reference logger stack (reference: torchrl/record/loggers/
+— abstract ``Logger`` common.py, ``CSVLogger`` csv.py, ``TensorboardLogger``,
+``WandbLogger``, ``MLFlowLogger``, ``get_logger`` utils.py). Backends are
+import-gated with graceful errors; the ``Logger`` API is
+``log_scalar/log_video/log_hparams/log_histogram``.
+"""
+
+from __future__ import annotations
+
+import csv as _csv
+import json
+import os
+from typing import Any, Mapping
+
+import numpy as np
+
+__all__ = [
+    "Logger",
+    "CSVLogger",
+    "TensorboardLogger",
+    "WandbLogger",
+    "MLFlowLogger",
+    "NullLogger",
+    "MultiLogger",
+    "get_logger",
+]
+
+
+class Logger:
+    """Abstract logger (reference record/loggers/common.py)."""
+
+    def __init__(self, exp_name: str, log_dir: str | None = None):
+        self.exp_name = exp_name
+        self.log_dir = log_dir
+
+    def log_scalar(self, name: str, value: float, step: int | None = None) -> None:
+        raise NotImplementedError
+
+    def log_scalars(self, metrics: Mapping[str, Any], step: int | None = None) -> None:
+        for k, v in metrics.items():
+            v = np.asarray(v)
+            if v.ndim == 0 and np.issubdtype(v.dtype, np.number):
+                self.log_scalar(k, float(v), step)
+
+    def log_video(self, name: str, frames: np.ndarray, step: int | None = None, fps: int = 30) -> None:
+        pass
+
+    def log_hparams(self, hparams: Mapping[str, Any]) -> None:
+        pass
+
+    def log_histogram(self, name: str, values: np.ndarray, step: int | None = None) -> None:
+        pass
+
+
+class NullLogger(Logger):
+    """Drops everything (reference monitoring.py NullLogger)."""
+
+    def __init__(self, exp_name: str = "null", log_dir: str | None = None):
+        super().__init__(exp_name, log_dir)
+
+    def log_scalar(self, name, value, step=None):
+        pass
+
+
+class CSVLogger(Logger):
+    """One CSV per scalar stream + a JSON for hparams (reference csv.py)."""
+
+    def __init__(self, exp_name: str, log_dir: str = "logs"):
+        super().__init__(exp_name, os.path.join(log_dir, exp_name))
+        os.makedirs(self.log_dir, exist_ok=True)
+        self._files: dict[str, Any] = {}
+
+    def _writer(self, name: str):
+        if name not in self._files:
+            safe = name.replace("/", "_")
+            f = open(os.path.join(self.log_dir, f"{safe}.csv"), "a", newline="")
+            self._files[name] = (f, _csv.writer(f))
+        return self._files[name]
+
+    def log_scalar(self, name, value, step=None):
+        f, w = self._writer(name)
+        w.writerow([step, value])
+        f.flush()
+
+    def log_hparams(self, hparams):
+        with open(os.path.join(self.log_dir, "hparams.json"), "w") as f:
+            json.dump({k: str(v) for k, v in dict(hparams).items()}, f, indent=2)
+
+    def log_video(self, name, frames, step=None, fps=30):
+        # store as .npy next to the scalars (renderable offline)
+        safe = name.replace("/", "_")
+        np.save(os.path.join(self.log_dir, f"{safe}_{step or 0}.npy"), np.asarray(frames))
+
+    def close(self):
+        for f, _ in self._files.values():
+            f.close()
+
+
+class TensorboardLogger(Logger):
+    """tensorboardX-backed (reference tensorboard.py)."""
+
+    def __init__(self, exp_name: str, log_dir: str = "tb_logs"):
+        super().__init__(exp_name, os.path.join(log_dir, exp_name))
+        try:
+            from tensorboardX import SummaryWriter
+        except ImportError as e:  # pragma: no cover
+            raise ImportError("TensorboardLogger requires tensorboardX") from e
+        self.writer = SummaryWriter(self.log_dir)
+
+    def log_scalar(self, name, value, step=None):
+        self.writer.add_scalar(name, value, global_step=step)
+
+    def log_video(self, name, frames, step=None, fps=30):
+        import numpy as np
+
+        # tensorboardX expects [N, T, C, H, W]
+        arr = np.asarray(frames)
+        if arr.ndim == 4:  # [T, H, W, C] -> [1, T, C, H, W]
+            arr = arr.transpose(0, 3, 1, 2)[None]
+        self.writer.add_video(name, arr, global_step=step, fps=fps)
+
+    def log_hparams(self, hparams):
+        self.writer.add_hparams({k: str(v) for k, v in dict(hparams).items()}, {})
+
+    def log_histogram(self, name, values, step=None):
+        self.writer.add_histogram(name, np.asarray(values), global_step=step)
+
+
+class WandbLogger(Logger):  # pragma: no cover - dep not in image
+    """wandb-backed (reference wandb.py); import-gated."""
+
+    def __init__(self, exp_name: str, project: str = "rl_tpu", **kwargs):
+        super().__init__(exp_name)
+        try:
+            import wandb
+        except ImportError as e:
+            raise ImportError("WandbLogger requires wandb") from e
+        self._wandb = wandb
+        self.run = wandb.init(project=project, name=exp_name, **kwargs)
+
+    def log_scalar(self, name, value, step=None):
+        self._wandb.log({name: value}, step=step)
+
+    def log_hparams(self, hparams):
+        self.run.config.update(dict(hparams), allow_val_change=True)
+
+    def log_video(self, name, frames, step=None, fps=30):
+        self._wandb.log({name: self._wandb.Video(np.asarray(frames), fps=fps)}, step=step)
+
+
+class MLFlowLogger(Logger):  # pragma: no cover - dep not in image
+    """mlflow-backed (reference mlflow.py); import-gated."""
+
+    def __init__(self, exp_name: str, tracking_uri: str | None = None):
+        super().__init__(exp_name)
+        try:
+            import mlflow
+        except ImportError as e:
+            raise ImportError("MLFlowLogger requires mlflow") from e
+        self._mlflow = mlflow
+        if tracking_uri:
+            mlflow.set_tracking_uri(tracking_uri)
+        mlflow.set_experiment(exp_name)
+        mlflow.start_run()
+
+    def log_scalar(self, name, value, step=None):
+        self._mlflow.log_metric(name.replace("/", "_"), value, step=step)
+
+    def log_hparams(self, hparams):
+        self._mlflow.log_params({k: str(v) for k, v in dict(hparams).items()})
+
+
+class MultiLogger(Logger):
+    """Fan out to several loggers."""
+
+    def __init__(self, *loggers: Logger):
+        super().__init__(loggers[0].exp_name if loggers else "multi")
+        self.loggers = list(loggers)
+
+    def log_scalar(self, name, value, step=None):
+        for lg in self.loggers:
+            lg.log_scalar(name, value, step)
+
+    def log_video(self, name, frames, step=None, fps=30):
+        for lg in self.loggers:
+            lg.log_video(name, frames, step, fps)
+
+    def log_hparams(self, hparams):
+        for lg in self.loggers:
+            lg.log_hparams(hparams)
+
+    def log_histogram(self, name, values, step=None):
+        for lg in self.loggers:
+            lg.log_histogram(name, values, step)
+
+
+_BACKENDS = {
+    "csv": CSVLogger,
+    "tensorboard": TensorboardLogger,
+    "wandb": WandbLogger,
+    "mlflow": MLFlowLogger,
+    "null": NullLogger,
+}
+
+
+def get_logger(backend: str, exp_name: str, **kwargs) -> Logger:
+    """Factory (reference record/loggers/utils.py get_logger)."""
+    if backend not in _BACKENDS:
+        raise ValueError(f"unknown logger backend {backend!r}; options: {sorted(_BACKENDS)}")
+    return _BACKENDS[backend](exp_name, **kwargs)

@@ -1,9 +1,23 @@
 from .off_policy import OffPolicyConfig, OffPolicyProgram
 from .on_policy import OnPolicyConfig, OnPolicyProgram
+from .trainer import (
+    CountFramesLog,
+    EarlyStopping,
+    Evaluator,
+    LogScalar,
+    LogTiming,
+    Trainer,
+)
 
 __all__ = [
     "OnPolicyConfig",
     "OnPolicyProgram",
     "OffPolicyConfig",
     "OffPolicyProgram",
+    "Trainer",
+    "LogScalar",
+    "LogTiming",
+    "CountFramesLog",
+    "EarlyStopping",
+    "Evaluator",
 ]

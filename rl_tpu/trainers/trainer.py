@@ -1,0 +1,296 @@
+"""Hook-driven Trainer: host-side orchestration around a jitted program.
+
+Redesign of the reference trainer (reference: torchrl/trainers/trainers.py —
+``Trainer``:320, ``train()``:1354, hook base ``TrainerHookBase``:173, hooks
+``LogScalar``:2119, ``LogTiming``:2042, ``CountFramesLog``:2766,
+``EarlyStopping``:3046, ``UpdateWeights``:2644).
+
+The inversion: the reference's train loop interleaves Python hooks *inside*
+the optimization path; here the whole optimization path is one jitted
+``program.train_step`` (OnPolicyProgram/OffPolicyProgram), and hooks run at
+the host boundary between steps — logging, eval, checkpoint, early stop —
+where Python cost is amortized over an entire fused step.
+
+Hook stages: "pre_step", "post_step" (gets metrics), "post_eval",
+"save_checkpoint". Hooks are callables ``(trainer) -> None`` or
+``(trainer, metrics) -> None`` registered via ``register_op`` (reference
+register_op naming kept).
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Any, Callable
+
+import jax
+import numpy as np
+
+from ..data import ArrayDict
+from ..record.loggers import Logger, NullLogger
+from ..utils import logger as _log
+from ..utils.timing import timeit
+
+__all__ = ["Trainer", "LogScalar", "LogTiming", "CountFramesLog", "EarlyStopping", "Evaluator"]
+
+STAGES = ("pre_step", "post_step", "post_eval", "save_checkpoint")
+
+
+class Trainer:
+    """Train loop driver.
+
+    Args:
+        program: object with jittable ``train_step(ts) -> (ts, metrics)``.
+        total_steps: number of fused steps to run.
+        logger: experiment logger (defaults to Null).
+        frames_per_step: env frames per fused step (for frame accounting).
+        checkpoint: optional rl_tpu.checkpoint.Checkpoint; registered with
+            the live train state and saved every ``checkpoint_interval``.
+    """
+
+    def __init__(
+        self,
+        program: Any,
+        total_steps: int,
+        logger: Logger | None = None,
+        frames_per_step: int | None = None,
+        checkpoint: Any | None = None,
+        checkpoint_interval: int = 0,
+        log_interval: int = 1,
+    ):
+        self.program = program
+        self.total_steps = total_steps
+        self.logger = logger or NullLogger()
+        self.frames_per_step = frames_per_step or getattr(
+            getattr(program, "collector", None), "frames_per_batch", 0
+        )
+        self.checkpoint = checkpoint
+        self.checkpoint_interval = checkpoint_interval
+        self.log_interval = log_interval
+        self._hooks: dict[str, list[Callable]] = defaultdict(list)
+        self.step_count = 0
+        self.collected_frames = 0
+        self.ts: Any = None
+        self._stop = False
+        if checkpoint is not None:
+            from ..checkpoint import JSONAdapter
+
+            checkpoint.register(
+                "train_state", lambda: self.ts, self._set_ts, template=lambda: self.ts
+            )
+            checkpoint.register(
+                "counters",
+                lambda: {
+                    "step_count": self.step_count,
+                    "collected_frames": self.collected_frames,
+                },
+                self._set_counters,
+                adapter=JSONAdapter(),
+            )
+
+    def _set_ts(self, ts):
+        self.ts = ts
+
+    def _set_counters(self, counters: dict):
+        self.step_count = counters["step_count"]
+        self.collected_frames = counters["collected_frames"]
+
+    def restore(self, step: int | None = None, key: jax.Array | int = 0) -> None:
+        """Resume from a saved checkpoint (latest by default).
+
+        Builds a fresh train state first so the orbax restore has a template
+        with correct shapes/shardings (topology-safe), then overwrites it and
+        the step/frame counters from disk. Call before :meth:`train`.
+        """
+        if self.checkpoint is None:
+            raise RuntimeError("Trainer has no checkpoint configured")
+        step = step if step is not None else self.checkpoint.latest_step()
+        if step is None:
+            raise FileNotFoundError("no checkpoint found to restore")
+        if self.ts is None:
+            k = jax.random.key(key) if isinstance(key, int) else key
+            self.ts = self.program.init(k)
+        self.checkpoint.load(step)
+
+    # -- hooks ----------------------------------------------------------------
+
+    def register_op(self, stage: str, hook: Callable) -> None:
+        if stage not in STAGES:
+            raise ValueError(f"unknown stage {stage!r}; options {STAGES}")
+        self._hooks[stage].append(hook)
+
+    def _run_hooks(self, stage: str, *args) -> None:
+        for h in self._hooks[stage]:
+            with timeit(f"hook/{stage}/{type(h).__name__}"):
+                h(self, *args)
+
+    def request_stop(self) -> None:
+        self._stop = True
+
+    # -- loop -----------------------------------------------------------------
+
+    def train(self, key: jax.Array | int = 0, ts: Any = None) -> Any:
+        if ts is None and self.ts is not None:
+            ts = self.ts  # restored via restore() or a previous train()
+        if ts is None:
+            key = jax.random.key(key) if isinstance(key, int) else key
+            with timeit("trainer/init"):
+                ts = self.program.init(key)
+                if hasattr(self.program, "prefill"):
+                    ts = self.program.prefill(ts)
+        self.ts = ts
+        step_fn = jax.jit(self.program.train_step)
+        while self.step_count < self.total_steps and not self._stop:
+            self._run_hooks("pre_step")
+            with timeit("trainer/step"):
+                self.ts, metrics = step_fn(self.ts)
+            self.step_count += 1
+            self.collected_frames += self.frames_per_step
+            self._run_hooks("post_step", metrics)
+            if (
+                self.checkpoint is not None
+                and self.checkpoint_interval
+                and self.step_count % self.checkpoint_interval == 0
+            ):
+                with timeit("trainer/checkpoint"):
+                    jax.block_until_ready(self.ts)
+                    self.checkpoint.save(self.step_count)
+                    self._run_hooks("save_checkpoint")
+        return self.ts
+
+
+class LogScalar:
+    """Push scalar metrics to the logger (reference LogScalar:2119)."""
+
+    def __init__(self, prefix: str = "train", interval: int = 1):
+        self.prefix = prefix
+        self.interval = interval
+
+    def __call__(self, trainer: Trainer, metrics: ArrayDict) -> None:
+        if trainer.step_count % self.interval:
+            return
+        flat = {
+            f"{self.prefix}/{'/'.join(k)}": v
+            for k, v in metrics.items(nested=True, leaves_only=True)
+        }
+        trainer.logger.log_scalars(flat, step=trainer.collected_frames)
+
+
+class LogTiming:
+    """Push the timeit registry to the logger (reference LogTiming:2042)."""
+
+    def __init__(self, interval: int = 10):
+        self.interval = interval
+
+    def __call__(self, trainer: Trainer, metrics=None) -> None:
+        if trainer.step_count % self.interval:
+            return
+        for name, val in timeit.todict().items():
+            trainer.logger.log_scalar(f"time/{name}", val, step=trainer.collected_frames)
+
+
+class CountFramesLog:
+    """Frames/sec + totals (reference CountFramesLog:2766)."""
+
+    def __init__(self, interval: int = 10):
+        self.interval = interval
+        self._last = None
+
+    def __call__(self, trainer: Trainer, metrics=None) -> None:
+        import time
+
+        now = time.perf_counter()
+        if trainer.step_count % self.interval == 0:
+            if self._last is not None:
+                t0, f0 = self._last
+                fps = (trainer.collected_frames - f0) / max(now - t0, 1e-9)
+                trainer.logger.log_scalar("train/fps", fps, step=trainer.collected_frames)
+                _log.info(
+                    "step %d frames %d fps %.0f",
+                    trainer.step_count,
+                    trainer.collected_frames,
+                    fps,
+                )
+            self._last = (now, trainer.collected_frames)
+
+
+class EarlyStopping:
+    """Stop when a metric crosses a threshold (reference EarlyStopping:3046)."""
+
+    def __init__(self, metric: str = "episode_reward_mean", threshold: float = float("inf"), patience: int = 1):
+        self.metric = metric
+        self.threshold = threshold
+        self.patience = patience
+        self._count = 0
+
+    def __call__(self, trainer: Trainer, metrics: ArrayDict) -> None:
+        if self.metric not in metrics:
+            return
+        v = float(np.asarray(metrics[self.metric]))
+        if np.isfinite(v) and v >= self.threshold:
+            self._count += 1
+            if self._count >= self.patience:
+                _log.info("EarlyStopping: %s=%.3f >= %.3f", self.metric, v, self.threshold)
+                trainer.request_stop()
+        else:
+            self._count = 0
+
+
+class Evaluator:
+    """Periodic greedy-policy evaluation off the training path (reference:
+    torchrl/collectors/_evaluator.py:99 + LogValidationReward:2484).
+
+    Runs a jitted deterministic rollout on the eval env every ``interval``
+    steps and logs episode return statistics.
+    """
+
+    def __init__(
+        self,
+        env,
+        policy,
+        interval: int = 10,
+        max_steps: int = 500,
+        metric_prefix: str = "eval",
+    ):
+        from ..envs.base import rollout as _rollout
+        from ..envs.utils import ExplorationType, set_exploration_type
+
+        self.env = env
+        self.interval = interval
+        self.max_steps = max_steps
+        self.metric_prefix = metric_prefix
+
+        def eval_fn(params, key):
+            with set_exploration_type(ExplorationType.MODE):
+                steps = _rollout(env, key, lambda td, k: policy(params, td, k), max_steps=max_steps)
+            reward = steps["next", "reward"]
+            done = steps["next", "done"]
+            import jax.numpy as jnp
+
+            ep = (
+                steps["next", "episode_reward"]
+                if ("next", "episode_reward") in steps
+                else None
+            )
+            out = {"reward_mean": jnp.mean(reward)}
+            if ep is not None:
+                count = jnp.sum(done)
+                out["episode_reward"] = jnp.where(
+                    count > 0,
+                    jnp.sum(jnp.where(done, ep, 0.0)) / jnp.clip(count, 1),
+                    jnp.nan,
+                )
+            return out
+
+        self._eval_fn = jax.jit(eval_fn)
+        self._key = jax.random.key(17)
+
+    def __call__(self, trainer: Trainer, metrics=None) -> None:
+        if trainer.step_count % self.interval:
+            return
+        self._key, k = jax.random.split(self._key)
+        out = self._eval_fn(trainer.ts["params"], k)
+        trainer.logger.log_scalars(
+            {f"{self.metric_prefix}/{k2}": v for k2, v in out.items()},
+            step=trainer.collected_frames,
+        )
+        trainer._run_hooks("post_eval", out)

@@ -9,6 +9,7 @@ if not logger.handlers:
     _h.setFormatter(logging.Formatter("%(asctime)s [%(name)s][%(levelname)s] %(message)s"))
     logger.addHandler(_h)
     logger.setLevel(logging.INFO)
+    logger.propagate = False  # avoid double emission via the root logger
 
 __all__ = [
     "logger",

@@ -1,0 +1,119 @@
+"""Parallelism tests on the virtual 8-device CPU mesh: ring/Ulysses attention
+vs full-attention oracle, mesh construction, DP train-state sharding
+(the multi-chip strategy validated without TPU hardware, SURVEY.md §4)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from rl_tpu.parallel import (
+    attention_reference,
+    make_mesh,
+    ring_attention,
+    shard_train_state,
+    ulysses_attention,
+)
+
+KEY = jax.random.key(0)
+
+
+def qkv(B=2, T=32, H=4, D=16):
+    k1, k2, k3 = jax.random.split(KEY, 3)
+    return (
+        jax.random.normal(k1, (B, T, H, D)),
+        jax.random.normal(k2, (B, T, H, D)),
+        jax.random.normal(k3, (B, T, H, D)),
+    )
+
+
+class TestMesh:
+    def test_make_mesh_absorb(self):
+        mesh = make_mesh(model=2)
+        assert mesh.shape["data"] == 4 and mesh.shape["model"] == 2
+
+    def test_make_mesh_full(self):
+        mesh = make_mesh(data=2, context=4)
+        assert mesh.shape["context"] == 4
+
+    def test_bad_divisibility(self):
+        with pytest.raises(ValueError):
+            make_mesh(model=3)
+
+
+@pytest.mark.parametrize("causal", [False, True], ids=["full", "causal"])
+class TestRingAttention:
+    def test_matches_reference(self, causal):
+        mesh = make_mesh(data=1, context=8)
+        q, k, v = qkv()
+        out = jax.jit(
+            lambda q, k, v: ring_attention(q, k, v, mesh, causal=causal)
+        )(q, k, v)
+        ref = attention_reference(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-5)
+
+    def test_gradients_match(self, causal):
+        mesh = make_mesh(data=1, context=4)
+        q, k, v = qkv(B=1, T=16, H=2, D=8)
+
+        g_ring = jax.grad(lambda q: ring_attention(q, k, v, mesh, causal=causal).sum())(q)
+        g_ref = jax.grad(lambda q: attention_reference(q, k, v, causal=causal).sum())(q)
+        np.testing.assert_allclose(np.asarray(g_ring), np.asarray(g_ref), rtol=2e-3, atol=2e-4)
+
+    def test_sharded_inputs(self, causal):
+        # with inputs actually placed seq-sharded, output stays sharded
+        mesh = make_mesh(data=1, context=8)
+        q, k, v = qkv()
+        sh = NamedSharding(mesh, P(None, "context", None, None))
+        qs, ks, vs = (jax.device_put(x, sh) for x in (q, k, v))
+        out = jax.jit(lambda q, k, v: ring_attention(q, k, v, mesh, causal=causal))(qs, ks, vs)
+        assert out.sharding.spec == P(None, "context", None, None)
+
+
+class TestUlysses:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_reference(self, causal):
+        mesh = make_mesh(data=1, context=4)
+        q, k, v = qkv(T=32, H=8)
+        out = jax.jit(
+            lambda q, k, v: ulysses_attention(q, k, v, mesh, causal=causal)
+        )(q, k, v)
+        ref = attention_reference(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-5)
+
+    def test_head_divisibility_check(self):
+        mesh = make_mesh(data=1, context=8)
+        q, k, v = qkv(H=4)  # 4 heads < 8 devices
+        with pytest.raises(ValueError):
+            ulysses_attention(q, k, v, mesh)
+
+
+class TestDataParallelProgram:
+    def test_ppo_train_state_sharded_runs(self):
+        from rl_tpu.collectors import Collector
+        from rl_tpu.envs import CartPoleEnv, VmapEnv
+        from rl_tpu.modules import MLP, Categorical, ProbabilisticActor, TDModule, ValueOperator
+        from rl_tpu.objectives import ClipPPOLoss
+        from rl_tpu.trainers import OnPolicyConfig, OnPolicyProgram
+
+        mesh = make_mesh()  # 8-way data
+        num_envs = 16
+        env = VmapEnv(CartPoleEnv(), num_envs)
+        actor = ProbabilisticActor(
+            TDModule(MLP(out_features=2), ["observation"], ["logits"]),
+            Categorical,
+            dist_keys=("logits",),
+        )
+        critic = ValueOperator(MLP(out_features=1))
+        loss = ClipPPOLoss(actor, critic)
+        coll = Collector(env, lambda p, td, k: actor(p["actor"], td, k), frames_per_batch=64)
+        program = OnPolicyProgram(coll, loss, OnPolicyConfig(num_epochs=1, minibatch_size=32))
+        ts = program.init(KEY)
+        ts = shard_train_state(ts, mesh, num_envs=num_envs)
+        with mesh:
+            ts2, metrics = jax.jit(program.train_step)(ts)
+        assert np.isfinite(float(metrics["loss"]))
+        # env state stays sharded across steps
+        obs_sh = ts2["collector"]["carry"]["observation"].sharding
+        assert "data" in str(obs_sh.spec) or obs_sh.is_fully_replicated is False
