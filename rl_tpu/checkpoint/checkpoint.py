@@ -175,11 +175,17 @@ class Checkpoint:
             setter(adapter.load(os.path.join(d, name), tmpl))
 
     def latest_step(self) -> int | None:
+        """Latest COMPLETE checkpoint (meta.json is written last by save(),
+        so its presence marks completeness; foreign/partial dirs are skipped)."""
         if not os.path.isdir(self.root):
             return None
-        steps = [
-            int(n.removeprefix("step_"))
-            for n in os.listdir(self.root)
-            if n.startswith("step_")
-        ]
+        steps = []
+        for n in os.listdir(self.root):
+            if not n.startswith("step_"):
+                continue
+            suffix = n.removeprefix("step_")
+            if not suffix.isdigit():
+                continue
+            if os.path.exists(os.path.join(self.root, n, "meta.json")):
+                steps.append(int(suffix))
         return max(steps) if steps else None

@@ -1,0 +1,105 @@
+"""LLM collector: chat env x jitted generation -> GRPO training batches.
+
+Redesign of the reference's ``LLMCollector`` (reference:
+torchrl/collectors/llm/base.py:26 — rollout = wrapper.generate() batch into a
+ChatEnv) without the external engine: generation is the jitted KV-cache scan
+(rl_tpu/models/generate.py) over the SAME params the trainer optimizes
+(SharedProgramScheme — zero-copy weight "sync"), or over a scheme-provided
+snapshot for decoupled rollout.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..data import ArrayDict
+from ..envs.llm.chat import DatasetChatEnv
+from ..models import generate
+from ..objectives.llm import mc_advantage
+
+__all__ = ["LLMCollector"]
+
+
+class LLMCollector:
+    """Collect GRPO batches: sample prompt groups, generate G responses per
+    prompt, score, compute group-relative advantages."""
+
+    def __init__(
+        self,
+        env: DatasetChatEnv,
+        model: Any,
+        num_prompts: int = 8,
+        max_new_tokens: int = 64,
+        temperature: float = 1.0,
+        eos_id: int | None = None,
+        ref_params: Any = None,
+        weight_scheme: Any = None,
+    ):
+        self.env = env
+        self.model = model
+        self.num_prompts = num_prompts
+        self.max_new_tokens = max_new_tokens
+        self.temperature = temperature
+        self.eos_id = eos_id
+        self.ref_params = ref_params
+        self.weight_scheme = weight_scheme
+
+        self._gen = jax.jit(
+            lambda params, toks, mask, key: generate(
+                model,
+                params,
+                toks,
+                mask,
+                key,
+                max_new_tokens=max_new_tokens,
+                temperature=temperature,
+                eos_id=eos_id,
+            )
+        )
+        if ref_params is not None:
+            from ..models import token_log_probs
+
+            self._ref_lp = jax.jit(
+                lambda toks, mask: token_log_probs(model, ref_params, toks, mask)
+            )
+
+    def collect(self, params: Any, key: jax.Array) -> ArrayDict:
+        """One GRPO batch: ArrayDict with tokens/attention_mask/
+        assistant_mask/sample_log_prob/advantage/reward (+ref_log_prob)."""
+        if self.weight_scheme is not None:
+            params = self.weight_scheme.pull()
+        state, group_ids = self.env.sample_batch(self.num_prompts)
+        toks = jnp.asarray(state["tokens"])
+        pmask = jnp.asarray(state["attention_mask"], jnp.float32)
+        out = self._gen(params, toks, pmask, key)
+
+        resp = np.asarray(out.response_tokens)
+        rmask = np.asarray(out.response_mask)
+        _, rewards, _ = self.env.step(state, resp, rmask)
+
+        G = toks.shape[0]
+        P_len = toks.shape[1]
+        T = P_len + self.max_new_tokens
+        gid = jnp.asarray(group_ids)
+        adv = mc_advantage(jnp.asarray(rewards), gid, self.num_prompts)
+
+        batch = ArrayDict(
+            tokens=out.tokens,
+            attention_mask=out.full_mask[:, :T].astype(jnp.float32),
+            assistant_mask=jnp.concatenate(
+                [jnp.zeros((G, P_len), bool), out.response_mask], axis=1
+            ),
+            sample_log_prob=jnp.concatenate(
+                [jnp.zeros((G, P_len)), out.response_log_probs], axis=1
+            ),
+            advantage=adv,
+            reward=jnp.asarray(rewards),
+            group_id=gid,
+        )
+        if self.ref_params is not None:
+            batch = batch.set("ref_log_prob", self._ref_lp(batch["tokens"], batch["attention_mask"]))
+        return batch

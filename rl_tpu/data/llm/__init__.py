@@ -1,0 +1,3 @@
+from .history import History, Message
+
+__all__ = ["History", "Message"]

@@ -1,0 +1,3 @@
+from .chat import ChatEnv, DatasetChatEnv
+
+__all__ = ["ChatEnv", "DatasetChatEnv"]

@@ -1,0 +1,11 @@
+from .generate import GenerateOutput, generate, token_log_probs
+from .transformer import TransformerConfig, TransformerLM, param_sharding_rules
+
+__all__ = [
+    "TransformerConfig",
+    "TransformerLM",
+    "param_sharding_rules",
+    "generate",
+    "token_log_probs",
+    "GenerateOutput",
+]

@@ -1,0 +1,143 @@
+"""Autoregressive generation + teacher-forced scoring for RLHF.
+
+The native replacement for the reference's wrapper/engine split (reference:
+torchrl/modules/llm/policies/common.py:783 ``LLMWrapperBase`` with
+``generate``/``log_prob`` modes; vllm/sglang engines behind it): here both
+paths are jitted XLA programs over the same :class:`TransformerLM` params —
+no external engine, no weight transfer for the sync case.
+
+Conventions:
+- prompts are **left-padded** (``attention_mask`` 0 on pads), so every row's
+  last prompt token sits at the same column — batch decode stays uniform;
+- ``generate`` scans one decode step at a time over a preallocated KV cache
+  (``lax.scan``, static ``max_new_tokens``), sampling with temperature or
+  greedy; rows stop at ``eos_id`` (continuations masked);
+- ``token_log_probs`` is the training-side teacher-forced scorer.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["GenerateOutput", "generate", "token_log_probs"]
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class GenerateOutput:
+    tokens: jax.Array  # [B, Tp + Tn] full sequences (prompt + response)
+    response_tokens: jax.Array  # [B, Tn]
+    response_mask: jax.Array  # [B, Tn] True on real (pre-eos) tokens
+    response_log_probs: jax.Array  # [B, Tn] behavior log-probs
+    full_mask: jax.Array  # [B, Tp + Tn]
+
+
+def _positions_from_mask(mask: jax.Array) -> jax.Array:
+    return jnp.clip(jnp.cumsum(mask.astype(jnp.int32), axis=1) - 1, 0)
+
+
+def generate(
+    model,
+    params,
+    prompt_tokens: jax.Array,
+    prompt_mask: jax.Array,
+    key: jax.Array,
+    max_new_tokens: int,
+    temperature: float = 1.0,
+    eos_id: int | None = None,
+    pad_id: int = 0,
+    greedy: bool = False,
+) -> GenerateOutput:
+    B, Tp = prompt_tokens.shape
+    total = Tp + max_new_tokens
+    max_seq = getattr(getattr(model, "cfg", None), "max_seq_len", None)
+    if max_seq is not None and total > max_seq:
+        raise ValueError(
+            f"prompt ({Tp}) + max_new_tokens ({max_new_tokens}) exceeds "
+            f"max_seq_len ({max_seq}); position embeddings would clamp silently"
+        )
+    cache = model.init_cache(B, total)
+
+    full_mask0 = jnp.concatenate(
+        [prompt_mask.astype(bool), jnp.zeros((B, max_new_tokens), bool)], axis=1
+    )
+    positions = _positions_from_mask(prompt_mask)
+
+    # prefill the cache with the prompt
+    logits, cache = model.apply(
+        {"params": params},
+        prompt_tokens,
+        attention_mask=full_mask0,
+        cache=cache,
+        positions=positions,
+    )
+    last_logits = logits[:, -1]
+    next_pos = positions[:, -1] + 1  # per-row position of the next token
+
+    def step(carry, step_key):
+        cache, last_logits, mask, pos, alive = carry
+        lp_full = jax.nn.log_softmax(last_logits / jnp.maximum(temperature, 1e-6), axis=-1)
+        if greedy:
+            tok = jnp.argmax(last_logits, axis=-1)
+        else:
+            tok = jax.random.categorical(step_key, last_logits / jnp.maximum(temperature, 1e-6))
+        lp = jnp.take_along_axis(lp_full, tok[:, None], axis=-1)[:, 0]
+        tok = jnp.where(alive, tok, pad_id)
+        # the new token becomes attendable where the row is alive
+        write_col = cache[0]["len"]
+        mask = mask.at[:, write_col].set(alive)
+        logits, cache = model.apply(
+            {"params": params},
+            tok[:, None],
+            attention_mask=mask,
+            cache=cache,
+            positions=pos[:, None],
+        )
+        was_alive = alive
+        if eos_id is not None:
+            alive = alive & (tok != eos_id)
+        return (cache, logits[:, -1], mask, pos + 1, alive), (tok, lp, was_alive)
+
+    keys = jax.random.split(key, max_new_tokens)
+    (cache, _, full_mask, _, _), (toks, lps, valid) = jax.lax.scan(
+        step,
+        (cache, last_logits, full_mask0, next_pos, jnp.ones((B,), bool)),
+        keys,
+    )
+    response = jnp.moveaxis(toks, 0, 1)  # [B, Tn]
+    resp_lp = jnp.moveaxis(lps, 0, 1)
+    resp_mask = jnp.moveaxis(valid, 0, 1)
+    full = jnp.concatenate([prompt_tokens, response], axis=1)
+    return GenerateOutput(
+        tokens=full,
+        response_tokens=response,
+        response_mask=resp_mask,
+        response_log_probs=resp_lp,
+        full_mask=full_mask,
+    )
+
+
+def token_log_probs(
+    model,
+    params,
+    tokens: jax.Array,
+    attention_mask: jax.Array,
+    temperature: float = 1.0,
+) -> jax.Array:
+    """log p(token_t | tokens_<t) for every position (teacher-forced).
+
+    Output [B, T]; position 0 has no prediction and gets 0. This is the
+    training/scoring path (reference LLMWrapper log-probs mode).
+    """
+    positions = _positions_from_mask(attention_mask)
+    logits = model.apply(
+        {"params": params}, tokens, attention_mask=attention_mask.astype(bool), positions=positions
+    )
+    lp = jax.nn.log_softmax(logits[:, :-1] / jnp.maximum(temperature, 1e-6), axis=-1)
+    tgt = tokens[:, 1:]
+    out = jnp.take_along_axis(lp, tgt[..., None], axis=-1)[..., 0]
+    return jnp.concatenate([jnp.zeros_like(out[:, :1]), out], axis=1)

@@ -1,0 +1,3 @@
+from .grpo import CISPOLoss, DAPOLoss, GRPOLoss, SFTLoss, mc_advantage
+
+__all__ = ["GRPOLoss", "DAPOLoss", "CISPOLoss", "SFTLoss", "mc_advantage"]

@@ -1,0 +1,188 @@
+"""GRPO-family RLHF losses + group-relative advantages + SFT.
+
+Functional redesigns (reference: torchrl/objectives/llm/grpo.py —
+``GRPOLoss``:354, ``DAPO``:948, ``CISPOLoss``:999, ``MCAdvantage``:1023;
+torchrl/objectives/llm/sft.py:104 ``SFTLoss``).
+
+Batch layout (token-level, produced by the generation path
+rl_tpu/models/generate.py): ``tokens`` [B, T], ``attention_mask`` [B, T],
+``assistant_mask`` [B, T] (True on response/assistant tokens — the loss
+support), ``sample_log_prob`` [B, T] behavior per-token log-probs,
+``advantage`` [B] or [B, T], optional ``ref_log_prob`` [B, T] for the KL
+penalty, ``group_id``/``reward`` [B] for MCAdvantage.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...data import ArrayDict
+from ..common import LossModule
+
+__all__ = ["GRPOLoss", "DAPOLoss", "CISPOLoss", "SFTLoss", "mc_advantage"]
+
+
+def _masked_token_mean(x, mask, per_seq_norm: bool = False):
+    m = mask.astype(x.dtype)
+    if per_seq_norm:
+        seq = jnp.sum(x * m, axis=-1) / jnp.clip(jnp.sum(m, axis=-1), 1.0)
+        return jnp.mean(seq)
+    return jnp.sum(x * m) / jnp.clip(jnp.sum(m), 1.0)
+
+
+class GRPOLoss(LossModule):
+    """Group-relative PPO over assistant tokens (reference grpo.py:354).
+
+    ``log_prob_fn(params, batch) -> [B, T]`` per-token log-probs of the
+    current policy (rl_tpu.models.token_log_probs partial-applied).
+    KL regularization vs a frozen reference via the k3 estimator
+    (Schulman), coefficient ``kl_coeff``; entropy bonus optional.
+    """
+
+    def __init__(
+        self,
+        log_prob_fn,
+        clip_epsilon: float | tuple[float, float] = 0.2,
+        kl_coeff: float = 0.0,
+        entropy_coeff: float = 0.0,
+        per_seq_norm: bool = False,
+    ):
+        self.log_prob_fn = log_prob_fn
+        if isinstance(clip_epsilon, tuple):
+            self.eps_low, self.eps_high = clip_epsilon
+        else:
+            self.eps_low = self.eps_high = clip_epsilon
+        self.kl_coeff = kl_coeff
+        self.entropy_coeff = entropy_coeff
+        self.per_seq_norm = per_seq_norm
+
+    def init_params(self, key, td):
+        raise NotImplementedError("GRPOLoss wraps an externally-initialized model")
+
+    def _objective(self, ratio, adv, mask):
+        clipped = jnp.clip(ratio, 1.0 - self.eps_low, 1.0 + self.eps_high)
+        gain = jnp.minimum(ratio * adv, clipped * adv)
+        clip_frac = _masked_token_mean(
+            ((ratio < 1.0 - self.eps_low) | (ratio > 1.0 + self.eps_high)).astype(
+                jnp.float32
+            ),
+            mask,
+        )
+        return gain, ArrayDict(clip_fraction=clip_frac)
+
+    def __call__(self, params, batch: ArrayDict, key=None):
+        mask = batch["assistant_mask"].astype(bool)
+        log_prob = self.log_prob_fn(params, batch)
+        behav = jax.lax.stop_gradient(batch["sample_log_prob"])
+        log_ratio = jnp.where(mask, log_prob - behav, 0.0)
+        ratio = jnp.exp(log_ratio)
+
+        adv = batch["advantage"]
+        if adv.ndim == 1:
+            adv = adv[:, None]
+        adv = jax.lax.stop_gradient(adv)
+
+        gain, extra = self._objective(ratio, adv, mask)
+        loss_obj = -_masked_token_mean(gain, mask, self.per_seq_norm)
+
+        total = loss_obj
+        metrics = ArrayDict(
+            loss_objective=loss_obj,
+            kl_approx=_masked_token_mean(jax.lax.stop_gradient(-log_ratio), mask),
+        ).update(extra)
+
+        if self.kl_coeff and "ref_log_prob" in batch:
+            ref = jax.lax.stop_gradient(batch["ref_log_prob"])
+            # k3 estimator: e^(ref-pi) - (ref-pi) - 1 >= 0
+            d = jnp.where(mask, ref - log_prob, 0.0)
+            kl = _masked_token_mean(jnp.exp(d) - d - 1.0, mask, self.per_seq_norm)
+            total = total + self.kl_coeff * kl
+            metrics = metrics.set("kl_to_ref", jax.lax.stop_gradient(kl))
+
+        if self.entropy_coeff:
+            ent = -_masked_token_mean(log_prob, mask, self.per_seq_norm)
+            total = total - self.entropy_coeff * ent
+            metrics = metrics.set("entropy", jax.lax.stop_gradient(ent))
+
+        return total, metrics.set("loss", total)
+
+
+class DAPOLoss(GRPOLoss):
+    """Decoupled-clip GRPO (reference DAPO:948): asymmetric (eps_low,
+    eps_high) clipping, token-level normalization."""
+
+    def __init__(self, log_prob_fn, clip_epsilon=(0.2, 0.28), **kw):
+        super().__init__(log_prob_fn, clip_epsilon=clip_epsilon, **kw)
+
+
+class CISPOLoss(GRPOLoss):
+    """Clipped-IS-weight policy gradient (reference CISPO:999): the IS ratio
+    is clipped and *detached*, the gradient flows through log-prob only."""
+
+    def __call__(self, params, batch: ArrayDict, key=None):
+        mask = batch["assistant_mask"].astype(bool)
+        log_prob = self.log_prob_fn(params, batch)
+        behav = jax.lax.stop_gradient(batch["sample_log_prob"])
+        log_ratio = jnp.where(mask, log_prob - behav, 0.0)
+        ratio = jax.lax.stop_gradient(
+            jnp.clip(jnp.exp(log_ratio), 1.0 - self.eps_low, 1.0 + self.eps_high)
+        )
+        adv = batch["advantage"]
+        if adv.ndim == 1:
+            adv = adv[:, None]
+        adv = jax.lax.stop_gradient(adv)
+        loss = -_masked_token_mean(ratio * adv * log_prob, mask, self.per_seq_norm)
+        return loss, ArrayDict(
+            loss=loss, kl_approx=_masked_token_mean(jax.lax.stop_gradient(-log_ratio), mask)
+        )
+
+
+def mc_advantage(
+    reward: jax.Array,
+    group_id: jax.Array,
+    num_groups: int,
+    std_normalize: bool = True,
+    eps: float = 1e-4,
+) -> jax.Array:
+    """Group-relative Monte-Carlo advantage (reference MCAdvantage:1023):
+    ``A_i = r_i - mean(r in group)``, optionally / std. Jit-safe segment
+    statistics over ``group_id`` ∈ [0, num_groups)."""
+    ones = jnp.ones_like(reward)
+    sums = jax.ops.segment_sum(reward, group_id, num_segments=num_groups)
+    counts = jax.ops.segment_sum(ones, group_id, num_segments=num_groups)
+    means = sums / jnp.clip(counts, 1.0)
+    adv = reward - means[group_id]
+    if std_normalize:
+        sq = jax.ops.segment_sum(adv**2, group_id, num_segments=num_groups)
+        std = jnp.sqrt(sq / jnp.clip(counts, 1.0))
+        adv = adv / (std[group_id] + eps)
+    return adv
+
+
+class SFTLoss(LossModule):
+    """Supervised fine-tuning on assistant tokens (reference sft.py:104):
+    NLL of target tokens over the assistant span, optional label smoothing."""
+
+    def __init__(self, log_prob_fn, label_smoothing: float = 0.0, logits_fn=None):
+        self.log_prob_fn = log_prob_fn
+        self.label_smoothing = label_smoothing
+        self.logits_fn = logits_fn  # needed when label_smoothing > 0
+
+    def init_params(self, key, td):
+        raise NotImplementedError("SFTLoss wraps an externally-initialized model")
+
+    def __call__(self, params, batch: ArrayDict, key=None):
+        mask = batch["assistant_mask"].astype(bool)
+        log_prob = self.log_prob_fn(params, batch)
+        nll = -_masked_token_mean(log_prob, mask)
+        loss = nll
+        if self.label_smoothing > 0.0:
+            if self.logits_fn is None:
+                raise ValueError("label_smoothing requires logits_fn")
+            logits = self.logits_fn(params, batch)
+            uniform = -jnp.mean(jax.nn.log_softmax(logits, -1), axis=-1)[:, :-1]
+            uniform = jnp.concatenate([jnp.zeros_like(uniform[:, :1]), uniform], axis=1)
+            smooth = _masked_token_mean(uniform, mask)
+            loss = (1.0 - self.label_smoothing) * nll + self.label_smoothing * smooth
+        return loss, ArrayDict(loss=loss, nll=jax.lax.stop_gradient(nll))

@@ -43,13 +43,15 @@ def attention_reference(q, k, v, causal: bool = True, scale: float | None = None
     return jnp.einsum("bhqk,bkhd->bqhd", p, v)
 
 
-def _block_attn(q, k, v, q_pos, kv_pos, scale, causal):
+def _block_attn(q, k, v, q_pos, kv_pos, scale, causal, kv_mask=None):
     """Scores+weighted values for one (Q_local, KV_block) pair with running
     softmax stats. Returns (o_blk, m_blk, l_blk)."""
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
     if causal:
         mask = q_pos[:, None] >= kv_pos[None, :]
         s = jnp.where(mask[None, None], s, -jnp.inf)
+    if kv_mask is not None:  # padding mask over this KV block [B, Tk]
+        s = jnp.where(kv_mask[:, None, None, :], s, -jnp.inf)
     m_blk = jnp.max(s, axis=-1)  # [B, H, Tq]
     # guard fully-masked rows (all -inf): exp(-inf - -inf) -> use where
     safe_m = jnp.where(jnp.isfinite(m_blk), m_blk, 0.0)
@@ -60,7 +62,9 @@ def _block_attn(q, k, v, q_pos, kv_pos, scale, causal):
     return o_blk, jnp.where(jnp.isfinite(m_blk), m_blk, -jnp.inf), l_blk
 
 
-def _ring_attention_inner(q, k, v, axis_name: str, causal: bool, scale: float | None):
+def _ring_attention_inner(
+    q, k, v, kv_mask, axis_name: str, causal: bool, scale: float | None
+):
     B, Tq, H, D = q.shape
     scale = scale if scale is not None else D**-0.5
     n = lax.psum(1, axis_name)
@@ -81,21 +85,25 @@ def _ring_attention_inner(q, k, v, axis_name: str, causal: bool, scale: float | 
         return o_new, m_new, l_new
 
     def body(i, carry):
-        o, m, l, k_blk, v_blk = carry
+        o, m, l, k_blk, v_blk, mask_blk = carry
         kv_idx = (my_idx - i) % n
         kv_pos = kv_idx * Tq + jnp.arange(Tq)
-        o_blk, m_blk, l_blk = _block_attn(q, k_blk, v_blk, q_pos, kv_pos, scale, causal)
+        o_blk, m_blk, l_blk = _block_attn(
+            q, k_blk, v_blk, q_pos, kv_pos, scale, causal, mask_blk
+        )
         o, m, l = combine((o, m, l), o_blk, m_blk, l_blk)
-        # rotate KV to the next device (neighbor hop around the ring)
+        # rotate KV (and its padding mask) to the next device (neighbor hop)
         perm = [(j, (j + 1) % n) for j in range(n)]
         k_blk = lax.ppermute(k_blk, axis_name, perm)
         v_blk = lax.ppermute(v_blk, axis_name, perm)
-        return o, m, l, k_blk, v_blk
+        if mask_blk is not None:
+            mask_blk = lax.ppermute(mask_blk, axis_name, perm)
+        return o, m, l, k_blk, v_blk, mask_blk
 
     o0 = jnp.zeros_like(q)
     m0 = jnp.full((B, H, Tq), -jnp.inf, q.dtype)
     l0 = jnp.zeros((B, H, Tq), q.dtype)
-    o, m, l, _, _ = lax.fori_loop(0, n, body, (o0, m0, l0, k, v))
+    o, m, l, _, _, _ = lax.fori_loop(0, n, body, (o0, m0, l0, k, v, kv_mask))
     l = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows -> zeros, not NaN
     return o / jnp.moveaxis(l, 1, -1)[..., None]
 
@@ -108,21 +116,38 @@ def ring_attention(
     axis_name: str = "context",
     causal: bool = True,
     scale: float | None = None,
+    kv_mask: jax.Array | None = None,
 ) -> jax.Array:
     """Exact attention with the sequence sharded over ``axis_name``.
 
     Inputs/outputs are GLOBAL arrays [B, T, H, D]; shard_map splits T over
-    the mesh axis (T must divide evenly). Compose inside jit — XLA overlaps
-    the ppermute hops with the block computation.
+    the mesh axis (T must divide evenly). ``kv_mask`` [B, T] masks padded key
+    positions (rotates around the ring with K/V). Compose inside jit — XLA
+    overlaps the ppermute hops with the block computation.
     """
     spec = P(None, axis_name, None, None)
+    if kv_mask is None:
+        inner = functools.partial(
+            _ring_attention_inner,
+            kv_mask=None,
+            axis_name=axis_name,
+            causal=causal,
+            scale=scale,
+        )
+        return shard_map(
+            lambda q, k, v: inner(q, k, v),
+            mesh=mesh,
+            in_specs=(spec, spec, spec),
+            out_specs=spec,
+            check_vma=False,
+        )(q, k, v)
     inner = functools.partial(
         _ring_attention_inner, axis_name=axis_name, causal=causal, scale=scale
     )
     return shard_map(
-        inner,
+        lambda q, k, v, m: inner(q, k, v, m),
         mesh=mesh,
-        in_specs=(spec, spec, spec),
+        in_specs=(spec, spec, spec, P(None, axis_name)),
         out_specs=spec,
         check_vma=False,
-    )(q, k, v)
+    )(q, k, v, kv_mask.astype(bool))

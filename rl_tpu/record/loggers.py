@@ -147,7 +147,10 @@ class WandbLogger(Logger):  # pragma: no cover - dep not in image
         self.run.config.update(dict(hparams), allow_val_change=True)
 
     def log_video(self, name, frames, step=None, fps=30):
-        self._wandb.log({name: self._wandb.Video(np.asarray(frames), fps=fps)}, step=step)
+        arr = np.asarray(frames)
+        if arr.ndim == 4 and arr.shape[-1] in (1, 3, 4):
+            arr = arr.transpose(0, 3, 1, 2)  # [T,H,W,C] -> wandb's (T,C,H,W)
+        self._wandb.log({name: self._wandb.Video(arr, fps=fps)}, step=step)
 
 
 class MLFlowLogger(Logger):  # pragma: no cover - dep not in image

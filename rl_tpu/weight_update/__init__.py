@@ -1,0 +1,13 @@
+from .schemes import (
+    DevicePutScheme,
+    DoubleBufferScheme,
+    SharedProgramScheme,
+    WeightSyncScheme,
+)
+
+__all__ = [
+    "WeightSyncScheme",
+    "SharedProgramScheme",
+    "DevicePutScheme",
+    "DoubleBufferScheme",
+]

@@ -1,0 +1,142 @@
+"""Weight synchronization schemes: trainer -> rollout model publication.
+
+Redesign of the reference's scheme registry (reference:
+torchrl/weight_update/weight_sync_schemes.py:346 ``WeightSyncScheme``;
+shared-mem ``_shared.py``:327; NCCL-broadcast vllm scheme
+``llm/vllm_nccl.py``:405; double-buffer ``llm/vllm_double_buffer.py``:149).
+
+On TPU the reference's whole problem (push torch tensors into worker
+processes / engine ranks over NCCL) collapses into three cases:
+
+- :class:`SharedProgramScheme` — trainer and rollout run in ONE jitted
+  program on one mesh: the "sync" is passing the params pytree to the next
+  collect call. Zero copies; the default and the fast path.
+- :class:`DevicePutScheme` — distinct meshes/shardings (e.g. train TP=4,
+  rollout replicated): ``jax.device_put`` re-lays the params; XLA turns it
+  into the minimal collective.
+- :class:`DoubleBufferScheme` — host/offline handoff: params snapshot to a
+  directory (numpy), a version file flips atomically, receivers poll —
+  mirrors the reference's memmap double buffer for engine processes.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+from typing import Any
+
+import jax
+import numpy as np
+
+__all__ = ["WeightSyncScheme", "SharedProgramScheme", "DevicePutScheme", "DoubleBufferScheme"]
+
+
+class WeightSyncScheme:
+    """Protocol: ``push(params)`` on the sender; ``pull() -> params`` on the
+    receiver (same object in-process, or a directory handshake across)."""
+
+    def push(self, params: Any) -> None:
+        raise NotImplementedError
+
+    def pull(self) -> Any:
+        raise NotImplementedError
+
+    @property
+    def version(self) -> int:
+        raise NotImplementedError
+
+
+class SharedProgramScheme(WeightSyncScheme):
+    """Same-program aliasing: hold a reference, no copy (the staged-graph
+    north star — SURVEY.md §2.10 TPU equivalent (a))."""
+
+    def __init__(self):
+        self._params = None
+        self._version = 0
+
+    def push(self, params):
+        self._params = params
+        self._version += 1
+
+    def pull(self):
+        if self._params is None:
+            raise RuntimeError("no params pushed yet")
+        return self._params
+
+    @property
+    def version(self):
+        return self._version
+
+
+class DevicePutScheme(WeightSyncScheme):
+    """Re-placement onto the rollout sharding (mesh-to-mesh broadcast)."""
+
+    def __init__(self, target_sharding):
+        self.target_sharding = target_sharding
+        self._params = None
+        self._version = 0
+
+    def push(self, params):
+        if isinstance(self.target_sharding, (dict,)) or hasattr(self.target_sharding, "keys"):
+            self._params = jax.tree.map(
+                lambda x, s: jax.device_put(x, s), params, self.target_sharding
+            )
+        else:
+            self._params = jax.device_put(params, self.target_sharding)
+        self._version += 1
+
+    def pull(self):
+        if self._params is None:
+            raise RuntimeError("no params pushed yet")
+        return self._params
+
+    @property
+    def version(self):
+        return self._version
+
+
+class DoubleBufferScheme(WeightSyncScheme):
+    """Two on-disk buffers + an atomically-flipped version pointer
+    (reference vllm_double_buffer.py:149). Sender and receiver may be
+    different processes; numpy .npz per buffer slot."""
+
+    def __init__(self, directory: str | None = None):
+        self.dir = directory or tempfile.mkdtemp(prefix="rl_tpu_weights_")
+        os.makedirs(self.dir, exist_ok=True)
+        self._treedef = None
+
+    def _slot(self, version: int) -> str:
+        return os.path.join(self.dir, f"buf{version % 2}.npz")
+
+    def _pointer(self) -> str:
+        return os.path.join(self.dir, "VERSION.json")
+
+    def push(self, params):
+        version = self.version + 1
+        leaves, treedef = jax.tree_util.tree_flatten(params)
+        self._treedef = treedef
+        np.savez(self._slot(version), *[np.asarray(l) for l in leaves])
+        tmp = self._pointer() + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"version": version}, f)
+        os.replace(tmp, self._pointer())  # atomic flip
+
+    def pull(self, treedef=None):
+        version = self.version
+        if version == 0:
+            raise RuntimeError("no params pushed yet")
+        with np.load(self._slot(version)) as z:
+            leaves = [z[k] for k in z.files]
+        treedef = treedef or self._treedef
+        if treedef is None:
+            raise RuntimeError("receiver needs the treedef (pass it to pull)")
+        return jax.tree_util.tree_unflatten(treedef, [jax.numpy.asarray(l) for l in leaves])
+
+    @property
+    def version(self) -> int:
+        try:
+            with open(self._pointer()) as f:
+                return json.load(f)["version"]
+        except FileNotFoundError:
+            return 0
