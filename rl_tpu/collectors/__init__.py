@@ -1,3 +1,11 @@
+from .host import HostCollector, ThreadedEnvPool
+from .llm import LLMCollector
 from .single import Collector, CollectorState
 
-__all__ = ["Collector", "CollectorState"]
+__all__ = [
+    "Collector",
+    "CollectorState",
+    "HostCollector",
+    "ThreadedEnvPool",
+    "LLMCollector",
+]

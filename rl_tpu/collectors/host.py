@@ -1,0 +1,159 @@
+"""Host-env collection: threaded env pool + jitted batched policy.
+
+The Sebulba-style actor split for sims that cannot run inside XLA
+(reference analogs: torchrl/envs/async_envs.py:59 ``AsyncEnvPool`` /
+``ThreadingAsyncEnvPool``:841; torchrl/envs/batched_envs.py:1805
+``ParallelEnv`` worker processes; torchrl/modules/inference_server/
+``InferenceServer``:261 which batches many actors' queries onto one device
+policy). On TPU the shape is: N host envs step in a thread pool, their
+observations batch into ONE device policy call (the "inference server" is
+just the jitted policy over the stacked batch), actions scatter back.
+
+Produces time-major [T, N, ...] ArrayDict batches in the standard
+{..., "next": ...} layout — downstream losses/estimators are identical to
+the pure-JAX path.
+"""
+
+from __future__ import annotations
+
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..data import ArrayDict
+from ..utils.seeding import seed_generator
+
+__all__ = ["ThreadedEnvPool", "HostCollector"]
+
+
+class ThreadedEnvPool:
+    """N host envs stepped concurrently (GIL-friendly: gym envs release the
+    GIL in C physics; otherwise threads still overlap with device compute).
+
+    ``async_step_send``/``async_step_recv`` expose the out-of-sync API
+    (reference AsyncEnvPool:59); ``step_wait`` is the sync barrier form.
+    """
+
+    def __init__(self, env_fns: list[Callable[[], Any]], num_threads: int | None = None):
+        self.envs = [fn() for fn in env_fns]
+        self.num_envs = len(self.envs)
+        self._pool = ThreadPoolExecutor(max_workers=num_threads or self.num_envs)
+        self._futures: list = [None] * self.num_envs
+
+    @property
+    def observation_spec(self):
+        return self.envs[0].observation_spec
+
+    @property
+    def action_spec(self):
+        return self.envs[0].action_spec
+
+    def reset(self, seed: int = 0) -> list[dict]:
+        seeds = []
+        s = seed
+        for _ in range(self.num_envs):
+            seeds.append(s)
+            s = seed_generator(s)
+        return list(self._pool.map(lambda ev_s: ev_s[0].reset(seed=ev_s[1]), zip(self.envs, seeds)))
+
+    # -- async protocol -------------------------------------------------------
+
+    def async_step_send(self, i: int, action) -> None:
+        self._futures[i] = self._pool.submit(self.envs[i].step, action)
+
+    def async_step_recv(self, i: int):
+        out = self._futures[i].result()
+        self._futures[i] = None
+        return out
+
+    def step_wait(self, actions: np.ndarray) -> list[tuple]:
+        for i in range(self.num_envs):
+            self.async_step_send(i, actions[i])
+        return [self.async_step_recv(i) for i in range(self.num_envs)]
+
+    def close(self) -> None:
+        for e in self.envs:
+            e.close()
+        self._pool.shutdown(wait=False)
+
+
+class HostCollector:
+    """Collect batches from a host env pool with a jitted device policy.
+
+    ``policy``: ``(params, td, key) -> td`` over the BATCHED observation
+    ArrayDict (the inference-server pattern: one device call serves all
+    envs). ``None`` collects random actions. Host-side auto-reset matches
+    the device collector's semantics ("next" holds terminal content, the
+    carry restarts).
+    """
+
+    def __init__(
+        self,
+        pool: ThreadedEnvPool,
+        policy: Callable | None = None,
+        frames_per_batch: int = 1024,
+        seed: int = 0,
+    ):
+        self.pool = pool
+        self.policy = jax.jit(policy) if policy is not None else None
+        n = pool.num_envs
+        if frames_per_batch % n:
+            raise ValueError(f"frames_per_batch={frames_per_batch} not divisible by {n} envs")
+        self.scan_length = frames_per_batch // n
+        self.frames_per_batch = frames_per_batch
+        self._seed = seed
+        self._obs: list[dict] | None = None
+
+    def _stack_obs(self, obs_list: list[dict]) -> ArrayDict:
+        keys = obs_list[0].keys()
+        return ArrayDict({k: jnp.asarray(np.stack([o[k] for o in obs_list])) for k in keys})
+
+    def collect(self, params: Any, key: jax.Array) -> ArrayDict:
+        n = self.pool.num_envs
+        if self._obs is None:
+            self._obs = self.pool.reset(seed=self._seed)
+        steps = []
+        for _ in range(self.scan_length):
+            td = self._stack_obs(self._obs)
+            key, k_act = jax.random.split(key)
+            if self.policy is None:
+                td = td.set("action", self.pool.action_spec.rand(k_act, (n,)))
+            else:
+                td = self.policy(params, td, k_act)
+            actions = np.asarray(td["action"])
+
+            results = self.pool.step_wait(actions)
+            next_obs = [r[0] for r in results]
+            reward = np.asarray([r[1] for r in results], np.float32)
+            term = np.asarray([r[2] for r in results])
+            trunc = np.asarray([r[3] for r in results])
+            done = term | trunc
+
+            next_td = self._stack_obs(next_obs).update(
+                ArrayDict(
+                    reward=jnp.asarray(reward),
+                    terminated=jnp.asarray(term),
+                    truncated=jnp.asarray(trunc),
+                    done=jnp.asarray(done),
+                )
+            )
+            steps.append(td.set("next", next_td))
+
+            # host auto-reset: restart finished envs; carry keeps fresh obs
+            carry = list(next_obs)
+            for i in range(n):
+                if done[i]:
+                    self._seed = seed_generator(self._seed)
+                    carry[i] = self.pool.envs[i].reset(seed=self._seed)
+            self._obs = carry
+        return ArrayDict.stack(steps, axis=0)
+
+    def iterate(self, params: Any, key: jax.Array, total_frames: int):
+        collected = 0
+        while collected < total_frames:
+            key, k = jax.random.split(key)
+            yield self.collect(params, k)
+            collected += self.frames_per_batch

@@ -1,4 +1,5 @@
 from .buffer import ReplayBuffer
+from .host_per import HostPrioritizedSampler
 from .samplers import (
     PrioritizedSampler,
     RandomSampler,
@@ -10,6 +11,7 @@ from .storages import DeviceStorage, ListStorage, MemmapStorage, Storage
 from .writers import ImmutableDatasetWriter, MaxValueWriter, RoundRobinWriter, Writer
 
 __all__ = [
+    "HostPrioritizedSampler",
     "ReplayBuffer",
     "Storage",
     "DeviceStorage",

@@ -1,0 +1,73 @@
+"""Host-side prioritized sampler backed by the C++ segment trees.
+
+The reference's PER architecture (reference:
+torchrl/data/replay_buffers/samplers.py:942 ``PrioritizedSampler`` over the
+C++ trees): O(log N) point updates and prefix-search sampling on the host.
+Use with host storages (MemmapStorage / ListStorage) where the buffer never
+enters XLA; the device path is :class:`rl_tpu.data.PrioritizedSampler`.
+
+NOT jit-traceable (mutates native trees) — by construction, like the
+reference.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+
+from ...csrc import MinSegmentTree, SumSegmentTree
+from ..arraydict import ArrayDict
+from .samplers import Sampler
+
+__all__ = ["HostPrioritizedSampler"]
+
+
+class HostPrioritizedSampler(Sampler):
+    def __init__(self, alpha: float = 0.6, beta: float = 0.4, eps: float = 1e-8):
+        self.alpha = alpha
+        self.beta = beta
+        self.eps = eps
+        self._sum = None
+        self._min = None
+        self._max_priority = 1.0
+
+    def init(self, capacity: int) -> ArrayDict:
+        self.capacity = capacity
+        self._sum = SumSegmentTree(capacity)
+        self._min = MinSegmentTree(capacity)
+        return ArrayDict()
+
+    def on_write(self, sstate, idx, items):
+        idx = np.asarray(idx)
+        p = self._max_priority**self.alpha
+        self._sum[idx] = np.full(idx.shape, p)
+        self._min[idx] = np.full(idx.shape, p)
+        return sstate
+
+    def update_priority(self, sstate, idx, priority):
+        idx = np.asarray(idx)
+        priority = np.abs(np.asarray(priority, np.float64)) + self.eps
+        self._max_priority = max(self._max_priority, float(priority.max()))
+        p = priority**self.alpha
+        self._sum[idx] = p
+        self._min[idx] = p
+        return sstate
+
+    def sample(self, sstate, key, batch_size, size, capacity):
+        total = self._sum.reduce(0, int(size))
+        us = np.asarray(jax.random.uniform(key, (batch_size,))) * total
+        idx = self._sum.scan(us)
+        idx = np.minimum(idx, int(size) - 1)
+
+        n = max(int(size), 1)
+        probs = self._sum[idx] / max(total, 1e-12)
+        weights = (n * np.clip(probs, 1e-12, None)) ** -self.beta
+        min_prob = self._min.reduce(0, int(size)) / max(total, 1e-12)
+        max_w = (n * max(min_prob, 1e-12)) ** -self.beta
+        weights = weights / max(max_w, 1e-12)
+        info = ArrayDict(
+            _weight=jax.numpy.asarray(weights, jax.numpy.float32),
+            index=jax.numpy.asarray(idx, jax.numpy.int32),
+        )
+        return jax.numpy.asarray(idx, jax.numpy.int32), info, sstate

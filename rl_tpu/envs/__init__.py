@@ -2,6 +2,8 @@ from .base import EnvBase, EnvState, VmapEnv, rollout, step_mdp, where_done
 from .classic.cartpole import CartPoleEnv
 from .classic.pendulum import PendulumEnv
 from .transforms.base import Compose, Transform, TransformedEnv
+from .transforms.image import CenterCrop, GrayScale, Resize, ToFloatImage
+from .transforms.vecnorm import VecNorm
 from .transforms.common import (
     ActionScaling,
     CatFrames,
@@ -22,6 +24,11 @@ from .transforms.common import (
 from .utils import ExplorationType, check_env_specs, exploration_type, set_exploration_type
 
 __all__ = [
+    "VecNorm",
+    "ToFloatImage",
+    "GrayScale",
+    "Resize",
+    "CenterCrop",
     "EnvBase",
     "EnvState",
     "VmapEnv",

@@ -212,9 +212,18 @@ class EnvBase:
 
 
 def where_done(done: jax.Array, on_done, on_not_done):
-    """Leaf-wise ``where`` with ``done`` broadcast over trailing feature dims."""
+    """Leaf-wise ``where`` with ``done`` broadcast over trailing feature dims.
+
+    Leaves that cannot be indexed per-env (fewer dims than ``done``) keep the
+    continuing value. NOTE: per-env vs global state CANNOT be told apart by
+    shape alone (a global stats vector may coincide with the env batch
+    shape) — transform state goes through ``Transform.on_done`` instead
+    (transforms/base.py), which dispatches per transform.
+    """
 
     def pick(a, b):
+        if a.ndim < done.ndim:
+            return b
         d = done.reshape(done.shape + (1,) * (a.ndim - done.ndim))
         return jnp.where(d, a, b)
 

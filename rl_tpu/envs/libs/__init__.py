@@ -1,0 +1,3 @@
+from .gym import GymEnv, GymWrapper, spec_from_gym_space
+
+__all__ = ["GymWrapper", "GymEnv", "spec_from_gym_space"]

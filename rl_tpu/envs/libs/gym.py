@@ -1,0 +1,120 @@
+"""Gymnasium bridge: host envs behind the framework's spec/data contract.
+
+Redesign of the reference's gym wrapper (reference: torchrl/envs/libs/gym.py
+— ``GymWrapper``:972/``GymEnv``:1805 with ``set_gym_backend`` version
+dispatch :138; spec conversion helpers; ``GymLikeEnv`` protocol
+gym_like.py:153). The version-dispatch machinery collapses: only gymnasium's
+five-tuple API is supported (the reference's `implement_for` handles a
+decade of gym drift we don't inherit).
+
+These are HOST envs: numpy in/out, not jit-traceable. They plug into
+:class:`rl_tpu.collectors.HostCollector` (threads + jitted policy), the
+Sebulba-style split for sims that cannot live inside XLA.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import numpy as np
+
+from ...data import (
+    Binary,
+    Bounded,
+    Categorical,
+    Composite,
+    MultiCategorical,
+    Unbounded,
+)
+
+__all__ = ["GymWrapper", "GymEnv", "spec_from_gym_space"]
+
+
+def spec_from_gym_space(space) -> Any:
+    """gymnasium.Space -> rl_tpu Spec (reference gym.py spec converters)."""
+    import gymnasium.spaces as S
+
+    if isinstance(space, S.Box):
+        return Bounded(shape=space.shape, low=space.low, high=space.high, dtype=space.dtype)
+    if isinstance(space, S.Discrete):
+        # start offset is applied in GymWrapper.step (actions stay [0, n))
+        return Categorical(n=int(space.n))
+    if isinstance(space, S.MultiDiscrete):
+        return MultiCategorical(nvec=tuple(int(n) for n in space.nvec))
+    if isinstance(space, S.MultiBinary):
+        return Binary(shape=(int(space.n),) if np.isscalar(space.n) else tuple(space.n), dtype=np.int8)
+    if isinstance(space, S.Dict):
+        return Composite({k: spec_from_gym_space(v) for k, v in space.spaces.items()})
+    if isinstance(space, S.Tuple):
+        return Composite({str(i): spec_from_gym_space(v) for i, v in enumerate(space.spaces)})
+    return Unbounded(shape=getattr(space, "shape", ()) or (), dtype=getattr(space, "dtype", np.float32))
+
+
+class GymWrapper:
+    """Wrap a constructed gymnasium env into the host-env protocol:
+
+    - ``reset(seed) -> obs_dict``
+    - ``step(action) -> (obs_dict, reward, terminated, truncated)``
+    - spec properties matching :class:`rl_tpu.envs.EnvBase`'s contract.
+
+    Observations are exposed under "observation" (Dict spaces keep their
+    own keys), mirroring the reference's key conventions.
+    """
+
+    def __init__(self, env: Any):
+        self.env = env
+        self._obs_spec = spec_from_gym_space(env.observation_space)
+        self._action_spec = spec_from_gym_space(env.action_space)
+        self._action_start = int(getattr(env.action_space, "start", 0) or 0)
+        self._obs_is_tuple = type(env.observation_space).__name__ == "Tuple"
+
+    # -- specs ----------------------------------------------------------------
+
+    @property
+    def observation_spec(self) -> Composite:
+        if isinstance(self._obs_spec, Composite):
+            return self._obs_spec
+        return Composite(observation=self._obs_spec)
+
+    @property
+    def action_spec(self):
+        return self._action_spec
+
+    @property
+    def batch_shape(self) -> tuple:
+        return ()
+
+    # -- host protocol --------------------------------------------------------
+
+    def _obs_dict(self, obs) -> dict:
+        if isinstance(obs, dict):
+            return dict(obs)
+        if self._obs_is_tuple:  # keys match the Composite spec ("0","1",…)
+            return {str(i): np.asarray(o) for i, o in enumerate(obs)}
+        return {"observation": np.asarray(obs)}
+
+    def reset(self, seed: int | None = None) -> dict:
+        obs, _info = self.env.reset(seed=seed)
+        return self._obs_dict(obs)
+
+    def step(self, action) -> tuple[dict, float, bool, bool]:
+        a = np.asarray(action)
+        if isinstance(self._action_spec, Categorical):
+            a = a + self._action_start  # gym Discrete.start offset
+            if a.ndim == 0:
+                a = a.item()
+        obs, reward, terminated, truncated, _info = self.env.step(a)
+        return self._obs_dict(obs), float(reward), bool(terminated), bool(truncated)
+
+    def close(self) -> None:
+        self.env.close()
+
+
+class GymEnv(GymWrapper):
+    """Build from an env id (reference GymEnv, gym.py:1805)."""
+
+    def __init__(self, env_id: str, **kwargs):
+        import gymnasium
+
+        super().__init__(gymnasium.make(env_id, **kwargs))
+        self.env_id = env_id

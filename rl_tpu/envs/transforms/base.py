@@ -57,6 +57,15 @@ class Transform:
         """Applied to the input (action) before the base env's step."""
         return td
 
+    def on_done(self, reset_tstate: ArrayDict, tstate: ArrayDict, done) -> ArrayDict:
+        """Merge state at auto-reset boundaries: default = per-env masking
+        (episodic state like RewardSum/CatFrames restarts where done).
+        GLOBAL state (e.g. VecNorm running stats) overrides this to keep the
+        continuing value — shape heuristics cannot make that call."""
+        from ..base import where_done
+
+        return where_done(done, reset_tstate, tstate)
+
     # -- spec hooks -----------------------------------------------------------
 
     def transform_observation_spec(self, spec: Composite) -> Composite:
@@ -108,6 +117,12 @@ class Compose(Transform):
         for t in reversed(self.transforms):
             td = t.inv(td)
         return td
+
+    def on_done(self, reset_tstate, tstate, done):
+        out = ArrayDict()
+        for i, t in enumerate(self.transforms):
+            out = out.set(f"t{i}", t.on_done(reset_tstate[f"t{i}"], tstate[f"t{i}"], done))
+        return out
 
     def transform_observation_spec(self, spec):
         for t in self.transforms:
@@ -201,6 +216,37 @@ class TransformedEnv(EnvBase):
 
     def _spec_state(self, state):
         return self.env._spec_state(state["env"])
+
+    def step_and_reset(self, state: EnvState, td: ArrayDict):
+        """Masked auto-reset with per-transform state dispatch: the env part
+        masks per-env (EnvBase semantics); each transform decides via
+        :meth:`Transform.on_done` whether its state is episodic or global."""
+        from ..base import step_mdp, where_done
+
+        new_state, full_td = self.step(state, td)
+        rng_path = self._rng_path
+        rng = new_state[rng_path]
+        if rng.shape == ():
+            reset_key, carry_key = jax.random.split(rng)
+        else:
+            pairs = jax.vmap(jax.random.split)(rng.reshape(-1))
+            carry_key = pairs[:, 1].reshape(rng.shape)
+            reset_key = pairs[0, 0]
+        reset_state, reset_td = self.reset(reset_key)
+
+        done = full_td["next", "done"]
+        carry_td = where_done(done, reset_td, step_mdp(full_td))
+        env_rng_path = self.env._rng_path
+        env_carry = where_done(
+            done,
+            reset_state["env"].delete(env_rng_path),
+            new_state["env"].delete(env_rng_path),
+        )
+        tstate = self.transform.on_done(
+            reset_state["transforms"], new_state["transforms"], done
+        )
+        carry_state = ArrayDict(env=env_carry.set(env_rng_path, carry_key), transforms=tstate)
+        return carry_state, full_td, carry_td
 
     def rand_action(self, td, key):
         return td.set("action", self.action_spec.rand(key, self.batch_shape))

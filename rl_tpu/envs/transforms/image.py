@@ -1,0 +1,102 @@
+"""Image/pixel observation transforms (jit-native, HWC layout).
+
+Redesigns of the reference's vision transforms (reference:
+torchrl/envs/transforms/transforms.py — ``ToTensorImage``, ``Resize``,
+``CenterCrop``, ``GrayScale``): implemented with ``jax.image`` so they run
+*inside* the staged rollout (the reference applies them host-side per step).
+Layout is HWC (TPU/XLA-native), not the reference's CHW.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from ...data import Bounded, Unbounded
+from .common import _KeyedTransform
+
+__all__ = ["ToFloatImage", "GrayScale", "Resize", "CenterCrop"]
+
+
+class ToFloatImage(_KeyedTransform):
+    """uint8 [0,255] HWC -> float32 [0,1] (reference ToTensorImage, minus
+    the CHW permute — HWC stays)."""
+
+    def __init__(self, in_keys=("pixels",)):
+        super().__init__(in_keys)
+
+    def _apply_leaf(self, x):
+        if jnp.issubdtype(x.dtype, jnp.floating):
+            return x
+        return x.astype(jnp.float32) / 255.0
+
+    def transform_observation_spec(self, spec):
+        for k in self._keys(spec):
+            leaf = spec[k]
+            spec = spec.set(k, Bounded(shape=leaf.shape, low=0.0, high=1.0))
+        return spec
+
+
+class GrayScale(_KeyedTransform):
+    """RGB -> single-channel luma (reference GrayScale)."""
+
+    WEIGHTS = (0.2989, 0.587, 0.114)
+
+    def __init__(self, in_keys=("pixels",)):
+        super().__init__(in_keys)
+
+    def _apply_leaf(self, x):
+        w = jnp.asarray(self.WEIGHTS, x.dtype if jnp.issubdtype(x.dtype, jnp.floating) else jnp.float32)
+        y = jnp.tensordot(x.astype(w.dtype), w, axes=[[-1], [0]])[..., None]
+        return y.astype(x.dtype) if jnp.issubdtype(x.dtype, jnp.floating) else y
+
+    def transform_observation_spec(self, spec):
+        for k in self._keys(spec):
+            leaf = spec[k]
+            new_shape = leaf.shape[:-1] + (1,)
+            spec = spec.set(k, dataclasses.replace(leaf, shape=new_shape) if not isinstance(leaf, Bounded) else Unbounded(shape=new_shape, dtype=jnp.float32))
+        return spec
+
+
+class Resize(_KeyedTransform):
+    """Bilinear resize of the trailing HWC dims (reference Resize) via
+    ``jax.image.resize`` — fused into the rollout graph."""
+
+    def __init__(self, h: int, w: int, in_keys=("pixels",), method: str = "bilinear"):
+        super().__init__(in_keys)
+        self.h, self.w = h, w
+        self.method = method
+
+    def _apply_leaf(self, x):
+        out_shape = x.shape[:-3] + (self.h, self.w, x.shape[-1])
+        y = jax.image.resize(x.astype(jnp.float32), out_shape, self.method)
+        return y.astype(x.dtype) if jnp.issubdtype(x.dtype, jnp.floating) else y.astype(jnp.float32)
+
+    def transform_observation_spec(self, spec):
+        for k in self._keys(spec):
+            leaf = spec[k]
+            new_shape = leaf.shape[:-3] + (self.h, self.w, leaf.shape[-1])
+            spec = spec.set(k, Unbounded(shape=new_shape, dtype=jnp.float32))
+        return spec
+
+
+class CenterCrop(_KeyedTransform):
+    """Center crop of the trailing HWC dims (reference CenterCrop)."""
+
+    def __init__(self, h: int, w: int, in_keys=("pixels",)):
+        super().__init__(in_keys)
+        self.h, self.w = h, w
+
+    def _apply_leaf(self, x):
+        H, W = x.shape[-3], x.shape[-2]
+        top, left = (H - self.h) // 2, (W - self.w) // 2
+        return x[..., top : top + self.h, left : left + self.w, :]
+
+    def transform_observation_spec(self, spec):
+        for k in self._keys(spec):
+            leaf = spec[k]
+            new_shape = leaf.shape[:-3] + (self.h, self.w, leaf.shape[-1])
+            spec = spec.set(k, dataclasses.replace(leaf, shape=new_shape) if not isinstance(leaf, Bounded) else Unbounded(shape=new_shape, dtype=leaf.dtype))
+        return spec

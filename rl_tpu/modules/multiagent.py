@@ -1,0 +1,129 @@
+"""Multi-agent networks and value mixers.
+
+Redesign of the reference's multi-agent stack (reference:
+torchrl/modules/models/multiagent.py — ``MultiAgentNetBase``:21 (vmap over
+agents with optional param sharing), ``MultiAgentMLP``:292, ``VDNMixer``:879,
+``QMixer``:952).
+
+Agent axis convention: the SECOND-to-last batch axis — inputs are
+``[..., n_agents, F]``. With ``share_params=True`` one param set is vmapped
+over agents; otherwise params carry a leading ``n_agents`` axis (the same
+stacked-ensemble machinery as critics, rl_tpu.modules.init_ensemble).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from .networks import MLP
+
+__all__ = ["MultiAgentMLP", "VDNMixer", "QMixer"]
+
+
+class MultiAgentMLP:
+    """Per-agent MLPs with optional parameter sharing (reference :292).
+
+    ``centralized=True`` lets every agent see the concatenation of all
+    agents' inputs (central critic pattern).
+    """
+
+    def __init__(
+        self,
+        n_agents: int,
+        out_features: int,
+        num_cells: Sequence[int] = (64, 64),
+        share_params: bool = True,
+        centralized: bool = False,
+        activation: Any = "tanh",
+    ):
+        self.n_agents = n_agents
+        self.share_params = share_params
+        self.centralized = centralized
+        self.net = MLP(out_features=out_features, num_cells=num_cells, activation=activation)
+
+    def _prep(self, x: jax.Array) -> jax.Array:
+        if self.centralized:
+            # every agent sees all agents' features
+            flat = x.reshape(x.shape[:-2] + (1, x.shape[-2] * x.shape[-1]))
+            x = jnp.broadcast_to(flat, x.shape[:-2] + (self.n_agents, flat.shape[-1]))
+        return x
+
+    def init(self, key: jax.Array, x: jax.Array):
+        x = self._prep(x)
+        if self.share_params:
+            return self.net.init(key, x[..., 0, :])["params"]
+        keys = jax.random.split(key, self.n_agents)
+        return jax.vmap(lambda k: self.net.init(k, x[..., 0, :])["params"])(keys)
+
+    def __call__(self, params, x: jax.Array) -> jax.Array:
+        x = self._prep(x)
+        if self.share_params:
+            return self.net.apply({"params": params}, x)
+        # params leading axis = agents; map both over the agent axis
+        return jnp.moveaxis(
+            jax.vmap(lambda p, xa: self.net.apply({"params": p}, xa), in_axes=(0, -2), out_axes=0)(
+                params, x
+            ),
+            0,
+            -2,
+        )
+
+
+class VDNMixer:
+    """Value decomposition: Q_tot = Σ_a Q_a (reference VDNMixer:879)."""
+
+    n_agents: int
+
+    def __init__(self, n_agents: int):
+        self.n_agents = n_agents
+
+    def init(self, key, chosen_q, state=None):
+        return {}
+
+    def __call__(self, params, chosen_q: jax.Array, state=None) -> jax.Array:
+        return jnp.sum(chosen_q, axis=-1)
+
+
+class _QMixNet(nn.Module):
+    """Monotonic mixing hypernetwork (Rashid et al. 2018)."""
+
+    n_agents: int
+    mixing_dim: int = 32
+    hyper_cells: int = 64
+
+    @nn.compact
+    def __call__(self, chosen_q, state):
+        # hypernetworks conditioned on the global state produce non-negative
+        # mixing weights -> Q_tot monotone in each agent's Q
+        w1 = jnp.abs(
+            nn.Dense(self.n_agents * self.mixing_dim, name="hyper_w1")(state)
+        ).reshape(state.shape[:-1] + (self.n_agents, self.mixing_dim))
+        b1 = nn.Dense(self.mixing_dim, name="hyper_b1")(state)
+        w2 = jnp.abs(nn.Dense(self.mixing_dim, name="hyper_w2")(state))
+        b2 = nn.Dense(self.hyper_cells, name="hyper_b2_h")(state)
+        b2 = nn.relu(b2)
+        b2 = nn.Dense(1, name="hyper_b2")(b2)
+
+        h = jnp.einsum("...a,...am->...m", chosen_q, w1) + b1
+        h = nn.elu(h)
+        q_tot = jnp.einsum("...m,...m->...", h, w2) + b2[..., 0]
+        return q_tot
+
+
+class QMixer:
+    """QMIX monotonic mixer (reference QMixer:952): mixes per-agent chosen
+    Q-values into Q_tot conditioned on a global state."""
+
+    def __init__(self, n_agents: int, mixing_dim: int = 32):
+        self.n_agents = n_agents
+        self.net = _QMixNet(n_agents, mixing_dim)
+
+    def init(self, key, chosen_q, state):
+        return self.net.init(key, chosen_q, state)["params"]
+
+    def __call__(self, params, chosen_q: jax.Array, state: jax.Array) -> jax.Array:
+        return self.net.apply({"params": params}, chosen_q, state)

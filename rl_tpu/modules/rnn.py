@@ -1,0 +1,208 @@
+"""Recurrent policy modules with per-step episode-reset handling.
+
+Redesign of the reference's RNN stack (reference:
+torchrl/modules/tensordict_module/rnn.py — ``LSTM``:363/``GRU``:1818 with
+python cells :250/:1713 handling per-timestep ``is_init`` resets;
+``recurrent_backend`` ∈ {python, scan, triton} with the fused Triton kernels
+in _rnn_triton.py:2214; ``set_recurrent_mode``:3004).
+
+On TPU the natural form of the Triton fused-reset kernel is a
+``lax.scan`` whose carry is masked by ``is_init`` at each step — XLA fuses
+the gate matmuls and the reset select into one loop body, so no custom
+kernel is needed (SURVEY.md §2.0 "scan is the natural TPU form").
+
+Two execution modes (reference ``set_recurrent_mode``):
+- **sequence mode** (training): input [B, T, F] + ``is_init`` [B, T];
+  the module scans the whole sequence, resetting the carry where flagged.
+- **step mode** (collection): input [B, F] with explicit carried state in
+  the ArrayDict under ("exploration"-style) recurrent keys — handled by
+  :class:`RNNModule`'s ``step_mode=True``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..data import ArrayDict
+
+__all__ = ["LSTMCellCore", "GRUCellCore", "LSTMModule", "GRUModule", "set_recurrent_mode", "recurrent_mode"]
+
+_RECURRENT_MODE = ["sequence"]
+
+
+def recurrent_mode() -> str:
+    return _RECURRENT_MODE[-1]
+
+
+@contextlib.contextmanager
+def set_recurrent_mode(mode: str):
+    """"sequence" (scan whole trajectories — training) or "step" (one step
+    with explicit carry — collection). Reference rnn.py:3004."""
+    if mode not in ("sequence", "step"):
+        raise ValueError("mode must be 'sequence' or 'step'")
+    _RECURRENT_MODE.append(mode)
+    try:
+        yield
+    finally:
+        _RECURRENT_MODE.pop()
+
+
+class LSTMCellCore(nn.Module):
+    """Fused-gate LSTM cell: one [F+H -> 4H] matmul per step (MXU-shaped)."""
+
+    hidden_size: int
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, carry, x):
+        h, c = carry
+        z = nn.Dense(4 * self.hidden_size, dtype=self.dtype, name="gates")(
+            jnp.concatenate([x, h], axis=-1)
+        )
+        i, f, g, o = jnp.split(z, 4, axis=-1)
+        c = jax.nn.sigmoid(f + 1.0) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+        h = jax.nn.sigmoid(o) * jnp.tanh(c)
+        return (h, c), h
+
+
+class GRUCellCore(nn.Module):
+    """Fused-gate GRU cell: [F+H -> 3H] + candidate path."""
+
+    hidden_size: int
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, carry, x):
+        (h,) = carry
+        rz = nn.Dense(2 * self.hidden_size, dtype=self.dtype, name="rz")(
+            jnp.concatenate([x, h], axis=-1)
+        )
+        r, z = jnp.split(rz, 2, axis=-1)
+        r, z = jax.nn.sigmoid(r), jax.nn.sigmoid(z)
+        n = jnp.tanh(
+            nn.Dense(self.hidden_size, dtype=self.dtype, name="cand")(
+                jnp.concatenate([x, r * h], axis=-1)
+            )
+        )
+        h = (1.0 - z) * n + z * h
+        return (h,), h
+
+
+class _RecurrentBase:
+    """Shared machinery: TDModule-style key routing + reset-masked scan."""
+
+    cell_cls: type
+    num_carry: int
+
+    def __init__(
+        self,
+        input_size: int,
+        hidden_size: int,
+        in_key="observation",
+        out_key="embed",
+        is_init_key="is_init",
+        dtype=jnp.float32,
+    ):
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.in_key = in_key if isinstance(in_key, tuple) else (in_key,)
+        self.out_key = out_key if isinstance(out_key, tuple) else (out_key,)
+        self.is_init_key = is_init_key if isinstance(is_init_key, tuple) else (is_init_key,)
+        self.cell = self.cell_cls(hidden_size, dtype)
+        self.in_keys = [self.in_key, self.is_init_key]
+        self.out_keys = [self.out_key]
+
+    # -- params ---------------------------------------------------------------
+
+    def init(self, key: jax.Array, td: ArrayDict) -> Any:
+        x = td[self.in_key]
+        x = x.reshape((-1, x.shape[-1]))[:1]
+        carry = self.zero_carry(1)
+        return self.cell.init(key, carry, x)["params"]
+
+    def zero_carry(self, batch: int):
+        shape = (batch, self.hidden_size)
+        return tuple(jnp.zeros(shape) for _ in range(self.num_carry))
+
+    def _carry_keys(self) -> list[tuple]:
+        # keyed by out_key so stacked instances of the same class don't
+        # collide on carried state
+        tag = f"{type(self).__name__}_{'_'.join(self.out_key)}"
+        return [("recurrent", f"{tag}_c{i}") for i in range(self.num_carry)]
+
+    # -- application ----------------------------------------------------------
+
+    def _mask_carry(self, carry, is_init):
+        flag = is_init.reshape(is_init.shape + (1,))
+        return tuple(jnp.where(flag, 0.0, c) for c in carry)
+
+    def __call__(self, params, td: ArrayDict, key=None) -> ArrayDict:
+        if recurrent_mode() == "step":
+            return self._step(params, td)
+        return self._sequence(params, td)
+
+    def _step(self, params, td: ArrayDict) -> ArrayDict:
+        """One step: carry lives in td under ("recurrent", ...)."""
+        x = td[self.in_key]
+        batch = x.shape[:-1]
+        ckeys = self._carry_keys()
+        if ckeys[0] in td:
+            carry = tuple(td[k] for k in ckeys)
+        else:
+            carry = self.zero_carry(int(jnp.prod(jnp.asarray(batch))) if batch else 1)
+            carry = tuple(c.reshape(batch + (self.hidden_size,)) for c in carry)
+        if self.is_init_key in td:
+            carry = self._mask_carry(carry, td[self.is_init_key])
+        carry, out = self.cell.apply({"params": params}, carry, x)
+        td = td.set(self.out_key, out)
+        for k, c in zip(ckeys, carry):
+            td = td.set(k, c)
+        return td
+
+    def _sequence(self, params, td: ArrayDict) -> ArrayDict:
+        """Scan a [B, T, F] (or [T, F]) sequence with is_init resets."""
+        x = td[self.in_key]
+        squeeze = x.ndim == 2
+        if squeeze:
+            x = x[None]
+        B, T, F = x.shape
+        is_init = (
+            td[self.is_init_key]
+            if self.is_init_key in td
+            else jnp.zeros((B, T), bool)
+        )
+        if squeeze and is_init.ndim == 1:
+            is_init = is_init[None]
+
+        def body(carry, xs):
+            xt, it = xs  # [B, F], [B]
+            carry = self._mask_carry(carry, it)
+            carry, out = self.cell.apply({"params": params}, carry, xt)
+            return carry, out
+
+        carry = self.zero_carry(B)
+        xs = (jnp.moveaxis(x, 1, 0), jnp.moveaxis(is_init, 1, 0))
+        _, outs = jax.lax.scan(body, carry, xs)
+        out = jnp.moveaxis(outs, 0, 1)  # [B, T, H]
+        if squeeze:
+            out = out[0]
+        return td.set(self.out_key, out)
+
+
+class LSTMModule(_RecurrentBase):
+    """LSTM policy trunk (reference LSTM Module, rnn.py:363)."""
+
+    cell_cls = LSTMCellCore
+    num_carry = 2
+
+
+class GRUModule(_RecurrentBase):
+    """GRU policy trunk (reference GRU Module, rnn.py:1818)."""
+
+    cell_cls = GRUCellCore
+    num_carry = 1

@@ -1,0 +1,79 @@
+"""Value normalization: running normalizer + PopArt.
+
+Redesign of the reference's value norms (reference:
+torchrl/modules/value_norm.py — ``ValueNorm``:30, ``PopArtValueNorm``:89,
+``RunningValueNorm``:165). Functional: stats are explicit state threaded
+through the train step; PopArt rescales the final linear head's params so
+the network output stays invariant when the normalizer moves (Hessel et al.
+2016), expressed as a pure param-surgery function.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from ..data import ArrayDict
+
+__all__ = ["ValueNorm", "popart_update"]
+
+
+class ValueNorm:
+    """Running mean/std of value targets; normalize targets, denormalize
+    predictions. ``beta`` is the EMA factor (reference RunningValueNorm)."""
+
+    def __init__(self, beta: float = 0.995, eps: float = 1e-5):
+        self.beta = beta
+        self.eps = eps
+
+    def init(self) -> ArrayDict:
+        return ArrayDict(
+            mu=jnp.asarray(0.0),
+            nu=jnp.asarray(1.0),  # second moment
+            initialized=jnp.asarray(0.0),
+        )
+
+    def update(self, state: ArrayDict, targets: jax.Array) -> ArrayDict:
+        m, v = targets.mean(), (targets**2).mean()
+        # first update adopts the batch stats wholesale
+        beta = jnp.where(state["initialized"] > 0, self.beta, 0.0)
+        return ArrayDict(
+            mu=beta * state["mu"] + (1 - beta) * m,
+            nu=beta * state["nu"] + (1 - beta) * v,
+            initialized=jnp.asarray(1.0),
+        )
+
+    def std(self, state: ArrayDict) -> jax.Array:
+        return jnp.sqrt(jnp.clip(state["nu"] - state["mu"] ** 2, self.eps))
+
+    def normalize(self, state: ArrayDict, x: jax.Array) -> jax.Array:
+        return (x - state["mu"]) / self.std(state)
+
+    def denormalize(self, state: ArrayDict, x: jax.Array) -> jax.Array:
+        return x * self.std(state) + state["mu"]
+
+
+def popart_update(
+    head_params: dict,
+    old_state: ArrayDict,
+    new_state: ArrayDict,
+    norm: ValueNorm,
+    kernel_key: str = "kernel",
+    bias_key: str = "bias",
+) -> dict:
+    """PopArt param surgery (reference PopArtValueNorm:89): after the
+    normalizer moves (old -> new), rescale the value head so that
+    ``denorm_new(head_new(x)) == denorm_old(head_old(x))`` — the network's
+    un-normalized predictions are preserved across the stats update.
+
+    ``head_params`` is the flax param dict of the final Dense layer.
+    """
+    old_std, new_std = norm.std(old_state), norm.std(new_state)
+    old_mu, new_mu = old_state["mu"], new_state["mu"]
+    scale = old_std / new_std
+    out = dict(head_params)
+    out[kernel_key] = head_params[kernel_key] * scale
+    out[bias_key] = (head_params[bias_key] * old_std + old_mu - new_mu) / new_std
+    return out

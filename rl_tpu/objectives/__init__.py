@@ -11,6 +11,7 @@ from .ddpg import DDPGLoss, TD3Loss
 from .dqn import DistributionalDQNLoss, DQNLoss
 from .iql import IQLLoss
 from .redq import REDQLoss
+from .multiagent import IPPOLoss, MAPPOLoss, QMixerLoss
 from .ppo import A2CLoss, ClipPPOLoss, KLPENPPOLoss, PPOLoss, ReinforceLoss
 from .sac import DiscreteSACLoss, SACLoss
 from .value import (
@@ -25,6 +26,9 @@ from .value import (
 )
 
 __all__ = [
+    "QMixerLoss",
+    "MAPPOLoss",
+    "IPPOLoss",
     "LossModule",
     "ActorCriticLossMixin",
     "SoftUpdate",

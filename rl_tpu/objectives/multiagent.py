@@ -1,0 +1,140 @@
+"""Multi-agent losses: QMIX/VDN and MAPPO/IPPO.
+
+Redesigns (reference: torchrl/objectives/multiagent/qmixer.py:34
+``QMixerLoss``; torchrl/objectives/multiagent/mappo.py — ``MAPPOLoss``:83,
+``IPPOLoss``:213).
+
+Batch conventions: agent axis is the last batch axis — per-agent leaves are
+``[..., n_agents, F]`` (actions ``[..., n_agents]``), global leaves (team
+reward, done, central state) are ``[...]``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..data import ArrayDict
+from .common import LossModule, hold_out, masked_mean
+from .ppo import ClipPPOLoss
+
+__all__ = ["QMixerLoss", "MAPPOLoss", "IPPOLoss"]
+
+
+class QMixerLoss(LossModule):
+    """Monotonic joint Q-learning (reference qmixer.py:34): per-agent Q-nets
+    pick per-agent values; a mixer combines them into Q_tot trained on the
+    team reward with a target mixer+nets pair.
+
+    ``qnet``: callable TDModule-style writing "action_value"
+    [..., n_agents, n_actions] from per-agent observations;
+    ``mixer``: VDNMixer/QMixer (state-conditioned for QMix, reading
+    ``state_key``).
+    """
+
+    target_keys = ("target_qvalue", "target_mixer")
+
+    def __init__(
+        self,
+        qnet,
+        mixer,
+        gamma: float = 0.99,
+        state_key: str = "state",
+        double_dqn: bool = True,
+    ):
+        self.qnet = qnet
+        self.mixer = mixer
+        self.gamma = gamma
+        self.state_key = state_key
+        self.double_dqn = double_dqn
+
+    def init_params(self, key, td):
+        k1, k2 = jax.random.split(key)
+        qparams = self.qnet.init(k1, td)
+        q = self.qnet(qparams, td)["action_value"]
+        chosen = q[..., 0]
+        state = td[self.state_key] if self.state_key in td else None
+        mparams = self.mixer.init(k2, chosen, state)
+        return {
+            "qvalue": qparams,
+            "mixer": mparams,
+            "target_qvalue": jax.tree.map(jnp.copy, qparams),
+            "target_mixer": jax.tree.map(jnp.copy, mparams),
+        }
+
+    def _chosen(self, qparams, td, action):
+        q = self.qnet(qparams, td)["action_value"]
+        if action.ndim == q.ndim:  # one-hot per agent
+            return jnp.sum(q * action, axis=-1), q
+        return jnp.take_along_axis(q, action[..., None].astype(jnp.int32), axis=-1)[..., 0], q
+
+    def __call__(self, params, batch: ArrayDict, key=None):
+        state = batch[self.state_key] if self.state_key in batch else None
+        next_state = (
+            batch["next", self.state_key] if ("next", self.state_key) in batch else None
+        )
+
+        chosen, _ = self._chosen(params["qvalue"], batch, batch["action"])
+        q_tot = self.mixer(params["mixer"], chosen, state)
+
+        tq = self.qnet(hold_out(params["target_qvalue"]), batch["next"])["action_value"]
+        if self.double_dqn:
+            oq = self.qnet(hold_out(params["qvalue"]), batch["next"])["action_value"]
+            next_a = jnp.argmax(oq, axis=-1)
+        else:
+            next_a = jnp.argmax(tq, axis=-1)
+        next_chosen = jnp.take_along_axis(tq, next_a[..., None], axis=-1)[..., 0]
+        next_q_tot = self.mixer(hold_out(params["target_mixer"]), next_chosen, next_state)
+
+        reward = batch["next", "reward"]
+        not_term = 1.0 - batch["next", "terminated"].astype(jnp.float32)
+        target = jax.lax.stop_gradient(reward + self.gamma * not_term * next_q_tot)
+        td_error = q_tot - target
+        loss = jnp.mean(td_error**2)
+        return loss, ArrayDict(
+            loss_qmix=loss,
+            td_error=jax.lax.stop_gradient(jnp.abs(td_error)),
+            q_tot_mean=jax.lax.stop_gradient(q_tot.mean()),
+        )
+
+
+class MAPPOLoss(ClipPPOLoss):
+    """Centralized-critic multi-agent PPO (reference mappo.py:83).
+
+    The actor factorizes over agents: the joint log-prob is the SUM of
+    per-agent log-probs (actor.log_prob / dist.log_prob return
+    ``[..., n_agents]`` here); the critic is centralized (scalar value per
+    team state) and the advantage is shared by all agents.
+    """
+
+    def _log_weight(self, params, batch):
+        dist, _ = self.actor.get_dist(params["actor"], batch)
+        per_agent = dist.log_prob(batch["action"])  # [..., n_agents]
+        log_prob = jnp.sum(per_agent, axis=-1)
+        log_weight = log_prob - jax.lax.stop_gradient(
+            jnp.sum(batch["sample_log_prob"], axis=-1)
+            if batch["sample_log_prob"].ndim == per_agent.ndim
+            else batch["sample_log_prob"]
+        )
+        return log_weight, dist, log_prob
+
+    def _entropy(self, dist, log_prob):
+        try:
+            ent = dist.entropy()  # [..., n_agents]
+            # joint entropy of the factorized policy = sum over agents
+            return jnp.sum(ent, axis=-1) if ent.ndim == log_prob.ndim + 1 else ent
+        except NotImplementedError:
+            return -log_prob
+
+
+class IPPOLoss(ClipPPOLoss):
+    """Independent multi-agent PPO (reference mappo.py:213): each agent has
+    its own (decentralized) advantage/critic; the loss averages per-agent
+    clipped objectives. Assumes "advantage" [..., n_agents] and per-agent
+    log-probs."""
+
+    def _log_weight(self, params, batch):
+        dist, _ = self.actor.get_dist(params["actor"], batch)
+        per_agent = dist.log_prob(batch["action"])  # [..., n_agents]
+        log_weight = per_agent - jax.lax.stop_gradient(batch["sample_log_prob"])
+        return log_weight, dist, per_agent

@@ -1,6 +1,7 @@
 from .mocks import (
     ContinuousActionMock,
     CountingEnv,
+    MultiAgentCountingEnv,
     MultiKeyCountingEnv,
     NestedCountingEnv,
 )
@@ -9,5 +10,6 @@ __all__ = [
     "CountingEnv",
     "NestedCountingEnv",
     "MultiKeyCountingEnv",
+    "MultiAgentCountingEnv",
     "ContinuousActionMock",
 ]

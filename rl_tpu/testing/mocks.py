@@ -111,6 +111,60 @@ class MultiKeyCountingEnv(CountingEnv):
         )
 
 
+class MultiAgentCountingEnv(EnvBase):
+    """N-agent team counting env: per-agent observations/actions, team
+    reward = number of agents that chose action 1 (cooperative), shared
+    termination at max_count. Agent axis per the framework convention
+    (last batch axis of per-agent leaves).
+
+    Model for multi-agent losses (reference HeterogeneousCountingEnv-style
+    mocks, torchrl/testing/mocking_classes.py:1787).
+    """
+
+    def __init__(self, n_agents: int = 3, max_count: int = 5):
+        self.n_agents = n_agents
+        self.max_count = max_count
+
+    @property
+    def observation_spec(self) -> Composite:
+        mc = float(self.max_count)
+        return Composite(
+            agents=Composite(
+                observation=Bounded(shape=(self.n_agents, 2), low=0.0, high=mc)
+            ),
+            state=Bounded(shape=(3,), low=0.0, high=mc * self.n_agents),
+        )
+
+    @property
+    def action_spec(self):
+        return Categorical(shape=(self.n_agents,), n=2)
+
+    def _obs(self, count):
+        c = count.astype(jnp.float32)
+        agent_ids = jnp.arange(self.n_agents, dtype=jnp.float32)
+        per_agent = jnp.stack([jnp.full((self.n_agents,), c), agent_ids], axis=-1)
+        return ArrayDict(
+            agents=ArrayDict(observation=per_agent),
+            state=jnp.asarray([c, c * self.n_agents, 0.0]),
+        )
+
+    def _reset(self, key):
+        return ArrayDict(count=jnp.asarray(0, jnp.int32)), self._obs(
+            jnp.asarray(0, jnp.int32)
+        )
+
+    def _step(self, state, action, key):
+        count = state["count"] + 1
+        reward = jnp.sum(action.astype(jnp.float32), axis=-1)
+        return (
+            ArrayDict(count=count),
+            self._obs(count),
+            reward,
+            count >= self.max_count,
+            jnp.asarray(False),
+        )
+
+
 class ContinuousActionMock(EnvBase):
     """Continuous-action mock: obs random-walks by the action, reward = -|obs|.
 

@@ -1,0 +1,158 @@
+"""Multi-agent tests (strategy mirrors reference test/objectives multiagent
+coverage: mixer math, monotonicity, QMIX TD, MAPPO/IPPO learning on the
+cooperative counting mock — BASELINE config #4 path)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from rl_tpu.collectors import Collector
+from rl_tpu.data import ArrayDict
+from rl_tpu.envs import TransformedEnv, VmapEnv, RewardSum, check_env_specs
+from rl_tpu.modules import (
+    Categorical,
+    MultiAgentMLP,
+    QMixer,
+    TDModule,
+    VDNMixer,
+    ValueOperator,
+    MLP,
+    ProbabilisticActor,
+)
+from rl_tpu.objectives import MAPPOLoss, QMixerLoss
+from rl_tpu.testing import MultiAgentCountingEnv
+from rl_tpu.trainers import OnPolicyConfig, OnPolicyProgram
+
+KEY = jax.random.key(0)
+N_AGENTS = 3
+
+
+class TestMockEnv:
+    def test_conformance(self):
+        check_env_specs(MultiAgentCountingEnv(N_AGENTS), KEY)
+        check_env_specs(VmapEnv(MultiAgentCountingEnv(N_AGENTS), 2), KEY)
+
+
+class TestMultiAgentMLP:
+    def test_shared_params_output(self):
+        net = MultiAgentMLP(N_AGENTS, out_features=4, share_params=True)
+        x = jax.random.normal(KEY, (5, N_AGENTS, 2))
+        params = net.init(KEY, x)
+        out = net(params, x)
+        assert out.shape == (5, N_AGENTS, 4)
+        # shared params: same input row -> same output regardless of agent slot
+        same = jnp.broadcast_to(x[:, :1], x.shape)
+        out2 = net(params, same)
+        np.testing.assert_allclose(np.asarray(out2[:, 0]), np.asarray(out2[:, 1]), rtol=1e-6)
+
+    def test_independent_params(self):
+        net = MultiAgentMLP(N_AGENTS, out_features=4, share_params=False)
+        x = jax.random.normal(KEY, (5, N_AGENTS, 2))
+        params = net.init(KEY, x)
+        out = net(params, x)
+        assert out.shape == (5, N_AGENTS, 4)
+        same = jnp.broadcast_to(x[:, :1], x.shape)
+        out2 = net(params, same)
+        assert float(jnp.abs(out2[:, 0] - out2[:, 1]).max()) > 1e-4
+
+    def test_centralized_sees_all(self):
+        net = MultiAgentMLP(N_AGENTS, out_features=2, centralized=True)
+        x = jax.random.normal(KEY, (4, N_AGENTS, 2))
+        params = net.init(KEY, x)
+        out1 = net(params, x)
+        # perturb ONLY agent 2's input; agent 0's output must change
+        x2 = x.at[:, 2].add(1.0)
+        out2 = net(params, x2)
+        assert float(jnp.abs(out2[:, 0] - out1[:, 0]).max()) > 1e-5
+
+
+class TestMixers:
+    def test_vdn_sum(self):
+        mixer = VDNMixer(N_AGENTS)
+        q = jnp.asarray([[1.0, 2.0, 3.0]])
+        np.testing.assert_allclose(np.asarray(mixer({}, q)), [6.0])
+
+    def test_qmix_monotone(self):
+        mixer = QMixer(N_AGENTS)
+        state = jax.random.normal(KEY, (8, 3))
+        q = jax.random.normal(KEY, (8, N_AGENTS))
+        params = mixer.init(KEY, q, state)
+        base = mixer(params, q, state)
+        # increasing any agent's Q must not decrease Q_tot (monotonic mixing)
+        for a in range(N_AGENTS):
+            up = mixer(params, q.at[:, a].add(1.0), state)
+            assert (np.asarray(up) >= np.asarray(base) - 1e-5).all()
+
+
+class TestQMixLoss:
+    def test_loss_and_targets(self):
+        env = MultiAgentCountingEnv(N_AGENTS)
+        manet = MultiAgentMLP(N_AGENTS, out_features=2)
+        qnet = TDModule(
+            lambda obs, params=None: None, [("agents", "observation")], ["action_value"]
+        )
+        # wrap MultiAgentMLP into the TDModule protocol by hand
+        class QNet:
+            in_keys = [("agents", "observation")]
+            out_keys = [("action_value",)]
+
+            def init(self, key, td):
+                return manet.init(key, td["agents", "observation"])
+
+            def __call__(self, params, td, key=None):
+                return td.set("action_value", manet(params, td["agents", "observation"]))
+
+        loss = QMixerLoss(QNet(), QMixer(N_AGENTS), state_key="state")
+        env_b = VmapEnv(env, 4)
+        coll = Collector(env_b, None, frames_per_batch=16)
+        batch, _ = coll.collect({}, coll.init(KEY))
+        flat = batch.flatten_batch()
+        params = loss.init_params(KEY, flat)
+        total, grads, metrics = loss.grad(params, flat)
+        assert np.isfinite(float(total))
+        for name in ("qvalue", "mixer"):
+            gmax = max(float(jnp.abs(g).max()) for g in jax.tree.leaves(grads[name]))
+            assert gmax > 0, f"no grads into {name}"
+        assert "target_mixer" not in grads
+
+
+class TestMAPPO:
+    def test_mappo_learns_cooperation(self):
+        """Team reward = #agents choosing action 1 -> MAPPO should drive all
+        agents to action 1 (analytic optimum = n_agents per step)."""
+        env = TransformedEnv(VmapEnv(MultiAgentCountingEnv(N_AGENTS, max_count=8), 8), RewardSum())
+        manet = MultiAgentMLP(N_AGENTS, out_features=2)
+
+        class ActorNet:
+            in_keys = [("agents", "observation")]
+            out_keys = [("logits",)]
+
+            def init(self, key, td):
+                return manet.init(key, td["agents", "observation"])
+
+            def __call__(self, params, td, key=None):
+                return td.set("logits", manet(params, td["agents", "observation"]))
+
+        actor = ProbabilisticActor(ActorNet(), Categorical, dist_keys=("logits",))
+        critic = ValueOperator(MLP(out_features=1), in_keys=["state"])
+        loss = MAPPOLoss(actor, critic, normalize_advantage=True, entropy_coeff=0.01)
+        loss.make_value_estimator(gamma=0.9)
+
+        def policy(p, td, k):
+            out = actor(p["actor"], td, k)
+            return out
+
+        coll = Collector(env, policy, frames_per_batch=256)
+        program = OnPolicyProgram(
+            coll, loss, OnPolicyConfig(num_epochs=4, minibatch_size=128, learning_rate=3e-3)
+        )
+        ts = program.init(KEY)
+        step = jax.jit(program.train_step)
+        rewards = []
+        for _ in range(25):
+            ts, m = step(ts)
+            rewards.append(float(m["reward_mean"]))
+        early, late = np.mean(rewards[:5]), np.mean(rewards[-5:])
+        assert late > early + 0.5, f"MAPPO failed to learn: {early:.2f} -> {late:.2f}"
+        assert late > 0.8 * N_AGENTS  # near the analytic optimum
