@@ -1,0 +1,162 @@
+"""Offline dataset loaders -> replay buffers.
+
+Redesign of the reference's dataset layer (reference: torchrl/data/datasets/
+common.py base + d4rl.py/minari_data.py/atari_dqn.py etc.: each downloads
+and memmaps episodes into a TensorStorage-backed ReplayBuffer). The image
+has no network egress, so downloads are out of scope; what ships is the
+schema + ingestion path the loaders share:
+
+- :func:`dataset_from_arrays`: transitions dict -> (Memmap|Device)Storage
+  ReplayBuffer with ImmutableDatasetWriter, reward-to-go and
+  timestep annotations for DT-style training.
+- :class:`MinariDataset` / :class:`D4RLDataset`: thin import-gated adapters
+  mapping those libraries' episode dicts onto ``dataset_from_arrays``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from .arraydict import ArrayDict
+from .replay import (
+    DeviceStorage,
+    ImmutableDatasetWriter,
+    MemmapStorage,
+    RandomSampler,
+    ReplayBuffer,
+    RoundRobinWriter,
+)
+
+__all__ = ["dataset_from_arrays", "MinariDataset", "D4RLDataset"]
+
+
+def dataset_from_arrays(
+    observations: np.ndarray,
+    actions: np.ndarray,
+    rewards: np.ndarray,
+    terminations: np.ndarray,
+    truncations: np.ndarray | None = None,
+    next_observations: np.ndarray | None = None,
+    device: bool = True,
+    scratch_dir: str | None = None,
+    sampler=None,
+    batch_size: int | None = 256,
+) -> tuple[ReplayBuffer, ArrayDict]:
+    """Build an immutable offline buffer from transition arrays.
+
+    Returns ``(buffer, state)``. The stored layout matches the collector's
+    ({obs, action, "next": {...}}), plus "reward_to_go" and "timesteps"
+    (undiscounted returns within episodes; DT consumables).
+    """
+    n = len(observations)
+    truncations = (
+        np.zeros(n, bool) if truncations is None else np.asarray(truncations, bool)
+    )
+    terminations = np.asarray(terminations, bool)
+    done = terminations | truncations
+    if next_observations is None:
+        # within an episode, next obs is the following row; at cuts reuse obs
+        next_observations = np.concatenate([observations[1:], observations[-1:]])
+        next_observations = np.where(
+            done[:, None] if next_observations.ndim == 2 else done.reshape((-1,) + (1,) * (next_observations.ndim - 1)),
+            observations,
+            next_observations,
+        )
+
+    # reward-to-go + timesteps per episode (vectorized segmented pass)
+    rewards = np.asarray(rewards, np.float32)
+    ends = np.flatnonzero(done)
+    if ends.size == 0 or ends[-1] != n - 1:
+        ends = np.append(ends, n - 1)
+    # suffix sums overall; rtg_i = suffix[i] - suffix after the episode end
+    suffix = np.cumsum(rewards[::-1])[::-1]
+    boundary_of = ends[np.searchsorted(ends, np.arange(n), side="left")]
+    after = np.where(
+        boundary_of + 1 < n, np.append(suffix, 0.0)[boundary_of + 1], 0.0
+    )
+    rtg = (suffix - after).astype(np.float32)
+    starts = np.concatenate([[0], ends[:-1] + 1])
+    lengths = ends - starts + 1
+    ts = (np.arange(n) - np.repeat(starts, lengths)).astype(np.int32)
+
+    items = ArrayDict(
+        observation=jnp.asarray(observations),
+        action=jnp.asarray(actions),
+        returns_to_go=jnp.asarray(rtg)[:, None],
+        timesteps=jnp.asarray(ts),
+        next=ArrayDict(
+            observation=jnp.asarray(next_observations),
+            reward=jnp.asarray(rewards, jnp.float32),
+            terminated=jnp.asarray(terminations),
+            truncated=jnp.asarray(truncations),
+            done=jnp.asarray(done),
+        ),
+    )
+    storage = (
+        DeviceStorage(n) if device else MemmapStorage(n, scratch_dir=scratch_dir)
+    )
+    # writes go through a RoundRobinWriter once, then the buffer is sealed
+    rb = ReplayBuffer(storage, sampler or RandomSampler(), RoundRobinWriter(), batch_size=batch_size)
+    state = rb.init(items[0])
+    state = rb.extend(state, items)
+    rb.writer = ImmutableDatasetWriter()
+    return rb, state
+
+
+class MinariDataset:
+    """minari adapter (import-gated; reference minari_data.py)."""
+
+    def __init__(self, dataset_id: str, **kw):
+        try:
+            import minari
+        except ImportError as e:  # pragma: no cover
+            raise ImportError("MinariDataset requires the minari package") from e
+        ds = minari.load_dataset(dataset_id)
+        obs, next_obs, act, rew, term, trunc = [], [], [], [], [], []
+        for ep in ds.iterate_episodes():
+            T = len(ep.rewards)
+            # minari stores T+1 observations: rows 1..T are the TRUE
+            # successors (incl. the final post-truncation obs)
+            obs.append(ep.observations[:T])
+            next_obs.append(ep.observations[1 : T + 1])
+            act.append(ep.actions[:T])
+            rew.append(ep.rewards)
+            t = np.zeros(T, bool)
+            t[-1] = bool(ep.terminations[-1])
+            term.append(t)
+            tr = np.zeros(T, bool)
+            tr[-1] = bool(ep.truncations[-1])
+            trunc.append(tr)
+        self.buffer, self.state = dataset_from_arrays(
+            np.concatenate(obs),
+            np.concatenate(act),
+            np.concatenate(rew),
+            np.concatenate(term),
+            np.concatenate(trunc),
+            next_observations=np.concatenate(next_obs),
+            **kw,
+        )
+
+
+class D4RLDataset:
+    """d4rl adapter (import-gated; reference d4rl.py)."""
+
+    def __init__(self, env_id: str, **kw):
+        try:
+            import d4rl  # noqa: F401
+            import gym as d4rl_gym
+        except ImportError as e:  # pragma: no cover
+            raise ImportError("D4RLDataset requires d4rl + legacy gym") from e
+        env = d4rl_gym.make(env_id)
+        data = env.get_dataset()
+        self.buffer, self.state = dataset_from_arrays(
+            data["observations"],
+            data["actions"],
+            data["rewards"],
+            data["terminals"],
+            data.get("timeouts"),
+            next_observations=data.get("next_observations"),
+            **kw,
+        )

@@ -1,6 +1,7 @@
 from .base import EnvBase, EnvState, VmapEnv, rollout, step_mdp, where_done
 from .classic.cartpole import CartPoleEnv
 from .classic.pendulum import PendulumEnv
+from .model_based import ModelBasedEnv
 from .transforms.base import Compose, Transform, TransformedEnv
 from .transforms.image import CenterCrop, GrayScale, Resize, ToFloatImage
 from .transforms.vecnorm import VecNorm
@@ -24,6 +25,7 @@ from .transforms.common import (
 from .utils import ExplorationType, check_env_specs, exploration_type, set_exploration_type
 
 __all__ = [
+    "ModelBasedEnv",
     "VecNorm",
     "ToFloatImage",
     "GrayScale",

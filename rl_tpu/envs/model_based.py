@@ -1,0 +1,88 @@
+"""Model-based environments: envs whose dynamics are a learned model.
+
+Redesign of the reference's model-based layer (reference:
+torchrl/envs/model_based/common.py ``ModelBasedEnvBase``, dreamer.py
+``DreamerEnv``): a :class:`ModelBasedEnv` wraps a world-model TDModule whose
+forward maps (state latents + action) -> (next latents, reward,
+terminated). Because it is a pure EnvBase, everything composes: planners
+shoot through it, collectors roll imagination trajectories, check_env_specs
+validates it.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+from ..data import ArrayDict, Composite, Spec
+from .base import EnvBase
+
+__all__ = ["ModelBasedEnv"]
+
+
+class ModelBasedEnv(EnvBase):
+    """EnvBase over a learned transition model.
+
+    Args:
+        world_model: ``(params, td_with_action_and_state, key) -> td`` writing
+            next-state keys + "reward" (+ optional "terminated").
+        params: model params (captured; swap with ``replace_params``).
+        observation_spec/action_spec: the imagined MDP's contract.
+        prior_fn: ``key -> ArrayDict`` sampling initial model state
+            (e.g. encoder output on real obs, or a learned prior).
+    """
+
+    def __init__(
+        self,
+        world_model: Callable,
+        params: Any,
+        observation_spec: Composite,
+        action_spec: Spec,
+        prior_fn: Callable[[jax.Array], ArrayDict],
+        max_episode_steps: int = 100,
+    ):
+        self.world_model = world_model
+        self.params = params
+        self._obs_spec = observation_spec
+        self._action_spec = action_spec
+        self.prior_fn = prior_fn
+        self.max_episode_steps = max_episode_steps
+
+    def replace_params(self, params) -> "ModelBasedEnv":
+        import copy
+
+        out = copy.copy(self)
+        out.params = params
+        return out
+
+    @property
+    def observation_spec(self) -> Composite:
+        return self._obs_spec
+
+    @property
+    def action_spec(self) -> Spec:
+        return self._action_spec
+
+    def _reset(self, key):
+        latents = self.prior_fn(key)
+        obs = latents.select(*[k for k in self._obs_spec.keys() if k in latents])
+        state = latents.set("step_count", jnp.asarray(0, jnp.int32))
+        return state, obs
+
+    def _step(self, state, action, key):
+        td = state.exclude("step_count").set("action", action)
+        out = self.world_model(self.params, td, key)
+        count = state["step_count"] + 1
+        next_state = out.select(
+            *[k for k in state.keys() if k != "step_count" and k in out]
+        ).set("step_count", count)
+        obs = out.select(*[k for k in self._obs_spec.keys() if k in out])
+        reward = out["reward"]
+        reward = reward[..., 0] if reward.ndim and reward.shape[-1] == 1 else reward
+        terminated = (
+            out["terminated"] if "terminated" in out else jnp.zeros_like(reward, bool)
+        )
+        truncated = count >= self.max_episode_steps
+        return next_state, obs, reward, terminated, truncated

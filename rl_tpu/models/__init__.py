@@ -1,11 +1,20 @@
+from .decision_transformer import DecisionTransformer, DTConfig, DTLoss
 from .generate import GenerateOutput, generate, token_log_probs
+from .rssm import RSSM, DreamerModelLoss, RSSMConfig, dreamer_lambda_returns
 from .transformer import TransformerConfig, TransformerLM, param_sharding_rules
 
 __all__ = [
+    "DecisionTransformer",
+    "DTConfig",
+    "DTLoss",
     "TransformerConfig",
     "TransformerLM",
     "param_sharding_rules",
     "generate",
     "token_log_probs",
     "GenerateOutput",
+    "RSSM",
+    "RSSMConfig",
+    "DreamerModelLoss",
+    "dreamer_lambda_returns",
 ]

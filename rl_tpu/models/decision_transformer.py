@@ -1,0 +1,114 @@
+"""Decision Transformer: return-conditioned sequence policy.
+
+Redesign of the reference's DT stack (reference:
+torchrl/modules/models/decision_transformer.py; actors.py:1507,1609 DT
+actors; objectives/decision_transformer.py:21 ``DTLoss``, :285
+``OnlineDTLoss``): a compact causal transformer over interleaved
+(return-to-go, state, action) token triples predicting the next action.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..data import ArrayDict
+from ..objectives.common import LossModule
+
+__all__ = ["DTConfig", "DecisionTransformer", "DTLoss"]
+
+
+@dataclasses.dataclass(frozen=True)
+class DTConfig:
+    state_dim: int = 4
+    action_dim: int = 2
+    d_model: int = 64
+    n_layers: int = 2
+    n_heads: int = 4
+    max_ep_len: int = 1000
+    context_len: int = 20
+
+
+class _Block(nn.Module):
+    cfg: DTConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        T = x.shape[1]
+        h = nn.LayerNorm()(x)
+        h = nn.SelfAttention(num_heads=cfg.n_heads, qkv_features=cfg.d_model)(
+            h, mask=jnp.tril(jnp.ones((T, T), bool))
+        )
+        x = x + h
+        y = nn.LayerNorm()(x)
+        y = nn.Dense(4 * cfg.d_model)(y)
+        y = nn.gelu(y)
+        y = nn.Dense(cfg.d_model)(y)
+        return x + y
+
+
+class DecisionTransformer(nn.Module):
+    """(returns_to_go [B,T,1], states [B,T,S], actions [B,T,A], timesteps
+    [B,T]) -> predicted actions [B,T,A] (tanh-bounded)."""
+
+    cfg: DTConfig
+
+    @nn.compact
+    def __call__(self, returns_to_go, states, actions, timesteps):
+        cfg = self.cfg
+        B, T = timesteps.shape
+        time_emb = nn.Embed(cfg.max_ep_len, cfg.d_model, name="time")(timesteps)
+        r_tok = nn.Dense(cfg.d_model, name="emb_r")(returns_to_go) + time_emb
+        s_tok = nn.Dense(cfg.d_model, name="emb_s")(states) + time_emb
+        a_tok = nn.Dense(cfg.d_model, name="emb_a")(actions) + time_emb
+        # interleave (R_t, s_t, a_t): [B, 3T, D]
+        x = jnp.stack([r_tok, s_tok, a_tok], axis=2).reshape(B, 3 * T, cfg.d_model)
+        x = nn.LayerNorm(name="ln_in")(x)
+        for i in range(cfg.n_layers):
+            x = _Block(cfg, name=f"h{i}")(x)
+        x = nn.LayerNorm(name="ln_f")(x)
+        # predict a_t from the state token at position (3t + 1)
+        s_positions = x[:, 1::3]
+        return jnp.tanh(nn.Dense(cfg.action_dim, name="head")(s_positions))
+
+
+class DTLoss(LossModule):
+    """Offline DT loss (reference decision_transformer.py:21): MSE between
+    predicted and dataset actions over valid steps."""
+
+    def __init__(self, cfg: DTConfig):
+        self.cfg = cfg
+        self.model = DecisionTransformer(cfg)
+
+    def init_params(self, key, batch: ArrayDict) -> dict:
+        return {
+            "model": self.model.init(
+                key,
+                batch["returns_to_go"],
+                batch["observation"],
+                batch["action"],
+                batch["timesteps"],
+            )["params"]
+        }
+
+    def predict(self, params, batch: ArrayDict) -> jax.Array:
+        return self.model.apply(
+            {"params": params["model"]},
+            batch["returns_to_go"],
+            batch["observation"],
+            batch["action"],
+            batch["timesteps"],
+        )
+
+    def __call__(self, params, batch: ArrayDict, key=None):
+        pred = self.predict(params, batch)
+        err = (pred - batch["action"]) ** 2
+        if "mask" in batch:
+            m = batch["mask"][..., None].astype(err.dtype)
+            loss = jnp.sum(err * m) / jnp.clip(jnp.sum(m) * err.shape[-1], 1.0)
+        else:
+            loss = jnp.mean(err)
+        return loss, ArrayDict(loss_dt=loss)

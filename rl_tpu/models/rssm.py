@@ -1,0 +1,235 @@
+"""RSSM world model + Dreamer-style losses.
+
+Redesign of the reference's Dreamer stack (reference:
+torchrl/modules/models/model_based.py — RSSM prior/posterior/rollout
+modules; torchrl/objectives/dreamer.py:28 ``DreamerModelLoss``, :211
+``DreamerActorLoss``, :373 ``DreamerValueLoss``).
+
+The RSSM (Hafner et al.): deterministic GRU core ``h_t = f(h_{t-1},
+z_{t-1}, a_{t-1})``, stochastic latent ``z_t`` with a prior ``p(z|h)`` and a
+posterior ``q(z|h, embed(o))``; heads decode observation, reward, and
+continue-flag from (h, z). Sequence training is one ``lax.scan``
+(observe); imagination is another (imagine) — both pure, both jitted.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..data import ArrayDict
+
+__all__ = ["RSSMConfig", "RSSM", "DreamerModelLoss", "dreamer_lambda_returns"]
+
+
+@dataclasses.dataclass(frozen=True)
+class RSSMConfig:
+    obs_dim: int = 8  # vector observations (pixels go through a ConvNet encoder)
+    action_dim: int = 2
+    deter_dim: int = 64
+    stoch_dim: int = 8
+    hidden: int = 64
+    free_nats: float = 1.0
+    kl_scale: float = 1.0
+
+
+class _RSSMCore(nn.Module):
+    cfg: RSSMConfig
+
+    def setup(self):
+        c = self.cfg
+        self.encoder = nn.Dense(c.hidden, name="enc")
+        self.gru_in = nn.Dense(c.hidden, name="gru_in")
+        self.gru = nn.GRUCell(features=c.deter_dim, name="gru")
+        self.prior_net = nn.Dense(2 * c.stoch_dim, name="prior")
+        self.post_net = nn.Dense(2 * c.stoch_dim, name="post")
+        self.decoder = nn.Sequential(
+            [nn.Dense(c.hidden), nn.relu, nn.Dense(c.obs_dim)], name="dec"
+        )
+        self.reward_head = nn.Sequential(
+            [nn.Dense(c.hidden), nn.relu, nn.Dense(1)], name="rew"
+        )
+        self.continue_head = nn.Sequential(
+            [nn.Dense(c.hidden), nn.relu, nn.Dense(1)], name="cont"
+        )
+
+    # -- pieces ---------------------------------------------------------------
+
+    def _stats(self, raw):
+        mean, std_raw = jnp.split(raw, 2, axis=-1)
+        return mean, jax.nn.softplus(std_raw) + 0.1
+
+    def step_prior(self, h, z, a):
+        """(h, z, a) -> (h', prior mean/std)."""
+        x = nn.relu(self.gru_in(jnp.concatenate([z, a], axis=-1)))
+        h, _ = self.gru(h, x)
+        mean, std = self._stats(self.prior_net(h))
+        return h, mean, std
+
+    def posterior(self, h, obs):
+        e = nn.relu(self.encoder(obs))
+        mean, std = self._stats(self.post_net(jnp.concatenate([h, e], axis=-1)))
+        return mean, std
+
+    def decode(self, h, z):
+        feat = jnp.concatenate([h, z], axis=-1)
+        return self.decoder(feat), self.reward_head(feat)[..., 0], self.continue_head(feat)[..., 0]
+
+    # -- programs -------------------------------------------------------------
+
+    def observe(self, obs_seq, action_seq, is_first, key):
+        """Teacher-forced filtering over [B, T, ...]; returns posteriors,
+        priors, features and reconstructions."""
+        B, T, _ = obs_seq.shape
+        c = self.cfg
+
+        def body(carry, xs):
+            h, z, key = carry
+            obs, act, first = xs
+            mask = (1.0 - first.astype(jnp.float32))[:, None]
+            h, z = h * mask, z * mask
+            act = act * mask
+            h, pmean, pstd = self.step_prior(h, z, act)
+            qmean, qstd = self.posterior(h, obs)
+            key, k = jax.random.split(key)
+            z = qmean + qstd * jax.random.normal(k, qmean.shape)
+            return (h, z, key), (h, z, pmean, pstd, qmean, qstd)
+
+        h0 = jnp.zeros((B, c.deter_dim))
+        z0 = jnp.zeros((B, c.stoch_dim))
+        xs = (
+            jnp.moveaxis(obs_seq, 1, 0),
+            jnp.moveaxis(action_seq, 1, 0),
+            jnp.moveaxis(is_first, 1, 0),
+        )
+        _, (h, z, pm, ps, qm, qs) = jax.lax.scan(body, (h0, z0, key), xs)
+        to_bt = lambda x: jnp.moveaxis(x, 0, 1)  # noqa: E731
+        h, z = to_bt(h), to_bt(z)
+        recon, reward, cont = self.decode(h, z)
+        return {
+            "h": h,
+            "z": z,
+            "prior": (to_bt(pm), to_bt(ps)),
+            "post": (to_bt(qm), to_bt(qs)),
+            "recon": recon,
+            "reward": reward,
+            "continue_logit": cont,
+        }
+
+    def imagine_step(self, h, z, a, key):
+        h, mean, std = self.step_prior(h, z, a)
+        z = mean + std * jax.random.normal(key, mean.shape)
+        recon, reward, cont = self.decode(h, z)
+        return h, z, recon, reward, cont
+
+    def __call__(self, obs_seq, action_seq, is_first, key):
+        # init path: touch every submodule once OUTSIDE lax.scan (flax cannot
+        # create params inside a scanned body); apply() uses observe/imagine
+        c = self.cfg
+        B = obs_seq.shape[0]
+        h = jnp.zeros((B, c.deter_dim))
+        z = jnp.zeros((B, c.stoch_dim))
+        h, pm, ps = self.step_prior(h, z, action_seq[:, 0])
+        qm, qs = self.posterior(h, obs_seq[:, 0])
+        return self.decode(h, qm)
+
+
+class RSSM:
+    """Functional wrapper: init/observe/imagine over the flax core."""
+
+    def __init__(self, cfg: RSSMConfig):
+        self.cfg = cfg
+        self.core = _RSSMCore(cfg)
+
+    def init(self, key: jax.Array) -> Any:
+        obs = jnp.zeros((1, 2, self.cfg.obs_dim))
+        act = jnp.zeros((1, 2, self.cfg.action_dim))
+        first = jnp.zeros((1, 2), bool)
+        return self.core.init(key, obs, act, first, key)["params"]
+
+    def observe(self, params, obs_seq, action_seq, is_first, key):
+        return self.core.apply(
+            {"params": params}, obs_seq, action_seq, is_first, key, method=_RSSMCore.observe
+        )
+
+    def imagine_step(self, params, h, z, a, key):
+        return self.core.apply(
+            {"params": params}, h, z, a, key, method=_RSSMCore.imagine_step
+        )
+
+    def world_model_fn(self):
+        """(params, td{h,z,action}, key) -> td — the ModelBasedEnv adapter."""
+
+        def fn(params, td: ArrayDict, key):
+            h, z, recon, reward, cont = self.imagine_step(
+                params, td["h"], td["z"], td["action"], key
+            )
+            return ArrayDict(
+                h=h,
+                z=z,
+                observation=recon,
+                reward=reward,
+                terminated=jax.nn.sigmoid(cont) < 0.5,
+            )
+
+        return fn
+
+
+def _kl_diag_gauss(m1, s1, m2, s2):
+    return jnp.sum(
+        jnp.log(s2 / s1) + (s1**2 + (m1 - m2) ** 2) / (2 * s2**2) - 0.5, axis=-1
+    )
+
+
+class DreamerModelLoss:
+    """World-model loss (reference dreamer.py:28): reconstruction NLL +
+    reward NLL + continue BCE + free-nats-clipped KL(posterior ‖ prior)."""
+
+    def __init__(self, rssm: RSSM):
+        self.rssm = rssm
+
+    def __call__(self, params, batch: ArrayDict, key):
+        out = self.rssm.observe(
+            params,
+            batch["observation"],
+            batch["action"],
+            batch["is_first"],
+            key,
+        )
+        cfg = self.rssm.cfg
+        recon_loss = jnp.mean((out["recon"] - batch["observation"]) ** 2)
+        reward_loss = jnp.mean((out["reward"] - batch["reward"]) ** 2)
+        cont_target = 1.0 - batch["terminated"].astype(jnp.float32)
+        cont_loss = jnp.mean(
+            jnp.maximum(out["continue_logit"], 0)
+            - out["continue_logit"] * cont_target
+            + jnp.log1p(jnp.exp(-jnp.abs(out["continue_logit"])))
+        )
+        pm, ps = out["prior"]
+        qm, qs = out["post"]
+        kl = jnp.maximum(jnp.mean(_kl_diag_gauss(qm, qs, pm, ps)), cfg.free_nats)
+        total = recon_loss + reward_loss + cont_loss + cfg.kl_scale * kl
+        return total, ArrayDict(
+            loss_model=total,
+            loss_recon=recon_loss,
+            loss_reward=reward_loss,
+            loss_continue=cont_loss,
+            kl=jax.lax.stop_gradient(kl),
+        )
+
+
+def dreamer_lambda_returns(reward, value, discount, lmbda: float = 0.95):
+    """λ-returns over imagined trajectories (reference DreamerActorLoss
+    machinery): time-major [H, ...], bootstrap from ``value``."""
+    from ..ops.value import linear_recurrence_reverse
+
+    next_value = jnp.concatenate([value[1:], value[-1:]], axis=0)
+    a = discount * lmbda
+    b = reward + discount * (1.0 - lmbda) * next_value
+    b = b.at[-1].set(reward[-1] + discount[-1] * next_value[-1])
+    a = a.at[-1].set(0.0)
+    return linear_recurrence_reverse(a, b)

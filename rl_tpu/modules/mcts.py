@@ -1,0 +1,125 @@
+"""MCTS scores and a vectorized tree store.
+
+Redesigns of the reference MCTS pieces (reference: torchrl/modules/mcts/
+scores.py — ``PUCTScore``:34, ``UCBScore``:150; torchrl/data/map/tree.py:30
+``Tree``/``MCTSForest`` hash-indexed branch storage).
+
+The tree store is array-based (fixed capacity, int32 parent/child tables)
+instead of the reference's hash-keyed TensorDict map — jit-compatible so
+selection/backup run as XLA loops.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..data import ArrayDict
+
+__all__ = ["puct_score", "ucb_score", "MCTSTree"]
+
+
+def puct_score(q, prior, visits, parent_visits, c_puct: float = 1.0):
+    """PUCT (AlphaZero; reference PUCTScore:34):
+    ``Q + c * P * sqrt(N_parent) / (1 + N)``."""
+    return q + c_puct * prior * jnp.sqrt(parent_visits) / (1.0 + visits)
+
+
+def ucb_score(q, visits, parent_visits, c: float = math.sqrt(2.0)):
+    """UCB1 (reference UCBScore:150): unvisited children get +inf."""
+    explore = c * jnp.sqrt(jnp.log(jnp.maximum(parent_visits, 1.0)) / jnp.maximum(visits, 1e-8))
+    return jnp.where(visits > 0, q + explore, jnp.inf)
+
+
+class MCTSTree:
+    """Fixed-capacity array tree: select (PUCT) / expand / backup, all
+    functional over an ArrayDict state."""
+
+    def __init__(self, capacity: int, num_actions: int, c_puct: float = 1.0):
+        self.capacity = capacity
+        self.num_actions = num_actions
+        self.c_puct = c_puct
+
+    def init(self, root_prior: jax.Array) -> ArrayDict:
+        C, A = self.capacity, self.num_actions
+        return ArrayDict(
+            children=jnp.full((C, A), -1, jnp.int32),
+            parent=jnp.full((C,), -1, jnp.int32),
+            parent_action=jnp.full((C,), -1, jnp.int32),
+            visits=jnp.zeros((C,), jnp.float32),
+            value_sum=jnp.zeros((C,), jnp.float32),
+            prior=jnp.zeros((C, A), jnp.float32).at[0].set(root_prior),
+            size=jnp.asarray(1, jnp.int32),
+        )
+
+    def q_values(self, t: ArrayDict, node: jax.Array) -> jax.Array:
+        kids = t["children"][node]
+        v = jnp.where(kids >= 0, t["value_sum"][kids], 0.0)
+        n = jnp.where(kids >= 0, t["visits"][kids], 0.0)
+        return jnp.where(n > 0, v / jnp.maximum(n, 1.0), 0.0), n
+
+    def select_child(self, t: ArrayDict, node: jax.Array) -> jax.Array:
+        q, n = self.q_values(t, node)
+        scores = puct_score(q, t["prior"][node], n, t["visits"][node], self.c_puct)
+        return jnp.argmax(scores)
+
+    def select_path(self, t: ArrayDict) -> tuple[jax.Array, jax.Array]:
+        """Walk PUCT-greedy to the deepest expanded node; returns
+        (leaf, action-to-expand)."""
+
+        def cond(carry):
+            _, _, cont = carry
+            return cont
+
+        def body(carry):
+            node, _, _ = carry
+            a = self.select_child(t, node)
+            child = t["children"][node, a]
+            nxt = jnp.where(child >= 0, child, node)
+            return nxt, a, child >= 0
+
+        leaf, _, _ = jax.lax.while_loop(
+            cond, body, (jnp.asarray(0), jnp.asarray(0), jnp.asarray(True))
+        )
+        return leaf, self.select_child(t, leaf)
+
+    def expand(self, t: ArrayDict, parent: jax.Array, action: jax.Array, prior: jax.Array) -> tuple[ArrayDict, jax.Array]:
+        """Add a child under (parent, action). When the tree is FULL the
+        expansion is dropped and ``parent`` is returned as the node to back
+        up from — never a self-referential link (which would spin the
+        select/backup while_loops forever)."""
+        new = t["size"]
+        can = new < self.capacity
+        slot = jnp.minimum(new, self.capacity - 1)
+        t2 = t.replace(
+            children=t["children"].at[parent, action].set(slot),
+            parent=t["parent"].at[slot].set(parent),
+            parent_action=t["parent_action"].at[slot].set(action),
+            prior=t["prior"].at[slot].set(prior),
+            size=new + 1,
+        )
+        t = jax.tree.map(lambda a, b: jnp.where(can, a, b), t2, t)
+        return t, jnp.where(can, slot, parent)
+
+    def backup(self, t: ArrayDict, node: jax.Array, value: jax.Array, gamma: float = 1.0) -> ArrayDict:
+        def cond(carry):
+            t, node, v = carry
+            return node >= 0
+
+        def body(carry):
+            t, node, v = carry
+            t = t.replace(
+                visits=t["visits"].at[node].add(1.0),
+                value_sum=t["value_sum"].at[node].add(v),
+            )
+            return t, t["parent"][node], v * gamma
+
+        t, _, _ = jax.lax.while_loop(cond, body, (t, node, value))
+        return t
+
+    def root_visit_probs(self, t: ArrayDict) -> jax.Array:
+        kids = t["children"][0]
+        n = jnp.where(kids >= 0, t["visits"][kids], 0.0)
+        return n / jnp.clip(n.sum(), 1.0)

@@ -9,6 +9,7 @@ from .common import (
 from .cql import CQLLoss, DiscreteCQLLoss
 from .ddpg import DDPGLoss, TD3Loss
 from .dqn import DistributionalDQNLoss, DQNLoss
+from .imitation import BCLoss, GAILLoss, RNDModule
 from .iql import IQLLoss
 from .redq import REDQLoss
 from .multiagent import IPPOLoss, MAPPOLoss, QMixerLoss
@@ -26,6 +27,9 @@ from .value import (
 )
 
 __all__ = [
+    "BCLoss",
+    "GAILLoss",
+    "RNDModule",
     "QMixerLoss",
     "MAPPOLoss",
     "IPPOLoss",

@@ -1,0 +1,136 @@
+"""Imitation and intrinsic-motivation losses: BC, GAIL, RND.
+
+Redesigns (reference: torchrl/objectives/bc.py:23 ``BCLoss``; gail.py:19
+``GAILLoss``; rnd.py:20 ``RNDLoss``).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from ..data import ArrayDict
+from ..modules.networks import MLP
+from .common import LossModule, masked_mean
+
+__all__ = ["BCLoss", "GAILLoss", "RNDModule"]
+
+
+class BCLoss(LossModule):
+    """Behavioral cloning (reference bc.py:23): maximize log π(a_data|s) for
+    probabilistic actors, or MSE for deterministic ones."""
+
+    def __init__(self, actor, loss_function: str = "log_prob", mask_key=None):
+        self.actor = actor
+        self.loss_function = loss_function
+        self.mask_key = mask_key
+
+    def init_params(self, key, td):
+        return {"actor": self.actor.init(key, td)}
+
+    def __call__(self, params, batch: ArrayDict, key=None):
+        mask = batch[self.mask_key] if self.mask_key and self.mask_key in batch else None
+        if self.loss_function == "mse":
+            pred = self.actor(params["actor"], batch)["action"] if not hasattr(self.actor, "get_dist") else self.actor.get_dist(params["actor"], batch)[0].mode
+            loss = masked_mean((pred - batch["action"]) ** 2, mask)
+        else:
+            lp = self.actor.log_prob(params["actor"], batch)
+            loss = -masked_mean(lp, mask)
+        return loss, ArrayDict(loss_bc=loss)
+
+
+class GAILLoss(LossModule):
+    """Adversarial imitation (reference gail.py:19): discriminator classifies
+    expert vs policy (s, a); with optional gradient penalty. The policy's
+    reward signal is ``discriminator_reward`` (plug into any RL loss).
+    """
+
+    def __init__(
+        self,
+        discriminator: Any | None = None,
+        gp_coeff: float = 0.0,
+    ):
+        self.disc = discriminator or MLP(out_features=1, num_cells=(64, 64), activation="tanh")
+        self.gp_coeff = gp_coeff
+
+    def init_params(self, key, td):
+        x = jnp.concatenate([td["observation"], td["action"]], axis=-1)
+        return {"discriminator": self.disc.init(key, x)["params"]}
+
+    def _logit(self, params, obs, action):
+        x = jnp.concatenate([obs, action], axis=-1)
+        return self.disc.apply({"params": params["discriminator"]}, x)[..., 0]
+
+    def __call__(self, params, batch: ArrayDict, key=None):
+        """``batch`` holds policy data at the root and expert data under
+        "expert" ({observation, action})."""
+        pol_logit = self._logit(params, batch["observation"], batch["action"])
+        exp_logit = self._logit(params, batch["expert", "observation"], batch["expert", "action"])
+        # expert -> 1, policy -> 0 (BCE with logits)
+        loss_exp = jnp.mean(jax.nn.softplus(-exp_logit))
+        loss_pol = jnp.mean(jax.nn.softplus(pol_logit))
+        total = loss_exp + loss_pol
+
+        metrics = ArrayDict(
+            loss_discriminator=total,
+            expert_acc=jax.lax.stop_gradient((exp_logit > 0).mean()),
+            policy_acc=jax.lax.stop_gradient((pol_logit < 0).mean()),
+        )
+        if self.gp_coeff and key is not None:
+            eps = jax.random.uniform(key, (batch["observation"].shape[0], 1))
+            mix_obs = eps * batch["expert", "observation"] + (1 - eps) * batch["observation"]
+            mix_act = eps * batch["expert", "action"] + (1 - eps) * batch["action"]
+
+            def d(o, a):
+                return self._logit(params, o[None], a[None])[0]
+
+            g = jax.vmap(jax.grad(d, argnums=(0, 1)))(mix_obs, mix_act)
+            gnorm = jnp.sqrt(
+                jnp.sum(g[0] ** 2, axis=-1) + jnp.sum(g[1] ** 2, axis=-1) + 1e-12
+            )
+            gp = jnp.mean((gnorm - 1.0) ** 2)
+            total = total + self.gp_coeff * gp
+            metrics = metrics.set("gradient_penalty", gp)
+        return total, metrics
+
+    def reward(self, params, obs, action) -> jax.Array:
+        """Imitation reward for the policy: -log(1 - D) form (stable)."""
+        logit = self._logit(params, obs, action)
+        return jax.lax.stop_gradient(jax.nn.softplus(logit))
+
+
+class RNDModule(LossModule):
+    """Random network distillation (reference rnd.py:20): a frozen random
+    target embeds observations; a predictor regresses it; the per-sample
+    error is the intrinsic reward (novelty)."""
+
+    def __init__(self, feature_dim: int = 64, num_cells=(64, 64), reward_scale: float = 1.0):
+        self.target = MLP(out_features=feature_dim, num_cells=num_cells, activation="relu")
+        self.predictor = MLP(out_features=feature_dim, num_cells=num_cells, activation="relu")
+        self.reward_scale = reward_scale
+
+    target_keys = ("target_rnd",)  # frozen — never optimized, never polyak'd
+
+    def init_params(self, key, td):
+        k1, k2 = jax.random.split(key)
+        return {
+            "predictor": self.predictor.init(k1, td["observation"])["params"],
+            "target_rnd": self.target.init(k2, td["observation"])["params"],
+        }
+
+    def intrinsic_reward(self, params, obs) -> jax.Array:
+        tgt = self.target.apply({"params": params["target_rnd"]}, obs)
+        pred = self.predictor.apply({"params": params["predictor"]}, obs)
+        return jax.lax.stop_gradient(
+            self.reward_scale * jnp.mean((pred - tgt) ** 2, axis=-1)
+        )
+
+    def __call__(self, params, batch: ArrayDict, key=None):
+        tgt = jax.lax.stop_gradient(
+            self.target.apply({"params": params["target_rnd"]}, batch["observation"])
+        )
+        pred = self.predictor.apply({"params": params["predictor"]}, batch["observation"])
+        loss = jnp.mean((pred - tgt) ** 2)
+        return loss, ArrayDict(loss_rnd=loss)
