@@ -1,0 +1,24 @@
+"""Double-DQN + n-step + PER on CartPole (reference analog:
+sota-implementations/dqn/)."""
+
+from rl_tpu.envs import CartPoleEnv, RewardSum, TransformedEnv, VmapEnv
+from rl_tpu.record import CSVLogger
+from rl_tpu.trainers import OffPolicyConfig
+from rl_tpu.trainers.algorithms import make_dqn_trainer
+
+
+def main():
+    env = TransformedEnv(VmapEnv(CartPoleEnv(), 16), RewardSum())
+    trainer = make_dqn_trainer(
+        env,
+        total_steps=300,
+        frames_per_batch=512,
+        config=OffPolicyConfig(batch_size=256, utd_ratio=4, learning_rate=1e-3, tau=0.01,
+                               init_random_frames=2000),
+        logger=CSVLogger("dqn_cartpole"),
+    )
+    trainer.train(0)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,70 @@
+"""GRPO RLHF loop on a tiny native transformer with a rule-based reward
+(reference analog: sota-implementations/grpo/grpo-sync.py, engine-free)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from rl_tpu.collectors import LLMCollector
+from rl_tpu.data.llm import History
+from rl_tpu.envs.llm import DatasetChatEnv
+from rl_tpu.models import TransformerConfig, TransformerLM, token_log_probs
+from rl_tpu.objectives.llm import GRPOLoss
+from rl_tpu.weight_update import SharedProgramScheme
+
+
+class ByteTokenizer:
+    def encode(self, s):
+        return [ord(c) % 120 + 1 for c in s]
+
+    def decode(self, ids):
+        return "".join(chr(i) for i in ids)
+
+
+def main():
+    cfg = TransformerConfig(vocab_size=128, d_model=128, n_layers=4, n_heads=8,
+                            d_ff=256, max_seq_len=128, dtype=jnp.float32)
+    model = TransformerLM(cfg)
+    params = model.init(jax.random.key(0), jnp.zeros((1, 4), jnp.int32))["params"]
+
+    prompts = History.from_chats(
+        [[{"role": "user", "content": c}] for c in ["count", "list", "sing"]]
+    )
+    env = DatasetChatEnv(
+        prompts,
+        ByteTokenizer(),
+        reward_fn=lambda h, t: float((np.asarray(t) % 2 == 0).mean()) if len(t) else 0.0,
+        group_repeats=8,
+        max_prompt_len=16,
+    )
+    scheme = SharedProgramScheme()
+    scheme.push(params)
+    coll = LLMCollector(env, model, num_prompts=4, max_new_tokens=16,
+                        weight_scheme=scheme, ref_params=params)
+    loss = GRPOLoss(
+        lambda p, b: token_log_probs(model, p, b["tokens"], b["attention_mask"]),
+        kl_coeff=0.02,
+    )
+    opt = optax.adam(1e-3)
+    opt_state = opt.init(params)
+
+    @jax.jit
+    def update(params, opt_state, batch):
+        (v, m), g = jax.value_and_grad(lambda p: loss(p, batch), has_aux=True)(params)
+        upd, opt_state = opt.update(g, opt_state)
+        return optax.apply_updates(params, upd), opt_state, m
+
+    key = jax.random.key(1)
+    for i in range(60):
+        key, k = jax.random.split(key)
+        batch = coll.collect(params, k)
+        params, opt_state, m = update(params, opt_state, batch)
+        scheme.push(params)
+        if i % 10 == 0:
+            print(f"step {i} reward {float(batch['reward'].mean()):.3f} "
+                  f"kl {float(m['kl_approx']):.4f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,24 @@
+"""PPO on vectorized CartPole — the minimal end-to-end recipe
+(reference analog: sota-implementations/ppo/). Run: python examples/ppo_cartpole.py"""
+
+from rl_tpu.envs import CartPoleEnv, RewardSum, TransformedEnv, VmapEnv
+from rl_tpu.record import CSVLogger
+from rl_tpu.trainers import OnPolicyConfig
+from rl_tpu.trainers.algorithms import make_ppo_trainer
+
+
+def main():
+    env = TransformedEnv(VmapEnv(CartPoleEnv(), 32), RewardSum())
+    trainer = make_ppo_trainer(
+        env,
+        total_steps=50,
+        frames_per_batch=2048,
+        config=OnPolicyConfig(num_epochs=4, minibatch_size=512, learning_rate=3e-4),
+        logger=CSVLogger("ppo_cartpole"),
+        log_interval=5,
+    )
+    trainer.train(seed_or_key := 0)
+
+
+if __name__ == "__main__":
+    main()

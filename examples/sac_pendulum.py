@@ -1,0 +1,24 @@
+"""SAC on Pendulum with device-resident replay (reference analog:
+sota-implementations/sac/)."""
+
+from rl_tpu.envs import PendulumEnv, RewardSum, TransformedEnv, VmapEnv
+from rl_tpu.record import CSVLogger
+from rl_tpu.trainers import OffPolicyConfig
+from rl_tpu.trainers.algorithms import make_sac_trainer
+
+
+def main():
+    env = TransformedEnv(VmapEnv(PendulumEnv(), 16), RewardSum())
+    trainer = make_sac_trainer(
+        env,
+        total_steps=200,
+        frames_per_batch=1024,
+        buffer_capacity=200_000,
+        config=OffPolicyConfig(batch_size=256, utd_ratio=4, init_random_frames=4096),
+        logger=CSVLogger("sac_pendulum"),
+    )
+    trainer.train(0)
+
+
+if __name__ == "__main__":
+    main()

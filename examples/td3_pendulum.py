@@ -1,0 +1,25 @@
+"""TD3 (twin critics, target smoothing, delayed policy) on Pendulum
+(reference analog: sota-implementations/td3/)."""
+
+from rl_tpu.envs import PendulumEnv, RewardSum, TransformedEnv, VmapEnv
+from rl_tpu.record import CSVLogger
+from rl_tpu.trainers import OffPolicyConfig
+from rl_tpu.trainers.algorithms import make_td3_trainer
+
+
+def main():
+    env = TransformedEnv(VmapEnv(PendulumEnv(), 16), RewardSum())
+    trainer = make_td3_trainer(
+        env,
+        total_steps=200,
+        frames_per_batch=1024,
+        config=OffPolicyConfig(
+            batch_size=256, utd_ratio=4, init_random_frames=4096, policy_delay=2
+        ),
+        logger=CSVLogger("td3_pendulum"),
+    )
+    trainer.train(0)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,320 @@
+"""Communication backbone: backend atoms, rendezvous, control-plane channels.
+
+Redesign of the reference's comm layer (reference: torchrl/_comm/ —
+backend atoms backends.py:13-34 with contextvar scoping :191,221;
+``Mailbox`` mailbox.py:185; ``CommandChannel`` command.py:42; ``Rendezvous``
+protocols rendezvous.py:17,30,51,79).
+
+On TPU the DATA plane is in-program XLA collectives over the mesh
+(SURVEY.md §2.2: psum/all_gather/ppermute replace NCCL point-to-point) —
+there is no tensor transport to build. What remains host-side is the
+CONTROL plane: how peers find each other (Rendezvous → wraps
+``jax.distributed.initialize``'s coordinator) and how commands/results move
+between host processes/threads (Mailbox/CommandChannel over queues or TCP).
+The backend-atom naming is kept verbatim — it is the one piece of the
+reference worth copying as a design.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import enum
+import json
+import queue
+import socket
+import socketserver
+import threading
+from typing import Any, Callable, Mapping
+
+__all__ = [
+    "ServiceBackend",
+    "TransportBackend",
+    "service_backend",
+    "transport_backend",
+    "current_service_backend",
+    "current_transport_backend",
+    "Rendezvous",
+    "MappingRendezvous",
+    "EnvVarRendezvous",
+    "JaxDistributedRendezvous",
+    "Mailbox",
+    "CommandChannel",
+    "TCPCommandServer",
+    "TCPCommandClient",
+]
+
+
+class ServiceBackend(enum.Enum):
+    """WHERE code runs (reference backends.py:13)."""
+
+    DIRECT = "direct"
+    THREAD = "thread"
+    PROCESS = "process"
+    JAX_COLLECTIVE = "jax_collective"  # in-mesh, data plane handled by XLA
+    RAY = "ray"  # import-gated
+
+
+class TransportBackend(enum.Enum):
+    """HOW bytes move (reference backends.py:21)."""
+
+    AUTO = "auto"
+    DIRECT = "direct"
+    QUEUE = "queue"
+    TCP = "tcp"
+    DEVICE = "device"  # jax.device_put / collectives
+    RAY = "ray"
+
+
+_SERVICE = contextvars.ContextVar("rl_tpu_service_backend", default=ServiceBackend.DIRECT)
+_TRANSPORT = contextvars.ContextVar("rl_tpu_transport_backend", default=TransportBackend.AUTO)
+
+
+@contextlib.contextmanager
+def service_backend(backend: ServiceBackend | str):
+    """Scope the default service backend (reference backends.py:191)."""
+    token = _SERVICE.set(ServiceBackend(backend) if isinstance(backend, str) else backend)
+    try:
+        yield
+    finally:
+        _SERVICE.reset(token)
+
+
+@contextlib.contextmanager
+def transport_backend(backend: TransportBackend | str):
+    token = _TRANSPORT.set(TransportBackend(backend) if isinstance(backend, str) else backend)
+    try:
+        yield
+    finally:
+        _TRANSPORT.reset(token)
+
+
+def current_service_backend() -> ServiceBackend:
+    return _SERVICE.get()
+
+
+def current_transport_backend() -> TransportBackend:
+    return _TRANSPORT.get()
+
+
+# -- rendezvous ---------------------------------------------------------------
+
+
+class Rendezvous:
+    """How peers discover each other (reference rendezvous.py:17)."""
+
+    def addresses(self) -> Mapping[str, str]:
+        raise NotImplementedError
+
+    def my_rank(self) -> int:
+        raise NotImplementedError
+
+    def world_size(self) -> int:
+        raise NotImplementedError
+
+
+class MappingRendezvous(Rendezvous):
+    """Static peer map (reference MappingRendezvous:30)."""
+
+    def __init__(self, peers: Mapping[str, str], rank: int = 0):
+        self._peers = dict(peers)
+        self._rank = rank
+
+    def addresses(self):
+        return dict(self._peers)
+
+    def my_rank(self):
+        return self._rank
+
+    def world_size(self):
+        return len(self._peers)
+
+
+class EnvVarRendezvous(Rendezvous):
+    """From the standard cluster env vars (COORDINATOR_ADDRESS,
+    PROCESS_ID/NUM_PROCESSES — what TPU pod launchers export)."""
+
+    def __init__(self, prefix: str = ""):
+        import os
+
+        self.coordinator = os.environ.get(prefix + "COORDINATOR_ADDRESS", "localhost:0")
+        self._rank = int(os.environ.get(prefix + "PROCESS_ID", 0))
+        self._world = int(os.environ.get(prefix + "NUM_PROCESSES", 1))
+
+    def addresses(self):
+        return {"coordinator": self.coordinator}
+
+    def my_rank(self):
+        return self._rank
+
+    def world_size(self):
+        return self._world
+
+
+class JaxDistributedRendezvous(Rendezvous):
+    """Bind the rendezvous to ``jax.distributed.initialize`` — the TPU-native
+    coordinator (maps 1:1 onto the reference's TCPStoreRendezvous:51)."""
+
+    def __init__(
+        self,
+        coordinator_address: str | None = None,
+        num_processes: int | None = None,
+        process_id: int | None = None,
+    ):
+        import jax
+
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+        import jax as _j
+
+        self._rank = _j.process_index()
+        self._world = _j.process_count()
+        self.coordinator = coordinator_address or "jax-coordinator"
+
+    def addresses(self):
+        return {"coordinator": self.coordinator}
+
+    def my_rank(self):
+        return self._rank
+
+    def world_size(self):
+        return self._world
+
+
+# -- mailbox / command channel ------------------------------------------------
+
+
+class Mailbox:
+    """Async message channel between threads (reference mailbox.py:185):
+    named queues with blocking receive and futures-free semantics."""
+
+    def __init__(self):
+        self._queues: dict[str, queue.Queue] = {}
+        self._lock = threading.Lock()
+
+    def _q(self, name: str) -> queue.Queue:
+        with self._lock:
+            if name not in self._queues:
+                self._queues[name] = queue.Queue()
+            return self._queues[name]
+
+    def send(self, to: str, message: Any) -> None:
+        self._q(to).put(message)
+
+    def receive(self, name: str, timeout: float | None = None) -> Any:
+        return self._q(name).get(timeout=timeout)
+
+    def try_receive(self, name: str) -> Any | None:
+        try:
+            return self._q(name).get_nowait()
+        except queue.Empty:
+            return None
+
+
+class CommandChannel:
+    """Control-plane RPC between a driver and named workers (reference
+    command.py:42): register handlers, send commands, await replies."""
+
+    def __init__(self, mailbox: Mailbox | None = None):
+        self.mailbox = mailbox or Mailbox()
+        self._handlers: dict[str, Callable[[Any], Any]] = {}
+        self._seq = 0
+
+    def register_handler(self, command: str, fn: Callable[[Any], Any]) -> None:
+        self._handlers[command] = fn
+
+    def serve_once(self, worker: str, timeout: float | None = None) -> bool:
+        """Process one pending command addressed to ``worker``; False if none
+        arrived within ``timeout``."""
+        try:
+            msg = self.mailbox.receive(f"cmd:{worker}", timeout=timeout)
+        except queue.Empty:
+            return False
+        cmd, payload, reply_to = msg
+        if cmd not in self._handlers:
+            self.mailbox.send(reply_to, ("error", f"unknown command {cmd!r}"))
+            return True
+        try:
+            out = self._handlers[cmd](payload)
+            self.mailbox.send(reply_to, ("ok", out))
+        except Exception as e:  # noqa: BLE001 - control plane reports, not crashes
+            self.mailbox.send(reply_to, ("error", repr(e)))
+        return True
+
+    def call(self, worker: str, command: str, payload: Any = None, timeout: float | None = 10.0) -> Any:
+        self._seq += 1
+        reply_to = f"reply:{worker}:{self._seq}"
+        self.mailbox.send(f"cmd:{worker}", (command, payload, reply_to))
+        try:
+            status, out = self.mailbox.receive(reply_to, timeout=timeout)
+        except queue.Empty:
+            raise TimeoutError(
+                f"no reply from worker {worker!r} to {command!r} within {timeout}s"
+            ) from None
+        if status != "ok":
+            raise RuntimeError(f"command {command!r} on {worker!r} failed: {out}")
+        return out
+
+
+class _JSONHandler(socketserver.StreamRequestHandler):
+    def handle(self):
+        line = self.rfile.readline()
+        if not line:
+            return
+        try:
+            req = json.loads(line)
+            fn = self.server._handlers.get(req.get("command"))  # type: ignore[attr-defined]
+            if fn is None:
+                resp = {"status": "error", "out": f"unknown command {req.get('command')!r}"}
+            else:
+                resp = {"status": "ok", "out": fn(req.get("payload"))}
+        except Exception as e:  # noqa: BLE001
+            resp = {"status": "error", "out": repr(e)}
+        self.wfile.write((json.dumps(resp) + "\n").encode())
+
+
+class TCPCommandServer:
+    """Cross-process command endpoint (line-delimited JSON over TCP) — the
+    DCN control plane for multi-host orchestration."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._server = socketserver.ThreadingTCPServer((host, port), _JSONHandler)
+        self._server._handlers = {}  # type: ignore[attr-defined]
+        self._thread = threading.Thread(target=self._server.serve_forever, daemon=True)
+
+    @property
+    def address(self) -> tuple[str, int]:
+        return self._server.server_address[:2]
+
+    def register_handler(self, command: str, fn: Callable[[Any], Any]) -> None:
+        self._server._handlers[command] = fn  # type: ignore[attr-defined]
+
+    def start(self) -> "TCPCommandServer":
+        self._thread.start()
+        return self
+
+    def shutdown(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+
+class TCPCommandClient:
+    def __init__(self, host: str, port: int, timeout: float = 10.0):
+        self.host, self.port, self.timeout = host, port, timeout
+
+    def call(self, command: str, payload: Any = None) -> Any:
+        with socket.create_connection((self.host, self.port), timeout=self.timeout) as s:
+            s.sendall((json.dumps({"command": command, "payload": payload}) + "\n").encode())
+            data = b""
+            while not data.endswith(b"\n"):
+                chunk = s.recv(65536)
+                if not chunk:
+                    break
+                data += chunk
+        resp = json.loads(data)
+        if resp["status"] != "ok":
+            raise RuntimeError(f"remote command {command!r} failed: {resp['out']}")
+        return resp["out"]

@@ -47,7 +47,7 @@ def dataset_from_arrays(
     """Build an immutable offline buffer from transition arrays.
 
     Returns ``(buffer, state)``. The stored layout matches the collector's
-    ({obs, action, "next": {...}}), plus "reward_to_go" and "timesteps"
+    ({obs, action, "next": {...}}), plus "returns_to_go" and "timesteps"
     (undiscounted returns within episodes; DT consumables).
     """
     n = len(observations)

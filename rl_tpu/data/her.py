@@ -1,0 +1,103 @@
+"""Hindsight experience replay: goal relabeling.
+
+Redesign of the reference's HER (reference:
+torchrl/data/replay_buffers/her.py:463 — relabeling via a sampler wrapper).
+Here relabeling is a pure jit-safe function over time-major batches,
+usable as a collector postproc or a buffer transform: the "future" strategy
+samples an achieved goal from a later step of the SAME episode and
+recomputes the reward.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+from .arraydict import ArrayDict
+
+__all__ = ["her_relabel", "HERRelabeler"]
+
+
+def her_relabel(
+    batch: ArrayDict,
+    key: jax.Array,
+    reward_fn: Callable[[jax.Array, jax.Array], jax.Array],
+    achieved_key=("next", "achieved_goal"),
+    desired_key="desired_goal",
+    relabel_prob: float = 0.8,
+) -> ArrayDict:
+    """Future-strategy HER over a time-major [T, …] batch.
+
+    For each step t (with probability ``relabel_prob``): draw u ∈ [t, T)
+    within the same episode, set desired_goal := achieved_goal[u], and
+    recompute ``reward = reward_fn(achieved[t], new_desired)``. Episode
+    boundaries come from ("next","done").
+    """
+    T = batch.batch_shape[0]
+    done = batch["next", "done"]
+    achieved = batch[achieved_key]
+    desired = batch[desired_key]
+
+    k_u, k_p = jax.random.split(key)
+    shape = done.shape
+    t_full = jnp.broadcast_to(
+        jnp.arange(T).reshape((T,) + (1,) * (len(shape) - 1)), shape
+    )
+    # last index of each step's episode: reverse scan carrying the nearest
+    # done-at-or-after-t (T-1 for the trailing partial episode) — so the
+    # draw below is exactly uniform over the episode's remaining steps
+    def body(carry, xs):
+        d, t = xs
+        end = jnp.where(d, t, carry)
+        return end, end
+
+    _, ep_end = jax.lax.scan(
+        body,
+        jnp.full(shape[1:], T - 1),
+        (done, t_full),
+        reverse=True,
+    )
+    u = jax.random.randint(k_u, shape, t_full, ep_end + 1)
+
+    gathered = jnp.take_along_axis(
+        achieved, u.reshape(u.shape + (1,) * (achieved.ndim - u.ndim)), axis=0
+    )
+    relabel = jax.random.bernoulli(k_p, relabel_prob, shape)
+    rmask = relabel.reshape(relabel.shape + (1,) * (gathered.ndim - relabel.ndim))
+    new_desired = jnp.where(rmask, gathered, desired)
+    new_reward = reward_fn(achieved, new_desired)
+    new_reward = jnp.where(relabel, new_reward, batch["next", "reward"])
+
+    out = batch.set(desired_key, new_desired)
+    out = out.set(("next", "reward"), new_reward)
+    if isinstance(desired_key, str) and ("next", desired_key) in out:
+        out = out.set(("next", desired_key), new_desired)
+    return out
+
+
+class HERRelabeler:
+    """Collector-postproc / buffer-transform form of :func:`her_relabel`.
+
+    The postproc signature has no key argument, and python-side key state
+    would be baked at trace time — so the relabel key is derived in-graph by
+    folding batch-varying content (trajectory ids) into a base key.
+    """
+
+    def __init__(self, reward_fn, relabel_prob: float = 0.8, seed: int = 0, **keys):
+        self.reward_fn = reward_fn
+        self.relabel_prob = relabel_prob
+        self.keys = keys
+        self._base = jax.random.key(seed)
+
+    def __call__(self, batch: ArrayDict) -> ArrayDict:
+        salt = (
+            jnp.sum(batch["collector", "traj_ids"]).astype(jnp.uint32)
+            if ("collector", "traj_ids") in batch
+            else jnp.asarray(0, jnp.uint32)
+        )
+        k = jax.random.fold_in(self._base, salt)
+        return her_relabel(
+            batch, k, self.reward_fn, relabel_prob=self.relabel_prob, **self.keys
+        )

@@ -74,7 +74,6 @@ class GAILLoss(LossModule):
         total = loss_exp + loss_pol
 
         metrics = ArrayDict(
-            loss_discriminator=total,
             expert_acc=jax.lax.stop_gradient((exp_logit > 0).mean()),
             policy_acc=jax.lax.stop_gradient((pol_logit < 0).mean()),
         )
@@ -93,6 +92,8 @@ class GAILLoss(LossModule):
             gp = jnp.mean((gnorm - 1.0) ** 2)
             total = total + self.gp_coeff * gp
             metrics = metrics.set("gradient_penalty", gp)
+        # logged loss matches the optimized objective (incl. penalty)
+        metrics = metrics.set("loss_discriminator", total)
         return total, metrics
 
     def reward(self, params, obs, action) -> jax.Array:

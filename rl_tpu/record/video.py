@@ -1,0 +1,84 @@
+"""Video recording of policy rollouts.
+
+Redesign of the reference's recorder stack (reference:
+torchrl/record/recorder.py:43 ``VideoRecorder`` (a transform buffering pixel
+observations into the logger) and torchrl/render/ ``render_policy`` + CLI).
+Here rollouts are arrays already, so recording is a pure function over a
+rollout batch plus host-side encoding (cv2, import-gated).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import numpy as np
+
+import jax
+
+from ..data import ArrayDict
+from ..envs.base import EnvBase, rollout
+
+__all__ = ["frames_from_rollout", "record_video", "write_mp4", "render_policy"]
+
+
+def frames_from_rollout(steps: ArrayDict, pixel_key="pixels") -> np.ndarray:
+    """Extract [T, H, W, C] uint8 frames from a rollout batch (batched envs:
+    first sub-env)."""
+    px = np.asarray(steps["next", pixel_key] if ("next", pixel_key) in steps else steps[pixel_key])
+    while px.ndim > 4:  # [T, B, H, W, C] -> first env
+        px = px[:, 0]
+    if px.dtype != np.uint8:
+        px = (np.clip(px, 0.0, 1.0) * 255).astype(np.uint8)
+    if px.shape[-1] == 1:
+        px = np.repeat(px, 3, axis=-1)
+    return px
+
+
+def record_video(
+    env: EnvBase,
+    policy: Callable | None,
+    key: jax.Array,
+    max_steps: int = 500,
+    pixel_key: str = "pixels",
+) -> np.ndarray:
+    """Roll the env and return frames (the VideoRecorder transform's job,
+    done functionally)."""
+    steps = rollout(env, key, policy, max_steps=max_steps)
+    return frames_from_rollout(steps, pixel_key)
+
+
+def write_mp4(frames: np.ndarray, path: str, fps: int = 30) -> str:
+    """Encode [T, H, W, C] uint8 frames to mp4 (cv2, import-gated)."""
+    try:
+        import cv2
+    except ImportError as e:  # pragma: no cover
+        raise ImportError("write_mp4 requires opencv (cv2)") from e
+    T, H, W, _ = frames.shape
+    writer = cv2.VideoWriter(path, cv2.VideoWriter_fourcc(*"mp4v"), fps, (W, H))
+    try:
+        for t in range(T):
+            writer.write(cv2.cvtColor(frames[t], cv2.COLOR_RGB2BGR))
+    finally:
+        writer.release()
+    return path
+
+
+def render_policy(
+    env: EnvBase,
+    policy: Callable | None,
+    key: jax.Array | int = 0,
+    max_steps: int = 500,
+    out_path: str | None = None,
+    logger: Any | None = None,
+    pixel_key: str = "pixels",
+    fps: int = 30,
+) -> np.ndarray:
+    """Offline visualization entry (reference render/cli.py ``render_policy``):
+    rollout -> frames -> mp4 and/or logger video."""
+    key = jax.random.key(key) if isinstance(key, int) else key
+    frames = record_video(env, policy, key, max_steps=max_steps, pixel_key=pixel_key)
+    if out_path is not None:
+        write_mp4(frames, out_path, fps=fps)
+    if logger is not None:
+        logger.log_video("render/rollout", frames, fps=fps)
+    return frames

@@ -1,0 +1,246 @@
+"""Per-algorithm trainer builders: one call from specs to a ready Trainer.
+
+Redesign of the reference's algorithm trainers (reference:
+torchrl/trainers/algorithms/ — ``PPOTrainer`` ppo.py:11, ``SACTrainer``
+sac.py:37, ``DQNTrainer``, ``TD3Trainer`` td3.py:29 …, each assembling
+env+collector+buffer+loss+hooks from hydra configs). Here each builder
+assembles the fused Program + hook-driven Trainer from plain arguments
+(or config dicts via rl_tpu.config.instantiate).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..collectors import Collector
+from ..data import (
+    DeviceStorage,
+    MultiStep,
+    PrioritizedSampler,
+    RandomSampler,
+    ReplayBuffer,
+)
+from ..envs.base import EnvBase
+from ..modules import (
+    MLP,
+    Categorical,
+    ConcatMLP,
+    EGreedyModule,
+    NormalParamExtractor,
+    ProbabilisticActor,
+    TanhNormal,
+    TanhPolicy,
+    TDModule,
+    TDSequential,
+    ValueOperator,
+)
+from ..objectives import ClipPPOLoss, DQNLoss, SACLoss, TD3Loss
+from ..record.loggers import Logger
+from .off_policy import OffPolicyConfig, OffPolicyProgram
+from .on_policy import OnPolicyConfig, OnPolicyProgram
+from .trainer import CountFramesLog, LogScalar, Trainer
+
+__all__ = [
+    "make_ppo_trainer",
+    "make_sac_trainer",
+    "make_dqn_trainer",
+    "make_td3_trainer",
+    "default_continuous_actor",
+    "default_discrete_actor",
+]
+
+
+def _action_dims(env: EnvBase) -> int:
+    spec = env.action_spec
+    return int(jnp.prod(jnp.asarray(spec.shape))) if spec.shape else 1
+
+
+def default_continuous_actor(env: EnvBase, num_cells=(256, 256)) -> ProbabilisticActor:
+    act_dim = _action_dims(env)
+    spec = env.action_spec
+    net = TDSequential(
+        TDModule(MLP(out_features=2 * act_dim, num_cells=num_cells), ["observation"], ["raw"]),
+        TDModule(NormalParamExtractor(), ["raw"], ["loc", "scale"]),
+    )
+    low = float(jnp.min(jnp.asarray(getattr(spec, "low", -1.0))))
+    high = float(jnp.max(jnp.asarray(getattr(spec, "high", 1.0))))
+    return ProbabilisticActor(net, TanhNormal, dist_kwargs={"low": low, "high": high})
+
+
+def default_discrete_actor(env: EnvBase, num_cells=(256, 256)) -> ProbabilisticActor:
+    n = env.action_spec.n
+    return ProbabilisticActor(
+        TDModule(MLP(out_features=n, num_cells=num_cells), ["observation"], ["logits"]),
+        Categorical,
+        dist_keys=("logits",),
+    )
+
+
+def _std_hooks(trainer: Trainer, log_interval: int) -> Trainer:
+    trainer.register_op("post_step", LogScalar(interval=log_interval))
+    trainer.register_op("post_step", CountFramesLog(interval=log_interval))
+    return trainer
+
+
+def make_ppo_trainer(
+    env: EnvBase,
+    total_steps: int,
+    actor: ProbabilisticActor | None = None,
+    critic: ValueOperator | None = None,
+    frames_per_batch: int = 2048,
+    config: OnPolicyConfig | None = None,
+    gamma: float = 0.99,
+    lmbda: float = 0.95,
+    logger: Logger | None = None,
+    log_interval: int = 10,
+    **loss_kwargs,
+) -> Trainer:
+    """PPO on any (vmapped) EnvBase (reference PPOTrainer, algorithms/ppo.py:11)."""
+    from ..data.specs import Categorical as CatSpec
+
+    discrete = isinstance(env.action_spec, CatSpec)
+    actor = actor or (default_discrete_actor(env) if discrete else default_continuous_actor(env))
+    critic = critic or ValueOperator(MLP(out_features=1, num_cells=(256, 256)))
+    loss = ClipPPOLoss(actor, critic, normalize_advantage=True, **loss_kwargs)
+    loss.make_value_estimator(gamma=gamma, lmbda=lmbda)
+    coll = Collector(env, lambda p, td, k: actor(p["actor"], td, k), frames_per_batch=frames_per_batch)
+    if config is None:
+        config = OnPolicyConfig(minibatch_size=min(256, frames_per_batch))
+    program = OnPolicyProgram(coll, loss, config)
+    return _std_hooks(Trainer(program, total_steps, logger=logger), log_interval)
+
+
+def make_sac_trainer(
+    env: EnvBase,
+    total_steps: int,
+    actor: ProbabilisticActor | None = None,
+    buffer_capacity: int = 1_000_000,
+    frames_per_batch: int = 1024,
+    config: OffPolicyConfig | None = None,
+    prioritized: bool = False,
+    n_step: int | None = None,
+    gamma: float = 0.99,
+    logger: Logger | None = None,
+    log_interval: int = 10,
+    **loss_kwargs,
+) -> Trainer:
+    """SAC with device replay (reference SACTrainer, algorithms/sac.py:37)."""
+    actor = actor or default_continuous_actor(env)
+    loss = SACLoss(actor, ConcatMLP(out_features=1, num_cells=(256, 256)), gamma=gamma, **loss_kwargs)
+    postproc = MultiStep(gamma=gamma, n_steps=n_step) if n_step else None
+    coll = Collector(
+        env,
+        lambda p, td, k: actor(p["actor"], td, k),
+        frames_per_batch=frames_per_batch,
+        postproc=postproc,
+    )
+    sampler = PrioritizedSampler() if prioritized else RandomSampler()
+    buffer = ReplayBuffer(DeviceStorage(buffer_capacity), sampler)
+    program = OffPolicyProgram(
+        coll,
+        loss,
+        buffer,
+        config or OffPolicyConfig(init_random_frames=5000),
+        priority_key="td_error" if prioritized else None,
+    )
+    return _std_hooks(Trainer(program, total_steps, logger=logger), log_interval)
+
+
+def make_dqn_trainer(
+    env: EnvBase,
+    total_steps: int,
+    qnet: TDModule | None = None,
+    buffer_capacity: int = 1_000_000,
+    frames_per_batch: int = 512,
+    config: OffPolicyConfig | None = None,
+    prioritized: bool = True,
+    n_step: int | None = 3,
+    gamma: float = 0.99,
+    eps_init: float = 1.0,
+    eps_end: float = 0.05,
+    annealing_num_steps: int = 100_000,
+    logger: Logger | None = None,
+    log_interval: int = 10,
+    **loss_kwargs,
+) -> Trainer:
+    """(Double/n-step/PER) DQN (reference DQNTrainer)."""
+    n = env.action_spec.n
+    qnet = qnet or TDModule(MLP(out_features=n, num_cells=(256, 256)), ["observation"], ["action_value"])
+    loss = DQNLoss(qnet, gamma=gamma, **loss_kwargs)
+    eg = EGreedyModule(env.action_spec, eps_init, eps_end, annealing_num_steps)
+
+    def policy(params, td, key):
+        q = qnet(params["qvalue"], td)["action_value"]
+        td = td.set("action", jnp.argmax(q, axis=-1))
+        return eg(td, key)
+
+    postproc = MultiStep(gamma=gamma, n_steps=n_step) if n_step else None
+    coll = Collector(
+        env,
+        policy,
+        frames_per_batch=frames_per_batch,
+        postproc=postproc,
+        policy_state=eg.init_state(),
+    )
+    sampler = PrioritizedSampler() if prioritized else RandomSampler()
+    buffer = ReplayBuffer(DeviceStorage(buffer_capacity), sampler)
+    program = OffPolicyProgram(
+        coll,
+        loss,
+        buffer,
+        config or OffPolicyConfig(init_random_frames=2000, tau=0.01),
+        priority_key="td_error" if prioritized else None,
+    )
+    return _std_hooks(Trainer(program, total_steps, logger=logger), log_interval)
+
+
+def make_td3_trainer(
+    env: EnvBase,
+    total_steps: int,
+    buffer_capacity: int = 1_000_000,
+    frames_per_batch: int = 1024,
+    config: OffPolicyConfig | None = None,
+    gamma: float = 0.99,
+    exploration_sigma: float = 0.1,
+    logger: Logger | None = None,
+    log_interval: int = 10,
+    **loss_kwargs,
+) -> Trainer:
+    """TD3 with delayed policy updates (reference TD3Trainer, td3.py:29)."""
+    from ..modules import AdditiveGaussianModule
+
+    spec = env.action_spec
+    act_dim = _action_dims(env)
+    low = float(jnp.min(jnp.asarray(spec.low)))
+    high = float(jnp.max(jnp.asarray(spec.high)))
+    actor = TDModule(
+        TanhPolicy(action_dim=act_dim, low=low, high=high), ["observation"], ["action"]
+    )
+    loss = TD3Loss(
+        actor,
+        ConcatMLP(out_features=1, num_cells=(256, 256)),
+        action_low=low,
+        action_high=high,
+        gamma=gamma,
+        **loss_kwargs,
+    )
+    noise = AdditiveGaussianModule(spec, sigma_init=exploration_sigma, sigma_end=exploration_sigma)
+
+    def policy(params, td, key):
+        td = actor(params["actor"], td)
+        return noise(td, key)
+
+    coll = Collector(
+        env,
+        policy,
+        frames_per_batch=frames_per_batch,
+        policy_state=noise.init_state(),
+    )
+    buffer = ReplayBuffer(DeviceStorage(buffer_capacity))
+    cfg = config or OffPolicyConfig(init_random_frames=5000, policy_delay=2)
+    program = OffPolicyProgram(coll, loss, buffer, cfg)
+    return _std_hooks(Trainer(program, total_steps, logger=logger), log_interval)

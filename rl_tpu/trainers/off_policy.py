@@ -134,6 +134,7 @@ class OffPolicyProgram:
             policy=rand_policy,
             frames_per_batch=self.collector.frames_per_batch,
             policy_state=self.collector.policy_state,
+            postproc=self.collector.postproc,  # keep batch structure identical
         )
 
         @jax.jit

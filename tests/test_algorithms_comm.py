@@ -1,0 +1,147 @@
+"""Algorithm builders + comm backbone + video tests."""
+
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from rl_tpu.comm import (
+    CommandChannel,
+    Mailbox,
+    MappingRendezvous,
+    ServiceBackend,
+    TCPCommandClient,
+    TCPCommandServer,
+    current_service_backend,
+    service_backend,
+)
+from rl_tpu.envs import CartPoleEnv, PendulumEnv, RewardSum, TransformedEnv, VmapEnv
+from rl_tpu.trainers.algorithms import (
+    make_dqn_trainer,
+    make_ppo_trainer,
+    make_sac_trainer,
+    make_td3_trainer,
+)
+
+KEY = jax.random.key(0)
+
+
+class TestAlgorithmBuilders:
+    def test_ppo_builder_runs(self):
+        env = TransformedEnv(VmapEnv(CartPoleEnv(), 4), RewardSum())
+        tr = make_ppo_trainer(env, total_steps=2, frames_per_batch=64)
+        tr.train(0)
+        assert tr.step_count == 2
+
+    def test_sac_builder_runs(self):
+        env = TransformedEnv(VmapEnv(PendulumEnv(), 4), RewardSum())
+        from rl_tpu.trainers import OffPolicyConfig
+
+        tr = make_sac_trainer(
+            env, total_steps=2, frames_per_batch=64, buffer_capacity=1024,
+            config=OffPolicyConfig(batch_size=32, init_random_frames=64),
+        )
+        tr.train(0)
+        assert tr.step_count == 2
+
+    def test_dqn_builder_runs(self):
+        env = TransformedEnv(VmapEnv(CartPoleEnv(), 4), RewardSum())
+        from rl_tpu.trainers import OffPolicyConfig
+
+        tr = make_dqn_trainer(
+            env, total_steps=2, frames_per_batch=64, buffer_capacity=1024,
+            config=OffPolicyConfig(batch_size=32, init_random_frames=64),
+        )
+        tr.train(0)
+        assert tr.step_count == 2
+
+    def test_td3_builder_runs(self):
+        env = TransformedEnv(VmapEnv(PendulumEnv(), 4), RewardSum())
+        from rl_tpu.trainers import OffPolicyConfig
+
+        tr = make_td3_trainer(
+            env, total_steps=2, frames_per_batch=64, buffer_capacity=1024,
+            config=OffPolicyConfig(batch_size=32, init_random_frames=64, policy_delay=2),
+        )
+        tr.train(0)
+        assert tr.step_count == 2
+
+
+class TestComm:
+    def test_backend_scoping(self):
+        assert current_service_backend() == ServiceBackend.DIRECT
+        with service_backend("thread"):
+            assert current_service_backend() == ServiceBackend.THREAD
+        assert current_service_backend() == ServiceBackend.DIRECT
+
+    def test_mailbox(self):
+        mb = Mailbox()
+        mb.send("worker0", {"x": 1})
+        assert mb.receive("worker0")["x"] == 1
+        assert mb.try_receive("worker0") is None
+
+    def test_command_channel_threaded(self):
+        ch = CommandChannel()
+        ch.register_handler("add", lambda p: p["a"] + p["b"])
+        ch.register_handler("boom", lambda p: 1 / 0)
+
+        stop = threading.Event()
+
+        def serve():
+            while not stop.is_set():
+                ch.serve_once("w", timeout=0.2)
+
+        t = threading.Thread(target=serve, daemon=True)
+        t.start()
+        try:
+            assert ch.call("w", "add", {"a": 2, "b": 3}) == 5
+            with pytest.raises(RuntimeError):
+                ch.call("w", "boom", {})
+            with pytest.raises(RuntimeError):
+                ch.call("w", "unknown_cmd", {})
+        finally:
+            stop.set()
+
+    def test_call_timeout_on_dead_worker(self):
+        ch = CommandChannel()
+        with pytest.raises(TimeoutError):
+            ch.call("nobody", "ping", timeout=0.2)
+
+    def test_serve_once_empty_returns_false(self):
+        assert CommandChannel().serve_once("idle", timeout=0.05) is False
+
+    def test_tcp_command_roundtrip(self):
+        srv = TCPCommandServer().start()
+        try:
+            srv.register_handler("echo", lambda p: {"got": p})
+            srv.register_handler("seed", lambda p: p * 2)
+            host, port = srv.address
+            cli = TCPCommandClient(host, port)
+            assert cli.call("echo", [1, 2])["got"] == [1, 2]
+            assert cli.call("seed", 21) == 42
+            with pytest.raises(RuntimeError):
+                cli.call("nope")
+        finally:
+            srv.shutdown()
+
+    def test_mapping_rendezvous(self):
+        rdv = MappingRendezvous({"a": "h1:1", "b": "h2:2"}, rank=1)
+        assert rdv.world_size() == 2 and rdv.my_rank() == 1
+
+
+class TestVideo:
+    def test_frames_and_mp4(self, tmp_path):
+        from rl_tpu.record.video import frames_from_rollout, write_mp4
+        from rl_tpu.data import ArrayDict
+
+        steps = ArrayDict(
+            next=ArrayDict(pixels=jnp.zeros((5, 2, 8, 8, 3)))  # [T, B, H, W, C]
+        )
+        frames = frames_from_rollout(steps)
+        assert frames.shape == (5, 8, 8, 3) and frames.dtype == np.uint8
+        path = write_mp4(frames, str(tmp_path / "out.mp4"), fps=5)
+        import os
+
+        assert os.path.getsize(path) > 0

@@ -269,3 +269,91 @@ class TestMultiStep:
         # t=0 folds only through the done at t=1 -> next obs from t=1
         np.testing.assert_allclose(np.asarray(out["next", "obs"])[0], 2.0)
         np.testing.assert_allclose(np.asarray(out["next", "original_reward"]), 1.0)
+
+
+class TestHER:
+    def test_future_relabel_within_episode(self):
+        from rl_tpu.data import her_relabel
+
+        T = 8
+        achieved = jnp.arange(T, dtype=jnp.float32)[:, None]  # goal = step idx
+        done = jnp.zeros(T, bool).at[3].set(True)  # episodes 0-3, 4-7
+        batch = ArrayDict(
+            desired_goal=jnp.full((T, 1), -1.0),
+            next=ArrayDict(
+                achieved_goal=achieved,
+                reward=jnp.zeros(T),
+                done=done,
+            ),
+        )
+        reward_fn = lambda a, d: (jnp.abs(a - d).sum(-1) < 0.5).astype(jnp.float32)  # noqa: E731
+        out = her_relabel(batch, jax.random.key(0), reward_fn, relabel_prob=1.0)
+        dg = np.asarray(out["desired_goal"])[:, 0]
+        # relabeled goals come from the future OF THE SAME EPISODE
+        for t in range(4):
+            assert t <= dg[t] <= 3, (t, dg[t])
+        for t in range(4, 8):
+            assert t <= dg[t] <= 7, (t, dg[t])
+        # rewards recomputed: goal == own achieved -> 1
+        r = np.asarray(out["next", "reward"])
+        eq = dg == np.arange(T)
+        np.testing.assert_array_equal(r[eq], 1.0)
+
+    def test_relabeler_in_collector_postproc(self):
+        from rl_tpu.collectors import Collector
+        from rl_tpu.data import HERRelabeler
+        from rl_tpu.envs import VmapEnv
+        from rl_tpu.testing import CountingEnv
+
+        class GoalCounting(CountingEnv):
+            @property
+            def observation_spec(self):
+                from rl_tpu.data import Bounded, Composite
+
+                mc = float(self.max_count)
+                return Composite(
+                    observation=Bounded(shape=(1,), low=0.0, high=mc),
+                    achieved_goal=Bounded(shape=(1,), low=0.0, high=mc),
+                    desired_goal=Bounded(shape=(1,), low=0.0, high=mc),
+                )
+
+            def _reset(self, key):
+                state, obs = super()._reset(key)
+                obs = obs.set("achieved_goal", obs["observation"]).set(
+                    "desired_goal", jnp.full((1,), 3.0)
+                )
+                return state, obs
+
+            def _step(self, state, action, key):
+                state, obs, r, term, trunc = super()._step(state, action, key)
+                obs = obs.set("achieved_goal", obs["observation"]).set(
+                    "desired_goal", jnp.full((1,), 3.0)
+                )
+                return state, obs, r, term, trunc
+
+        reward_fn = lambda a, d: (jnp.abs(a - d).sum(-1) < 0.5).astype(jnp.float32)  # noqa: E731
+        relabeler = HERRelabeler(reward_fn)
+        env = VmapEnv(GoalCounting(max_count=4), 2)
+        coll = Collector(env, None, frames_per_batch=16, postproc=relabeler)
+        batch, _ = jax.jit(coll.collect)({}, coll.init(KEY))
+        assert batch["desired_goal"].shape == (8, 2, 1)
+
+    def test_future_sampling_uniform_within_episode(self):
+        from rl_tpu.data import her_relabel
+
+        T = 8
+        achieved = jnp.arange(T, dtype=jnp.float32)[:, None]
+        done = jnp.zeros(T, bool).at[3].set(True)
+        batch = ArrayDict(
+            desired_goal=jnp.full((T, 1), -1.0),
+            next=ArrayDict(achieved_goal=achieved, reward=jnp.zeros(T), done=done),
+        )
+        reward_fn = lambda a, d: jnp.zeros(a.shape[:-1])  # noqa: E731
+        counts = np.zeros(4)
+        for s in range(200):
+            out = her_relabel(batch, jax.random.key(s), reward_fn, relabel_prob=1.0)
+            g0 = int(np.asarray(out["desired_goal"])[0, 0])
+            counts[g0] += 1
+        # t=0 in episode [0,3]: each of the 4 goals ~uniform (not biased to 0)
+        freq = counts / counts.sum()
+        assert freq.max() < 0.45, freq
