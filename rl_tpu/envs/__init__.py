@@ -2,10 +2,12 @@ from .base import EnvBase, EnvState, VmapEnv, rollout, step_mdp, where_done
 from .classic.cartpole import CartPoleEnv
 from .classic.pendulum import PendulumEnv
 from .model_based import ModelBasedEnv
+from .wrappers import FrameSkipEnv, NoopResetEnv
 from .transforms.base import Compose, Transform, TransformedEnv
 from .transforms.image import CenterCrop, GrayScale, Resize, ToFloatImage
 from .transforms.vecnorm import VecNorm
 from .transforms.common import (
+    TimeMaxPool,
     ActionScaling,
     CatFrames,
     CatTensors,
@@ -25,6 +27,9 @@ from .transforms.common import (
 from .utils import ExplorationType, check_env_specs, exploration_type, set_exploration_type
 
 __all__ = [
+    "FrameSkipEnv",
+    "NoopResetEnv",
+    "TimeMaxPool",
     "ModelBasedEnv",
     "VecNorm",
     "ToFloatImage",

@@ -224,6 +224,31 @@ class CatFrames(Transform):
         return spec.set(self.in_key, dataclasses.replace(leaf, shape=new_shape))
 
 
+class TimeMaxPool(Transform):
+    """Element-wise max over the last ``T`` observations (reference
+    TimeMaxPool — Atari flicker removal). Buffer on a TRAILING axis
+    [..., feature, T] (like CatFrames) so the default per-env ``on_done``
+    masking applies unchanged."""
+
+    def __init__(self, T: int = 2, in_key: str = "observation"):
+        self.T = T
+        self.in_key = in_key
+
+    def init(self, reset_td):
+        obs = reset_td[self.in_key]
+        return ArrayDict(buffer=jnp.repeat(obs[..., None], self.T, axis=-1))
+
+    def reset(self, tstate, td):
+        obs = td[self.in_key]
+        buf = jnp.repeat(obs[..., None], self.T, axis=-1)
+        return ArrayDict(buffer=buf), td.set(self.in_key, buf.max(axis=-1))
+
+    def step(self, tstate, next_td):
+        obs = next_td[self.in_key]
+        buf = jnp.concatenate([tstate["buffer"][..., 1:], obs[..., None]], axis=-1)
+        return ArrayDict(buffer=buf), next_td.set(self.in_key, buf.max(axis=-1))
+
+
 class FlattenObservation(_KeyedTransform):
     """Flatten the last ``ndims`` observation dims to 1-D (reference
     FlattenObservation). ``ndims`` is explicit (e.g. 3 for HWC images)

@@ -1,0 +1,134 @@
+"""Step-altering env wrappers: frame skip, noop reset.
+
+These change the *step structure* (multiple base steps per outer step), so
+they are EnvBase wrappers rather than data transforms (reference implements
+them as transforms over a stateful env — ``FrameSkipTransform``,
+``NoopResetEnv`` in torchrl/envs/transforms/transforms.py; here the env is
+the state carrier, so the wrapper owns the inner ``lax.scan``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..data import ArrayDict
+from .base import EnvBase
+
+
+__all__ = ["FrameSkipEnv", "NoopResetEnv"]
+
+
+class _DelegateWrapper(EnvBase):
+    def __init__(self, env: EnvBase):
+        self.env = env
+
+    @property
+    def observation_spec(self):
+        return self.env.observation_spec
+
+    @property
+    def action_spec(self):
+        return self.env.action_spec
+
+    @property
+    def reward_spec(self):
+        return self.env.reward_spec
+
+    @property
+    def done_spec(self):
+        return self.env.done_spec
+
+    @property
+    def state_spec(self):
+        return self.env.state_spec
+
+    @property
+    def batch_shape(self):
+        return self.env.batch_shape
+
+    @property
+    def _rng_path(self):
+        return self.env._rng_path
+
+    def _spec_state(self, state):
+        return self.env._spec_state(state)
+
+    def reset(self, key):
+        return self.env.reset(key)
+
+    def step(self, state, td):
+        return self.env.step(state, td)
+
+
+class FrameSkipEnv(_DelegateWrapper):
+    """Repeat each action ``skip`` times, summing rewards; stops accumulating
+    after the episode ends inside the window (reference FrameSkipTransform).
+    """
+
+    def __init__(self, env: EnvBase, skip: int = 4):
+        super().__init__(env)
+        if skip < 1:
+            raise ValueError("skip must be >= 1")
+        self.skip = skip
+
+    def step(self, state, td: ArrayDict):
+        def body(carry, _):
+            state, out_prev, done_prev, reward_acc = carry
+            new_state, out = self.env.step(state, td)
+            done = out["next", "done"] | done_prev
+            # freeze state/output once done inside the window
+            from .base import where_done
+
+            state = where_done(done_prev, state, new_state)
+            out = where_done(done_prev, out_prev, out)
+            reward_acc = reward_acc + jnp.where(
+                done_prev, 0.0, out["next", "reward"]
+            )
+            return (state, out, done, reward_acc), None
+
+        state0, out0 = self.env.step(state, td)
+        done0 = out0["next", "done"]
+        r0 = out0["next", "reward"]
+        (state, out, _, reward), _ = jax.lax.scan(
+            body, (state0, out0, done0, r0), None, length=self.skip - 1
+        )
+        return state, out.set(("next", "reward"), reward)
+
+
+class NoopResetEnv(_DelegateWrapper):
+    """Take a random number (1..noop_max) of fixed no-op actions after reset
+    (reference NoopResetEnv — Atari-style start-state randomization).
+
+    ``noop_action`` defaults to the action spec's zero.
+    """
+
+    def __init__(self, env: EnvBase, noop_max: int = 30, noop_action=None):
+        super().__init__(env)
+        self.noop_max = noop_max
+        self.noop_action = noop_action
+
+    def reset(self, key):
+        k_reset, k_n = jax.random.split(key)
+        state, td = self.env.reset(k_reset)
+        n = jax.random.randint(k_n, (), 1, self.noop_max + 1)
+        noop = (
+            self.noop_action
+            if self.noop_action is not None
+            else self.env.action_spec.zero(self.env.batch_shape)
+        )
+
+        def body(i, carry):
+            state, td = carry
+            new_state, out = self.env.step(state, td.set("action", noop))
+            from .base import step_mdp, where_done
+
+            nxt = step_mdp(out)
+            # stop noop-stepping past the budget, and refuse any step that
+            # would end the episode (reset() must never return a done state)
+            keep = (i >= n) | td["done"] | nxt["done"]
+            state = where_done(keep, state, new_state)
+            td = where_done(keep, td, nxt)
+            return state, td
+
+        return jax.lax.fori_loop(0, self.noop_max, body, (state, td))

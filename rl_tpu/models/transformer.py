@@ -41,7 +41,8 @@ class TransformerConfig:
     d_ff: int = 2048
     max_seq_len: int = 1024
     dtype: Any = jnp.bfloat16  # activation dtype; params stay fp32
-    attention_impl: str = "local"  # "local" | "ring"
+    attention_impl: str = "local"  # "local" | "ring" | "flash"
+    flash_interpret: bool = False  # pallas interpret mode (CPU testing)
     mesh: Any = None  # required for "ring"
     context_axis: str = "context"
 
@@ -85,6 +86,23 @@ class _Attention(nn.Module):
                 s = jnp.where(mask[:, None, None, :], s, -1e9)
             p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(cfg.dtype)
             o = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+        elif cfg.attention_impl == "flash":
+            from ..ops.attention import flash_attention
+
+            if mask is not None:
+                # fail loud: per-row padding masks are not threaded into the
+                # kernel yet; silent pad-attendance would corrupt log-probs
+                raise ValueError(
+                    "attention_impl='flash' does not support padding masks yet; "
+                    "use 'local' or 'ring' for padded batches"
+                )
+            o = flash_attention(
+                q.astype(jnp.float32),
+                k.astype(jnp.float32),
+                v.astype(jnp.float32),
+                causal=True,
+                interpret=cfg.flash_interpret,
+            ).astype(cfg.dtype)
         elif cfg.attention_impl == "ring":
             from ..parallel import ring_attention
 

@@ -1,3 +1,13 @@
 from .math import safeatanh, safetanh
 
-__all__ = ["safetanh", "safeatanh"]
+__all__ = ["safetanh", "safeatanh", "flash_attention"]
+
+
+def __getattr__(name):
+    # flash_attention pulls in jax.experimental.pallas; load it lazily so
+    # importing rl_tpu.ops for the math helpers stays cheap
+    if name == "flash_attention":
+        from .attention import flash_attention
+
+        return flash_attention
+    raise AttributeError(f"module 'rl_tpu.ops' has no attribute {name!r}")

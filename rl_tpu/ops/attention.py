@@ -1,0 +1,167 @@
+"""Pallas flash-attention kernel (TPU) with interpret-mode CPU fallback.
+
+The hot-op kernel slot (pallas_guide.md playbook): a blockwise
+online-softmax attention forward that keeps the running (m, l, acc)
+statistics in VMEM and streams K/V blocks through the MXU — O(T_block)
+memory instead of materializing the [T, T] score matrix. The reference
+delegates its fused attention to external engines (vLLM/SGLang) or Triton
+(SURVEY.md §2.0); this is the native TPU form.
+
+Gradients: ``flash_attention`` carries a ``jax.custom_vjp`` whose backward
+recomputes attention with plain XLA ops (the standard recompute trade:
+flash forward for speed/memory, dense backward for simplicity). Training
+through it is exact; for the long-context *training* path prefer
+:func:`rl_tpu.parallel.ring_attention` (sharded, O(T_local) both ways).
+
+Tested in interpret mode on CPU against the dense oracle; the same kernel
+lowers to Mosaic on TPU (``interpret=False``).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+__all__ = ["flash_attention"]
+
+_NEG_INF = -1e30
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q, block_k, seq_len, causal, scale):
+    # refs: q [1, block_q, D]; k/v [1, T, D]; o [1, block_q, D]
+    q = q_ref[0].astype(jnp.float32)
+    iq = pl.program_id(1)
+    q_pos = iq * block_q + jax.lax.iota(jnp.int32, block_q)
+
+    num_kv = pl.cdiv(seq_len, block_k)
+
+    def body(j, carry):
+        m, l, acc = carry
+        k_blk = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale  # [block_q, block_k]
+        kv_pos = j * block_k + jax.lax.iota(jnp.int32, block_k)
+        valid = kv_pos[None, :] < seq_len
+        if causal:
+            valid = valid & (q_pos[:, None] >= kv_pos[None, :])
+        s = jnp.where(valid, s, _NEG_INF)
+
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + jnp.sum(p, axis=-1)
+        acc_new = acc * corr[:, None] + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return m_new, l_new, acc_new
+
+    # causal: KV blocks strictly above the diagonal contribute nothing
+    upper = num_kv if not causal else jnp.minimum(
+        num_kv, ((iq + 1) * block_q + block_k - 1) // block_k
+    )
+    m0 = jnp.full((block_q,), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    acc0 = jnp.zeros((block_q, q.shape[-1]), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, upper, body, (m0, l0, acc0))
+    l = jnp.where(l == 0.0, 1.0, l)
+    o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
+
+
+def _flash_fwd_bhtd(q, k, v, *, causal, scale, block_q, block_k, interpret):
+    BH, T, D = q.shape
+    block_q = min(block_q, T)
+    block_k = min(block_k, T)
+    # pad to a common block multiple: out-of-bounds dynamic slices CLAMP
+    # their start, which would silently read wrong rows on ragged tails
+    import math
+
+    lcm = math.lcm(block_q, block_k)
+    T_pad = ((T + lcm - 1) // lcm) * lcm
+    if T_pad != T:
+        pad = ((0, 0), (0, T_pad - T), (0, 0))
+        q = jnp.pad(q, pad)
+        k = jnp.pad(k, pad)
+        v = jnp.pad(v, pad)
+    grid = (BH, T_pad // block_q)
+    kernel = functools.partial(
+        _fwd_kernel,
+        block_q=block_q,
+        block_k=block_k,
+        seq_len=T,  # the true length: kv tail masking uses it
+        causal=causal,
+        scale=scale,
+    )
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((BH, T_pad, D), q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, T_pad, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, T_pad, D), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+        interpret=interpret,
+    )(q, k, v)
+    return out[:, :T]
+
+
+def _dense_reference(q, k, v, causal, scale):
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    T, S = s.shape[-2], s.shape[-1]
+    if causal:
+        mask = jnp.tril(jnp.ones((T, S), bool))
+        s = jnp.where(mask, s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32)).astype(q.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = True,
+    scale: float | None = None,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool = False,
+) -> jax.Array:
+    """Blockwise-online-softmax attention over [B, T, H, D] inputs."""
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    B, T, H, D = q.shape
+
+    def to_bhtd(x):
+        return jnp.moveaxis(x, 2, 1).reshape(B * H, T, D)
+
+    o = _flash_fwd_bhtd(
+        to_bhtd(q),
+        to_bhtd(k),
+        to_bhtd(v),
+        causal=causal,
+        scale=scale,
+        block_q=block_q,
+        block_k=block_k,
+        interpret=interpret,
+    )
+    return jnp.moveaxis(o.reshape(B, H, T, D), 1, 2)
+
+
+def _fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    return flash_attention(q, k, v, causal, scale, block_q, block_k, interpret), (q, k, v)
+
+
+def _bwd(causal, scale, block_q, block_k, interpret, res, g):
+    # dense recompute backward: exact gradients through standard XLA attention
+    q, k, v = res
+    s = scale if scale is not None else q.shape[-1] ** -0.5
+    _, vjp = jax.vjp(lambda q, k, v: _dense_reference(q, k, v, causal, s), q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_fwd, _bwd)

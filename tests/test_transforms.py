@@ -7,6 +7,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from rl_tpu.data import ArrayDict
+
 from rl_tpu.envs import (
     ActionScaling,
     CatFrames,
@@ -149,3 +151,64 @@ class TestBehavior:
         steps = f(KEY)
         ep = np.asarray(steps["next", "episode_reward"])
         np.testing.assert_allclose(ep, [2, 4, 6, 2, 4, 6])
+
+
+class TestWrappersAndPooling:
+    def test_frame_skip_sums_rewards(self):
+        from rl_tpu.envs import FrameSkipEnv
+
+        env = FrameSkipEnv(CountingEnv(max_count=100), skip=4)
+        check_env_specs(env, KEY)
+        steps = rollout(env, KEY, max_steps=3)
+        # each outer step advances 4, reward 4x1
+        np.testing.assert_allclose(np.asarray(steps["next", "reward"]), 4.0)
+        np.testing.assert_allclose(
+            np.asarray(steps["next", "observation"]).squeeze(-1), [4, 8, 12]
+        )
+
+    def test_frame_skip_stops_at_done(self):
+        from rl_tpu.envs import FrameSkipEnv
+
+        env = FrameSkipEnv(CountingEnv(max_count=2), skip=4)
+        state, td = env.reset(KEY)
+        td = env.rand_action(td, KEY)
+        _, out = env.step(state, td)
+        # episode ends at count 2 -> only 2 rewards accumulate
+        assert float(out["next", "reward"]) == 2.0
+        assert bool(out["next", "done"])
+
+    def test_noop_reset_advances_state(self):
+        from rl_tpu.envs import NoopResetEnv
+
+        env = NoopResetEnv(CountingEnv(max_count=100), noop_max=5)
+        check_env_specs(env, KEY)
+        state, td = env.reset(KEY)
+        c = float(td["observation"][0])
+        assert 1 <= c <= 5, c
+
+    def test_time_max_pool(self):
+        from rl_tpu.envs import TimeMaxPool
+
+        class Alternating(CountingEnv):
+            def _step(self, state, action, key):
+                state, obs, r, term, trunc = super()._step(state, action, key)
+                # even steps produce 10, odd steps produce count
+                c = state["count"]
+                val = jnp.where(c % 2 == 0, 10.0, obs["observation"][0])
+                return state, ArrayDict(observation=val[None]), r, term, trunc
+
+        env = TransformedEnv(Alternating(max_count=100), TimeMaxPool(T=2))
+        steps = rollout(env, KEY, max_steps=6)
+        obs = np.asarray(steps["next", "observation"]).squeeze(-1)
+        # max over {current, previous} -> 10 persists across odd steps
+        assert (obs >= 9.0).sum() >= 4
+
+    def test_noop_reset_never_returns_done(self):
+        from rl_tpu.envs import NoopResetEnv
+
+        # max_count=2 < noop_max: reset must stop before terminating
+        env = NoopResetEnv(CountingEnv(max_count=2), noop_max=6)
+        for s in range(5):
+            state, td = env.reset(jax.random.key(s))
+            assert not bool(td["done"]), "reset returned a done state"
+            assert float(td["observation"][0]) <= 1.0  # stops pre-terminal
