@@ -1,5 +1,6 @@
 from .buffer import ReplayBuffer
 from .host_per import HostPrioritizedSampler
+from .service import RemoteReplayBuffer, ReplayService
 from .samplers import (
     PrioritizedSampler,
     RandomSampler,
@@ -11,6 +12,8 @@ from .storages import DeviceStorage, ListStorage, MemmapStorage, Storage
 from .writers import ImmutableDatasetWriter, MaxValueWriter, RoundRobinWriter, Writer
 
 __all__ = [
+    "ReplayService",
+    "RemoteReplayBuffer",
     "HostPrioritizedSampler",
     "ReplayBuffer",
     "Storage",

@@ -1,0 +1,114 @@
+"""Replay buffer served over the TCP control plane.
+
+Redesign of the reference's distributed replay service (reference:
+torchrl/_comm/replay_service.py:102 ``_DistributedReplayService`` /
+``_DistributedReplayClient``:32 — a ReplayBuffer served to remote trainers
+over the transport): here the server owns the buffer state and exposes
+extend/sample/size/update_priority over the line-JSON TCP channel
+(rl_tpu.comm), with arrays base64-npz encoded. This is the DCN path for
+host-resident buffers; device-resident buffers move with the program.
+"""
+
+from __future__ import annotations
+
+import base64
+import io
+import threading
+from typing import Any
+
+import numpy as np
+
+import jax
+
+from ...comm import TCPCommandClient, TCPCommandServer
+from ..arraydict import ArrayDict
+from .buffer import ReplayBuffer
+
+__all__ = ["ReplayService", "RemoteReplayBuffer"]
+
+
+def _encode(td: ArrayDict) -> dict:
+    buf = io.BytesIO()
+    flat = td.flatten_keys("|")
+    np.savez(buf, **{k: np.asarray(v) for k, v in flat.items()})
+    return {"npz": base64.b64encode(buf.getvalue()).decode()}
+
+
+def _decode(payload: dict) -> ArrayDict:
+    raw = base64.b64decode(payload["npz"])
+    with np.load(io.BytesIO(raw)) as z:
+        flat = ArrayDict({k: jax.numpy.asarray(z[k]) for k in z.files})
+    return flat.unflatten_keys("|")
+
+
+class ReplayService:
+    """Own a buffer + its state; serve it over TCP."""
+
+    def __init__(self, buffer: ReplayBuffer, example: ArrayDict, host="127.0.0.1", port=0):
+        self.buffer = buffer
+        self.state = buffer.init(example)
+        self._key = jax.random.key(0)
+        # TCPCommandServer is threading: serialize state updates or
+        # concurrent extend/sample would read-modify-write the same state
+        # and silently drop data
+        self._lock = threading.Lock()
+        self.server = TCPCommandServer(host, port)
+        self.server.register_handler("extend", self._extend)
+        self.server.register_handler("sample", self._sample)
+        self.server.register_handler("size", lambda p: int(self.buffer.size(self.state)))
+        self.server.register_handler("update_priority", self._update_priority)
+
+    @property
+    def address(self):
+        return self.server.address
+
+    def start(self) -> "ReplayService":
+        self.server.start()
+        return self
+
+    def shutdown(self):
+        self.server.shutdown()
+
+    def _extend(self, payload):
+        items = _decode(payload)
+        with self._lock:
+            self.state = self.buffer.extend(self.state, items)
+            return int(self.buffer.size(self.state))
+
+    def _sample(self, payload):
+        bs = payload.get("batch_size") if payload else None
+        with self._lock:
+            self._key, k = jax.random.split(self._key)
+            batch, self.state = self.buffer.sample(self.state, k, bs)
+        return _encode(batch)
+
+    def _update_priority(self, payload):
+        idx = np.asarray(payload["index"], np.int32)
+        prio = np.asarray(payload["priority"], np.float32)
+        with self._lock:
+            self.state = self.buffer.update_priority(
+                self.state, jax.numpy.asarray(idx), jax.numpy.asarray(prio)
+            )
+        return True
+
+
+class RemoteReplayBuffer:
+    """Client view of a served buffer (reference _DistributedReplayClient)."""
+
+    def __init__(self, host: str, port: int, timeout: float = 30.0):
+        self.client = TCPCommandClient(host, port, timeout=timeout)
+
+    def extend(self, items: ArrayDict) -> int:
+        return self.client.call("extend", _encode(items))
+
+    def sample(self, batch_size: int | None = None) -> ArrayDict:
+        return _decode(self.client.call("sample", {"batch_size": batch_size}))
+
+    def size(self) -> int:
+        return self.client.call("size")
+
+    def update_priority(self, index, priority) -> None:
+        self.client.call(
+            "update_priority",
+            {"index": np.asarray(index).tolist(), "priority": np.asarray(priority).tolist()},
+        )

@@ -111,7 +111,8 @@ class NoopResetEnv(_DelegateWrapper):
     def reset(self, key):
         k_reset, k_n = jax.random.split(key)
         state, td = self.env.reset(k_reset)
-        n = jax.random.randint(k_n, (), 1, self.noop_max + 1)
+        # per-env counts: batched envs must randomize INDEPENDENTLY
+        n = jax.random.randint(k_n, self.env.batch_shape, 1, self.noop_max + 1)
         noop = (
             self.noop_action
             if self.noop_action is not None

@@ -97,11 +97,7 @@ class _Attention(nn.Module):
                     "use 'local' or 'ring' for padded batches"
                 )
             o = flash_attention(
-                q.astype(jnp.float32),
-                k.astype(jnp.float32),
-                v.astype(jnp.float32),
-                causal=True,
-                interpret=cfg.flash_interpret,
+                q, k, v, causal=True, interpret=cfg.flash_interpret
             ).astype(cfg.dtype)
         elif cfg.attention_impl == "ring":
             from ..parallel import ring_attention

@@ -6,6 +6,8 @@ from .common import (
     hold_out,
     masked_mean,
 )
+from .crossq import BatchNormMLP, CrossQLoss
+from .dreamer import DreamerActorLoss, DreamerValueLoss, imagine_rollout
 from .cql import CQLLoss, DiscreteCQLLoss
 from .ddpg import DDPGLoss, TD3Loss
 from .dqn import DistributionalDQNLoss, DQNLoss
@@ -17,6 +19,7 @@ from .ppo import A2CLoss, ClipPPOLoss, KLPENPPOLoss, PPOLoss, ReinforceLoss
 from .sac import DiscreteSACLoss, SACLoss
 from .value import (
     GAE,
+    MultiAgentGAE,
     TD0Estimator,
     TD1Estimator,
     TDLambdaEstimator,
@@ -27,6 +30,11 @@ from .value import (
 )
 
 __all__ = [
+    "CrossQLoss",
+    "BatchNormMLP",
+    "DreamerActorLoss",
+    "DreamerValueLoss",
+    "imagine_rollout",
     "BCLoss",
     "GAILLoss",
     "RNDModule",
@@ -60,6 +68,7 @@ __all__ = [
     "TD1Estimator",
     "TDLambdaEstimator",
     "GAE",
+    "MultiAgentGAE",
     "VTrace",
     "make_value_estimator",
 ]

@@ -1,0 +1,194 @@
+"""CrossQ: target-network-free SAC with batch-normalized critics.
+
+Redesign (reference: torchrl/objectives/crossq.py:40 ``CrossQLoss``;
+modules/models/batchrenorm.py): the CrossQ trick is evaluating Q(s,a) and
+Q(s',a') in ONE forward pass so both share the same batch-norm statistics —
+removing target networks entirely (Bhatt et al. 2024).
+
+Batch-norm running statistics are explicit state (flax "batch_stats"
+collection) threaded alongside params: ``__call__(params, batch, key)``
+returns the loss with ``metrics["batch_stats"]`` holding the updated stats;
+the train step merges them back (they carry no gradients).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..data import ArrayDict
+from ..modules.networks import _activation
+from .common import LossModule, hold_out
+
+__all__ = ["BatchNormMLP", "CrossQLoss"]
+
+
+class BatchNormMLP(nn.Module):
+    """MLP with BatchNorm after each hidden layer (the CrossQ critic body;
+    reference batchrenorm.py — plain BN with high momentum is the published
+    configuration)."""
+
+    out_features: int
+    num_cells: Sequence[int] = (256, 256)
+    activation: Any = "relu"
+    momentum: float = 0.99
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, *xs, train: bool = True):
+        act = _activation(self.activation)
+        x = jnp.concatenate([jnp.asarray(v, self.dtype) for v in xs], axis=-1)
+        x = nn.BatchNorm(
+            use_running_average=not train, momentum=self.momentum, dtype=self.dtype
+        )(x)
+        for width in self.num_cells:
+            x = nn.Dense(width, dtype=self.dtype)(x)
+            x = nn.BatchNorm(
+                use_running_average=not train, momentum=self.momentum, dtype=self.dtype
+            )(x)
+            x = act(x)
+        return nn.Dense(self.out_features, dtype=self.dtype)(x)
+
+
+class CrossQLoss(LossModule):
+    """SAC-style objective with joint-BN critics, NO target networks."""
+
+    target_keys = ()  # the whole point
+
+    def __init__(
+        self,
+        actor,
+        num_qvalue_nets: int = 2,
+        num_cells: Sequence[int] = (256, 256),
+        gamma: float = 0.99,
+        target_entropy: float | str = "auto",
+        alpha_init: float = 1.0,
+    ):
+        self.actor = actor
+        self.qnet = BatchNormMLP(out_features=1, num_cells=num_cells)
+        self.num_qvalue_nets = num_qvalue_nets
+        self.gamma = gamma
+        self._target_entropy = target_entropy
+        self.alpha_init = alpha_init
+        self._action_dim = None
+
+    def init_params(self, key: jax.Array, td: ArrayDict) -> dict:
+        ka, kq = jax.random.split(key)
+        actor_params = self.actor.init(ka, td)
+        dist, _ = self.actor.get_dist(actor_params, td)
+        action = dist.mode
+        self._action_dim = action.shape[-1]
+
+        keys = jax.random.split(kq, self.num_qvalue_nets)
+
+        def one(k):
+            return self.qnet.init(k, td["observation"], action, train=False)
+
+        stacked = jax.vmap(one)(keys)
+        return {
+            "actor": actor_params,
+            "qvalue": stacked["params"],
+            "batch_stats": stacked["batch_stats"],
+            "log_alpha": jnp.asarray(jnp.log(self.alpha_init), jnp.float32),
+        }
+
+    def target_entropy(self, action_dim: int | None = None) -> float:
+        if self._target_entropy == "auto":
+            dim = action_dim if action_dim is not None else self._action_dim
+            if dim is None:
+                raise ValueError(
+                    "target_entropy='auto' needs the action dim; call "
+                    "init_params or pass action_dim"
+                )
+            return -float(dim)
+        return float(self._target_entropy)
+
+    def _q_joint(self, params, stats, obs, act, next_obs, next_act, train):
+        """ONE forward over the concatenated [current; next] batch so both
+        halves normalize with the same statistics — the CrossQ trick."""
+        obs_cat = jnp.concatenate([obs, next_obs], axis=0)
+        act_cat = jnp.concatenate([act, next_act], axis=0)
+
+        def one(p, s):
+            out, updates = self.qnet.apply(
+                {"params": p, "batch_stats": s},
+                obs_cat,
+                act_cat,
+                train=train,
+                mutable=["batch_stats"] if train else [],
+            ) if train else (
+                self.qnet.apply({"params": p, "batch_stats": s}, obs_cat, act_cat, train=False),
+                {"batch_stats": s},
+            )
+            return out[..., 0], updates["batch_stats"]
+
+        q, new_stats = jax.vmap(one)(params, stats)
+        n = obs.shape[0]
+        return q[:, :n], q[:, n:], new_stats
+
+    def __call__(self, params, batch: ArrayDict, key=None):
+        if key is None:
+            raise ValueError("CrossQLoss requires a PRNG key")
+        k_next, k_pi = jax.random.split(key)
+        alpha = jnp.exp(jax.lax.stop_gradient(params["log_alpha"]))
+        # stats may round-trip through ArrayDict metrics; flax requires plain
+        # dict collections
+        stats_in = params["batch_stats"]
+        if isinstance(stats_in, ArrayDict):
+            stats_in = stats_in.to_dict()
+        params = {**params, "batch_stats": stats_in}
+
+        next_dist, _ = self.actor.get_dist(hold_out(params["actor"]), batch["next"])
+        next_a = next_dist.sample(k_next)
+        next_lp = next_dist.log_prob(next_a)
+
+        q_cur, q_next, new_stats = self._q_joint(
+            params["qvalue"],
+            params["batch_stats"],
+            batch["observation"],
+            batch["action"],
+            batch["next", "observation"],
+            next_a,
+            train=True,
+        )
+        next_v = jnp.min(jax.lax.stop_gradient(q_next), axis=0) - alpha * next_lp
+        reward = batch["next", "reward"]
+        not_term = 1.0 - batch["next", "terminated"].astype(jnp.float32)
+        target = jax.lax.stop_gradient(reward + self.gamma * not_term * next_v)
+        td_error = q_cur - target[None]
+        loss_qvalue = 0.5 * jnp.mean(jnp.sum(td_error**2, axis=0))
+
+        # actor against eval-mode critics (running stats, no grad into BN)
+        dist, _ = self.actor.get_dist(params["actor"], batch)
+        a_pi = dist.rsample(k_pi)
+        lp_pi = dist.log_prob(a_pi)
+
+        def q_eval(p, s):
+            return self.qnet.apply(
+                {"params": p, "batch_stats": s},
+                batch["observation"],
+                a_pi,
+                train=False,
+            )[..., 0]
+
+        q_pi = jax.vmap(q_eval)(hold_out(params["qvalue"]), params["batch_stats"])
+        loss_actor = jnp.mean(alpha * lp_pi - jnp.min(q_pi, axis=0))
+
+        loss_alpha = -params["log_alpha"] * jnp.mean(
+            jax.lax.stop_gradient(lp_pi + self.target_entropy(batch["action"].shape[-1]))
+        )
+        total = loss_qvalue + loss_actor + loss_alpha
+        metrics = ArrayDict(
+            loss_qvalue=loss_qvalue,
+            loss_actor=loss_actor,
+            loss_alpha=loss_alpha,
+            alpha=alpha,
+        ).set("batch_stats", jax.lax.stop_gradient(new_stats))
+        return total, metrics
+
+    def trainable(self, params: dict) -> dict:
+        # batch_stats are state, not parameters
+        return {k: v for k, v in params.items() if k not in ("batch_stats",)}

@@ -146,6 +146,31 @@ class TDLambdaEstimator(ValueEstimatorBase):
         return target - value, target
 
 
+class MultiAgentGAE(GAE):
+    """Per-agent GAE with a shared team reward (reference MultiAgentGAE,
+    advantages.py:2367): the value network emits per-agent values
+    [..., n_agents]; team reward/done broadcast over the agent axis, and the
+    recurrence runs independently per agent (IPPO-style decentralized
+    advantages)."""
+
+    def _kernel(self, value, next_value, batch):
+        def bcast(x):
+            return jnp.broadcast_to(x[..., None], value.shape)
+
+        adv, target = F.generalized_advantage_estimate(
+            self.gamma,
+            self.lmbda,
+            value,
+            next_value,
+            bcast(batch["next", "reward"]),
+            bcast(batch["next", "done"]),
+            bcast(batch["next", "terminated"]),
+        )
+        if self.average_gae:
+            adv = (adv - adv.mean()) / jnp.clip(adv.std(), 1e-6)
+        return adv, target
+
+
 class VTrace(ValueEstimatorBase):
     """V-trace with importance ratios from ("sample_log_prob" vs the current
     policy's log-prob of the stored action) (reference :2473)."""

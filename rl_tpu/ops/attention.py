@@ -30,46 +30,56 @@ __all__ = ["flash_attention"]
 _NEG_INF = -1e30
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q, block_k, seq_len, causal, scale):
-    # refs: q [1, block_q, D]; k/v [1, T, D]; o [1, block_q, D]
-    q = q_ref[0].astype(jnp.float32)
+def _fwd_kernel(
+    q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *, block_q, block_k, seq_len, causal, scale
+):
+    # refs: q [1, block_q, D]; k/v [1, block_k, D] (BLOCKED over the kv grid
+    # dim — only one KV tile in VMEM at a time); o [1, block_q, D];
+    # m/l/acc are VMEM scratch persisting across the sequential kv grid dim.
     iq = pl.program_id(1)
+    j = pl.program_id(2)
+    num_kv = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
     q_pos = iq * block_q + jax.lax.iota(jnp.int32, block_q)
+    kv_start = j * block_k
+    # causal: KV tiles strictly above the diagonal contribute nothing
+    needed = jnp.logical_or(not causal, kv_start <= iq * block_q + block_q - 1)
 
-    num_kv = pl.cdiv(seq_len, block_k)
-
-    def body(j, carry):
-        m, l, acc = carry
-        k_blk = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+    @pl.when(needed)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k_blk = k_ref[0].astype(jnp.float32)
+        v_blk = v_ref[0].astype(jnp.float32)
         s = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * scale  # [block_q, block_k]
-        kv_pos = j * block_k + jax.lax.iota(jnp.int32, block_k)
+        ) * scale
+        kv_pos = kv_start + jax.lax.iota(jnp.int32, block_k)
         valid = kv_pos[None, :] < seq_len
         if causal:
             valid = valid & (q_pos[:, None] >= kv_pos[None, :])
         s = jnp.where(valid, s, _NEG_INF)
 
+        m = m_ref[:]
         m_new = jnp.maximum(m, jnp.max(s, axis=-1))
         p = jnp.exp(s - m_new[:, None])
         corr = jnp.exp(m - m_new)
-        l_new = l * corr + jnp.sum(p, axis=-1)
-        acc_new = acc * corr[:, None] + jax.lax.dot_general(
+        m_ref[:] = m_new
+        l_ref[:] = l_ref[:] * corr + jnp.sum(p, axis=-1)
+        acc_ref[:] = acc_ref[:] * corr[:, None] + jax.lax.dot_general(
             p, v_blk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
-        return m_new, l_new, acc_new
 
-    # causal: KV blocks strictly above the diagonal contribute nothing
-    upper = num_kv if not causal else jnp.minimum(
-        num_kv, ((iq + 1) * block_q + block_k - 1) // block_k
-    )
-    m0 = jnp.full((block_q,), _NEG_INF, jnp.float32)
-    l0 = jnp.zeros((block_q,), jnp.float32)
-    acc0 = jnp.zeros((block_q, q.shape[-1]), jnp.float32)
-    m, l, acc = jax.lax.fori_loop(0, upper, body, (m0, l0, acc0))
-    l = jnp.where(l == 0.0, 1.0, l)
-    o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
+    @pl.when(j == num_kv - 1)
+    def _finish():
+        l = l_ref[:]
+        l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_ref[:] / l[:, None]).astype(o_ref.dtype)
 
 
 def _flash_fwd_bhtd(q, k, v, *, causal, scale, block_q, block_k, interpret):
@@ -87,7 +97,7 @@ def _flash_fwd_bhtd(q, k, v, *, causal, scale, block_q, block_k, interpret):
         q = jnp.pad(q, pad)
         k = jnp.pad(k, pad)
         v = jnp.pad(v, pad)
-    grid = (BH, T_pad // block_q)
+    grid = (BH, T_pad // block_q, T_pad // block_k)
     kernel = functools.partial(
         _fwd_kernel,
         block_q=block_q,
@@ -101,14 +111,25 @@ def _flash_fwd_bhtd(q, k, v, *, causal, scale, block_q, block_k, interpret):
         out_shape=jax.ShapeDtypeStruct((BH, T_pad, D), q.dtype),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, T_pad, D), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, T_pad, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+        scratch_shapes=[
+            _scratch((block_q,)),
+            _scratch((block_q,)),
+            _scratch((block_q, D)),
+        ],
         interpret=interpret,
     )(q, k, v)
     return out[:, :T]
+
+
+def _scratch(shape):
+    from jax.experimental.pallas import tpu as pltpu
+
+    return pltpu.VMEM(shape, jnp.float32)
 
 
 def _dense_reference(q, k, v, causal, scale):

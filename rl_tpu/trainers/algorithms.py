@@ -45,6 +45,7 @@ from .on_policy import OnPolicyConfig, OnPolicyProgram
 from .trainer import CountFramesLog, LogScalar, Trainer
 
 __all__ = [
+    "make_a2c_trainer",
     "make_ppo_trainer",
     "make_sac_trainer",
     "make_dqn_trainer",
@@ -243,4 +244,33 @@ def make_td3_trainer(
     buffer = ReplayBuffer(DeviceStorage(buffer_capacity))
     cfg = config or OffPolicyConfig(init_random_frames=5000, policy_delay=2)
     program = OffPolicyProgram(coll, loss, buffer, cfg)
+    return _std_hooks(Trainer(program, total_steps, logger=logger), log_interval)
+
+
+def make_a2c_trainer(
+    env: EnvBase,
+    total_steps: int,
+    frames_per_batch: int = 1024,
+    gamma: float = 0.99,
+    lmbda: float = 0.95,
+    learning_rate: float = 7e-4,
+    logger: Logger | None = None,
+    log_interval: int = 10,
+    **loss_kwargs,
+) -> Trainer:
+    """A2C (reference A2CTrainer): single-epoch full-batch updates."""
+    from ..data.specs import Categorical as CatSpec
+    from ..objectives import A2CLoss
+
+    discrete = isinstance(env.action_spec, CatSpec)
+    actor = default_discrete_actor(env) if discrete else default_continuous_actor(env)
+    critic = ValueOperator(MLP(out_features=1, num_cells=(256, 256)))
+    loss = A2CLoss(actor, critic, **loss_kwargs)
+    loss.make_value_estimator(gamma=gamma, lmbda=lmbda)
+    coll = Collector(env, lambda p, td, k: actor(p["actor"], td, k), frames_per_batch=frames_per_batch)
+    program = OnPolicyProgram(
+        coll,
+        loss,
+        OnPolicyConfig(num_epochs=1, minibatch_size=frames_per_batch, learning_rate=learning_rate),
+    )
     return _std_hooks(Trainer(program, total_steps, logger=logger), log_interval)

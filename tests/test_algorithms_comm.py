@@ -145,3 +145,73 @@ class TestVideo:
         import os
 
         assert os.path.getsize(path) > 0
+
+
+class TestReplayService:
+    def test_remote_buffer_roundtrip(self):
+        from rl_tpu.data import (
+            ArrayDict,
+            DeviceStorage,
+            PrioritizedSampler,
+            RemoteReplayBuffer,
+            ReplayService,
+        )
+
+        example = ArrayDict(obs=jnp.zeros(3), reward=jnp.zeros(()))
+        from rl_tpu.data import ReplayBuffer
+
+        svc = ReplayService(
+            ReplayBuffer(DeviceStorage(64), PrioritizedSampler(), batch_size=8),
+            example,
+        ).start()
+        try:
+            host, port = svc.address
+            rb = RemoteReplayBuffer(host, port)
+            items = ArrayDict(
+                obs=jnp.arange(30.0).reshape(10, 3),
+                reward=jnp.arange(10.0),
+            )
+            assert rb.extend(items) == 10
+            assert rb.size() == 10
+            batch = rb.sample()
+            assert batch["obs"].shape == (8, 3)
+            rb.update_priority(np.arange(10), np.full(10, 2.0))
+            batch2 = rb.sample(batch_size=4)
+            assert batch2["obs"].shape == (4, 3)
+        finally:
+            svc.shutdown()
+
+
+class TestA2CBuilder:
+    def test_a2c_builder_runs(self):
+        from rl_tpu.envs import CartPoleEnv, RewardSum, TransformedEnv, VmapEnv
+        from rl_tpu.trainers.algorithms import make_a2c_trainer
+
+        env = TransformedEnv(VmapEnv(CartPoleEnv(), 4), RewardSum())
+        tr = make_a2c_trainer(env, total_steps=2, frames_per_batch=64)
+        tr.train(0)
+        assert tr.step_count == 2
+
+
+class TestMultiAgentGAE:
+    def test_per_agent_advantages(self):
+        from rl_tpu.objectives import MultiAgentGAE
+        from rl_tpu.data import ArrayDict
+
+        T, B, A = 6, 2, 3
+        value_net = lambda p, td: td.set("state_value", td["per_agent_value"])  # noqa: E731
+        est = MultiAgentGAE(value_net, gamma=0.9, lmbda=0.8)
+        batch = ArrayDict(
+            per_agent_value=jax.random.normal(KEY, (T, B, A)),
+            next=ArrayDict(
+                per_agent_value=jax.random.normal(KEY, (T, B, A)),
+                reward=jnp.ones((T, B)),
+                done=jnp.zeros((T, B), bool),
+                terminated=jnp.zeros((T, B), bool),
+            ),
+        )
+        out = est({}, batch)
+        assert out["advantage"].shape == (T, B, A)
+        # agents with different values get different advantages
+        adv = np.asarray(out["advantage"])
+        assert np.abs(adv[..., 0] - adv[..., 1]).max() > 1e-4

@@ -120,15 +120,27 @@ class TestPerf:
         fallback[np.arange(cap)] = vals
         us = np.random.default_rng(2).random(64) * vals.sum() * 0.999
 
-        # point updates dominate PER maintenance: native O(log N) vs O(N) scan
-        t0 = time.perf_counter()
-        for _ in range(200):
-            native[np.arange(64)] = vals[:64]
+        # point updates dominate PER maintenance: native O(log N) vs O(N)
+        # scan; min-of-runs to shrug off scheduler noise on a busy machine
+        def time_min(fn, runs=3, iters=200):
+            best = float("inf")
+            for _ in range(runs):
+                t0 = time.perf_counter()
+                for _ in range(iters):
+                    fn()
+                best = min(best, time.perf_counter() - t0)
+            return best
+
+        idx = np.arange(64)
+
+        def native_iter():
+            native[idx] = vals[:64]
             native.scan(us)
-        t_native = time.perf_counter() - t0
-        t0 = time.perf_counter()
-        for _ in range(200):
-            fallback[np.arange(64)] = vals[:64]
+
+        def fallback_iter():
+            fallback[idx] = vals[:64]
             fallback.scan(us)
-        t_fallback = time.perf_counter() - t0
+
+        t_native = time_min(native_iter)
+        t_fallback = time_min(fallback_iter)
         assert t_native < t_fallback, (t_native, t_fallback)

@@ -1,0 +1,152 @@
+"""Dreamer actor/value losses + CrossQ tests."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from rl_tpu.data import ArrayDict
+from rl_tpu.models import RSSM, RSSMConfig
+from rl_tpu.modules import (
+    MLP,
+    NormalParamExtractor,
+    ProbabilisticActor,
+    TanhNormal,
+    TDModule,
+    TDSequential,
+)
+from rl_tpu.objectives import CrossQLoss, DreamerActorLoss, DreamerValueLoss, imagine_rollout
+
+KEY = jax.random.key(0)
+
+
+def make_latent_actor(latent_dim, act_dim=2):
+    net = TDSequential(
+        TDModule(lambda h, z: jnp.concatenate([h, z], -1), ["h", "z"], ["feat"]),
+        TDModule(MLP(out_features=2 * act_dim, num_cells=(32,)), ["feat"], ["raw"]),
+        TDModule(NormalParamExtractor(), ["raw"], ["loc", "scale"]),
+    )
+    return ProbabilisticActor(net, TanhNormal)
+
+
+class TestDreamerActorValue:
+    def setup_method(self):
+        self.cfg = RSSMConfig(obs_dim=4, action_dim=2, deter_dim=16, stoch_dim=4, hidden=16)
+        self.rssm = RSSM(self.cfg)
+        self.rssm_params = self.rssm.init(KEY)
+        self.actor = make_latent_actor(self.cfg.deter_dim + self.cfg.stoch_dim)
+        td0 = ArrayDict(h=jnp.zeros((1, self.cfg.deter_dim)), z=jnp.zeros((1, self.cfg.stoch_dim)))
+        self.actor_params = self.actor.init(KEY, td0)
+        self.value = MLP(out_features=1, num_cells=(32,))
+        feat = jnp.zeros((1, self.cfg.deter_dim + self.cfg.stoch_dim))
+        self.value_params = self.value.init(KEY, feat)["params"]
+        self.params = {
+            "actor": self.actor_params,
+            "rssm": self.rssm_params,
+            "value": self.value_params,
+        }
+        self.batch = ArrayDict(
+            h=jax.random.normal(KEY, (3, 5, self.cfg.deter_dim)),
+            z=jax.random.normal(KEY, (3, 5, self.cfg.stoch_dim)),
+        )
+
+    def _value_fn(self, p, feat):
+        return self.value.apply({"params": p}, feat)[..., 0]
+
+    def test_imagination_shapes(self):
+        traj = imagine_rollout(
+            self.rssm, self.rssm_params,
+            lambda p, td, k: self.actor(p, td, k),
+            self.actor_params,
+            jnp.zeros((6, self.cfg.deter_dim)), jnp.zeros((6, self.cfg.stoch_dim)),
+            horizon=7, key=KEY,
+        )
+        assert traj["h"].shape == (7, 6, self.cfg.deter_dim)
+        assert traj["reward"].shape == (7, 6)
+
+    def test_actor_loss_grads_only_actor(self):
+        loss = DreamerActorLoss(
+            self.rssm, lambda p, td, k: self.actor(p, td, k), self._value_fn, horizon=5
+        )
+        (v, m), grads = jax.value_and_grad(
+            lambda p: loss(p, self.batch, KEY), has_aux=True
+        )(self.params)
+        assert np.isfinite(float(v))
+        ga = max(float(jnp.abs(g).max()) for g in jax.tree.leaves(grads["actor"]))
+        gr = max(float(jnp.abs(g).max()) for g in jax.tree.leaves(grads["rssm"]))
+        gv = max(float(jnp.abs(g).max()) for g in jax.tree.leaves(grads["value"]))
+        assert ga > 0 and gr == 0 and gv == 0
+
+    def test_value_loss_grads_only_value(self):
+        loss = DreamerValueLoss(
+            self.rssm, lambda p, td, k: self.actor(p, td, k), self._value_fn, horizon=5
+        )
+        (v, m), grads = jax.value_and_grad(
+            lambda p: loss(p, self.batch, KEY), has_aux=True
+        )(self.params)
+        gv = max(float(jnp.abs(g).max()) for g in jax.tree.leaves(grads["value"]))
+        ga = max(float(jnp.abs(g).max()) for g in jax.tree.leaves(grads["actor"]))
+        assert gv > 0 and ga == 0
+
+
+class TestCrossQ:
+    def make(self, obs_dim=4, act_dim=2):
+        net = TDSequential(
+            TDModule(MLP(out_features=2 * act_dim, num_cells=(32,)), ["observation"], ["raw"]),
+            TDModule(NormalParamExtractor(), ["raw"], ["loc", "scale"]),
+        )
+        actor = ProbabilisticActor(net, TanhNormal)
+        return CrossQLoss(actor, num_cells=(32, 32))
+
+    def batch(self, B=32):
+        ks = jax.random.split(KEY, 3)
+        return ArrayDict(
+            observation=jax.random.normal(ks[0], (B, 4)),
+            action=jax.random.uniform(ks[1], (B, 2), minval=-1, maxval=1),
+            next=ArrayDict(
+                observation=jax.random.normal(ks[2], (B, 4)),
+                reward=jnp.ones((B,)),
+                done=jnp.zeros((B,), bool),
+                terminated=jnp.zeros((B,), bool),
+            ),
+        )
+
+    def test_no_target_networks(self):
+        loss = self.make()
+        params = loss.init_params(KEY, self.batch()[0:1])
+        assert "target_qvalue" not in params
+        assert loss.target_keys == ()
+
+    def test_loss_updates_stats_and_trains(self):
+        loss = self.make()
+        batch = self.batch()
+        params = loss.init_params(KEY, batch[0:1])
+        opt = optax.adam(1e-3)
+        opt_state = opt.init(loss.trainable(params))
+
+        @jax.jit
+        def step(params, opt_state, key):
+            (v, m), g = jax.value_and_grad(
+                lambda tr: loss({**params, **tr}, batch, key), has_aux=True
+            )(loss.trainable(params))
+            upd, opt_state = opt.update(g, opt_state)
+            tr = optax.apply_updates(loss.trainable(params), upd)
+            new_params = {**params, **tr, "batch_stats": m["batch_stats"]}
+            return new_params, opt_state, v
+
+        stats0 = jax.tree.leaves(params["batch_stats"])[0].copy()
+        key = KEY
+        vals = []
+        for _ in range(10):
+            key, k = jax.random.split(key)
+            params, opt_state, v = step(params, opt_state, k)
+            vals.append(float(v))
+        assert all(np.isfinite(v) for v in vals)
+        stats1 = jax.tree.leaves(params["batch_stats"])[0]
+        assert float(jnp.abs(stats1 - stats0).max()) > 0, "running stats never updated"
+
+    def test_batch_stats_not_trainable(self):
+        loss = self.make()
+        params = loss.init_params(KEY, self.batch()[0:1])
+        assert "batch_stats" not in loss.trainable(params)
