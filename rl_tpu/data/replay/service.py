@@ -44,10 +44,13 @@ def _decode(payload: dict) -> ArrayDict:
 class ReplayService:
     """Own a buffer + its state; serve it over TCP."""
 
-    def __init__(self, buffer: ReplayBuffer, example: ArrayDict, host="127.0.0.1", port=0):
+    def __init__(
+        self, buffer: ReplayBuffer, example: ArrayDict, host="127.0.0.1", port=0,
+        seed: int = 0,
+    ):
         self.buffer = buffer
         self.state = buffer.init(example)
-        self._key = jax.random.key(0)
+        self._key = jax.random.key(seed)
         # TCPCommandServer is threading: serialize state updates or
         # concurrent extend/sample would read-modify-write the same state
         # and silently drop data

@@ -33,6 +33,7 @@ __all__ = [
     "OneHotCategorical",
     "MaskedCategorical",
     "Ordinal",
+    "OneHotOrdinal",
 ]
 
 _LOG_2PI = jnp.log(2.0 * jnp.pi)
@@ -399,6 +400,34 @@ class MaskedCategorical(Distribution):
     @property
     def probs(self):
         return jax.nn.softmax(self.masked_logits, axis=-1)
+
+
+@_register
+@dataclasses.dataclass(frozen=True)
+class OneHotOrdinal(Distribution):
+    """One-hot-valued ordinal (reference OneHotOrdinal, discrete.py:668)."""
+
+    logits: Any
+    event_ndim: ClassVar[int] = 1
+
+    def _base(self):
+        return Ordinal(self.logits)
+
+    def sample(self, key, sample_shape=()):
+        idx = self._base().sample(key, sample_shape)
+        n = jnp.shape(self.logits)[-1]
+        return jax.nn.one_hot(idx, n, dtype=jnp.asarray(self.logits).dtype)
+
+    def log_prob(self, x):
+        return self._base().log_prob(jnp.argmax(x, axis=-1))
+
+    def entropy(self):
+        return self._base().entropy()
+
+    @property
+    def mode(self):
+        n = jnp.shape(self.logits)[-1]
+        return jax.nn.one_hot(self._base().mode, n, dtype=jnp.asarray(self.logits).dtype)
 
 
 @_register

@@ -25,6 +25,8 @@ __all__ = [
     "DuelingMLP",
     "NoisyDense",
     "NormalParamExtractor",
+    "GSDEModule",
+    "ConsistentDropout",
     "init_ensemble",
     "apply_ensemble",
 ]
@@ -221,6 +223,52 @@ class TanhPolicy(nn.Module):
         )(x)
         t = jnp.tanh(out)
         return (t + 1.0) * 0.5 * (self.high - self.low) + self.low
+
+
+class GSDEModule(nn.Module):
+    """Generalized state-dependent exploration head (reference gSDEModule,
+    models/exploration.py:280): noise = eps_matrix @ features, with the
+    exploration matrix resampled via the "noise" rng collection (hold it
+    fixed across an episode for temporally-coherent exploration).
+
+    Returns (action_mean + noise, action_mean) so losses can use the
+    deterministic mean.
+    """
+
+    action_dim: int
+    log_sigma_init: float = -0.5
+
+    @nn.compact
+    def __call__(self, features, action_mean):
+        latent = features.shape[-1]
+        log_sigma = self.param(
+            "log_sigma", nn.initializers.constant(self.log_sigma_init),
+            (latent, self.action_dim),
+        )
+        sigma = jnp.exp(log_sigma)
+        if self.has_rng("noise"):
+            eps = jax.random.normal(self.make_rng("noise"), (latent, self.action_dim))
+        else:
+            eps = jnp.zeros((latent, self.action_dim))
+        noise = features @ (sigma * eps)
+        return action_mean + noise, action_mean
+
+
+class ConsistentDropout(nn.Module):
+    """Dropout with an externally-carried mask (reference ConsistentDropout,
+    models/exploration.py:571): the SAME mask applies across an episode —
+    sample it once per reset via ``make_mask`` and pass it in each step."""
+
+    rate: float = 0.1
+
+    def make_mask(self, key, shape):
+        return jax.random.bernoulli(key, 1.0 - self.rate, shape)
+
+    @nn.compact
+    def __call__(self, x, mask=None):
+        if mask is None:
+            return x
+        return jnp.where(mask, x / (1.0 - self.rate), 0.0)
 
 
 class NormalParamExtractor(nn.Module):

@@ -21,7 +21,7 @@ import jax.numpy as jnp
 
 from ..data import ArrayDict
 from ..modules.networks import _activation
-from .common import LossModule, hold_out
+from .common import LossModule, bootstrap_discount, hold_out
 
 __all__ = ["BatchNormMLP", "CrossQLoss"]
 
@@ -157,7 +157,9 @@ class CrossQLoss(LossModule):
         next_v = jnp.min(jax.lax.stop_gradient(q_next), axis=0) - alpha * next_lp
         reward = batch["next", "reward"]
         not_term = 1.0 - batch["next", "terminated"].astype(jnp.float32)
-        target = jax.lax.stop_gradient(reward + self.gamma * not_term * next_v)
+        target = jax.lax.stop_gradient(
+            reward + bootstrap_discount(batch, self.gamma) * not_term * next_v
+        )
         td_error = q_cur - target[None]
         loss_qvalue = 0.5 * jnp.mean(jnp.sum(td_error**2, axis=0))
 

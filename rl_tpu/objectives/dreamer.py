@@ -118,8 +118,9 @@ class DreamerActorLoss(LossModule):
 
 class DreamerValueLoss(LossModule):
     """Regress the value head onto λ-returns of imagined rollouts
-    (reference :373). Uses the SAME imagination as the actor loss (pass the
-    traj through ``precomputed``) or re-imagines under a stop-grad actor."""
+    (reference :373). Re-imagines under a stop-gradient actor each call
+    (sharing one imagination between actor and value losses is a planned
+    optimization — for now each loss runs its own horizon scan)."""
 
     def __init__(self, rssm: RSSM, actor, value_fn, horizon: int = 15, gamma=0.99, lmbda=0.95):
         self.rssm = rssm

@@ -7,6 +7,7 @@ from .trainer import (
     LogScalar,
     LogTiming,
     Trainer,
+    UTDRHook,
 )
 
 __all__ = [
@@ -19,5 +20,6 @@ __all__ = [
     "LogTiming",
     "CountFramesLog",
     "EarlyStopping",
+    "UTDRHook",
     "Evaluator",
 ]

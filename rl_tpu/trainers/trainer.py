@@ -30,7 +30,7 @@ from ..record.loggers import Logger, NullLogger
 from ..utils import logger as _log
 from ..utils.timing import timeit
 
-__all__ = ["Trainer", "LogScalar", "LogTiming", "CountFramesLog", "EarlyStopping", "Evaluator"]
+__all__ = ["Trainer", "LogScalar", "LogTiming", "CountFramesLog", "EarlyStopping", "UTDRHook", "Evaluator"]
 
 STAGES = ("pre_step", "post_step", "post_eval", "save_checkpoint")
 
@@ -233,6 +233,28 @@ class EarlyStopping:
                 trainer.request_stop()
         else:
             self._count = 0
+
+
+class UTDRHook:
+    """Log the update-to-data ratio (reference UTDRHook, trainers.py:2978):
+    gradient updates per collected frame, from the program's config."""
+
+    def __init__(self, interval: int = 10):
+        self.interval = interval
+
+    def __call__(self, trainer: Trainer, metrics=None) -> None:
+        if trainer.step_count % self.interval:
+            return
+        cfg = getattr(trainer.program, "config", None)
+        utd = getattr(cfg, "utd_ratio", None)
+        if utd is None:
+            return
+        updates = trainer.step_count * utd
+        trainer.logger.log_scalar(
+            "train/utd_ratio",
+            updates * getattr(cfg, "batch_size", 1) / max(trainer.collected_frames, 1),
+            step=trainer.collected_frames,
+        )
 
 
 class Evaluator:

@@ -150,3 +150,10 @@ class TestCrossQ:
         loss = self.make()
         params = loss.init_params(KEY, self.batch()[0:1])
         assert "batch_stats" not in loss.trainable(params)
+
+    def test_crossq_nstep_discount(self):
+        loss = self.make()
+        batch = self.batch().set("steps_to_next_obs", jnp.full((32,), 3, jnp.int32))
+        params = loss.init_params(KEY, batch[0:1])
+        v, m = loss(params, batch, KEY)
+        assert np.isfinite(float(v))  # gamma**n path traces cleanly

@@ -239,3 +239,49 @@ class TestConvNet:
         params = net.init(KEY, x)["params"]
         out = net.apply({"params": params}, x)
         assert out.ndim == 2 and out.shape[0] == 2
+
+
+class TestSmallExplorationModels:
+    def test_gsde_noise_consistent_per_key(self):
+        from rl_tpu.modules import GSDEModule
+
+        m = GSDEModule(action_dim=2)
+        feats = jax.random.normal(KEY, (4, 8))
+        mean = jnp.zeros((4, 2))
+        params = m.init({"params": KEY, "noise": KEY}, feats, mean)["params"]
+        a1, _ = m.apply({"params": params}, feats, mean, rngs={"noise": jax.random.key(5)})
+        a2, _ = m.apply({"params": params}, feats, mean, rngs={"noise": jax.random.key(5)})
+        a3, _ = m.apply({"params": params}, feats, mean, rngs={"noise": jax.random.key(6)})
+        np.testing.assert_array_equal(np.asarray(a1), np.asarray(a2))  # same eps
+        assert np.abs(np.asarray(a1) - np.asarray(a3)).max() > 1e-6
+        # state-dependent: different features -> different noise
+        a4, _ = m.apply({"params": params}, feats * 2, mean, rngs={"noise": jax.random.key(5)})
+        assert np.abs(np.asarray(a1) - np.asarray(a4)).max() > 1e-6
+        # no rng -> deterministic mean
+        a5, mu = m.apply({"params": params}, feats, mean)
+        np.testing.assert_array_equal(np.asarray(a5), np.asarray(mu))
+
+    def test_consistent_dropout_mask_reuse(self):
+        from rl_tpu.modules import ConsistentDropout
+
+        d = ConsistentDropout(rate=0.5)
+        mask = d.make_mask(KEY, (4, 8))
+        x = jnp.ones((4, 8))
+        params = d.init(KEY, x, mask)
+        y1 = d.apply(params, x, mask)
+        y2 = d.apply(params, x, mask)
+        np.testing.assert_array_equal(np.asarray(y1), np.asarray(y2))
+        # kept units upscaled, dropped zeroed
+        kept = np.asarray(mask)
+        assert (np.asarray(y1)[kept] == 2.0).all()
+        assert (np.asarray(y1)[~kept] == 0.0).all()
+
+    def test_one_hot_ordinal(self):
+        from rl_tpu.modules import OneHotOrdinal
+
+        d = OneHotOrdinal(logits=5.0 * jnp.ones(4))
+        m = np.asarray(d.mode)
+        np.testing.assert_array_equal(m, [0, 0, 0, 1])
+        s = d.sample(KEY)
+        assert float(s.sum()) == 1.0
+        assert np.isfinite(float(d.log_prob(d.mode)))
