@@ -7,6 +7,7 @@ from .samplers import (
     Sampler,
     SamplerWithoutReplacement,
     SliceSampler,
+    StalenessAwareSampler,
 )
 from .storages import DeviceStorage, ListStorage, MemmapStorage, Storage
 from .writers import ImmutableDatasetWriter, MaxValueWriter, RoundRobinWriter, Writer
@@ -25,6 +26,7 @@ __all__ = [
     "SamplerWithoutReplacement",
     "PrioritizedSampler",
     "SliceSampler",
+    "StalenessAwareSampler",
     "Writer",
     "RoundRobinWriter",
     "MaxValueWriter",

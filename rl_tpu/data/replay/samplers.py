@@ -29,6 +29,7 @@ from ..arraydict import ArrayDict
 
 __all__ = [
     "Sampler",
+    "StalenessAwareSampler",
     "RandomSampler",
     "SamplerWithoutReplacement",
     "PrioritizedSampler",
@@ -173,6 +174,34 @@ class PrioritizedSampler(Sampler):
         prio = sstate["priorities"].at[idx].set(priority)
         max_p = jnp.maximum(sstate["max_priority"], jnp.max(priority))
         return sstate.replace(priorities=prio, max_priority=max_p)
+
+
+class StalenessAwareSampler(Sampler):
+    """Uniform sampling with staleness importance weights (reference
+    StalenessAwareSampler, samplers.py:735): each slot records the global
+    write version; samples carry "staleness" (current - written) and a
+    downweighting ``(1 + staleness)^-eta`` in "_weight" so losses can
+    discount stale off-policy data."""
+
+    def __init__(self, eta: float = 1.0):
+        self.eta = eta
+
+    def init(self, capacity: int) -> ArrayDict:
+        return ArrayDict(
+            written=jnp.zeros((capacity,), jnp.int32),
+            version=jnp.asarray(0, jnp.int32),
+        )
+
+    def on_write(self, sstate, idx, items):
+        v = sstate["version"] + 1
+        return ArrayDict(written=sstate["written"].at[idx].set(v), version=v)
+
+    def sample(self, sstate, key, batch_size, size, capacity):
+        idx = jax.random.randint(key, (batch_size,), 0, jnp.maximum(size, 1))
+        staleness = (sstate["version"] - sstate["written"][idx]).astype(jnp.float32)
+        weight = jnp.power(1.0 + staleness, -self.eta)
+        info = ArrayDict(staleness=staleness, _weight=weight)
+        return idx, info, sstate
 
 
 class SliceSampler(Sampler):

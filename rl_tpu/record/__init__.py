@@ -1,3 +1,4 @@
+from .service import LoggerService, RemoteLogger
 from .loggers import (
     CSVLogger,
     Logger,
@@ -10,6 +11,8 @@ from .loggers import (
 )
 
 __all__ = [
+    "LoggerService",
+    "RemoteLogger",
     "Logger",
     "CSVLogger",
     "TensorboardLogger",

@@ -1,0 +1,83 @@
+"""Remote logger service: many processes log to one sink over TCP.
+
+Redesign of the reference's logger-as-service (reference:
+torchrl/record/loggers/_service.py + process.py — a logger living in a
+separate process receiving log calls from workers): the sink wraps any
+rl_tpu Logger behind a TCPCommandServer; workers hold a LoggerClient that
+satisfies the Logger API.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Mapping
+
+import numpy as np
+
+from ..comm import TCPCommandClient, TCPCommandServer
+from .loggers import Logger
+
+__all__ = ["LoggerService", "RemoteLogger"]
+
+
+class LoggerService:
+    """Serve a concrete Logger over TCP."""
+
+    def __init__(self, logger: Logger, host: str = "127.0.0.1", port: int = 0):
+        self.logger = logger
+        # handler threads share one sink: serialize (CSV writers etc. are
+        # not thread-safe; same hazard the ReplayService guards against)
+        self._lock = threading.Lock()
+        self.server = TCPCommandServer(host, port)
+        self.server.register_handler("log_scalar", self._scalar)
+        self.server.register_handler("log_scalars", self._scalars)
+        self.server.register_handler("log_hparams", self._hparams)
+
+    @property
+    def address(self):
+        return self.server.address
+
+    def start(self) -> "LoggerService":
+        self.server.start()
+        return self
+
+    def shutdown(self):
+        self.server.shutdown()
+
+    def _scalar(self, p):
+        with self._lock:
+            self.logger.log_scalar(p["name"], float(p["value"]), p.get("step"))
+        return True
+
+    def _scalars(self, p):
+        with self._lock:
+            self.logger.log_scalars(p["metrics"], p.get("step"))
+        return True
+
+    def _hparams(self, p):
+        with self._lock:
+            self.logger.log_hparams(p["hparams"])
+        return True
+
+
+class RemoteLogger(Logger):
+    """Logger-API client for a LoggerService (videos/histograms are dropped —
+    ship arrays through the replay-style npz channel if needed)."""
+
+    def __init__(self, host: str, port: int, exp_name: str = "remote"):
+        super().__init__(exp_name)
+        self.client = TCPCommandClient(host, port)
+
+    def log_scalar(self, name, value, step=None):
+        self.client.call("log_scalar", {"name": name, "value": float(value), "step": step})
+
+    def log_scalars(self, metrics: Mapping[str, Any], step=None):
+        clean = {}
+        for k, v in metrics.items():
+            arr = np.asarray(v)
+            if arr.ndim == 0 and np.issubdtype(arr.dtype, np.number):
+                clean[k] = float(arr)
+        self.client.call("log_scalars", {"metrics": clean, "step": step})
+
+    def log_hparams(self, hparams):
+        self.client.call("log_hparams", {"hparams": {k: str(v) for k, v in dict(hparams).items()}})

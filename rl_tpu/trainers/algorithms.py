@@ -38,7 +38,7 @@ from ..modules import (
     TDSequential,
     ValueOperator,
 )
-from ..objectives import ClipPPOLoss, DQNLoss, SACLoss, TD3Loss
+from ..objectives import ClipPPOLoss, DQNLoss, SACLoss, SoftUpdate, TD3Loss
 from ..record.loggers import Logger
 from .off_policy import OffPolicyConfig, OffPolicyProgram
 from .on_policy import OnPolicyConfig, OnPolicyProgram
@@ -46,6 +46,8 @@ from .trainer import CountFramesLog, LogScalar, Trainer
 
 __all__ = [
     "make_a2c_trainer",
+    "make_iql_trainer",
+    "make_cql_trainer",
     "make_ppo_trainer",
     "make_sac_trainer",
     "make_dqn_trainer",
@@ -274,3 +276,102 @@ def make_a2c_trainer(
         OnPolicyConfig(num_epochs=1, minibatch_size=frames_per_batch, learning_rate=learning_rate),
     )
     return _std_hooks(Trainer(program, total_steps, logger=logger), log_interval)
+
+
+def _offline_loop(loss, buffer_state, rb, total_steps, batch_size, learning_rate, logger, log_interval):
+    """Shared offline-training driver for IQL/CQL builders."""
+    import optax
+
+    from ..record.loggers import NullLogger
+
+    logger = logger or NullLogger()
+    example = buffer_state["storage", "data"][0:1]
+    params = loss.init_params(jax.random.key(0), example)
+    opt = optax.adam(learning_rate)
+    opt_state = opt.init(loss.trainable(params))
+    update = SoftUpdate(loss, tau=0.005)
+
+    @jax.jit
+    def step(params, opt_state, bstate, key):
+        k_s, k_l = jax.random.split(key)
+        batch, bstate = rb.sample(bstate, k_s, batch_size)
+        loss_val, grads, metrics = loss.grad(params, batch, k_l)
+        upd, opt_state = opt.update(grads, opt_state, loss.trainable(params))
+        tr = optax.apply_updates(loss.trainable(params), upd)
+        params = update(loss.merge(tr, params))
+        return params, opt_state, bstate, metrics.set("loss", loss_val)
+
+    key = jax.random.key(1)
+    for i in range(total_steps):
+        key, k = jax.random.split(key)
+        params, opt_state, buffer_state, metrics = step(params, opt_state, buffer_state, k)
+        if i % log_interval == 0:
+            logger.log_scalars(
+                {f"train/{'/'.join(kk)}": v for kk, v in metrics.items(nested=True, leaves_only=True)},
+                step=i,
+            )
+    return params
+
+
+def make_iql_trainer(
+    dataset_buffer,
+    dataset_state,
+    total_steps: int,
+    batch_size: int = 256,
+    learning_rate: float = 3e-4,
+    expectile: float = 0.7,
+    temperature: float = 3.0,
+    logger: Logger | None = None,
+    log_interval: int = 100,
+):
+    """Offline IQL over a loaded dataset buffer (reference IQLTrainer):
+    returns trained params = {actor, qvalue, value, target_qvalue}."""
+    from ..objectives import IQLLoss
+
+    actor = _offline_continuous_actor(dataset_state["storage", "data"][0:1])
+    loss = IQLLoss(
+        actor,
+        ConcatMLP(out_features=1, num_cells=(256, 256)),
+        MLP(out_features=1, num_cells=(256, 256)),
+        expectile=expectile,
+        temperature=temperature,
+    )
+    return _offline_loop(
+        loss, dataset_state, dataset_buffer, total_steps, batch_size,
+        learning_rate, logger, log_interval,
+    )
+
+
+def make_cql_trainer(
+    dataset_buffer,
+    dataset_state,
+    total_steps: int,
+    batch_size: int = 256,
+    learning_rate: float = 3e-4,
+    cql_alpha: float = 1.0,
+    logger: Logger | None = None,
+    log_interval: int = 100,
+):
+    """Offline continuous CQL over a loaded dataset buffer (reference
+    CQLTrainer)."""
+    from ..objectives import CQLLoss
+
+    actor = _offline_continuous_actor(dataset_state["storage", "data"][0:1])
+    loss = CQLLoss(
+        actor,
+        ConcatMLP(out_features=1, num_cells=(256, 256)),
+        cql_alpha=cql_alpha,
+    )
+    return _offline_loop(
+        loss, dataset_state, dataset_buffer, total_steps, batch_size,
+        learning_rate, logger, log_interval,
+    )
+
+
+def _offline_continuous_actor(example) -> ProbabilisticActor:
+    act_dim = example["action"].shape[-1]
+    net = TDSequential(
+        TDModule(MLP(out_features=2 * act_dim, num_cells=(256, 256)), ["observation"], ["raw"]),
+        TDModule(NormalParamExtractor(), ["raw"], ["loc", "scale"]),
+    )
+    return ProbabilisticActor(net, TanhNormal)

@@ -215,3 +215,69 @@ class TestMultiAgentGAE:
         # agents with different values get different advantages
         adv = np.asarray(out["advantage"])
         assert np.abs(adv[..., 0] - adv[..., 1]).max() > 1e-4
+
+
+class TestRemoteLogger:
+    def test_remote_logging_roundtrip(self, tmp_path):
+        from rl_tpu.record import CSVLogger, LoggerService, RemoteLogger
+        import os
+
+        sink = CSVLogger("remote_exp", log_dir=str(tmp_path))
+        svc = LoggerService(sink).start()
+        try:
+            host, port = svc.address
+            rl = RemoteLogger(host, port)
+            rl.log_scalar("a", 1.5, step=3)
+            rl.log_scalars({"b": 2.5, "skip_me": np.zeros(3)}, step=4)
+            rl.log_hparams({"lr": 1e-3})
+        finally:
+            svc.shutdown()
+        files = os.listdir(tmp_path / "remote_exp")
+        assert "a.csv" in files and "b.csv" in files and "hparams.json" in files
+
+
+class TestStalenessSampler:
+    def test_staleness_weights(self):
+        from rl_tpu.data import ArrayDict as AD, DeviceStorage, ReplayBuffer, StalenessAwareSampler
+
+        rb = ReplayBuffer(DeviceStorage(32), StalenessAwareSampler(eta=1.0), batch_size=64)
+        st = rb.init(AD(x=jnp.zeros(())))
+        st = rb.extend(st, AD(x=jnp.arange(8.0)))      # version 1
+        st = rb.extend(st, AD(x=jnp.arange(8.0, 16.0)))  # version 2
+        batch, _ = rb.sample(st, KEY)
+        stal = np.asarray(batch["staleness"])
+        w = np.asarray(batch["_weight"])
+        idx = np.asarray(batch["index"])
+        assert set(np.unique(stal[idx < 8])) == {1.0}
+        assert set(np.unique(stal[idx >= 8])) == {0.0}
+        np.testing.assert_allclose(w, (1.0 + stal) ** -1.0)
+
+
+class TestOfflineBuilders:
+    def test_iql_builder_trains_on_synthetic(self):
+        from rl_tpu.data import dataset_from_arrays
+        from rl_tpu.trainers.algorithms import make_iql_trainer
+
+        rng = np.random.default_rng(0)
+        n = 256
+        obs = rng.normal(size=(n, 3)).astype(np.float32)
+        act = np.tanh(obs[:, :2]).astype(np.float32)
+        rew = -np.abs(obs[:, 0]).astype(np.float32)
+        term = np.zeros(n, bool); term[63::64] = True
+        rb, state = dataset_from_arrays(obs, act, rew, term)
+        params = make_iql_trainer(rb, state, total_steps=5, batch_size=64)
+        assert "value" in params and "target_qvalue" in params
+
+    def test_cql_builder_trains_on_synthetic(self):
+        from rl_tpu.data import dataset_from_arrays
+        from rl_tpu.trainers.algorithms import make_cql_trainer
+
+        rng = np.random.default_rng(0)
+        n = 128
+        obs = rng.normal(size=(n, 3)).astype(np.float32)
+        act = rng.uniform(-1, 1, size=(n, 2)).astype(np.float32)
+        rew = np.ones(n, np.float32)
+        term = np.zeros(n, bool)
+        rb, state = dataset_from_arrays(obs, act, rew, term)
+        params = make_cql_trainer(rb, state, total_steps=3, batch_size=32)
+        assert "qvalue" in params
