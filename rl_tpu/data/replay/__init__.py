@@ -7,6 +7,8 @@ from .samplers import (
     Sampler,
     SamplerWithoutReplacement,
     SliceSampler,
+    PrioritizedSliceSampler,
+    SliceSamplerWithoutReplacement,
     StalenessAwareSampler,
 )
 from .storages import DeviceStorage, ListStorage, MemmapStorage, Storage
@@ -26,6 +28,8 @@ __all__ = [
     "SamplerWithoutReplacement",
     "PrioritizedSampler",
     "SliceSampler",
+    "SliceSamplerWithoutReplacement",
+    "PrioritizedSliceSampler",
     "StalenessAwareSampler",
     "Writer",
     "RoundRobinWriter",

@@ -34,6 +34,8 @@ __all__ = [
     "SamplerWithoutReplacement",
     "PrioritizedSampler",
     "SliceSampler",
+    "SliceSamplerWithoutReplacement",
+    "PrioritizedSliceSampler",
 ]
 
 
@@ -177,14 +179,15 @@ class PrioritizedSampler(Sampler):
 
 
 class StalenessAwareSampler(Sampler):
-    """Uniform sampling with staleness importance weights (reference
-    StalenessAwareSampler, samplers.py:735): each slot records the global
-    write version; samples carry "staleness" (current - written) and a
-    downweighting ``(1 + staleness)^-eta`` in "_weight" so losses can
-    discount stale off-policy data."""
+    """Freshness-weighted sampling (reference StalenessAwareSampler,
+    samplers.py:735): each slot records the global write version; sampling
+    probability is proportional to ``(1 + staleness)^-eta`` and entries
+    older than ``max_staleness`` versions are excluded outright. Samples
+    also carry "staleness" for diagnostics."""
 
-    def __init__(self, eta: float = 1.0):
+    def __init__(self, eta: float = 1.0, max_staleness: int | None = None):
         self.eta = eta
+        self.max_staleness = max_staleness
 
     def init(self, capacity: int) -> ArrayDict:
         return ArrayDict(
@@ -197,10 +200,19 @@ class StalenessAwareSampler(Sampler):
         return ArrayDict(written=sstate["written"].at[idx].set(v), version=v)
 
     def sample(self, sstate, key, batch_size, size, capacity):
-        idx = jax.random.randint(key, (batch_size,), 0, jnp.maximum(size, 1))
-        staleness = (sstate["version"] - sstate["written"][idx]).astype(jnp.float32)
-        weight = jnp.power(1.0 + staleness, -self.eta)
-        info = ArrayDict(staleness=staleness, _weight=weight)
+        stal_all = (sstate["version"] - sstate["written"]).astype(jnp.float32)
+        mask = jnp.arange(capacity) < size
+        if self.max_staleness is not None:
+            mask = mask & (stal_all <= self.max_staleness)
+        w = jnp.where(mask, jnp.power(1.0 + stal_all, -self.eta), 0.0)
+        csum = jnp.cumsum(w)
+        # fall back to uniform-over-filled when everything is gated out
+        any_mass = csum[-1] > 0
+        u = jax.random.uniform(key, (batch_size,)) * jnp.where(any_mass, csum[-1], 1.0)
+        idx_w = jnp.clip(jnp.searchsorted(csum, u, side="right"), 0, capacity - 1)
+        idx_u = jax.random.randint(key, (batch_size,), 0, jnp.maximum(size, 1))
+        idx = jnp.where(any_mass, idx_w, idx_u)
+        info = ArrayDict(staleness=stal_all[idx])
         return idx, info, sstate
 
 
@@ -256,4 +268,143 @@ class SliceSampler(Sampler):
         idx = (chosen[:, None] + window[None, :]).reshape(-1)
         step_mask = jnp.repeat(any_ok, self.slice_len)
         info = ArrayDict(valid_slices=any_ok, mask=step_mask)
+        return idx, info, sstate
+
+
+class SliceSamplerWithoutReplacement(SliceSampler):
+    """Epoch-style trajectory-slice sampling (reference
+    SliceSamplerWithoutReplacement, samplers.py:2789): each epoch permutes
+    all candidate start positions and walks them in order, so no slice start
+    repeats until the pass completes. Starts whose window crosses an episode
+    boundary are masked invalid in "mask"/"valid_slices" (jit-safe
+    alternative to dynamic filtering; consumers already honor the mask).
+    """
+
+    def init(self, capacity: int) -> ArrayDict:
+        base = super().init(capacity)
+        return base.update(
+            ArrayDict(
+                pos=jnp.asarray(0, jnp.int32),
+                epoch=jnp.asarray(0, jnp.int32),
+                epoch_key=jax.random.key(0),
+            )
+        )
+
+    def sample(self, sstate, key, batch_size, size, capacity):
+        from ...utils.seeding import ensure_typed_key
+
+        key = ensure_typed_key(key)
+        num_slices = batch_size // self.slice_len
+        hi = jnp.maximum(size - self.slice_len + 1, 1)
+        pos = sstate["pos"]
+        need_reshuffle = (pos + num_slices > hi) | (sstate["epoch"] == 0)
+        epoch_key = jax.lax.select(need_reshuffle, key, sstate["epoch_key"])
+        pos = jnp.where(need_reshuffle, 0, pos)
+
+        perm = jax.random.permutation(epoch_key, capacity)
+        valid_start = perm < hi
+        rank = jnp.cumsum(valid_start) - 1
+        target = jnp.where(valid_start, rank, capacity)
+        order = jnp.zeros((capacity,), perm.dtype).at[target].set(perm, mode="drop")
+        wanted = (pos + jnp.arange(num_slices)) % hi
+        starts = order[wanted]
+
+        window = jnp.arange(self.slice_len)
+        tids = sstate["traj_ids"]
+
+        def valid(start):
+            w = tids[start + window]
+            return jnp.all(w == w[0]) & (w[0] >= 0)
+
+        ok = jax.vmap(valid)(starts)
+        idx = (starts[:, None] + window[None, :]).reshape(-1)
+        info = ArrayDict(valid_slices=ok, mask=jnp.repeat(ok, self.slice_len))
+        new_state = sstate.replace(
+            pos=pos + num_slices,
+            epoch=sstate["epoch"] + need_reshuffle.astype(jnp.int32),
+            epoch_key=epoch_key,
+        )
+        return idx, info, new_state
+
+
+class PrioritizedSliceSampler(SliceSampler):
+    """PER over trajectory slices (reference PrioritizedSliceSampler,
+    samplers.py:3091): each start position's priority is its element's PER
+    priority; invalid starts (window crossing an episode boundary) get zero
+    mass. update_priority is element-wise like PrioritizedSampler.
+    """
+
+    def __init__(
+        self,
+        slice_len: int,
+        alpha: float = 0.6,
+        beta: float = 0.4,
+        eps: float = 1e-8,
+        traj_key=("collector", "traj_ids"),
+    ):
+        super().__init__(slice_len, traj_key=traj_key)
+        self.alpha = alpha
+        self.beta = beta
+        self.eps = eps
+
+    def init(self, capacity: int) -> ArrayDict:
+        base = super().init(capacity)
+        return base.update(
+            ArrayDict(
+                priorities=jnp.zeros((capacity,), jnp.float32),
+                max_priority=jnp.asarray(1.0, jnp.float32),
+            )
+        )
+
+    def on_write(self, sstate, idx, items):
+        sstate = super().on_write(sstate, idx, items)
+        prio = sstate["priorities"].at[idx].set(sstate["max_priority"])
+        return sstate.set("priorities", prio)
+
+    def update_priority(self, sstate, idx, priority):
+        priority = jnp.abs(priority) + self.eps
+        prio = sstate["priorities"].at[idx].set(priority)
+        return sstate.replace(
+            priorities=prio,
+            max_priority=jnp.maximum(sstate["max_priority"], jnp.max(priority)),
+        )
+
+    def sample(self, sstate, key, batch_size, size, capacity):
+        num_slices = batch_size // self.slice_len
+        window = jnp.arange(self.slice_len)
+        tids = sstate["traj_ids"]
+        positions = jnp.arange(capacity)
+        hi = jnp.maximum(size - self.slice_len + 1, 1)
+
+        def start_ok(start):
+            w = tids[jnp.minimum(start + window, capacity - 1)]
+            return jnp.all(w == w[0]) & (w[0] >= 0) & (start < hi)
+
+        valid = jax.vmap(start_ok)(positions)
+        p_alpha = jnp.where(
+            valid, jnp.power(sstate["priorities"] + self.eps, self.alpha), 0.0
+        )
+        csum = jnp.cumsum(p_alpha)
+        total = jnp.clip(csum[-1], 1e-12)
+        u = jax.random.uniform(key, (num_slices,)) * total
+        starts = jnp.clip(jnp.searchsorted(csum, u, side="right"), 0, capacity - 1)
+
+        probs = p_alpha / total
+        n = jnp.clip(jnp.sum(valid.astype(jnp.float32)), 1.0)
+        weights = jnp.power(n * jnp.clip(probs[starts], 1e-12), -self.beta)
+        # normalize by the max POSSIBLE weight (min valid prob), like
+        # PrioritizedSampler — per-batch max would rescale the loss with
+        # sampling luck
+        min_prob = jnp.min(jnp.where(valid, probs, jnp.inf))
+        max_w = jnp.power(n * jnp.clip(min_prob, 1e-12), -self.beta)
+        weights = weights / jnp.clip(max_w, 1e-12)
+
+        idx = (starts[:, None] + window[None, :]).reshape(-1)
+        ok = valid[starts]
+        info = ArrayDict(
+            valid_slices=ok,
+            mask=jnp.repeat(ok, self.slice_len),
+            _weight=jnp.repeat(weights, self.slice_len),
+            start_index=starts,
+        )
         return idx, info, sstate

@@ -62,14 +62,21 @@ class LoggerService:
 
 class RemoteLogger(Logger):
     """Logger-API client for a LoggerService (videos/histograms are dropped —
-    ship arrays through the replay-style npz channel if needed)."""
+    ship arrays through the replay-style npz channel if needed).
+
+    Each call is a synchronous TCP round-trip: batch metrics through
+    ``log_scalars`` on hot paths (a persistent/fire-and-forget channel is a
+    planned optimization)."""
 
     def __init__(self, host: str, port: int, exp_name: str = "remote"):
         super().__init__(exp_name)
         self.client = TCPCommandClient(host, port)
 
     def log_scalar(self, name, value, step=None):
-        self.client.call("log_scalar", {"name": name, "value": float(value), "step": step})
+        self.client.call(
+            "log_scalar",
+            {"name": name, "value": float(value), "step": None if step is None else int(step)},
+        )
 
     def log_scalars(self, metrics: Mapping[str, Any], step=None):
         clean = {}
@@ -77,7 +84,7 @@ class RemoteLogger(Logger):
             arr = np.asarray(v)
             if arr.ndim == 0 and np.issubdtype(arr.dtype, np.number):
                 clean[k] = float(arr)
-        self.client.call("log_scalars", {"metrics": clean, "step": step})
+        self.client.call("log_scalars", {"metrics": clean, "step": None if step is None else int(step)})
 
     def log_hparams(self, hparams):
         self.client.call("log_hparams", {"hparams": {k: str(v) for k, v in dict(hparams).items()}})

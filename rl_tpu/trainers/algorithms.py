@@ -46,8 +46,8 @@ from .trainer import CountFramesLog, LogScalar, Trainer
 
 __all__ = [
     "make_a2c_trainer",
-    "make_iql_trainer",
-    "make_cql_trainer",
+    "train_iql",
+    "train_cql",
     "make_ppo_trainer",
     "make_sac_trainer",
     "make_dqn_trainer",
@@ -278,7 +278,7 @@ def make_a2c_trainer(
     return _std_hooks(Trainer(program, total_steps, logger=logger), log_interval)
 
 
-def _offline_loop(loss, buffer_state, rb, total_steps, batch_size, learning_rate, logger, log_interval):
+def _offline_loop(loss, buffer_state, rb, total_steps, batch_size, learning_rate, logger, log_interval, seed=0, tau=0.005):
     """Shared offline-training driver for IQL/CQL builders."""
     import optax
 
@@ -286,10 +286,10 @@ def _offline_loop(loss, buffer_state, rb, total_steps, batch_size, learning_rate
 
     logger = logger or NullLogger()
     example = buffer_state["storage", "data"][0:1]
-    params = loss.init_params(jax.random.key(0), example)
+    params = loss.init_params(jax.random.key(seed), example)
     opt = optax.adam(learning_rate)
     opt_state = opt.init(loss.trainable(params))
-    update = SoftUpdate(loss, tau=0.005)
+    update = SoftUpdate(loss, tau=tau)
 
     @jax.jit
     def step(params, opt_state, bstate, key):
@@ -301,7 +301,7 @@ def _offline_loop(loss, buffer_state, rb, total_steps, batch_size, learning_rate
         params = update(loss.merge(tr, params))
         return params, opt_state, bstate, metrics.set("loss", loss_val)
 
-    key = jax.random.key(1)
+    key = jax.random.key(seed + 1)
     for i in range(total_steps):
         key, k = jax.random.split(key)
         params, opt_state, buffer_state, metrics = step(params, opt_state, buffer_state, k)
@@ -313,7 +313,7 @@ def _offline_loop(loss, buffer_state, rb, total_steps, batch_size, learning_rate
     return params
 
 
-def make_iql_trainer(
+def train_iql(
     dataset_buffer,
     dataset_state,
     total_steps: int,
@@ -323,9 +323,15 @@ def make_iql_trainer(
     temperature: float = 3.0,
     logger: Logger | None = None,
     log_interval: int = 100,
+    seed: int = 0,
+    tau: float = 0.005,
 ):
-    """Offline IQL over a loaded dataset buffer (reference IQLTrainer):
-    returns trained params = {actor, qvalue, value, target_qvalue}."""
+    """Offline IQL over a loaded dataset buffer (reference IQLTrainer).
+
+    Runs the whole jitted offline loop NOW and returns trained params
+    {actor, qvalue, value, target_qvalue} — unlike the online make_*_trainer
+    builders (which return a Trainer), offline training has no
+    collection/hook lifecycle to drive."""
     from ..objectives import IQLLoss
 
     actor = _offline_continuous_actor(dataset_state["storage", "data"][0:1])
@@ -338,11 +344,11 @@ def make_iql_trainer(
     )
     return _offline_loop(
         loss, dataset_state, dataset_buffer, total_steps, batch_size,
-        learning_rate, logger, log_interval,
+        learning_rate, logger, log_interval, seed=seed, tau=tau,
     )
 
 
-def make_cql_trainer(
+def train_cql(
     dataset_buffer,
     dataset_state,
     total_steps: int,
@@ -351,9 +357,11 @@ def make_cql_trainer(
     cql_alpha: float = 1.0,
     logger: Logger | None = None,
     log_interval: int = 100,
+    seed: int = 0,
+    tau: float = 0.005,
 ):
     """Offline continuous CQL over a loaded dataset buffer (reference
-    CQLTrainer)."""
+    CQLTrainer). Runs now, returns trained params (see train_iql)."""
     from ..objectives import CQLLoss
 
     actor = _offline_continuous_actor(dataset_state["storage", "data"][0:1])
@@ -364,7 +372,7 @@ def make_cql_trainer(
     )
     return _offline_loop(
         loss, dataset_state, dataset_buffer, total_steps, batch_size,
-        learning_rate, logger, log_interval,
+        learning_rate, logger, log_interval, seed=seed, tau=tau,
     )
 
 

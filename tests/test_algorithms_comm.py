@@ -237,26 +237,35 @@ class TestRemoteLogger:
 
 
 class TestStalenessSampler:
-    def test_staleness_weights(self):
+    def test_fresh_sampled_more_and_gate(self):
         from rl_tpu.data import ArrayDict as AD, DeviceStorage, ReplayBuffer, StalenessAwareSampler
 
-        rb = ReplayBuffer(DeviceStorage(32), StalenessAwareSampler(eta=1.0), batch_size=64)
+        rb = ReplayBuffer(DeviceStorage(32), StalenessAwareSampler(eta=2.0), batch_size=512)
         st = rb.init(AD(x=jnp.zeros(())))
-        st = rb.extend(st, AD(x=jnp.arange(8.0)))      # version 1
-        st = rb.extend(st, AD(x=jnp.arange(8.0, 16.0)))  # version 2
+        st = rb.extend(st, AD(x=jnp.arange(8.0)))        # version 1 (stale)
+        st = rb.extend(st, AD(x=jnp.arange(8.0, 16.0)))  # version 2 (fresh)
         batch, _ = rb.sample(st, KEY)
-        stal = np.asarray(batch["staleness"])
-        w = np.asarray(batch["_weight"])
         idx = np.asarray(batch["index"])
+        stal = np.asarray(batch["staleness"])
+        # freshness-weighted SAMPLING: fresh entries dominate (w ratio 4:1)
+        frac_fresh = (idx >= 8).mean()
+        assert frac_fresh > 0.7, frac_fresh
         assert set(np.unique(stal[idx < 8])) == {1.0}
         assert set(np.unique(stal[idx >= 8])) == {0.0}
-        np.testing.assert_allclose(w, (1.0 + stal) ** -1.0)
+
+        # hard gate: max_staleness=0 excludes the stale half entirely
+        rb2 = ReplayBuffer(DeviceStorage(32), StalenessAwareSampler(max_staleness=0), batch_size=256)
+        st2 = rb2.init(AD(x=jnp.zeros(())))
+        st2 = rb2.extend(st2, AD(x=jnp.arange(8.0)))
+        st2 = rb2.extend(st2, AD(x=jnp.arange(8.0, 16.0)))
+        b2, _ = rb2.sample(st2, KEY)
+        assert (np.asarray(b2["index"]) >= 8).all()
 
 
 class TestOfflineBuilders:
     def test_iql_builder_trains_on_synthetic(self):
         from rl_tpu.data import dataset_from_arrays
-        from rl_tpu.trainers.algorithms import make_iql_trainer
+        from rl_tpu.trainers.algorithms import train_iql
 
         rng = np.random.default_rng(0)
         n = 256
@@ -265,12 +274,12 @@ class TestOfflineBuilders:
         rew = -np.abs(obs[:, 0]).astype(np.float32)
         term = np.zeros(n, bool); term[63::64] = True
         rb, state = dataset_from_arrays(obs, act, rew, term)
-        params = make_iql_trainer(rb, state, total_steps=5, batch_size=64)
+        params = train_iql(rb, state, total_steps=5, batch_size=64)
         assert "value" in params and "target_qvalue" in params
 
     def test_cql_builder_trains_on_synthetic(self):
         from rl_tpu.data import dataset_from_arrays
-        from rl_tpu.trainers.algorithms import make_cql_trainer
+        from rl_tpu.trainers.algorithms import train_cql
 
         rng = np.random.default_rng(0)
         n = 128
@@ -279,5 +288,5 @@ class TestOfflineBuilders:
         rew = np.ones(n, np.float32)
         term = np.zeros(n, bool)
         rb, state = dataset_from_arrays(obs, act, rew, term)
-        params = make_cql_trainer(rb, state, total_steps=3, batch_size=32)
+        params = train_cql(rb, state, total_steps=3, batch_size=32)
         assert "qvalue" in params

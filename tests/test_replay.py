@@ -357,3 +357,72 @@ class TestHER:
         # t=0 in episode [0,3]: each of the 4 goals ~uniform (not biased to 0)
         freq = counts / counts.sum()
         assert freq.max() < 0.45, freq
+
+
+class TestSliceVariants:
+    def _traj_state(self, rb):
+        example = ArrayDict(
+            obs=jnp.zeros(()),
+            collector=ArrayDict(traj_ids=jnp.asarray(0, jnp.int32)),
+        )
+        state = rb.init(example)
+        data = ArrayDict(
+            obs=jnp.arange(24.0),
+            collector=ArrayDict(
+                traj_ids=jnp.repeat(jnp.arange(3, dtype=jnp.int32), 8)
+            ),
+        )
+        return rb.extend(state, data)
+
+    def test_without_replacement_covers_starts(self):
+        from rl_tpu.data import SliceSamplerWithoutReplacement
+
+        rb = ReplayBuffer(
+            DeviceStorage(32), SliceSamplerWithoutReplacement(slice_len=4), batch_size=16
+        )
+        state = self._traj_state(rb)
+        starts = []
+        key = KEY
+        for _ in range(5):  # 5 batches x 4 slices = 20 starts < hi=21
+            key, k = jax.random.split(key)
+            batch, state = rb.sample(state, k)
+            s = np.asarray(batch["obs"]).reshape(4, 4)[:, 0]
+            starts.extend(s.tolist())
+        # within one epoch no start position repeats
+        assert len(starts) == len(set(starts)), sorted(starts)
+
+    def test_without_replacement_masks_boundary_slices(self):
+        from rl_tpu.data import SliceSamplerWithoutReplacement
+
+        rb = ReplayBuffer(
+            DeviceStorage(32), SliceSamplerWithoutReplacement(slice_len=4), batch_size=16
+        )
+        state = self._traj_state(rb)
+        batch, state = rb.sample(state, KEY)
+        ok = np.asarray(batch["valid_slices"])
+        obs = np.asarray(batch["obs"]).reshape(4, 4)
+        tids = np.asarray(batch["collector", "traj_ids"]).reshape(4, 4)
+        for r in range(4):
+            same = len(set(tids[r].tolist())) == 1
+            assert ok[r] == same
+
+    def test_prioritized_slices_prefer_high_priority(self):
+        from rl_tpu.data import PrioritizedSliceSampler
+
+        rb = ReplayBuffer(
+            DeviceStorage(32),
+            PrioritizedSliceSampler(slice_len=4, alpha=1.0),
+            batch_size=64,
+        )
+        state = self._traj_state(rb)
+        # boost priorities of trajectory 1 (elements 8..15)
+        prio = jnp.full((24,), 0.01).at[8:16].set(50.0)
+        state = rb.update_priority(state, jnp.arange(24), prio)
+        batch, _ = rb.sample(state, KEY)
+        starts = np.asarray(batch["start_index"])
+        # most sampled slices start inside trajectory 1's start range [8, 12]
+        frac = ((starts >= 8) & (starts <= 12)).mean()
+        assert frac > 0.7, (frac, starts)
+        # all returned slices valid (within one trajectory)
+        assert np.asarray(batch["valid_slices"]).all()
+        assert (np.asarray(batch["_weight"]) > 0).all()
