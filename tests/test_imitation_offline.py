@@ -38,6 +38,7 @@ def demo_batch(B=64, obs_dim=4, act_dim=2):
 
 
 class TestBC:
+    @pytest.mark.slow
     def test_bc_clones_expert(self):
         import optax
 
@@ -62,6 +63,7 @@ class TestBC:
 
 
 class TestGAIL:
+    @pytest.mark.slow
     def test_discriminator_separates(self):
         import optax
 
@@ -95,6 +97,7 @@ class TestGAIL:
 
 
 class TestRND:
+    @pytest.mark.slow
     def test_novelty_higher_for_unseen(self):
         import optax
 
@@ -128,6 +131,7 @@ class TestRND:
 
 
 class TestDT:
+    @pytest.mark.slow
     def test_dt_fits_offline_data(self):
         import optax
 

@@ -207,6 +207,7 @@ class TestGRPO:
         val, metrics = loss(params, batch)
         assert float(metrics["nll"]) > 0
 
+    @pytest.mark.slow
     def test_grpo_trains_tiny_model(self, model_and_params):
         """RLHF round-trip: reward favors even tokens; GRPO should raise the
         probability of even continuations within ~30 steps."""

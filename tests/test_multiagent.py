@@ -118,6 +118,7 @@ class TestQMixLoss:
 
 
 class TestMAPPO:
+    @pytest.mark.slow
     def test_mappo_learns_cooperation(self):
         """Team reward = #agents choosing action 1 -> MAPPO should drive all
         agents to action 1 (analytic optimum = n_agents per step)."""

@@ -180,6 +180,7 @@ class TestDDPGTD3:
 
 
 class TestOffPolicyProgram:
+    @pytest.mark.slow
     def test_dqn_cartpole_learns(self):
         env = TransformedEnv(VmapEnv(CartPoleEnv(max_episode_steps=200), 8), RewardSum())
         qnet = TDModule(MLP(out_features=2, num_cells=(64, 64)), ["observation"], ["action_value"])

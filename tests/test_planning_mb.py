@@ -107,6 +107,7 @@ class TestModelBasedAndRSSM:
         assert out["h"].shape == (3, 7, cfg.deter_dim)
         assert out["reward"].shape == (3, 7)
 
+    @pytest.mark.slow
     def test_model_loss_trains(self):
         """The world model must fit a deterministic toy dynamics: obs cycles
         +0.1 each step; recon loss should drop."""

@@ -169,6 +169,7 @@ class TestImageTransforms:
 
 
 class TestVecNorm:
+    @pytest.mark.slow
     def test_running_stats_whiten(self):
         class BiasedEnv(EnvBase):
             @property
