@@ -1,6 +1,9 @@
 """Benchmark entry point (run by the driver on real TPU hardware).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+ALWAYS prints ONE JSON line: {"metric", "value", "unit", "vs_baseline",
+"mfu", "error"} — even when setup or the run fails (then value=0.0 and
+"error" carries the reason), mirroring the reference CI's always-report
+benchmark discipline (reference benchmarks/test_collectors_benchmark.py).
 
 Metric: PPO env-steps/sec on a single chip — the fused
 collect+GAE+ClipPPO+Adam program (BASELINE.md config #1 path). The
@@ -8,26 +11,91 @@ reference publishes no absolute numbers (BASELINE.md: relative CI tracking
 only), so ``vs_baseline`` is measured against the BASELINE.md north-star
 target of 1M env-steps/s on a v5e-64 pod, i.e. 15625 env-steps/s/chip:
 ``vs_baseline = value / 15625``.
+
+``mfu`` is an analytic model-FLOPs/s over chip-peak estimate (matmul FLOPs
+of actor+critic over rollout + training epochs; tiny MLPs ⇒ tiny MFU — the
+number tracks trend, not headline efficiency).
 """
 
 import json
+import os
 import time
+import traceback
 
-import jax
-
-from rl_tpu.collectors import Collector
-from rl_tpu.envs import CartPoleEnv, RewardSum, TransformedEnv, VmapEnv
-from rl_tpu.modules import MLP, Categorical, ProbabilisticActor, TDModule, ValueOperator
-from rl_tpu.objectives import ClipPPOLoss
-from rl_tpu.trainers import OnPolicyConfig, OnPolicyProgram
-
-NUM_ENVS = 2048
-FRAMES_PER_BATCH = 65536  # 32 steps x 2048 envs
-TRAIN_STEPS = 8
+_SMOKE = bool(os.environ.get("BENCH_SMOKE"))  # tiny shapes for local checks
+NUM_ENVS = 64 if _SMOKE else 2048
+ROLLOUT_STEPS = 4 if _SMOKE else 32
+FRAMES_PER_BATCH = NUM_ENVS * ROLLOUT_STEPS  # 65536
+TRAIN_STEPS = 2 if _SMOKE else 8
+NUM_EPOCHS = 4
+MINIBATCH = min(8192, FRAMES_PER_BATCH // 2)
 PER_CHIP_TARGET = 1_000_000 / 64  # BASELINE.md: 1M steps/s on v5e-64
+
+# Approximate peak dense f32/bf16 FLOP/s by TPU generation (public numbers);
+# fall back to a conservative 100 TFLOP/s when the device kind is unknown.
+_PEAK_FLOPS = {
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,
+    "TPU v6e": 918e12,
+}
+
+
+def _model_flops_per_train_step() -> float:
+    """Analytic matmul FLOPs of one fused train step.
+
+    Actor MLP 4→64→64→2 and critic 4→64→64→1; fwd = 2*MACs, bwd ≈ 2*fwd.
+    Rollout: actor fwd per frame. GAE: critic fwd per frame. Training:
+    NUM_EPOCHS passes, each frame through actor+critic fwd+bwd.
+    """
+    actor_macs = 4 * 64 + 64 * 64 + 64 * 2
+    critic_macs = 4 * 64 + 64 * 64 + 64 * 1
+    fwd = 2 * (actor_macs + critic_macs)
+    rollout = 2 * actor_macs * FRAMES_PER_BATCH
+    gae = 2 * critic_macs * FRAMES_PER_BATCH
+    train = 3 * fwd * FRAMES_PER_BATCH * NUM_EPOCHS
+    return float(rollout + gae + train)
+
+
+def _report(value=0.0, mfu=0.0, error=None):
+    print(
+        json.dumps(
+            {
+                "metric": "ppo_cartpole_env_steps_per_sec_per_chip",
+                "value": round(value, 1),
+                "unit": "env_steps/s",
+                "vs_baseline": round(value / PER_CHIP_TARGET, 3),
+                "mfu": round(mfu, 6),
+                "error": error,
+            }
+        ),
+        flush=True,
+    )
 
 
 def main():
+    import jax
+
+    # This image's sitecustomize re-pins JAX_PLATFORMS=axon at interpreter
+    # start, so an env var set by the caller is clobbered; jax.config wins.
+    plat = os.environ.get("BENCH_PLATFORM")
+    if plat:
+        jax.config.update("jax_platforms", plat)
+
+    from rl_tpu.collectors import Collector
+    from rl_tpu.envs import CartPoleEnv, RewardSum, TransformedEnv, VmapEnv
+    from rl_tpu.modules import (
+        MLP,
+        Categorical,
+        ProbabilisticActor,
+        TDModule,
+        ValueOperator,
+    )
+    from rl_tpu.objectives import ClipPPOLoss
+    from rl_tpu.trainers import OnPolicyConfig, OnPolicyProgram
+
     env = TransformedEnv(VmapEnv(CartPoleEnv(), NUM_ENVS), RewardSum())
     actor = ProbabilisticActor(
         TDModule(MLP(out_features=2, num_cells=(64, 64)), ["observation"], ["logits"]),
@@ -41,7 +109,7 @@ def main():
         env, lambda p, td, k: actor(p["actor"], td, k), frames_per_batch=FRAMES_PER_BATCH
     )
     program = OnPolicyProgram(
-        coll, loss, OnPolicyConfig(num_epochs=4, minibatch_size=8192)
+        coll, loss, OnPolicyConfig(num_epochs=NUM_EPOCHS, minibatch_size=MINIBATCH)
     )
 
     ts = program.init(jax.random.key(0))
@@ -61,17 +129,33 @@ def main():
     dt = time.perf_counter() - t0
 
     steps_per_sec = TRAIN_STEPS * FRAMES_PER_BATCH / dt
-    print(
-        json.dumps(
-            {
-                "metric": "ppo_cartpole_env_steps_per_sec_per_chip",
-                "value": round(steps_per_sec, 1),
-                "unit": "env_steps/s",
-                "vs_baseline": round(steps_per_sec / PER_CHIP_TARGET, 3),
-            }
-        )
-    )
+
+    kind = jax.devices()[0].device_kind
+    peak = next((v for k, v in _PEAK_FLOPS.items() if k.lower() in kind.lower()), 100e12)
+    mfu = _model_flops_per_train_step() * TRAIN_STEPS / dt / peak
+    _report(steps_per_sec, mfu)
+
+
+def _watchdog(seconds: float):
+    """Emit the failure JSON and hard-exit if the run wedges (e.g. the TPU
+    relay hangs inside backend init, where no exception ever surfaces)."""
+    import threading
+
+    def fire():
+        _report(error=f"bench timed out after {seconds}s (backend hang?)")
+        os._exit(1)
+
+    t = threading.Timer(seconds, fire)
+    t.daemon = True
+    t.start()
+    return t
 
 
 if __name__ == "__main__":
-    main()
+    timer = _watchdog(float(os.environ.get("BENCH_TIMEOUT", "900")))
+    try:
+        main()
+        timer.cancel()
+    except BaseException:  # always emit the JSON line, whatever happened
+        _report(error=traceback.format_exc(limit=5))
+        raise SystemExit(1)

@@ -1,5 +1,12 @@
+"""Collectors: single-program (Anakin-style), host-env, and LLM.
+
+``LLMCollector`` is imported lazily (PEP 562): it pulls in the transformer
+model stack (``rl_tpu.models`` → ``rl_tpu.objectives`` → ``rl_tpu.modules``),
+and eager chaining of those imports is what broke the round-1 bench when the
+backend was unreachable — importing *anything* must not import *everything*.
+"""
+
 from .host import HostCollector, ThreadedEnvPool
-from .llm import LLMCollector
 from .single import Collector, CollectorState
 
 __all__ = [
@@ -9,3 +16,11 @@ __all__ = [
     "ThreadedEnvPool",
     "LLMCollector",
 ]
+
+
+def __getattr__(name):
+    if name == "LLMCollector":
+        from .llm import LLMCollector
+
+        return LLMCollector
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -15,6 +15,7 @@ through scans, and vmapped. API: ``sample(key)``, ``log_prob(x)``,
 from __future__ import annotations
 
 import dataclasses
+import math
 from typing import Any, ClassVar
 
 import jax
@@ -36,7 +37,9 @@ __all__ = [
     "OneHotOrdinal",
 ]
 
-_LOG_2PI = jnp.log(2.0 * jnp.pi)
+# math (not jnp): module-level jnp ops would initialize the JAX backend at
+# import time, crashing `import rl_tpu` when no accelerator is reachable.
+_LOG_2PI = math.log(2.0 * math.pi)
 
 
 def _register(cls):
