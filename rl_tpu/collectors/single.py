@@ -21,6 +21,8 @@ partial-applied or any callable); ``None`` collects random actions
 
 from __future__ import annotations
 
+import math
+
 from typing import Any, Callable
 
 import jax
@@ -61,7 +63,7 @@ class Collector:
         self.env = env
         self.policy = policy
         self.policy_state = policy_state
-        num_envs = int(jnp.prod(jnp.asarray(env.batch_shape))) if env.batch_shape else 1
+        num_envs = math.prod(env.batch_shape) if env.batch_shape else 1
         if frames_per_batch % num_envs:
             raise ValueError(
                 f"frames_per_batch={frames_per_batch} not divisible by num_envs={num_envs}"

@@ -1,0 +1,97 @@
+"""Replay-side batch transforms.
+
+The reference applies ``Transform``s on replay-buffer output (reference:
+torchrl/envs/transforms/rb_transforms.py ``MultiStepTransform``;
+torchrl/envs/transforms/transforms.py ``Reward2GoTransform``,
+``BurnInTransform``). Here a replay transform is simply a pure callable
+``batch -> batch`` passed to ``ReplayBuffer(transform=...)`` or applied by
+the trainer — it runs inside the training jit, so these stay shape-static.
+
+Batches are time-minor ``[B, T, ...]`` (slice-sampler output) or time-major
+``[T, ...]`` (collector output); ``time_axis`` selects.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .arraydict import ArrayDict
+
+__all__ = ["BurnInTransform", "Reward2GoTransform"]
+
+
+class Reward2GoTransform:
+    """Write the discounted reward-to-go of each step (reference
+    Reward2GoTransform): ``rtg_t = Σ_{k>=t} γ^{k-t} r_k`` restarting at
+    episode boundaries. Used for return-conditioned policies (Decision
+    Transformer) and REINFORCE-style targets.
+    """
+
+    def __init__(
+        self,
+        gamma: float = 1.0,
+        in_key=("next", "reward"),
+        out_key: str = "reward_to_go",
+        time_axis: int = 0,
+    ):
+        self.gamma = gamma
+        self.in_key = in_key if isinstance(in_key, tuple) else (in_key,)
+        self.out_key = out_key if isinstance(out_key, tuple) else (out_key,)
+        self.time_axis = time_axis
+
+    def __call__(self, batch: ArrayDict) -> ArrayDict:
+        from ..ops.value import reward2go
+
+        reward = batch[self.in_key]
+        done = batch["next", "done"]
+        if self.time_axis != 0:
+            reward = jnp.moveaxis(reward, self.time_axis, 0)
+            done = jnp.moveaxis(done, self.time_axis, 0)
+        rtg = reward2go(reward, done, self.gamma)
+        if self.time_axis != 0:
+            rtg = jnp.moveaxis(rtg, 0, self.time_axis)
+        return batch.set(self.out_key, rtg)
+
+
+class BurnInTransform:
+    """Warm up recurrent state on the first ``burn_in`` steps of each sampled
+    sub-trajectory, then drop them from the training slice (reference
+    BurnInTransform — the R2D2 trick).
+
+    ``module`` is an rl_tpu recurrent module (LSTMModule/GRUModule); the
+    computed carry is written at the module's carry keys so the subsequent
+    sequence forward starts from the burned-in state rather than zeros.
+    Operates on ``[B, T, ...]`` batches (slice-sampler layout).
+    """
+
+    def __init__(self, module, params, burn_in: int):
+        self.module = module
+        self.params = params
+        self.burn_in = burn_in
+
+    def __call__(self, batch: ArrayDict) -> ArrayDict:
+        m = self.module
+        x = batch[m.in_key][:, : self.burn_in]
+        B = x.shape[0]
+        is_init = (
+            batch[m.is_init_key][:, : self.burn_in]
+            if m.is_init_key in batch
+            else jnp.zeros((B, self.burn_in), bool)
+        )
+
+        def body(carry, xs):
+            xt, it = xs
+            carry = m._mask_carry(carry, it)
+            carry, _ = m.cell.apply({"params": self.params}, carry, xt)
+            return carry, None
+
+        carry = m.zero_carry(B)
+        xs = (jnp.moveaxis(x, 1, 0), jnp.moveaxis(is_init, 1, 0))
+        carry, _ = jax.lax.scan(body, carry, xs)
+        carry = jax.lax.stop_gradient(carry)
+
+        out = jax.tree_util.tree_map(lambda a: a[:, self.burn_in :], batch)
+        for k, c in zip(m._carry_keys(), carry):
+            out = out.set(k, c)
+        return out

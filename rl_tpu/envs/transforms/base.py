@@ -66,6 +66,15 @@ class Transform:
 
         return where_done(done, reset_tstate, tstate)
 
+    def on_done_reset_td(self, tstate: ArrayDict, reset_td: ArrayDict) -> ArrayDict:
+        """Re-derive auto-reset output data from the MERGED transform state.
+
+        The auto-reset path builds ``reset_td`` from a *fresh* ``init()``
+        state; transforms with global state (TrajCounter's id counter) must
+        re-emit their keys from the merged ``tstate`` here so post-reset
+        root data reflects the continuing global state."""
+        return reset_td
+
     # -- spec hooks -----------------------------------------------------------
 
     def transform_observation_spec(self, spec: Composite) -> Composite:
@@ -124,6 +133,11 @@ class Compose(Transform):
             out = out.set(f"t{i}", t.on_done(reset_tstate[f"t{i}"], tstate[f"t{i}"], done))
         return out
 
+    def on_done_reset_td(self, tstate, reset_td):
+        for i, t in enumerate(self.transforms):
+            reset_td = t.on_done_reset_td(tstate[f"t{i}"], reset_td)
+        return reset_td
+
     def transform_observation_spec(self, spec):
         for t in self.transforms:
             spec = t.transform_observation_spec(spec)
@@ -165,8 +179,12 @@ class TransformedEnv(EnvBase):
         self.env = env
         self.transform = transform
         # Run spec transformation eagerly: transforms that cache spec-derived
-        # layout (feature ndims etc.) are initialized before any data flows.
+        # layout (CatTensors feature ndims, ActionDiscretizer bin bounds)
+        # are initialized before any data flows.
         self.transform.transform_observation_spec(env.observation_spec)
+        self.transform.transform_action_spec(env.action_spec)
+        self.transform.transform_reward_spec(env.reward_spec)
+        self.transform.transform_done_spec(env.done_spec)
 
     @property
     def base_env(self) -> EnvBase:
@@ -235,6 +253,10 @@ class TransformedEnv(EnvBase):
         reset_state, reset_td = self.reset(reset_key)
 
         done = full_td["next", "done"]
+        tstate = self.transform.on_done(
+            reset_state["transforms"], new_state["transforms"], done
+        )
+        reset_td = self.transform.on_done_reset_td(tstate, reset_td)
         carry_td = where_done(done, reset_td, step_mdp(full_td))
         env_rng_path = self.env._rng_path
         env_carry = where_done(
@@ -242,11 +264,22 @@ class TransformedEnv(EnvBase):
             reset_state["env"].delete(env_rng_path),
             new_state["env"].delete(env_rng_path),
         )
-        tstate = self.transform.on_done(
-            reset_state["transforms"], new_state["transforms"], done
-        )
         carry_state = ArrayDict(env=env_carry.set(env_rng_path, carry_key), transforms=tstate)
         return carry_state, full_td, carry_td
 
     def rand_action(self, td, key):
+        # Legal-action aware: if an ActionMask transform is attached and the
+        # mask is in the carried td, draw uniformly over legal actions.
+        from .extra import ActionMask
+
+        stack = (
+            self.transform.transforms
+            if isinstance(self.transform, Compose)
+            else [self.transform]
+        )
+        for t in stack:
+            if isinstance(t, ActionMask) and t.mask_key in td:
+                return td.set(
+                    "action", ActionMask.masked_rand(key, td[t.mask_key])
+                )
         return td.set("action", self.action_spec.rand(key, self.batch_shape))

@@ -10,6 +10,8 @@ ActionScaling/TanhAction (action domain mapping).
 
 from __future__ import annotations
 
+import math
+
 import dataclasses
 
 import jax.numpy as jnp
@@ -267,7 +269,7 @@ class FlattenObservation(_KeyedTransform):
         for k in self._keys(spec):
             leaf = spec[k]
             keep = leaf.shape[: len(leaf.shape) - self.ndims]
-            flat = int(jnp.prod(jnp.asarray(leaf.shape[len(leaf.shape) - self.ndims :])))
+            flat = math.prod(leaf.shape[len(leaf.shape) - self.ndims :])
             spec = spec.set(k, Unbounded(shape=keep + (flat,), dtype=leaf.dtype))
         return spec
 
@@ -368,7 +370,7 @@ class CatTensors(Transform):
         for k in self.in_keys:
             leaf = spec[k]
             self._feature_ndims[k] = len(leaf.shape)
-            total += int(jnp.prod(jnp.asarray(leaf.shape))) if leaf.shape else 1
+            total += math.prod(leaf.shape) if leaf.shape else 1
             dtype = leaf.dtype
         if self.del_keys:
             for k in self.in_keys:

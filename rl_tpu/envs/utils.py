@@ -8,6 +8,8 @@ against the declared specs.
 
 from __future__ import annotations
 
+import math
+
 import contextlib
 import enum
 
@@ -87,7 +89,7 @@ def check_env_specs(env: EnvBase, key: jax.Array | None = None, num_steps: int =
     for path in env.observation_spec.keys(nested=True, leaves_only=True):
         leaf_spec = env.observation_spec[path]
         n = steps["next"][path].size // max(
-            int(jnp.prod(jnp.array(leaf_spec.shape, jnp.int32))) if leaf_spec.shape else 1, 1
+            math.prod(leaf_spec.shape) if leaf_spec.shape else 1, 1
         )
         vals = steps["next"][path].reshape((n,) + leaf_spec.shape)
         assert leaf_spec.is_in(vals), f"rollout obs {path} violates spec"

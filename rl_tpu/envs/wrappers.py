@@ -16,7 +16,7 @@ from ..data import ArrayDict
 from .base import EnvBase
 
 
-__all__ = ["FrameSkipEnv", "NoopResetEnv"]
+__all__ = ["ConditionalSkipEnv", "FrameSkipEnv", "MultiActionEnv", "NoopResetEnv"]
 
 
 class _DelegateWrapper(EnvBase):
@@ -133,3 +133,79 @@ class NoopResetEnv(_DelegateWrapper):
             return state, td
 
         return jax.lax.fori_loop(0, self.noop_max, body, (state, td))
+
+
+class MultiActionEnv(_DelegateWrapper):
+    """Execute a macro of ``num_actions`` sub-actions per outer step
+    (reference MultiAction transform / MultiStepActorWrapper consumer).
+
+    The outer action has shape ``(num_actions, *action_shape)``; rewards are
+    summed and stepping freezes once the episode ends mid-macro, so the
+    emitted transition is the macro-level MDP transition.
+    """
+
+    def __init__(self, env: EnvBase, num_actions: int):
+        super().__init__(env)
+        if num_actions < 1:
+            raise ValueError("num_actions must be >= 1")
+        self.num_actions = num_actions
+
+    @property
+    def action_spec(self):
+        import dataclasses
+
+        inner = self.env.action_spec
+        return dataclasses.replace(inner, shape=(self.num_actions,) + inner.shape)
+
+    def step(self, state, td: ArrayDict):
+        from .base import where_done
+
+        # action is batch-major per the declared spec: [*batch, K, *act];
+        # move the macro axis to the front for the scan
+        batch_ndim = len(self.env.batch_shape)
+        macro = jnp.moveaxis(td["action"], batch_ndim, 0)  # [K, *batch, *act]
+
+        def body(carry, action_k):
+            state, out_prev, done_prev, reward_acc = carry
+            new_state, out = self.env.step(state, td.set("action", action_k))
+            done = out["next", "done"] | done_prev
+            state = where_done(done_prev, state, new_state)
+            out = where_done(done_prev, out_prev, out)
+            reward_acc = reward_acc + jnp.where(done_prev, 0.0, out["next", "reward"])
+            return (state, out, done, reward_acc), None
+
+        state0, out0 = self.env.step(state, td.set("action", macro[0]))
+        carry0 = (state0, out0, out0["next", "done"], out0["next", "reward"])
+        (state, out, done, reward), _ = jax.lax.scan(body, carry0, macro[1:])
+        out = out.set(("next", "reward"), reward).set("action", td["action"])
+        return state, out
+
+
+class ConditionalSkipEnv(_DelegateWrapper):
+    """Skip the base step for envs where ``cond(td)`` is True (reference
+    ConditionalSkip transform): skipped envs keep their state and re-emit
+    their current observation with zero reward and no done flags.
+    """
+
+    def __init__(self, env: EnvBase, cond):
+        super().__init__(env)
+        self.cond = cond
+
+    def step(self, state, td: ArrayDict):
+        from .base import DONE_KEYS, where_done
+
+        skip = self.cond(td)  # bool over batch_shape
+        new_state, out = self.env.step(state, td)
+        # synthesized "next" for skipped envs: keep current content where the
+        # root td carries it, zero reward, clear done flags
+        synth = out["next"]
+        for k in synth.keys(nested=True, leaves_only=True):
+            if k == ("reward",):
+                synth = synth.set(k, jnp.zeros_like(synth[k]))
+            elif k in [(d,) for d in DONE_KEYS]:
+                synth = synth.set(k, jnp.zeros_like(synth[k]))
+            elif k in td:
+                synth = synth.set(k, td[k])
+        kept_state = where_done(skip, state, new_state)
+        merged_next = where_done(skip, synth, out["next"])
+        return kept_state, out.set("next", merged_next)

@@ -21,6 +21,8 @@ Two execution modes (reference ``set_recurrent_mode``):
 
 from __future__ import annotations
 
+import math
+
 import contextlib
 from typing import Any
 
@@ -154,7 +156,7 @@ class _RecurrentBase:
         if ckeys[0] in td:
             carry = tuple(td[k] for k in ckeys)
         else:
-            carry = self.zero_carry(int(jnp.prod(jnp.asarray(batch))) if batch else 1)
+            carry = self.zero_carry(math.prod(batch) if batch else 1)
             carry = tuple(c.reshape(batch + (self.hidden_size,)) for c in carry)
         if self.is_init_key in td:
             carry = self._mask_carry(carry, td[self.is_init_key])
@@ -185,7 +187,15 @@ class _RecurrentBase:
             carry, out = self.cell.apply({"params": params}, carry, xt)
             return carry, out
 
-        carry = self.zero_carry(B)
+        # start from a burned-in carry when present (BurnInTransform writes
+        # [B, H] carries at the carry keys), else zeros. Collector batches
+        # can contain per-STEP carries recorded with a time axis ([B, T, H]);
+        # those are rollout traces, not initial state — ignore them.
+        ckeys = self._carry_keys()
+        if ckeys[0] in td and td[ckeys[0]].shape == (B, self.hidden_size):
+            carry = tuple(td[k] for k in ckeys)
+        else:
+            carry = self.zero_carry(B)
         xs = (jnp.moveaxis(x, 1, 0), jnp.moveaxis(is_init, 1, 0))
         _, outs = jax.lax.scan(body, carry, xs)
         out = jnp.moveaxis(outs, 0, 1)  # [B, T, H]

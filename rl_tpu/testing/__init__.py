@@ -1,6 +1,8 @@
 from .mocks import (
     ContinuousActionMock,
     CountingEnv,
+    LivesCountingEnv,
+    MaskedActionMock,
     MultiAgentCountingEnv,
     MultiKeyCountingEnv,
     NestedCountingEnv,
@@ -12,4 +14,6 @@ __all__ = [
     "MultiKeyCountingEnv",
     "MultiAgentCountingEnv",
     "ContinuousActionMock",
+    "LivesCountingEnv",
+    "MaskedActionMock",
 ]

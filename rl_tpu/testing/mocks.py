@@ -15,7 +15,14 @@ import jax.numpy as jnp
 from ..data import ArrayDict, Bounded, Categorical, Composite, Unbounded
 from ..envs.base import EnvBase
 
-__all__ = ["CountingEnv", "NestedCountingEnv", "MultiKeyCountingEnv", "ContinuousActionMock"]
+__all__ = [
+    "ContinuousActionMock",
+    "CountingEnv",
+    "LivesCountingEnv",
+    "MaskedActionMock",
+    "MultiKeyCountingEnv",
+    "NestedCountingEnv",
+]
 
 
 class CountingEnv(EnvBase):
@@ -201,4 +208,99 @@ class ContinuousActionMock(EnvBase):
             reward,
             jnp.asarray(False),
             count >= self.max_episode_steps,
+        )
+
+
+class MaskedActionMock(EnvBase):
+    """Categorical-action mock exposing a legal-action mask (model for
+    reference ActionMask tests): only actions < count+1 are legal, so the
+    legal set grows as the episode advances and masked sampling is
+    verifiable in closed form.
+    """
+
+    def __init__(self, n_actions: int = 4, max_count: int = 5):
+        self.n_actions = n_actions
+        self.max_count = max_count
+
+    @property
+    def observation_spec(self) -> Composite:
+        from ..data.specs import Binary
+
+        return Composite(
+            observation=Bounded(shape=(1,), low=0.0, high=float(self.max_count)),
+            action_mask=Binary(shape=(self.n_actions,)),
+        )
+
+    @property
+    def action_spec(self):
+        return Categorical(n=self.n_actions)
+
+    def _mask(self, count):
+        return jnp.arange(self.n_actions) <= count
+
+    def _reset(self, key):
+        state = ArrayDict(count=jnp.asarray(0, jnp.int32))
+        obs = ArrayDict(
+            observation=jnp.zeros((1,), jnp.float32), action_mask=self._mask(0)
+        )
+        return state, obs
+
+    def _step(self, state, action, key):
+        count = state["count"] + 1
+        obs = ArrayDict(
+            observation=count[None].astype(jnp.float32),
+            action_mask=self._mask(count),
+        )
+        return (
+            ArrayDict(count=count),
+            obs,
+            jnp.asarray(1.0, jnp.float32),
+            count >= self.max_count,
+            jnp.asarray(False),
+        )
+
+
+class LivesCountingEnv(EnvBase):
+    """Counting env with an Atari-style "lives" counter (model for reference
+    EndOfLifeTransform tests): loses a life every ``steps_per_life`` steps,
+    terminates when lives reach 0.
+    """
+
+    def __init__(self, lives: int = 3, steps_per_life: int = 2):
+        self.lives = lives
+        self.steps_per_life = steps_per_life
+
+    @property
+    def observation_spec(self) -> Composite:
+        max_c = self.lives * self.steps_per_life
+        return Composite(
+            observation=Bounded(shape=(1,), low=0.0, high=float(max_c)),
+            lives=Bounded(shape=(), low=0, high=self.lives, dtype=jnp.int32),
+        )
+
+    @property
+    def action_spec(self):
+        return Categorical(n=2)
+
+    def _reset(self, key):
+        state = ArrayDict(count=jnp.asarray(0, jnp.int32))
+        obs = ArrayDict(
+            observation=jnp.zeros((1,), jnp.float32),
+            lives=jnp.asarray(self.lives, jnp.int32),
+        )
+        return state, obs
+
+    def _step(self, state, action, key):
+        count = state["count"] + 1
+        lives = self.lives - count // self.steps_per_life
+        obs = ArrayDict(
+            observation=count[None].astype(jnp.float32),
+            lives=lives.astype(jnp.int32),
+        )
+        return (
+            ArrayDict(count=count),
+            obs,
+            jnp.asarray(1.0, jnp.float32),
+            lives <= 0,
+            jnp.asarray(False),
         )

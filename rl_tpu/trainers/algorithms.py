@@ -10,6 +10,8 @@ assembles the fused Program + hook-driven Trainer from plain arguments
 
 from __future__ import annotations
 
+import math
+
 import dataclasses
 from typing import Any, Callable, Sequence
 
@@ -59,7 +61,7 @@ __all__ = [
 
 def _action_dims(env: EnvBase) -> int:
     spec = env.action_spec
-    return int(jnp.prod(jnp.asarray(spec.shape))) if spec.shape else 1
+    return math.prod(spec.shape) if spec.shape else 1
 
 
 def default_continuous_actor(env: EnvBase, num_cells=(256, 256)) -> ProbabilisticActor:

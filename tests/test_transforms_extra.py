@@ -1,0 +1,383 @@
+"""Second-wave transform tests (strategy mirrors reference test/transforms/):
+per-transform behavior + spec agreement via check_env_specs, plus the
+step-structure wrappers (MultiAction, ConditionalSkip) and replay-side
+transforms (Reward2Go, BurnIn)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from rl_tpu.data import ArrayDict, BurnInTransform, Reward2GoTransform
+from rl_tpu.data.specs import Unbounded
+from rl_tpu.envs import (
+    ActionDiscretizer,
+    ActionMask,
+    BinarizeReward,
+    ClipTransform,
+    Compose,
+    ConditionalSkipEnv,
+    EndOfLifeTransform,
+    ExcludeTransform,
+    FiniteCheck,
+    Hash,
+    LineariseRewards,
+    ModuleTransform,
+    MultiActionEnv,
+    PermuteTransform,
+    SelectTransform,
+    SignTransform,
+    StackTransform,
+    TensorDictPrimer,
+    Timer,
+    TrajCounter,
+    TransformedEnv,
+    VmapEnv,
+    check_env_specs,
+    rollout,
+)
+from rl_tpu.testing import (
+    ContinuousActionMock,
+    CountingEnv,
+    LivesCountingEnv,
+    MaskedActionMock,
+    MultiKeyCountingEnv,
+)
+
+KEY = jax.random.key(0)
+
+
+STACKS = [
+    lambda: TransformedEnv(CountingEnv(), BinarizeReward()),
+    lambda: TransformedEnv(CountingEnv(), SignTransform()),
+    lambda: TransformedEnv(CountingEnv(), ClipTransform(low=-0.5, high=0.5)),
+    lambda: TransformedEnv(CountingEnv(), ExcludeTransform()),
+    lambda: TransformedEnv(CountingEnv(), SelectTransform("observation")),
+    lambda: TransformedEnv(CountingEnv(), TrajCounter()),
+    lambda: TransformedEnv(
+        CountingEnv(), TensorDictPrimer({"hidden": Unbounded(shape=(3,))})
+    ),
+    lambda: TransformedEnv(LivesCountingEnv(), EndOfLifeTransform()),
+    lambda: TransformedEnv(MaskedActionMock(), ActionMask()),
+    lambda: TransformedEnv(ContinuousActionMock(), ActionDiscretizer(num_intervals=7)),
+    lambda: TransformedEnv(CountingEnv(), Hash(in_keys=["observation"])),
+    lambda: TransformedEnv(
+        CountingEnv(), ModuleTransform(lambda x: 2.0 * x, in_keys=["observation"])
+    ),
+    lambda: TransformedEnv(CountingEnv(), FiniteCheck()),
+    lambda: TransformedEnv(
+        MultiKeyCountingEnv(), StackTransform(in_keys=["obs_vec"], out_key="stacked")
+    ),
+]
+
+
+@pytest.mark.parametrize("make", STACKS, ids=lambda m: repr(m().transform)[:48])
+def test_check_env_specs(make):
+    check_env_specs(make(), KEY)
+
+
+def test_select_exclude_keys():
+    env = TransformedEnv(MultiKeyCountingEnv(), ExcludeTransform(("nested", "obs_img")))
+    _, td = env.reset(KEY)
+    assert ("nested", "obs_img") not in td
+    env = TransformedEnv(MultiKeyCountingEnv(), SelectTransform("obs_vec"))
+    _, td = env.reset(KEY)
+    assert "obs_vec" in td and ("nested", "obs_img") not in td
+    assert "done" in td  # protected keys survive
+
+
+def test_permute_hwc_to_chw():
+    t = PermuteTransform(dims=(-1, -3, -2), in_keys=["img"])
+    td = ArrayDict(img=jnp.zeros((5, 8, 6, 3)), done=jnp.zeros((5,), bool))
+    _, out = t.step(ArrayDict(), td)
+    assert out["img"].shape == (5, 3, 8, 6)
+    spec = t.transform_observation_spec(
+        __import__("rl_tpu.data", fromlist=["Composite"]).Composite(
+            img=Unbounded(shape=(8, 6, 3))
+        )
+    )
+    assert spec["img"].shape == (3, 8, 6)
+
+
+def test_stack_transform_shape():
+    env = TransformedEnv(
+        MultiKeyCountingEnv(),
+        StackTransform(in_keys=["obs_vec"], out_key="stacked", del_keys=False),
+    )
+    _, td = env.reset(KEY)
+    assert td["stacked"].shape[-1] == 1
+    assert np.allclose(np.asarray(td["stacked"][..., 0]), np.asarray(td["obs_vec"]))
+
+
+def test_reward_shaping_values():
+    env = TransformedEnv(CountingEnv(), BinarizeReward())
+    batch = rollout(env, KEY, max_steps=4)
+    assert np.all(np.asarray(batch["next", "reward"]) == 1.0)
+
+    env = TransformedEnv(CountingEnv(), SignTransform())
+    batch = rollout(env, KEY, max_steps=4)
+    assert np.all(np.asarray(batch["next", "reward"]) == 1.0)
+
+    env = TransformedEnv(CountingEnv(), ClipTransform(low=-0.25, high=0.25))
+    batch = rollout(env, KEY, max_steps=4)
+    assert np.all(np.asarray(batch["next", "reward"]) == 0.25)
+
+
+def test_linearise_rewards():
+    t = LineariseRewards(weights=[1.0, 2.0])
+    td = ArrayDict(reward=jnp.asarray([1.0, 3.0]), done=jnp.asarray(False))
+    _, out = t.step(ArrayDict(), td)
+    assert float(out["reward"]) == 7.0
+    spec = t.transform_reward_spec(Unbounded(shape=(2,)))
+    assert spec.shape == ()
+
+
+def test_primer_defaults_and_carry():
+    env = TransformedEnv(
+        CountingEnv(), TensorDictPrimer({"hidden": Unbounded(shape=(3,))})
+    )
+    _, td = env.reset(KEY)
+    assert td["hidden"].shape == (3,)
+    assert np.all(np.asarray(td["hidden"]) == 0)
+    batch = rollout(env, KEY, max_steps=3)
+    assert batch["next", "hidden"].shape == (3, 3)
+
+
+def test_traj_counter_unique_ids():
+    env = VmapEnv(CountingEnv(max_count=3), 4)
+    env = TransformedEnv(env, TrajCounter())
+    batch = rollout(env, KEY, max_steps=10)
+    ids = np.asarray(batch["next", "traj_count"])  # [T, B]
+    done = np.asarray(batch["next", "done"])
+    # ids within an episode are constant; after a done the id changes and is fresh
+    seen = set()
+    for b in range(4):
+        cur = ids[0, b]
+        for t in range(10):
+            assert ids[t, b] == cur or done[t - 1, b]
+            cur = ids[t, b]
+        for t in range(10):
+            if done[t, b] and t + 1 < 10:
+                nxt = ids[t + 1, b]
+                assert nxt != ids[t, b]
+        for t in range(10):
+            seen.add((b, int(ids[t, b])))
+    # global uniqueness: an id never appears in two different env slots
+    by_id = {}
+    for b, i in seen:
+        assert by_id.setdefault(i, b) == b
+
+
+def test_timer_measures_nonnegative():
+    env = TransformedEnv(CountingEnv(), Timer())
+    batch = rollout(env, KEY, max_steps=3)
+    assert np.all(np.asarray(batch["next", "time_step"]) >= 0)
+
+
+def test_end_of_life_flag():
+    env = TransformedEnv(LivesCountingEnv(lives=3, steps_per_life=2), EndOfLifeTransform())
+    batch = rollout(env, KEY, max_steps=6)
+    eol = np.asarray(batch["next", "end_of_life"])
+    done = np.asarray(batch["next", "done"])
+    # life losses at steps 2 and 4 (0-indexed 1, 3); termination at step 6
+    assert eol[1] and eol[3]
+    assert not eol[0] and not eol[2]
+    assert done[5] and not eol[5]  # terminal step is done, not eol
+
+
+def test_end_of_life_done_promotion():
+    env = TransformedEnv(
+        LivesCountingEnv(lives=3, steps_per_life=2),
+        EndOfLifeTransform(done_on_life_loss=True),
+    )
+    batch = rollout(env, KEY, max_steps=6)
+    done = np.asarray(batch["next", "done"])
+    assert done[1]  # first life loss now ends the episode
+
+
+def test_action_mask_rand_action_legal():
+    env = TransformedEnv(MaskedActionMock(n_actions=6, max_count=5), ActionMask())
+    batch = rollout(env, KEY, max_steps=5)
+    acts = np.asarray(batch["action"])
+    # at step t the mask allows actions <= t (count before the step)
+    for t in range(5):
+        assert acts[t] <= t
+
+
+def test_action_discretizer_roundtrip():
+    base = ContinuousActionMock()
+    env = TransformedEnv(base, ActionDiscretizer(num_intervals=5))
+    spec = env.action_spec
+    assert spec.shape == (base.act_dim,)
+    batch = rollout(env, KEY, max_steps=4)
+    acts = np.asarray(batch["action"])
+    assert acts.dtype in (np.int32, np.int64)
+    assert acts.min() >= 0 and acts.max() < 5
+
+
+def test_hash_deterministic():
+    t = Hash(in_keys=["observation"])
+    td1 = ArrayDict(observation=jnp.asarray([1.0, 2.0]), done=jnp.asarray(False))
+    td2 = ArrayDict(observation=jnp.asarray([1.0, 2.0]), done=jnp.asarray(False))
+    td3 = ArrayDict(observation=jnp.asarray([1.0, 3.0]), done=jnp.asarray(False))
+    _, h1 = t.step(ArrayDict(), td1)
+    _, h2 = t.step(ArrayDict(), td2)
+    _, h3 = t.step(ArrayDict(), td3)
+    assert int(h1["observation_hash"]) == int(h2["observation_hash"])
+    assert int(h1["observation_hash"]) != int(h3["observation_hash"])
+
+
+def test_module_transform_applies():
+    env = TransformedEnv(
+        CountingEnv(), ModuleTransform(lambda x: 3.0 * x, in_keys=["observation"])
+    )
+    batch = rollout(env, KEY, max_steps=3)
+    obs = np.asarray(batch["next", "observation"])
+    assert np.allclose(obs[:, 0], 3.0 * np.arange(1, 4))
+
+
+def test_finite_check_flags_nan():
+    env = TransformedEnv(
+        CountingEnv(),
+        Compose(
+            ModuleTransform(
+                lambda x: jnp.where(x > 1.5, jnp.nan, x), in_keys=["observation"]
+            ),
+            FiniteCheck(),
+        ),
+    )
+    batch = rollout(env, KEY, max_steps=4)
+    ok = np.asarray(batch["next", "finite_ok"])
+    assert ok[0] and not ok[2]
+
+
+def test_multi_action_env_sums_rewards():
+    env = MultiActionEnv(CountingEnv(max_count=10), num_actions=3)
+    assert env.action_spec.shape == (3,)
+    batch = rollout(env, KEY, max_steps=2)
+    # each macro step advances 3 counts, reward 3.0
+    assert np.allclose(np.asarray(batch["next", "reward"]), 3.0)
+    obs = np.asarray(batch["next", "observation"])
+    assert np.allclose(obs[:, 0], [3.0, 6.0])
+
+
+def test_multi_action_env_stops_at_done():
+    env = MultiActionEnv(CountingEnv(max_count=2), num_actions=5)
+    batch = rollout(env, KEY, max_steps=1, auto_reset=False)
+    # only 2 of 5 sub-steps yield reward before termination
+    assert float(batch["next", "reward"][0]) == 2.0
+    assert bool(batch["next", "done"][0])
+
+
+def test_conditional_skip_freezes_state():
+    # skip every step where the current count is odd
+    def cond(td):
+        return (td["observation"][..., 0].astype(jnp.int32) % 2) == 1
+
+    env = ConditionalSkipEnv(CountingEnv(max_count=100), cond)
+    batch = rollout(env, KEY, max_steps=6)
+    obs = np.asarray(batch["next", "observation"][:, 0])
+    rew = np.asarray(batch["next", "reward"])
+    # counts: 1 (stepped), then frozen at 1 forever (cond is True at count 1)
+    assert obs[0] == 1.0
+    assert np.all(obs[1:] == 1.0)
+    assert rew[0] == 1.0 and np.all(rew[1:] == 0.0)
+
+
+def test_reward2go_matches_bruteforce():
+    T = 8
+    key = jax.random.key(3)
+    reward = jax.random.normal(key, (T,))
+    done = jnp.zeros((T,), bool).at[3].set(True)
+    batch = ArrayDict(next=ArrayDict(reward=reward, done=done))
+    out = Reward2GoTransform(gamma=0.9)(batch)
+    rtg = np.asarray(out["reward_to_go"])
+    expect = np.zeros(T)
+    acc = 0.0
+    for t in reversed(range(T)):
+        acc = float(reward[t]) + 0.9 * acc * (0.0 if done[t] else 1.0)
+        # reward-to-go INCLUDES own reward; reset AFTER a done step
+        expect[t] = float(reward[t]) + 0.9 * (expect[t + 1] if t + 1 < T and not done[t] else 0.0)
+    assert np.allclose(rtg, expect, atol=1e-5)
+
+
+def test_burn_in_transform():
+    from rl_tpu.modules.rnn import GRUModule
+
+    m = GRUModule(input_size=3, hidden_size=4, in_key="obs", out_key="embed")
+    B, T = 2, 6
+    obs = jax.random.normal(jax.random.key(1), (B, T, 3))
+    td = ArrayDict(obs=obs, is_init=jnp.zeros((B, T), bool))
+    params = m.init(jax.random.key(2), td)
+
+    burn = BurnInTransform(m, params, burn_in=2)
+    out = burn(td)
+    assert out["obs"].shape == (B, T - 2, 3)
+    ck = m._carry_keys()
+    assert ck[0] in out and out[ck[0]].shape == (B, 4)
+
+    # burned-in carry changes the sequence output vs zero-carry
+    with_carry = m(params, out)["embed"]
+    zero_carry = m(params, out.exclude(*ck))["embed"]
+    assert not np.allclose(np.asarray(with_carry), np.asarray(zero_carry))
+
+
+def test_traj_counter_root_ids_after_autoreset():
+    # regression: the root (carried) traj_count after an auto-reset must be
+    # the freshly ASSIGNED global id, not a fresh-init arange id
+    env = VmapEnv(CountingEnv(max_count=2), 3)
+    env = TransformedEnv(env, TrajCounter())
+    batch = rollout(env, KEY, max_steps=6)
+    root_ids = np.asarray(batch["traj_count"])  # [T, B]
+    next_ids = np.asarray(batch["next", "traj_count"])
+    done = np.asarray(batch["next", "done"])
+    for b in range(3):
+        for t in range(5):
+            if done[t, b]:
+                assert root_ids[t + 1, b] not in next_ids[: t + 1, b]
+            else:
+                assert root_ids[t + 1, b] == next_ids[t, b]
+
+
+def test_multi_action_batch_major_layout():
+    # regression: spec-shaped (batch-major) actions must drive the macro scan
+    env = MultiActionEnv(VmapEnv(CountingEnv(max_count=100), 2), num_actions=3)
+    spec = env.action_spec
+    acts = spec.rand(KEY, env.batch_shape)
+    assert acts.shape == (2, 3)
+    batch = rollout(env, KEY, max_steps=2)
+    obs = np.asarray(batch["next", "observation"])
+    assert np.allclose(obs[:, :, 0], [[3.0, 3.0], [6.0, 6.0]])
+
+
+def test_permute_default_keys_skips_flags():
+    # regression: default in_keys must not permute reward/done leaves
+    class ImgEnv(CountingEnv):
+        @property
+        def observation_spec(self):
+            from rl_tpu.data import Composite
+
+            return Composite(pixels=Unbounded(shape=(4, 6, 3)))
+
+        def _reset(self, key):
+            state, _ = super()._reset(key)
+            return state, ArrayDict(pixels=jnp.zeros((4, 6, 3)))
+
+        def _step(self, state, action, key):
+            state, _, r, te, tr = super()._step(state, action, key)
+            c = state["count"].astype(jnp.float32)
+            return state, ArrayDict(pixels=jnp.full((4, 6, 3), c)), r, te, tr
+
+    env = TransformedEnv(ImgEnv(), PermuteTransform(dims=(-1, -3, -2)))
+    check_env_specs(env, KEY)
+    batch = rollout(env, KEY, max_steps=2)
+    assert batch["next", "pixels"].shape[-3:] == (3, 4, 6)
+
+
+def test_action_discretizer_inv_without_spec_read():
+    # regression: inv() must work even if env.action_spec is never read
+    env = TransformedEnv(ContinuousActionMock(), ActionDiscretizer(num_intervals=4))
+    state, td = env.reset(KEY)
+    td = td.set("action", jnp.zeros((2,), jnp.int32))
+    _, out = env.step(state, td)
+    assert "next" in out
