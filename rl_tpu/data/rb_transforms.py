@@ -26,6 +26,12 @@ class Reward2GoTransform:
     Reward2GoTransform): ``rtg_t = Σ_{k>=t} γ^{k-t} r_k`` restarting at
     episode boundaries. Used for return-conditioned policies (Decision
     Transformer) and REINFORCE-style targets.
+
+    Apply on TIME-CONTIGUOUS batches only: collector output (time-major,
+    ``time_axis=0``, e.g. ``Collector(postproc=...)``) or slice-sampler
+    ``[B, T]`` sub-trajectories (``time_axis=1``). Applying it on randomly
+    sampled flat batches would chain unrelated transitions — the reference
+    applies it buffer-INPUT-side (``inv``) for the same reason.
     """
 
     def __init__(
@@ -72,8 +78,16 @@ class BurnInTransform:
 
     def __call__(self, batch: ArrayDict) -> ArrayDict:
         m = self.module
-        x = batch[m.in_key][:, : self.burn_in]
-        B = x.shape[0]
+        seq = batch[m.in_key]
+        if seq.ndim < 3:
+            raise ValueError(
+                "BurnInTransform needs [B, T, ...] sub-trajectory batches "
+                f"(got shape {seq.shape}); reshape slice-sampler output to "
+                "[B, T] before applying (flat [B*T] batches would slice the "
+                "feature axis as time)"
+            )
+        x = seq[:, : self.burn_in]
+        B, T = seq.shape[0], seq.shape[1]
         is_init = (
             batch[m.is_init_key][:, : self.burn_in]
             if m.is_init_key in batch
@@ -91,7 +105,14 @@ class BurnInTransform:
         carry, _ = jax.lax.scan(body, carry, xs)
         carry = jax.lax.stop_gradient(carry)
 
-        out = jax.tree_util.tree_map(lambda a: a[:, self.burn_in :], batch)
+        # slice only [B, T, ...] leaves; bookkeeping leaves with other
+        # shapes (sample indices, weights) pass through unchanged
+        out = jax.tree_util.tree_map(
+            lambda a: a[:, self.burn_in :]
+            if a.ndim >= 2 and a.shape[:2] == (B, T)
+            else a,
+            batch,
+        )
         for k, c in zip(m._carry_keys(), carry):
             out = out.set(k, c)
         return out

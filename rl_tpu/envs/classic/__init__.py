@@ -1,4 +1,12 @@
+from .acrobot import AcrobotEnv
 from .cartpole import CartPoleEnv
+from .mountain_car import MountainCarContinuousEnv, MountainCarEnv
 from .pendulum import PendulumEnv
 
-__all__ = ["PendulumEnv", "CartPoleEnv"]
+__all__ = [
+    "AcrobotEnv",
+    "CartPoleEnv",
+    "MountainCarContinuousEnv",
+    "MountainCarEnv",
+    "PendulumEnv",
+]

@@ -1,0 +1,123 @@
+"""Acrobot swing-up, pure JAX (classic Gym Acrobot-v1 dynamics).
+
+Two-link underactuated pendulum; torque on the middle joint; RK4
+integration of the book dynamics (Sutton & Barto form). Part of the
+pure-JAX env portfolio (reference keeps this behind the gym wrapper,
+torchrl/envs/libs/gym.py).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...data import ArrayDict, Bounded, Categorical, Composite, Unbounded
+from ..base import EnvBase
+
+__all__ = ["AcrobotEnv"]
+
+
+def _wrap(x, low, high):
+    return low + (x - low) % (high - low)
+
+
+class AcrobotEnv(EnvBase):
+    dt = 0.2
+    link_length_1 = 1.0
+    link_mass_1 = 1.0
+    link_mass_2 = 1.0
+    link_com_1 = 0.5
+    link_com_2 = 0.5
+    link_moi = 1.0
+    max_vel_1 = 4 * jnp.pi
+    max_vel_2 = 9 * jnp.pi
+    torques = (-1.0, 0.0, 1.0)
+    g = 9.8
+
+    def __init__(self, max_episode_steps: int = 500):
+        self.max_episode_steps = max_episode_steps
+
+    @property
+    def observation_spec(self) -> Composite:
+        high = jnp.array(
+            [1.0, 1.0, 1.0, 1.0, float(self.max_vel_1), float(self.max_vel_2)],
+            jnp.float32,
+        )
+        return Composite(observation=Bounded(shape=(6,), low=-high, high=high))
+
+    @property
+    def action_spec(self):
+        return Categorical(n=3)
+
+    @property
+    def state_spec(self) -> Composite:
+        return Composite(
+            physics=Unbounded(shape=(4,)),
+            step_count=Unbounded(shape=(), dtype=jnp.int32),
+        )
+
+    def _obs(self, s):
+        t1, t2, dt1, dt2 = s
+        return ArrayDict(
+            observation=jnp.stack(
+                [jnp.cos(t1), jnp.sin(t1), jnp.cos(t2), jnp.sin(t2), dt1, dt2]
+            )
+        )
+
+    def _reset(self, key):
+        physics = jax.random.uniform(key, (4,), minval=-0.1, maxval=0.1)
+        state = ArrayDict(physics=physics, step_count=jnp.asarray(0, jnp.int32))
+        return state, self._obs(physics)
+
+    def _dsdt(self, s, torque):
+        m1, m2 = self.link_mass_1, self.link_mass_2
+        l1 = self.link_length_1
+        lc1, lc2 = self.link_com_1, self.link_com_2
+        i1 = i2 = self.link_moi
+        g = self.g
+        t1, t2, dt1, dt2 = s
+        d1 = (
+            m1 * lc1**2
+            + m2 * (l1**2 + lc2**2 + 2 * l1 * lc2 * jnp.cos(t2))
+            + i1
+            + i2
+        )
+        d2 = m2 * (lc2**2 + l1 * lc2 * jnp.cos(t2)) + i2
+        phi2 = m2 * lc2 * g * jnp.cos(t1 + t2 - jnp.pi / 2)
+        phi1 = (
+            -m2 * l1 * lc2 * dt2**2 * jnp.sin(t2)
+            - 2 * m2 * l1 * lc2 * dt2 * dt1 * jnp.sin(t2)
+            + (m1 * lc1 + m2 * l1) * g * jnp.cos(t1 - jnp.pi / 2)
+            + phi2
+        )
+        ddt2 = (
+            torque + d2 / d1 * phi1 - m2 * l1 * lc2 * dt1**2 * jnp.sin(t2) - phi2
+        ) / (m2 * lc2**2 + i2 - d2**2 / d1)
+        ddt1 = -(d2 * ddt2 + phi1) / d1
+        return jnp.stack([dt1, dt2, ddt1, ddt2])
+
+    def _rk4(self, s, torque):
+        dt = self.dt
+        k1 = self._dsdt(s, torque)
+        k2 = self._dsdt(s + dt / 2 * k1, torque)
+        k3 = self._dsdt(s + dt / 2 * k2, torque)
+        k4 = self._dsdt(s + dt * k3, torque)
+        return s + dt / 6 * (k1 + 2 * k2 + 2 * k3 + k4)
+
+    def _step(self, state, action, key):
+        torque = jnp.asarray(self.torques)[action]
+        s = self._rk4(state["physics"], torque)
+        s = jnp.stack(
+            [
+                _wrap(s[0], -jnp.pi, jnp.pi),
+                _wrap(s[1], -jnp.pi, jnp.pi),
+                jnp.clip(s[2], -self.max_vel_1, self.max_vel_1),
+                jnp.clip(s[3], -self.max_vel_2, self.max_vel_2),
+            ]
+        )
+        count = state["step_count"] + 1
+        terminated = -jnp.cos(s[0]) - jnp.cos(s[1] + s[0]) > 1.0
+        truncated = count >= self.max_episode_steps
+        reward = jnp.where(terminated, 0.0, -1.0)
+        new_state = ArrayDict(physics=s, step_count=count)
+        return new_state, self._obs(s), reward, terminated, truncated

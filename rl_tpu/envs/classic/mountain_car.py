@@ -1,0 +1,113 @@
+"""Mountain Car, pure JAX (classic Gym dynamics, discrete + continuous).
+
+Part of the pure-JAX env portfolio (the reference keeps classic-control via
+the gym wrapper, torchrl/envs/libs/gym.py; here the sims are native so whole
+rollouts stay inside one XLA program — SURVEY.md §2.13 env-level DP via
+``jax.vmap``).
+
+Dynamics (classic): ``v += force + cos(3 p) * (-0.0025)``;
+``p += v``; walls at p=-1.2 (velocity zeroed); goal on the right hill.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...data import ArrayDict, Bounded, Categorical, Composite, Unbounded
+from ..base import EnvBase
+
+__all__ = ["MountainCarEnv", "MountainCarContinuousEnv"]
+
+
+class MountainCarEnv(EnvBase):
+    """Discrete 3-action mountain car (reward -1/step, goal at 0.5)."""
+
+    min_position = -1.2
+    max_position = 0.6
+    max_speed = 0.07
+    goal_position = 0.5
+    force = 0.001
+    gravity = 0.0025
+
+    def __init__(self, max_episode_steps: int = 200):
+        self.max_episode_steps = max_episode_steps
+
+    @property
+    def observation_spec(self) -> Composite:
+        low = jnp.array([self.min_position, -self.max_speed], jnp.float32)
+        high = jnp.array([self.max_position, self.max_speed], jnp.float32)
+        return Composite(observation=Bounded(shape=(2,), low=low, high=high))
+
+    @property
+    def action_spec(self):
+        return Categorical(n=3)
+
+    @property
+    def state_spec(self) -> Composite:
+        return Composite(
+            physics=Unbounded(shape=(2,)),
+            step_count=Unbounded(shape=(), dtype=jnp.int32),
+        )
+
+    def _reset(self, key):
+        pos = jax.random.uniform(key, (), minval=-0.6, maxval=-0.4)
+        physics = jnp.stack([pos, jnp.asarray(0.0)])
+        state = ArrayDict(physics=physics, step_count=jnp.asarray(0, jnp.int32))
+        return state, ArrayDict(observation=physics)
+
+    def _advance(self, physics, force):
+        pos, vel = physics
+        vel = vel + force + jnp.cos(3 * pos) * (-self.gravity)
+        vel = jnp.clip(vel, -self.max_speed, self.max_speed)
+        pos = pos + vel
+        pos = jnp.clip(pos, self.min_position, self.max_position)
+        vel = jnp.where((pos <= self.min_position) & (vel < 0), 0.0, vel)
+        return jnp.stack([pos, vel])
+
+    def _step(self, state, action, key):
+        physics = self._advance(
+            state["physics"], (action.astype(jnp.float32) - 1.0) * self.force
+        )
+        count = state["step_count"] + 1
+        terminated = physics[0] >= self.goal_position
+        truncated = count >= self.max_episode_steps
+        new_state = ArrayDict(physics=physics, step_count=count)
+        return (
+            new_state,
+            ArrayDict(observation=physics),
+            jnp.asarray(-1.0),
+            terminated,
+            truncated,
+        )
+
+
+class MountainCarContinuousEnv(MountainCarEnv):
+    """Continuous-force variant: action in [-1, 1], +100 at the goal,
+    -0.1 a² control cost per step (classic MountainCarContinuous-v0)."""
+
+    force_scale = 0.0015
+    goal_position = 0.45
+
+    def __init__(self, max_episode_steps: int = 999):
+        super().__init__(max_episode_steps)
+
+    @property
+    def action_spec(self):
+        return Bounded(shape=(1,), low=-1.0, high=1.0)
+
+    def _step(self, state, action, key):
+        a = jnp.clip(action[0], -1.0, 1.0)
+        physics = self._advance(state["physics"], a * self.force_scale)
+        count = state["step_count"] + 1
+        terminated = physics[0] >= self.goal_position
+        truncated = count >= self.max_episode_steps
+        reward = jnp.where(terminated, 100.0, 0.0) - 0.1 * a**2
+        new_state = ArrayDict(physics=physics, step_count=count)
+        return (
+            new_state,
+            ArrayDict(observation=physics),
+            reward,
+            terminated,
+            truncated,
+        )

@@ -1,0 +1,5 @@
+from .navigation import NavigationEnv
+from .tictactoe import TicTacToeEnv
+from .trading import TradingEnv
+
+__all__ = ["NavigationEnv", "TicTacToeEnv", "TradingEnv"]

@@ -1,0 +1,118 @@
+"""Tic-tac-toe, pure JAX (reference: torchrl/envs/custom/tictactoeenv.py).
+
+Turn-based two-player board game in one env: "turn" says whose move it is,
+"action_mask" lists the empty cells (consumed by the ActionMask transform /
+masked exploration). Rewards are from player 0's perspective (+1 player-0
+win, -1 player-1 win, 0 draw) — the zero-sum scalar-reward convention.
+
+``single_player=True`` makes the env play a uniform-random legal move for
+player 1 after every player-0 move (the reference's opponent mode).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...data import ArrayDict, Bounded, Categorical, Composite, Unbounded
+from ...data.specs import Binary
+from ..base import EnvBase
+
+__all__ = ["TicTacToeEnv"]
+
+_LINES = jnp.asarray(
+    [
+        [0, 1, 2],
+        [3, 4, 5],
+        [6, 7, 8],
+        [0, 3, 6],
+        [1, 4, 7],
+        [2, 5, 8],
+        [0, 4, 8],
+        [2, 4, 6],
+    ]
+)
+
+
+def _winner(board):
+    """+1 / -1 if that player completed a line, else 0."""
+    sums = board[_LINES].sum(axis=-1)
+    return jnp.where(
+        jnp.any(sums == 3), 1, jnp.where(jnp.any(sums == -3), -1, 0)
+    ).astype(jnp.int32)
+
+
+class TicTacToeEnv(EnvBase):
+    def __init__(self, single_player: bool = False):
+        self.single_player = single_player
+
+    @property
+    def observation_spec(self) -> Composite:
+        return Composite(
+            board=Bounded(shape=(9,), low=-1, high=1, dtype=jnp.int32),
+            turn=Bounded(shape=(), low=0, high=1, dtype=jnp.int32),
+            action_mask=Binary(shape=(9,)),
+        )
+
+    @property
+    def action_spec(self):
+        return Categorical(n=9)
+
+    @property
+    def state_spec(self) -> Composite:
+        return Composite(
+            board=Unbounded(shape=(9,), dtype=jnp.int32),
+            turn=Unbounded(shape=(), dtype=jnp.int32),
+        )
+
+    def _obs(self, board, turn):
+        return ArrayDict(board=board, turn=turn, action_mask=board == 0)
+
+    def _reset(self, key):
+        board = jnp.zeros((9,), jnp.int32)
+        turn = jnp.asarray(0, jnp.int32)
+        return ArrayDict(board=board, turn=turn), self._obs(board, turn)
+
+    def _place(self, board, cell, mark):
+        """Place if the cell is empty; returns (board, was_legal)."""
+        legal = board[cell] == 0
+        return board.at[cell].set(jnp.where(legal, mark, board[cell])), legal
+
+    def _step(self, state, action, key):
+        board, turn = state["board"], state["turn"]
+        mark = jnp.where(turn == 0, 1, -1).astype(jnp.int32)
+        board, legal = self._place(board, action, mark)
+        win = _winner(board)
+        full = jnp.all(board != 0)
+        over = (win != 0) | full | ~legal
+        # illegal move = forfeit: the mover loses
+        forfeit = jnp.where(turn == 0, -1, 1) * (~legal).astype(jnp.int32)
+        outcome = jnp.where(legal, win, forfeit)
+        next_turn = (turn + 1) % 2
+
+        if self.single_player:
+            # env answers with a random legal move for player 1
+            def opp(args):
+                board, key = args
+                mask = board == 0
+                logits = jnp.where(mask, 0.0, -jnp.inf)
+                cell = jax.random.categorical(key, logits)
+                return board.at[cell].set(-1)
+
+            board = jax.lax.cond(
+                over, lambda a: a[0], opp, (board, key)
+            )
+            win2 = _winner(board)
+            over = over | (win2 != 0) | jnp.all(board != 0)
+            outcome = jnp.where(outcome != 0, outcome, win2)
+            next_turn = jnp.asarray(0, jnp.int32)
+
+        reward = outcome.astype(jnp.float32)
+        new_state = ArrayDict(board=board, turn=next_turn)
+        return (
+            new_state,
+            self._obs(board, next_turn),
+            reward,
+            over,
+            jnp.asarray(False),
+        )

@@ -1,3 +1,12 @@
 from .gym import GymEnv, GymWrapper, spec_from_gym_space
 
-__all__ = ["GymWrapper", "GymEnv", "spec_from_gym_space"]
+__all__ = ["GymWrapper", "GymEnv", "spec_from_gym_space", "PettingZooEnv", "PettingZooWrapper"]
+
+
+def __getattr__(name):
+    # pettingzoo import is optional; load the bridge lazily
+    if name in ("PettingZooEnv", "PettingZooWrapper"):
+        from . import pettingzoo as _pz
+
+        return getattr(_pz, name)
+    raise AttributeError(name)

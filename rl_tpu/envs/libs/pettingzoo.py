@@ -1,0 +1,199 @@
+"""PettingZoo bridge: multi-agent host envs behind the framework contract.
+
+Redesign of the reference's wrapper (reference: torchrl/envs/libs/
+pettingzoo.py:852 ``PettingZooEnv`` — supports both AEC turn-based and
+parallel APIs with group-mapping machinery). Host-side like the gym bridge:
+numpy in/out, consumed by HostCollector / ThreadedEnvPool.
+
+Two modes, mirroring the reference:
+
+- **AEC (turn-based)**: one agent acts per step; the observation exposes the
+  current agent's view, its "action_mask" (legal moves), and "turn" (agent
+  index). The scalar "reward" is the ACTING agent's reward accumulated
+  since its previous turn (including this step); because other agents can
+  accrue rewards during someone else's turn (zero-sum terminal credit),
+  every transition also exposes the full per-agent outstanding-reward
+  vector under "agent_rewards" — learners for turn-based games should read
+  their column from it.
+- **Parallel**: all agents act each step; per-agent leaves are stacked on a
+  leading agent axis under ("agents", ...), team reward = sum — matching the
+  native multi-agent layout (NavigationEnv).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ...data import Categorical, Composite
+from ...data.specs import Binary
+from .gym import spec_from_gym_space
+
+__all__ = ["PettingZooWrapper", "PettingZooEnv"]
+
+
+class PettingZooWrapper:
+    """Wrap a constructed PettingZoo env (AEC or parallel API)."""
+
+    def __init__(self, env):
+        self.env = env
+        self._acc: dict = {}
+        # AEC envs expose per-agent ``observe``; parallel envs do not
+        self.is_parallel = not hasattr(env, "observe")
+        self.agents = list(env.possible_agents)
+        space = env.observation_space(self.agents[0])
+        self._per_agent_obs_spec = spec_from_gym_space(space)
+        self._action_spec = spec_from_gym_space(env.action_space(self.agents[0]))
+        # AEC envs with masked discrete actions expose Dict({observation, action_mask})
+        self._masked = (
+            isinstance(self._per_agent_obs_spec, Composite)
+            and "action_mask" in self._per_agent_obs_spec
+        )
+
+    # -- specs ----------------------------------------------------------------
+
+    @property
+    def observation_spec(self) -> Composite:
+        if self.is_parallel:
+            import dataclasses
+
+            n = len(self.agents)
+            per = self._per_agent_obs_spec
+            if not isinstance(per, Composite):
+                per = Composite(observation=per)
+            stacked = Composite(
+                {
+                    k: dataclasses.replace(v, shape=(n,) + v.shape)
+                    for k, v in per.items()
+                }
+            )
+            return Composite(agents=stacked)
+        import numpy as np
+
+        from ...data import Unbounded
+
+        spec = self._per_agent_obs_spec
+        if not isinstance(spec, Composite):
+            spec = Composite(observation=spec)
+        if "action_mask" in spec:
+            spec = spec.set("action_mask", Binary(shape=spec["action_mask"].shape))
+        spec = spec.set("turn", Categorical(n=len(self.agents)))
+        return spec.set(
+            "agent_rewards", Unbounded(shape=(len(self.agents),), dtype=np.float32)
+        )
+
+    @property
+    def action_spec(self):
+        if self.is_parallel:
+            import dataclasses
+
+            return dataclasses.replace(
+                self._action_spec, shape=(len(self.agents),) + self._action_spec.shape
+            )
+        return self._action_spec
+
+    @property
+    def batch_shape(self) -> tuple:
+        return ()
+
+    # -- host protocol (AEC) ---------------------------------------------------
+
+    def _aec_obs(self) -> dict:
+        agent = self.env.agent_selection
+        raw = self.env.observe(agent)
+        out = {}
+        if isinstance(raw, dict):
+            for k, v in raw.items():
+                out[k] = np.asarray(v)
+        else:
+            out["observation"] = np.asarray(raw)
+        if "action_mask" in out:
+            out["action_mask"] = out["action_mask"].astype(bool)
+        out["turn"] = np.asarray(self.agents.index(agent), np.int32)
+        out["agent_rewards"] = np.asarray(
+            [self._acc.get(a, 0.0) for a in self.agents], np.float32
+        )
+        return out
+
+    def reset(self, seed: int | None = None) -> dict:
+        self._acc = {a: 0.0 for a in self.agents}
+        if self.is_parallel:
+            obs, _ = self.env.reset(seed=seed)
+            return self._stack_parallel(obs)
+        self.env.reset(seed=seed)
+        return self._aec_obs()
+
+    def step(self, action):
+        if self.is_parallel:
+            return self._step_parallel(action)
+        agent = self.env.agent_selection
+        a = np.asarray(action)
+        self.env.step(a.item() if a.ndim == 0 else a)
+        # rewards can be assigned to ANY agent on this step (terminal credit
+        # in zero-sum games lands during the winner's move) — accumulate all,
+        # emit + clear the acting agent's total
+        for ag, r in self.env.rewards.items():
+            self._acc[ag] = self._acc.get(ag, 0.0) + float(r)
+        reward = self._acc.get(agent, 0.0)
+        self._acc[agent] = 0.0
+        trunc = bool(self.env.truncations.get(agent, False))
+        done_all = not self.env.agents or all(
+            self.env.terminations.get(a, False) or self.env.truncations.get(a, False)
+            for a in self.env.agents
+        )
+        if done_all:
+            obs = self._aec_obs() if self.env.agents else self._terminal_obs()
+            return obs, reward, True, trunc
+        return self._aec_obs(), reward, False, trunc
+
+    def _terminal_obs(self) -> dict:
+        spec = self.observation_spec
+        out = {}
+        for k in spec.keys(nested=True, leaves_only=True):
+            leaf = spec[k]
+            out[k[0] if len(k) == 1 else k] = np.zeros(
+                leaf.shape, getattr(leaf, "dtype", np.float32)
+            )
+        if not self.is_parallel:
+            # surface outstanding terminal credit (e.g. the loser's -1)
+            out["agent_rewards"] = np.asarray(
+                [self._acc.get(a, 0.0) for a in self.agents], np.float32
+            )
+        return out
+
+    # -- host protocol (parallel) ----------------------------------------------
+
+    def _stack_parallel(self, obs: dict) -> dict:
+        per = [obs[a] for a in self.agents]
+        if isinstance(per[0], dict):
+            keys = per[0].keys()
+            return {
+                ("agents", k): np.stack([np.asarray(p[k]) for p in per]) for k in keys
+            }
+        return {("agents", "observation"): np.stack([np.asarray(p) for p in per])}
+
+    def _step_parallel(self, action):
+        acts = {a: np.asarray(action[i]) for i, a in enumerate(self.agents)}
+        obs, rewards, terms, truncs, _ = self.env.step(acts)
+        reward = float(sum(rewards.values()))
+        term = bool(all(terms.values())) if terms else True
+        trunc = bool(all(truncs.values())) if truncs else False
+        if not obs:
+            return self._terminal_obs(), reward, term, trunc
+        return self._stack_parallel(obs), reward, term, trunc
+
+    def close(self) -> None:
+        self.env.close()
+
+
+class PettingZooEnv(PettingZooWrapper):
+    """Build from a task name, e.g. ``PettingZooEnv("classic/tictactoe_v3")``
+    (reference PettingZooEnv's task= constructor)."""
+
+    def __init__(self, task: str, **kwargs):
+        import importlib
+
+        family, name = task.split("/")
+        mod = importlib.import_module(f"pettingzoo.{family}.{name}")
+        env = mod.env(**kwargs) if hasattr(mod, "env") else mod.parallel_env(**kwargs)
+        super().__init__(env)
+        self.task = task

@@ -91,8 +91,11 @@ class SelectTransform(Transform):
         return tstate, self._apply(next_td)
 
     def transform_observation_spec(self, spec):
+        # keep the same bookkeeping keys the data path keeps, so the
+        # spec==data invariant of TransformedEnv holds
+        bookkeeping = {("episode_reward",), ("step_count",), ("is_init",)}
         for k in list(spec.keys(nested=True, leaves_only=True)):
-            if k not in self.keys:
+            if k not in self.keys and k not in bookkeeping:
                 spec = spec.delete(k)
         return spec
 
@@ -436,7 +439,9 @@ class EndOfLifeTransform(Transform):
         eol = (lives < tstate["lives"]) & ~next_td["done"]
         out = next_td.set("end_of_life", eol)
         if self.done_on_life_loss:
-            out = out.set("truncated", out["truncated"] | eol).set(
+            # life loss must TERMINATE (cut value bootstrapping — the DQN
+            # trick), not truncate (ops/value.py: terminated cuts bootstrap)
+            out = out.set("terminated", out["terminated"] | eol).set(
                 "done", out["done"] | eol
             )
         return ArrayDict(lives=lives), out
