@@ -11,7 +11,18 @@ from .samplers import (
     SliceSamplerWithoutReplacement,
     StalenessAwareSampler,
 )
-from .storages import DeviceStorage, ListStorage, MemmapStorage, Storage
+from .storages import (
+    CompressedListStorage,
+    DeviceStorage,
+    ListStorage,
+    MemmapStorage,
+    Storage,
+    StorageEnsemble,
+)
+from .ensemble import ReplayBufferEnsemble
+from .checkpointers import load_buffer_state, save_buffer_state
+from .scheduler import LinearScheduler, SchedulerList, StepScheduler
+from .query import insertion_order_indices, iterate_ordered, read_latest, read_range
 from .writers import ImmutableDatasetWriter, MaxValueWriter, RoundRobinWriter, Writer
 
 __all__ = [
@@ -23,6 +34,18 @@ __all__ = [
     "DeviceStorage",
     "MemmapStorage",
     "ListStorage",
+    "CompressedListStorage",
+    "StorageEnsemble",
+    "ReplayBufferEnsemble",
+    "save_buffer_state",
+    "load_buffer_state",
+    "LinearScheduler",
+    "StepScheduler",
+    "SchedulerList",
+    "read_range",
+    "read_latest",
+    "iterate_ordered",
+    "insertion_order_indices",
     "Sampler",
     "RandomSampler",
     "SamplerWithoutReplacement",

@@ -1,0 +1,84 @@
+"""Per-storage checkpointers (reference: torchrl/data/replay_buffers/
+checkpointers.py — flat/nested/H5 storage checkpointers).
+
+``save_buffer_state``/``load_buffer_state`` serialize a ReplayBuffer's full
+runtime state (storage arrays + sampler priorities + writer cursors) so
+off-policy training resumes with its replay intact:
+
+- Device-backed state (an ArrayDict pytree) -> one ``.npz`` of flattened
+  leaves.
+- MemmapStorage -> the memmaps already live on disk; only the cursor dict
+  is written (a json manifest next to the scratch dir).
+
+The trainer-level checkpoint registry (rl_tpu/checkpoint) handles model/
+optimizer state; these functions are the storage-level adapters it plugs in.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..arraydict import ArrayDict
+from .storages import MemmapStorage
+
+__all__ = ["save_buffer_state", "load_buffer_state"]
+
+_SEP = "\x1f"  # unit separator: safe joiner for nested key paths
+
+
+def save_buffer_state(buffer, state, path: str) -> None:
+    """Serialize buffer runtime state to ``path`` (.npz + optional .json)."""
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    host_state = {}
+    arrays = {}
+
+    def visit(prefix: tuple, node):
+        if isinstance(node, ArrayDict):
+            for k in node:
+                visit(prefix + (k,), node[k])
+        elif isinstance(node, dict):  # memmap/list storage python state
+            host_state[_SEP.join(prefix)] = node
+        else:
+            arrays[_SEP.join(prefix)] = np.asarray(node)
+
+    visit((), state)
+    np.savez(path + ".npz", **arrays)
+    if host_state or isinstance(buffer.storage, MemmapStorage):
+        manifest = {"host_state": host_state}
+        if isinstance(buffer.storage, MemmapStorage):
+            manifest["scratch_dir"] = buffer.storage.scratch_dir
+            buffer.storage.flush()
+        with open(path + ".json", "w") as f:
+            json.dump(manifest, f)
+
+
+def load_buffer_state(buffer, path: str) -> ArrayDict:
+    """Rebuild buffer state saved by :func:`save_buffer_state`."""
+    flat = {}
+    with np.load(path + ".npz") as z:
+        for k in z.files:
+            flat[tuple(k.split(_SEP))] = jnp.asarray(z[k])
+    state = ArrayDict()
+    for k, v in flat.items():
+        state = state.set(k, v)
+    # leaf-less subtrees (e.g. a RandomSampler's empty state) leave no
+    # arrays behind — rebuild them from the buffer's components
+    if "sampler" not in state:
+        state = state.set("sampler", buffer.sampler.init(buffer.capacity))
+    if "writer" not in state:
+        state = state.set("writer", buffer.writer.init(buffer.capacity))
+    if os.path.exists(path + ".json"):
+        with open(path + ".json") as f:
+            manifest = json.load(f)
+        for k, node in manifest["host_state"].items():
+            state = state.set(tuple(k.split(_SEP)), node)
+        if "scratch_dir" in manifest and isinstance(buffer.storage, MemmapStorage):
+            # point the storage at the checkpointed memmaps; the caller's
+            # next buffer.init(example) reattaches them without truncation
+            # (MemmapStorage.init opens existing right-sized files "r+")
+            buffer.storage.scratch_dir = manifest["scratch_dir"]
+    return state

@@ -1,0 +1,84 @@
+"""Replay buffer ensembles (reference: torchrl/data/replay_buffers/
+replay_buffers.py:3064 ``ReplayBufferEnsemble``).
+
+Samples a full batch from EACH member buffer, then composes the final batch
+by drawing each row from member ``m`` with probability ``weights[m]`` — the
+jit-friendly formulation of the reference's per-sample buffer choice (all
+gathers are fixed-shape; the mixture select is a ``where``). Used for
+offline-to-online mixes (expert dataset + online buffer).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..arraydict import ArrayDict
+from .buffer import ReplayBuffer
+
+__all__ = ["ReplayBufferEnsemble"]
+
+
+class ReplayBufferEnsemble:
+    def __init__(self, *buffers: ReplayBuffer, weights=None, batch_size: int | None = None):
+        if not buffers:
+            raise ValueError("need at least one member buffer")
+        self.buffers = list(buffers)
+        w = jnp.asarray(
+            weights if weights is not None else [1.0] * len(buffers), jnp.float32
+        )
+        self.weights = w / w.sum()
+        self.batch_size = batch_size
+
+    def init(self, example: ArrayDict) -> ArrayDict:
+        return ArrayDict(
+            {f"b{i}": rb.init(example) for i, rb in enumerate(self.buffers)}
+        )
+
+    def extend_member(self, state: ArrayDict, which: int, items: ArrayDict, n=None):
+        key = f"b{which}"
+        return state.set(key, self.buffers[which].extend(state[key], items, n=n))
+
+    def size(self, state: ArrayDict):
+        return sum(
+            jnp.asarray(rb.size(state[f"b{i}"]))
+            for i, rb in enumerate(self.buffers)
+        )
+
+    def sample(
+        self, state: ArrayDict, key: jax.Array, batch_size: int | None = None
+    ) -> tuple[ArrayDict, ArrayDict]:
+        bs = batch_size or self.batch_size
+        if bs is None:
+            raise ValueError("batch_size not set")
+        kc, *keys = jax.random.split(key, len(self.buffers) + 1)
+        batches, new_state = [], state
+        for i, (rb, k) in enumerate(zip(self.buffers, keys)):
+            b, s = rb.sample(state[f"b{i}"], k, batch_size=bs)
+            # members can disagree on info keys (PER adds _weight) — keep
+            # the intersection so the mixture select has one structure
+            batches.append(b)
+            new_state = new_state.set(f"b{i}", s)
+        shared = set(batches[0].keys(nested=True, leaves_only=True))
+        for b in batches[1:]:
+            shared &= set(b.keys(nested=True, leaves_only=True))
+        batches = [b.select(*shared) for b in batches]
+        # empty members must not contribute (their samplers clamp to row 0
+        # of unwritten storage); zero their weight and renormalize
+        sizes = jnp.stack(
+            [
+                jnp.asarray(rb.size(state[f"b{i}"]), jnp.float32)
+                for i, rb in enumerate(self.buffers)
+            ]
+        )
+        w = self.weights * (sizes > 0)
+        w = w / jnp.clip(w.sum(), 1e-12)
+        which = jax.random.choice(kc, len(self.buffers), (bs,), p=w)
+        stacked = ArrayDict.stack(batches, axis=0)  # [M, bs, ...]
+
+        def pick(leaf):
+            w = which.reshape((1, bs) + (1,) * (leaf.ndim - 2)).astype(jnp.int32)
+            return jnp.take_along_axis(leaf, w, axis=0)[0]
+
+        out = stacked.apply(pick)
+        return out.set("buffer_ids", which.astype(jnp.int32)), new_state

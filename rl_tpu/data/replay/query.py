@@ -1,0 +1,69 @@
+"""Ordered / range access over replay storage (reference: torchrl/data/
+replay_buffers/query.py — range and ordered storage reads outside the
+sampler path).
+
+The sampler API answers "give me a random batch"; these helpers answer
+"give me rows [a, b)", "iterate the buffer in insertion order", "give me
+the most recent k" — needed by offline evaluation, dataset export, and
+staleness inspection. All device-path functions are jit-safe fixed-shape
+gathers.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..arraydict import ArrayDict
+
+__all__ = ["read_range", "read_latest", "iterate_ordered", "insertion_order_indices"]
+
+
+def read_range(buffer, state: ArrayDict, start: int, stop: int) -> ArrayDict:
+    """Rows [start, stop) in STORAGE order (static bounds; jit-safe)."""
+    idx = jnp.arange(start, stop)
+    return buffer.storage.get(state["storage"], idx)
+
+
+def insertion_order_indices(buffer, state: ArrayDict) -> jax.Array:
+    """Storage indices sorted oldest -> newest for a ring-written buffer.
+
+    With a RoundRobinWriter the write cursor wraps: the oldest row is at
+    ``cursor`` once the ring is full, else at 0. Returns a full-capacity
+    index vector; only the first ``size`` entries are valid.
+    """
+    cap = buffer.capacity
+    cursor = state["storage"]["cursor"]
+    size = buffer.size(state)
+    full = size >= cap
+    startpos = jnp.where(full, cursor, 0)
+    return (startpos + jnp.arange(cap)) % cap
+
+
+def read_latest(buffer, state: ArrayDict, k: int) -> ArrayDict:
+    """The k most recently written rows, newest last (static k).
+
+    When fewer than k rows have been written, the OLDEST written row is
+    repeated at the front (fixed output shape; never fabricates unwritten
+    zero rows).
+    """
+    cap = buffer.capacity
+    cursor = state["storage"]["cursor"]
+    size = jnp.asarray(buffer.size(state))
+    ring = (cursor - k + jnp.arange(k)) % cap          # size >= cap case
+    oldest = jnp.where(size >= cap, cursor % cap, 0)
+    lin = jnp.clip(size - k + jnp.arange(k), 0, jnp.maximum(size - 1, 0))
+    idx = jnp.where(size >= cap, ring, (oldest + lin) % cap)
+    return buffer.storage.get(state["storage"], idx)
+
+
+def iterate_ordered(buffer, state: ArrayDict, batch_size: int):
+    """Host-side generator over the buffer in insertion order (reference
+    ordered access / __iter__). Not jit: intended for export/eval loops."""
+    import numpy as np
+
+    order = np.asarray(insertion_order_indices(buffer, state))
+    size = int(buffer.size(state))
+    for i in range(0, size, batch_size):
+        idx = jnp.asarray(order[i : min(i + batch_size, size)])
+        yield buffer.storage.get(state["storage"], idx)

@@ -1,0 +1,76 @@
+"""Sampler/storage parameter schedulers (reference: torchrl/data/
+replay_buffers/scheduler.py — anneal sampler params like PER α/β over
+training).
+
+A scheduler is pure: ``value(step) -> float`` plus ``apply(sstate, step) ->
+sstate`` writing into a named field of the sampler state. Because sampler
+state threads through jit, schedules compile into the train step (no host
+mutation) — the TPU-native form of the reference's in-place ``step()``.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..arraydict import ArrayDict
+
+__all__ = ["LinearScheduler", "StepScheduler", "SchedulerList"]
+
+
+class LinearScheduler:
+    """Linear ramp ``init -> end`` over ``num_steps`` (reference
+    LinearScheduler)."""
+
+    def __init__(self, field: str, init_value: float, end_value: float, num_steps: int):
+        self.field = field
+        self.init_value = init_value
+        self.end_value = end_value
+        self.num_steps = num_steps
+
+    def value(self, step):
+        frac = jnp.clip(jnp.asarray(step, jnp.float32) / self.num_steps, 0.0, 1.0)
+        return self.init_value + (self.end_value - self.init_value) * frac
+
+    def apply(self, sstate: ArrayDict, step) -> ArrayDict:
+        return sstate.set(self.field, self.value(step))
+
+
+class StepScheduler:
+    """Multiply the field by ``gamma`` every ``n`` steps, clamped (reference
+    StepScheduler)."""
+
+    def __init__(
+        self,
+        field: str,
+        init_value: float,
+        gamma: float = 0.1,
+        n_steps: int = 10_000,
+        min_value: float = 0.0,
+        max_value: float = float("inf"),
+    ):
+        self.field = field
+        self.init_value = init_value
+        self.gamma = gamma
+        self.n_steps = n_steps
+        self.min_value = min_value
+        self.max_value = max_value
+
+    def value(self, step):
+        k = jnp.asarray(step, jnp.int32) // self.n_steps
+        v = self.init_value * jnp.power(self.gamma, k.astype(jnp.float32))
+        return jnp.clip(v, self.min_value, self.max_value)
+
+    def apply(self, sstate: ArrayDict, step) -> ArrayDict:
+        return sstate.set(self.field, self.value(step))
+
+
+class SchedulerList:
+    """Apply several schedulers (reference SchedulerList)."""
+
+    def __init__(self, *schedulers):
+        self.schedulers = list(schedulers)
+
+    def apply(self, sstate: ArrayDict, step) -> ArrayDict:
+        for s in self.schedulers:
+            sstate = s.apply(sstate, step)
+        return sstate

@@ -112,14 +112,33 @@ class MemmapStorage(Storage):
         self._maps: dict[tuple, np.memmap] = {}
 
     def init(self, example: ArrayDict) -> dict:
+        import json
+
         os.makedirs(self.scratch_dir, exist_ok=True)
         self._maps = {}
+        meta_path = os.path.join(self.scratch_dir, "meta.json")
+        old_meta = {}
+        if os.path.exists(meta_path):
+            with open(meta_path) as f:
+                old_meta = json.load(f)
+        meta = {}
         for path in example.keys(nested=True, leaves_only=True):
             x = np.asarray(example[path])
             fname = os.path.join(self.scratch_dir, "_".join(path) + ".dat")
-            self._maps[path] = np.memmap(
-                fname, dtype=x.dtype, mode="w+", shape=(self.capacity,) + x.shape
+            shape = (self.capacity,) + x.shape
+            sig = {"dtype": str(x.dtype), "shape": list(shape)}
+            meta["_".join(path)] = sig
+            # reattach (don't truncate) only when the sidecar metadata proves
+            # the file holds the SAME dtype/shape layout — byte size alone
+            # would silently reinterpret old data under a changed schema
+            mode = (
+                "r+"
+                if os.path.exists(fname) and old_meta.get("_".join(path)) == sig
+                else "w+"
             )
+            self._maps[path] = np.memmap(fname, dtype=x.dtype, mode=mode, shape=shape)
+        with open(meta_path, "w") as f:
+            json.dump(meta, f)
         return {"cursor": 0, "size": 0}
 
     def set(self, state: dict, idx, items: ArrayDict) -> dict:
@@ -170,3 +189,98 @@ class ListStorage(Storage):
 
     def size(self, state: dict) -> int:
         return state["size"]
+
+
+class CompressedListStorage(ListStorage):
+    """Host storage with per-item zlib compression (reference
+    CompressedListStorage, storages.py:1953): each item's leaves are packed
+    into one compressed blob; decompressed on read. For large image/video
+    replay where host RAM, not device HBM, is the bound.
+    """
+
+    def __init__(self, capacity: int, level: int = 3):
+        super().__init__(capacity)
+        self.level = level
+
+    @staticmethod
+    def _pack(item: ArrayDict) -> bytes:
+        import io
+        import zlib
+
+        buf = io.BytesIO()
+        flat = {
+            "/".join(k): np.asarray(v)
+            for k, v in item.items(nested=True, leaves_only=True)
+        }
+        np.savez(buf, **flat)
+        return zlib.compress(buf.getvalue())
+
+    @staticmethod
+    def _unpack(blob: bytes) -> ArrayDict:
+        import io
+        import zlib
+
+        with np.load(io.BytesIO(zlib.decompress(blob))) as z:
+            out = ArrayDict()
+            for k in z.files:
+                out = out.set(tuple(k.split("/")), jnp.asarray(z[k]))
+        return out
+
+    def set(self, state: dict, idx, items) -> dict:
+        idx = np.atleast_1d(np.asarray(idx))
+        seq = (
+            items
+            if isinstance(items, (list, tuple))
+            else [items[i] for i in range(idx.size)]
+        )
+        blobs = [self._pack(it) for it in seq]
+        return super().set(state, idx, blobs)
+
+    def get(self, state: dict, idx):
+        return [self._unpack(b) for b in super().get(state, idx)]
+
+    def nbytes(self) -> int:
+        return sum(len(b) for b in self._items if b is not None)
+
+
+class StorageEnsemble(Storage):
+    """Fixed collection of storages sampled as one (reference
+    StorageEnsemble, storages.py:2266). Reads take a (which, idx) pair;
+    writes must target a member explicitly (``set_member``) — members
+    typically hold distinct datasets (expert vs online data).
+    """
+
+    def __init__(self, *storages: Storage):
+        super().__init__(sum(s.capacity for s in storages))
+        self.storages = list(storages)
+
+    def init(self, example: ArrayDict):
+        return [s.init(example) for s in self.storages]
+
+    def set_member(self, state, which: int, idx, items):
+        state = list(state)
+        state[which] = self.storages[which].set(state[which], idx, items)
+        return state
+
+    def set(self, state, idx, items):
+        raise NotImplementedError("StorageEnsemble: use set_member(which, ...)")
+
+    def get(self, state, which_and_idx):
+        which, idx = which_and_idx
+        # gather member-by-member, then select: jit-safe for DeviceStorages
+        outs = [
+            self.storages[i].get(state[i], jnp.asarray(idx) % self.storages[i].capacity)
+            for i in range(len(self.storages))
+        ]
+        which = jnp.asarray(which)
+        stacked = ArrayDict.stack(outs, axis=0)
+
+        def pick(leaf):
+            w = which.reshape(which.shape + (1,) * (leaf.ndim - 1 - which.ndim))
+            return jnp.take_along_axis(leaf, w[None].astype(jnp.int32), axis=0)[0]
+
+        return stacked.apply(pick)
+
+    def size(self, state):
+        sizes = [s.size(st) for s, st in zip(self.storages, state)]
+        return sum(jnp.asarray(s) for s in sizes)

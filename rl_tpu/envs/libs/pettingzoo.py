@@ -126,8 +126,15 @@ class PettingZooWrapper:
         if self.is_parallel:
             return self._step_parallel(action)
         agent = self.env.agent_selection
-        a = np.asarray(action)
-        self.env.step(a.item() if a.ndim == 0 else a)
+        # AEC API: a terminated/truncated agent's only legal action is None
+        dead = self.env.terminations.get(agent, False) or self.env.truncations.get(
+            agent, False
+        )
+        if dead:
+            self.env.step(None)
+        else:
+            a = np.asarray(action)
+            self.env.step(a.item() if a.ndim == 0 else a)
         # rewards can be assigned to ANY agent on this step (terminal credit
         # in zero-sum games lands during the winner's move) — accumulate all,
         # emit + clear the acting agent's total
@@ -163,23 +170,47 @@ class PettingZooWrapper:
     # -- host protocol (parallel) ----------------------------------------------
 
     def _stack_parallel(self, obs: dict) -> dict:
-        per = [obs[a] for a in self.agents]
-        if isinstance(per[0], dict):
-            keys = per[0].keys()
+        # fixed (n_agents, ...) layout: dead agents' rows are zero-filled
+        # (parallel envs drop them from the obs dict mid-episode)
+        example = next(iter(obs.values()))
+        per = [obs.get(a) for a in self.agents]
+        if isinstance(example, dict):
             return {
-                ("agents", k): np.stack([np.asarray(p[k]) for p in per]) for k in keys
+                ("agents", k): np.stack(
+                    [
+                        np.asarray(p[k])
+                        if p is not None
+                        else np.zeros_like(np.asarray(example[k]))
+                        for p in per
+                    ]
+                )
+                for k in example
             }
-        return {("agents", "observation"): np.stack([np.asarray(p) for p in per])}
+        return {
+            ("agents", "observation"): np.stack(
+                [
+                    np.asarray(p)
+                    if p is not None
+                    else np.zeros_like(np.asarray(example))
+                    for p in per
+                ]
+            )
+        }
 
     def _step_parallel(self, action):
-        acts = {a: np.asarray(action[i]) for i, a in enumerate(self.agents)}
+        # only LIVE agents receive actions (dead ones are dropped by the env)
+        live = list(self.env.agents)
+        acts = {
+            a: np.asarray(action[self.agents.index(a)]) for a in live
+        }
         obs, rewards, terms, truncs, _ = self.env.step(acts)
         reward = float(sum(rewards.values()))
         term = bool(all(terms.values())) if terms else True
         trunc = bool(all(truncs.values())) if truncs else False
+        done = (term or trunc) and not self.env.agents
         if not obs:
-            return self._terminal_obs(), reward, term, trunc
-        return self._stack_parallel(obs), reward, term, trunc
+            return self._terminal_obs(), reward, True, trunc
+        return self._stack_parallel(obs), reward, done, trunc
 
     def close(self) -> None:
         self.env.close()
@@ -189,11 +220,14 @@ class PettingZooEnv(PettingZooWrapper):
     """Build from a task name, e.g. ``PettingZooEnv("classic/tictactoe_v3")``
     (reference PettingZooEnv's task= constructor)."""
 
-    def __init__(self, task: str, **kwargs):
+    def __init__(self, task: str, parallel: bool = False, **kwargs):
         import importlib
 
         family, name = task.split("/")
         mod = importlib.import_module(f"pettingzoo.{family}.{name}")
-        env = mod.env(**kwargs) if hasattr(mod, "env") else mod.parallel_env(**kwargs)
+        if parallel:
+            env = mod.parallel_env(**kwargs)
+        else:
+            env = mod.env(**kwargs)
         super().__init__(env)
         self.task = task

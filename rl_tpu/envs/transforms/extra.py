@@ -58,6 +58,9 @@ __all__ = [
 ]
 
 _PROTECTED = [("reward",), ("done",), ("terminated",), ("truncated",)]
+# bookkeeping written by sibling transforms (RewardSum/StepCounter/
+# InitTracker); SelectTransform keeps these in BOTH data and spec paths
+_BOOKKEEPING = [("episode_reward",), ("step_count",), ("is_init",)]
 
 
 def _tupled(keys) -> list[tuple]:
@@ -75,14 +78,8 @@ class SelectTransform(Transform):
         self.keys = _tupled(keys)
 
     def _apply(self, td: ArrayDict) -> ArrayDict:
-        keep = [k for k in self.keys + _PROTECTED if k in td]
-        # also keep non-observation bookkeeping produced by outer machinery
-        extra = [
-            k
-            for k in td.keys(nested=True, leaves_only=True)
-            if k[0] in ("episode_reward", "step_count", "is_init")
-        ]
-        return td.select(*keep, *extra, strict=False)
+        keep = [k for k in self.keys + _PROTECTED + _BOOKKEEPING if k in td]
+        return td.select(*keep, strict=False)
 
     def reset(self, tstate, td):
         return tstate, self._apply(td)
@@ -91,11 +88,9 @@ class SelectTransform(Transform):
         return tstate, self._apply(next_td)
 
     def transform_observation_spec(self, spec):
-        # keep the same bookkeeping keys the data path keeps, so the
-        # spec==data invariant of TransformedEnv holds
-        bookkeeping = {("episode_reward",), ("step_count",), ("is_init",)}
+        # same keep-rule as _apply, so the spec==data invariant holds
         for k in list(spec.keys(nested=True, leaves_only=True)):
-            if k not in self.keys and k not in bookkeeping:
+            if k not in self.keys and k not in _BOOKKEEPING:
                 spec = spec.delete(k)
         return spec
 

@@ -23,3 +23,18 @@ __all__ = [
     "UTDRHook",
     "Evaluator",
 ]
+
+
+def __getattr__(name):
+    # algorithm builders pull in collectors/objectives; load lazily to keep
+    # `import rl_tpu.trainers` light and side-effect-free
+    _builders = {
+        "make_ppo_trainer", "make_sac_trainer", "make_dqn_trainer",
+        "make_td3_trainer", "make_a2c_trainer", "train_iql", "train_cql",
+        "default_continuous_actor", "default_discrete_actor",
+    }
+    if name in _builders:
+        from . import algorithms as _alg
+
+        return getattr(_alg, name)
+    raise AttributeError(name)

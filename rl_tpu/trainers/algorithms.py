@@ -280,28 +280,54 @@ def make_a2c_trainer(
     return _std_hooks(Trainer(program, total_steps, logger=logger), log_interval)
 
 
+def _offline_example(rb, buffer_state):
+    """One stored row, storage-agnostic (device OR memmap datasets)."""
+    return rb.storage.get(buffer_state["storage"], jnp.asarray([0]))
+
+
 def _offline_loop(loss, buffer_state, rb, total_steps, batch_size, learning_rate, logger, log_interval, seed=0, tau=0.005):
-    """Shared offline-training driver for IQL/CQL builders."""
+    """Shared offline-training driver for IQL/CQL builders.
+
+    Device-backed datasets sample inside the jitted step; memmap (host)
+    datasets sample on host and feed the jitted update — the reference's
+    dataloader split (minari_data.py memmap buffers) mapped onto jit.
+    """
     import optax
 
+    from ..data.replay.storages import MemmapStorage
     from ..record.loggers import NullLogger
 
     logger = logger or NullLogger()
-    example = buffer_state["storage", "data"][0:1]
+    host_sampled = isinstance(rb.storage, MemmapStorage)
+    example = _offline_example(rb, buffer_state)
     params = loss.init_params(jax.random.key(seed), example)
     opt = optax.adam(learning_rate)
     opt_state = opt.init(loss.trainable(params))
     update = SoftUpdate(loss, tau=tau)
 
-    @jax.jit
-    def step(params, opt_state, bstate, key):
-        k_s, k_l = jax.random.split(key)
-        batch, bstate = rb.sample(bstate, k_s, batch_size)
+    def _update(params, opt_state, batch, k_l):
         loss_val, grads, metrics = loss.grad(params, batch, k_l)
         upd, opt_state = opt.update(grads, opt_state, loss.trainable(params))
         tr = optax.apply_updates(loss.trainable(params), upd)
         params = update(loss.merge(tr, params))
-        return params, opt_state, bstate, metrics.set("loss", loss_val)
+        return params, opt_state, metrics.set("loss", loss_val)
+
+    if host_sampled:
+        jit_update = jax.jit(_update)
+
+        def step(params, opt_state, bstate, key):
+            k_s, k_l = jax.random.split(key)
+            batch, bstate = rb.sample(bstate, k_s, batch_size)
+            params, opt_state, metrics = jit_update(params, opt_state, batch, k_l)
+            return params, opt_state, bstate, metrics
+    else:
+
+        @jax.jit
+        def step(params, opt_state, bstate, key):
+            k_s, k_l = jax.random.split(key)
+            batch, bstate = rb.sample(bstate, k_s, batch_size)
+            params, opt_state, metrics = _update(params, opt_state, batch, k_l)
+            return params, opt_state, bstate, metrics
 
     key = jax.random.key(seed + 1)
     for i in range(total_steps):
@@ -336,7 +362,7 @@ def train_iql(
     collection/hook lifecycle to drive."""
     from ..objectives import IQLLoss
 
-    actor = _offline_continuous_actor(dataset_state["storage", "data"][0:1])
+    actor = _offline_continuous_actor(_offline_example(dataset_buffer, dataset_state))
     loss = IQLLoss(
         actor,
         ConcatMLP(out_features=1, num_cells=(256, 256)),
@@ -366,7 +392,7 @@ def train_cql(
     CQLTrainer). Runs now, returns trained params (see train_iql)."""
     from ..objectives import CQLLoss
 
-    actor = _offline_continuous_actor(dataset_state["storage", "data"][0:1])
+    actor = _offline_continuous_actor(_offline_example(dataset_buffer, dataset_state))
     loss = CQLLoss(
         actor,
         ConcatMLP(out_features=1, num_cells=(256, 256)),

@@ -4,6 +4,14 @@ Mirrors the reference's distributed-tests-without-a-cluster strategy
 (reference test/test_distributed.py spawns process groups on one machine);
 here we instead ask XLA for 8 host devices so every sharding/pjit test runs
 the real partitioner without TPU hardware.
+
+Tiers (reference CI's per-job isolation, SURVEY §4):
+- smoke:  ``pytest -m "not slow and not mesh"``  (~2 min on a 1-core box)
+- mesh:   ``pytest -m mesh`` — multi-device sharding/pjit tests
+- full:   ``pytest tests/`` — everything (what the driver runs)
+Compile artifacts persist in RL_TPU_TEST_CACHE between runs, and XLA's
+backend optimization level is dropped for tests (hundreds of tiny programs;
+codegen quality is irrelevant to correctness).
 """
 
 import os
@@ -11,9 +19,11 @@ import os
 # XLA_FLAGS must be set before the CPU client initializes (first device use).
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+    flags = (flags + " --xla_force_host_platform_device_count=8").strip()
+if "xla_backend_optimization_level" not in flags:
+    # tests compile hundreds of tiny programs; codegen quality is irrelevant
+    flags += " --xla_backend_optimization_level=0 --xla_llvm_disable_expensive_passes=true"
+os.environ["XLA_FLAGS"] = flags
 
 import jax  # noqa: E402
 
@@ -21,6 +31,14 @@ import jax  # noqa: E402
 # JAX_PLATFORMS=axon before any user code runs, so an env-var override here is
 # too late — but jax.config wins over the env and backends init lazily.
 jax.config.update("jax_platforms", "cpu")
+
+# Persistent compile cache: big fused-program tests (trainer loops, GRPO)
+# compile once per content hash instead of once per run.
+_cache_dir = os.environ.get(
+    "RL_TPU_TEST_CACHE", os.path.expanduser("~/.cache/rl_tpu_jax_cache")
+)
+jax.config.update("jax_compilation_cache_dir", _cache_dir)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
 import pytest  # noqa: E402
 

@@ -29,12 +29,14 @@ KEY = jax.random.key(0)
 
 
 class TestAlgorithmBuilders:
+    @pytest.mark.slow
     def test_ppo_builder_runs(self):
         env = TransformedEnv(VmapEnv(CartPoleEnv(), 4), RewardSum())
         tr = make_ppo_trainer(env, total_steps=2, frames_per_batch=64)
         tr.train(0)
         assert tr.step_count == 2
 
+    @pytest.mark.slow
     def test_sac_builder_runs(self):
         env = TransformedEnv(VmapEnv(PendulumEnv(), 4), RewardSum())
         from rl_tpu.trainers import OffPolicyConfig
@@ -46,6 +48,7 @@ class TestAlgorithmBuilders:
         tr.train(0)
         assert tr.step_count == 2
 
+    @pytest.mark.slow
     def test_dqn_builder_runs(self):
         env = TransformedEnv(VmapEnv(CartPoleEnv(), 4), RewardSum())
         from rl_tpu.trainers import OffPolicyConfig
@@ -57,6 +60,7 @@ class TestAlgorithmBuilders:
         tr.train(0)
         assert tr.step_count == 2
 
+    @pytest.mark.slow
     def test_td3_builder_runs(self):
         env = TransformedEnv(VmapEnv(PendulumEnv(), 4), RewardSum())
         from rl_tpu.trainers import OffPolicyConfig
@@ -148,6 +152,7 @@ class TestVideo:
 
 
 class TestReplayService:
+    @pytest.mark.slow
     def test_remote_buffer_roundtrip(self):
         from rl_tpu.data import (
             ArrayDict,
@@ -183,6 +188,7 @@ class TestReplayService:
 
 
 class TestA2CBuilder:
+    @pytest.mark.slow
     def test_a2c_builder_runs(self):
         from rl_tpu.envs import CartPoleEnv, RewardSum, TransformedEnv, VmapEnv
         from rl_tpu.trainers.algorithms import make_a2c_trainer
@@ -237,6 +243,7 @@ class TestRemoteLogger:
 
 
 class TestStalenessSampler:
+    @pytest.mark.slow
     def test_fresh_sampled_more_and_gate(self):
         from rl_tpu.data import ArrayDict as AD, DeviceStorage, ReplayBuffer, StalenessAwareSampler
 
@@ -263,6 +270,7 @@ class TestStalenessSampler:
 
 
 class TestOfflineBuilders:
+    @pytest.mark.slow
     def test_iql_builder_trains_on_synthetic(self):
         from rl_tpu.data import dataset_from_arrays
         from rl_tpu.trainers.algorithms import train_iql
@@ -277,6 +285,7 @@ class TestOfflineBuilders:
         params = train_iql(rb, state, total_steps=5, batch_size=64)
         assert "value" in params and "target_qvalue" in params
 
+    @pytest.mark.slow
     def test_cql_builder_trains_on_synthetic(self):
         from rl_tpu.data import dataset_from_arrays
         from rl_tpu.trainers.algorithms import train_cql

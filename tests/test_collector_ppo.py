@@ -60,12 +60,14 @@ class TestCollector:
             assert (np.diff(col) >= 0).all()
         assert len(np.unique(ids)) >= 2 * (12 // 3) - 1
 
+    @pytest.mark.slow
     def test_total_frames_budget(self):
         env = VmapEnv(CountingEnv(), 2)
         coll = Collector(env, frames_per_batch=8, total_frames=24)
         batches = list(coll.iterate({}, KEY, jit=False))
         assert len(batches) == 3
 
+    @pytest.mark.slow
     def test_policy_driven(self):
         env, actor, _ = make_cartpole_actor_critic(4)
         cstate_env = env.reset(KEY)[1]
@@ -100,6 +102,7 @@ class TestEndToEndPPO:
         late = np.mean(rewards[-5:])
         assert late > early + 20, f"PPO failed to learn: early={early:.1f} late={late:.1f} all={rewards}"
 
+    @pytest.mark.slow
     def test_train_step_shapes_and_finiteness(self):
         env, actor, critic = make_cartpole_actor_critic(num_envs=4)
         loss = ClipPPOLoss(actor, critic)

@@ -70,6 +70,7 @@ loss_cfg:
         for name in list(REGISTRY) + list(_BUILTINS):
             assert callable(get_component(name))
 
+    @pytest.mark.slow
     def test_config_import_is_cheap(self):
         # importing rl_tpu.config alone must not pull in the whole framework
         import subprocess, sys

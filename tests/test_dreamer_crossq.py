@@ -54,6 +54,7 @@ class TestDreamerActorValue:
     def _value_fn(self, p, feat):
         return self.value.apply({"params": p}, feat)[..., 0]
 
+    @pytest.mark.slow
     def test_imagination_shapes(self):
         traj = imagine_rollout(
             self.rssm, self.rssm_params,
@@ -65,6 +66,7 @@ class TestDreamerActorValue:
         assert traj["h"].shape == (7, 6, self.cfg.deter_dim)
         assert traj["reward"].shape == (7, 6)
 
+    @pytest.mark.slow
     def test_actor_loss_grads_only_actor(self):
         loss = DreamerActorLoss(
             self.rssm, lambda p, td, k: self.actor(p, td, k), self._value_fn, horizon=5
@@ -78,6 +80,7 @@ class TestDreamerActorValue:
         gv = max(float(jnp.abs(g).max()) for g in jax.tree.leaves(grads["value"]))
         assert ga > 0 and gr == 0 and gv == 0
 
+    @pytest.mark.slow
     def test_value_loss_grads_only_value(self):
         loss = DreamerValueLoss(
             self.rssm, lambda p, td, k: self.actor(p, td, k), self._value_fn, horizon=5
@@ -112,12 +115,14 @@ class TestCrossQ:
             ),
         )
 
+    @pytest.mark.slow
     def test_no_target_networks(self):
         loss = self.make()
         params = loss.init_params(KEY, self.batch()[0:1])
         assert "target_qvalue" not in params
         assert loss.target_keys == ()
 
+    @pytest.mark.slow
     def test_loss_updates_stats_and_trains(self):
         loss = self.make()
         batch = self.batch()
@@ -146,11 +151,13 @@ class TestCrossQ:
         stats1 = jax.tree.leaves(params["batch_stats"])[0]
         assert float(jnp.abs(stats1 - stats0).max()) > 0, "running stats never updated"
 
+    @pytest.mark.slow
     def test_batch_stats_not_trainable(self):
         loss = self.make()
         params = loss.init_params(KEY, self.batch()[0:1])
         assert "batch_stats" not in loss.trainable(params)
 
+    @pytest.mark.slow
     def test_crossq_nstep_discount(self):
         loss = self.make()
         batch = self.batch().set("steps_to_next_obs", jnp.full((32,), 3, jnp.int32))

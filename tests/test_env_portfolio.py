@@ -37,11 +37,13 @@ ENVS = [
 
 
 @pytest.mark.parametrize("make", ENVS, ids=lambda m: getattr(m, "__name__", "1p-ttt"))
+@pytest.mark.slow
 def test_check_env_specs(make):
     check_env_specs(make(), KEY)
 
 
 @pytest.mark.parametrize("make", [MountainCarEnv, AcrobotEnv, NavigationEnv])
+@pytest.mark.slow
 def test_vmapped_rollout(make):
     env = VmapEnv(make(), 4)
     batch = jax.jit(lambda k: rollout(env, k, max_steps=8))(KEY)

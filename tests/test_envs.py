@@ -36,9 +36,11 @@ ALL_ENVS = [
 
 @pytest.mark.parametrize("env_cls", ALL_ENVS, ids=lambda c: c.__name__)
 class TestConformance:
+    @pytest.mark.slow
     def test_check_env_specs(self, env_cls):
         check_env_specs(env_cls(), KEY)
 
+    @pytest.mark.slow
     def test_check_env_specs_vmapped(self, env_cls):
         check_env_specs(VmapEnv(env_cls(), 3), KEY)
 

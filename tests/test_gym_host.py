@@ -62,6 +62,7 @@ class TestHostCollector:
         assert bool(np.asarray(batch["next", "done"]).any())
         pool.close()
 
+    @pytest.mark.slow
     def test_policy_driven_and_loss_compatible(self):
         pool = ThreadedEnvPool([lambda: GymEnv("CartPole-v1") for _ in range(2)])
         actor = ProbabilisticActor(

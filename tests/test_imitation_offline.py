@@ -165,6 +165,7 @@ class TestDT:
 
 
 class TestOfflineDatasets:
+    @pytest.mark.slow
     def test_dataset_from_arrays_roundtrip(self):
         n = 10
         obs = np.arange(n, dtype=np.float32)[:, None] * np.ones((1, 3), np.float32)

@@ -37,11 +37,13 @@ def model_and_params():
 
 
 class TestTransformer:
+    @pytest.mark.slow
     def test_forward_shapes(self, model_and_params):
         model, params = model_and_params
         logits = model.apply({"params": params}, jnp.zeros((3, 10), jnp.int32))
         assert logits.shape == (3, 10, 128)
 
+    @pytest.mark.slow
     def test_causality(self, model_and_params):
         model, params = model_and_params
         t1 = jax.random.randint(KEY, (1, 12), 0, 128)
@@ -52,6 +54,7 @@ class TestTransformer:
             np.asarray(l1[:, :6]), np.asarray(l2[:, :6]), atol=1e-5
         )
 
+    @pytest.mark.slow
     def test_cache_matches_full_forward(self, model_and_params):
         model, params = model_and_params
         toks = jax.random.randint(KEY, (2, 9), 0, 128)
@@ -85,6 +88,7 @@ class TestTransformer:
         proj = [spec for path, spec in flat if "proj" in str(path)]
         assert all(s == P("model", None) for s in proj)
 
+    @pytest.mark.mesh
     def test_tp_forward_on_mesh(self, model_and_params):
         from rl_tpu.parallel import make_mesh
         from jax.sharding import NamedSharding
@@ -103,6 +107,7 @@ class TestTransformer:
 
 
 class TestGenerate:
+    @pytest.mark.slow
     def test_greedy_matches_teacher_forcing(self, model_and_params):
         model, params = model_and_params
         prompts = jax.random.randint(KEY, (2, 6), 1, 128)
@@ -115,6 +120,7 @@ class TestGenerate:
             np.asarray(lps[:, 6:]), np.asarray(out.response_log_probs), atol=2e-4
         )
 
+    @pytest.mark.slow
     def test_left_padding_consistency(self, model_and_params):
         model, params = model_and_params
         # same prompt with and without left-padding must greedy-decode alike
@@ -128,6 +134,7 @@ class TestGenerate:
             np.asarray(o1.response_tokens), np.asarray(o2.response_tokens)
         )
 
+    @pytest.mark.slow
     def test_eos_stops_row(self, model_and_params):
         model, params = model_and_params
         prompts = jax.random.randint(KEY, (2, 4), 1, 128)
@@ -167,6 +174,7 @@ class TestGRPO:
             advantage=jnp.asarray([1.0, -1.0, 0.5, -0.5]),
         )
 
+    @pytest.mark.slow
     def test_grpo_loss_and_grads(self, model_and_params):
         model, params = model_and_params
         lp_fn = lambda p, b: token_log_probs(model, p, b["tokens"], b["attention_mask"])  # noqa: E731
@@ -181,6 +189,7 @@ class TestGRPO:
         assert gmax > 0
         assert "kl_to_ref" in metrics
 
+    @pytest.mark.slow
     def test_on_policy_ratio_is_one(self, model_and_params):
         model, params = model_and_params
         lp_fn = lambda p, b: token_log_probs(model, p, b["tokens"], b["attention_mask"])  # noqa: E731
@@ -350,6 +359,7 @@ class TestChatEnvAndCollector:
         assert done.all()
         assert state["histories"][0].last.role == "assistant"
 
+    @pytest.mark.slow
     def test_llm_collector_grpo_batch(self, model_and_params):
         from rl_tpu.collectors.llm import LLMCollector
         from rl_tpu.data.llm import History
@@ -377,6 +387,7 @@ class TestChatEnvAndCollector:
         for g in range(2):
             assert abs(adv[gid == g].sum()) < 1e-3
 
+    @pytest.mark.slow
     def test_collector_feeds_grpo_loss(self, model_and_params):
         from rl_tpu.collectors.llm import LLMCollector
         from rl_tpu.data.llm import History
@@ -397,6 +408,7 @@ class TestChatEnvAndCollector:
 
 
 class TestLLMReviewFixes:
+    @pytest.mark.mesh
     def test_ring_attention_respects_padding(self):
         from rl_tpu.parallel import attention_reference, make_mesh, ring_attention
 
@@ -411,6 +423,7 @@ class TestLLMReviewFixes:
         ref = jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(s, -1), v)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-5)
 
+    @pytest.mark.mesh
     def test_ring_transformer_matches_local_with_padding(self):
         from rl_tpu.parallel import make_mesh
 

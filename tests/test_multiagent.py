@@ -29,12 +29,14 @@ N_AGENTS = 3
 
 
 class TestMockEnv:
+    @pytest.mark.slow
     def test_conformance(self):
         check_env_specs(MultiAgentCountingEnv(N_AGENTS), KEY)
         check_env_specs(VmapEnv(MultiAgentCountingEnv(N_AGENTS), 2), KEY)
 
 
 class TestMultiAgentMLP:
+    @pytest.mark.slow
     def test_shared_params_output(self):
         net = MultiAgentMLP(N_AGENTS, out_features=4, share_params=True)
         x = jax.random.normal(KEY, (5, N_AGENTS, 2))
@@ -46,6 +48,7 @@ class TestMultiAgentMLP:
         out2 = net(params, same)
         np.testing.assert_allclose(np.asarray(out2[:, 0]), np.asarray(out2[:, 1]), rtol=1e-6)
 
+    @pytest.mark.slow
     def test_independent_params(self):
         net = MultiAgentMLP(N_AGENTS, out_features=4, share_params=False)
         x = jax.random.normal(KEY, (5, N_AGENTS, 2))
@@ -56,6 +59,7 @@ class TestMultiAgentMLP:
         out2 = net(params, same)
         assert float(jnp.abs(out2[:, 0] - out2[:, 1]).max()) > 1e-4
 
+    @pytest.mark.slow
     def test_centralized_sees_all(self):
         net = MultiAgentMLP(N_AGENTS, out_features=2, centralized=True)
         x = jax.random.normal(KEY, (4, N_AGENTS, 2))
@@ -73,6 +77,7 @@ class TestMixers:
         q = jnp.asarray([[1.0, 2.0, 3.0]])
         np.testing.assert_allclose(np.asarray(mixer({}, q)), [6.0])
 
+    @pytest.mark.slow
     def test_qmix_monotone(self):
         mixer = QMixer(N_AGENTS)
         state = jax.random.normal(KEY, (8, 3))
@@ -86,6 +91,7 @@ class TestMixers:
 
 
 class TestQMixLoss:
+    @pytest.mark.slow
     def test_loss_and_targets(self):
         env = MultiAgentCountingEnv(N_AGENTS)
         manet = MultiAgentMLP(N_AGENTS, out_features=2)

@@ -73,6 +73,7 @@ def make_sac_loss(obs_dim=4, act_dim=2):
 
 
 class TestSAC:
+    @pytest.mark.slow
     def test_loss_finite_and_routes_gradients(self):
         loss = make_sac_loss()
         params = loss.init_params(KEY, example_td())
@@ -86,6 +87,7 @@ class TestSAC:
             assert gmax > 0, f"no gradient into {name}"
         assert "target_qvalue" not in grads
 
+    @pytest.mark.slow
     def test_target_params_isolated(self):
         loss = make_sac_loss()
         params = loss.init_params(KEY, example_td())
@@ -116,6 +118,7 @@ class TestSAC:
 
 
 class TestDiscreteSAC:
+    @pytest.mark.slow
     def test_loss_and_grads(self):
         actor = ProbabilisticActor(
             TDModule(MLP(out_features=3), ["observation"], ["logits"]),
@@ -157,6 +160,7 @@ class TestDDPGTD3:
         actor = TDModule(TanhPolicy(action_dim=2), ["observation"], ["action"])
         return DDPGLoss(actor, ConcatMLP(out_features=1, num_cells=(32, 32)))
 
+    @pytest.mark.slow
     def test_ddpg_losses(self):
         loss = self.make_ddpg()
         params = loss.init_params(KEY, example_td())
@@ -214,6 +218,7 @@ class TestOffPolicyProgram:
         late = np.nanmean(rewards[-10:])
         assert late > early + 15, f"DQN failed to learn: early={early:.1f} late={late:.1f}"
 
+    @pytest.mark.slow
     def test_sac_mock_runs_with_per(self):
         env = VmapEnv(ContinuousActionMock(obs_dim=4, act_dim=2), 4)
         sac = make_sac_loss()
@@ -246,6 +251,7 @@ class TestOfflineLosses:
         )
         return ProbabilisticActor(net, TanhNormal)
 
+    @pytest.mark.slow
     def test_iql(self):
         from rl_tpu.objectives import IQLLoss
 
@@ -261,6 +267,7 @@ class TestOfflineLosses:
             gmax = max(float(jnp.abs(g).max()) for g in jax.tree.leaves(grads[name]))
             assert gmax > 0, f"no grad into {name}"
 
+    @pytest.mark.slow
     def test_cql_penalty_positive_effect(self):
         from rl_tpu.objectives import CQLLoss
 
@@ -286,6 +293,7 @@ class TestOfflineLosses:
         # penalty is nonnegative in expectation (logsumexp >= max >= chosen)
         assert float(metrics["loss_cql"]) > -1e-5
 
+    @pytest.mark.slow
     def test_redq_ensemble(self):
         from rl_tpu.objectives import REDQLoss
 
@@ -303,6 +311,7 @@ class TestOfflineLosses:
 
 
 class TestDistributionalDQN:
+    @pytest.mark.slow
     def test_c51_loss(self):
         from rl_tpu.objectives import DistributionalDQNLoss
 

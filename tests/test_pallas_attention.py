@@ -21,6 +21,7 @@ def qkv(B=2, T=64, H=4, D=16):
 @pytest.mark.parametrize("causal", [False, True], ids=["full", "causal"])
 @pytest.mark.parametrize("T,block", [(64, 16), (64, 64), (50, 16)], ids=["tiled", "single", "ragged"])
 class TestFlashForward:
+    @pytest.mark.slow
     def test_matches_oracle(self, causal, T, block):
         q, k, v = qkv(T=T)
         out = flash_attention(q, k, v, causal, None, block, block, True)
@@ -29,6 +30,7 @@ class TestFlashForward:
 
 
 class TestFlashGradients:
+    @pytest.mark.slow
     def test_grads_match_dense(self):
         q, k, v = qkv(T=32, H=2, D=8)
 
@@ -51,6 +53,7 @@ class TestFlashGradients:
 
 
 class TestTransformerFlashPath:
+    @pytest.mark.slow
     def test_lm_flash_matches_local(self):
         from rl_tpu.models import TransformerConfig, TransformerLM
 

@@ -6,6 +6,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
+
+pytestmark = pytest.mark.mesh
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from rl_tpu.parallel import (

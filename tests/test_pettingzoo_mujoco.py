@@ -82,6 +82,7 @@ class TestGymMuJoCo:
         yield e
         e.close()
 
+    @pytest.mark.slow
     def test_specs(self, env):
         assert env.observation_spec["observation"].shape == (17,)
         assert env.action_spec.shape == (6,)

@@ -68,6 +68,7 @@ class TestMCTS:
         s = puct_score(jnp.zeros(2), jnp.asarray([0.9, 0.1]), jnp.zeros(2), jnp.asarray(4.0))
         assert s[0] > s[1]
 
+    @pytest.mark.slow
     def test_tree_search_prefers_better_action(self):
         """Simulate values: action 0 -> 1.0, action 1 -> 0.0. After N sims the
         root visit distribution must prefer action 0."""
@@ -95,6 +96,7 @@ class TestMCTS:
 
 
 class TestModelBasedAndRSSM:
+    @pytest.mark.slow
     def test_rssm_observe_shapes(self):
         cfg = RSSMConfig(obs_dim=4, action_dim=2)
         rssm = RSSM(cfg)
@@ -147,6 +149,7 @@ class TestModelBasedAndRSSM:
             losses.append(float(m["loss_recon"]))
         assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
 
+    @pytest.mark.slow
     def test_model_based_env_conformance_and_planning(self):
         cfg = RSSMConfig(obs_dim=4, action_dim=1, deter_dim=16, stoch_dim=4, hidden=16)
         rssm = RSSM(cfg)
@@ -177,6 +180,7 @@ class TestModelBasedAndRSSM:
         a = jax.jit(planner.plan)(state, td, KEY)
         assert a.shape == (1,)
 
+    @pytest.mark.slow
     def test_lambda_returns_match_bruteforce(self):
         H = 6
         r = jax.random.normal(KEY, (H, 3))
@@ -198,6 +202,7 @@ class TestModelBasedAndRSSM:
 
 
 class TestMCTSSaturation:
+    @pytest.mark.slow
     def test_full_tree_does_not_hang_or_self_link(self):
         tree = MCTSTree(capacity=4, num_actions=2, c_puct=1.5)
         t = tree.init(jnp.asarray([0.5, 0.5]))

@@ -106,6 +106,7 @@ class TestBufferBasics:
 
 
 class TestWithoutReplacement:
+    @pytest.mark.slow
     def test_epoch_covers_all(self):
         rb = ReplayBuffer(DeviceStorage(16), SamplerWithoutReplacement(), batch_size=5)
         state = rb.init(item(0.0))
@@ -170,6 +171,7 @@ class TestPER:
 
 
 class TestSliceSampler:
+    @pytest.mark.slow
     def test_slices_within_trajectories(self):
         rb = ReplayBuffer(
             DeviceStorage(64), SliceSampler(slice_len=4), batch_size=16
@@ -233,6 +235,7 @@ class TestMemmapAndList:
 
 
 class TestMultiStep:
+    @pytest.mark.slow
     def test_three_step_fold(self):
         T = 6
         batch = ArrayDict(
@@ -272,6 +275,7 @@ class TestMultiStep:
 
 
 class TestHER:
+    @pytest.mark.slow
     def test_future_relabel_within_episode(self):
         from rl_tpu.data import her_relabel
 
@@ -299,6 +303,7 @@ class TestHER:
         eq = dg == np.arange(T)
         np.testing.assert_array_equal(r[eq], 1.0)
 
+    @pytest.mark.slow
     def test_relabeler_in_collector_postproc(self):
         from rl_tpu.collectors import Collector
         from rl_tpu.data import HERRelabeler
@@ -338,6 +343,7 @@ class TestHER:
         batch, _ = jax.jit(coll.collect)({}, coll.init(KEY))
         assert batch["desired_goal"].shape == (8, 2, 1)
 
+    @pytest.mark.slow
     def test_future_sampling_uniform_within_episode(self):
         from rl_tpu.data import her_relabel
 
@@ -374,6 +380,7 @@ class TestSliceVariants:
         )
         return rb.extend(state, data)
 
+    @pytest.mark.slow
     def test_without_replacement_covers_starts(self):
         from rl_tpu.data import SliceSamplerWithoutReplacement
 
@@ -406,6 +413,7 @@ class TestSliceVariants:
             same = len(set(tids[r].tolist())) == 1
             assert ok[r] == same
 
+    @pytest.mark.slow
     def test_prioritized_slices_prefer_high_priority(self):
         from rl_tpu.data import PrioritizedSliceSampler
 

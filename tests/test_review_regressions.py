@@ -19,6 +19,7 @@ KEY = jax.random.key(0)
 
 
 class TestExplorationCarry:
+    @pytest.mark.slow
     def test_egreedy_anneals_through_collector(self):
         env = VmapEnv(CountingEnv(max_count=100), 2)
         eg = EGreedyModule(CategoricalSpec(n=2), eps_init=1.0, eps_end=0.0, annealing_num_steps=8)
@@ -36,6 +37,7 @@ class TestExplorationCarry:
         assert acts[:4].sum() > 0
         assert acts[-4:].sum() == 0
 
+    @pytest.mark.slow
     def test_ou_noise_correlated_through_rollout(self):
         env = VmapEnv(
             _ContinuousNoTermEnv(), 2
@@ -79,6 +81,7 @@ class _ContinuousNoTermEnv(EnvBase):
 
 
 class TestCatTensorsBatched:
+    @pytest.mark.slow
     def test_batched_scalar_keys(self):
         class ScalarObsEnv(CountingEnv):
             @property
@@ -156,6 +159,7 @@ class TestMaskedESS:
 
 
 class TestOffPolicyReviewFixes:
+    @pytest.mark.slow
     def test_unbatched_env_buffer_layout(self):
         from rl_tpu.data import DeviceStorage, ReplayBuffer
         from rl_tpu.modules import MLP, TDModule
@@ -172,6 +176,7 @@ class TestOffPolicyReviewFixes:
         ts, m = jax.jit(program.train_step)(ts)
         assert np.isfinite(float(m["loss"]))
 
+    @pytest.mark.slow
     def test_env_major_flatten_keeps_trajectories_contiguous(self):
         from rl_tpu.data import DeviceStorage, ReplayBuffer, SliceSampler
         from rl_tpu.modules import MLP, TDModule
@@ -194,6 +199,7 @@ class TestOffPolicyReviewFixes:
         mb, _ = program.buffer.sample(bstate, KEY, 16)
         assert bool(np.asarray(mb["valid_slices"]).all()), "no valid slices found"
 
+    @pytest.mark.slow
     def test_policy_delay_masks_actor_updates(self):
         from rl_tpu.data import DeviceStorage, ReplayBuffer
         from rl_tpu.modules import ConcatMLP, TanhPolicy, TDModule

@@ -36,6 +36,7 @@ KEY = jax.random.key(0)
 
 @pytest.mark.parametrize("mod_cls", [LSTMModule, GRUModule], ids=["lstm", "gru"])
 class TestRNN:
+    @pytest.mark.slow
     def test_sequence_shapes(self, mod_cls):
         rnn = mod_cls(input_size=3, hidden_size=8)
         td = ArrayDict(
@@ -46,6 +47,7 @@ class TestRNN:
         out = rnn(params, td)
         assert out["embed"].shape == (2, 5, 8)
 
+    @pytest.mark.slow
     def test_step_equals_sequence(self, mod_cls):
         """Step-mode unroll must equal sequence-mode scan (the reference's
         python-cell vs fused-kernel equivalence test)."""
@@ -66,6 +68,7 @@ class TestRNN:
         step_out = jnp.stack(outs, axis=1)
         np.testing.assert_allclose(np.asarray(seq_out), np.asarray(step_out), atol=1e-5)
 
+    @pytest.mark.slow
     def test_reset_isolates_episodes(self, mod_cls):
         """With a reset at t, the output from t onward must match a fresh
         sequence started at t."""
@@ -83,6 +86,7 @@ class TestRNN:
         )["embed"]
         np.testing.assert_allclose(np.asarray(full[:, 4:]), np.asarray(fresh), atol=1e-5)
 
+    @pytest.mark.slow
     def test_collector_rollout_with_rnn_policy(self, mod_cls):
         """RNN policy through the scan collector: carry via the recurrent
         keys must thread through exploration-style carry."""
@@ -150,6 +154,7 @@ class _PixelEnv(EnvBase):
 
 
 class TestImageTransforms:
+    @pytest.mark.slow
     def test_pipeline_spec_conformance(self):
         env = TransformedEnv(
             _PixelEnv(),

@@ -39,10 +39,12 @@ LEAF_SPECS = [
 
 @pytest.mark.parametrize("spec", LEAF_SPECS, ids=lambda s: type(s).__name__ + str(s.shape))
 class TestLeafProtocol:
+    @pytest.mark.slow
     def test_rand_is_in(self, spec):
         x = spec.rand(KEY)
         assert spec.is_in(x), f"{spec} rejected own rand sample {x}"
 
+    @pytest.mark.slow
     def test_rand_batched(self, spec):
         x = spec.rand(KEY, (10,))
         assert x.shape == (10, *spec.shape)
@@ -52,6 +54,7 @@ class TestLeafProtocol:
         z = spec.zero((2,))
         assert z.shape == (2, *spec.shape)
 
+    @pytest.mark.slow
     def test_project_idempotent(self, spec):
         x = spec.rand(KEY, (4,))
         np.testing.assert_array_equal(spec.project(x), x)

@@ -44,6 +44,7 @@ def make_program(num_envs=4, frames=64):
 
 
 class TestTrainer:
+    @pytest.mark.slow
     def test_loop_with_hooks(self, tmp_path):
         env, actor, program = make_program()
         logger = CSVLogger("t1", log_dir=str(tmp_path))
@@ -58,6 +59,7 @@ class TestTrainer:
         assert any(f.startswith("train_loss") for f in files)
         assert any(f.startswith("train_fps") for f in files)
 
+    @pytest.mark.slow
     def test_early_stopping(self):
         env, actor, program = make_program()
         trainer = Trainer(program, total_steps=50)
@@ -66,6 +68,7 @@ class TestTrainer:
         trainer.train(0)
         assert trainer.step_count == 1
 
+    @pytest.mark.slow
     def test_evaluator_hook(self, tmp_path):
         env, actor, program = make_program()
         logger = CSVLogger("t2", log_dir=str(tmp_path))
@@ -86,6 +89,7 @@ class TestTrainer:
 
 
 class TestCheckpoint:
+    @pytest.mark.slow
     def test_roundtrip_train_state(self, tmp_path):
         _, _, program = make_program()
         ts = program.init(KEY)
@@ -108,6 +112,7 @@ class TestCheckpoint:
             float(m2["loss"]), float(m3["loss"]), rtol=1e-5
         )
 
+    @pytest.mark.slow
     def test_trainer_checkpoint_cadence(self, tmp_path):
         _, _, program = make_program()
         ckpt = Checkpoint(str(tmp_path / "ck2"))
@@ -137,6 +142,7 @@ class TestCheckpoint:
         ckpt.load(step=1)
         assert len(migrated) == 1
 
+    @pytest.mark.slow
     def test_trainer_restore_resumes_counters(self, tmp_path):
         _, _, program = make_program()
         ckpt = Checkpoint(str(tmp_path / "ck4"))
@@ -175,6 +181,7 @@ class TestLoggers:
         with open(os.path.join(str(tmp_path), "exp", "a_b.csv")) as f:
             assert f.read().strip() == "10,1.5"
 
+    @pytest.mark.slow
     def test_tensorboard_logger(self, tmp_path):
         lg = get_logger("tensorboard", "exp", log_dir=str(tmp_path))
         lg.log_scalar("x", 2.0, step=1)

@@ -56,9 +56,11 @@ STACKS = [
 
 @pytest.mark.parametrize("make", STACKS, ids=lambda m: repr(m().transform)[:48])
 class TestConformance:
+    @pytest.mark.slow
     def test_check_env_specs(self, make):
         check_env_specs(make(), KEY)
 
+    @pytest.mark.slow
     def test_vmapped(self, make):
         check_env_specs(VmapEnv(make(), 3), KEY)
 
@@ -154,6 +156,7 @@ class TestBehavior:
 
 
 class TestWrappersAndPooling:
+    @pytest.mark.slow
     def test_frame_skip_sums_rewards(self):
         from rl_tpu.envs import FrameSkipEnv
 
@@ -177,6 +180,7 @@ class TestWrappersAndPooling:
         assert float(out["next", "reward"]) == 2.0
         assert bool(out["next", "done"])
 
+    @pytest.mark.slow
     def test_noop_reset_advances_state(self):
         from rl_tpu.envs import NoopResetEnv
 
@@ -203,6 +207,7 @@ class TestWrappersAndPooling:
         # max over {current, previous} -> 10 persists across odd steps
         assert (obs >= 9.0).sum() >= 4
 
+    @pytest.mark.slow
     def test_noop_reset_never_returns_done(self):
         from rl_tpu.envs import NoopResetEnv
 

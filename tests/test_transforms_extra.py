@@ -72,6 +72,7 @@ STACKS = [
 
 
 @pytest.mark.parametrize("make", STACKS, ids=lambda m: repr(m().transform)[:48])
+@pytest.mark.slow
 def test_check_env_specs(make):
     check_env_specs(make(), KEY)
 
@@ -109,6 +110,7 @@ def test_stack_transform_shape():
     assert np.allclose(np.asarray(td["stacked"][..., 0]), np.asarray(td["obs_vec"]))
 
 
+@pytest.mark.slow
 def test_reward_shaping_values():
     env = TransformedEnv(CountingEnv(), BinarizeReward())
     batch = rollout(env, KEY, max_steps=4)
@@ -143,6 +145,7 @@ def test_primer_defaults_and_carry():
     assert batch["next", "hidden"].shape == (3, 3)
 
 
+@pytest.mark.slow
 def test_traj_counter_unique_ids():
     env = VmapEnv(CountingEnv(max_count=3), 4)
     env = TransformedEnv(env, TrajCounter())
@@ -204,6 +207,7 @@ def test_action_mask_rand_action_legal():
         assert acts[t] <= t
 
 
+@pytest.mark.slow
 def test_action_discretizer_roundtrip():
     base = ContinuousActionMock()
     env = TransformedEnv(base, ActionDiscretizer(num_intervals=5))
@@ -236,6 +240,7 @@ def test_module_transform_applies():
     assert np.allclose(obs[:, 0], 3.0 * np.arange(1, 4))
 
 
+@pytest.mark.slow
 def test_finite_check_flags_nan():
     env = TransformedEnv(
         CountingEnv(),
@@ -284,6 +289,7 @@ def test_conditional_skip_freezes_state():
     assert rew[0] == 1.0 and np.all(rew[1:] == 0.0)
 
 
+@pytest.mark.slow
 def test_reward2go_matches_bruteforce():
     T = 8
     key = jax.random.key(3)
@@ -301,6 +307,7 @@ def test_reward2go_matches_bruteforce():
     assert np.allclose(rtg, expect, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_burn_in_transform():
     from rl_tpu.modules.rnn import GRUModule
 
@@ -322,6 +329,7 @@ def test_burn_in_transform():
     assert not np.allclose(np.asarray(with_carry), np.asarray(zero_carry))
 
 
+@pytest.mark.slow
 def test_traj_counter_root_ids_after_autoreset():
     # regression: the root (carried) traj_count after an auto-reset must be
     # the freshly ASSIGNED global id, not a fresh-init arange id
@@ -339,6 +347,7 @@ def test_traj_counter_root_ids_after_autoreset():
                 assert root_ids[t + 1, b] == next_ids[t, b]
 
 
+@pytest.mark.slow
 def test_multi_action_batch_major_layout():
     # regression: spec-shaped (batch-major) actions must drive the macro scan
     env = MultiActionEnv(VmapEnv(CountingEnv(max_count=100), 2), num_actions=3)
@@ -350,6 +359,7 @@ def test_multi_action_batch_major_layout():
     assert np.allclose(obs[:, :, 0], [[3.0, 3.0], [6.0, 6.0]])
 
 
+@pytest.mark.slow
 def test_permute_default_keys_skips_flags():
     # regression: default in_keys must not permute reward/done leaves
     class ImgEnv(CountingEnv):

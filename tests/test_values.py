@@ -61,6 +61,7 @@ def brute_td_lambda(gamma, lmbda, next_value, reward, done, terminated):
 
 
 class TestLinearRecurrence:
+    @pytest.mark.slow
     def test_matches_loop(self):
         a = jnp.asarray(np.random.default_rng(0).uniform(0, 1, (10, 2)), jnp.float32)
         b = jnp.asarray(np.random.default_rng(1).normal(size=(10, 2)), jnp.float32)
@@ -72,6 +73,7 @@ class TestLinearRecurrence:
             expected[t] = run
         np.testing.assert_allclose(y, expected, rtol=1e-5)
 
+    @pytest.mark.slow
     def test_gradients_flow(self):
         def f(b):
             return linear_recurrence_reverse(0.9 * jnp.ones_like(b), b).sum()
@@ -85,6 +87,7 @@ class TestLinearRecurrence:
 
 @pytest.mark.parametrize("gamma,lmbda", [(0.99, 0.95), (0.9, 1.0), (1.0, 0.5)])
 class TestGAE:
+    @pytest.mark.slow
     def test_matches_bruteforce(self, gamma, lmbda):
         reward, value, next_value, done, terminated = make_data()
         adv, target = generalized_advantage_estimate(
@@ -94,6 +97,7 @@ class TestGAE:
         np.testing.assert_allclose(np.asarray(adv), badv, rtol=1e-4, atol=1e-5)
         np.testing.assert_allclose(np.asarray(target), btarget, rtol=1e-4, atol=1e-5)
 
+    @pytest.mark.slow
     def test_jit_and_vmap_agree(self, gamma, lmbda):
         reward, value, next_value, done, terminated = make_data()
         f = jax.jit(
@@ -113,6 +117,7 @@ class TestTD:
         expected = reward + 0.99 * next_value * (1 - terminated)
         np.testing.assert_allclose(np.asarray(target), expected, rtol=1e-5)
 
+    @pytest.mark.slow
     def test_td_lambda_matches_bruteforce(self):
         reward, value, next_value, done, terminated = make_data(T=15)
         target = td_lambda_return_estimate(0.95, 0.8, next_value, reward, done, terminated)
@@ -159,6 +164,7 @@ class TestVTrace:
 
 
 class TestReward2Go:
+    @pytest.mark.slow
     def test_resets_at_done(self):
         reward = jnp.ones((6, 1))
         done = jnp.asarray([[0], [0], [1], [0], [0], [1]], bool)
