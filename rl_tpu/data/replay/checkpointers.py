@@ -5,10 +5,10 @@ checkpointers.py — flat/nested/H5 storage checkpointers).
 runtime state (storage arrays + sampler priorities + writer cursors) so
 off-policy training resumes with its replay intact:
 
-- Device-backed state (an ArrayDict pytree) -> one ``.npz`` of flattened
-  leaves.
-- MemmapStorage -> the memmaps already live on disk; only the cursor dict
-  is written (a json manifest next to the scratch dir).
+- Buffer state (always an ArrayDict pytree — ReplayBuffer.init wraps even
+  host-storage cursor dicts) -> one ``.npz`` of flattened leaves.
+- MemmapStorage -> the memmaps themselves already live on disk; a json
+  manifest records the scratch dir so a fresh process can reattach them.
 
 The trainer-level checkpoint registry (rl_tpu/checkpoint) handles model/
 optimizer state; these functions are the storage-level adapters it plugs in.
@@ -33,27 +33,21 @@ _SEP = "\x1f"  # unit separator: safe joiner for nested key paths
 def save_buffer_state(buffer, state, path: str) -> None:
     """Serialize buffer runtime state to ``path`` (.npz + optional .json)."""
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-    host_state = {}
     arrays = {}
 
     def visit(prefix: tuple, node):
         if isinstance(node, ArrayDict):
             for k in node:
                 visit(prefix + (k,), node[k])
-        elif isinstance(node, dict):  # memmap/list storage python state
-            host_state[_SEP.join(prefix)] = node
         else:
             arrays[_SEP.join(prefix)] = np.asarray(node)
 
     visit((), state)
     np.savez(path + ".npz", **arrays)
-    if host_state or isinstance(buffer.storage, MemmapStorage):
-        manifest = {"host_state": host_state}
-        if isinstance(buffer.storage, MemmapStorage):
-            manifest["scratch_dir"] = buffer.storage.scratch_dir
-            buffer.storage.flush()
+    if isinstance(buffer.storage, MemmapStorage):
+        buffer.storage.flush()
         with open(path + ".json", "w") as f:
-            json.dump(manifest, f)
+            json.dump({"scratch_dir": buffer.storage.scratch_dir}, f)
 
 
 def load_buffer_state(buffer, path: str) -> ArrayDict:
@@ -74,11 +68,9 @@ def load_buffer_state(buffer, path: str) -> ArrayDict:
     if os.path.exists(path + ".json"):
         with open(path + ".json") as f:
             manifest = json.load(f)
-        for k, node in manifest["host_state"].items():
-            state = state.set(tuple(k.split(_SEP)), node)
         if "scratch_dir" in manifest and isinstance(buffer.storage, MemmapStorage):
             # point the storage at the checkpointed memmaps; the caller's
-            # next buffer.init(example) reattaches them without truncation
-            # (MemmapStorage.init opens existing right-sized files "r+")
+            # next storage.init(example) reattaches them without truncation
+            # (MemmapStorage.init validates the sidecar schema and opens "r+")
             buffer.storage.scratch_dir = manifest["scratch_dir"]
     return state

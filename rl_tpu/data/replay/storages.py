@@ -34,7 +34,14 @@ import numpy as np
 
 from ..arraydict import ArrayDict
 
-__all__ = ["Storage", "DeviceStorage", "MemmapStorage", "ListStorage"]
+__all__ = [
+    "CompressedListStorage",
+    "DeviceStorage",
+    "ListStorage",
+    "MemmapStorage",
+    "Storage",
+    "StorageEnsemble",
+]
 
 
 class Storage:

@@ -205,12 +205,13 @@ class PettingZooWrapper:
         }
         obs, rewards, terms, truncs, _ = self.env.step(acts)
         reward = float(sum(rewards.values()))
-        term = bool(all(terms.values())) if terms else True
+        # slot 3 of the host protocol is TERMINATED (cuts value bootstrap);
+        # a pure time-limit cut must stay truncation-only
+        term = bool(all(terms.values())) if terms else False
         trunc = bool(all(truncs.values())) if truncs else False
-        done = (term or trunc) and not self.env.agents
         if not obs:
-            return self._terminal_obs(), reward, True, trunc
-        return self._stack_parallel(obs), reward, done, trunc
+            return self._terminal_obs(), reward, term, trunc or not term
+        return self._stack_parallel(obs), reward, term, trunc
 
     def close(self) -> None:
         self.env.close()

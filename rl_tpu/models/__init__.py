@@ -1,9 +1,28 @@
 from .decision_transformer import DecisionTransformer, DTConfig, DTLoss
 from .generate import GenerateOutput, generate, token_log_probs
+from .act import ACTConfig, ACTModel
 from .rssm import RSSM, DreamerModelLoss, RSSMConfig, dreamer_lambda_returns
+from .rssm_v3 import (
+    RSSMv3,
+    RSSMv3Config,
+    symexp,
+    symlog,
+    symlog_bins,
+    twohot_decode,
+    twohot_encode,
+)
 from .transformer import TransformerConfig, TransformerLM, param_sharding_rules
 
 __all__ = [
+    "ACTConfig",
+    "ACTModel",
+    "RSSMv3",
+    "RSSMv3Config",
+    "symlog",
+    "symexp",
+    "symlog_bins",
+    "twohot_encode",
+    "twohot_decode",
     "DecisionTransformer",
     "DTConfig",
     "DTLoss",

@@ -1,0 +1,115 @@
+"""ACT: Action Chunking with Transformers, compact CVAE form.
+
+Redesign of the reference's ACT imitation stack (reference:
+torchrl/modules/models/act.py + torchrl/objectives/act.py:19 — a CVAE whose
+encoder embeds (obs, expert action chunk) into a style latent z and whose
+decoder predicts the K-step action chunk from (obs, z); trained with L1
+reconstruction + β·KL; at inference z = 0). The reference uses a DETR-style
+transformer; here the sequence model is a small pre-LN self-attention stack
+over the K chunk slots — same CVAE structure, MXU-shaped matmuls.
+
+Consumed by :class:`rl_tpu.objectives.imitation.ACTLoss` and executed
+step-by-step with :class:`rl_tpu.modules.MultiStepActorWrapper`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+__all__ = ["ACTModel", "ACTConfig"]
+
+
+@dataclasses.dataclass
+class ACTConfig:
+    obs_dim: int = 8
+    action_dim: int = 2
+    chunk: int = 8  # actions predicted per forward
+    latent_dim: int = 16
+    d_model: int = 64
+    n_heads: int = 4
+    n_layers: int = 2
+
+
+class _Block(nn.Module):
+    d_model: int
+    n_heads: int
+
+    @nn.compact
+    def __call__(self, x):
+        y = nn.LayerNorm()(x)
+        y = nn.SelfAttention(num_heads=self.n_heads)(y)
+        x = x + y
+        y = nn.LayerNorm()(x)
+        y = nn.Dense(4 * self.d_model)(y)
+        y = nn.gelu(y)
+        y = nn.Dense(self.d_model)(y)
+        return x + y
+
+
+class _ACTCore(nn.Module):
+    cfg: ACTConfig
+
+    def setup(self):
+        c = self.cfg
+        self.obs_proj = nn.Dense(c.d_model, name="obs_proj")
+        self.act_proj = nn.Dense(c.d_model, name="act_proj")
+        self.enc_blocks = [_Block(c.d_model, c.n_heads) for _ in range(c.n_layers)]
+        self.enc_out = nn.Dense(2 * c.latent_dim, name="enc_out")
+        self.z_proj = nn.Dense(c.d_model, name="z_proj")
+        self.slot_embed = nn.Embed(c.chunk, c.d_model, name="slots")
+        self.dec_blocks = [_Block(c.d_model, c.n_heads) for _ in range(c.n_layers)]
+        self.dec_out = nn.Dense(c.action_dim, name="dec_out")
+
+    def encode(self, obs, chunk):
+        """(obs [B,D], chunk [B,K,A]) -> latent mean/std."""
+        tokens = jnp.concatenate(
+            [self.obs_proj(obs)[:, None], self.act_proj(chunk)], axis=1
+        )
+        for blk in self.enc_blocks:
+            tokens = blk(tokens)
+        stats = self.enc_out(tokens[:, 0])
+        mean, raw = jnp.split(stats, 2, axis=-1)
+        return mean, jax.nn.softplus(raw) + 1e-4
+
+    def decode(self, obs, z):
+        """(obs [B,D], z [B,L]) -> action chunk [B,K,A]."""
+        c = self.cfg
+        cond = self.obs_proj(obs) + self.z_proj(z)
+        slots = self.slot_embed(jnp.arange(c.chunk))[None] + cond[:, None]
+        for blk in self.dec_blocks:
+            slots = blk(slots)
+        return self.dec_out(slots)
+
+    def __call__(self, obs, chunk, key):
+        mean, std = self.encode(obs, chunk)
+        z = mean + std * jax.random.normal(key, mean.shape)
+        return self.decode(obs, z), mean, std
+
+
+class ACTModel:
+    """Functional wrapper: init/encode/decode over the flax core."""
+
+    def __init__(self, cfg: ACTConfig):
+        self.cfg = cfg
+        self.core = _ACTCore(cfg)
+
+    def init(self, key: jax.Array) -> Any:
+        c = self.cfg
+        obs = jnp.zeros((1, c.obs_dim))
+        chunk = jnp.zeros((1, c.chunk, c.action_dim))
+        return self.core.init(key, obs, chunk, key)["params"]
+
+    def forward(self, params, obs, chunk, key):
+        return self.core.apply({"params": params}, obs, chunk, key)
+
+    def act(self, params, obs):
+        """Inference: decode with the prior mode z = 0 (reference ACT)."""
+        z = jnp.zeros(obs.shape[:-1] + (self.cfg.latent_dim,))
+        return self.core.apply(
+            {"params": params}, obs, z, method=_ACTCore.decode
+        )

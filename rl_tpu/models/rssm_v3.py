@@ -1,0 +1,258 @@
+"""DreamerV3 world model: categorical-latent RSSM + symlog/two-hot heads.
+
+Redesign of the reference's DreamerV3 model family (reference:
+torchrl/modules/models/model_based_v3.py + torchrl/objectives/
+dreamer_v3.py:263/496/778). The V3 recipe over V1 (models/rssm.py):
+
+- **discrete latents**: the stochastic state is ``groups × classes``
+  one-hot categoricals with straight-through gradients and a 1% uniform
+  mixture (prevents collapsed logits);
+- **symlog predictions**: observations/rewards/values regress
+  ``symlog(x) = sign(x)·log(1+|x|)`` targets;
+- **two-hot regression**: scalar heads (reward, value) are ``n_bins``-way
+  classifiers over fixed symlog-spaced bins trained with cross-entropy on
+  the two-hot-encoded target — robust to scale across domains;
+- **KL balancing + free bits**: ``0.5·KL(sg(post)‖prior) +
+  0.1·KL(post‖sg(prior))``, each clipped below 1 nat.
+
+Everything is a ``lax.scan``-friendly pure function on TPU.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..data import ArrayDict
+
+__all__ = [
+    "RSSMv3",
+    "RSSMv3Config",
+    "symlog",
+    "symexp",
+    "twohot_encode",
+    "twohot_decode",
+    "symlog_bins",
+]
+
+
+# -- scalar transforms ---------------------------------------------------------
+
+
+def symlog(x):
+    return jnp.sign(x) * jnp.log1p(jnp.abs(x))
+
+
+def symexp(x):
+    return jnp.sign(x) * (jnp.exp(jnp.abs(x)) - 1.0)
+
+
+def symlog_bins(n_bins: int = 41, low: float = -20.0, high: float = 20.0):
+    """Fixed bin centers in symlog space (reference uses 255 over ±20)."""
+    return jnp.linspace(low, high, n_bins)
+
+
+def twohot_encode(y, bins):
+    """Scalar targets -> two-hot distribution over ``bins`` (symlog space).
+
+    y is ALREADY in symlog space. Mass splits linearly between the two
+    neighbouring bins.
+    """
+    y = jnp.clip(y, bins[0], bins[-1])
+    idx_hi = jnp.clip(jnp.searchsorted(bins, y, side="left"), 1, len(bins) - 1)
+    idx_lo = idx_hi - 1
+    lo, hi = bins[idx_lo], bins[idx_hi]
+    w_hi = (y - lo) / jnp.maximum(hi - lo, 1e-8)
+    w_lo = 1.0 - w_hi
+    return jax.nn.one_hot(idx_lo, len(bins)) * w_lo[..., None] + jax.nn.one_hot(
+        idx_hi, len(bins)
+    ) * w_hi[..., None]
+
+
+def twohot_decode(logits, bins):
+    """Expected value of the bin distribution, back through symexp."""
+    probs = jax.nn.softmax(logits, axis=-1)
+    return symexp(jnp.sum(probs * bins, axis=-1))
+
+
+# -- model ---------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class RSSMv3Config:
+    obs_dim: int = 8
+    action_dim: int = 2
+    deter_dim: int = 64
+    groups: int = 4  # stochastic state: groups × classes one-hots
+    classes: int = 8
+    hidden: int = 64
+    n_bins: int = 41
+    unimix: float = 0.01  # uniform mixture on categorical logits
+    free_nats: float = 1.0
+    dyn_scale: float = 0.5
+    rep_scale: float = 0.1
+
+    @property
+    def stoch_dim(self) -> int:
+        return self.groups * self.classes
+
+
+class _RSSMv3Core(nn.Module):
+    cfg: RSSMv3Config
+
+    def setup(self):
+        c = self.cfg
+        self.encoder = nn.Dense(c.hidden, name="enc")
+        self.gru_in = nn.Dense(c.hidden, name="gru_in")
+        self.gru = nn.GRUCell(features=c.deter_dim, name="gru")
+        self.prior_net = nn.Dense(c.stoch_dim, name="prior")
+        self.post_net = nn.Dense(c.stoch_dim, name="post")
+        self.decoder = nn.Sequential(
+            [nn.Dense(c.hidden), nn.silu, nn.Dense(c.obs_dim)], name="dec"
+        )
+        self.reward_head = nn.Sequential(
+            [nn.Dense(c.hidden), nn.silu, nn.Dense(c.n_bins)], name="rew"
+        )
+        self.continue_head = nn.Sequential(
+            [nn.Dense(c.hidden), nn.silu, nn.Dense(1)], name="cont"
+        )
+
+    # -- latent machinery ------------------------------------------------------
+
+    def _logits(self, raw):
+        c = self.cfg
+        logits = raw.reshape(raw.shape[:-1] + (c.groups, c.classes))
+        # unimix: mix 1% uniform into the softmax probabilities
+        probs = jax.nn.softmax(logits, axis=-1)
+        probs = (1 - c.unimix) * probs + c.unimix / c.classes
+        return jnp.log(probs)
+
+    def _sample(self, logits, key):
+        """Straight-through one-hot sample, flattened to stoch_dim."""
+        c = self.cfg
+        idx = jax.random.categorical(key, logits, axis=-1)
+        onehot = jax.nn.one_hot(idx, c.classes)
+        probs = jax.nn.softmax(logits, axis=-1)
+        st = onehot + probs - jax.lax.stop_gradient(probs)
+        return st.reshape(st.shape[:-2] + (c.stoch_dim,))
+
+    def step_prior(self, h, z, a):
+        x = nn.silu(self.gru_in(jnp.concatenate([z, a], axis=-1)))
+        h, _ = self.gru(h, x)
+        return h, self._logits(self.prior_net(h))
+
+    def posterior(self, h, obs):
+        e = nn.silu(self.encoder(symlog(obs)))
+        return self._logits(self.post_net(jnp.concatenate([h, e], axis=-1)))
+
+    def decode(self, h, z):
+        feat = jnp.concatenate([h, z], axis=-1)
+        return (
+            self.decoder(feat),  # symlog-space reconstruction
+            self.reward_head(feat),  # two-hot logits
+            self.continue_head(feat)[..., 0],
+        )
+
+    # -- programs --------------------------------------------------------------
+
+    def observe(self, obs_seq, action_seq, is_first, key):
+        B, T, _ = obs_seq.shape
+        c = self.cfg
+
+        def body(carry, xs):
+            h, z, key = carry
+            obs, act, first = xs
+            mask = (1.0 - first.astype(jnp.float32))[:, None]
+            h, z, act = h * mask, z * mask, act * mask
+            h, prior_logits = self.step_prior(h, z, act)
+            post_logits = self.posterior(h, obs)
+            key, k = jax.random.split(key)
+            z = self._sample(post_logits, k)
+            return (h, z, key), (h, z, prior_logits, post_logits)
+
+        h0 = jnp.zeros((B, c.deter_dim))
+        z0 = jnp.zeros((B, c.stoch_dim))
+        xs = (
+            jnp.moveaxis(obs_seq, 1, 0),
+            jnp.moveaxis(action_seq, 1, 0),
+            jnp.moveaxis(is_first, 1, 0),
+        )
+        _, (h, z, pl, ql) = jax.lax.scan(body, (h0, z0, key), xs)
+        to_bt = lambda x: jnp.moveaxis(x, 0, 1)  # noqa: E731
+        h, z = to_bt(h), to_bt(z)
+        recon, reward_logits, cont = self.decode(h, z)
+        return {
+            "h": h,
+            "z": z,
+            "prior_logits": to_bt(pl),
+            "post_logits": to_bt(ql),
+            "recon": recon,
+            "reward_logits": reward_logits,
+            "continue_logit": cont,
+        }
+
+    def imagine_step(self, h, z, a, key):
+        h, logits = self.step_prior(h, z, a)
+        z = self._sample(logits, key)
+        recon, reward_logits, cont = self.decode(h, z)
+        return h, z, recon, reward_logits, cont
+
+    def __call__(self, obs_seq, action_seq, is_first, key):
+        # init path: touch every submodule once outside lax.scan
+        c = self.cfg
+        B = obs_seq.shape[0]
+        h = jnp.zeros((B, c.deter_dim))
+        z = jnp.zeros((B, c.stoch_dim))
+        h, pl = self.step_prior(h, z, action_seq[:, 0])
+        ql = self.posterior(h, obs_seq[:, 0])
+        return self.decode(h, self._sample(ql, key))
+
+
+class RSSMv3:
+    """Functional wrapper mirroring models/rssm.py's RSSM API."""
+
+    def __init__(self, cfg: RSSMv3Config):
+        self.cfg = cfg
+        self.core = _RSSMv3Core(cfg)
+        self.bins = symlog_bins(cfg.n_bins)
+
+    def init(self, key: jax.Array) -> Any:
+        obs = jnp.zeros((1, 2, self.cfg.obs_dim))
+        act = jnp.zeros((1, 2, self.cfg.action_dim))
+        first = jnp.zeros((1, 2), bool)
+        return self.core.init(key, obs, act, first, key)["params"]
+
+    def observe(self, params, obs_seq, action_seq, is_first, key):
+        return self.core.apply(
+            {"params": params}, obs_seq, action_seq, is_first, key,
+            method=_RSSMv3Core.observe,
+        )
+
+    def imagine_step(self, params, h, z, a, key):
+        return self.core.apply(
+            {"params": params}, h, z, a, key, method=_RSSMv3Core.imagine_step
+        )
+
+    def reward_value(self, reward_logits):
+        return twohot_decode(reward_logits, self.bins)
+
+    def world_model_fn(self):
+        """(params, td{h,z,action}, key) -> td — the ModelBasedEnv adapter."""
+
+        def fn(params, td: ArrayDict, key):
+            h, z, recon, reward_logits, cont = self.imagine_step(
+                params, td["h"], td["z"], td["action"], key
+            )
+            return ArrayDict(
+                h=h,
+                z=z,
+                observation=symexp(recon),
+                reward=self.reward_value(reward_logits),
+                terminated=jax.nn.sigmoid(cont) < 0.5,
+            )
+
+        return fn

@@ -1,0 +1,72 @@
+"""Macro-action actors (reference: torchrl/modules/tensordict_module/
+actors.py — ``MultiStepActorWrapper``:2280).
+
+An inner policy that plans a CHUNK of ``n_steps`` actions (ACT decoders,
+planners, option policies) is executed one env step at a time: the wrapper
+keeps the chunk and a step pointer in the explicit policy-state carry
+(("exploration", ...) — the same carry the Collector scan threads for
+EGreedy/OU), replanning when the chunk is exhausted or the episode resets.
+All branching is ``jnp.where`` masking over fixed shapes, so the wrapper
+lives inside the fused collection scan.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..data import ArrayDict
+
+__all__ = ["MultiStepActorWrapper"]
+
+
+class MultiStepActorWrapper:
+    """Wrap a chunk-planning policy into a per-step policy.
+
+    ``plan_fn(params, td, key) -> [*, n_steps, *action_shape]`` produces the
+    macro; the wrapper emits element ``ptr`` each call. Replans when
+    ``ptr == n_steps`` or ``is_init`` (episode start after auto-reset).
+    """
+
+    def __init__(self, plan_fn, n_steps: int, action_shape, init_key: str = "is_init"):
+        self.plan_fn = plan_fn
+        self.n_steps = n_steps
+        self.action_shape = tuple(action_shape)
+        self.init_key = init_key if isinstance(init_key, tuple) else (init_key,)
+
+    def init_state(self, batch_shape=()) -> ArrayDict:
+        return ArrayDict(
+            msa_chunk=jnp.zeros(batch_shape + (self.n_steps,) + self.action_shape),
+            # start exhausted: first call always plans
+            msa_ptr=jnp.full(batch_shape, self.n_steps, jnp.int32),
+        )
+
+    def __call__(self, params, td: ArrayDict, key: jax.Array) -> ArrayDict:
+        state = (
+            td["exploration"]
+            if "exploration" in td and "msa_ptr" in td["exploration"]
+            else self.init_state(td["done"].shape)
+        )
+        chunk, ptr = state["msa_chunk"], state["msa_ptr"]
+        needs_plan = ptr >= self.n_steps
+        if self.init_key in td:
+            needs_plan = needs_plan | td[self.init_key]
+
+        fresh = self.plan_fn(params, td, key)
+        mask = needs_plan.reshape(
+            needs_plan.shape + (1,) * (fresh.ndim - needs_plan.ndim)
+        )
+        chunk = jnp.where(mask, fresh, chunk)
+        ptr = jnp.where(needs_plan, 0, ptr)
+
+        # gather action at ptr along the chunk axis (after batch dims)
+        bdim = needs_plan.ndim
+        p = ptr.reshape(ptr.shape + (1,) * (chunk.ndim - bdim))
+        action = jnp.take_along_axis(chunk, p.astype(jnp.int32), axis=bdim)
+        action = jnp.squeeze(action, axis=bdim)
+
+        new_state = state.replace(msa_chunk=chunk, msa_ptr=ptr + 1)
+        estate = td["exploration"] if "exploration" in td else ArrayDict()
+        return td.set("action", action).set(
+            "exploration", estate.update(new_state)
+        )

@@ -8,10 +8,16 @@ from .common import (
 )
 from .crossq import BatchNormMLP, CrossQLoss
 from .dreamer import DreamerActorLoss, DreamerValueLoss, imagine_rollout
+from .dreamer_v3 import (
+    DreamerV3ActorLoss,
+    DreamerV3ModelLoss,
+    DreamerV3ValueLoss,
+    imagine_rollout_v3,
+)
 from .cql import CQLLoss, DiscreteCQLLoss
-from .ddpg import DDPGLoss, TD3Loss
+from .ddpg import DDPGLoss, TD3BCLoss, TD3Loss
 from .dqn import DistributionalDQNLoss, DQNLoss
-from .imitation import BCLoss, GAILLoss, RNDModule
+from .imitation import ACTLoss, BCLoss, GAILLoss, RNDModule
 from .iql import IQLLoss
 from .redq import REDQLoss
 from .multiagent import IPPOLoss, MAPPOLoss, QMixerLoss
@@ -30,6 +36,12 @@ from .value import (
 )
 
 __all__ = [
+    "ACTLoss",
+    "TD3BCLoss",
+    "DreamerV3ModelLoss",
+    "DreamerV3ActorLoss",
+    "DreamerV3ValueLoss",
+    "imagine_rollout_v3",
     "CrossQLoss",
     "BatchNormMLP",
     "DreamerActorLoss",

@@ -15,7 +15,7 @@ from ..data import ArrayDict
 from ..modules.networks import apply_ensemble, init_ensemble
 from .common import bootstrap_discount, LossModule, hold_out
 
-__all__ = ["DDPGLoss", "TD3Loss"]
+__all__ = ["DDPGLoss", "TD3BCLoss", "TD3Loss"]
 
 
 class DDPGLoss(LossModule):
@@ -121,9 +121,8 @@ class TD3Loss(LossModule):
     def _q(self, qparams, obs, action):
         return apply_ensemble(self.qvalue_module, qparams, obs, action)[..., 0]
 
-    def __call__(self, params, batch: ArrayDict, key=None):
-        if key is None:
-            raise ValueError("TD3Loss requires a PRNG key (target policy smoothing)")
+    def _critic_loss(self, params, batch: ArrayDict, key):
+        """Twin-critic TD loss + the policy action/Q reused by actor terms."""
         next_a = self.actor(hold_out(params["target_actor"]), batch["next"])["action"]
         noise = jnp.clip(
             self.policy_noise * jax.random.normal(key, next_a.shape),
@@ -144,11 +143,45 @@ class TD3Loss(LossModule):
         a_pi = self.actor(params["actor"], batch)["action"]
         # reference uses the first critic for the actor objective
         q_pi = self._q(hold_out(params["qvalue"]), batch["observation"], a_pi)[0]
-        loss_actor = -jnp.mean(q_pi)
+        return loss_qvalue, td_error, a_pi, q_pi
 
+    def __call__(self, params, batch: ArrayDict, key=None):
+        if key is None:
+            raise ValueError("TD3Loss requires a PRNG key (target policy smoothing)")
+        loss_qvalue, td_error, a_pi, q_pi = self._critic_loss(params, batch, key)
+        loss_actor = -jnp.mean(q_pi)
         total = loss_qvalue + loss_actor
         return total, ArrayDict(
             loss_qvalue=loss_qvalue,
             loss_actor=loss_actor,
+            td_error=jax.lax.stop_gradient(jnp.abs(td_error).mean(axis=0)),
+        )
+
+
+class TD3BCLoss(TD3Loss):
+    """TD3+BC offline RL (reference td3_bc.py:27, Fujimoto & Gu 2021):
+    TD3's critic objective unchanged; the actor objective becomes
+    ``-λ·Q(s, π(s)) + (π(s) − a)²`` with the adaptive scale
+    ``λ = α / mean(|Q(s, π(s))|)`` — one-line offline regularization on top
+    of TD3 (the reference's minimalist-offline-RL selling point).
+    """
+
+    def __init__(self, *args, alpha: float = 2.5, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.alpha = alpha
+
+    def __call__(self, params, batch: ArrayDict, key=None):
+        if key is None:
+            raise ValueError("TD3BCLoss requires a PRNG key")
+        loss_qvalue, td_error, a_pi, q_pi = self._critic_loss(params, batch, key)
+        lam = self.alpha / jax.lax.stop_gradient(jnp.abs(q_pi).mean() + 1e-8)
+        bc = jnp.mean(jnp.sum((a_pi - batch["action"]) ** 2, axis=-1))
+        loss_actor = -lam * jnp.mean(q_pi) + bc
+        total = loss_qvalue + loss_actor
+        return total, ArrayDict(
+            loss_qvalue=loss_qvalue,
+            loss_actor=loss_actor,
+            bc_loss=bc,
+            lmbda=lam,
             td_error=jax.lax.stop_gradient(jnp.abs(td_error).mean(axis=0)),
         )

@@ -15,7 +15,7 @@ from ..data import ArrayDict
 from ..modules.networks import MLP
 from .common import LossModule, masked_mean
 
-__all__ = ["BCLoss", "GAILLoss", "RNDModule"]
+__all__ = ["ACTLoss", "BCLoss", "GAILLoss", "RNDModule"]
 
 
 class BCLoss(LossModule):
@@ -135,3 +135,32 @@ class RNDModule(LossModule):
         pred = self.predictor.apply({"params": params["predictor"]}, batch["observation"])
         loss = jnp.mean((pred - tgt) ** 2)
         return loss, ArrayDict(loss_rnd=loss)
+
+
+class ACTLoss(LossModule):
+    """Action-Chunking-Transformer CVAE loss (reference objectives/act.py:19):
+    L1 reconstruction of the expert action chunk + β·KL(enc(obs,chunk) ‖
+    N(0,1)). Batches carry "observation" [B, D] and "action_chunk" [B, K, A]
+    (build chunks from trajectories with a SliceSampler of length K).
+    """
+
+    def __init__(self, model, beta: float = 10.0):
+        self.model = model
+        self.beta = beta
+
+    def init_params(self, key, td):
+        return {"act": self.model.init(key)}
+
+    def __call__(self, params, batch: ArrayDict, key=None):
+        if key is None:
+            raise ValueError("ACTLoss requires a PRNG key (CVAE sampling)")
+        chunk = batch["action_chunk"]
+        pred, mean, std = self.model.forward(
+            params["act"], batch["observation"], chunk, key
+        )
+        l1 = jnp.mean(jnp.abs(pred - chunk))
+        kl = jnp.mean(
+            0.5 * jnp.sum(mean**2 + std**2 - 2 * jnp.log(std) - 1.0, axis=-1)
+        )
+        total = l1 + self.beta * kl
+        return total, ArrayDict(loss_act=total, l1=l1, kl=kl)

@@ -1,0 +1,389 @@
+"""Algorithm-tail tests: TD3+BC, DreamerV3 (symlog/two-hot/balanced-KL),
+ACT CVAE imitation, MultiStepActorWrapper (strategy mirrors reference
+test/objectives/ per-loss files: brute-force math checks + gradient-routing
++ small learning runs)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from rl_tpu.data import ArrayDict
+from rl_tpu.models import (
+    ACTConfig,
+    ACTModel,
+    RSSMv3,
+    RSSMv3Config,
+    symexp,
+    symlog,
+    symlog_bins,
+    twohot_decode,
+    twohot_encode,
+)
+from rl_tpu.modules import MLP, MultiStepActorWrapper, ProbabilisticActor, TanhNormal, TDModule, TDSequential, NormalParamExtractor
+from rl_tpu.objectives import (
+    ACTLoss,
+    DreamerV3ActorLoss,
+    DreamerV3ModelLoss,
+    DreamerV3ValueLoss,
+    TD3BCLoss,
+)
+
+KEY = jax.random.key(0)
+
+
+# -- symlog / two-hot ----------------------------------------------------------
+
+
+class TestSymlogTwohot:
+    def test_symlog_roundtrip(self):
+        x = jnp.asarray([-100.0, -1.0, 0.0, 0.5, 1e4])
+        np.testing.assert_allclose(np.asarray(symexp(symlog(x))), np.asarray(x), rtol=1e-4)
+
+    def test_twohot_mass_and_decode(self):
+        bins = symlog_bins(41)
+        y = symlog(jnp.asarray([0.0, 3.7, -250.0]))
+        enc = twohot_encode(y, bins)
+        np.testing.assert_allclose(np.asarray(enc.sum(-1)), 1.0, rtol=1e-5)
+        assert int((enc[1] > 0).sum()) <= 2  # exactly two adjacent bins
+        # decoding the *exact* two-hot distribution recovers the scalar
+        logits = jnp.log(enc + 1e-30)
+        dec = twohot_decode(logits, bins)
+        np.testing.assert_allclose(np.asarray(dec), [0.0, 3.7, -250.0], rtol=1e-3, atol=1e-3)
+
+
+# -- TD3+BC --------------------------------------------------------------------
+
+
+class TestTD3BC:
+    def _setup(self):
+        from rl_tpu.modules import ConcatMLP, TanhPolicy
+
+        actor = TDModule(TanhPolicy(action_dim=2, num_cells=(32, 32)), ["observation"], ["action"])
+        loss = TD3BCLoss(
+            actor,
+            ConcatMLP(out_features=1, num_cells=(32, 32)),
+            action_low=-1.0,
+            action_high=1.0,
+            alpha=2.5,
+        )
+        B = 16
+        k = jax.random.key(1)
+        batch = ArrayDict(
+            observation=jax.random.normal(k, (B, 4)),
+            action=jax.random.uniform(k, (B, 2), minval=-1, maxval=1),
+            next=ArrayDict(
+                observation=jax.random.normal(k, (B, 4)),
+                reward=jax.random.normal(k, (B,)),
+                terminated=jnp.zeros((B,), bool),
+                truncated=jnp.zeros((B,), bool),
+                done=jnp.zeros((B,), bool),
+            ),
+        )
+        params = loss.init_params(KEY, batch)
+        return loss, params, batch
+
+    def test_loss_finite_and_has_bc_term(self):
+        loss, params, batch = self._setup()
+        total, metrics = loss(params, batch, KEY)
+        assert np.isfinite(float(total))
+        assert float(metrics["bc_loss"]) > 0
+        assert float(metrics["lmbda"]) > 0
+
+    def test_bc_pulls_actor_toward_data(self):
+        """With alpha=0 (pure BC), gradient steps shrink ||pi(s) - a||."""
+        import optax
+
+        from rl_tpu.modules import ConcatMLP, TanhPolicy
+
+        actor = TDModule(TanhPolicy(action_dim=2, num_cells=(32, 32)), ["observation"], ["action"])
+        loss = TD3BCLoss(
+            actor,
+            ConcatMLP(out_features=1, num_cells=(32, 32)),
+            action_low=-1.0,
+            action_high=1.0,
+            alpha=0.0,
+        )
+        k = jax.random.key(2)
+        B = 64
+        obs = jax.random.normal(k, (B, 4))
+        act = jnp.tanh(obs[:, :2])  # deterministic expert
+        batch = ArrayDict(
+            observation=obs,
+            action=act,
+            next=ArrayDict(
+                observation=obs,
+                reward=jnp.zeros((B,)),
+                terminated=jnp.zeros((B,), bool),
+                truncated=jnp.zeros((B,), bool),
+                done=jnp.zeros((B,), bool),
+            ),
+        )
+        params = loss.init_params(KEY, batch)
+        opt = optax.adam(1e-2)
+        ost = opt.init(loss.trainable(params))
+
+        @jax.jit
+        def step(params, ost, key):
+            _, grads, m = loss.grad(params, batch, key)
+            upd, ost = opt.update(grads, ost, loss.trainable(params))
+            import optax as _o
+
+            params = loss.merge(_o.apply_updates(loss.trainable(params), upd), params)
+            return params, ost, m
+
+        key = KEY
+        first = None
+        for i in range(40):
+            key, k2 = jax.random.split(key)
+            params, ost, m = step(params, ost, k2)
+            if first is None:
+                first = float(m["bc_loss"])
+        assert float(m["bc_loss"]) < 0.5 * first
+
+
+# -- DreamerV3 -----------------------------------------------------------------
+
+
+def _v3_batch(cfg, B=4, T=6, key=jax.random.key(3)):
+    k1, k2, k3 = jax.random.split(key, 3)
+    return ArrayDict(
+        observation=jax.random.normal(k1, (B, T, cfg.obs_dim)),
+        action=jax.random.uniform(k2, (B, T, cfg.action_dim), minval=-1, maxval=1),
+        reward=jax.random.normal(k3, (B, T)),
+        terminated=jnp.zeros((B, T), bool),
+        is_first=jnp.zeros((B, T), bool).at[:, 0].set(True),
+    )
+
+
+class TestDreamerV3:
+    def _models(self):
+        cfg = RSSMv3Config(obs_dim=5, action_dim=2, deter_dim=16, groups=2, classes=4, hidden=16, n_bins=21)
+        rssm = RSSMv3(cfg)
+
+        net = TDSequential(
+            TDModule(MLP(out_features=4, num_cells=(16,)), ["h"], ["raw1"]),
+            TDModule(lambda x: x, ["raw1"], ["raw1"]),
+        )
+
+        class Actor:
+            in_keys = [("h",), ("z",)]
+            out_keys = [("action",)]
+
+            def __init__(self):
+                self.mlp = MLP(out_features=2 * cfg.action_dim, num_cells=(16,))
+
+            def init(self, key, td):
+                feat = jnp.concatenate([td["h"], td["z"]], axis=-1)
+                return self.mlp.init(key, feat)
+
+            def __call__(self, params, td, key=None):
+                feat = jnp.concatenate([td["h"], td["z"]], axis=-1)
+                loc, raw = jnp.split(self.mlp.apply(params, feat), 2, axis=-1)
+                dist_scale = jax.nn.softplus(raw) + 1e-3
+                if key is None:
+                    a = jnp.tanh(loc)
+                    lp = jnp.zeros(loc.shape[:-1])
+                else:
+                    eps = jax.random.normal(key, loc.shape)
+                    a = jnp.tanh(loc + dist_scale * eps)
+                    lp = -0.5 * jnp.sum(eps**2, axis=-1)
+                return td.set("action", a).set("sample_log_prob", lp)
+
+        value_mlp = MLP(out_features=cfg.n_bins, num_cells=(16,))
+
+        def value_fn(vparams, feat):
+            return value_mlp.apply(vparams, feat)
+
+        return cfg, rssm, Actor(), value_mlp, value_fn
+
+    def test_model_loss_trains(self):
+        import optax
+
+        cfg, rssm, actor, value_mlp, value_fn = self._models()
+        loss = DreamerV3ModelLoss(rssm)
+        batch = _v3_batch(cfg)
+        params = loss.init_params(KEY, batch)
+        opt = optax.adam(3e-3)
+        ost = opt.init(params)
+
+        @jax.jit
+        def step(params, ost, key):
+            (l, m), g = jax.value_and_grad(lambda p: loss(p, batch, key), has_aux=True)(params)
+            upd, ost = opt.update(g, ost, params)
+            return optax.apply_updates(params, upd), ost, l
+
+        key = KEY
+        losses = []
+        for _ in range(25):
+            key, k = jax.random.split(key)
+            params, ost, l = step(params, ost, k)
+            losses.append(float(l))
+        assert losses[-1] < losses[0]
+
+    def test_actor_value_losses_route_gradients(self):
+        cfg, rssm, actor, value_mlp, value_fn = self._models()
+        model_loss = DreamerV3ModelLoss(rssm)
+        batch = _v3_batch(cfg)
+        rssm_params = model_loss.init_params(KEY, batch)["rssm"]
+        out = rssm.observe(rssm_params, batch["observation"], batch["action"], batch["is_first"], KEY)
+
+        feat_dim = cfg.deter_dim + cfg.stoch_dim
+        td0 = ArrayDict(h=jnp.zeros((1, cfg.deter_dim)), z=jnp.zeros((1, cfg.stoch_dim)))
+        actor_params = actor.init(KEY, td0)
+        vparams = value_mlp.init(KEY, jnp.zeros((1, feat_dim)))
+        params = {
+            "actor": actor_params,
+            "rssm": rssm_params,
+            "value": vparams,
+            "slow_value": jax.tree.map(jnp.copy, vparams),
+            "return_scale": jnp.asarray(1.0),
+        }
+        ab = ArrayDict(h=out["h"], z=out["z"])
+
+        a_loss = DreamerV3ActorLoss(rssm, actor, value_fn, horizon=4)
+        (l, m), g = jax.value_and_grad(lambda p: a_loss({**params, "actor": p}, ab, KEY), has_aux=True)(actor_params)
+        assert np.isfinite(float(l))
+        assert max(float(jnp.abs(x).max()) for x in jax.tree.leaves(g)) > 0
+        assert float(m["return_scale"]) > 0
+
+        v_loss = DreamerV3ValueLoss(rssm, actor, value_fn, horizon=4)
+        (l2, m2), g2 = jax.value_and_grad(lambda p: v_loss({**params, "value": p}, ab, KEY), has_aux=True)(vparams)
+        assert np.isfinite(float(l2))
+        assert max(float(jnp.abs(x).max()) for x in jax.tree.leaves(g2)) > 0
+
+    def test_rssm_reset_masking(self):
+        cfg, rssm, *_ = self._models()
+        batch = _v3_batch(cfg, B=2, T=4)
+        params = DreamerV3ModelLoss(rssm).init_params(KEY, batch)["rssm"]
+        # all-first sequence == each step filtered from zero state
+        allfirst = batch.replace(is_first=jnp.ones((2, 4), bool))
+        out = rssm.observe(params, allfirst["observation"], allfirst["action"], allfirst["is_first"], KEY)
+        assert np.isfinite(np.asarray(out["h"])).all()
+
+
+# -- ACT -----------------------------------------------------------------------
+
+
+class TestACT:
+    def test_cvae_shapes_and_loss(self):
+        cfg = ACTConfig(obs_dim=6, action_dim=3, chunk=5, d_model=32, n_layers=1)
+        model = ACTModel(cfg)
+        loss = ACTLoss(model, beta=1.0)
+        B = 8
+        batch = ArrayDict(
+            observation=jax.random.normal(KEY, (B, 6)),
+            action_chunk=jax.random.uniform(KEY, (B, 5, 3), minval=-1, maxval=1),
+        )
+        params = loss.init_params(KEY, batch)
+        total, metrics = loss(params, batch, KEY)
+        assert np.isfinite(float(total))
+        act = model.act(params["act"], batch["observation"])
+        assert act.shape == (B, 5, 3)
+
+    @pytest.mark.slow
+    def test_act_learns_chunks(self):
+        """L1 falls by >2x on a deterministic obs->chunk mapping."""
+        import optax
+
+        cfg = ACTConfig(obs_dim=4, action_dim=2, chunk=4, d_model=32, n_layers=1)
+        model = ACTModel(cfg)
+        loss = ACTLoss(model, beta=0.1)
+        k = jax.random.key(7)
+        B = 64
+        obs = jax.random.normal(k, (B, 4))
+        # expert chunk: linear ramp scaled by obs features
+        t = jnp.linspace(0, 1, 4)[None, :, None]
+        chunk = jnp.tanh(obs[:, None, :2] * t)
+        batch = ArrayDict(observation=obs, action_chunk=chunk)
+        params = loss.init_params(KEY, batch)
+        opt = optax.adam(1e-3)
+        ost = opt.init(params)
+
+        @jax.jit
+        def step(params, ost, key):
+            (l, m), g = jax.value_and_grad(lambda p: loss(p, batch, key), has_aux=True)(params)
+            upd, ost = opt.update(g, ost, params)
+            return optax.apply_updates(params, upd), ost, m
+
+        key = KEY
+        first = last = None
+        for i in range(150):
+            key, k2 = jax.random.split(key)
+            params, ost, m = step(params, ost, k2)
+            if i == 0:
+                first = float(m["l1"])
+        last = float(m["l1"])
+        assert last < 0.5 * first, (first, last)
+
+
+# -- MultiStepActorWrapper -----------------------------------------------------
+
+
+class TestMultiStepActorWrapper:
+    def test_chunk_playout_and_replan(self):
+        K = 3
+
+        calls = []
+
+        def plan_fn(params, td, key):
+            # chunk = [base, base+1, base+2] where base = 10 * obs
+            base = td["observation"][..., 0] * 10.0
+            return base[..., None, None] + jnp.arange(K, dtype=jnp.float32)[:, None]
+
+        w = MultiStepActorWrapper(plan_fn, n_steps=K, action_shape=(1,))
+        td = ArrayDict(
+            observation=jnp.asarray([[1.0], [2.0]]),
+            done=jnp.zeros((2,), bool),
+        )
+        state = w.init_state((2,))
+        outs = []
+        for t in range(2 * K):
+            td2 = w({}, td.set("exploration", state), jax.random.key(t))
+            state = td2["exploration"]
+            outs.append(np.asarray(td2["action"][:, 0]))
+        outs = np.stack(outs)  # [2K, B]
+        np.testing.assert_allclose(outs[:, 0], [10, 11, 12, 10, 11, 12])
+        np.testing.assert_allclose(outs[:, 1], [20, 21, 22, 20, 21, 22])
+
+    def test_replans_on_episode_reset(self):
+        K = 4
+
+        def plan_fn(params, td, key):
+            base = td["observation"][..., 0]
+            return base[..., None, None] * jnp.ones((K, 1))
+
+        w = MultiStepActorWrapper(plan_fn, n_steps=K, action_shape=(1,))
+        td = ArrayDict(
+            observation=jnp.asarray([[5.0]]),
+            done=jnp.zeros((1,), bool),
+            is_init=jnp.zeros((1,), bool),
+        )
+        state = w.init_state((1,))
+        td2 = w({}, td.set("exploration", state), KEY)
+        state = td2["exploration"]
+        # mid-chunk the obs changes AND is_init fires -> must replan from new obs
+        td3 = td.replace(observation=jnp.asarray([[9.0]]), is_init=jnp.ones((1,), bool))
+        out = w({}, td3.set("exploration", state), KEY)
+        assert float(out["action"][0, 0]) == 9.0
+
+    def test_collector_integration(self):
+        from rl_tpu.collectors import Collector
+        from rl_tpu.envs import VmapEnv
+        from rl_tpu.testing import ContinuousActionMock
+
+        env = VmapEnv(ContinuousActionMock(obs_dim=4, act_dim=2), 3)
+
+        def plan_fn(params, td, key):
+            return jnp.zeros(td["done"].shape + (2, 2))
+
+        w = MultiStepActorWrapper(plan_fn, n_steps=2, action_shape=(2,))
+        coll = Collector(
+            env,
+            lambda p, td, k: w(p, td, k),
+            frames_per_batch=12,
+            policy_state=w.init_state((3,)),
+        )
+        cstate = coll.init(KEY)
+        batch, cstate = coll.collect({}, cstate)
+        assert batch["action"].shape == (4, 3, 2)
