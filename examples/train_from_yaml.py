@@ -1,0 +1,30 @@
+"""Train any algorithm from a YAML recipe alone — the config-driven driver
+(reference analog: sota-implementations/*/`python xxx.py --config-name=...`
+via hydra; here: `python examples/train_from_yaml.py <recipe.yaml> [steps]`).
+
+Every component in the recipe resolves through the rl_tpu.config registry,
+so the YAML is the full specification of the run.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from rl_tpu.configs import load_recipe  # noqa: E402
+
+
+def main(argv=None):
+    argv = argv if argv is not None else sys.argv[1:]
+    if not argv:
+        print(__doc__)
+        return 2
+    trainer = load_recipe(argv[0])
+    if len(argv) > 1:  # optional step-count override for smoke runs
+        trainer.total_steps = int(argv[1])
+    trainer.train(0)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -90,47 +90,140 @@ def to_dict(obj: Any) -> Any:
     return obj
 
 
-# Standard component registry (the reference's config groups). Values are
-# dotted import paths resolved lazily by get_component.
-_BUILTINS: dict[str, str] = {
-    "env/pendulum": "rl_tpu.envs.PendulumEnv",
+# Standard component registry (the reference's config groups,
+# trainers/algorithms/configs/__init__.py registers a *Config per component).
+# Values are dotted import paths resolved lazily by get_component, built from
+# per-group tables below so importing rl_tpu.config stays import-cheap.
+_BUILTINS: dict[str, str] = {}
+
+
+def _snake(name: str) -> str:
+    import re
+
+    # lower→Upper and UPPER→Upper-lower boundaries only (A2C→a2c, TD3→td3)
+    return re.sub(r"(?<=[a-z])(?=[A-Z])|(?<=[A-Z])(?=[A-Z][a-z])", "_", name).lower()
+
+
+def _add_group(group: str, module: str, names: Sequence[str], strip: str = "") -> None:
+    for n in names:
+        short = n[: -len(strip)] if strip and n.endswith(strip) and n != strip else n
+        _BUILTINS.setdefault(f"{group}/{_snake(short)}", f"{module}.{n}")
+
+
+_add_group("env", "rl_tpu.envs", [
+    "CartPoleEnv", "PendulumEnv", "MountainCarEnv", "MountainCarContinuousEnv",
+    "AcrobotEnv", "TicTacToeEnv", "TradingEnv", "NavigationEnv",
+    "VmapEnv", "TransformedEnv", "ModelBasedEnv",
+    "FrameSkipEnv", "NoopResetEnv", "ConditionalSkipEnv", "MultiActionEnv",
+], strip="Env")
+_add_group("env", "rl_tpu.envs.llm", ["ChatEnv", "DatasetChatEnv"], strip="Env")
+_add_group("env", "rl_tpu.envs.libs.gym", ["GymEnv"], strip="Env")
+_add_group("transform", "rl_tpu.envs", [
+    "Compose", "RewardSum", "RewardScaling", "RewardClipping", "StepCounter",
+    "InitTracker", "CatFrames", "CatTensors", "ObservationNorm", "VecNorm",
+    "DoubleToFloat", "DTypeCast", "FlattenObservation", "UnsqueezeTransform",
+    "SqueezeTransform", "RenameTransform", "ActionScaling", "TimeMaxPool",
+    "GrayScale", "Resize", "CenterCrop", "ToFloatImage",
+    "ActionMask", "ActionDiscretizer", "BinarizeReward", "ClipTransform",
+    "EndOfLifeTransform", "ExcludeTransform", "SelectTransform", "FiniteCheck",
+    "Hash", "LineariseRewards", "ModuleTransform", "PermuteTransform",
+    "SignTransform", "StackTransform", "TensorDictPrimer", "Timer",
+    "TrajCounter",
+], strip="Transform")
+_add_group("network", "rl_tpu.modules", [
+    "MLP", "ConcatMLP", "ConvNet", "DuelingMLP", "TanhPolicy", "NoisyDense",
+    "MultiAgentMLP", "QMixer", "VDNMixer", "NormalParamExtractor",
+])
+_add_group("module", "rl_tpu.modules", ["TDModule", "TDSequential"], strip="Module")
+_add_group("actor", "rl_tpu.modules", [
+    "ProbabilisticActor", "QValueActor", "RandomPolicy", "MultiStepActorWrapper",
+], strip="Actor")
+_add_group("operator", "rl_tpu.modules", ["ValueOperator", "ActorValueOperator"], strip="Operator")
+_add_group("exploration", "rl_tpu.modules", [
+    "EGreedyModule", "AdditiveGaussianModule", "OrnsteinUhlenbeckModule",
+    "GSDEModule", "ConsistentDropout",
+], strip="Module")
+_add_group("dist", "rl_tpu.modules", [
+    "Normal", "TanhNormal", "TruncatedNormal", "Delta", "TanhDelta",
+    "Categorical", "OneHotCategorical", "MaskedCategorical", "Ordinal",
+    "OneHotOrdinal",
+])
+_add_group("planner", "rl_tpu.modules", ["CEMPlanner", "MPPIPlanner"], strip="Planner")
+_add_group("loss", "rl_tpu.objectives", [
+    "PPOLoss", "ClipPPOLoss", "KLPENPPOLoss", "A2CLoss", "ReinforceLoss",
+    "SACLoss", "DiscreteSACLoss", "DQNLoss", "DistributionalDQNLoss",
+    "DDPGLoss", "TD3Loss", "TD3BCLoss", "CQLLoss", "DiscreteCQLLoss",
+    "IQLLoss", "REDQLoss", "CrossQLoss", "BCLoss", "GAILLoss", "ACTLoss",
+    "IPPOLoss", "MAPPOLoss", "QMixerLoss", "DreamerActorLoss",
+    "DreamerValueLoss", "DreamerV3ModelLoss", "DreamerV3ActorLoss",
+    "DreamerV3ValueLoss",
+], strip="Loss")
+_add_group("estimator", "rl_tpu.objectives", [
+    "GAE", "MultiAgentGAE", "TD0Estimator", "TD1Estimator",
+    "TDLambdaEstimator", "VTrace",
+], strip="Estimator")
+_add_group("updater", "rl_tpu.objectives", ["SoftUpdate", "HardUpdate"], strip="Update")
+_add_group("storage", "rl_tpu.data.replay", [
+    "DeviceStorage", "ListStorage", "MemmapStorage", "CompressedListStorage",
+    "StorageEnsemble",
+], strip="Storage")
+_add_group("sampler", "rl_tpu.data.replay", [
+    "RandomSampler", "SamplerWithoutReplacement", "PrioritizedSampler",
+    "HostPrioritizedSampler", "SliceSampler", "SliceSamplerWithoutReplacement",
+    "PrioritizedSliceSampler", "StalenessAwareSampler",
+], strip="Sampler")
+_add_group("writer", "rl_tpu.data.replay", [
+    "RoundRobinWriter", "MaxValueWriter", "ImmutableDatasetWriter",
+], strip="Writer")
+_add_group("buffer", "rl_tpu.data.replay", ["ReplayBuffer", "ReplayBufferEnsemble"], strip="Buffer")
+_add_group("postproc", "rl_tpu.data", [
+    "MultiStep", "DensifyReward", "Reward2GoTransform", "BurnInTransform",
+], strip="Transform")
+_add_group("model", "rl_tpu.models", [
+    "RSSM", "RSSMv3", "TransformerLM", "DecisionTransformer", "ACTModel",
+], strip="Model")
+_add_group("collector", "rl_tpu.collectors", [
+    "Collector", "HostCollector", "LLMCollector",
+], strip="Collector")
+_add_group("logger", "rl_tpu.record.loggers", [
+    "CSVLogger", "TensorboardLogger", "WandbLogger", "MLFlowLogger",
+    "NullLogger", "MultiLogger",
+], strip="Logger")
+_add_group("scheme", "rl_tpu.weight_update.schemes", [
+    "SharedProgramScheme", "DevicePutScheme", "DoubleBufferScheme",
+], strip="Scheme")
+_add_group("trainer", "rl_tpu.trainers", ["Trainer"])
+_add_group("program", "rl_tpu.trainers", [
+    "OnPolicyProgram", "OffPolicyProgram", "OnPolicyConfig", "OffPolicyConfig",
+], strip="Program")
+_BUILTINS.update({
+    # aliases kept from the round-1 registry + builder entry points
     "env/cartpole": "rl_tpu.envs.CartPoleEnv",
-    "env/vmap": "rl_tpu.envs.VmapEnv",
-    "env/transformed": "rl_tpu.envs.TransformedEnv",
-    "transform/reward_sum": "rl_tpu.envs.RewardSum",
-    "transform/reward_scaling": "rl_tpu.envs.RewardScaling",
-    "transform/step_counter": "rl_tpu.envs.StepCounter",
-    "transform/init_tracker": "rl_tpu.envs.InitTracker",
-    "transform/cat_frames": "rl_tpu.envs.CatFrames",
-    "transform/obs_norm": "rl_tpu.envs.ObservationNorm",
-    "network/mlp": "rl_tpu.modules.MLP",
-    "network/concat_mlp": "rl_tpu.modules.ConcatMLP",
-    "network/conv": "rl_tpu.modules.ConvNet",
-    "network/dueling": "rl_tpu.modules.DuelingMLP",
-    "network/tanh_policy": "rl_tpu.modules.TanhPolicy",
-    "module/td": "rl_tpu.modules.TDModule",
-    "actor/probabilistic": "rl_tpu.modules.ProbabilisticActor",
+    "env/mountaincar": "rl_tpu.envs.MountainCarEnv",
+    "env/tictactoe": "rl_tpu.envs.TicTacToeEnv",
     "actor/qvalue": "rl_tpu.modules.QValueActor",
-    "operator/value": "rl_tpu.modules.ValueOperator",
-    "loss/ppo_clip": "rl_tpu.objectives.ClipPPOLoss",
-    "loss/ppo": "rl_tpu.objectives.PPOLoss",
-    "loss/a2c": "rl_tpu.objectives.A2CLoss",
-    "loss/sac": "rl_tpu.objectives.SACLoss",
-    "loss/dqn": "rl_tpu.objectives.DQNLoss",
-    "loss/td3": "rl_tpu.objectives.TD3Loss",
-    "loss/ddpg": "rl_tpu.objectives.DDPGLoss",
-    "loss/iql": "rl_tpu.objectives.IQLLoss",
-    "loss/cql": "rl_tpu.objectives.CQLLoss",
-    "loss/redq": "rl_tpu.objectives.REDQLoss",
-    "storage/device": "rl_tpu.data.DeviceStorage",
-    "storage/memmap": "rl_tpu.data.MemmapStorage",
-    "sampler/random": "rl_tpu.data.RandomSampler",
-    "sampler/prioritized": "rl_tpu.data.PrioritizedSampler",
-    "sampler/slice": "rl_tpu.data.SliceSampler",
+    "transform/obs_norm": "rl_tpu.envs.ObservationNorm",
+    "loss/td3_bc": "rl_tpu.objectives.TD3BCLoss",
+    "loss/c51": "rl_tpu.objectives.DistributionalDQNLoss",
+    "loss/kl_pen_ppo": "rl_tpu.objectives.KLPENPPOLoss",
+    "loss/dreamer_v3_actor": "rl_tpu.objectives.DreamerV3ActorLoss",
+    "loss/dreamer_v3_model": "rl_tpu.objectives.DreamerV3ModelLoss",
+    "loss/dreamer_v3_value": "rl_tpu.objectives.DreamerV3ValueLoss",
+    "model/rssm_v3": "rl_tpu.models.RSSMv3",
     "sampler/without_replacement": "rl_tpu.data.SamplerWithoutReplacement",
     "buffer/replay": "rl_tpu.data.ReplayBuffer",
-    "program/on_policy": "rl_tpu.trainers.OnPolicyProgram",
+    "env/gym": "rl_tpu.envs.libs.gym.GymEnv",
+    "loss/ppo_clip": "rl_tpu.objectives.ClipPPOLoss",
+    "network/conv": "rl_tpu.modules.ConvNet",
+    "network/dueling": "rl_tpu.modules.DuelingMLP",
+    "module/td": "rl_tpu.modules.TDModule",
     "program/on_policy_config": "rl_tpu.trainers.OnPolicyConfig",
-    "program/off_policy": "rl_tpu.trainers.OffPolicyProgram",
     "program/off_policy_config": "rl_tpu.trainers.OffPolicyConfig",
-}
+    "trainer/ppo": "rl_tpu.trainers.make_ppo_trainer",
+    "trainer/a2c": "rl_tpu.trainers.make_a2c_trainer",
+    "trainer/sac": "rl_tpu.trainers.make_sac_trainer",
+    "trainer/dqn": "rl_tpu.trainers.make_dqn_trainer",
+    "trainer/td3": "rl_tpu.trainers.make_td3_trainer",
+    "trainer/iql_offline": "rl_tpu.trainers.train_iql",
+    "trainer/cql_offline": "rl_tpu.trainers.train_cql",
+})

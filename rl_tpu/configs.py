@@ -1,0 +1,231 @@
+"""Typed recipe dataclasses over the component registry.
+
+Redesign of the reference's hydra config surface (reference:
+torchrl/trainers/algorithms/configs/__init__.py — dataclasses registered in
+a ConfigStore, one per component, composed from YAML into full algorithm
+recipes). Here each ``*Recipe`` dataclass mirrors the keyword surface of one
+``make_*_trainer`` builder; ``as_node()`` lowers it to a ``_target_`` config
+tree (the exchange format), ``dump_yaml``/``load_recipe`` round-trip it, and
+``build()`` instantiates the actual Trainer via :mod:`rl_tpu.config`.
+
+YAML and dataclasses are two views of the same node tree, so a user can
+author either and the driver path is identical:
+
+>>> PPORecipe(env=EnvNode("env/cartpole"), total_steps=1000).build().run()
+>>> load_recipe("examples/configs/ppo_cartpole.yaml").run()
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Any
+
+from .config import instantiate, load_yaml
+
+__all__ = [
+    "EnvNode",
+    "Node",
+    "Recipe",
+    "PPORecipe",
+    "A2CRecipe",
+    "SACRecipe",
+    "DQNRecipe",
+    "TD3Recipe",
+    "as_node",
+    "from_node",
+    "dump_yaml",
+    "load_recipe",
+    "RECIPES",
+]
+
+
+@dataclass
+class Node:
+    """A generic registry-addressed component: ``target`` + kwargs."""
+
+    target: str
+    kwargs: dict[str, Any] = field(default_factory=dict)
+
+    def as_node(self) -> dict:
+        return {"_target_": self.target, **{k: as_node(v) for k, v in self.kwargs.items()}}
+
+
+@dataclass
+class EnvNode:
+    """Environment node with optional vmap batching and transform stack."""
+
+    target: str
+    num_envs: int = 0  # 0 = leave unbatched
+    transforms: list[Node] = field(default_factory=list)
+    kwargs: dict[str, Any] = field(default_factory=dict)
+
+    def as_node(self) -> dict:
+        node: dict = {"_target_": self.target, **{k: as_node(v) for k, v in self.kwargs.items()}}
+        if self.num_envs:
+            node = {"_target_": "env/vmap", "env": node, "num_envs": self.num_envs}
+        if self.transforms:
+            ts = [t.as_node() for t in self.transforms]
+            tf = ts[0] if len(ts) == 1 else {"_target_": "transform/compose", "transforms": ts}
+            node = {"_target_": "env/transformed", "env": node, "transform": tf}
+        return node
+
+
+@dataclass
+class Recipe:
+    """Base: fields lower to kwargs of the trainer builder named by TARGET."""
+
+    TARGET = ""  # class attr, overridden
+
+    def as_node(self) -> dict:
+        out: dict = {"_target_": type(self).TARGET}
+        for f in dataclasses.fields(self):
+            v = getattr(self, f.name)
+            if f.name == "extra":
+                out.update({k: as_node(x) for k, x in v.items()})
+            else:
+                # None is kept: the builders accept it, and dropping it would
+                # silently revert fields (e.g. DQN n_step=None) to defaults
+                out[f.name] = as_node(v)
+        return out
+
+    def build(self):
+        return instantiate(self.as_node())
+
+
+@dataclass
+class PPORecipe(Recipe):
+    TARGET = "trainer/ppo"
+    env: EnvNode = field(default_factory=lambda: EnvNode("env/cartpole", num_envs=8))
+    total_steps: int = 100
+    frames_per_batch: int = 2048
+    gamma: float = 0.99
+    lmbda: float = 0.95
+    log_interval: int = 10
+    logger: Node | None = None
+    extra: dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class A2CRecipe(Recipe):
+    TARGET = "trainer/a2c"
+    env: EnvNode = field(default_factory=lambda: EnvNode("env/cartpole", num_envs=8))
+    total_steps: int = 100
+    frames_per_batch: int = 1024
+    gamma: float = 0.99
+    lmbda: float = 0.95
+    learning_rate: float = 7e-4
+    log_interval: int = 10
+    logger: Node | None = None
+    extra: dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class SACRecipe(Recipe):
+    TARGET = "trainer/sac"
+    env: EnvNode = field(default_factory=lambda: EnvNode("env/pendulum", num_envs=8))
+    total_steps: int = 100
+    frames_per_batch: int = 1024
+    buffer_capacity: int = 1_000_000
+    prioritized: bool = False
+    n_step: int | None = None
+    gamma: float = 0.99
+    log_interval: int = 10
+    logger: Node | None = None
+    extra: dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class DQNRecipe(Recipe):
+    TARGET = "trainer/dqn"
+    env: EnvNode = field(default_factory=lambda: EnvNode("env/cartpole", num_envs=8))
+    total_steps: int = 100
+    frames_per_batch: int = 512
+    buffer_capacity: int = 1_000_000
+    prioritized: bool = True
+    n_step: int | None = 3
+    gamma: float = 0.99
+    eps_init: float = 1.0
+    eps_end: float = 0.05
+    annealing_num_steps: int = 100_000
+    log_interval: int = 10
+    logger: Node | None = None
+    extra: dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class TD3Recipe(Recipe):
+    TARGET = "trainer/td3"
+    env: EnvNode = field(default_factory=lambda: EnvNode("env/pendulum", num_envs=8))
+    total_steps: int = 100
+    frames_per_batch: int = 1024
+    buffer_capacity: int = 1_000_000
+    gamma: float = 0.99
+    exploration_sigma: float = 0.1
+    log_interval: int = 10
+    logger: Node | None = None
+    extra: dict[str, Any] = field(default_factory=dict)
+
+
+RECIPES = {r.TARGET: r for r in (PPORecipe, A2CRecipe, SACRecipe, DQNRecipe, TD3Recipe)}
+
+
+def as_node(v: Any) -> Any:
+    """Lower dataclass views (Recipe/EnvNode/Node) into plain node trees."""
+    if hasattr(v, "as_node"):
+        return v.as_node()
+    if isinstance(v, dict):
+        return {k: as_node(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [as_node(x) for x in v]
+    return v
+
+
+def from_node(node: dict) -> Recipe:
+    """Lift a trainer node tree back into its typed Recipe (round-trip)."""
+    cls = RECIPES[node["_target_"]]
+    names = {f.name for f in dataclasses.fields(cls)}
+    kw: dict[str, Any] = {}
+    extra: dict[str, Any] = {}
+    for k, v in node.items():
+        if k == "_target_":
+            continue
+        if k == "env":
+            kw["env"] = _env_from_node(v)
+        elif k == "logger" and isinstance(v, dict):
+            kw["logger"] = Node(v["_target_"], {x: y for x, y in v.items() if x != "_target_"})
+        elif k in names:
+            kw[k] = v
+        else:
+            extra[k] = v
+    return cls(extra=extra, **kw)
+
+
+def _env_from_node(node: dict) -> EnvNode:
+    transforms: list[Node] = []
+    num_envs = 0
+    if node.get("_target_") == "env/transformed":
+        tf = node["transform"]
+        ts = tf["transforms"] if tf.get("_target_") == "transform/compose" else [tf]
+        transforms = [
+            Node(t["_target_"], {k: v for k, v in t.items() if k != "_target_"}) for t in ts
+        ]
+        node = node["env"]
+    if node.get("_target_") == "env/vmap":
+        num_envs = node["num_envs"]
+        node = node["env"]
+    kwargs = {k: v for k, v in node.items() if k != "_target_"}
+    return EnvNode(node["_target_"], num_envs=num_envs, transforms=transforms, kwargs=kwargs)
+
+
+def dump_yaml(recipe: Recipe, path: str) -> None:
+    import yaml
+
+    with open(path, "w") as f:
+        yaml.safe_dump({"trainer": recipe.as_node()}, f, sort_keys=False)
+
+
+def load_recipe(path: str):
+    """YAML recipe file -> ready-to-run Trainer (the YAML-alone driver path)."""
+    cfg = load_yaml(path)
+    return instantiate(cfg["trainer"] if "trainer" in cfg else cfg)

@@ -20,23 +20,23 @@ from ..base import EnvBase
 
 __all__ = ["TicTacToeEnv"]
 
-_LINES = jnp.asarray(
-    [
-        [0, 1, 2],
-        [3, 4, 5],
-        [6, 7, 8],
-        [0, 3, 6],
-        [1, 4, 7],
-        [2, 5, 8],
-        [0, 4, 8],
-        [2, 4, 6],
-    ]
-)
+# plain nested list: a module-level jnp.asarray would initialize the JAX
+# backend at import time (breaks the driver's platform forcing)
+_LINES = [
+    [0, 1, 2],
+    [3, 4, 5],
+    [6, 7, 8],
+    [0, 3, 6],
+    [1, 4, 7],
+    [2, 5, 8],
+    [0, 4, 8],
+    [2, 4, 6],
+]
 
 
 def _winner(board):
     """+1 / -1 if that player completed a line, else 0."""
-    sums = board[_LINES].sum(axis=-1)
+    sums = board[jnp.asarray(_LINES)].sum(axis=-1)
     return jnp.where(
         jnp.any(sums == 3), 1, jnp.where(jnp.any(sums == -3), -1, 0)
     ).astype(jnp.int32)

@@ -37,6 +37,7 @@ class PettingZooWrapper:
     def __init__(self, env):
         self.env = env
         self._acc: dict = {}
+        self._saw_term = False
         # AEC envs expose per-agent ``observe``; parallel envs do not
         self.is_parallel = not hasattr(env, "observe")
         self.agents = list(env.possible_agents)
@@ -116,6 +117,7 @@ class PettingZooWrapper:
 
     def reset(self, seed: int | None = None) -> dict:
         self._acc = {a: 0.0 for a in self.agents}
+        self._saw_term = False  # any true termination this episode (AEC)
         if self.is_parallel:
             obs, _ = self.env.reset(seed=seed)
             return self._stack_parallel(obs)
@@ -142,6 +144,9 @@ class PettingZooWrapper:
             self._acc[ag] = self._acc.get(ag, 0.0) + float(r)
         reward = self._acc.get(agent, 0.0)
         self._acc[agent] = 0.0
+        # accumulate: pettingzoo deletes a dead agent's dict entries once it
+        # is removed, so the final step can no longer see who terminated
+        self._saw_term = self._saw_term or any(self.env.terminations.values())
         trunc = bool(self.env.truncations.get(agent, False))
         done_all = not self.env.agents or all(
             self.env.terminations.get(a, False) or self.env.truncations.get(a, False)
@@ -149,7 +154,9 @@ class PettingZooWrapper:
         )
         if done_all:
             obs = self._aec_obs() if self.env.agents else self._terminal_obs()
-            return obs, reward, True, trunc
+            # terminated only if some agent truly terminated; a pure
+            # time-limit end must stay truncation-only (bootstrap survives)
+            return obs, reward, self._saw_term, trunc or not self._saw_term
         return self._aec_obs(), reward, False, trunc
 
     def _terminal_obs(self) -> dict:
@@ -205,13 +212,15 @@ class PettingZooWrapper:
         }
         obs, rewards, terms, truncs, _ = self.env.step(acts)
         reward = float(sum(rewards.values()))
-        # slot 3 of the host protocol is TERMINATED (cuts value bootstrap);
-        # a pure time-limit cut must stay truncation-only
-        term = bool(all(terms.values())) if terms else False
-        trunc = bool(all(truncs.values())) if truncs else False
-        if not obs:
-            return self._terminal_obs(), reward, term, trunc or not term
-        return self._stack_parallel(obs), reward, term, trunc
+        if not obs:  # episode over for every agent
+            # slot 3 of the host protocol is TERMINATED (cuts value
+            # bootstrap): ANY true termination must cut it, even if other
+            # agents were only truncated; a pure time-limit end stays
+            # truncation-only
+            term = bool(any(terms.values()))
+            trunc = bool(any(truncs.values())) or not term
+            return self._terminal_obs(), reward, term, trunc
+        return self._stack_parallel(obs), reward, False, False
 
     def close(self) -> None:
         self.env.close()

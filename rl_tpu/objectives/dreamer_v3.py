@@ -49,6 +49,8 @@ class DreamerV3ModelLoss(LossModule):
         return {"rssm": self.rssm.init(key)}
 
     def __call__(self, params, batch: ArrayDict, key=None):
+        if key is None:
+            raise ValueError("DreamerV3ModelLoss requires a PRNG key")
         cfg = self.rssm.cfg
         out = self.rssm.observe(
             params["rssm"],
@@ -147,12 +149,13 @@ class DreamerV3ActorLoss(LossModule):
             "compose params externally: {'actor','rssm','value','return_scale'}"
         )
 
-    def __call__(self, params, batch: ArrayDict, key=None):
-        if key is None:
-            raise ValueError("DreamerV3ActorLoss requires a PRNG key")
+    def imagine(self, params, batch: ArrayDict, key):
+        """One imagined rollout from the batch's posterior states. Compute it
+        once per train step and pass to BOTH the actor and value losses via
+        ``traj=`` — imagination dominates a Dreamer step's cost."""
         h0 = jax.lax.stop_gradient(batch["h"].reshape(-1, batch["h"].shape[-1]))
         z0 = jax.lax.stop_gradient(batch["z"].reshape(-1, batch["z"].shape[-1]))
-        traj = imagine_rollout_v3(
+        return imagine_rollout_v3(
             self.rssm,
             hold_out(params["rssm"]),
             self.actor,
@@ -162,6 +165,12 @@ class DreamerV3ActorLoss(LossModule):
             self.horizon,
             key,
         )
+
+    def __call__(self, params, batch: ArrayDict, key=None, traj=None):
+        if traj is None:
+            if key is None:
+                raise ValueError("DreamerV3ActorLoss requires a PRNG key")
+            traj = self.imagine(params, batch, key)
         feat = jnp.concatenate([traj["h"], traj["z"]], axis=-1)
         value_logits = self.value_fn(hold_out(params["value"]), feat)
         value = twohot_decode(value_logits, self.rssm.bins)
@@ -228,21 +237,25 @@ class DreamerV3ValueLoss(LossModule):
             "compose params externally: {'actor','rssm','value','slow_value'}"
         )
 
-    def __call__(self, params, batch: ArrayDict, key=None):
-        if key is None:
-            raise ValueError("DreamerV3ValueLoss requires a PRNG key")
-        h0 = jax.lax.stop_gradient(batch["h"].reshape(-1, batch["h"].shape[-1]))
-        z0 = jax.lax.stop_gradient(batch["z"].reshape(-1, batch["z"].shape[-1]))
-        traj = imagine_rollout_v3(
-            self.rssm,
-            hold_out(params["rssm"]),
-            lambda p, td, k: self.actor(hold_out(p), td, k),
-            params["actor"],
-            h0,
-            z0,
-            self.horizon,
-            key,
-        )
+    def __call__(self, params, batch: ArrayDict, key=None, traj=None):
+        """``traj``: reuse the actor loss's imagined rollout (everything the
+        value loss reads from it is stop-gradient'd below, so sharing is
+        exact); without it, re-rolls imagination from the batch posterior."""
+        if traj is None:
+            if key is None:
+                raise ValueError("DreamerV3ValueLoss requires a PRNG key")
+            h0 = jax.lax.stop_gradient(batch["h"].reshape(-1, batch["h"].shape[-1]))
+            z0 = jax.lax.stop_gradient(batch["z"].reshape(-1, batch["z"].shape[-1]))
+            traj = imagine_rollout_v3(
+                self.rssm,
+                hold_out(params["rssm"]),
+                lambda p, td, k: self.actor(hold_out(p), td, k),
+                params["actor"],
+                h0,
+                z0,
+                self.horizon,
+                key,
+            )
         feat = jax.lax.stop_gradient(
             jnp.concatenate([traj["h"], traj["z"]], axis=-1)
         )

@@ -387,3 +387,41 @@ class TestMultiStepActorWrapper:
         cstate = coll.init(KEY)
         batch, cstate = coll.collect({}, cstate)
         assert batch["action"].shape == (4, 3, 2)
+
+
+class TestDreamerV3SharedTraj:
+    def test_shared_traj_matches_rerolled(self):
+        """value loss fed the actor's imagined traj == its own same-key roll."""
+        cfg, rssm, actor, value_mlp, value_fn = TestDreamerV3()._models()
+        model_loss = DreamerV3ModelLoss(rssm)
+        batch = _v3_batch(cfg)
+        rssm_params = model_loss.init_params(KEY, batch)["rssm"]
+        out = rssm.observe(rssm_params, batch["observation"], batch["action"], batch["is_first"], KEY)
+        feat_dim = cfg.deter_dim + cfg.stoch_dim
+        td0 = ArrayDict(h=jnp.zeros((1, cfg.deter_dim)), z=jnp.zeros((1, cfg.stoch_dim)))
+        vparams = value_mlp.init(KEY, jnp.zeros((1, feat_dim)))
+        params = {
+            "actor": actor.init(KEY, td0),
+            "rssm": rssm_params,
+            "value": vparams,
+            "slow_value": jax.tree.map(jnp.copy, vparams),
+            "return_scale": jnp.asarray(1.0),
+        }
+        ab = ArrayDict(h=out["h"], z=out["z"])
+        a_loss = DreamerV3ActorLoss(rssm, actor, value_fn, horizon=4)
+        v_loss = DreamerV3ValueLoss(rssm, actor, value_fn, horizon=4)
+        traj = a_loss.imagine(params, ab, KEY)
+        l_shared, _ = v_loss(params, ab, traj=traj)
+        l_rolled, _ = v_loss(params, ab, key=KEY)
+        assert abs(float(l_shared) - float(l_rolled)) < 1e-5
+        # and the actor loss accepts the same traj
+        l_a, _ = DreamerV3ActorLoss(rssm, actor, value_fn, horizon=4)(params, ab, traj=traj)
+        assert np.isfinite(float(l_a))
+
+    def test_model_loss_requires_key(self):
+        cfg, rssm, *_ = TestDreamerV3()._models()
+        batch = _v3_batch(cfg)
+        loss = DreamerV3ModelLoss(rssm)
+        params = loss.init_params(KEY, batch)
+        with pytest.raises(ValueError, match="PRNG key"):
+            loss(params, batch)
