@@ -38,6 +38,7 @@ class LLMCollector:
         eos_id: int | None = None,
         ref_params: Any = None,
         weight_scheme: Any = None,
+        reward_transform: Callable | None = None,
     ):
         self.env = env
         self.model = model
@@ -47,6 +48,9 @@ class LLMCollector:
         self.eos_id = eos_id
         self.ref_params = ref_params
         self.weight_scheme = weight_scheme
+        # (rewards, batch_arrays) -> rewards, applied BEFORE group advantages
+        # (KLRewardTransform / PolicyVersion — reference envs/llm/transforms/)
+        self.reward_transform = reward_transform
 
         self._gen = jax.jit(
             lambda params, toks, mask, key: generate(
@@ -85,21 +89,25 @@ class LLMCollector:
         P_len = toks.shape[1]
         T = P_len + self.max_new_tokens
         gid = jnp.asarray(group_ids)
-        adv = mc_advantage(jnp.asarray(rewards), gid, self.num_prompts)
 
-        batch = ArrayDict(
-            tokens=out.tokens,
-            attention_mask=out.full_mask[:, :T].astype(jnp.float32),
-            assistant_mask=jnp.concatenate(
+        arrays: dict = {
+            "tokens": out.tokens,
+            "attention_mask": out.full_mask[:, :T].astype(jnp.float32),
+            "assistant_mask": jnp.concatenate(
                 [jnp.zeros((G, P_len), bool), out.response_mask], axis=1
             ),
-            sample_log_prob=jnp.concatenate(
+            "sample_log_prob": jnp.concatenate(
                 [jnp.zeros((G, P_len)), out.response_log_probs], axis=1
             ),
-            advantage=adv,
-            reward=jnp.asarray(rewards),
-            group_id=gid,
-        )
+            "group_id": gid,
+        }
         if self.ref_params is not None:
-            batch = batch.set("ref_log_prob", self._ref_lp(batch["tokens"], batch["attention_mask"]))
-        return batch
+            arrays["ref_log_prob"] = self._ref_lp(
+                arrays["tokens"], arrays["attention_mask"]
+            )
+        if self.reward_transform is not None:
+            rewards = np.asarray(self.reward_transform(rewards, arrays))
+        # advantages AFTER reward shaping, same ordering as the reference's
+        # in-env KLRewardTransform (the estimator sees the shaped reward)
+        adv = mc_advantage(jnp.asarray(rewards), gid, self.num_prompts)
+        return ArrayDict(advantage=adv, reward=jnp.asarray(rewards), **arrays)

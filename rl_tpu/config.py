@@ -100,8 +100,11 @@ _BUILTINS: dict[str, str] = {}
 def _snake(name: str) -> str:
     import re
 
-    # lower→Upper and UPPER→Upper-lower boundaries only (A2C→a2c, TD3→td3)
-    return re.sub(r"(?<=[a-z])(?=[A-Z])|(?<=[A-Z])(?=[A-Z][a-z])", "_", name).lower()
+    # lower→Upper, UPPER→Upper-lower and digit→Upper-lower boundaries
+    # (A2C→a2c, TD3→td3, DreamerV3Actor→dreamer_v3_actor)
+    return re.sub(
+        r"(?<=[a-z])(?=[A-Z])|(?<=[A-Z0-9])(?=[A-Z][a-z])", "_", name
+    ).lower()
 
 
 def _add_group(group: str, module: str, names: Sequence[str], strip: str = "") -> None:
@@ -206,9 +209,6 @@ _BUILTINS.update({
     "loss/td3_bc": "rl_tpu.objectives.TD3BCLoss",
     "loss/c51": "rl_tpu.objectives.DistributionalDQNLoss",
     "loss/kl_pen_ppo": "rl_tpu.objectives.KLPENPPOLoss",
-    "loss/dreamer_v3_actor": "rl_tpu.objectives.DreamerV3ActorLoss",
-    "loss/dreamer_v3_model": "rl_tpu.objectives.DreamerV3ModelLoss",
-    "loss/dreamer_v3_value": "rl_tpu.objectives.DreamerV3ValueLoss",
     "model/rssm_v3": "rl_tpu.models.RSSMv3",
     "sampler/without_replacement": "rl_tpu.data.SamplerWithoutReplacement",
     "buffer/replay": "rl_tpu.data.ReplayBuffer",
@@ -226,4 +226,14 @@ _BUILTINS.update({
     "trainer/td3": "rl_tpu.trainers.make_td3_trainer",
     "trainer/iql_offline": "rl_tpu.trainers.train_iql",
     "trainer/cql_offline": "rl_tpu.trainers.train_cql",
+    "trainer/grpo": "rl_tpu.trainers.GRPOTrainer",
+    "tokenizer/simple": "rl_tpu.data.llm.SimpleTokenizer",
+    "dataset/arithmetic": "rl_tpu.envs.llm.arithmetic_dataset",
+    "dataset/copy": "rl_tpu.envs.llm.copy_dataset",
+    "scorer/exact_match": "rl_tpu.envs.llm.ExactMatchScorer",
+    "scorer/sum": "rl_tpu.envs.llm.SumScorer",
+    "scorer/format": "rl_tpu.envs.llm.FormatScorer",
+    "llm_transform/kl_reward": "rl_tpu.envs.llm.KLRewardTransform",
+    "llm_transform/policy_version": "rl_tpu.envs.llm.PolicyVersion",
+    "llm_transform/python_tool": "rl_tpu.envs.llm.PythonToolTransform",
 })

@@ -1,3 +1,4 @@
 from .history import History, Message
+from .tokenizer import SimpleTokenizer
 
-__all__ = ["History", "Message"]
+__all__ = ["History", "Message", "SimpleTokenizer"]

@@ -212,14 +212,21 @@ class PettingZooWrapper:
         }
         obs, rewards, terms, truncs, _ = self.env.step(acts)
         reward = float(sum(rewards.values()))
-        if not obs:  # episode over for every agent
+        # standard parallel envs return the FINAL obs together with the done
+        # flags (agents may or may not already be dropped from env.agents)
+        done = not self.env.agents or (
+            bool(terms)
+            and all(terms.get(a, False) or truncs.get(a, False) for a in terms)
+        )
+        if done:
             # slot 3 of the host protocol is TERMINATED (cuts value
             # bootstrap): ANY true termination must cut it, even if other
             # agents were only truncated; a pure time-limit end stays
             # truncation-only
             term = bool(any(terms.values()))
             trunc = bool(any(truncs.values())) or not term
-            return self._terminal_obs(), reward, term, trunc
+            final = self._stack_parallel(obs) if obs else self._terminal_obs()
+            return final, reward, term, trunc
         return self._stack_parallel(obs), reward, False, False
 
     def close(self) -> None:

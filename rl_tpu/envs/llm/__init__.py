@@ -1,3 +1,19 @@
 from .chat import ChatEnv, DatasetChatEnv
+from .datasets import QADataset, arithmetic_dataset, copy_dataset
+from .reward import ExactMatchScorer, FormatScorer, SumScorer, combine_scorers
+from .transforms import KLRewardTransform, PolicyVersion, PythonToolTransform
 
-__all__ = ["ChatEnv", "DatasetChatEnv"]
+__all__ = [
+    "ChatEnv",
+    "DatasetChatEnv",
+    "QADataset",
+    "arithmetic_dataset",
+    "copy_dataset",
+    "ExactMatchScorer",
+    "FormatScorer",
+    "SumScorer",
+    "combine_scorers",
+    "KLRewardTransform",
+    "PolicyVersion",
+    "PythonToolTransform",
+]

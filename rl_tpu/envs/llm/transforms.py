@@ -1,0 +1,115 @@
+"""Batch-level transforms for the RLHF collection path.
+
+Redesign of the reference's LLM transform layer (reference:
+torchrl/envs/llm/transforms/kl.py:159 ``KLRewardTransform`` — subtracts
+β·KL(π‖π_ref) from the env reward inside the transformed env;
+policy_version.py ``PolicyVersion``; tools.py ``PythonInterpreter`` tool
+execution). Here collection is a single jitted generate over left-padded
+batches, so reward shaping naturally lives on the collected batch: an
+``LLMCollector(reward_transform=...)`` hook applied BEFORE group advantages
+are computed (same ordering as the reference, where the transform rewrites
+the reward the estimator sees).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Callable
+
+import numpy as np
+
+__all__ = ["KLRewardTransform", "PolicyVersion", "PythonToolTransform"]
+
+
+class KLRewardTransform:
+    """reward_i -= coeff * Σ_t (log π(a_t) − log π_ref(a_t)) over response
+    tokens — the sequence-level KL(π‖π_ref) estimate (reference kl.py:159).
+
+    Called by LLMCollector with the full pre-advantage batch arrays; needs
+    the collector's ``ref_params`` so ``ref_log_prob`` is present.
+    """
+
+    def __init__(self, coeff: float = 0.1, clip: float | None = 20.0):
+        self.coeff = coeff
+        self.clip = clip
+
+    def __call__(self, rewards: np.ndarray, batch: dict) -> np.ndarray:
+        if "ref_log_prob" not in batch:
+            raise ValueError(
+                "KLRewardTransform needs ref_log_prob: construct the "
+                "LLMCollector with ref_params="
+            )
+        lp = np.asarray(batch["sample_log_prob"])
+        ref = np.asarray(batch["ref_log_prob"])
+        mask = np.asarray(batch["assistant_mask"], bool)
+        delta = np.where(mask, lp - ref, 0.0)
+        if self.clip is not None:
+            delta = np.clip(delta, -self.clip, self.clip)
+        return np.asarray(rewards) - self.coeff * delta.sum(axis=1)
+
+
+class PolicyVersion:
+    """Stamp each collected batch with the policy version that generated it
+    (reference policy_version.py) — staleness accounting for async training:
+    the trainer bumps on every weight push, samplers can gate on the lag.
+    """
+
+    def __init__(self):
+        self.version = 0
+
+    def bump(self) -> int:
+        self.version += 1
+        return self.version
+
+    def __call__(self, rewards: np.ndarray, batch: dict) -> np.ndarray:
+        batch["policy_version"] = np.full(len(rewards), self.version, np.int32)
+        return rewards
+
+
+class PythonToolTransform:
+    """Execute fenced ``python`` blocks in assistant turns and append the
+    output as a tool message (reference transforms/tools.py PythonInterpreter
+    — subprocess-isolated there, restricted eval here: zero-egress images
+    can't spawn arbitrary interpreters safely inside the collector loop).
+
+    Host-side, used by multi-turn ChatEnv loops: ``env.step`` calls this on
+    each new assistant turn; expressions only (no statements/imports).
+    """
+
+    _RX = re.compile(r"```python\n(.*?)```", re.DOTALL)
+    _SAFE = {"abs": abs, "min": min, "max": max, "sum": sum, "len": len,
+             "round": round, "range": range, "sorted": sorted}
+
+    @classmethod
+    def _check(cls, tree) -> None:
+        """Reject attribute traversal and dunder names: ``().__class__...``
+        escapes survive an empty ``__builtins__`` — expressions must stay on
+        the arithmetic/collection/allowlisted-call subset."""
+        import ast
+
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Attribute):
+                raise ValueError("attribute access is not allowed")
+            if isinstance(node, ast.Name) and node.id.startswith("_"):
+                raise ValueError(f"name {node.id!r} is not allowed")
+
+    def run(self, code: str) -> str:
+        import ast
+
+        try:
+            tree = ast.parse(code.strip(), "<tool>", mode="eval")
+            self._check(tree)
+            return repr(eval(compile(tree, "<tool>", "eval"),
+                             {"__builtins__": {}}, dict(self._SAFE)))
+        except Exception as e:  # noqa: BLE001 - tool errors go to the model
+            return f"error: {type(e).__name__}: {e}"
+
+    def __call__(self, history):
+        m = history.last
+        if m is None or m.role != "assistant":
+            return history
+        blocks = self._RX.findall(m.content)
+        if not blocks:
+            return history
+        out = "\n".join(self.run(b) for b in blocks)
+        return history.append("tool", out)

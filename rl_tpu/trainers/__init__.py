@@ -37,4 +37,8 @@ def __getattr__(name):
         from . import algorithms as _alg
 
         return getattr(_alg, name)
+    if name == "GRPOTrainer":
+        from .grpo import GRPOTrainer
+
+        return GRPOTrainer
     raise AttributeError(name)

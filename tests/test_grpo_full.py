@@ -1,0 +1,199 @@
+"""End-to-end GRPO/RLHF recipe tests (VERDICT round-1 item 5).
+
+Strategy mirrors the reference's GRPO test split (reference
+test/llm/test_objectives.py + sota-implementations/grpo): unit-test each
+seam (tokenizer round-trip, scorers, KL shaping, tool transform), then one
+slow learning test where reward must rise, and a mesh test where the
+training forward runs ring attention.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from rl_tpu.data.llm import History, SimpleTokenizer
+from rl_tpu.envs.llm import (
+    ExactMatchScorer,
+    FormatScorer,
+    KLRewardTransform,
+    PolicyVersion,
+    PythonToolTransform,
+    SumScorer,
+    arithmetic_dataset,
+    combine_scorers,
+    copy_dataset,
+)
+
+
+class TestTokenizer:
+    def test_roundtrip(self):
+        corpus = ["3+5=", "8", "copy: a b c =", "hello world"]
+        tok = SimpleTokenizer(corpus)
+        for s in corpus + ["5+3=", "world hello", "b c a"]:
+            assert tok.decode(tok.encode(s)) == s
+
+    def test_specials(self):
+        tok = SimpleTokenizer(["ab"])
+        assert tok.pad_token_id == 0 and tok.eos_token_id == 2
+        assert tok.decode([tok.BOS, *tok.encode("ab"), tok.EOS]) == "ab"
+
+    def test_unknown_chars_degrade(self):
+        tok = SimpleTokenizer(["abc"])
+        ids = tok.encode("azb")  # z untrained -> UNK
+        assert tok.UNK in ids
+
+
+class TestDatasets:
+    def test_arithmetic_answers(self):
+        ds = arithmetic_dataset(50, max_operand=5, seed=3)
+        for q, a in ds.items:
+            x, y = q[:-1].split("+")
+            assert int(a) == int(x) + int(y)
+        assert len(ds.prompts) == 50 and ds.prompts[0].messages[-1].role == "user"
+
+    def test_copy_dataset(self):
+        ds = copy_dataset(10, length=2)
+        for q, a in ds.items:
+            assert q == f"copy: {a} ="
+
+
+class TestScorers:
+    def _h(self, q, resp):
+        return History.from_chats([[{"role": "user", "content": q}]])[0].append(
+            "assistant", resp
+        )
+
+    def test_exact_match(self):
+        s = ExactMatchScorer({"2+2=": "4"})
+        assert s(self._h("2+2=", "4"), None) == 1.0
+        assert s(self._h("2+2=", " 4 "), None) == 1.0  # stripped
+        assert s(self._h("2+2=", "the answer is 4"), None) == pytest.approx(0.2)
+        assert s(self._h("2+2=", "5"), None) == 0.0
+        assert s(self._h("9+9=", "4"), None) == 0.0  # unknown question
+
+    def test_sum_scorer_dense(self):
+        s = SumScorer({"2+2=": "4"})
+        assert s(self._h("2+2=", "4"), None) == 1.0
+        assert s(self._h("2+2=", "6"), None) == pytest.approx(1 / 3)
+        assert s(self._h("2+2=", "x"), None) == 0.0
+
+    def test_format_and_combine(self):
+        f = FormatScorer(r"^A:", reward=0.3)
+        c = combine_scorers(ExactMatchScorer({"q": "a"}), f, weights=[1.0, 1.0])
+        assert c(self._h("q", "A: nope"), None) == pytest.approx(0.3)
+
+
+class TestKLRewardTransform:
+    def test_penalty_applied_on_masked_tokens_only(self):
+        kl = KLRewardTransform(coeff=0.5, clip=None)
+        batch = {
+            "sample_log_prob": np.array([[0.0, -1.0, -1.0], [0.0, -2.0, -1.0]]),
+            "ref_log_prob": np.array([[0.0, -2.0, -3.0], [0.0, -2.0, -4.0]]),
+            "assistant_mask": np.array([[0, 1, 1], [0, 0, 1]], bool),
+        }
+        out = kl(np.array([1.0, 1.0]), batch)
+        # row0: (−1+2)+(−1+3)=3 → 1−0.5*3 ; row1: (−1+4)=3 → 1−0.5*3
+        np.testing.assert_allclose(out, [1 - 1.5, 1 - 1.5])
+
+    def test_requires_ref(self):
+        with pytest.raises(ValueError, match="ref_log_prob"):
+            KLRewardTransform()(np.zeros(1), {"sample_log_prob": np.zeros((1, 2))})
+
+    def test_policy_version_stamps(self):
+        pv = PolicyVersion()
+        pv.bump(), pv.bump()
+        b: dict = {}
+        r = pv(np.zeros(3), b)
+        assert list(b["policy_version"]) == [2, 2, 2] and r.shape == (3,)
+
+
+class TestPythonTool:
+    def test_executes_fenced_block(self):
+        h = History.from_chats([[{"role": "user", "content": "calc"}]])[0].append(
+            "assistant", "```python\nsum(range(5))\n```"
+        )
+        h2 = PythonToolTransform()(h)
+        assert h2.last.role == "tool" and h2.last.content == "10"
+
+    def test_no_builtins_escape(self):
+        h = History([]).append("assistant", "```python\n__import__('os')\n```")
+        out = PythonToolTransform()(h).last.content
+        assert "error" in out
+
+    def test_attribute_traversal_blocked(self):
+        # the classic empty-__builtins__ escape must be rejected at the AST
+        code = ("[c for c in ().__class__.__base__.__subclasses__()"
+                " if c.__name__=='Popen'][0]")
+        h = History([]).append("assistant", f"```python\n{code}\n```")
+        out = PythonToolTransform()(h).last.content
+        assert "error" in out and "attribute" in out
+
+    def test_no_block_no_append(self):
+        h = History([]).append("assistant", "no code here")
+        assert PythonToolTransform()(h) is h
+
+
+def _tiny_trainer(mesh=None, **kw):
+    from rl_tpu.trainers.grpo import GRPOTrainer
+
+    ds = arithmetic_dataset(n=64, max_operand=2)
+    defaults = dict(num_prompts=4, group_repeats=4, max_prompt_len=8,
+                    max_new_tokens=4, learning_rate=3e-3, kl_coeff=0.005)
+    defaults.update(kw)
+    return GRPOTrainer(ds, mesh=mesh, **defaults)
+
+
+class TestGRPOTrainer:
+    def test_step_produces_finite_metrics_and_versions(self):
+        t = _tiny_trainer()
+        m1 = t.step()
+        m2 = t.step()
+        assert np.isfinite(m1["loss"]) and np.isfinite(m2["reward"])
+        assert t.policy_version.version == 2
+
+    def test_evaluate_returns_accuracy(self):
+        t = _tiny_trainer()
+        acc = t.evaluate(num_prompts=8)
+        assert 0.0 <= acc <= 1.0
+
+    @pytest.mark.mesh
+    def test_ring_attention_training_forward(self, mesh8):
+        """full train step with the sequence ring-sharded 4 ways (ctx axis)."""
+        from rl_tpu.parallel import make_mesh
+
+        mesh = make_mesh(data=1, context=4, devices=jax.devices()[:4])
+        t = _tiny_trainer(mesh=mesh, max_prompt_len=8, max_new_tokens=8)
+        m = t.step()
+        assert np.isfinite(m["loss"])
+
+    @pytest.mark.mesh
+    def test_ring_matches_local_logits(self, mesh8):
+        """teacher-forced log-probs: ring forward == local forward."""
+        from rl_tpu.models import token_log_probs
+        from rl_tpu.parallel import make_mesh
+
+        mesh = make_mesh(data=1, context=4, devices=jax.devices()[:4])
+        t_local = _tiny_trainer()
+        t_ring = _tiny_trainer(mesh=mesh)
+        # same seed -> same params; score the same batch through both
+        key = jax.random.key(7)
+        batch = t_local.collector.collect(t_local.params, key)
+        lp_local = token_log_probs(
+            t_local.train_model, t_local.params, batch["tokens"], batch["attention_mask"]
+        )
+        rb = jax.device_put(batch, t_ring._mesh_replicated)
+        lp_ring = token_log_probs(
+            t_ring.train_model, t_ring.params, rb["tokens"], rb["attention_mask"]
+        )
+        np.testing.assert_allclose(
+            np.asarray(lp_local), np.asarray(lp_ring), atol=2e-4
+        )
+
+    @pytest.mark.slow
+    def test_reward_rises(self):
+        """the VERDICT item-5 'done' bar: reward rises over ~50 steps."""
+        t = _tiny_trainer(num_prompts=8, group_repeats=8)
+        t.train(60)
+        h = t.history["reward"]
+        assert np.mean(h[-10:]) > np.mean(h[:10]) + 0.1, h
