@@ -26,7 +26,99 @@ import numpy as np
 from ..data import ArrayDict
 from ..utils.seeding import seed_generator
 
-__all__ = ["ThreadedEnvPool", "HostCollector"]
+__all__ = ["ThreadedEnvPool", "ProcessEnvPool", "HostCollector"]
+
+
+def _process_env_worker(env_fn, conn):
+    """One env per process; command protocol over the pipe (reference:
+    torchrl/envs/batched_envs.py:1805 ParallelEnv worker loop)."""
+    env = env_fn()
+    try:
+        while True:
+            cmd, arg = conn.recv()
+            if cmd == "reset":
+                conn.send(env.reset(seed=arg))
+            elif cmd == "step":
+                conn.send(env.step(arg))
+            elif cmd == "specs":
+                conn.send((env.observation_spec, env.action_spec))
+            elif cmd == "close":
+                try:
+                    env.close()
+                finally:
+                    conn.send(None)
+                break
+    except (EOFError, KeyboardInterrupt):
+        pass
+
+
+class ProcessEnvPool:
+    """N host envs in worker processes — the fallback for Python-heavy sims
+    that hold the GIL (reference ParallelEnv's mp workers; ThreadedEnvPool
+    covers GIL-releasing C sims).
+
+    Same surface as :class:`ThreadedEnvPool` (reset/step_wait/async pair).
+    ``ctx="fork"`` by default: workers must not touch JAX (env code only);
+    use ``ctx="spawn"`` with picklable top-level ``env_fns`` otherwise.
+    """
+
+    def __init__(self, env_fns, ctx: str = "fork"):
+        import multiprocessing as mp
+
+        mctx = mp.get_context(ctx)
+        self.num_envs = len(env_fns)
+        self._conns = []
+        self._procs = []
+        for fn in env_fns:
+            parent, child = mctx.Pipe()
+            p = mctx.Process(
+                target=_process_env_worker, args=(fn, child), daemon=True
+            )
+            p.start()
+            child.close()
+            self._conns.append(parent)
+            self._procs.append(p)
+        self._conns[0].send(("specs", None))
+        self.observation_spec, self.action_spec = self._conns[0].recv()
+
+    def reset(self, seed: int = 0) -> list[dict]:
+        s = seed
+        for c in self._conns:
+            c.send(("reset", s))
+            s = seed_generator(s)
+        return [c.recv() for c in self._conns]
+
+    def async_step_send(self, i: int, action) -> None:
+        self._conns[i].send(("step", action))
+
+    def async_step_recv(self, i: int):
+        return self._conns[i].recv()
+
+    def step_wait(self, actions) -> list[tuple]:
+        for i in range(self.num_envs):
+            self.async_step_send(i, actions[i])
+        return [self.async_step_recv(i) for i in range(self.num_envs)]
+
+    def reset_one(self, i: int, seed: int) -> dict:
+        self._conns[i].send(("reset", seed))
+        return self._conns[i].recv()
+
+    def alive(self) -> list[bool]:
+        """Worker liveness (feed a rl_tpu.comm.liveness.Watchdog)."""
+        return [p.is_alive() for p in self._procs]
+
+    def close(self) -> None:
+        for c, p in zip(self._conns, self._procs):
+            try:
+                c.send(("close", None))
+                c.recv()
+            except (BrokenPipeError, EOFError):
+                pass
+            c.close()
+        for p in self._procs:
+            p.join(timeout=5)
+            if p.is_alive():
+                p.terminate()
 
 
 class ThreadedEnvPool:
@@ -74,6 +166,9 @@ class ThreadedEnvPool:
             self.async_step_send(i, actions[i])
         return [self.async_step_recv(i) for i in range(self.num_envs)]
 
+    def reset_one(self, i: int, seed: int) -> dict:
+        return self.envs[i].reset(seed=seed)
+
     def close(self) -> None:
         for e in self.envs:
             e.close()
@@ -96,8 +191,13 @@ class HostCollector:
         policy: Callable | None = None,
         frames_per_batch: int = 1024,
         seed: int = 0,
+        interruptor: Any = None,
     ):
         self.pool = pool
+        # preemption (reference _Interruptor, collectors/_constants.py:53):
+        # when raised mid-collection, remaining steps are padded and masked
+        # out via "collected_mask" so the batch shape stays static for jit
+        self.interruptor = interruptor
         self.policy = jax.jit(policy) if policy is not None else None
         n = pool.num_envs
         if frames_per_batch % n:
@@ -117,6 +217,12 @@ class HostCollector:
             self._obs = self.pool.reset(seed=self._seed)
         steps = []
         for _ in range(self.scan_length):
+            if (
+                steps
+                and self.interruptor is not None
+                and self.interruptor.collection_stopped()
+            ):
+                break
             td = self._stack_obs(self._obs)
             key, k_act = jax.random.split(key)
             if self.policy is None:
@@ -147,9 +253,21 @@ class HostCollector:
             for i in range(n):
                 if done[i]:
                     self._seed = seed_generator(self._seed)
-                    carry[i] = self.pool.envs[i].reset(seed=self._seed)
+                    carry[i] = self.pool.reset_one(i, self._seed)
             self._obs = carry
-        return ArrayDict.stack(steps, axis=0)
+        batch = ArrayDict.stack(steps, axis=0)
+        if self.interruptor is None:
+            return batch
+        if len(steps) < self.scan_length:
+            # preempted: pad to the static [T, N] shape, mask the tail
+            pad = self.scan_length - len(steps)
+            batch = ArrayDict.stack(steps + [steps[-1]] * pad, axis=0)
+            mask = np.zeros((self.scan_length, n), bool)
+            mask[: len(steps)] = True
+            return batch.set("collected_mask", jnp.asarray(mask))
+        return batch.set(
+            "collected_mask", jnp.ones((self.scan_length, n), bool)
+        )
 
     def iterate(self, params: Any, key: jax.Array, total_frames: int):
         collected = 0

@@ -27,7 +27,11 @@ import socketserver
 import threading
 from typing import Any, Callable, Mapping
 
+from .liveness import Interruptor, Watchdog
+
 __all__ = [
+    "Interruptor",
+    "Watchdog",
     "ServiceBackend",
     "TransportBackend",
     "service_backend",

@@ -188,6 +188,9 @@ _add_group("model", "rl_tpu.models", [
 _add_group("collector", "rl_tpu.collectors", [
     "Collector", "HostCollector", "LLMCollector",
 ], strip="Collector")
+_add_group("pool", "rl_tpu.collectors", ["ThreadedEnvPool", "ProcessEnvPool"], strip="EnvPool")
+_add_group("serve", "rl_tpu.modules", ["InferenceServer"])
+_add_group("comm", "rl_tpu.comm", ["Watchdog", "Interruptor"])
 _add_group("logger", "rl_tpu.record.loggers", [
     "CSVLogger", "TensorboardLogger", "WandbLogger", "MLFlowLogger",
     "NullLogger", "MultiLogger",
@@ -210,6 +213,7 @@ _BUILTINS.update({
     "loss/c51": "rl_tpu.objectives.DistributionalDQNLoss",
     "loss/kl_pen_ppo": "rl_tpu.objectives.KLPENPPOLoss",
     "model/rssm_v3": "rl_tpu.models.RSSMv3",
+    "postproc/reward2go": "rl_tpu.data.Reward2GoTransform",
     "sampler/without_replacement": "rl_tpu.data.SamplerWithoutReplacement",
     "buffer/replay": "rl_tpu.data.ReplayBuffer",
     "env/gym": "rl_tpu.envs.libs.gym.GymEnv",

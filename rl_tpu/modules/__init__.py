@@ -102,3 +102,5 @@ __all__ = [
 ]
 
 from .actors_extra import MultiStepActorWrapper
+from .inference_server import InferenceClient, InferenceServer
+__all__ += ["InferenceServer", "InferenceClient"]

@@ -1,0 +1,211 @@
+"""Standalone inference server: many actors, one jitted device policy.
+
+Redesign of the reference's inference server (reference:
+torchrl/modules/inference_server/_server.py:261 — queues requests from N
+actor threads/processes, batches up to ``max_batch_size`` within a wait
+window, runs the policy once, scatters replies; transports under
+inference_server/transports/). The TPU shape: requests are host pytrees,
+the batch is padded to a FIXED size so the device program compiles once,
+and the policy call is the jitted function actors share. Transports:
+
+- in-process handles (:meth:`client`) — threads post to the server queue;
+- TCP (:meth:`serve_tcp`) — remote actors query over the line-JSON control
+  plane (rl_tpu.comm.TCPCommandServer), payloads as nested lists.
+
+Weight pushes go through :meth:`update_params` (versioned); a
+:class:`~rl_tpu.comm.liveness.Watchdog` drops vanished actors.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from concurrent.futures import Future
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..data import ArrayDict
+
+__all__ = ["InferenceServer", "InferenceClient"]
+
+
+class InferenceClient:
+    """In-process actor handle: blocking ``query(obs) -> action-tree``."""
+
+    def __init__(self, server: "InferenceServer", name: str):
+        self._server = server
+        self.name = name
+
+    def query(self, obs: dict | ArrayDict, timeout: float | None = 30.0):
+        if self._server._watchdog is not None:
+            self._server._watchdog.beat(self.name)
+        fut: Future = Future()
+        self._server._queue.put((obs, fut))
+        return fut.result(timeout=timeout)
+
+
+class InferenceServer:
+    """Batch many actors' queries onto one jitted policy call.
+
+    Args:
+        policy: ``(params, td, key) -> td_out`` over a BATCHED ArrayDict
+            (leading axis = batch of actors).
+        params: initial policy params.
+        out_keys: keys of the policy output returned to actors (default
+            ``("action",)``; a single key returns the bare leaf).
+        max_batch_size: fixed device batch — requests are padded up to it
+            (one XLA program, no shape churn) and excess queues for the
+            next round.
+        max_wait_ms: after the first request arrives, wait at most this
+            long for more before launching.
+    """
+
+    def __init__(
+        self,
+        policy: Callable,
+        params: Any,
+        out_keys: tuple[str, ...] = ("action",),
+        max_batch_size: int = 32,
+        max_wait_ms: float = 2.0,
+        watchdog: Any = None,
+        seed: int = 0,
+    ):
+        self._jit_policy = jax.jit(policy)
+        self._params = params
+        self._version = 0
+        self.out_keys = tuple(out_keys)
+        self.max_batch_size = max_batch_size
+        self.max_wait = max_wait_ms / 1e3
+        self._watchdog = watchdog
+        self._queue: queue.Queue = queue.Queue()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._key = jax.random.key(seed)
+        self._clients = 0
+        self._lock = threading.Lock()
+        self._tcp = None
+
+    # -- lifecycle -------------------------------------------------------------
+
+    def start(self) -> "InferenceServer":
+        if self._thread is None:
+            self._stop.clear()
+            self._thread = threading.Thread(target=self._serve_loop, daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+        if self._tcp is not None:
+            self._tcp.shutdown()
+            self._tcp = None
+        # fail anything still queued so callers don't hang in fut.result()
+        while True:
+            try:
+                _, fut = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if not fut.done():
+                fut.set_exception(RuntimeError("inference server stopped"))
+
+    # -- weights ---------------------------------------------------------------
+
+    def update_params(self, params: Any) -> int:
+        """Swap serving weights (atomic wrt the serve loop); returns version."""
+        with self._lock:
+            self._params = params
+            self._version += 1
+            return self._version
+
+    @property
+    def version(self) -> int:
+        return self._version
+
+    # -- transports ------------------------------------------------------------
+
+    def client(self, name: str | None = None) -> InferenceClient:
+        with self._lock:
+            self._clients += 1
+            name = name or f"actor-{self._clients}"
+        if self._watchdog is not None:
+            self._watchdog.register(name)
+        return InferenceClient(self, name)
+
+    def serve_tcp(self, host: str = "127.0.0.1", port: int = 0) -> tuple[str, int]:
+        """Expose ``query``/``version`` over line-JSON TCP; returns address."""
+        from ..comm import TCPCommandServer
+
+        srv = TCPCommandServer(host, port)
+
+        def _query(payload):
+            obs = {k: np.asarray(v) for k, v in payload.items()}
+            out = InferenceClient(self, "tcp").query(obs)
+            if isinstance(out, (dict, ArrayDict)):
+                return {k: np.asarray(v).tolist() for k, v in out.items()}
+            return np.asarray(out).tolist()
+
+        srv.register_handler("query", _query)
+        srv.register_handler("version", lambda _: self._version)
+        srv.start()
+        self._tcp = srv
+        return srv.address
+
+    # -- serve loop ------------------------------------------------------------
+
+    def _drain(self) -> list[tuple[Any, Future]]:
+        """Block for the first request, then gather within the wait window."""
+        try:
+            first = self._queue.get(timeout=0.1)
+        except queue.Empty:
+            return []
+        batch = [first]
+        deadline = self.max_wait
+        import time
+
+        t0 = time.monotonic()
+        while len(batch) < self.max_batch_size:
+            left = deadline - (time.monotonic() - t0)
+            if left <= 0:
+                break
+            try:
+                batch.append(self._queue.get(timeout=left))
+            except queue.Empty:
+                break
+        return batch
+
+    def _serve_loop(self) -> None:
+        while not self._stop.is_set():
+            batch = self._drain()
+            if not batch:
+                continue
+            try:
+                self._answer(batch)
+            except Exception as e:  # noqa: BLE001 - deliver, don't die
+                for _, fut in batch:
+                    if not fut.done():
+                        fut.set_exception(e)
+
+    def _answer(self, batch: list[tuple[Any, Future]]) -> None:
+        k = len(batch)
+        stacked = {}
+        keys = list(batch[0][0].keys())
+        for name in keys:
+            rows = [np.asarray(obs[name]) for obs, _ in batch]
+            pad = np.zeros((self.max_batch_size - k, *rows[0].shape), rows[0].dtype)
+            stacked[name] = jnp.asarray(np.concatenate([np.stack(rows), pad]))
+        with self._lock:
+            params = self._params
+        self._key, sub = jax.random.split(self._key)
+        out = self._jit_policy(params, ArrayDict(stacked), sub)
+        outs = {kk: np.asarray(out[kk]) for kk in self.out_keys}
+        for i, (_, fut) in enumerate(batch):
+            if len(self.out_keys) == 1:
+                fut.set_result(outs[self.out_keys[0]][i])
+            else:
+                fut.set_result({kk: outs[kk][i] for kk in self.out_keys})

@@ -45,7 +45,8 @@ class GRPOTrainer:
         dataset: (question, answer) pairs; tokenizer trains on its corpus.
         mesh: optional ``jax.sharding.Mesh`` with a "context" axis — the
             training forward then runs ring attention with the sequence
-            sharded over it (prompt+response length must divide the axis).
+            sharded over it (the axis size must divide prompt+response
+            length).
         kl_coeff: KL(π‖π_ref) reward-shaping coefficient (π_ref = init).
         scorer: reward override; default exact-match + dense arithmetic
             credit against ``dataset.answers``.
@@ -90,8 +91,8 @@ class GRPOTrainer:
             ctx = mesh.shape["context"]
             if total_len % ctx:
                 raise ValueError(
-                    f"prompt+response length {total_len} must divide the "
-                    f"context axis ({ctx}) for ring attention"
+                    f"context axis size ({ctx}) must divide prompt+response "
+                    f"length {total_len} for ring attention"
                 )
             train_cfg = dataclasses.replace(
                 model_config, attention_impl="ring", mesh=mesh

@@ -1,0 +1,256 @@
+"""Inference server + liveness/preemption tests (VERDICT round-1 item 7).
+
+Mirrors the reference's server/straggler coverage (reference
+test/test_inference_server.py: batched queries equal direct policy calls;
+collectors interrupted mid-rollout still produce static-shape batches).
+"""
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from rl_tpu.collectors import HostCollector, ProcessEnvPool, ThreadedEnvPool
+from rl_tpu.comm import Interruptor, Watchdog
+from rl_tpu.modules import InferenceServer
+
+
+def _linear_policy(params, td, key):
+    return td.set("action", td["observation"] @ params["w"])
+
+
+def _params():
+    return {"w": jnp.asarray(np.arange(12, dtype=np.float32).reshape(4, 3))}
+
+
+class TestInferenceServer:
+    def test_batched_query_matches_direct(self):
+        srv = InferenceServer(_linear_policy, _params(), max_batch_size=8).start()
+        try:
+            obs = np.arange(4, dtype=np.float32)
+            got = srv.client().query({"observation": obs})
+            np.testing.assert_allclose(got, obs @ np.asarray(_params()["w"]), rtol=1e-6)
+        finally:
+            srv.stop()
+
+    def test_many_actors_concurrently(self):
+        srv = InferenceServer(_linear_policy, _params(), max_batch_size=4).start()
+        results = {}
+
+        def actor(i):
+            obs = np.full(4, float(i), np.float32)
+            results[i] = srv.client(f"a{i}").query({"observation": obs})
+
+        try:
+            threads = [threading.Thread(target=actor, args=(i,)) for i in range(10)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=30)
+            w = np.asarray(_params()["w"])
+            for i in range(10):
+                np.testing.assert_allclose(
+                    results[i], np.full(4, float(i), np.float32) @ w, rtol=1e-5
+                )
+        finally:
+            srv.stop()
+
+    def test_update_params_versioned(self):
+        srv = InferenceServer(_linear_policy, _params(), max_batch_size=2).start()
+        try:
+            obs = np.ones(4, np.float32)
+            before = srv.client().query({"observation": obs})
+            v = srv.update_params({"w": jnp.zeros((4, 3))})
+            assert v == 1 and srv.version == 1
+            after = srv.client().query({"observation": obs})
+            assert np.abs(before).max() > 0
+            np.testing.assert_allclose(after, np.zeros(3), atol=1e-6)
+        finally:
+            srv.stop()
+
+    def test_tcp_transport(self):
+        srv = InferenceServer(_linear_policy, _params(), max_batch_size=4).start()
+        try:
+            host, port = srv.serve_tcp()
+            from rl_tpu.comm import TCPCommandClient
+
+            cli = TCPCommandClient(host, port)
+            out = cli.call("query", {"observation": [1.0, 0.0, 0.0, 0.0]})
+            np.testing.assert_allclose(out, np.asarray(_params()["w"])[0], rtol=1e-5)
+            assert cli.call("version", None) == 0
+        finally:
+            srv.stop()
+
+    def test_stop_fails_pending_futures(self):
+        srv = InferenceServer(_linear_policy, _params())  # never started
+        client = srv.client()
+        fut_holder = {}
+
+        def ask():
+            try:
+                client.query({"observation": np.zeros(4, np.float32)}, timeout=5)
+            except RuntimeError as e:
+                fut_holder["err"] = str(e)
+
+        t = threading.Thread(target=ask)
+        t.start()
+        time.sleep(0.05)
+        srv.stop()
+        t.join(timeout=5)
+        assert "stopped" in fut_holder.get("err", ""), fut_holder
+
+    def test_watchdog_drops_silent_actor(self):
+        wd = Watchdog(timeout=0.05)
+        srv = InferenceServer(_linear_policy, _params(), watchdog=wd).start()
+        try:
+            c1 = srv.client("alice")
+            srv.client("bob")  # never queries
+            c1.query({"observation": np.zeros(4, np.float32)})
+            time.sleep(0.1)
+            wd.check()
+            assert "bob" in wd.dead
+            # alice beats on query and is revived
+            c1.query({"observation": np.zeros(4, np.float32)})
+            assert "alice" in wd.alive
+        finally:
+            srv.stop()
+
+
+class TestWatchdog:
+    def test_death_reported_once_with_callback(self):
+        deaths = []
+        wd = Watchdog(timeout=0.03, on_death=deaths.append)
+        wd.register("w0")
+        time.sleep(0.06)
+        assert wd.check() == ["w0"]
+        assert wd.check() == []  # only once
+        assert deaths == ["w0"]
+        wd.beat("w0")  # resurrection
+        assert wd.alive == ["w0"]
+
+    def test_background_reaper(self):
+        deaths = []
+        wd = Watchdog(timeout=0.03, on_death=deaths.append, check_interval=0.01)
+        wd.register("w0")
+        wd.start()
+        try:
+            time.sleep(0.15)
+            assert deaths == ["w0"]
+        finally:
+            wd.stop()
+
+
+class _SlowEnv:
+    """Host env whose steps take `delay` seconds (straggler stand-in)."""
+
+    def __init__(self, delay=0.0, horizon=1000):
+        self.delay = delay
+        self.horizon = horizon
+        self.t = 0
+
+    @property
+    def observation_spec(self):
+        from rl_tpu.data.specs import Composite, Unbounded
+
+        return Composite(observation=Unbounded((2,)))
+
+    @property
+    def action_spec(self):
+        from rl_tpu.data.specs import Bounded
+
+        return Bounded(shape=(1,), low=-1.0, high=1.0)
+
+    def reset(self, seed=0):
+        self.t = 0
+        return {"observation": np.zeros(2, np.float32)}
+
+    def step(self, action):
+        time.sleep(self.delay)
+        self.t += 1
+        obs = {"observation": np.full(2, self.t, np.float32)}
+        return obs, 1.0, False, self.t >= self.horizon
+
+    def close(self):
+        pass
+
+
+class TestStragglerPreemption:
+    def test_interruptor_cuts_collection_with_masked_pad(self):
+        pool = ThreadedEnvPool([lambda: _SlowEnv(0.02) for _ in range(2)])
+        stop = Interruptor()
+        coll = HostCollector(pool, None, frames_per_batch=200, interruptor=stop)
+        stop.start_collection()
+        timer = threading.Timer(0.15, stop.stop_collection)
+        timer.start()
+        batch = coll.collect(None, jax.random.key(0))
+        timer.cancel()
+        pool.close()
+        # static shape preserved, tail masked out
+        assert batch["observation"].shape[:2] == (100, 2)
+        mask = np.asarray(batch["collected_mask"])
+        assert 0 < mask[:, 0].sum() < 100
+        # mask is a time-prefix: once cut, stays cut
+        col = mask[:, 0].astype(int)
+        assert (np.diff(col) <= 0).all()
+
+    def test_uninterrupted_batch_fully_masked_true(self):
+        pool = ThreadedEnvPool([lambda: _SlowEnv(0.0) for _ in range(2)])
+        coll = HostCollector(pool, None, frames_per_batch=8, interruptor=Interruptor())
+        batch = coll.collect(None, jax.random.key(0))
+        pool.close()
+        assert np.asarray(batch["collected_mask"]).all()
+
+    def test_no_interruptor_no_mask_key(self):
+        pool = ThreadedEnvPool([lambda: _SlowEnv(0.0) for _ in range(2)])
+        coll = HostCollector(pool, None, frames_per_batch=8)
+        batch = coll.collect(None, jax.random.key(0))
+        pool.close()
+        assert "collected_mask" not in batch
+
+
+def _short_env():
+    return _SlowEnv(horizon=3)
+
+
+class TestProcessEnvPool:
+    def test_step_and_specs_match_threaded(self):
+        penv = ProcessEnvPool([_SlowEnv for _ in range(3)])
+        try:
+            obs = penv.reset(seed=0)
+            assert len(obs) == 3
+            out = penv.step_wait(np.zeros((3, 1), np.float32))
+            for o, r, term, trunc in out:
+                assert o["observation"].tolist() == [1.0, 1.0]
+                assert r == 1.0 and not term and not trunc
+            assert all(penv.alive())
+            assert penv.action_spec.shape == (1,)
+        finally:
+            penv.close()
+
+    def test_host_collector_over_processes(self):
+        penv = ProcessEnvPool([_SlowEnv for _ in range(2)])
+        try:
+            coll = HostCollector(penv, None, frames_per_batch=8)
+            batch = coll.collect(None, jax.random.key(0))
+            assert batch["observation"].shape[:2] == (4, 2)
+            assert float(batch["next"]["reward"].sum()) == 8.0
+        finally:
+            penv.close()
+
+    def test_auto_reset_mid_batch_over_processes(self):
+        """episode ends inside the batch: collector resets THROUGH the pipe
+        (regression: collect() used to reach for pool.envs[i])."""
+        penv = ProcessEnvPool([_short_env for _ in range(2)])
+        try:
+            coll = HostCollector(penv, None, frames_per_batch=12)
+            batch = coll.collect(None, jax.random.key(0))
+            trunc = np.asarray(batch["next"]["truncated"])
+            assert trunc.sum() >= 2  # horizon 3, 6 steps -> 2 ends per env
+            # post-reset rows restart the counter at 1
+            obs = np.asarray(batch["observation"])[:, 0, 0]
+            assert 0.0 in obs[3:]  # fresh reset obs re-enters the carry
+        finally:
+            penv.close()
