@@ -215,6 +215,10 @@ class HostCollector:
         n = self.pool.num_envs
         if self._obs is None:
             self._obs = self.pool.reset(seed=self._seed)
+        if self.interruptor is not None:
+            # re-arm per batch (reference semantics): the flag cuts ONE
+            # batch short; a persistent trainer stop is request_stop()
+            self.interruptor.start_collection()
         steps = []
         for _ in range(self.scan_length):
             if (
@@ -255,9 +259,8 @@ class HostCollector:
                     self._seed = seed_generator(self._seed)
                     carry[i] = self.pool.reset_one(i, self._seed)
             self._obs = carry
-        batch = ArrayDict.stack(steps, axis=0)
         if self.interruptor is None:
-            return batch
+            return ArrayDict.stack(steps, axis=0)
         if len(steps) < self.scan_length:
             # preempted: pad to the static [T, N] shape, mask the tail
             pad = self.scan_length - len(steps)
@@ -265,7 +268,7 @@ class HostCollector:
             mask = np.zeros((self.scan_length, n), bool)
             mask[: len(steps)] = True
             return batch.set("collected_mask", jnp.asarray(mask))
-        return batch.set(
+        return ArrayDict.stack(steps, axis=0).set(
             "collected_mask", jnp.ones((self.scan_length, n), bool)
         )
 

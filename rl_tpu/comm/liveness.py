@@ -110,7 +110,13 @@ class Watchdog:
 
     def _run(self) -> None:
         while not self._stop.wait(self.check_interval):
-            self.check()
+            try:
+                self.check()
+            except Exception:  # noqa: BLE001 - a failing on_death callback
+                # must not kill the reaper; liveness sweeps keep running
+                import logging
+
+                logging.getLogger("rl_tpu").exception("watchdog sweep failed")
 
     def stop(self) -> None:
         self._stop.set()

@@ -40,10 +40,15 @@ class InferenceClient:
         self.name = name
 
     def query(self, obs: dict | ArrayDict, timeout: float | None = 30.0):
-        if self._server._watchdog is not None:
-            self._server._watchdog.beat(self.name)
+        srv = self._server
+        if srv._watchdog is not None:
+            srv._watchdog.beat(self.name)
         fut: Future = Future()
-        self._server._queue.put((obs, fut))
+        srv._queue.put((obs, fut))
+        if srv._stop.is_set():
+            # closes the race with stop(): a put landing after stop()'s own
+            # drain is failed here instead of hanging until timeout
+            srv._fail_pending()
         return fut.result(timeout=timeout)
 
 
@@ -106,6 +111,9 @@ class InferenceServer:
             self._tcp.shutdown()
             self._tcp = None
         # fail anything still queued so callers don't hang in fut.result()
+        self._fail_pending()
+
+    def _fail_pending(self) -> None:
         while True:
             try:
                 _, fut = self._queue.get_nowait()

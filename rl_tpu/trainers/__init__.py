@@ -41,4 +41,8 @@ def __getattr__(name):
         from .grpo import GRPOTrainer
 
         return GRPOTrainer
+    if name == "PreemptionHandler":
+        from .resilience import PreemptionHandler
+
+        return PreemptionHandler
     raise AttributeError(name)

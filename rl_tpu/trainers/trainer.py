@@ -45,6 +45,9 @@ class Trainer:
         frames_per_step: env frames per fused step (for frame accounting).
         checkpoint: optional rl_tpu.checkpoint.Checkpoint; registered with
             the live train state and saved every ``checkpoint_interval``.
+        auto_resume: restore the latest checkpoint (if any) when ``train``
+            starts with no state — the preemption-recovery default for TPU
+            pods (pair with trainers.resilience.PreemptionHandler).
     """
 
     def __init__(
@@ -56,6 +59,7 @@ class Trainer:
         checkpoint: Any | None = None,
         checkpoint_interval: int = 0,
         log_interval: int = 1,
+        auto_resume: bool = False,
     ):
         self.program = program
         self.total_steps = total_steps
@@ -66,6 +70,7 @@ class Trainer:
         self.checkpoint = checkpoint
         self.checkpoint_interval = checkpoint_interval
         self.log_interval = log_interval
+        self.auto_resume = auto_resume
         self._hooks: dict[str, list[Callable]] = defaultdict(list)
         self.step_count = 0
         self.collected_frames = 0
@@ -129,6 +134,14 @@ class Trainer:
     # -- loop -----------------------------------------------------------------
 
     def train(self, key: jax.Array | int = 0, ts: Any = None) -> Any:
+        if (
+            ts is None
+            and self.ts is None
+            and self.auto_resume
+            and self.checkpoint is not None
+            and self.checkpoint.latest_step() is not None
+        ):
+            self.restore(key=key)
         if ts is None and self.ts is not None:
             ts = self.ts  # restored via restore() or a previous train()
         if ts is None:
