@@ -28,10 +28,14 @@ import threading
 from typing import Any, Callable, Mapping
 
 from .liveness import Interruptor, Watchdog
+from .services import ServiceRegistry, TCPServiceRegistry, connect_registry
 
 __all__ = [
     "Interruptor",
     "Watchdog",
+    "ServiceRegistry",
+    "TCPServiceRegistry",
+    "connect_registry",
     "ServiceBackend",
     "TransportBackend",
     "service_backend",

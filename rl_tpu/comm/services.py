@@ -1,0 +1,118 @@
+"""Named service registry: discover shared helpers across workers.
+
+Redesign of the reference's services layer (reference: torchrl/services/
+base.py ``ServiceBase`` — a dict-like registry of named services;
+ray_service.py backs it with named ray actors). Without a Ray runtime the
+TPU-native backing is the line-JSON TCP control plane: one
+:class:`ServiceRegistry` process holds {name -> address/metadata}, workers
+register on startup and look peers up by name; a
+:class:`~rl_tpu.comm.liveness.Watchdog` expires silent registrations.
+
+In-process use needs no server: ``ServiceRegistry()`` is a plain registry
+(the reference's dict-like surface: register/get/__contains__/list).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+__all__ = ["ServiceRegistry", "TCPServiceRegistry", "connect_registry"]
+
+
+class ServiceRegistry:
+    """Dict-like named services (reference ServiceBase surface)."""
+
+    def __init__(self, watchdog: Any = None):
+        self._services: dict[str, Any] = {}
+        self._watchdog = watchdog
+
+    def register(self, name: str, service: Any, replace: bool = False) -> None:
+        if not replace and name in self._services:
+            raise ValueError(f"service {name!r} already registered")
+        self._services[name] = service
+        if self._watchdog is not None:
+            self._watchdog.register(name)
+
+    def unregister(self, name: str) -> None:
+        self._services.pop(name, None)
+        if self._watchdog is not None:
+            self._watchdog.unregister(name)
+
+    def get(self, name: str) -> Any:
+        if self._watchdog is not None and name in self._watchdog.dead:
+            raise KeyError(f"service {name!r} is registered but not alive")
+        if name not in self._services:
+            raise KeyError(f"unknown service {name!r}; have {sorted(self._services)}")
+        return self._services[name]
+
+    def heartbeat(self, name: str) -> None:
+        if self._watchdog is not None:
+            self._watchdog.beat(name)
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._services
+
+    def list(self) -> dict[str, Any]:
+        return dict(self._services)
+
+
+class TCPServiceRegistry:
+    """Serve a ServiceRegistry over the TCP control plane.
+
+    Values are JSON metadata (typically {"host","port", ...} of the actual
+    service endpoint) — the registry stores *addresses*, not live objects,
+    exactly like named ray actors resolve to handles.
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0, watchdog: Any = None):
+        from . import TCPCommandServer
+
+        self.registry = ServiceRegistry(watchdog=watchdog)
+        if watchdog is not None:
+            watchdog.start()  # the promised expiry of silent registrations
+        self._watchdog = watchdog
+        self._server = TCPCommandServer(host, port)
+        self._server.register_handler("register", self._register)
+        self._server.register_handler("unregister", lambda p: self.registry.unregister(p["name"]))
+        self._server.register_handler("get", lambda p: self.registry.get(p["name"]))
+        self._server.register_handler("list", lambda p: self.registry.list())
+        self._server.register_handler("heartbeat", lambda p: self.registry.heartbeat(p["name"]))
+        self._server.start()
+
+    def _register(self, payload):
+        self.registry.register(
+            payload["name"], payload["value"], replace=bool(payload.get("replace"))
+        )
+
+    @property
+    def address(self) -> tuple[str, int]:
+        return self._server.address
+
+    def shutdown(self) -> None:
+        if self._watchdog is not None:
+            self._watchdog.stop()
+        self._server.shutdown()
+
+
+class connect_registry:
+    """Client handle to a remote TCPServiceRegistry."""
+
+    def __init__(self, host: str, port: int, timeout: float = 10.0):
+        from . import TCPCommandClient
+
+        self._cli = TCPCommandClient(host, port, timeout=timeout)
+
+    def register(self, name: str, value: Any, replace: bool = False) -> None:
+        self._cli.call("register", {"name": name, "value": value, "replace": replace})
+
+    def unregister(self, name: str) -> None:
+        self._cli.call("unregister", {"name": name})
+
+    def get(self, name: str) -> Any:
+        return self._cli.call("get", {"name": name})
+
+    def list(self) -> dict:
+        return self._cli.call("list", None)
+
+    def heartbeat(self, name: str) -> None:
+        self._cli.call("heartbeat", {"name": name})

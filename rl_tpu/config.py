@@ -190,7 +190,11 @@ _add_group("collector", "rl_tpu.collectors", [
 ], strip="Collector")
 _add_group("pool", "rl_tpu.collectors", ["ThreadedEnvPool", "ProcessEnvPool"], strip="EnvPool")
 _add_group("serve", "rl_tpu.modules", ["InferenceServer"])
-_add_group("comm", "rl_tpu.comm", ["Watchdog", "Interruptor"])
+_add_group("comm", "rl_tpu.comm", [
+    "Watchdog", "Interruptor", "ServiceRegistry", "TCPServiceRegistry",
+])
+_add_group("storage", "rl_tpu.data", ["VideoCodecStorage"], strip="Storage")
+_add_group("postproc", "rl_tpu.data", ["AddActionChunks"])
 _add_group("logger", "rl_tpu.record.loggers", [
     "CSVLogger", "TensorboardLogger", "WandbLogger", "MLFlowLogger",
     "NullLogger", "MultiLogger",

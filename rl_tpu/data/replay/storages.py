@@ -181,9 +181,18 @@ class ListStorage(Storage):
         self._items = []
         return {"cursor": 0, "size": 0}
 
+    @staticmethod
+    def _as_items(idx: np.ndarray, items) -> list:
+        """Normalize a stacked ArrayDict or a list to a per-index item list."""
+        return (
+            items
+            if isinstance(items, (list, tuple))
+            else [items[i] for i in range(idx.size)]
+        )
+
     def set(self, state: dict, idx, items) -> dict:
         idx = np.atleast_1d(np.asarray(idx))
-        seq = items if isinstance(items, (list, tuple)) else [items[i] for i in range(idx.size)]
+        seq = self._as_items(idx, items)
         for i, item in zip(idx, seq):
             while len(self._items) <= i:
                 self._items.append(None)
@@ -235,12 +244,7 @@ class CompressedListStorage(ListStorage):
 
     def set(self, state: dict, idx, items) -> dict:
         idx = np.atleast_1d(np.asarray(idx))
-        seq = (
-            items
-            if isinstance(items, (list, tuple))
-            else [items[i] for i in range(idx.size)]
-        )
-        blobs = [self._pack(it) for it in seq]
+        blobs = [self._pack(it) for it in self._as_items(idx, items)]
         return super().set(state, idx, blobs)
 
     def get(self, state: dict, idx):

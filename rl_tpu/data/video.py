@@ -1,0 +1,139 @@
+"""Video-codec replay storage: image trajectories stored as encoded video.
+
+Redesign of the reference's video storage (reference: torchrl/data/video.py
+— ``VideoClipRef`` tensorclass + torchcodec-backed lazy decode so pixel
+replay fits in RAM). TPU-native shape: a :class:`ListStorage` whose items
+have their image leaves (uint8 [T, H, W, C]) encoded to MP4 (imageio/ffmpeg
+when available, zlib otherwise) at write and decoded at read. Non-image
+leaves ride alongside uncompressed, so sampling still returns a normal
+ArrayDict and the decode cost is paid only for sampled items.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+import numpy as np
+
+from .arraydict import ArrayDict
+from .replay.storages import ListStorage
+
+__all__ = ["VideoCodecStorage"]
+
+
+def _is_video_leaf(v) -> bool:
+    return v.ndim == 4 and v.dtype == jnp.uint8 and v.shape[-1] in (1, 3)
+
+
+class _MP4Codec:
+    name = "mp4"
+
+    def encode(self, arr: np.ndarray) -> bytes:
+        import imageio.v3 as iio
+
+        frames = np.repeat(arr, 3, axis=-1) if arr.shape[-1] == 1 else arr
+        # yuv420p needs even H/W: edge-pad bottom/right, crop on decode
+        T, H, W, _ = frames.shape
+        if H % 2 or W % 2:
+            frames = np.pad(
+                frames, ((0, 0), (0, H % 2), (0, W % 2), (0, 0)), mode="edge"
+            )
+        return iio.imwrite("<bytes>", frames, extension=".mp4", fps=30)
+
+    def decode(self, blob: bytes, shape, dtype) -> np.ndarray:
+        import imageio.v3 as iio
+
+        T, H, W, C = shape
+        frames = np.asarray(iio.imread(blob, extension=".mp4"))
+        frames = frames[:T, :H, :W, :C]  # crop encoder padding
+        if frames.shape != tuple(shape):
+            raise ValueError(
+                f"mp4 decode drifted: got {frames.shape}, stored {tuple(shape)}"
+                " — use codec='zlib' for this data"
+            )
+        # lossy codec: shapes match, values are approximate
+        return frames.astype(dtype)
+
+
+class _ZlibCodec:
+    name = "zlib"
+
+    def encode(self, arr: np.ndarray) -> bytes:
+        import zlib
+
+        return zlib.compress(np.ascontiguousarray(arr).tobytes(), 3)
+
+    def decode(self, blob: bytes, shape, dtype) -> np.ndarray:
+        import zlib
+
+        return np.frombuffer(zlib.decompress(blob), dtype=dtype).reshape(shape)
+
+
+def _pick_codec(name: str):
+    if name == "zlib":
+        return _ZlibCodec()
+    if name in ("mp4", "auto"):
+        try:
+            import imageio.v3 as iio  # noqa: F401
+
+            codec = _MP4Codec()
+            codec.encode(np.zeros((2, 16, 16, 3), np.uint8))  # probe ffmpeg
+            return codec
+        except Exception:
+            if name == "mp4":
+                raise
+            return _ZlibCodec()
+    raise ValueError(f"unknown codec {name!r} (mp4/zlib/auto)")
+
+
+class VideoCodecStorage(ListStorage):
+    """ListStorage with image leaves video-encoded per item.
+
+    Args:
+        capacity: number of trajectory items.
+        codec: "mp4" (lossy, needs ffmpeg), "zlib" (lossless), or "auto"
+            (mp4 when ffmpeg probes OK, else zlib).
+    """
+
+    def __init__(self, capacity: int, codec: str = "auto"):
+        super().__init__(capacity)
+        self.codec = _pick_codec(codec)
+
+    def _pack(self, item: ArrayDict) -> Any:
+        enc: dict = {}
+        rest: dict = {}
+        for k, v in item.items(nested=True, leaves_only=True):
+            arr = np.asarray(v)
+            if _is_video_leaf(arr):
+                enc[k] = (self.codec.encode(arr), arr.shape, arr.dtype)
+            else:
+                rest[k] = arr
+        return enc, rest
+
+    def _unpack(self, packed) -> ArrayDict:
+        enc, rest = packed
+        out = ArrayDict()
+        for k, (blob, shape, dtype) in enc.items():
+            out = out.set(k, jnp.asarray(self.codec.decode(blob, shape, dtype)))
+        for k, v in rest.items():
+            out = out.set(k, jnp.asarray(v))
+        return out
+
+    def set(self, state: dict, idx, items) -> dict:
+        idx = np.atleast_1d(np.asarray(idx))
+        packed = [self._pack(it) for it in self._as_items(idx, items)]
+        return super().set(state, idx, packed)
+
+    def get(self, state: dict, idx) -> list:
+        return [self._unpack(p) for p in super().get(state, idx)]
+
+    def nbytes(self) -> int:
+        total = 0
+        for p in self._items:
+            if p is None:
+                continue
+            enc, rest = p
+            total += sum(len(b) for b, _, _ in enc.values())
+            total += sum(v.nbytes for v in rest.values())
+        return total

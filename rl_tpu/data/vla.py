@@ -1,0 +1,123 @@
+"""Canonical VLA (vision-language-action) ArrayDict schema + chunking.
+
+Redesign of the reference's VLA layer (reference: torchrl/data/vla/ —
+schema.py ``validate_vla_tensordict``:79 defines the canonical nested-key
+layout shared by OpenX/LeRobot-style datasets, policies and losses;
+containers.py ``VLAAction`` carries per-step action chunks). The ArrayDict
+form keeps the same key convention so a reference user finds the familiar
+layout:
+
+    ArrayDict(
+        observation = ArrayDict(
+            image = {<camera>: uint8 [*B, T, H, W, C]},   # HWC: TPU/XLA conv
+            state = float [*B, T, state_dim],             # proprioception
+        ),
+        language_instruction = int32 [*B, L] (tokenized) ,
+        action = float [*B, T, action_dim],
+        vla_action = ArrayDict(chunk=float [*B, T, chunk, action_dim]),
+        action_is_pad = bool [*B, T, chunk],
+    )
+
+Chunk building is a jit-friendly gather (no Python loops over T), so it can
+run inside a replay-side transform.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from .arraydict import ArrayDict
+
+__all__ = [
+    "VLA_KEYS",
+    "validate_vla_arraydict",
+    "build_action_chunks",
+    "AddActionChunks",
+]
+
+# the shared key defaults (reference schema.py module constants)
+VLA_KEYS = {
+    "image": ("observation", "image"),
+    "state": ("observation", "state"),
+    "instruction": ("language_instruction",),
+    "action": ("action",),
+    "chunk": ("vla_action", "chunk"),
+    "pad": ("action_is_pad",),
+}
+
+
+def validate_vla_arraydict(td: ArrayDict, require_chunks: bool = False) -> None:
+    """Raise ValueError with an actionable message on schema violations
+    (reference validate_vla_tensordict:79)."""
+    problems: list[str] = []
+    if ("observation",) not in td and "observation" not in td:
+        problems.append("missing 'observation' sub-dict")
+    else:
+        obs = td["observation"]
+        if "image" not in obs and "state" not in obs:
+            problems.append("observation needs at least one of 'image'/'state'")
+        if "image" in obs:
+            img = obs["image"]
+            leaves = (
+                [v for _, v in img.items(nested=True, leaves_only=True)]
+                if isinstance(img, ArrayDict)
+                else [img]
+            )
+            for leaf in leaves:
+                if leaf.ndim < 4:
+                    problems.append(
+                        f"image leaves must be [*B, T, H, W, C]; got {leaf.shape}"
+                    )
+                elif leaf.dtype not in (jnp.uint8, jnp.float32, jnp.bfloat16):
+                    problems.append(f"image dtype {leaf.dtype} not in uint8/float")
+    if "action" not in td:
+        problems.append("missing 'action' [*B, T, action_dim]")
+    elif td["action"].ndim < 2:
+        problems.append(f"action must be [*B, T, action_dim]; got {td['action'].shape}")
+    if require_chunks:
+        if ("vla_action", "chunk") not in td:
+            problems.append("missing ('vla_action','chunk') — run AddActionChunks")
+        elif ("action_is_pad",) not in td and "action_is_pad" not in td:
+            problems.append("missing 'action_is_pad' chunk validity mask")
+    if problems:
+        raise ValueError("invalid VLA ArrayDict: " + "; ".join(problems))
+
+
+def build_action_chunks(actions, chunk: int, episode_len=None):
+    """[..., T, A] -> (chunks [..., T, chunk, A], is_pad [..., T, chunk]).
+
+    Each step t carries the next ``chunk`` actions (ACT/diffusion-policy
+    training targets). Steps past the episode tail are flagged in is_pad
+    and hold the last valid action repeated (clamped gather — jit-safe).
+    """
+    T = actions.shape[-2]
+    t_idx = jnp.arange(T)[:, None] + jnp.arange(chunk)[None, :]  # [T, chunk]
+    if episode_len is None:
+        is_pad = t_idx >= T
+    else:
+        # per-trajectory lengths [*B] broadcast over the trailing [T, chunk]
+        limit = jnp.asarray(episode_len).reshape(
+            *jnp.shape(episode_len), 1, 1
+        )
+        is_pad = t_idx >= limit
+    gather = jnp.clip(t_idx, 0, T - 1)
+    chunks = jnp.take(actions, gather.reshape(-1), axis=-2)
+    chunks = chunks.reshape(*actions.shape[:-2], T, chunk, actions.shape[-1])
+    # broadcast is_pad over leading batch dims
+    pad = jnp.broadcast_to(is_pad, (*actions.shape[:-2], T, chunk))
+    return chunks, pad
+
+
+class AddActionChunks:
+    """Replay/postproc transform stamping vla_action.chunk + action_is_pad
+    onto trajectory batches (reference vla/preprocessing.py chunk builder)."""
+
+    def __init__(self, chunk: int, episode_len_key: str | None = None):
+        self.chunk = chunk
+        self.episode_len_key = episode_len_key
+
+    def __call__(self, td: ArrayDict) -> ArrayDict:
+        ep_len = td[self.episode_len_key] if self.episode_len_key else None
+        chunks, pad = build_action_chunks(td["action"], self.chunk, ep_len)
+        return td.set(("vla_action", "chunk"), chunks).set("action_is_pad", pad)

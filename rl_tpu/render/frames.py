@@ -1,0 +1,86 @@
+"""Host-side numpy rasterizers for the built-in classic envs.
+
+The reference's render backends (reference: torchrl/render/backends/ —
+mujoco, gym rgb_array) assume simulators that draw themselves; the pure-JAX
+classic envs have no renderer, so these tiny rasterizers turn observation
+vectors into frames for the render CLI and VideoRecorder-style logging.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["render_cartpole", "render_pendulum", "renderer_for", "RENDERERS"]
+
+
+def _blank(h: int, w: int) -> np.ndarray:
+    return np.full((h, w, 3), 255, np.uint8)
+
+
+def _line(img: np.ndarray, x0: float, y0: float, x1: float, y1: float, color, width: int = 2) -> None:
+    h, w, _ = img.shape
+    n = int(max(abs(x1 - x0), abs(y1 - y0), 1)) * 2
+    xs = np.linspace(x0, x1, n)
+    ys = np.linspace(y0, y1, n)
+    r = width // 2
+    for dx in range(-r, r + 1):
+        for dy in range(-r, r + 1):
+            xi = np.clip(np.round(xs + dx).astype(int), 0, w - 1)
+            yi = np.clip(np.round(ys + dy).astype(int), 0, h - 1)
+            img[yi, xi] = color
+
+
+def _rect(img: np.ndarray, cx: float, cy: float, hw: float, hh: float, color) -> None:
+    h, w, _ = img.shape
+    x0, x1 = int(max(cx - hw, 0)), int(min(cx + hw, w - 1))
+    y0, y1 = int(max(cy - hh, 0)), int(min(cy + hh, h - 1))
+    img[y0:y1 + 1, x0:x1 + 1] = color
+
+
+def render_cartpole(obs: np.ndarray, height: int = 128, width: int = 192) -> np.ndarray:
+    """obs = [x, x_dot, theta, theta_dot] -> cart + pole frame."""
+    x, _, theta, _ = np.asarray(obs, np.float64)[:4]
+    img = _blank(height, width)
+    ground = int(height * 0.8)
+    _line(img, 0, ground, width - 1, ground, (0, 0, 0), width=1)
+    cx = width / 2 + x / 2.4 * (width / 2 - 10)
+    _rect(img, cx, ground - 6, 14, 6, (60, 60, 200))
+    pole_len = height * 0.45
+    tipx = cx + pole_len * np.sin(theta)
+    tipy = ground - 10 - pole_len * np.cos(theta)
+    _line(img, cx, ground - 10, tipx, tipy, (200, 120, 40), width=3)
+    return img
+
+
+def render_pendulum(obs: np.ndarray, height: int = 128, width: int = 128) -> np.ndarray:
+    """obs = [cos(th), sin(th), th_dot] -> rod frame (up = goal)."""
+    c, s = np.asarray(obs, np.float64)[:2]
+    img = _blank(height, width)
+    cx, cy = width / 2, height / 2
+    rod = height * 0.38
+    _line(img, cx, cy, cx + rod * s, cy - rod * c, (200, 60, 60), width=4)
+    _rect(img, cx, cy, 3, 3, (0, 0, 0))
+    return img
+
+
+RENDERERS = {
+    "CartPoleEnv": render_cartpole,
+    "PendulumEnv": render_pendulum,
+    "MountainCarEnv": None,  # placeholder until drawn
+}
+
+
+def renderer_for(env) -> "callable | None":
+    """Resolve a rasterizer for an env (unwraps Transformed/Vmap layers)."""
+    seen = set()
+    while id(env) not in seen:
+        seen.add(id(env))
+        name = type(env).__name__
+        fn = RENDERERS.get(name)
+        if fn is not None:
+            return fn
+        inner = getattr(env, "env", None)
+        if inner is None:
+            break
+        env = inner
+    return None

@@ -1,0 +1,210 @@
+"""VLA schema, video-codec storage, services registry, render CLI tests
+(reference analogs: test/test_vla.py schema validation, data/video.py decode
+round-trips, services registry tests, render/cli tests)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from rl_tpu.data import (
+    AddActionChunks,
+    ArrayDict,
+    VideoCodecStorage,
+    build_action_chunks,
+    validate_vla_arraydict,
+)
+
+
+def _vla_td(B=2, T=5, A=3):
+    return ArrayDict(
+        observation=ArrayDict(
+            image=ArrayDict(top=jnp.zeros((B, T, 8, 8, 3), jnp.uint8)),
+            state=jnp.zeros((B, T, 4)),
+        ),
+        language_instruction=jnp.zeros((B, 6), jnp.int32),
+        action=jnp.arange(B * T * A, dtype=jnp.float32).reshape(B, T, A),
+    )
+
+
+class TestVLASchema:
+    def test_valid_passes(self):
+        validate_vla_arraydict(_vla_td())
+
+    def test_missing_action(self):
+        td = _vla_td()
+        td = ArrayDict({k: v for k, v in td.items() if k != "action"})
+        with pytest.raises(ValueError, match="action"):
+            validate_vla_arraydict(td)
+
+    def test_bad_image_rank(self):
+        td = _vla_td().set(("observation", "image", "top"), jnp.zeros((2, 5, 8), jnp.uint8))
+        with pytest.raises(ValueError, match="image leaves"):
+            validate_vla_arraydict(td)
+
+    def test_chunks_required(self):
+        with pytest.raises(ValueError, match="AddActionChunks"):
+            validate_vla_arraydict(_vla_td(), require_chunks=True)
+
+    def test_chunk_builder_values_and_padding(self):
+        td = _vla_td(B=1, T=4, A=1)
+        chunks, pad = build_action_chunks(td["action"], chunk=3)
+        assert chunks.shape == (1, 4, 3, 1) and pad.shape == (1, 4, 3)
+        a = np.asarray(td["action"])[0, :, 0]
+        # step 0 sees actions [0,1,2]; step 3 sees [3,3,3] with pad True tail
+        np.testing.assert_allclose(np.asarray(chunks)[0, 0, :, 0], a[:3])
+        np.testing.assert_allclose(np.asarray(chunks)[0, 3, :, 0], [a[3]] * 3)
+        assert not np.asarray(pad)[0, 0].any()
+        assert np.asarray(pad)[0, 3].tolist() == [False, True, True]
+
+    def test_transform_round_trip_validates(self):
+        td = AddActionChunks(chunk=2)(_vla_td())
+        validate_vla_arraydict(td, require_chunks=True)
+
+    def test_chunk_builder_jits(self):
+        td = _vla_td()
+        f = jax.jit(lambda a: build_action_chunks(a, 3))
+        chunks, pad = f(td["action"])
+        assert chunks.shape == (2, 5, 3, 3)
+
+
+class TestVideoCodecStorage:
+    def _item(self, T=6, seed=0):
+        rng = np.random.default_rng(seed)
+        return ArrayDict(
+            pixels=jnp.asarray(rng.integers(0, 255, (T, 16, 16, 3), np.uint8)),
+            action=jnp.asarray(rng.normal(size=(T, 2)).astype(np.float32)),
+        )
+
+    def test_zlib_lossless_roundtrip(self):
+        st = VideoCodecStorage(4, codec="zlib")
+        state = st.init(None)
+        item = self._item()
+        state = st.set(state, [0], [item])
+        out = st.get(state, [0])[0]
+        np.testing.assert_array_equal(np.asarray(out["pixels"]), np.asarray(item["pixels"]))
+        np.testing.assert_allclose(np.asarray(out["action"]), np.asarray(item["action"]))
+
+    def test_auto_codec_roundtrip_and_compression(self):
+        st = VideoCodecStorage(4, codec="auto")
+        state = st.init(None)
+        # smooth frames compress well under any codec
+        T = 8
+        base = np.zeros((T, 16, 16, 3), np.uint8)
+        for t in range(T):
+            base[t, :, : t + 2] = 100
+        item = ArrayDict(pixels=jnp.asarray(base), action=jnp.zeros((T, 2)))
+        state = st.set(state, [0], [item])
+        out = st.get(state, [0])[0]
+        assert out["pixels"].shape == (T, 16, 16, 3)
+        if st.codec.name == "mp4":  # lossy: values close, not exact
+            err = np.abs(
+                np.asarray(out["pixels"], np.int32) - base.astype(np.int32)
+            ).mean()
+            assert err < 10, err
+        else:
+            np.testing.assert_array_equal(np.asarray(out["pixels"]), base)
+        assert st.nbytes() < base.nbytes + 8 * 2 * 4
+
+    def test_non_image_leaves_untouched(self):
+        st = VideoCodecStorage(2, codec="zlib")
+        state = st.init(None)
+        item = self._item()
+        state = st.set(state, [1], [item])
+        out = st.get(state, [1])[0]
+        assert out["action"].dtype == jnp.float32
+
+
+class TestServicesRegistry:
+    def test_in_process_registry(self):
+        from rl_tpu.comm import ServiceRegistry
+
+        reg = ServiceRegistry()
+        reg.register("replay", {"host": "a", "port": 1})
+        assert "replay" in reg and reg.get("replay")["port"] == 1
+        with pytest.raises(ValueError):
+            reg.register("replay", {})
+        reg.register("replay", {"port": 2}, replace=True)
+        assert reg.get("replay")["port"] == 2
+        with pytest.raises(KeyError, match="unknown service"):
+            reg.get("nope")
+
+    def test_tcp_registry_with_watchdog(self):
+        from rl_tpu.comm import TCPServiceRegistry, Watchdog, connect_registry
+
+        wd = Watchdog(timeout=30)
+        srv = TCPServiceRegistry(watchdog=wd)
+        try:
+            cli = connect_registry(*srv.address)
+            cli.register("logger", {"host": "x", "port": 9})
+            assert cli.get("logger") == {"host": "x", "port": 9}
+            assert "logger" in cli.list()
+            cli.heartbeat("logger")
+            with pytest.raises(RuntimeError):
+                cli.register("logger", {})  # duplicate -> remote error
+        finally:
+            srv.shutdown()
+
+    def test_dead_service_lookup_fails(self):
+        import time
+
+        from rl_tpu.comm import ServiceRegistry, Watchdog
+
+        wd = Watchdog(timeout=0.01)
+        reg = ServiceRegistry(watchdog=wd)
+        reg.register("flaky", {})
+        time.sleep(0.03)
+        wd.check()
+        with pytest.raises(KeyError, match="not alive"):
+            reg.get("flaky")
+        reg.heartbeat("flaky")  # resurrect
+        assert reg.get("flaky") == {}
+
+
+class TestRenderCLI:
+    def test_rasterizers_draw(self):
+        from rl_tpu.render.frames import render_cartpole, render_pendulum
+
+        f = render_cartpole(np.array([0.5, 0, 0.1, 0]))
+        assert f.shape == (128, 192, 3) and (f < 255).any()
+        f2 = render_pendulum(np.array([1.0, 0.0, 0.0]))
+        assert f2.shape == (128, 128, 3) and (f2 < 255).any()
+
+    def test_renderer_unwraps_transforms(self):
+        from rl_tpu.envs import CartPoleEnv, RewardSum, TransformedEnv, VmapEnv
+        from rl_tpu.render import renderer_for
+
+        env = TransformedEnv(VmapEnv(CartPoleEnv(), 2), RewardSum())
+        assert renderer_for(env) is not None
+
+    def test_cli_gif_and_npz(self, tmp_path):
+        from rl_tpu.render import main
+
+        gif = str(tmp_path / "o.gif")
+        assert main(["--env", "env/cartpole", "--steps", "8", "--out", gif]) == 0
+        npz = str(tmp_path / "o.npz")
+        assert main(["--env", "env/pendulum", "--steps", "5", "--out", npz]) == 0
+        with np.load(npz) as z:
+            assert any(k.startswith("next/") for k in z.files)
+
+
+class TestReviewRegressions2:
+    def test_batched_episode_len_pads_per_trajectory(self):
+        # regression: [T,chunk] >= [B] broadcast crashed / mixed trajectories
+        actions = jnp.zeros((2, 5, 1))
+        _, pad = build_action_chunks(actions, chunk=2, episode_len=jnp.array([3, 5]))
+        p = np.asarray(pad)
+        assert p.shape == (2, 5, 2)
+        assert p[0, 2].tolist() == [False, True]   # len-3 traj pads at t>=3
+        assert not p[1, :4].any()                   # len-5 traj pads only at
+        assert p[1, 4].tolist() == [False, True]    # the final chunk overhang
+
+    def test_odd_dimension_frames_survive_codec(self):
+        st = VideoCodecStorage(2, codec="auto")
+        state = st.init(None)
+        frames = np.zeros((4, 15, 17, 3), np.uint8)  # odd H/W
+        frames[:, :7] = 200
+        item = ArrayDict(pixels=jnp.asarray(frames))
+        state = st.set(state, [0], [item])
+        out = st.get(state, [0])[0]
+        assert out["pixels"].shape == (4, 15, 17, 3)
