@@ -20,40 +20,54 @@ __all__ = ["ServiceRegistry", "TCPServiceRegistry", "connect_registry"]
 
 
 class ServiceRegistry:
-    """Dict-like named services (reference ServiceBase surface)."""
+    """Dict-like named services (reference ServiceBase surface).
+
+    Thread-safe: TCPServiceRegistry serves it from ThreadingTCPServer
+    handler threads, so the duplicate-registration guard must be atomic.
+    """
 
     def __init__(self, watchdog: Any = None):
+        import threading
+
         self._services: dict[str, Any] = {}
         self._watchdog = watchdog
+        self._lock = threading.Lock()
 
     def register(self, name: str, service: Any, replace: bool = False) -> None:
-        if not replace and name in self._services:
-            raise ValueError(f"service {name!r} already registered")
-        self._services[name] = service
+        with self._lock:
+            if not replace and name in self._services:
+                raise ValueError(f"service {name!r} already registered")
+            self._services[name] = service
         if self._watchdog is not None:
             self._watchdog.register(name)
 
     def unregister(self, name: str) -> None:
-        self._services.pop(name, None)
+        with self._lock:
+            self._services.pop(name, None)
         if self._watchdog is not None:
             self._watchdog.unregister(name)
 
     def get(self, name: str) -> Any:
         if self._watchdog is not None and name in self._watchdog.dead:
             raise KeyError(f"service {name!r} is registered but not alive")
-        if name not in self._services:
-            raise KeyError(f"unknown service {name!r}; have {sorted(self._services)}")
-        return self._services[name]
+        with self._lock:
+            if name not in self._services:
+                raise KeyError(
+                    f"unknown service {name!r}; have {sorted(self._services)}"
+                )
+            return self._services[name]
 
     def heartbeat(self, name: str) -> None:
         if self._watchdog is not None:
             self._watchdog.beat(name)
 
     def __contains__(self, name: str) -> bool:
-        return name in self._services
+        with self._lock:
+            return name in self._services
 
     def list(self) -> dict[str, Any]:
-        return dict(self._services)
+        with self._lock:
+            return dict(self._services)
 
 
 class TCPServiceRegistry:

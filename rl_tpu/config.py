@@ -221,6 +221,9 @@ _BUILTINS.update({
     "sampler/without_replacement": "rl_tpu.data.SamplerWithoutReplacement",
     "buffer/replay": "rl_tpu.data.ReplayBuffer",
     "env/gym": "rl_tpu.envs.libs.gym.GymEnv",
+    "env/brax": "rl_tpu.envs.libs.brax.BraxEnv",
+    "env/jumanji": "rl_tpu.envs.libs.jumanji.JumanjiEnv",
+    "env/pettingzoo": "rl_tpu.envs.libs.pettingzoo.PettingZooEnv",
     "loss/ppo_clip": "rl_tpu.objectives.ClipPPOLoss",
     "network/conv": "rl_tpu.modules.ConvNet",
     "network/dueling": "rl_tpu.modules.DuelingMLP",

@@ -70,20 +70,28 @@ class _ZlibCodec:
         return np.frombuffer(zlib.decompress(blob), dtype=dtype).reshape(shape)
 
 
+_MP4_PROBE: list = []  # cached probe result; cannot change within a process
+
+
+def _mp4_available() -> bool:
+    if not _MP4_PROBE:
+        try:
+            _MP4Codec().encode(np.zeros((2, 16, 16, 3), np.uint8))
+            _MP4_PROBE.append(True)
+        except Exception:
+            _MP4_PROBE.append(False)
+    return _MP4_PROBE[0]
+
+
 def _pick_codec(name: str):
     if name == "zlib":
         return _ZlibCodec()
-    if name in ("mp4", "auto"):
-        try:
-            import imageio.v3 as iio  # noqa: F401
-
-            codec = _MP4Codec()
-            codec.encode(np.zeros((2, 16, 16, 3), np.uint8))  # probe ffmpeg
-            return codec
-        except Exception:
-            if name == "mp4":
-                raise
-            return _ZlibCodec()
+    if name == "mp4":
+        if not _mp4_available():
+            raise RuntimeError("codec='mp4' but no working ffmpeg backend")
+        return _MP4Codec()
+    if name == "auto":
+        return _MP4Codec() if _mp4_available() else _ZlibCodec()
     raise ValueError(f"unknown codec {name!r} (mp4/zlib/auto)")
 
 

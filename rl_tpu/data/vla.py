@@ -36,7 +36,9 @@ __all__ = [
     "AddActionChunks",
 ]
 
-# the shared key defaults (reference schema.py module constants)
+# documentation of the FIXED canonical layout for consumers building keys
+# (reference schema.py module constants); validate/AddActionChunks use the
+# same literal paths — remapping this dict does not reconfigure them
 VLA_KEYS = {
     "image": ("observation", "image"),
     "state": ("observation", "state"),
@@ -88,25 +90,24 @@ def build_action_chunks(actions, chunk: int, episode_len=None):
     """[..., T, A] -> (chunks [..., T, chunk, A], is_pad [..., T, chunk]).
 
     Each step t carries the next ``chunk`` actions (ACT/diffusion-policy
-    training targets). Steps past the episode tail are flagged in is_pad
-    and hold the last valid action repeated (clamped gather — jit-safe).
+    training targets). Slots past the (per-trajectory) episode tail are
+    flagged in is_pad and hold the LAST VALID action repeated — the gather
+    clamps at episode_len-1, never reading past an episode's end (packed
+    buffers can hold a neighboring episode there). jit-safe.
     """
+    batch = actions.shape[:-2]
     T = actions.shape[-2]
     t_idx = jnp.arange(T)[:, None] + jnp.arange(chunk)[None, :]  # [T, chunk]
     if episode_len is None:
-        is_pad = t_idx >= T
+        limit = jnp.asarray(T)[None, None]
     else:
-        # per-trajectory lengths [*B] broadcast over the trailing [T, chunk]
-        limit = jnp.asarray(episode_len).reshape(
-            *jnp.shape(episode_len), 1, 1
-        )
-        is_pad = t_idx >= limit
-    gather = jnp.clip(t_idx, 0, T - 1)
-    chunks = jnp.take(actions, gather.reshape(-1), axis=-2)
-    chunks = chunks.reshape(*actions.shape[:-2], T, chunk, actions.shape[-1])
-    # broadcast is_pad over leading batch dims
-    pad = jnp.broadcast_to(is_pad, (*actions.shape[:-2], T, chunk))
-    return chunks, pad
+        # per-trajectory lengths [*B] -> [*B, 1, 1]
+        limit = jnp.asarray(episode_len).reshape(*jnp.shape(episode_len), 1, 1)
+    is_pad = jnp.broadcast_to(t_idx >= limit, (*batch, T, chunk))
+    gather = jnp.minimum(jnp.clip(t_idx, 0, T - 1), limit - 1)  # [*B?, T, chunk]
+    idx = jnp.broadcast_to(gather, (*batch, T, chunk)).reshape(*batch, T * chunk)
+    chunks = jnp.take_along_axis(actions, idx[..., None], axis=-2)
+    return chunks.reshape(*batch, T, chunk, actions.shape[-1]), is_pad
 
 
 class AddActionChunks:

@@ -1,12 +1,29 @@
 from .gym import GymEnv, GymWrapper, spec_from_gym_space
 
-__all__ = ["GymWrapper", "GymEnv", "spec_from_gym_space", "PettingZooEnv", "PettingZooWrapper"]
+__all__ = [
+    "GymWrapper",
+    "GymEnv",
+    "spec_from_gym_space",
+    "PettingZooEnv",
+    "PettingZooWrapper",
+    "BraxEnv",
+    "JumanjiEnv",
+    "spec_from_jumanji",
+]
 
 
 def __getattr__(name):
-    # pettingzoo import is optional; load the bridge lazily
+    # third-party imports are optional; load each bridge lazily
     if name in ("PettingZooEnv", "PettingZooWrapper"):
         from . import pettingzoo as _pz
 
         return getattr(_pz, name)
+    if name == "BraxEnv":
+        from .brax import BraxEnv
+
+        return BraxEnv
+    if name in ("JumanjiEnv", "spec_from_jumanji"):
+        from . import jumanji as _jm
+
+        return getattr(_jm, name)
     raise AttributeError(name)

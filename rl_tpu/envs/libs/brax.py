@@ -1,0 +1,118 @@
+"""Brax bridge: physics env as a pure-functional EnvBase.
+
+Redesign of the reference's BraxWrapper (reference: torchrl/envs/libs/
+brax.py:70 — wraps brax's functional API back into the stateful torch env
+protocol, shuttling tensors across a device boundary). Here no inversion is
+needed: brax is already (reset, step) over pytree states in JAX, so the
+bridge is a thin relabeling that carries ``brax.State`` inside the EnvState
+pytree — the whole env runs INSIDE the fused program (collectors scan it,
+vmap batches it, shard_map shards it).
+
+Import-gated: brax is optional; construction raises ImportError without it.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from ...data import ArrayDict, Bounded, Composite, Unbounded
+from ..base import EnvBase
+
+__all__ = ["BraxEnv"]
+
+
+class BraxEnv(EnvBase):
+    """``BraxEnv("ant")`` — any `brax.envs` registry name.
+
+    Episode ends map: brax ``done`` is termination. Pass
+    ``episode_length=N`` to get time-limit truncation: the env is then
+    built via ``brax.envs.create`` with the EpisodeWrapper (which writes
+    info["truncation"]) and WITHOUT brax's auto-reset — EnvBase owns
+    autoreset (reference BraxWrapper does the same inversion). Without
+    ``episode_length`` the raw env never truncates.
+    """
+
+    def __init__(
+        self,
+        env_name: str,
+        backend: str | None = None,
+        episode_length: int | None = None,
+        **kwargs,
+    ):
+        try:
+            from brax import envs as brax_envs
+        except ImportError as e:  # pragma: no cover - optional dep
+            raise ImportError(
+                "BraxEnv requires the 'brax' package (not in this image)"
+            ) from e
+        if backend is not None:
+            kwargs["backend"] = backend
+        if episode_length is not None:
+            self._env = brax_envs.create(
+                env_name,
+                episode_length=episode_length,
+                auto_reset=False,
+                **kwargs,
+            )
+        else:
+            # raw env: no brax-side wrappers at all
+            self._env = brax_envs.get_environment(env_name, **kwargs)
+        self.env_name = env_name
+
+    # -- specs ----------------------------------------------------------------
+
+    @property
+    def observation_spec(self) -> Composite:
+        return Composite(
+            observation=Unbounded(shape=(self._env.observation_size,))
+        )
+
+    @property
+    def action_spec(self):
+        n = self._env.action_size
+        return Bounded(shape=(n,), low=-1.0, high=1.0)
+
+    # -- hooks ----------------------------------------------------------------
+
+    def _reset(self, key: jax.Array):
+        bstate = self._env.reset(key)
+        state = ArrayDict(brax=_as_arraydict(bstate))
+        return state, ArrayDict(observation=bstate.obs)
+
+    def _step(self, state: ArrayDict, action: Any, key: jax.Array):
+        bstate = _from_arraydict(self._raw_state_struct(), state["brax"])
+        bstate = self._env.step(bstate, jnp.asarray(action))
+        term = bstate.done.astype(bool)
+        trunc = jnp.asarray(
+            bstate.info.get("truncation", jnp.zeros_like(bstate.done)), bool
+        )
+        # brax folds truncation into done; termination = done and not trunc
+        term = jnp.logical_and(term, jnp.logical_not(trunc))
+        return (
+            ArrayDict(brax=_as_arraydict(bstate)),
+            ArrayDict(observation=bstate.obs),
+            bstate.reward.astype(jnp.float32),
+            term,
+            trunc,
+        )
+
+    def _raw_state_struct(self):
+        if not hasattr(self, "_struct"):
+            self._struct = jax.eval_shape(self._env.reset, jax.random.key(0))
+        return self._struct
+
+
+def _as_arraydict(bstate) -> ArrayDict:
+    """brax.State (a pytree dataclass) -> flat ArrayDict of its leaves."""
+    leaves, treedef = jax.tree.flatten(bstate)
+    return ArrayDict({f"leaf_{i}": leaf for i, leaf in enumerate(leaves)})
+
+
+def _from_arraydict(struct, td: ArrayDict):
+    """Rebuild the brax.State pytree from the stored leaves."""
+    _, treedef = jax.tree.flatten(struct)
+    leaves = [td[f"leaf_{i}"] for i in range(len(td.keys()))]
+    return jax.tree.unflatten(treedef, leaves)

@@ -208,3 +208,14 @@ class TestReviewRegressions2:
         state = st.set(state, [0], [item])
         out = st.get(state, [0])[0]
         assert out["pixels"].shape == (4, 15, 17, 3)
+
+    def test_pad_slots_hold_last_valid_action(self):
+        # regression: gather must clamp at episode_len-1, not read past it
+        actions = jnp.arange(10, dtype=jnp.float32).reshape(1, 10, 1)
+        chunks, pad = build_action_chunks(actions, chunk=3, episode_len=jnp.array([4]))
+        c = np.asarray(chunks)[0, :, :, 0]
+        # step 3 (last valid): slots beyond the episode repeat action 3
+        assert c[3].tolist() == [3.0, 3.0, 3.0]
+        assert np.asarray(pad)[0, 3].tolist() == [False, True, True]
+        # step 2 sees [2, 3, 3] — never action 4+ (the next packed episode)
+        assert c[2].tolist() == [2.0, 3.0, 3.0]
