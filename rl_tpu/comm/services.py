@@ -38,14 +38,16 @@ class ServiceRegistry:
             if not replace and name in self._services:
                 raise ValueError(f"service {name!r} already registered")
             self._services[name] = service
-        if self._watchdog is not None:
-            self._watchdog.register(name)
+            # watchdog update inside the lock so registry and watchdog can
+            # never disagree (watchdog's own lock nests without deadlock)
+            if self._watchdog is not None:
+                self._watchdog.register(name)
 
     def unregister(self, name: str) -> None:
         with self._lock:
             self._services.pop(name, None)
-        if self._watchdog is not None:
-            self._watchdog.unregister(name)
+            if self._watchdog is not None:
+                self._watchdog.unregister(name)
 
     def get(self, name: str) -> Any:
         if self._watchdog is not None and name in self._watchdog.dead:

@@ -70,16 +70,16 @@ class _ZlibCodec:
         return np.frombuffer(zlib.decompress(blob), dtype=dtype).reshape(shape)
 
 
-_MP4_PROBE: list = []  # cached probe result; cannot change within a process
+_MP4_PROBE: list = []  # cached (ok, error) probe; cannot change in-process
 
 
-def _mp4_available() -> bool:
+def _mp4_probe() -> tuple[bool, Exception | None]:
     if not _MP4_PROBE:
         try:
             _MP4Codec().encode(np.zeros((2, 16, 16, 3), np.uint8))
-            _MP4_PROBE.append(True)
-        except Exception:
-            _MP4_PROBE.append(False)
+            _MP4_PROBE.append((True, None))
+        except Exception as e:  # noqa: BLE001 - kept for diagnosis
+            _MP4_PROBE.append((False, e))
     return _MP4_PROBE[0]
 
 
@@ -87,11 +87,14 @@ def _pick_codec(name: str):
     if name == "zlib":
         return _ZlibCodec()
     if name == "mp4":
-        if not _mp4_available():
-            raise RuntimeError("codec='mp4' but no working ffmpeg backend")
+        ok, err = _mp4_probe()
+        if not ok:
+            raise RuntimeError(
+                "codec='mp4' but no working ffmpeg backend"
+            ) from err
         return _MP4Codec()
     if name == "auto":
-        return _MP4Codec() if _mp4_available() else _ZlibCodec()
+        return _MP4Codec() if _mp4_probe()[0] else _ZlibCodec()
     raise ValueError(f"unknown codec {name!r} (mp4/zlib/auto)")
 
 

@@ -1,0 +1,25 @@
+"""Shared helpers for carrying third-party pytree env states inside
+EnvState ArrayDicts (used by the brax and jumanji bridges)."""
+
+from __future__ import annotations
+
+import jax
+
+from ...data import ArrayDict
+
+__all__ = ["flatten_state", "unflatten_state"]
+
+
+def flatten_state(state) -> ArrayDict:
+    """Any pytree (brax.State, jumanji state dataclass) -> flat ArrayDict of
+    its leaves, keyed leaf_0..leaf_{n-1} in tree-flatten order."""
+    leaves, _ = jax.tree.flatten(state)
+    return ArrayDict({f"leaf_{i}": leaf for i, leaf in enumerate(leaves)})
+
+
+def unflatten_state(struct, td: ArrayDict):
+    """Rebuild the original pytree from stored leaves; ``struct`` is an
+    eval_shape template with the same treedef."""
+    _, treedef = jax.tree.flatten(struct)
+    n = len(td.keys())
+    return jax.tree.unflatten(treedef, [td[f"leaf_{i}"] for i in range(n)])

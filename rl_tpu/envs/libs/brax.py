@@ -20,6 +20,7 @@ import jax.numpy as jnp
 
 from ...data import ArrayDict, Bounded, Composite, Unbounded
 from ..base import EnvBase
+from ._pytree import flatten_state, unflatten_state
 
 __all__ = ["BraxEnv"]
 
@@ -79,11 +80,11 @@ class BraxEnv(EnvBase):
 
     def _reset(self, key: jax.Array):
         bstate = self._env.reset(key)
-        state = ArrayDict(brax=_as_arraydict(bstate))
+        state = ArrayDict(brax=flatten_state(bstate))
         return state, ArrayDict(observation=bstate.obs)
 
     def _step(self, state: ArrayDict, action: Any, key: jax.Array):
-        bstate = _from_arraydict(self._raw_state_struct(), state["brax"])
+        bstate = unflatten_state(self._raw_state_struct(), state["brax"])
         bstate = self._env.step(bstate, jnp.asarray(action))
         term = bstate.done.astype(bool)
         trunc = jnp.asarray(
@@ -92,7 +93,7 @@ class BraxEnv(EnvBase):
         # brax folds truncation into done; termination = done and not trunc
         term = jnp.logical_and(term, jnp.logical_not(trunc))
         return (
-            ArrayDict(brax=_as_arraydict(bstate)),
+            ArrayDict(brax=flatten_state(bstate)),
             ArrayDict(observation=bstate.obs),
             bstate.reward.astype(jnp.float32),
             term,
@@ -103,16 +104,3 @@ class BraxEnv(EnvBase):
         if not hasattr(self, "_struct"):
             self._struct = jax.eval_shape(self._env.reset, jax.random.key(0))
         return self._struct
-
-
-def _as_arraydict(bstate) -> ArrayDict:
-    """brax.State (a pytree dataclass) -> flat ArrayDict of its leaves."""
-    leaves, treedef = jax.tree.flatten(bstate)
-    return ArrayDict({f"leaf_{i}": leaf for i, leaf in enumerate(leaves)})
-
-
-def _from_arraydict(struct, td: ArrayDict):
-    """Rebuild the brax.State pytree from the stored leaves."""
-    _, treedef = jax.tree.flatten(struct)
-    leaves = [td[f"leaf_{i}"] for i in range(len(td.keys()))]
-    return jax.tree.unflatten(treedef, leaves)

@@ -19,6 +19,7 @@ import jax.numpy as jnp
 
 from ...data import ArrayDict, Bounded, Categorical, Composite, Unbounded
 from ..base import EnvBase
+from ._pytree import flatten_state, unflatten_state
 
 __all__ = ["JumanjiEnv", "spec_from_jumanji"]
 
@@ -74,10 +75,10 @@ class JumanjiEnv(EnvBase):
 
     def _reset(self, key: jax.Array):
         state, timestep = self._env.reset(key)
-        return ArrayDict(jumanji=_flatten_state(state)), self._obs_td(timestep)
+        return ArrayDict(jumanji=flatten_state(state)), self._obs_td(timestep)
 
     def _step(self, state: ArrayDict, action: Any, key: jax.Array):
-        jstate = _unflatten_state(self._state_struct(), state["jumanji"])
+        jstate = unflatten_state(self._state_struct(), state["jumanji"])
         jstate, timestep = self._env.step(jstate, action)
         # dm_env semantics: step_type LAST(2) = episode end; discount>0 at
         # LAST means truncation (bootstrap survives), discount==0 termination
@@ -87,7 +88,7 @@ class JumanjiEnv(EnvBase):
         term = jnp.logical_and(last, disc0 == 0.0)
         trunc = jnp.logical_and(last, disc0 > 0.0)
         return (
-            ArrayDict(jumanji=_flatten_state(jstate)),
+            ArrayDict(jumanji=flatten_state(jstate)),
             self._obs_td(timestep),
             jnp.asarray(timestep.reward, jnp.float32),
             term,
@@ -100,13 +101,3 @@ class JumanjiEnv(EnvBase):
                 lambda k: self._env.reset(k)[0], jax.random.key(0)
             )
         return self._struct
-
-
-def _flatten_state(state) -> ArrayDict:
-    leaves, _ = jax.tree.flatten(state)
-    return ArrayDict({f"leaf_{i}": leaf for i, leaf in enumerate(leaves)})
-
-
-def _unflatten_state(struct, td: ArrayDict):
-    _, treedef = jax.tree.flatten(struct)
-    return jax.tree.unflatten(treedef, [td[f"leaf_{i}"] for i in range(len(td.keys()))])

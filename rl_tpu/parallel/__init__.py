@@ -9,6 +9,7 @@ from .mesh import (
     shard_train_state,
     sharded,
 )
+from .pipeline import AXIS_PIPE, pipe_mesh, pipeline_apply, stack_stage_params
 from .ring_attention import attention_reference, ring_attention
 from .ulysses import ulysses_attention
 
@@ -25,4 +26,8 @@ __all__ = [
     "ring_attention",
     "attention_reference",
     "ulysses_attention",
+    "AXIS_PIPE",
+    "pipe_mesh",
+    "pipeline_apply",
+    "stack_stage_params",
 ]
