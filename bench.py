@@ -138,11 +138,10 @@ def main():
 
 def bench_attention():
     """BENCH_MODE=attention: Pallas flash attention vs plain XLA attention,
-    FORWARD pass, on the real chip (VERDICT round-1 weak #4 — the kernel
-    had never been timed on TPU). The forward is the kernel's deployment
-    path (serving/inference; the training path is ring attention or the
-    dense-recompute backward). Reports the flash/XLA speedup; > 1 means
-    the Pallas kernel wins at this shape."""
+    forward + full backward (the training path; flash bwd kernels), on the
+    real chip (VERDICT round-1 weak #4 — the kernel had never been timed
+    on TPU). Reports the flash/XLA speedup; > 1 means the Pallas kernels
+    win at this shape."""
     import jax
     import jax.numpy as jnp
 
@@ -169,33 +168,36 @@ def bench_attention():
         return jnp.einsum("bhqk,bkhd->bqhd", p, v)
 
     def run(fn, reps=2 if _SMOKE else 20):
-        # FORWARD pass (the kernel's deployment path: flash forward for
-        # inference/serving; training uses ring attention / dense-recompute
-        # backward). Time N chained iterations INSIDE one jit call: the axon
-        # relay adds tens of ms of per-dispatch latency (and its async
-        # block_until_ready is unreliable), so per-call host timing is
-        # garbage either way.
+        # forward + FULL backward (dq, dk, dv) — the training path. Time N
+        # chained iterations INSIDE one jit call: the axon relay adds tens
+        # of ms of per-dispatch latency (and its async block_until_ready is
+        # unreliable), so per-call host timing is garbage either way.
         from jax import lax
 
-        def chain(q0):
+        def chain(carry0):
             def body(_, carry):
-                o = fn(carry, k, v)
-                return carry + o.astype(dtype) * jnp.asarray(1e-6, dtype)
-            return lax.fori_loop(0, reps, body, q0).astype(jnp.float32).sum()
+                g = jax.grad(
+                    lambda t: fn(*t).astype(jnp.float32).sum()
+                )(carry)
+                eps = jnp.asarray(1e-8, dtype)
+                return tuple(c + gi.astype(dtype) * eps for c, gi in zip(carry, g))
+            out = lax.fori_loop(0, reps, body, carry0)
+            return sum(o.astype(jnp.float32).sum() for o in out)
 
         jit_chain = jax.jit(chain)
-        float(jit_chain(q))  # compile + warm
+        float(jit_chain((q, k, v)))  # compile + warm
         t0 = time.perf_counter()
-        float(jit_chain(q))
+        float(jit_chain((q, k, v)))
         return (time.perf_counter() - t0) / reps
 
     t_flash = run(
         lambda q, k, v: flash_attention(q, k, v, causal=True, interpret=interpret)
     )
     t_xla = run(xla_attn)
-    # causal attention forward: 2 matmuls x 2*B*H*T^2*D MACs, halved by the
-    # causal mask
-    flops = 2 * 2 * B * H * T * T * D / 2
+    # causal attention fwd+bwd: (2 fwd + 5 bwd) matmuls x 2*B*H*T^2*D FLOPs
+    # each, halved by the causal mask (ideal algorithm FLOPs, recompute not
+    # counted — standard MFU accounting)
+    flops = 7 * 2 * B * H * T * T * D / 2
     kind = jax.devices()[0].device_kind
     peak = next((v for kk_, v in _PEAK_FLOPS.items() if kk_.lower() in kind.lower()), 100e12)
     print(
@@ -214,6 +216,59 @@ def bench_attention():
         ),
         flush=True,
     )
+
+
+def bench_hostenv():
+    """BENCH_MODE=hostenv: host-env collection throughput (gymnasium
+    CartPole through ThreadedEnvPool + HostCollector with a jitted batched
+    MLP policy served per step — the ParallelEnv-analog path; reference
+    benchmarks/test_collectors_benchmark.py). vs_baseline compares against
+    the reference's async collector throughput band (~4.4k fps, BASELINE.md
+    config #6)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    plat = os.environ.get("BENCH_PLATFORM")
+    if plat:
+        jax.config.update("jax_platforms", plat)
+
+    from rl_tpu.collectors import HostCollector, ThreadedEnvPool
+    from rl_tpu.envs.libs import GymEnv
+    from rl_tpu.modules import MLP
+
+    n_envs = 4 if _SMOKE else 16
+    frames = 256 if _SMOKE else 8192
+    pool = ThreadedEnvPool([lambda: GymEnv("CartPole-v1") for _ in range(n_envs)])
+    net = MLP(out_features=2, num_cells=(64, 64))
+    params = net.init(jax.random.key(1), jnp.zeros((1, 4)))["params"]
+
+    def policy(p, td, key):
+        logits = net.apply({"params": p}, td["observation"])
+        return td.set("action", jax.random.categorical(key, logits))
+
+    coll = HostCollector(pool, policy, frames_per_batch=frames)
+    key = jax.random.key(0)
+    coll.collect(params, key)  # warm (compile the policy, prime envs)
+    t0 = time.perf_counter()
+    batch = coll.collect(params, key)
+    dt = time.perf_counter() - t0
+    pool.close()
+    fps = frames / dt
+    print(
+        json.dumps(
+            {
+                "metric": "host_env_steps_per_sec",
+                "value": round(fps, 1),
+                "unit": "env_steps/s",
+                "vs_baseline": round(fps / 4400.0, 3),
+                "n_envs": n_envs,
+                "error": None,
+            }
+        ),
+        flush=True,
+    )
+    assert np.isfinite(float(batch["next"]["reward"].sum()))
 
 
 def _watchdog(seconds: float):
@@ -235,7 +290,7 @@ if __name__ == "__main__":
     timer = _watchdog(float(os.environ.get("BENCH_TIMEOUT", "900")))
     mode = os.environ.get("BENCH_MODE", "ppo")
     try:
-        {"ppo": main, "attention": bench_attention}[mode]()
+        {"ppo": main, "attention": bench_attention, "hostenv": bench_hostenv}[mode]()
         timer.cancel()
     except BaseException:  # always emit the JSON line, whatever happened
         _report(error=traceback.format_exc(limit=5))

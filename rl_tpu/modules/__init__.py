@@ -103,4 +103,5 @@ __all__ = [
 
 from .actors_extra import MultiStepActorWrapper
 from .inference_server import InferenceClient, InferenceServer
-__all__ += ["InferenceServer", "InferenceClient"]
+from .multiagent import CrossGroupCritic
+__all__ += ["InferenceServer", "InferenceClient", "CrossGroupCritic"]

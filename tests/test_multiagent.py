@@ -163,3 +163,50 @@ class TestMAPPO:
         early, late = np.mean(rewards[:5]), np.mean(rewards[-5:])
         assert late > early + 0.5, f"MAPPO failed to learn: {early:.2f} -> {late:.2f}"
         assert late > 0.8 * N_AGENTS  # near the analytic optimum
+
+
+class TestCrossGroupCritic:
+    """Heterogeneous-group centralized critic (VERDICT row 34 gap)."""
+
+    def _obs(self, B=4):
+        return {
+            "agents": jnp.ones((B, 3, 8)),
+            "adversaries": jnp.zeros((B, 2, 6)),
+        }
+
+    def test_shapes_per_group(self):
+        from rl_tpu.modules import CrossGroupCritic
+
+        critic = CrossGroupCritic({"agents": (3, 8), "adversaries": (2, 6)})
+        params = critic.init(KEY, self._obs())
+        out = critic(params, self._obs())
+        assert out["agents"].shape == (4, 3, 1)
+        assert out["adversaries"].shape == (4, 2, 1)
+
+    def test_sees_other_group(self):
+        """values for group A must react to group B's observations."""
+        from rl_tpu.modules import CrossGroupCritic
+
+        critic = CrossGroupCritic({"agents": (3, 8), "adversaries": (2, 6)})
+        params = critic.init(KEY, self._obs())
+        o1 = self._obs()
+        o2 = {**o1, "adversaries": o1["adversaries"] + 1.0}
+        v1 = critic(params, o1)["agents"]
+        v2 = critic(params, o2)["agents"]
+        assert float(jnp.abs(v1 - v2).max()) > 1e-6
+
+    def test_wrong_shape_raises(self):
+        from rl_tpu.modules import CrossGroupCritic
+
+        critic = CrossGroupCritic({"agents": (3, 8)})
+        with pytest.raises(ValueError, match="expected"):
+            critic.init(KEY, {"agents": jnp.ones((4, 2, 8))})
+
+    def test_gradients_flow_to_trunk(self):
+        from rl_tpu.modules import CrossGroupCritic
+
+        critic = CrossGroupCritic({"agents": (2, 4), "adversaries": (1, 3)})
+        obs = {"agents": jnp.ones((2, 2, 4)), "adversaries": jnp.ones((2, 1, 3))}
+        params = critic.init(KEY, obs)
+        g = jax.grad(lambda p: sum(jnp.sum(v) for v in critic(p, obs).values()))(params)
+        assert max(float(jnp.abs(x).max()) for x in jax.tree.leaves(g["trunk"])) > 0
