@@ -131,7 +131,8 @@ _add_group("transform", "rl_tpu.envs", [
     "EndOfLifeTransform", "ExcludeTransform", "SelectTransform", "FiniteCheck",
     "Hash", "LineariseRewards", "ModuleTransform", "PermuteTransform",
     "SignTransform", "StackTransform", "TensorDictPrimer", "Timer",
-    "TrajCounter",
+    "TrajCounter", "TargetReturn", "Crop", "DiscreteActionProjection",
+    "UnaryTransform", "RandomTruncationTransform",
 ], strip="Transform")
 _add_group("network", "rl_tpu.modules", [
     "MLP", "ConcatMLP", "ConvNet", "DuelingMLP", "TanhPolicy", "NoisyDense",

@@ -1,0 +1,184 @@
+"""Transform library, third wave.
+
+Remaining reference exports worth native forms (reference:
+torchrl/envs/transforms/transforms.py): return-conditioning
+(``TargetReturn`` — decision-transformer inference), image ``Crop``,
+action-space projection (``DiscreteActionProjection``), generic per-key
+functions (``UnaryTransform``), and stochastic episode cutting
+(``RandomTruncationTransform``). Same pure-state conventions as base.py.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+import dataclasses
+
+from ...data import ArrayDict, Bounded, Categorical, Composite, Unbounded
+from .base import Transform
+from .common import _KeyedTransform
+
+__all__ = [
+    "TargetReturn",
+    "Crop",
+    "DiscreteActionProjection",
+    "UnaryTransform",
+    "RandomTruncationTransform",
+]
+
+
+class TargetReturn(Transform):
+    """Return-to-go conditioning key (reference TargetReturn).
+
+    Writes ``target_return`` at reset; each step either decrements it by
+    the received reward (``mode="reduce"``, the DT convention) or keeps it
+    fixed (``mode="constant"``).
+    """
+
+    def __init__(self, target_return: float, mode: str = "reduce", key: str = "target_return"):
+        if mode not in ("reduce", "constant"):
+            raise ValueError(f"mode {mode!r} not in ('reduce', 'constant')")
+        self.target = float(target_return)
+        self.mode = mode
+        self.key = key
+
+    def init(self, reset_td: ArrayDict) -> ArrayDict:
+        shape = reset_td["done"].shape
+        return ArrayDict(target=jnp.full(shape, self.target, jnp.float32))
+
+    def reset(self, tstate, td):
+        return tstate, td.set(self.key, tstate["target"])
+
+    def step(self, tstate, next_td):
+        if self.mode == "reduce":
+            tstate = tstate.set(
+                "target", tstate["target"] - next_td["reward"].astype(jnp.float32)
+            )
+        return tstate, next_td.set(self.key, tstate["target"])
+
+    def transform_observation_spec(self, spec: Composite) -> Composite:
+        return spec.set(self.key, Unbounded(shape=(), dtype=jnp.float32))
+
+
+class Crop(_KeyedTransform):
+    """Fixed offset crop of the trailing HWC dims (reference Crop) — the
+    top/left-anchored sibling of image.py's CenterCrop, sharing its keyed
+    machinery and spec handling."""
+
+    def __init__(self, height: int, width: int, top: int = 0, left: int = 0, in_keys=("pixels",)):
+        super().__init__(in_keys)
+        self.h, self.w, self.top, self.left = height, width, top, left
+
+    def _apply_leaf(self, x):
+        return x[..., self.top : self.top + self.h, self.left : self.left + self.w, :]
+
+    def transform_observation_spec(self, spec: Composite) -> Composite:
+        for k in self._keys(spec):
+            leaf = spec[k]
+            new_shape = (*leaf.shape[:-3], self.h, self.w, leaf.shape[-1])
+            spec = spec.set(
+                k,
+                dataclasses.replace(leaf, shape=new_shape)
+                if not isinstance(leaf, Bounded)
+                else Unbounded(shape=new_shape, dtype=leaf.dtype),
+            )
+        return spec
+
+
+class DiscreteActionProjection(Transform):
+    """Project actions from a larger discrete space onto the env's n
+    (reference DiscreteActionProjection): the OUTER spec advertises
+    ``num_actions`` choices, actions >= n fold back via modulo before the
+    base env sees them. Used when replaying data whose action space was
+    widened (e.g. action-masked training)."""
+
+    def __init__(self, num_actions: int):
+        self.num_actions = num_actions
+        self._n_base: int | None = None
+
+    def inv(self, td: ArrayDict) -> ArrayDict:
+        if self._n_base is None:
+            raise RuntimeError("spec transformation must run before data")
+        a = td["action"]
+        return td.set("action", jnp.mod(a, self._n_base).astype(a.dtype))
+
+    def transform_action_spec(self, spec):
+        if not isinstance(spec, Categorical):
+            raise TypeError("DiscreteActionProjection needs a Categorical action spec")
+        if self.num_actions < spec.n:
+            raise ValueError(
+                f"num_actions ({self.num_actions}) must be >= the env's ({spec.n})"
+            )
+        self._n_base = int(spec.n)
+        return Categorical(n=self.num_actions, shape=spec.shape, dtype=spec.dtype)
+
+
+class UnaryTransform(Transform):
+    """Apply an arbitrary (jit-safe) function to keys (reference
+    UnaryTransform): ``out_key = fn(td[in_key])`` on both reset and step
+    paths; ``spec_fn`` derives the out spec (identity by default)."""
+
+    def __init__(self, in_key, out_key, fn: Callable, spec_fn: Callable | None = None):
+        self.in_key = in_key if isinstance(in_key, tuple) else (in_key,)
+        self.out_key = out_key if isinstance(out_key, tuple) else (out_key,)
+        self.fn = fn
+        self.spec_fn = spec_fn
+
+    def _apply(self, td: ArrayDict) -> ArrayDict:
+        # presence guard: step-only keys (reward) are absent on the reset path
+        if self.in_key not in td:
+            return td
+        return td.set(self.out_key, self.fn(td[self.in_key]))
+
+    def reset(self, tstate, td):
+        return tstate, self._apply(td)
+
+    def step(self, tstate, next_td):
+        return tstate, self._apply(next_td)
+
+    def transform_observation_spec(self, spec: Composite) -> Composite:
+        if self.in_key in spec:
+            out = self.spec_fn(spec[self.in_key]) if self.spec_fn else spec[self.in_key]
+            spec = spec.set(self.out_key, out)
+        return spec
+
+
+class RandomTruncationTransform(Transform):
+    """Truncate episodes with probability ``p`` per step (reference
+    RandomTruncationTransform — randomized horizons decorrelate resets in
+    vectorized fleets). The PRNG chain rides in transform state."""
+
+    def __init__(self, p: float, seed: int = 0):
+        self.p = float(p)
+        self.seed = seed
+
+    def init(self, reset_td: ArrayDict) -> ArrayDict:
+        # fold per-instance entropy from the reset observations: under
+        # VmapEnv(TransformedEnv(...)) each lane calls init() with its own
+        # reset data, so lanes get DECORRELATED chains instead of the
+        # lockstep truncation a constant seed would give. (Envs whose reset
+        # obs are constant across lanes still correlate — wrap the batched
+        # env instead: TransformedEnv(VmapEnv(...), ...).)
+        ent = jnp.uint32(self.seed)
+        for _, leaf in reset_td.items(nested=True, leaves_only=True):
+            if jnp.issubdtype(leaf.dtype, jnp.floating):
+                bits = jax.lax.bitcast_convert_type(
+                    leaf.astype(jnp.float32), jnp.uint32
+                )
+                ent = ent ^ jnp.sum(bits, dtype=jnp.uint32)
+        return ArrayDict(rng=jax.random.fold_in(jax.random.key(self.seed), ent))
+
+    def step(self, tstate, next_td):
+        k_cut, k_next = jax.random.split(tstate["rng"])
+        cut = jax.random.bernoulli(k_cut, self.p, next_td["done"].shape)
+        trunc = jnp.logical_or(next_td["truncated"], cut)
+        next_td = next_td.set("truncated", trunc).set(
+            "done", jnp.logical_or(next_td["done"], trunc)
+        )
+        return tstate.set("rng", k_next), next_td
+
+    def on_done(self, reset_tstate, tstate, done):
+        return tstate  # the rng chain is global state, never reset

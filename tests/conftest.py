@@ -6,9 +6,11 @@ here we instead ask XLA for 8 host devices so every sharding/pjit test runs
 the real partitioner without TPU hardware.
 
 Tiers (reference CI's per-job isolation, SURVEY §4):
-- smoke:  ``pytest -m "not slow and not mesh"``  (~2 min on a 1-core box)
+- smoke:  ``pytest -m "smoke and not slow"`` — core data/env/value/config
+  coverage, <2 min on this 1-core box (the marker is auto-applied below)
+- fast:   ``pytest -m "not slow and not mesh"`` (~4-5 min on 1 core)
 - mesh:   ``pytest -m mesh`` — multi-device sharding/pjit tests
-- full:   ``pytest tests/`` — everything (what the driver runs)
+- full:   ``pytest tests/`` — everything (what the driver runs, ~20 min)
 Compile artifacts persist in RL_TPU_TEST_CACHE between runs, and XLA's
 backend optimization level is dropped for tests (hundreds of tiny programs;
 codegen quality is irrelevant to correctness).
@@ -41,6 +43,23 @@ jax.config.update("jax_compilation_cache_dir", _cache_dir)
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
 import pytest  # noqa: E402
+
+# the <2-min core-coverage tier: one file per load-bearing layer
+_SMOKE_MODULES = {
+    "test_specs",
+    "test_envs",
+    "test_values",
+    "test_config",
+    "test_import_hygiene",
+    "test_collector_ppo",
+    "test_transforms",
+}
+
+
+def pytest_collection_modifyitems(items):
+    for it in items:
+        if it.module.__name__.rpartition(".")[-1] in _SMOKE_MODULES:
+            it.add_marker(pytest.mark.smoke)
 
 
 @pytest.fixture

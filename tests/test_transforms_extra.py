@@ -391,3 +391,100 @@ def test_action_discretizer_inv_without_spec_read():
     td = td.set("action", jnp.zeros((2,), jnp.int32))
     _, out = env.step(state, td)
     assert "next" in out
+
+
+class TestThirdWave:
+    """extra2.py transforms (reference TargetReturn/Crop/
+    DiscreteActionProjection/UnaryTransform/RandomTruncationTransform)."""
+
+    def test_target_return_reduce(self):
+        from rl_tpu.envs import CartPoleEnv, TargetReturn, TransformedEnv
+
+        env = TransformedEnv(CartPoleEnv(), TargetReturn(5.0))
+        state, td = env.reset(KEY)
+        assert float(td["target_return"]) == 5.0
+        td = td.set("action", jnp.asarray(0))
+        state, out = env.step(state, td)
+        # CartPole reward is 1 -> target drops to 4
+        assert float(out["next"]["target_return"]) == 4.0
+        check_env_specs(env)
+
+    def test_target_return_constant(self):
+        from rl_tpu.envs import CartPoleEnv, TargetReturn, TransformedEnv
+
+        env = TransformedEnv(CartPoleEnv(), TargetReturn(3.0, mode="constant"))
+        state, td = env.reset(KEY)
+        td = td.set("action", jnp.asarray(0))
+        _, out = env.step(state, td)
+        assert float(out["next"]["target_return"]) == 3.0
+
+    def test_crop(self):
+        from rl_tpu.envs import Crop
+
+        t = Crop(8, 6, top=2, left=1)
+        td = ArrayDict(pixels=jnp.arange(16 * 16 * 3).reshape(16, 16, 3))
+        _, out = t.step(ArrayDict(), td)
+        assert out["pixels"].shape == (8, 6, 3)
+        np.testing.assert_array_equal(
+            np.asarray(out["pixels"]), np.asarray(td["pixels"])[2:10, 1:7]
+        )
+
+    def test_discrete_action_projection(self):
+        from rl_tpu.envs import CartPoleEnv, DiscreteActionProjection, TransformedEnv
+
+        env = TransformedEnv(CartPoleEnv(), DiscreteActionProjection(6))
+        assert env.action_spec.n == 6
+        state, td = env.reset(KEY)
+        # action 5 folds to 5 % 2 = 1 — must step without error
+        _, out = env.step(state, td.set("action", jnp.asarray(5)))
+        assert bool(out["next"]["done"]) in (True, False)
+        check_env_specs(env)
+
+    def test_unary(self):
+        from rl_tpu.envs import CartPoleEnv, TransformedEnv, UnaryTransform
+
+        env = TransformedEnv(
+            CartPoleEnv(), UnaryTransform("observation", "obs_sq", lambda x: x**2)
+        )
+        state, td = env.reset(KEY)
+        np.testing.assert_allclose(
+            np.asarray(td["obs_sq"]), np.asarray(td["observation"]) ** 2, rtol=1e-6
+        )
+        check_env_specs(env)
+
+    def test_random_truncation_statistics(self):
+        from rl_tpu.envs import PendulumEnv, RandomTruncationTransform, TransformedEnv, VmapEnv
+
+        env = TransformedEnv(
+            VmapEnv(PendulumEnv(), 64), RandomTruncationTransform(p=0.5, seed=1)
+        )
+        state, td = env.reset(KEY)
+        td = td.set("action", jnp.zeros((64, 1)))
+        _, out = env.step(state, td)
+        frac = float(out["next"]["truncated"].mean())
+        assert 0.25 < frac < 0.75  # ~Bernoulli(0.5)
+
+    def test_random_truncation_decorrelated_under_vmap(self):
+        """transform INSIDE VmapEnv: lanes must not truncate in lockstep."""
+        from rl_tpu.envs import PendulumEnv, RandomTruncationTransform, TransformedEnv, VmapEnv
+
+        env = VmapEnv(
+            TransformedEnv(PendulumEnv(), RandomTruncationTransform(p=0.5, seed=3)), 32
+        )
+        state, td = env.reset(KEY)
+        td = td.set("action", jnp.zeros((32, 1)))
+        _, out = env.step(state, td)
+        t = np.asarray(out["next"]["truncated"])
+        assert 0 < t.sum() < 32, t.sum()  # mixed, not all-or-nothing
+
+    def test_unary_on_step_only_key(self):
+        """reward exists only on the step path; reset must not crash."""
+        from rl_tpu.envs import CartPoleEnv, TransformedEnv, UnaryTransform
+
+        env = TransformedEnv(
+            CartPoleEnv(), UnaryTransform("reward", "abs_r", jnp.abs)
+        )
+        state, td = env.reset(KEY)  # no KeyError
+        assert "abs_r" not in td
+        _, out = env.step(state, td.set("action", jnp.asarray(0)))
+        assert float(out["next"]["abs_r"]) == 1.0
