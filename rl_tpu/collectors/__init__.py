@@ -6,13 +6,14 @@ and eager chaining of those imports is what broke the round-1 bench when the
 backend was unreachable — importing *anything* must not import *everything*.
 """
 
-from .host import HostCollector, ProcessEnvPool, ThreadedEnvPool
+from .host import HostCollector, ProcessEnvPool, ThreadedEnvPool, compact_collected
 from .single import Collector, CollectorState
 
 __all__ = [
     "Collector",
     "CollectorState",
     "HostCollector",
+    "compact_collected",
     "ProcessEnvPool",
     "ThreadedEnvPool",
     "LLMCollector",

@@ -262,7 +262,15 @@ class HostCollector:
         if self.interruptor is None:
             return ArrayDict.stack(steps, axis=0)
         if len(steps) < self.scan_length:
-            # preempted: pad to the static [T, N] shape, mask the tail
+            # preempted: pad to the static [T, N] shape, mask the tail.
+            # Mark the cut point truncated+done so value estimators stop the
+            # recursion there (GAE's (1-done) gate) — otherwise the padded
+            # rows' fake deltas would bootstrap into every REAL step's
+            # advantage, which the loss-level mask cannot undo.
+            last = steps[-1]
+            tru = jnp.ones((n,), bool)
+            last = last.set(("next", "truncated"), tru).set(("next", "done"), tru)
+            steps = steps[:-1] + [last]
             pad = self.scan_length - len(steps)
             batch = ArrayDict.stack(steps + [steps[-1]] * pad, axis=0)
             mask = np.zeros((self.scan_length, n), bool)
@@ -278,3 +286,28 @@ class HostCollector:
             key, k = jax.random.split(key)
             yield self.collect(params, k)
             collected += self.frames_per_batch
+
+
+def compact_collected(batch: ArrayDict) -> ArrayDict:
+    """Drop padded rows from a preempted [T, N] HostCollector batch.
+
+    Interruptor-cut batches duplicate the last step to keep shapes static
+    and mark real rows in ``collected_mask``. Losses fold the mask in
+    automatically (ActorCriticLossMixin._mask), but replay-buffer insertion
+    does not — padded rows would enter storage as fake transitions. Call
+    this host-side (dynamic shape is fine off-device) before ``extend``:
+
+    >>> buffer_state = buffer.extend(buffer_state, compact_collected(b).flatten_batch())
+
+    Fully-collected batches pass through with only the mask key removed.
+    Only whole time rows are dropped (the mask is constant across envs
+    within a row), so the [T', N] layout is preserved.
+    """
+    if "collected_mask" not in batch:
+        return batch
+    mask = np.asarray(batch["collected_mask"])
+    rest = batch.exclude("collected_mask")
+    if mask.all():
+        return rest
+    rows = mask.any(axis=1)
+    return jax.tree.map(lambda x: x[np.flatnonzero(rows)], rest)

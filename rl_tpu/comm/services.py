@@ -50,14 +50,18 @@ class ServiceRegistry:
                 self._watchdog.unregister(name)
 
     def get(self, name: str) -> Any:
-        if self._watchdog is not None and name in self._watchdog.dead:
-            raise KeyError(f"service {name!r} is registered but not alive")
+        # Membership first (under the lock): a name in the watchdog's dead
+        # set that was never registered — or already unregistered — must
+        # report "unknown service", not "registered but not alive".
         with self._lock:
             if name not in self._services:
                 raise KeyError(
                     f"unknown service {name!r}; have {sorted(self._services)}"
                 )
-            return self._services[name]
+            service = self._services[name]
+        if self._watchdog is not None and name in self._watchdog.dead:
+            raise KeyError(f"service {name!r} is registered but not alive")
+        return service
 
     def heartbeat(self, name: str) -> None:
         if self._watchdog is not None:

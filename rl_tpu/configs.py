@@ -11,8 +11,8 @@ tree (the exchange format), ``dump_yaml``/``load_recipe`` round-trip it, and
 YAML and dataclasses are two views of the same node tree, so a user can
 author either and the driver path is identical:
 
->>> PPORecipe(env=EnvNode("env/cartpole"), total_steps=1000).build().run()
->>> load_recipe("examples/configs/ppo_cartpole.yaml").run()
+>>> PPORecipe(env=EnvNode("env/cartpole"), total_steps=1000).build().train(0)
+>>> load_recipe("examples/configs/ppo_cartpole.yaml").train(0)
 """
 
 from __future__ import annotations

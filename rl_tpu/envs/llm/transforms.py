@@ -66,11 +66,37 @@ class PolicyVersion:
         return rewards
 
 
+#: Evaluator that runs inside a FRESH ``python -I -S`` subprocess: sets
+#: rlimits on itself, then evals the stdin expression against allowlisted
+#: builtins. A fresh interpreter (~30ms, no JAX/libtpu mappings) keeps the
+#: 512MB RLIMIT_AS meaningful and avoids fork()-ing the multi-threaded
+#: collector process (fork under held malloc/JAX runtime locks can deadlock
+#: the child before it ever reaches eval).
+_SANDBOX_RUNNER = r"""
+import resource, sys
+cpu, mem = int(sys.argv[1]), int(sys.argv[2])
+for lim, val in ((resource.RLIMIT_CPU, cpu), (resource.RLIMIT_AS, mem)):
+    try:
+        resource.setrlimit(lim, (val, val))
+    except (ValueError, OSError):
+        pass
+code = sys.stdin.read()
+safe = {n: getattr(__builtins__, n) for n in sys.argv[3].split(",")}
+try:
+    out = repr(eval(compile(code, "<tool>", "eval"), {"__builtins__": {}}, safe))
+    if len(out) > 4096:
+        out = out[:4096] + "...<truncated>"
+except Exception as e:
+    out = f"error: {type(e).__name__}: {e}"
+sys.stdout.write(out)
+"""
+
+
 class PythonToolTransform:
     """Execute fenced ``python`` blocks in assistant turns and append the
     output as a tool message (reference transforms/tools.py PythonInterpreter
-    — subprocess-isolated there, restricted eval here: zero-egress images
-    can't spawn arbitrary interpreters safely inside the collector loop).
+    — subprocess-isolated there; same here: a fresh rlimit-bounded
+    interpreter per expression, AST-filtered in the parent first).
 
     Host-side, used by multi-turn ChatEnv loops: ``env.step`` calls this on
     each new assistant turn; expressions only (no statements/imports).
@@ -80,11 +106,20 @@ class PythonToolTransform:
     _SAFE = {"abs": abs, "min": min, "max": max, "sum": sum, "len": len,
              "round": round, "range": range, "sorted": sorted}
 
+    #: wall-clock deadline per expression (seconds) and child address-space
+    #: cap — model-emitted ``9**9**9`` or ``sorted(range(10**9))`` must not
+    #: stall or OOM the collector.
+    timeout: float = 2.0
+    memory_limit: int = 512 * 1024 * 1024
+    _MAX_CONST = 10**6  # largest int literal allowed as pow operand
+
     @classmethod
     def _check(cls, tree) -> None:
         """Reject attribute traversal and dunder names: ``().__class__...``
         escapes survive an empty ``__builtins__`` — expressions must stay on
-        the arithmetic/collection/allowlisted-call subset."""
+        the arithmetic/collection/allowlisted-call subset. Also reject
+        obviously-explosive operands (huge pow exponents / bases) before
+        ever evaluating."""
         import ast
 
         for node in ast.walk(tree):
@@ -92,17 +127,41 @@ class PythonToolTransform:
                 raise ValueError("attribute access is not allowed")
             if isinstance(node, ast.Name) and node.id.startswith("_"):
                 raise ValueError(f"name {node.id!r} is not allowed")
+            if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Pow):
+                for side in (node.left, node.right):
+                    if (
+                        isinstance(side, ast.Constant)
+                        and isinstance(side.value, int)
+                        and abs(side.value) > cls._MAX_CONST
+                    ):
+                        raise ValueError("pow operand too large")
 
     def run(self, code: str) -> str:
         import ast
+        import subprocess
+        import sys
 
+        code = code.strip()
+        try:  # parse + filter in the parent: fast fail, no process spawn
+            self._check(ast.parse(code, "<tool>", mode="eval"))
+        except Exception as e:  # noqa: BLE001 - incl. parser MemoryError /
+            # RecursionError on adversarially nested model output: every
+            # parse failure is a tool error string, never a collector crash
+            msg = getattr(e, "msg", None) or str(e)
+            return f"error: {type(e).__name__}: {msg}"
         try:
-            tree = ast.parse(code.strip(), "<tool>", mode="eval")
-            self._check(tree)
-            return repr(eval(compile(tree, "<tool>", "eval"),
-                             {"__builtins__": {}}, dict(self._SAFE)))
-        except Exception as e:  # noqa: BLE001 - tool errors go to the model
-            return f"error: {type(e).__name__}: {e}"
+            proc = subprocess.run(
+                [sys.executable, "-I", "-S", "-c", _SANDBOX_RUNNER,
+                 str(max(1, int(self.timeout) + 1)), str(self.memory_limit),
+                 ",".join(self._SAFE)],
+                input=code, capture_output=True, text=True,
+                timeout=self.timeout + 1.0,
+            )
+        except subprocess.TimeoutExpired:
+            return f"error: TimeoutError: expression exceeded {self.timeout}s"
+        if proc.returncode != 0 and not proc.stdout:
+            return "error: ResourceError: expression killed (cpu/memory limit)"
+        return proc.stdout
 
     def __call__(self, history):
         m = history.last

@@ -92,6 +92,7 @@ class InferenceServer:
         self._clients = 0
         self._lock = threading.Lock()
         self._tcp = None
+        self._served_sig = None  # signature of the last successful batch
 
     # -- lifecycle -------------------------------------------------------------
 
@@ -199,7 +200,64 @@ class InferenceServer:
                     if not fut.done():
                         fut.set_exception(e)
 
+    def _reject_mismatched(
+        self, batch: list[tuple[Any, Future]]
+    ) -> list[tuple[Any, Future]]:
+        """Fail only the futures whose obs keys/shapes/dtypes disagree with
+        the reference signature — one malformed actor must not poison the
+        whole batch (every other future would otherwise get its stacking
+        error), even when the malformed request happens to arrive first.
+
+        Reference = the signature served in previous batches when it is
+        still present (so an even split can't flip to a newcomer), else the
+        batch majority (ties broken by arrival, the only information left).
+        """
+        from collections import Counter
+
+        def signature(obs):
+            # shape/dtype attrs read metadata only — no device->host copy
+            # for jax arrays in the serving hot path
+            return tuple(
+                sorted(
+                    (
+                        k,
+                        tuple(v.shape) if hasattr(v, "shape") else np.shape(v),
+                        str(v.dtype) if hasattr(v, "dtype") else
+                        str(np.asarray(v).dtype),
+                    )
+                    for k, v in obs.items()
+                )
+            )
+
+        sigs = []
+        for obs, fut in batch:
+            try:
+                sigs.append(signature(obs))
+            except Exception:  # noqa: BLE001 - unreadable obs: no signature
+                sigs.append(None)
+        counts = Counter(s for s in sigs if s is not None)
+        if self._served_sig in counts:
+            ref_sig = self._served_sig
+        else:  # first batch, or the fleet legitimately changed shapes
+            ref_sig = counts.most_common(1)[0][0] if counts else None
+        keep = []
+        for (obs, fut), sig in zip(batch, sigs):
+            if sig is not None and sig == ref_sig:
+                keep.append((obs, fut))
+            elif not fut.done():
+                fut.set_exception(
+                    ValueError(
+                        f"request signature {sig} != batch signature {ref_sig}"
+                    )
+                )
+        if keep:
+            self._served_sig = ref_sig
+        return keep
+
     def _answer(self, batch: list[tuple[Any, Future]]) -> None:
+        batch = self._reject_mismatched(batch)
+        if not batch:
+            return
         k = len(batch)
         stacked = {}
         keys = list(batch[0][0].keys())

@@ -122,8 +122,18 @@ class ActorCriticLossMixin(LossModule):
 
     def _mask(self, batch: ArrayDict):
         if self.mask_key and self.mask_key in batch:
-            return batch[self.mask_key]
-        return None
+            mask = batch[self.mask_key]
+        else:
+            mask = None
+        # Preempted HostCollector batches pad the tail with duplicated steps
+        # and mark the real rows in "collected_mask"; fold it in so losses
+        # and advantage normalization never train on the padding.
+        if "collected_mask" in batch:
+            cm = batch["collected_mask"]
+            # logical_and, not &: a user-supplied float 0/1 mask is valid
+            # (masked_mean casts), and float & bool is a dtype error
+            mask = cm if mask is None else jnp.logical_and(mask, cm)
+        return mask
 
     def _ensure_advantage(self, params: dict, batch: ArrayDict) -> ArrayDict:
         if "advantage" not in batch:

@@ -272,3 +272,120 @@ class TestOffPolicyReviewFixes:
         np.testing.assert_allclose(
             np.asarray(out["next", "reward"]), [1 / 3, 1 / 3, 1 / 3, 1.0, 1.0], rtol=1e-5
         )
+
+
+class TestAdviceRound2:
+    """Round-2 advisor findings pinned (ADVICE.md)."""
+
+    def test_tool_transform_timeout(self):
+        from rl_tpu.envs.llm.transforms import PythonToolTransform
+
+        t = PythonToolTransform()
+        t.timeout = 1.0
+        out = t.run("9**9**9")  # explosive pow: bounded, never stalls
+        assert out.startswith("error:")
+        assert t.run("sum(range(10**12))").startswith("error:")
+        assert t.run("10**(10**7)").startswith("error:")
+        assert t.run("1 + 2") == "3"
+
+    def test_tool_transform_rejects_large_pow_literal(self):
+        from rl_tpu.envs.llm.transforms import PythonToolTransform
+
+        out = PythonToolTransform().run("2**99999999")
+        assert "error" in out
+
+    def test_collected_mask_folds_into_loss_mask(self):
+        from rl_tpu.modules import MLP, Categorical, ProbabilisticActor, TDModule, ValueOperator
+        from rl_tpu.objectives import ClipPPOLoss
+
+        actor = ProbabilisticActor(
+            TDModule(MLP(out_features=2, num_cells=(8,)), ["observation"], ["logits"]),
+            Categorical,
+            dist_keys=("logits",),
+        )
+        critic = ValueOperator(MLP(out_features=1, num_cells=(8,)))
+        loss = ClipPPOLoss(actor, critic)
+        loss.make_value_estimator()
+        T, N = 6, 2
+        obs = jax.random.normal(jax.random.key(1), (T, N, 3))
+        base = ArrayDict(
+            observation=obs,
+            action=jnp.zeros((T, N), jnp.int32),
+            sample_log_prob=jnp.zeros((T, N)),
+            next=ArrayDict(
+                observation=obs,
+                reward=jnp.ones((T, N)),
+                done=jnp.zeros((T, N), bool),
+                terminated=jnp.zeros((T, N), bool),
+            ),
+        )
+        params = loss.init_params(jax.random.key(2), base)
+        cm = jnp.zeros((T, N), bool).at[:3].set(True)
+        # padded tail rows duplicated garbage: poison them, mask must hide it
+        poisoned = base.set(
+            "observation", obs.at[3:].set(1e6)
+        ).set(("next", "observation"), obs.at[3:].set(1e6)).set("collected_mask", cm)
+        _, m = loss(params, poisoned)
+        assert np.isfinite(float(m["loss_objective"]))
+        # collected_mask must act exactly like an explicit "mask" key
+        _, m_explicit = loss(
+            params, poisoned.exclude("collected_mask").set("mask", cm)
+        )
+        np.testing.assert_allclose(
+            float(m["loss_objective"]), float(m_explicit["loss_objective"]), rtol=1e-6
+        )
+        np.testing.assert_allclose(
+            float(m["loss_critic"]), float(m_explicit["loss_critic"]), rtol=1e-6
+        )
+        # and differ from the unmasked computation (mask actually applied)
+        _, m_unmasked = loss(params, poisoned.exclude("collected_mask"))
+        assert float(m_unmasked["loss_critic"]) != float(m["loss_critic"])
+
+    def test_compact_collected_drops_padded_rows(self):
+        from rl_tpu.collectors import compact_collected
+
+        T, N = 4, 2
+        b = ArrayDict(
+            observation=jnp.arange(T * N).reshape(T, N).astype(jnp.float32),
+            collected_mask=jnp.asarray([[True, True], [True, True], [False, False], [False, False]]),
+        )
+        out = compact_collected(b)
+        assert "collected_mask" not in out
+        assert out["observation"].shape == (2, N)
+
+    def test_service_registry_unknown_vs_dead(self):
+        from rl_tpu.comm import ServiceRegistry
+
+        class FakeWd:
+            dead = {"ghost"}
+
+        reg = ServiceRegistry.__new__(ServiceRegistry)
+        import threading
+
+        reg._services = {}
+        reg._watchdog = FakeWd()
+        reg._lock = threading.Lock()
+        with pytest.raises(KeyError, match="unknown service"):
+            reg.get("ghost")  # never registered: unknown, not "not alive"
+
+    def test_inference_server_rejects_only_malformed(self):
+        from concurrent.futures import Future
+
+        from rl_tpu.modules.inference_server import InferenceServer
+
+        srv = InferenceServer.__new__(InferenceServer)
+        srv._served_sig = None
+        good = ({"observation": np.zeros(3, np.float32)}, Future())
+        bad = ({"observation": np.zeros(5, np.float32)}, Future())
+        good2 = ({"observation": np.ones(3, np.float32)}, Future())
+        # malformed request arriving FIRST must still lose the majority vote
+        keep = srv._reject_mismatched([bad, good, good2])
+        assert len(keep) == 2
+        assert bad[1].done() and isinstance(bad[1].exception(), ValueError)
+        assert not good[1].done() and not good2[1].done()
+        # even-split later batch: the served signature wins, newcomer fails
+        bad2 = ({"observation": np.zeros(5, np.float32)}, Future())
+        good3 = ({"observation": np.ones(3, np.float32)}, Future())
+        keep = srv._reject_mismatched([bad2, good3])
+        assert [f.done() for _, f in keep] == [False]
+        assert bad2[1].done() and not good3[1].done()
