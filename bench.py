@@ -60,19 +60,16 @@ def _model_flops_per_train_step() -> float:
 
 
 def _report(value=0.0, mfu=0.0, error=None):
-    print(
-        json.dumps(
-            {
-                "metric": "ppo_cartpole_env_steps_per_sec_per_chip",
-                "value": round(value, 1),
-                "unit": "env_steps/s",
-                "vs_baseline": round(value / PER_CHIP_TARGET, 3),
-                "mfu": round(mfu, 6),
-                "error": error,
-            }
-        ),
-        flush=True,
-    )
+    line = {
+        "metric": "ppo_cartpole_env_steps_per_sec_per_chip",
+        "value": round(value, 1),
+        "unit": "env_steps/s",
+        "vs_baseline": round(value / PER_CHIP_TARGET, 3),
+        "mfu": round(mfu, 6),
+        "error": error,
+    }
+    line.update(_report_extras)
+    print(json.dumps(line), flush=True)
 
 
 def main():
@@ -271,6 +268,326 @@ def bench_hostenv():
     assert np.isfinite(float(batch["next"]["reward"].sum()))
 
 
+def _peak_flops(jax) -> float:
+    kind = jax.devices()[0].device_kind
+    return next(
+        (v for k, v in _PEAK_FLOPS.items() if k.lower() in kind.lower()), 100e12
+    )
+
+
+def bench_rlhf(report: bool = True) -> dict:
+    """BENCH_MODE=rlhf: the second north-star metric (BASELINE.md config #5,
+    reference examples/rlhf/train_rlhf.py + benchmarks/test_llm.py).
+
+    One full RLHF cycle on a GPT-2-small-scale TransformerLM (~110M params,
+    bf16, flash attention): KV-cache rollout of 512 response tokens from a
+    512-token prompt, then one GRPO update over the full [B, 1024] batch.
+    Reports end-to-end tokens/sec/chip; ``train_mfu`` is the GRPO train
+    step's model-FLOPs utilization (the VERDICT round-2 target: >= 0.30);
+    ``vs_baseline`` = train_mfu / 0.30.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    plat = os.environ.get("BENCH_PLATFORM")
+    if plat:
+        jax.config.update("jax_platforms", plat)
+
+    import optax
+
+    from rl_tpu.models import (
+        TransformerConfig,
+        TransformerLM,
+        generate,
+        token_log_probs,
+    )
+    from rl_tpu.objectives.llm.grpo import GRPOLoss, mc_advantage
+
+    on_tpu = jax.devices()[0].platform != "cpu"
+    if _SMOKE:
+        B, Tp, Tn = 2, 32, 32
+        cfg = TransformerConfig(
+            vocab_size=512, d_model=128, n_layers=2, n_heads=2, d_ff=512,
+            max_seq_len=Tp + Tn, dtype=jnp.bfloat16,
+            attention_impl="flash" if on_tpu else "local",
+        )
+    else:
+        B, Tp, Tn = 16, 512, 512
+        # flash_decode=False: at S=1024 the cache fits 2 pallas blocks and
+        # grid overhead beats the bandwidth saving (measured 4.1k vs 4.9k
+        # tok/s); the decode kernel pays off on long caches, not here
+        cfg = TransformerConfig(
+            vocab_size=32768, d_model=768, n_layers=12, n_heads=12, d_ff=3072,
+            max_seq_len=Tp + Tn, dtype=jnp.bfloat16, attention_impl="flash",
+        )
+    T = Tp + Tn
+    model = TransformerLM(cfg)
+    key = jax.random.key(0)
+    params = model.init(key, jnp.zeros((1, 8), jnp.int32))["params"]
+    n_params = sum(x.size for x in jax.tree.leaves(params))
+
+    opt = optax.adamw(3e-5)
+    opt_state = opt.init(params)
+    loss = GRPOLoss(
+        lambda p, b: token_log_probs(model, p, b["tokens"]), clip_epsilon=0.2
+    )
+
+    prompts = jax.random.randint(key, (B, Tp), 0, cfg.vocab_size)
+    pmask = jnp.ones((B, Tp), jnp.float32)
+
+    @jax.jit
+    def rollout(params, key):
+        out = generate(
+            model, params, prompts, pmask, key, max_new_tokens=Tn, eos_id=None
+        )
+        lp = jnp.concatenate(
+            [jnp.zeros((B, Tp)), out.response_log_probs], axis=1
+        )
+        amask = jnp.concatenate(
+            [jnp.zeros((B, Tp), bool), out.response_mask], axis=1
+        )
+        return out.tokens, lp, amask
+
+    @jax.jit
+    def train_step(params, opt_state, tokens, sample_lp, amask, key):
+        reward = jax.random.normal(key, (B,))
+        adv = mc_advantage(reward, jnp.arange(B) // 4, max(1, (B + 3) // 4))
+        from rl_tpu.data import ArrayDict
+
+        batch = ArrayDict(
+            tokens=tokens, sample_log_prob=sample_lp,
+            assistant_mask=amask, advantage=adv,
+        )
+        (v, m), g = jax.value_and_grad(
+            lambda p: loss(p, batch), has_aux=True
+        )(params)
+        upd, opt_state = opt.update(g, opt_state, params)
+        return optax.apply_updates(params, upd), opt_state, v
+
+    # warm/compile both programs
+    k1, k2 = jax.random.split(key)
+    tokens, lp, amask = rollout(params, k1)
+    params2, opt_state2, v = train_step(params, opt_state, tokens, lp, amask, k2)
+    jax.block_until_ready(v)
+
+    reps = 1 if _SMOKE else 3
+    # time generation and training separately (different bound regimes),
+    # then report the fused cycle
+    t0 = time.perf_counter()
+    for i in range(reps):
+        tokens, lp, amask = rollout(params, jax.random.key(10 + i))
+    jax.block_until_ready(tokens)
+    t_gen = (time.perf_counter() - t0) / reps
+
+    t0 = time.perf_counter()
+    for i in range(reps):
+        params2, opt_state2, v = train_step(
+            params, opt_state, tokens, lp, amask, jax.random.key(20 + i)
+        )
+    jax.block_until_ready(v)
+    t_train = (time.perf_counter() - t0) / reps
+
+    # train step model FLOPs: fwd+bwd = 6 * n_params_matmul * tokens, plus
+    # causal attention 12*L*B*T^2*D/2 each for fwd, doubled for bwd recompute
+    # excluded (standard MFU accounting counts algorithmic FLOPs only)
+    emb = cfg.vocab_size * cfg.d_model
+    matmul_params = n_params - emb  # positional+token embeds are gathers
+    flops_fwd = 2 * matmul_params * B * T + 2 * emb * B * T  # + lm head
+    attn_flops = cfg.n_layers * 4 * B * cfg.n_heads * T * T * cfg.head_dim / 2
+    train_flops = 3 * (flops_fwd + attn_flops)
+    peak = _peak_flops(jax)
+    train_mfu = train_flops / t_train / peak
+
+    cycle = t_gen + t_train
+    toks_per_sec = B * T / cycle  # full-batch tokens through one RLHF cycle
+    out = {
+        "metric": "rlhf_tokens_per_sec_per_chip",
+        "value": round(toks_per_sec, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(train_mfu / 0.30, 3),
+        "train_mfu": round(train_mfu, 4),
+        "gen_tokens_per_sec": round(B * Tn / t_gen, 1),
+        "train_tokens_per_sec": round(B * T / t_train, 1),
+        "n_params": n_params,
+        "shape": [B, Tp, Tn],
+        "error": None,
+    }
+    if report:
+        print(json.dumps(out), flush=True)
+    return out
+
+
+def bench_sac(report: bool = True) -> dict:
+    """BENCH_MODE=sac: SAC with on-device replay (BASELINE.md config #2,
+    reference sota-implementations/sac/): the fused collect -> extend ->
+    sample -> update train step as ONE jitted program on a native
+    continuous-control env. Reports env-steps/sec/chip; ``vs_baseline``
+    relative to the same per-chip north-star share as the ppo mode."""
+    import jax
+
+    plat = os.environ.get("BENCH_PLATFORM")
+    if plat:
+        jax.config.update("jax_platforms", plat)
+
+    import jax.numpy as jnp
+
+    from rl_tpu.collectors import Collector
+    from rl_tpu.data.replay import DeviceStorage, ReplayBuffer
+    from rl_tpu.envs import PendulumEnv, VmapEnv
+    from rl_tpu.modules import (
+        MLP,
+        ConcatMLP,
+        NormalParamExtractor,
+        ProbabilisticActor,
+        TDModule,
+        TDSequential,
+        TanhNormal,
+    )
+    from rl_tpu.objectives import SACLoss
+    from rl_tpu.trainers import OffPolicyConfig, OffPolicyProgram
+
+    n_envs = 8 if _SMOKE else 256
+    frames = 64 if _SMOKE else 2048
+    cells = (64,) if _SMOKE else (256, 256)
+    act_dim = 1
+    actor = ProbabilisticActor(
+        TDSequential(
+            TDModule(MLP(out_features=2 * act_dim, num_cells=cells),
+                     ["observation"], ["raw"]),
+            TDModule(NormalParamExtractor(), ["raw"], ["loc", "scale"]),
+        ),
+        TanhNormal,
+        dist_keys=("loc", "scale"),
+    )
+    sac = SACLoss(actor, ConcatMLP(out_features=1, num_cells=cells))
+    env = VmapEnv(PendulumEnv(), n_envs)
+
+    def policy(params, td, key):
+        return sac.actor(params["actor"], td, key)
+
+    coll = Collector(env, policy, frames_per_batch=frames)
+    buffer = ReplayBuffer(DeviceStorage(100_000))
+    program = OffPolicyProgram(
+        coll, sac, buffer,
+        OffPolicyConfig(batch_size=256, utd_ratio=4, learning_rate=3e-4),
+    )
+    ts = program.init(jax.random.key(0))
+    step = jax.jit(program.train_step)
+    ts, m = step(ts)
+    jax.block_until_ready(m)
+    reps = 2 if _SMOKE else 8
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        ts, m = step(ts)
+    jax.block_until_ready(m)
+    dt = time.perf_counter() - t0
+    sps = reps * frames / dt
+    out = {
+        "metric": "sac_device_replay_env_steps_per_sec_per_chip",
+        "value": round(sps, 1),
+        "unit": "env_steps/s",
+        "vs_baseline": round(sps / PER_CHIP_TARGET, 3),
+        "grad_updates_per_sec": round(reps * 4 / dt, 2),
+        "loss": float(jnp.asarray(m["loss"])),
+        "error": None,
+    }
+    if report:
+        print(json.dumps(out), flush=True)
+    return out
+
+
+def bench_per(report: bool = True) -> dict:
+    """BENCH_MODE=per: on-device prioritized sampling vs the host C++
+    segment tree (BASELINE.md config #3's explicit target: on-device PER
+    >= host tree). One cycle = sample a batch by priority + write new
+    priorities back. The device side runs the jit-resident
+    PrioritizedSampler (prefix-sum + searchsorted); the host side runs the
+    native C++ SumSegmentTree (set batch + prefix-search batch).
+    ``vs_baseline`` = host_time / device_time (>1 means on-device wins)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    plat = os.environ.get("BENCH_PLATFORM")
+    if plat:
+        jax.config.update("jax_platforms", plat)
+
+    from rl_tpu.csrc import SumSegmentTree
+    from rl_tpu.data.replay.samplers import PrioritizedSampler
+
+    capacity = 4096 if _SMOKE else 1 << 20
+    batch = 256
+    inner = 5 if _SMOKE else 50  # cycles per timed call (amortize dispatch)
+    sampler = PrioritizedSampler()
+    sstate = sampler.init(capacity)
+    key = jax.random.key(0)
+    prio0 = jax.random.uniform(key, (capacity,)) + 0.01
+    sstate = sstate.set("priorities", prio0)
+    size = jnp.asarray(capacity, jnp.int32)
+
+    @jax.jit
+    def device_cycles(sstate, key):
+        def body(_, carry):
+            sstate, key = carry
+            key, k1, k2 = jax.random.split(key, 3)
+            idx, info, sstate = sampler.sample(sstate, k1, batch, size, capacity)
+            newp = jax.random.uniform(k2, (batch,)) + 0.01
+            sstate = sampler.update_priority(sstate, idx, newp)
+            return sstate, key
+        return jax.lax.fori_loop(0, inner, body, (sstate, key))
+
+    out_state, _ = device_cycles(sstate, key)
+    jax.block_until_ready(out_state["priorities"])
+    t0 = time.perf_counter()
+    out_state, _ = device_cycles(sstate, key)
+    jax.block_until_ready(out_state["priorities"])
+    t_dev = (time.perf_counter() - t0) / inner
+
+    tree = SumSegmentTree(capacity)
+    rng = np.random.default_rng(0)
+    tree[np.arange(capacity)] = np.asarray(prio0, np.float64) ** sampler.alpha
+    idx = None
+    t0 = time.perf_counter()
+    for _ in range(inner):
+        us = rng.uniform(0, tree.reduce(), batch)
+        idx = tree.scan(us)
+        newp = rng.uniform(0.01, 1.01, batch) ** sampler.alpha
+        tree[idx] = newp
+    t_host = (time.perf_counter() - t0) / inner
+    out = {
+        "metric": "per_on_device_speedup_vs_host_tree",
+        "value": round(t_host / t_dev, 3),
+        "unit": "x",
+        "vs_baseline": round(t_host / t_dev, 3),
+        "device_us_per_cycle": round(t_dev * 1e6, 1),
+        "host_us_per_cycle": round(t_host * 1e6, 1),
+        "native_tree": bool(getattr(tree, "IS_NATIVE", False)),
+        "capacity": capacity,
+        "batch": batch,
+        "error": None,
+    }
+    if report:
+        print(json.dumps(out), flush=True)
+    return out
+
+
+def bench_all():
+    """Default mode: the round-2 headline ppo line, extended with the three
+    north-star sub-benches (rlhf / sac / per) as nested fields — still ONE
+    JSON line for the driver, each sub-bench failing independently."""
+    extras = {}
+    for name, fn in (("rlhf", bench_rlhf), ("sac", bench_sac), ("per", bench_per)):
+        try:
+            extras[name] = fn(report=False)
+        except BaseException:  # noqa: BLE001 - sub-bench fails alone
+            extras[name] = {"error": traceback.format_exc(limit=3)}
+    _report_extras.update(extras)
+    main()
+
+
+_report_extras: dict = {}
+
+
 def _watchdog(seconds: float):
     """Emit the failure JSON and hard-exit if the run wedges (e.g. the TPU
     relay hangs inside backend init, where no exception ever surfaces)."""
@@ -288,9 +605,17 @@ def _watchdog(seconds: float):
 
 if __name__ == "__main__":
     timer = _watchdog(float(os.environ.get("BENCH_TIMEOUT", "900")))
-    mode = os.environ.get("BENCH_MODE", "ppo")
+    mode = os.environ.get("BENCH_MODE", "all")
     try:
-        {"ppo": main, "attention": bench_attention, "hostenv": bench_hostenv}[mode]()
+        {
+            "all": bench_all,
+            "ppo": main,
+            "attention": bench_attention,
+            "hostenv": bench_hostenv,
+            "rlhf": bench_rlhf,
+            "sac": bench_sac,
+            "per": bench_per,
+        }[mode]()
         timer.cancel()
     except BaseException:  # always emit the JSON line, whatever happened
         _report(error=traceback.format_exc(limit=5))

@@ -9,6 +9,9 @@ __all__ = [
     "BraxEnv",
     "JumanjiEnv",
     "spec_from_jumanji",
+    "DMControlEnv",
+    "DMControlWrapper",
+    "spec_from_dm_spec",
 ]
 
 
@@ -26,4 +29,8 @@ def __getattr__(name):
         from . import jumanji as _jm
 
         return getattr(_jm, name)
+    if name in ("DMControlEnv", "DMControlWrapper", "spec_from_dm_spec"):
+        from . import dm_control as _dmc
+
+        return getattr(_dmc, name)
     raise AttributeError(name)

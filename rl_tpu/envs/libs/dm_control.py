@@ -1,0 +1,164 @@
+"""dm_control bridge: DeepMind Control Suite behind the host-env protocol.
+
+Redesign of the reference's dm_control wrapper (reference:
+torchrl/envs/libs/dm_control.py — ``DMControlWrapper``:168 /
+``DMControlEnv``:390 with ``_dmcontrol_to_torchrl_spec_transform``:57 spec
+conversion and pixel rendering via ``render_kwargs``). The reference builds
+a TensorDict env; here dm_control sims are HOST envs (numpy in/out, not
+jit-traceable) that plug into :class:`rl_tpu.collectors.HostCollector` /
+``ThreadedEnvPool`` exactly like the gym bridge.
+
+dm_env TimeStep semantics are mapped to the framework's flags:
+- ``ts.last() and ts.discount == 0``  -> terminated (true env termination)
+- ``ts.last() and ts.discount > 0``   -> truncated  (time limit)
+
+Pixels: ``from_pixels=True`` renders ``physics.render(**render_kwargs)``
+into a "pixels" observation (the reference's pixels path).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+from ...data import Bounded, Composite, Unbounded
+
+__all__ = ["DMControlWrapper", "DMControlEnv", "spec_from_dm_spec"]
+
+
+def spec_from_dm_spec(dm_spec) -> Any:
+    """dm_env specs -> rl_tpu Spec (reference dm_control.py:57).
+
+    ``BoundedArray`` -> Bounded; plain ``Array`` -> Unbounded. dm_control
+    observation scalars (shape ()) keep their scalar shape — VmapEnv-style
+    batching happens at the pool level.
+    """
+    kind = type(dm_spec).__name__
+    dtype = np.dtype(dm_spec.dtype)
+    if dtype == np.float64:
+        dtype = np.dtype(np.float32)  # device-friendly; sim stays f64 on host
+    if "BoundedArray" in kind:
+        return Bounded(
+            shape=tuple(dm_spec.shape),
+            low=np.broadcast_to(dm_spec.minimum, dm_spec.shape).astype(dtype),
+            high=np.broadcast_to(dm_spec.maximum, dm_spec.shape).astype(dtype),
+            dtype=dtype,
+        )
+    return Unbounded(shape=tuple(dm_spec.shape), dtype=dtype)
+
+
+class DMControlWrapper:
+    """Wrap a constructed ``dm_env.Environment`` into the host-env protocol:
+
+    - ``reset(seed) -> obs_dict``
+    - ``step(action) -> (obs_dict, reward, terminated, truncated)``
+
+    Observation keys keep dm_control's own names (position, velocity, …),
+    mirroring the reference's key passthrough.
+    """
+
+    def __init__(
+        self,
+        env: Any,
+        from_pixels: bool = False,
+        render_kwargs: dict | None = None,
+    ):
+        self.env = env
+        self.from_pixels = from_pixels
+        self.render_kwargs = {"height": 84, "width": 84, "camera_id": 0}
+        if render_kwargs:
+            self.render_kwargs.update(render_kwargs)
+        obs_specs = {
+            k: spec_from_dm_spec(v) for k, v in env.observation_spec().items()
+        }
+        if from_pixels:
+            h, w = self.render_kwargs["height"], self.render_kwargs["width"]
+            obs_specs["pixels"] = Bounded(
+                shape=(h, w, 3), low=0, high=255, dtype=np.uint8
+            )
+        self._obs_spec = Composite(obs_specs)
+        self._action_spec = spec_from_dm_spec(env.action_spec())
+
+    # -- specs ----------------------------------------------------------------
+
+    @property
+    def observation_spec(self) -> Composite:
+        return self._obs_spec
+
+    @property
+    def action_spec(self):
+        return self._action_spec
+
+    @property
+    def batch_shape(self) -> tuple:
+        return ()
+
+    # -- host protocol --------------------------------------------------------
+
+    def _obs_dict(self, ts) -> dict:
+        out = {}
+        for k, v in ts.observation.items():
+            a = np.asarray(v)
+            if a.dtype == np.float64:
+                a = a.astype(np.float32)
+            out[k] = a
+        if self.from_pixels:
+            out["pixels"] = self.env.physics.render(**self.render_kwargs)
+        return out
+
+    def reset(self, seed: int | None = None) -> dict:
+        if seed is not None:
+            # dm_control fixes the seed at task construction; re-seed the
+            # task's RandomState in place (reference re-creates the env)
+            task = getattr(self.env, "task", None)
+            if task is not None and hasattr(task, "_random"):
+                task._random = np.random.RandomState(seed)
+        return self._obs_dict(self.env.reset())
+
+    def step(self, action) -> tuple[dict, float, bool, bool]:
+        a = np.asarray(action, np.float64)
+        ts = self.env.step(a)
+        reward = float(ts.reward if ts.reward is not None else 0.0)
+        last = bool(ts.last())
+        terminated = last and float(ts.discount or 0.0) == 0.0
+        truncated = last and not terminated
+        return self._obs_dict(ts), reward, terminated, truncated
+
+    def close(self) -> None:
+        close = getattr(self.env, "close", None)
+        if close is not None:
+            close()
+
+
+class DMControlEnv(DMControlWrapper):
+    """Build from (domain, task) names (reference DMControlEnv:390).
+
+    >>> env = DMControlEnv("cheetah", "run")
+    >>> obs = env.reset(seed=0)
+    >>> obs2, r, term, trunc = env.step(env.action_spec.rand(key))
+    """
+
+    def __init__(
+        self,
+        domain: str,
+        task: str,
+        from_pixels: bool = False,
+        render_kwargs: dict | None = None,
+        seed: int | None = None,
+        **task_kwargs,
+    ):
+        from dm_control import suite
+
+        kwargs = dict(task_kwargs)
+        if seed is not None:
+            kwargs["random"] = seed
+        env = suite.load(domain, task, task_kwargs=kwargs or None)
+        super().__init__(env, from_pixels=from_pixels, render_kwargs=render_kwargs)
+        self.domain, self.task = domain, task
+
+    @staticmethod
+    def available_envs() -> list[tuple[str, str]]:
+        from dm_control import suite
+
+        return sorted(suite.BENCHMARKING)

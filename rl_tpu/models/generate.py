@@ -125,17 +125,25 @@ def token_log_probs(
     model,
     params,
     tokens: jax.Array,
-    attention_mask: jax.Array,
+    attention_mask: jax.Array | None = None,
     temperature: float = 1.0,
 ) -> jax.Array:
     """log p(token_t | tokens_<t) for every position (teacher-forced).
 
     Output [B, T]; position 0 has no prediction and gets 0. This is the
     training/scoring path (reference LLMWrapper log-probs mode).
+    ``attention_mask=None`` means every position is real (full sequences) —
+    required for ``attention_impl="flash"`` until the kernel threads
+    padding masks.
     """
-    positions = _positions_from_mask(attention_mask)
+    if attention_mask is None:
+        positions = None
+        mask = None
+    else:
+        positions = _positions_from_mask(attention_mask)
+        mask = attention_mask.astype(bool)
     logits = model.apply(
-        {"params": params}, tokens, attention_mask=attention_mask.astype(bool), positions=positions
+        {"params": params}, tokens, attention_mask=mask, positions=positions
     )
     lp = jax.nn.log_softmax(logits[:, :-1] / jnp.maximum(temperature, 1e-6), axis=-1)
     tgt = tokens[:, 1:]

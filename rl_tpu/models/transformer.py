@@ -38,10 +38,12 @@ class TransformerConfig:
     d_model: int = 512
     n_layers: int = 4
     n_heads: int = 8
+    n_kv_heads: int | None = None  # < n_heads => GQA/MQA (shared KV heads)
     d_ff: int = 2048
     max_seq_len: int = 1024
     dtype: Any = jnp.bfloat16  # activation dtype; params stay fp32
     attention_impl: str = "local"  # "local" | "ring" | "flash"
+    flash_decode: bool = False  # pallas decode kernel for T=1 cache steps
     flash_interpret: bool = False  # pallas interpret mode (CPU testing)
     mesh: Any = None  # required for "ring"
     context_axis: str = "context"
@@ -49,6 +51,10 @@ class TransformerConfig:
     @property
     def head_dim(self) -> int:
         return self.d_model // self.n_heads
+
+    @property
+    def kv_heads(self) -> int:
+        return self.n_kv_heads or self.n_heads
 
 
 class _Attention(nn.Module):
@@ -58,13 +64,34 @@ class _Attention(nn.Module):
     def __call__(self, x, mask, cache=None, positions=None):
         cfg = self.cfg
         B, T, _ = x.shape
-        qkv = nn.Dense(3 * cfg.d_model, use_bias=False, dtype=cfg.dtype, name="qkv")(x)
-        q, k, v = jnp.split(qkv, 3, axis=-1)
+        Hk = cfg.kv_heads
+        if Hk == cfg.n_heads:
+            qkv = nn.Dense(
+                3 * cfg.d_model, use_bias=False, dtype=cfg.dtype, name="qkv"
+            )(x)
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+        else:  # GQA/MQA: fewer KV heads — smaller cache, less decode traffic
+            q = nn.Dense(cfg.d_model, use_bias=False, dtype=cfg.dtype, name="wq")(x)
+            kv = nn.Dense(
+                2 * Hk * cfg.head_dim, use_bias=False, dtype=cfg.dtype, name="wkv"
+            )(x)
+            k, v = jnp.split(kv, 2, axis=-1)
 
-        def heads(t):
-            return t.reshape(B, T, cfg.n_heads, cfg.head_dim)
+        q = q.reshape(B, T, cfg.n_heads, cfg.head_dim)
+        k = k.reshape(B, T, Hk, cfg.head_dim)
+        v = v.reshape(B, T, Hk, cfg.head_dim)
 
-        q, k, v = heads(q), heads(k), heads(v)
+        def dense_gqa(q, k, v, attn_mask):
+            """XLA attention with KV-head grouping ([B,H,T,S] scores)."""
+            if Hk != cfg.n_heads:
+                k_ = jnp.repeat(k, cfg.n_heads // Hk, axis=2)
+                v_ = jnp.repeat(v, cfg.n_heads // Hk, axis=2)
+            else:
+                k_, v_ = k, v
+            s = jnp.einsum("bqhd,bkhd->bhqk", q, k_) * cfg.head_dim**-0.5
+            s = jnp.where(attn_mask, s, -1e9)
+            p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(cfg.dtype)
+            return jnp.einsum("bhqk,bkhd->bqhd", p, v_)
 
         new_cache = None
         if cache is not None:
@@ -75,33 +102,44 @@ class _Attention(nn.Module):
             new_cache = {"k": ck, "v": cv, "len": cache_len + T}
             k, v = ck, cv
             S = k.shape[1]
-            kv_pos = jnp.arange(S)
-            q_pos = cache_len + jnp.arange(T)
-            causal = q_pos[:, None] >= kv_pos[None, :]
-            valid = kv_pos[None, :] < (cache_len + T)
-            attn_mask = causal & valid
-            s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * cfg.head_dim**-0.5
-            s = jnp.where(attn_mask[None, None], s, -1e9)
-            if mask is not None:  # padding mask over cached keys [B, S]
-                s = jnp.where(mask[:, None, None, :], s, -1e9)
-            p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(cfg.dtype)
-            o = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+            if (
+                cfg.flash_decode
+                and T == 1
+                and S % min(512, S) == 0
+            ):
+                from ..ops.attention import flash_decode
+
+                o = flash_decode(
+                    q,
+                    k,
+                    v,
+                    new_cache["len"],
+                    kv_mask=mask,
+                    interpret=cfg.flash_interpret,
+                ).astype(cfg.dtype)
+            else:
+                kv_pos = jnp.arange(S)
+                q_pos = cache_len + jnp.arange(T)
+                causal = q_pos[:, None] >= kv_pos[None, :]
+                valid = kv_pos[None, :] < (cache_len + T)
+                attn_mask = (causal & valid)[None, None]
+                if mask is not None:  # padding mask over cached keys [B, S]
+                    attn_mask = attn_mask & mask[:, None, None, :]
+                o = dense_gqa(q, k, v, attn_mask)
         elif cfg.attention_impl == "flash":
             from ..ops.attention import flash_attention
 
-            if mask is not None:
-                # fail loud: per-row padding masks are not threaded into the
-                # kernel yet; silent pad-attendance would corrupt log-probs
-                raise ValueError(
-                    "attention_impl='flash' does not support padding masks yet; "
-                    "use 'local' or 'ring' for padded batches"
-                )
+            # ragged batches ride the kernel: padding mask -> segment ids
             o = flash_attention(
-                q, k, v, causal=True, interpret=cfg.flash_interpret
+                q, k, v, causal=True, interpret=cfg.flash_interpret,
+                kv_mask=None if mask is None else mask,
             ).astype(cfg.dtype)
         elif cfg.attention_impl == "ring":
             from ..parallel import ring_attention
 
+            if Hk != cfg.n_heads:
+                k = jnp.repeat(k, cfg.n_heads // Hk, axis=2)
+                v = jnp.repeat(v, cfg.n_heads // Hk, axis=2)
             o = ring_attention(
                 q.astype(jnp.float32),
                 k.astype(jnp.float32),
@@ -112,13 +150,10 @@ class _Attention(nn.Module):
                 kv_mask=mask[:, : k.shape[1]] if mask is not None else None,
             ).astype(cfg.dtype)
         else:
-            s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * cfg.head_dim**-0.5
-            causal = jnp.tril(jnp.ones((T, T), bool))
-            s = jnp.where(causal[None, None], s, -1e9)
+            causal = jnp.tril(jnp.ones((T, T), bool))[None, None]
             if mask is not None:
-                s = jnp.where(mask[:, None, None, :], s, -1e9)
-            p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(cfg.dtype)
-            o = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+                causal = causal & mask[:, None, None, :]
+            o = dense_gqa(q, k, v, causal)
 
         o = o.reshape(B, T, cfg.d_model)
         o = nn.Dense(cfg.d_model, use_bias=False, dtype=cfg.dtype, name="proj")(o)
@@ -177,8 +212,8 @@ class TransformerLM(nn.Module):
         cfg = self.cfg
         return [
             {
-                "k": jnp.zeros((batch_size, max_len, cfg.n_heads, cfg.head_dim), cfg.dtype),
-                "v": jnp.zeros((batch_size, max_len, cfg.n_heads, cfg.head_dim), cfg.dtype),
+                "k": jnp.zeros((batch_size, max_len, cfg.kv_heads, cfg.head_dim), cfg.dtype),
+                "v": jnp.zeros((batch_size, max_len, cfg.kv_heads, cfg.head_dim), cfg.dtype),
                 "len": jnp.asarray(0, jnp.int32),
             }
             for _ in range(cfg.n_layers)
@@ -199,7 +234,13 @@ def param_sharding_rules(params, model_axis: str = "model"):
         joined = "/".join(names)
         if x.ndim < 2:
             return P()  # biases, norms
-        if "qkv" in joined or "/up/" in joined or joined.endswith("up/kernel"):
+        if (
+            "qkv" in joined
+            or "wq" in joined
+            or "wkv" in joined
+            or "/up/" in joined
+            or joined.endswith("up/kernel")
+        ):
             return P(None, model_axis)
         if "proj" in joined or "down" in joined:
             return P(model_axis, None)

