@@ -8,9 +8,10 @@ a TensorDict env; here dm_control sims are HOST envs (numpy in/out, not
 jit-traceable) that plug into :class:`rl_tpu.collectors.HostCollector` /
 ``ThreadedEnvPool`` exactly like the gym bridge.
 
-dm_env TimeStep semantics are mapped to the framework's flags:
-- ``ts.last() and ts.discount == 0``  -> terminated (true env termination)
-- ``ts.last() and ts.discount > 0``   -> truncated  (time limit)
+dm_env TimeStep semantics are mapped to the framework's flags (reference
+dm_control.py:362: only discount≈1 at a last step is a time limit):
+- ``ts.last() and ts.discount ≈ 1``  -> truncated  (time limit)
+- ``ts.last()`` otherwise (any discount < 1, incl. 0) -> terminated
 
 Pixels: ``from_pixels=True`` renders ``physics.render(**render_kwargs)``
 into a "pixels" observation (the reference's pixels path).
@@ -121,8 +122,10 @@ class DMControlWrapper:
         ts = self.env.step(a)
         reward = float(ts.reward if ts.reward is not None else 0.0)
         last = bool(ts.last())
-        terminated = last and float(ts.discount or 0.0) == 0.0
-        truncated = last and not terminated
+        # reference dm_control.py:362: only discount≈1 at a last step is a
+        # time-limit truncation; any other discount (incl. 0<d<1) terminates
+        truncated = last and bool(np.isclose(float(ts.discount or 0.0), 1.0))
+        terminated = last and not truncated
         return self._obs_dict(ts), reward, terminated, truncated
 
     def close(self) -> None:

@@ -132,9 +132,9 @@ def token_log_probs(
 
     Output [B, T]; position 0 has no prediction and gets 0. This is the
     training/scoring path (reference LLMWrapper log-probs mode).
-    ``attention_mask=None`` means every position is real (full sequences) —
-    required for ``attention_impl="flash"`` until the kernel threads
-    padding masks.
+    ``attention_mask=None`` simply means every position is real (full
+    sequences). Padding masks are supported on every attention impl,
+    including ``"flash"`` (threaded as ``kv_mask`` into the kernel).
     """
     if attention_mask is None:
         positions = None

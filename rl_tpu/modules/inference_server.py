@@ -215,8 +215,10 @@ class InferenceServer:
         error), even when the malformed request happens to arrive first.
 
         Reference = the signature served in previous batches when it is
-        still present (so an even split can't flip to a newcomer), else the
-        batch majority (ties broken by arrival, the only information left).
+        still present AND no other signature holds a strict batch majority
+        (>50%) — so an even split can't flip to a newcomer, but a migrated
+        fleet outvotes one stale actor. Otherwise the batch majority wins
+        (ties broken by arrival, the only information left).
         """
         from collections import Counter
 
@@ -242,10 +244,19 @@ class InferenceServer:
             except Exception:  # noqa: BLE001 - unreadable obs: no signature
                 sigs.append(None)
         counts = Counter(s for s in sigs if s is not None)
-        if self._served_sig in counts:
+        total = sum(counts.values())
+        majority_sig, majority_n = (
+            counts.most_common(1)[0] if counts else (None, 0)
+        )
+        if self._served_sig in counts and not (
+            majority_sig != self._served_sig and majority_n * 2 > total
+        ):
+            # stick with the served signature — unless a clear majority
+            # (>50% of the batch) disagrees, which means the fleet migrated
+            # and one stale actor must not pin the old shapes forever
             ref_sig = self._served_sig
-        else:  # first batch, or the fleet legitimately changed shapes
-            ref_sig = counts.most_common(1)[0][0] if counts else None
+        else:  # first batch, fleet changed shapes, or majority override
+            ref_sig = majority_sig
         keep = []
         for (obs, fut), sig in zip(batch, sigs):
             if sig is not None and sig == ref_sig:

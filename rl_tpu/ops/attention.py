@@ -140,7 +140,6 @@ def _flash_fwd_bhtd(
     """q [BH, T, D]; k/v [BHk, T, D] with BH = BHk*group; qseg/kseg [B, T]
     int32 or None (both or neither)."""
     BH, T, D = q.shape
-    H_per_B = group * (BH // max(1, BH))  # placeholder, real mapping below
     block_q = min(block_q, T)
     block_k = min(block_k, T)
     # pad to a common block multiple: out-of-bounds dynamic slices CLAMP

@@ -26,7 +26,6 @@ def _free_port() -> int:
 
 
 @pytest.mark.dist
-@pytest.mark.slow
 def test_two_process_group_replay_and_weights():
     worker = os.path.join(os.path.dirname(__file__), "dist_worker.py")
     coord = f"127.0.0.1:{_free_port()}"

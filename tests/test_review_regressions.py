@@ -389,3 +389,25 @@ class TestAdviceRound2:
         keep = srv._reject_mismatched([bad2, good3])
         assert [f.done() for _, f in keep] == [False]
         assert bad2[1].done() and not good3[1].done()
+
+    def test_inference_server_majority_overrides_stale_signature(self):
+        """One stale actor must not pin the old signature against a clear
+        majority of a migrated fleet (round-3 advisor finding)."""
+        from concurrent.futures import Future
+
+        from rl_tpu.modules.inference_server import InferenceServer
+
+        srv = InferenceServer.__new__(InferenceServer)
+        srv._served_sig = None
+        old = lambda: ({"observation": np.zeros(3, np.float32)}, Future())
+        new = lambda: ({"observation": np.zeros(5, np.float32)}, Future())
+        srv._reject_mismatched([old(), old()])  # establish old signature
+        stale, n1, n2, n3 = old(), new(), new(), new()
+        keep = srv._reject_mismatched([stale, n1, n2, n3])
+        assert len(keep) == 3  # migrated fleet wins
+        assert stale[1].done() and isinstance(stale[1].exception(), ValueError)
+        assert not n1[1].done()
+        # and the new signature is now the served one
+        old2, new2 = old(), new()
+        srv._reject_mismatched([old2, new2])
+        assert old2[1].done() and not new2[1].done()
