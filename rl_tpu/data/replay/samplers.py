@@ -20,6 +20,7 @@ priorities) is functional and threads through jit like storage state.
 
 from __future__ import annotations
 
+import math
 from typing import Any
 
 import jax
@@ -119,6 +120,17 @@ class PrioritizedSampler(Sampler):
     ``P(i) ∝ p_i^α``; importance weights ``w_i = (N·P(i))^{-β}`` normalized
     by ``max w`` (reference convention: weights relative to the minimum
     priority). β anneals linearly to 1 over ``beta_annealing_steps`` if set.
+
+    TPU-resident two-level prefix sum (the on-device answer to the
+    reference's host C++ segment tree): the sampler state carries
+    ``p_alpha`` (= ``(p+eps)^α``), per-chunk sums and per-chunk nonzero
+    mins, all maintained incrementally by ``on_write``/``update_priority``
+    (exact per-chunk recompute of the touched chunks — no float drift).
+    Sampling then inverts the CDF hierarchically: cumsum over ``√N`` chunk
+    sums, pick a chunk per draw, cumsum within the gathered chunk rows —
+    O(B·√N) work per sample instead of O(N) power+cumsum+min over the
+    whole buffer. The sampled distribution and weights are bit-identical
+    to the flat inversion modulo float summation order.
     """
 
     def __init__(
@@ -133,9 +145,22 @@ class PrioritizedSampler(Sampler):
         self.eps = eps
         self.beta_annealing_steps = beta_annealing_steps
 
+    @staticmethod
+    def _layout(capacity: int) -> tuple[int, int]:
+        """(chunk_size, n_chunks): chunk ≈ √capacity rounded to a power of
+        two, capacity padded up to a whole number of chunks."""
+        chunk = 1 << max(2, math.ceil(math.log2(max(1.0, math.sqrt(capacity)))))
+        chunk = min(chunk, max(4, capacity))
+        n_chunks = -(-capacity // chunk)
+        return chunk, n_chunks
+
     def init(self, capacity: int) -> ArrayDict:
+        chunk, n_chunks = self._layout(capacity)
         return ArrayDict(
             priorities=jnp.zeros((capacity,), jnp.float32),
+            p_alpha=jnp.zeros((chunk * n_chunks,), jnp.float32),
+            chunk_sums=jnp.zeros((n_chunks,), jnp.float32),
+            chunk_mins=jnp.full((n_chunks,), jnp.inf, jnp.float32),
             max_priority=jnp.asarray(1.0, jnp.float32),
             step=jnp.asarray(0, jnp.int32),
         )
@@ -146,21 +171,52 @@ class PrioritizedSampler(Sampler):
         frac = jnp.clip(step.astype(jnp.float32) / self.beta_annealing_steps, 0.0, 1.0)
         return self.beta0 + (1.0 - self.beta0) * frac
 
-    def sample(self, sstate, key, batch_size, size, capacity):
-        prio = sstate["priorities"]
-        mask = jnp.arange(capacity) < size
-        p_alpha = jnp.where(mask, jnp.power(prio + self.eps, self.alpha), 0.0)
-        csum = jnp.cumsum(p_alpha)
-        total = csum[-1]
-        u = jax.random.uniform(key, (batch_size,)) * total
-        idx = jnp.clip(jnp.searchsorted(csum, u, side="right"), 0, capacity - 1)
+    def _scatter(self, sstate, idx, priority):
+        """Write ``priority`` (already |·|+eps) at ``idx`` and exactly
+        refresh the touched chunks' sums/mins (duplicate idx safe: every
+        per-chunk quantity is recomputed from the post-scatter array)."""
+        capacity = sstate["priorities"].shape[0]
+        chunk, n_chunks = self._layout(capacity)
+        prio = sstate["priorities"].at[idx].set(priority)
+        p_alpha = sstate["p_alpha"].at[idx].set(
+            jnp.power(priority, self.alpha).astype(jnp.float32)
+        )
+        cid = idx // chunk
+        rows = p_alpha.reshape(n_chunks, chunk)[cid]  # (B, chunk)
+        sums = rows.sum(axis=-1)
+        mins = jnp.min(jnp.where(rows > 0, rows, jnp.inf), axis=-1)
+        return sstate.replace(
+            priorities=prio,
+            p_alpha=p_alpha,
+            chunk_sums=sstate["chunk_sums"].at[cid].set(sums),
+            chunk_mins=sstate["chunk_mins"].at[cid].set(mins),
+        )
 
-        probs = p_alpha / jnp.clip(total, 1e-12)
+    def sample(self, sstate, key, batch_size, size, capacity):
+        chunk, n_chunks = self._layout(capacity)
+        p_alpha = sstate["p_alpha"]
+        chunk_csum = jnp.cumsum(sstate["chunk_sums"])
+        total = chunk_csum[-1]
+        u = jax.random.uniform(key, (batch_size,)) * total
+        cidx = jnp.clip(
+            jnp.searchsorted(chunk_csum, u, side="right"), 0, n_chunks - 1
+        )
+        resid = u - jnp.where(cidx > 0, chunk_csum[cidx - 1], 0.0)
+        rows = p_alpha.reshape(n_chunks, chunk)[cidx]  # (B, chunk)
+        row_csum = jnp.cumsum(rows, axis=-1)
+        within = jax.vmap(
+            lambda c, r: jnp.searchsorted(c, r, side="right")
+        )(row_csum, resid)
+        idx = jnp.clip(cidx * chunk + jnp.clip(within, 0, chunk - 1),
+                       0, capacity - 1)
+
         beta = self._beta(sstate["step"])
         n = jnp.maximum(size.astype(jnp.float32), 1.0)
-        weights = jnp.power(n * jnp.clip(probs[idx], 1e-12), -beta)
-        # normalize by the max possible weight (min priority) for stability
-        min_prob = jnp.min(jnp.where(mask, probs, jnp.inf))
+        total_c = jnp.clip(total, 1e-12)
+        weights = jnp.power(n * jnp.clip(p_alpha[idx] / total_c, 1e-12), -beta)
+        # normalize by the max possible weight (min priority) for stability;
+        # unwritten slots hold p_alpha=0 and are excluded from chunk_mins
+        min_prob = jnp.min(sstate["chunk_mins"]) / total_c
         max_w = jnp.power(n * jnp.clip(min_prob, 1e-12), -beta)
         weights = weights / jnp.clip(max_w, 1e-12)
         info = ArrayDict(_weight=weights, index=idx)
@@ -168,14 +224,14 @@ class PrioritizedSampler(Sampler):
 
     def on_write(self, sstate, idx, items):
         # new samples get max priority (reference behavior)
-        prio = sstate["priorities"].at[idx].set(sstate["max_priority"])
-        return sstate.set("priorities", prio)
+        prio = jnp.broadcast_to(sstate["max_priority"], jnp.shape(idx))
+        return self._scatter(sstate, idx, prio)
 
     def update_priority(self, sstate, idx, priority):
         priority = jnp.abs(priority) + self.eps
-        prio = sstate["priorities"].at[idx].set(priority)
+        sstate = self._scatter(sstate, idx, priority)
         max_p = jnp.maximum(sstate["max_priority"], jnp.max(priority))
-        return sstate.replace(priorities=prio, max_priority=max_p)
+        return sstate.set("max_priority", max_p)
 
 
 class StalenessAwareSampler(Sampler):

@@ -223,10 +223,23 @@ class TransformedEnv(EnvBase):
     def step(self, state: EnvState, td: ArrayDict):
         td_in = self.transform.inv(td)
         base_state, out = self.env.step(state["env"], td_in)
+        # base-level hooks (ConditionalPolicySwitch): need env + state access
+        # no data hook has, so they dispatch here, before the data chain
+        for t in self._stack():
+            hook = getattr(t, "base_step_hook", None)
+            if hook is not None:
+                base_state, out = hook(self.env, base_state, out)
         tstate, next_td = self.transform.step(state["transforms"], out["next"])
         # keep the (un-inv'ed) input content at the root
         out = td.set("next", next_td)
         return ArrayDict(env=base_state, transforms=tstate), out
+
+    def _stack(self):
+        return (
+            self.transform.transforms
+            if isinstance(self.transform, Compose)
+            else [self.transform]
+        )
 
     @property
     def _rng_path(self) -> tuple[str, ...]:
@@ -272,11 +285,7 @@ class TransformedEnv(EnvBase):
         # mask is in the carried td, draw uniformly over legal actions.
         from .extra import ActionMask
 
-        stack = (
-            self.transform.transforms
-            if isinstance(self.transform, Compose)
-            else [self.transform]
-        )
+        stack = self._stack()
         for t in stack:
             if isinstance(t, ActionMask) and t.mask_key in td:
                 return td.set(

@@ -1,0 +1,516 @@
+"""Transform long tail, round 4 (round-3 VERDICT missing #1).
+
+Functional re-designs of the remaining feasible reference transforms:
+FlattenAction (reference torchrl/envs/transforms/_action.py:1525),
+SuccessReward (_reward.py:997), NextObservationDelta (_observation.py:1521),
+NextStateReconstructor (rb_transforms.py:230), RandomCropTensorDict
+(_misc.py:277), ConditionalPolicySwitch (_misc.py:773), MeanActionSelector
+(mean_action_selector.py:13), ExpandAs (_clip.py:168), TerminateTransform
+(_env.py:1175).
+
+Env-side hooks are pure ``(tstate, td) -> (tstate, td)`` functions (jit/scan
+safe); replay-buffer-side transforms are callables over the sampled batch
+and plug into ``ReplayBuffer(transform=...)``.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...data import ArrayDict, Bounded, Composite, Unbounded
+from .base import Transform
+
+__all__ = [
+    "FlattenAction",
+    "SuccessReward",
+    "NextObservationDelta",
+    "NextStateReconstructor",
+    "RandomCropTensorDict",
+    "ConditionalPolicySwitch",
+    "MeanActionSelector",
+    "ExpandAs",
+    "TerminateTransform",
+]
+
+
+def _as_key(k):
+    return k if isinstance(k, tuple) else (k,)
+
+
+class FlattenAction(Transform):
+    """Flatten the trailing ``ndims`` action dims (reference _action.py:1525).
+
+    The policy sees a 1-D action space; on the inv direction (policy ->
+    env) the flat action is reshaped back to the env's original
+    ``(d1, ..., dn)`` span before the base step. Mirrors
+    :class:`FlattenObservation` on the action side. ``ndims`` replaces the
+    reference's ``(first_dim, last_dim)`` negative-dim pair: it always
+    counts from the right, so the transform is batch-size agnostic.
+    """
+
+    def __init__(self, ndims: int = 2, action_key: str = "action"):
+        if ndims < 1:
+            raise ValueError("ndims must be >= 1")
+        self.ndims = ndims
+        self.action_key = action_key
+        self._orig_shape: tuple | None = None
+
+    def inv(self, td: ArrayDict) -> ArrayDict:
+        if self._orig_shape is None:
+            raise RuntimeError(
+                "FlattenAction must be attached via TransformedEnv "
+                "(action-spec pass not run)"
+            )
+        a = td[self.action_key]
+        return td.set(
+            self.action_key, a.reshape(a.shape[:-1] + self._orig_shape)
+        )
+
+    def transform_action_spec(self, spec):
+        import dataclasses
+
+        if len(spec.shape) < self.ndims:
+            raise ValueError(
+                f"cannot flatten {self.ndims} dims of action shape {spec.shape}"
+            )
+        self._orig_shape = tuple(spec.shape[len(spec.shape) - self.ndims :])
+        keep = spec.shape[: len(spec.shape) - self.ndims]
+        flat = math.prod(self._orig_shape)
+        new_shape = keep + (flat,)
+        if isinstance(spec, Bounded):
+            # numpy, not jnp: spec properties are re-derived under traces
+            low = np.broadcast_to(np.asarray(spec.low), spec.shape).reshape(new_shape)
+            high = np.broadcast_to(np.asarray(spec.high), spec.shape).reshape(new_shape)
+            return Bounded(shape=new_shape, low=low, high=high, dtype=spec.dtype)
+        return dataclasses.replace(spec, shape=new_shape)
+
+
+class SuccessReward(Transform):
+    """Sparse reward from a binary success signal (reference _reward.py:997):
+    ``reward = success * scale`` written at step time; the reward spec
+    becomes Bounded over ``{0, scale}`` shaped like the success entry."""
+
+    def __init__(
+        self,
+        success_key: str = "success",
+        reward_key: str = "reward",
+        *,
+        scale: float = 1.0,
+    ):
+        self.success_key = _as_key(success_key)
+        self.reward_key = _as_key(reward_key)
+        self.scale = float(scale)
+        self._success_shape: tuple | None = None
+
+    def step(self, tstate, next_td):
+        r = next_td[self.success_key].astype(jnp.float32) * self.scale
+        return tstate, next_td.set(self.reward_key, r)
+
+    def transform_observation_spec(self, spec):
+        if self.success_key in spec:
+            self._success_shape = tuple(spec[self.success_key].shape)
+        return spec
+
+    def transform_reward_spec(self, spec):
+        shape = self._success_shape
+        if shape is None:
+            shape = tuple(getattr(spec, "shape", ()))
+        return Bounded(
+            shape=shape,
+            low=min(0.0, self.scale),
+            high=max(0.0, self.scale),
+            dtype=jnp.float32,
+        )
+
+
+class NextObservationDelta(Transform):
+    """Store next-observation deltas in low precision (reference
+    _observation.py:1521).
+
+    Env side: for each in-key ``k``, the post-step hook writes
+    ``("delta", k) = (next_obs - obs).astype(delta_dtype)`` (previous obs
+    carried in transform state). The full next obs stays in the step output
+    (the in-jit rollout carry needs it); storage savings come from dropping
+    it at buffer-insertion time with :meth:`compact`.
+
+    RB side: the same instance is a sampled-batch callable
+    (``ReplayBuffer(transform=nod)``) reconstructing
+    ``("next", k) = root k + delta`` and dropping the delta key. Unlike
+    :class:`NextStateReconstructor` the delta encodes the actual
+    transition, so boundary transitions reconstruct exactly to
+    ``delta_dtype`` round-trip precision.
+    """
+
+    def __init__(
+        self,
+        in_keys: Sequence[Any] = ("observation",),
+        *,
+        delta_dtype=jnp.float16,
+        drop_delta: bool = True,
+    ):
+        self.in_keys = [_as_key(k) for k in in_keys]
+        self.delta_dtype = jnp.dtype(delta_dtype)
+        self.drop_delta = drop_delta
+
+    # -- env side --------------------------------------------------------------
+
+    def init(self, reset_td):
+        return ArrayDict(prev=ArrayDict(**{
+            "/".join(k): reset_td[k] for k in self.in_keys
+        }))
+
+    def reset(self, tstate, td):
+        prev = ArrayDict(**{"/".join(k): td[k] for k in self.in_keys})
+        for k in self.in_keys:  # zero delta at reset: spec/reset agreement
+            td = td.set(
+                ("delta",) + k, jnp.zeros_like(td[k], self.delta_dtype)
+            )
+        return ArrayDict(prev=prev), td
+
+    def step(self, tstate, next_td):
+        prev = tstate["prev"]
+        out = next_td
+        new_prev = {}
+        for k in self.in_keys:
+            flat = "/".join(k)
+            obs = next_td[k]
+            delta = (obs - prev[flat]).astype(self.delta_dtype)
+            out = out.set(("delta",) + k, delta)
+            new_prev[flat] = obs
+        return ArrayDict(prev=ArrayDict(**new_prev)), out
+
+    def transform_observation_spec(self, spec):
+        for k in self.in_keys:
+            leaf = spec[k]
+            spec = spec.set(
+                ("delta",) + k,
+                Unbounded(shape=leaf.shape, dtype=self.delta_dtype),
+            )
+        return spec
+
+    # -- storage / RB side -----------------------------------------------------
+
+    def compact(self, batch: ArrayDict) -> ArrayDict:
+        """Drop the full ``("next", k)`` entries before buffer insertion —
+        the delta keys carry the transition at ``delta_dtype`` cost."""
+        return batch.exclude(*[("next",) + k for k in self.in_keys])
+
+    def __call__(self, batch: ArrayDict) -> ArrayDict:
+        for k in self.in_keys:
+            root = batch[k]
+            delta = batch[("next", "delta") + k]
+            batch = batch.set(
+                ("next",) + k, root + delta.astype(root.dtype)
+            )
+            if self.drop_delta:
+                batch = batch.exclude(("next", "delta") + k)
+        return batch
+
+
+class NextStateReconstructor(Transform):
+    """Re-hydrate ``("next", k)`` at sampling time by shifting along the
+    batch (reference rb_transforms.py:230).
+
+    Pairs with collectors that drop next-observations from storage (they
+    are bit-identical to the root obs at ``t+1`` inside a trajectory).
+    For each flat batch position ``i``: ``next_k[i] = k[i+1]`` when
+    ``i+1`` is in the batch, shares the trajectory id, and ``done[i]`` is
+    False; otherwise ``fill_value``. A sampled-batch callable
+    (``ReplayBuffer(transform=...)``) — pure jnp, jit-safe.
+    """
+
+    def __init__(
+        self,
+        keys: Sequence[Any] = ("observation",),
+        *,
+        traj_key: Any = ("collector", "traj_ids"),
+        done_key: Any = ("next", "done"),
+        fill_value: float = float("nan"),
+        strict: bool = True,
+    ):
+        self.keys = [_as_key(k) for k in keys]
+        self.traj_key = _as_key(traj_key) if traj_key is not None else None
+        self.done_key = _as_key(done_key) if done_key is not None else None
+        self.fill_value = fill_value
+        self.strict = strict
+
+    def __call__(self, batch: ArrayDict) -> ArrayDict:
+        n = None
+        for k in self.keys:
+            n = batch[k].shape[0]
+            break
+        ok = jnp.arange(n) < (n - 1)  # position i+1 exists
+        if self.traj_key is not None:
+            if self.traj_key in batch:
+                traj = batch[self.traj_key].reshape(n, -1)[:, 0]
+                ok = ok & (jnp.roll(traj, -1) == traj)
+            elif self.strict:
+                raise KeyError(
+                    f"NextStateReconstructor: {self.traj_key} missing from batch"
+                )
+        if self.done_key is not None:
+            if self.done_key in batch:
+                done = batch[self.done_key].reshape(n, -1).any(axis=-1)
+                ok = ok & ~done
+            elif self.strict:
+                raise KeyError(
+                    f"NextStateReconstructor: {self.done_key} missing from batch"
+                )
+        for k in self.keys:
+            x = batch[k]
+            if jnp.issubdtype(x.dtype, jnp.integer) and not math.isfinite(
+                self.fill_value
+            ):
+                raise ValueError(
+                    f"NextStateReconstructor: key {k} has integer dtype "
+                    f"{x.dtype}; NaN cannot mark missing entries — pass an "
+                    "explicit integer fill_value (e.g. 0)"
+                )
+            shifted = jnp.roll(x, -1, axis=0)
+            mask = ok.reshape((n,) + (1,) * (x.ndim - 1))
+            fill = jnp.asarray(self.fill_value, x.dtype)
+            batch = batch.set(("next",) + k, jnp.where(mask, shifted, fill))
+        return batch
+
+
+class RandomCropTensorDict(Transform):
+    """Random fixed-length crop along a time dim of sampled trajectories
+    (reference _misc.py:277). A HOST-side replay/module transform (numpy
+    RNG for the start index — not jit-traceable; crop it before entering
+    the jitted train step, like the reference uses it on RB samples).
+
+    With ``mask_key``, valid lengths are taken from the (front-loaded)
+    boolean mask and crops are drawn inside the valid prefix.
+    """
+
+    def __init__(
+        self,
+        sub_seq_len: int,
+        sample_dim: int = -1,
+        mask_key: Any = None,
+        seed: int = 0,
+    ):
+        self.sub_seq_len = sub_seq_len
+        if sample_dim >= 0:
+            raise ValueError(
+                "sample_dim must be negative (batch-dim agnostic, the "
+                "framework's time convention is trailing)"
+            )
+        self.sample_dim = sample_dim
+        self.mask_key = _as_key(mask_key) if mask_key is not None else None
+        self._rng = np.random.default_rng(seed)
+
+    def __call__(self, td: ArrayDict) -> ArrayDict:
+        shape = td.batch_shape
+        if not len(shape):
+            raise RuntimeError("cannot crop a tensordict with empty batch shape")
+        dim = self.sample_dim % len(shape)
+        T = shape[dim]
+        if T < self.sub_seq_len:
+            raise RuntimeError(
+                f"cannot crop length {self.sub_seq_len} from time dim {T}"
+            )
+        idx_shape = list(shape)
+        idx_shape[dim] = 1
+        if self.mask_key is None or self.mask_key not in td:
+            idx0 = self._rng.integers(0, T - self.sub_seq_len + 1, idx_shape)
+        else:
+            mask = np.asarray(td[self.mask_key])
+            if mask.shape != tuple(shape):
+                raise ValueError(
+                    f"mask shape {mask.shape} != batch shape {tuple(shape)}"
+                )
+            lengths = mask.cumsum(dim).max(axis=dim, keepdims=True)
+            if (lengths < self.sub_seq_len).any():
+                raise RuntimeError(
+                    f"cannot crop length {self.sub_seq_len}: min valid "
+                    f"length is {lengths.min()}"
+                )
+            idx0 = (
+                self._rng.random(idx_shape) * (lengths - self.sub_seq_len + 1)
+            ).astype(np.int64)
+        arange = np.arange(self.sub_seq_len)
+        arange = arange.reshape(
+            [1] * dim + [self.sub_seq_len] + [1] * (len(shape) - dim - 1)
+        )
+        idx = jnp.asarray(idx0 + arange)
+
+        def crop(x):
+            return jnp.take_along_axis(
+                x,
+                idx.reshape(idx.shape + (1,) * (x.ndim - len(shape))),
+                axis=dim,
+            )
+
+        return jax.tree.map(crop, td)
+
+
+class ConditionalPolicySwitch(Transform):
+    """Step a second policy whenever a condition holds on the post-step
+    data (reference _misc.py:773 — the turn-based opponent pattern).
+
+    After the base env's step, ``condition(next_td)`` is evaluated
+    per-env; where it is True, ``policy`` produces an action from the
+    post-step data and the base env is stepped AGAIN, and that second
+    step's output replaces the first wholesale (state included). Both
+    branches execute under jit (the extra step is ``where``-selected, the
+    XLA-native form of data-dependent control flow), so the cost is one
+    additional env step per transition.
+
+    Unlike the reference the hook runs on the BASE env's output (before
+    the rest of the transform chain), and ``policy`` must be a
+    deterministic ``td -> td`` callable writing the action key.
+    """
+
+    def __init__(
+        self,
+        policy: Callable[[ArrayDict], ArrayDict],
+        condition: Callable[[ArrayDict], Any],
+    ):
+        self.policy = policy
+        self.condition = condition
+
+    # dispatched by TransformedEnv.step between the base step and the
+    # transform chain (needs base-env access no data hook has)
+    def base_step_hook(self, env, base_state, out: ArrayDict):
+        from ..base import step_mdp, where_done
+
+        cond = jnp.asarray(self.condition(out["next"]))
+        # never step past an episode end: a terminal transition must keep
+        # its done flags and terminal reward, whatever the condition says
+        done = out["next", "done"]
+        cond = cond & ~done.reshape(done.shape + (1,) * (cond.ndim - done.ndim))
+        opp_in = step_mdp(out)
+        opp_in = self.policy(opp_in)
+        state2, out2 = env.step(base_state, opp_in)
+        merged_state = where_done(cond, state2, base_state)
+        merged_next = where_done(cond, out2["next"], out["next"])
+        return merged_state, out.set("next", merged_next)
+
+
+class MeanActionSelector(Transform):
+    """Bridge Gaussian belief-space (PILCO-style) policies to standard envs
+    (reference mean_action_selector.py:13): observations are wrapped into
+    ``(obs, "mean")`` + zero-covariance ``(obs, "var")`` beliefs; the
+    policy's ``(action, "mean")`` is unwrapped to the flat action."""
+
+    def __init__(
+        self, observation_key: str = "observation", action_key: str = "action"
+    ):
+        self.obs_key = _as_key(observation_key)
+        self.action_key = _as_key(action_key)
+
+    def _wrap(self, td):
+        obs = td[self.obs_key]
+        d = obs.shape[-1]
+        var = jnp.zeros(obs.shape + (d,), obs.dtype)
+        return (
+            td.exclude(self.obs_key)
+            .set(self.obs_key + ("mean",), obs)
+            .set(self.obs_key + ("var",), var)
+        )
+
+    def reset(self, tstate, td):
+        return tstate, self._wrap(td)
+
+    def step(self, tstate, next_td):
+        return tstate, self._wrap(next_td)
+
+    def inv(self, td):
+        mean_key = self.action_key + ("mean",)
+        if mean_key in td:
+            td = td.set(self.action_key, td[mean_key]).exclude(mean_key)
+        return td
+
+    def transform_observation_spec(self, spec):
+        leaf = spec[self.obs_key]
+        d = leaf.shape[-1]
+        import dataclasses
+
+        return spec.delete(self.obs_key).set(
+            self.obs_key,
+            Composite(
+                {
+                    "mean": dataclasses.replace(leaf),
+                    "var": Unbounded(shape=leaf.shape + (d,), dtype=leaf.dtype),
+                }
+            ),
+        )
+
+
+class ExpandAs(Transform):
+    """Expand one entry to the right to match a reference entry's shape
+    (reference _clip.py:168) — e.g. broadcast an env-level ``done`` to the
+    per-agent reward shape in multi-agent setups."""
+
+    def __init__(self, in_key, ref_key, out_key=None):
+        self.in_key = _as_key(in_key)
+        self.ref_key = _as_key(ref_key)
+        self.out_key = _as_key(out_key) if out_key is not None else self.in_key
+        self._ref_shape: tuple | None = None
+
+    def _apply(self, td):
+        if self.ref_key not in td or self.in_key not in td:
+            return td
+        ref = td[self.ref_key]
+        v = td[self.in_key]
+        v = v.reshape(v.shape + (1,) * (ref.ndim - v.ndim))
+        return td.set(self.out_key, jnp.broadcast_to(v, ref.shape))
+
+    def reset(self, tstate, td):
+        return tstate, self._apply(td)
+
+    def step(self, tstate, next_td):
+        return tstate, self._apply(next_td)
+
+    def transform_observation_spec(self, spec):
+        if self.ref_key in spec:
+            self._ref_shape = tuple(spec[self.ref_key].shape)
+        if self._ref_shape is not None and self.in_key in spec:
+            import dataclasses
+
+            leaf = spec[self.in_key]
+            spec = spec.set(
+                self.out_key, dataclasses.replace(leaf, shape=self._ref_shape)
+            )
+        return spec
+
+    def transform_done_spec(self, spec):
+        if self._ref_shape is not None and self.in_key in spec:
+            import dataclasses
+
+            leaf = spec[self.in_key]
+            spec = spec.set(
+                self.out_key, dataclasses.replace(leaf, shape=self._ref_shape)
+            )
+        return spec
+
+
+class TerminateTransform(Transform):
+    """OR a user predicate into ``terminated``/``done`` after each step
+    (reference _env.py:1175): ``stop(next_td)`` returns a boolean scalar or
+    array broadcastable to the done shape; rollouts with early-stop
+    semantics end when the goal condition is reached. jit-safe (the flag is
+    data, not control flow)."""
+
+    def __init__(self, stop: Callable[[ArrayDict], Any], *, write_done: bool = True):
+        if not callable(stop):
+            raise ValueError("stop must be callable")
+        self.stop = stop
+        self.write_done = write_done
+
+    def step(self, tstate, next_td):
+        flag = jnp.asarray(self.stop(next_td)).astype(bool)
+        term = next_td["terminated"]
+        flag = jnp.broadcast_to(
+            flag.reshape(flag.shape + (1,) * (term.ndim - flag.ndim)), term.shape
+        )
+        out = next_td.set("terminated", term | flag)
+        if self.write_done and "done" in next_td:
+            out = out.set("done", out["done"] | flag)
+        return tstate, out
