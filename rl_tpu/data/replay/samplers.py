@@ -204,6 +204,11 @@ class PrioritizedSampler(Sampler):
         resid = u - jnp.where(cidx > 0, chunk_csum[cidx - 1], 0.0)
         rows = p_alpha.reshape(n_chunks, chunk)[cidx]  # (B, chunk)
         row_csum = jnp.cumsum(rows, axis=-1)
+        # chunk_sums (rows.sum) and row_csum (cumsum) can disagree in the
+        # last float ulps (different summation order under XLA); clamp the
+        # residual strictly inside the row total so searchsorted can never
+        # step past the last nonzero element into unwritten padding
+        resid = jnp.minimum(resid, row_csum[:, -1] * (1.0 - 1e-6))
         within = jax.vmap(
             lambda c, r: jnp.searchsorted(c, r, side="right")
         )(row_csum, resid)

@@ -524,26 +524,44 @@ class Composite(Spec):
 
 
 def stack_specs(specs: list[Spec], axis: int = 0) -> Spec:
-    """Stack homogeneous specs along a new batch axis (reference Stacked:1496).
+    """Stack specs along a new batch axis (reference Stacked:1496 /
+    ``torch.stack`` over specs).
 
-    Heterogeneous stacking (ragged multi-agent) is represented instead by a
-    Composite with per-agent keys — masking, not ragged lazy-stacks, is the
-    TPU-friendly form.
+    Homogeneous members produce a plain dense spec with a grown batch
+    shape. HETEROGENEOUS members (ragged multi-agent groups: same
+    semantics, different shapes/domains) produce the mask-backed
+    :class:`~rl_tpu.data.Stacked` / :class:`~rl_tpu.data.StackedComposite`
+    (axis 0 only — padding+mask is the TPU-native lazy stack).
     """
     first = specs[0]
     if any(type(s) is not type(first) for s in specs):
-        raise ValueError("stack_specs requires homogeneous specs; use Composite per-key for heterogeneous groups")
+        raise ValueError(
+            "stack_specs requires same-type specs; wrap mixed types in a "
+            "Composite per key"
+        )
     if isinstance(first, Composite):
+        homogeneous = all(
+            set(s.keys()) == set(first.keys())
+            and all(s[k] == first[k] for k in first.keys())
+            for s in specs[1:]
+        )
+        if not homogeneous:
+            if axis != 0:
+                raise ValueError("heterogeneous stacking supports axis=0 only")
+            from .hetero import StackedComposite
+
+            return StackedComposite(specs)
         # Children hold feature shapes; only the shared batch shape grows.
-        for k in first.keys():
-            if any(s[k] != first[k] for s in specs[1:]):
-                raise ValueError("stack_specs requires identical child specs")
         return Composite(
             dict(first.items()),
             shape=first.shape[:axis] + (len(specs),) + first.shape[axis:],
         )
     if any(s != first for s in specs):
-        raise ValueError("stack_specs requires identical leaf specs")
+        if axis != 0:
+            raise ValueError("heterogeneous stacking supports axis=0 only")
+        from .hetero import Stacked
+
+        return Stacked(specs=tuple(specs))
     new_shape = first.shape[:axis] + (len(specs),) + first.shape[axis:]
     return dataclasses.replace(first, shape=new_shape)
 

@@ -41,9 +41,27 @@ class PettingZooWrapper:
         # AEC envs expose per-agent ``observe``; parallel envs do not
         self.is_parallel = not hasattr(env, "observe")
         self.agents = list(env.possible_agents)
-        space = env.observation_space(self.agents[0])
-        self._per_agent_obs_spec = spec_from_gym_space(space)
-        self._action_spec = spec_from_gym_space(env.action_space(self.agents[0]))
+        self._agent_obs_specs = [
+            spec_from_gym_space(env.observation_space(a)) for a in self.agents
+        ]
+        self._agent_action_specs = [
+            spec_from_gym_space(env.action_space(a)) for a in self.agents
+        ]
+        # ragged groups (different per-agent spaces) take the mask-backed
+        # Stacked/StackedComposite path (reference pettingzoo.py stacks
+        # hetero agents lazily; here: dense padding + static masks).
+        # Tracked per side: obs and action spaces can be ragged independently
+        self.hetero_obs = any(
+            s != self._agent_obs_specs[0] for s in self._agent_obs_specs[1:]
+        )
+        self.hetero_act = any(
+            s != self._agent_action_specs[0]
+            for s in self._agent_action_specs[1:]
+        )
+        self.heterogeneous = self.hetero_obs or self.hetero_act
+        self._stacked_obs_spec = None  # built lazily once (static afterwards)
+        self._per_agent_obs_spec = self._agent_obs_specs[0]
+        self._action_spec = self._agent_action_specs[0]
         # AEC envs with masked discrete actions expose Dict({observation, action_mask})
         self._masked = (
             isinstance(self._per_agent_obs_spec, Composite)
@@ -57,10 +75,23 @@ class PettingZooWrapper:
         if self.is_parallel:
             import dataclasses
 
+            from ...data import stack_specs
+
+            per_all = [
+                s if isinstance(s, Composite) else Composite(observation=s)
+                for s in self._agent_obs_specs
+            ]
+            if self.hetero_obs:
+                # ragged group: StackedComposite via stack_specs (padded +
+                # static masks; see data/hetero.py); built once — the spec
+                # is static and _pad_rows reads it on the host hot path
+                if self._stacked_obs_spec is None:
+                    self._stacked_obs_spec = Composite(
+                        agents=stack_specs(per_all)
+                    )
+                return self._stacked_obs_spec
             n = len(self.agents)
-            per = self._per_agent_obs_spec
-            if not isinstance(per, Composite):
-                per = Composite(observation=per)
+            per = per_all[0]
             stacked = Composite(
                 {
                     k: dataclasses.replace(v, shape=(n,) + v.shape)
@@ -87,6 +118,10 @@ class PettingZooWrapper:
         if self.is_parallel:
             import dataclasses
 
+            if self.hetero_act:
+                from ...data import stack_specs
+
+                return stack_specs(list(self._agent_action_specs))
             return dataclasses.replace(
                 self._action_spec, shape=(len(self.agents),) + self._action_spec.shape
             )
@@ -176,39 +211,69 @@ class PettingZooWrapper:
 
     # -- host protocol (parallel) ----------------------------------------------
 
+    def _pad_rows(self, rows: list, key: tuple) -> np.ndarray:
+        """Stack per-agent leaves; hetero groups pad each row into its
+        member region of the spec's padded shape (dense + static mask —
+        the mask itself comes from observation_spec["agents"].masks())."""
+        if not self.hetero_obs:
+            return np.stack(rows)
+        spec = self.observation_spec["agents"][key]
+        out = np.zeros(spec.shape, np.asarray(rows[0]).dtype)
+        for i, r in enumerate(rows):
+            r = np.asarray(r)
+            out[(i,) + tuple(slice(0, d) for d in r.shape)] = r
+        return out
+
     def _stack_parallel(self, obs: dict) -> dict:
         # fixed (n_agents, ...) layout: dead agents' rows are zero-filled
         # (parallel envs drop them from the obs dict mid-episode)
         example = next(iter(obs.values()))
+        specs = self._agent_obs_specs
         per = [obs.get(a) for a in self.agents]
         if isinstance(example, dict):
+            keys = {k for p in per if isinstance(p, dict) for k in p}
             return {
-                ("agents", k): np.stack(
+                ("agents", k): self._pad_rows(
                     [
                         np.asarray(p[k])
-                        if p is not None
-                        else np.zeros_like(np.asarray(example[k]))
-                        for p in per
-                    ]
+                        if p is not None and k in p
+                        else np.zeros(
+                            specs[i][k].shape if isinstance(specs[i], Composite) and k in specs[i] else np.shape(example.get(k)),
+                            np.asarray(example[k]).dtype if k in example else np.float32,
+                        )
+                        for i, p in enumerate(per)
+                    ],
+                    (k,),
                 )
-                for k in example
+                for k in keys
             }
         return {
-            ("agents", "observation"): np.stack(
+            ("agents", "observation"): self._pad_rows(
                 [
                     np.asarray(p)
                     if p is not None
-                    else np.zeros_like(np.asarray(example))
-                    for p in per
-                ]
+                    else np.zeros(specs[i].shape, np.asarray(example).dtype)
+                    for i, p in enumerate(per)
+                ],
+                ("observation",),
             )
         }
 
     def _step_parallel(self, action):
         # only LIVE agents receive actions (dead ones are dropped by the env)
         live = list(self.env.agents)
+
+        def member_action(i):
+            a = np.asarray(action[i])
+            spec = self._agent_action_specs[i]
+            if self.hetero_act and a.shape != tuple(spec.shape):
+                # padded hetero row: the agent's true action is its member
+                # region (leading slice per dim)
+                a = a[tuple(slice(0, d) for d in spec.shape)]
+            return a
+
         acts = {
-            a: np.asarray(action[self.agents.index(a)]) for a in live
+            a: member_action(self.agents.index(a)) for a in live
         }
         obs, rewards, terms, truncs, _ = self.env.step(acts)
         reward = float(sum(rewards.values()))

@@ -144,6 +144,26 @@ class TestPER:
         assert w.shape == (16,)
         assert (w > 0).all() and (w <= 1.0 + 1e-5).all()
 
+    def test_never_samples_unwritten_slots(self):
+        """Partially-filled last chunk: the two-level inverse CDF must
+        never step into padding/unwritten slots, even when the residual
+        lands at a chunk boundary (float summation-order guard)."""
+        from rl_tpu.data.replay.samplers import PrioritizedSampler
+
+        cap = 1 << 10
+        size = cap - 3  # last chunk partially filled
+        s = PrioritizedSampler(alpha=1.0)
+        st = s.init(cap)
+        st = s.on_write(st, jnp.arange(size), None)
+        samp = jax.jit(
+            lambda st, k: s.sample(st, k, 512, jnp.asarray(size), cap)
+        )
+        for i in range(20):
+            idx, info, st = samp(st, jax.random.fold_in(KEY, i))
+            assert int(np.asarray(idx).max()) < size
+            w = np.asarray(info["_weight"])
+            assert np.isfinite(w).all() and (w <= 1.0 + 1e-5).all()
+
     def test_new_items_get_max_priority(self):
         sampler = PrioritizedSampler(alpha=1.0, beta=0.4)
         rb = ReplayBuffer(DeviceStorage(16), sampler, batch_size=8)

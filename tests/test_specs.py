@@ -199,9 +199,16 @@ class TestStackAndInfer:
         assert s["a"].shape == (2,)
         assert s.rand(KEY)["a"].shape == (2, 2)
 
-    def test_stack_heterogeneous_raises(self):
+    def test_stack_heterogeneous_returns_masked_stack(self):
+        # round 4: ragged members now produce the mask-backed Stacked
+        # (full behavior in tests/test_hetero_specs.py)
+        from rl_tpu.data import Stacked
+
+        s = stack_specs([Unbounded(shape=(2,)), Unbounded(shape=(3,))])
+        assert isinstance(s, Stacked) and s.shape == (2, 3)
+        # mixed TYPES still raise
         with pytest.raises(ValueError):
-            stack_specs([Unbounded(shape=(2,)), Unbounded(shape=(3,))])
+            stack_specs([Unbounded(shape=(2,)), Bounded(shape=(2,), low=0, high=1)])
 
     def test_make_composite_from_arraydict(self):
         td = ArrayDict(obs=jnp.zeros((4, 3)), nested=ArrayDict(r=jnp.zeros(4)))
