@@ -103,6 +103,7 @@ class Stacked(Spec):
     """
 
     specs: tuple = ()
+    present: tuple = ()  # per-member validity; () = all present
 
     def __post_init__(self):
         specs = tuple(self.specs)
@@ -111,8 +112,12 @@ class Stacked(Spec):
         dtypes = {jnp.dtype(s.dtype) for s in specs}
         if len(dtypes) != 1:
             raise ValueError(f"Stacked members must share dtype, got {dtypes}")
+        present = tuple(self.present) or (True,) * len(specs)
+        if len(present) != len(specs):
+            raise ValueError("present must align with specs")
         padded = _padded_shape([s.shape for s in specs])
         object.__setattr__(self, "specs", specs)
+        object.__setattr__(self, "present", present)
         object.__setattr__(self, "shape", (len(specs),) + padded)
         object.__setattr__(self, "dtype", specs[0].dtype)
 
@@ -130,7 +135,10 @@ class Stacked(Spec):
         m = np.zeros(self.shape, bool)
         for i, s in enumerate(self.specs):
             region = (i,) + tuple(slice(0, d) for d in s.shape)
-            m[region] = True
+            # presence is explicit, not shape-derived: a scalar member's
+            # region covers its whole row, so an ABSENT scalar needs the
+            # flag to stay masked out
+            m[region] = self.present[i]
         out = jnp.asarray(m)
         bs = _canon_shape(batch_shape)
         return jnp.broadcast_to(out, bs + self.shape) if bs else out
@@ -142,6 +150,8 @@ class Stacked(Spec):
         bs = _canon_shape(batch_shape)
         out = jnp.zeros(bs + self.shape, self.dtype)
         for i, s in enumerate(self.specs):
+            if not self.present[i]:
+                continue  # absent member stays zero
             r = s.rand(jax.random.fold_in(key, i), bs)
             out = out.at[self._member_region(i)].set(r)
         return out
@@ -153,6 +163,8 @@ class Stacked(Spec):
         if val.dtype != jnp.dtype(self.dtype):
             return False
         for i, s in enumerate(self.specs):
+            if not self.present[i]:
+                continue  # absent member's slot is padding, any value ok
             region = val[self._member_region(i)]
             if not bool(s._domain_ok(region)):
                 return False
@@ -162,6 +174,8 @@ class Stacked(Spec):
         val = jnp.asarray(val, self.dtype)
         out = jnp.zeros_like(val)
         for i, s in enumerate(self.specs):
+            if not self.present[i]:
+                continue  # absent member's slot projects to zero
             region = self._member_region(i)
             out = out.at[region].set(s.project(val[region]))
         return out
@@ -223,7 +237,8 @@ class StackedComposite(Composite):
                 children[k] = Stacked(
                     specs=tuple(
                         s if s is not None else _erase(proto) for s in subs
-                    )
+                    ),
+                    present=tuple(s is not None for s in subs),
                 )
         super().__init__(children)
         object.__setattr__(self, "members", members)

@@ -1,5 +1,6 @@
+from .chess import ChessEnv
 from .navigation import NavigationEnv
 from .tictactoe import TicTacToeEnv
 from .trading import TradingEnv
 
-__all__ = ["NavigationEnv", "TicTacToeEnv", "TradingEnv"]
+__all__ = ["ChessEnv", "NavigationEnv", "TicTacToeEnv", "TradingEnv"]

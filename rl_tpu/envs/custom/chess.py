@@ -1,0 +1,433 @@
+"""ChessEnv: full chess with a native array move-generation core
+(round-3 VERDICT missing #5).
+
+Redesign of the reference's chess env (reference:
+torchrl/envs/custom/chess.py — ``ChessEnv`` delegates ALL rules to the
+host-side python ``chess`` library and exposes a legal-move ``action_mask``
+consumed by the ActionMask transform). A host library cannot live inside an
+XLA program, so here the rules engine itself is array-native: precomputed
+numpy attack/ray tables + vectorized jnp move generation, with full
+legality (pins, checks, castling-through-check, en passant, promotions)
+decided by a vmapped make-move + king-attack probe. The entire step —
+move-gen, legality mask, termination — is jit/scan-safe, so self-play
+rollouts and MCTS run as single fused programs.
+
+Conventions:
+- square = rank*8 + file (a1=0, h1=7, a8=56); board is a flat [64] int32,
+  white pieces positive (P=1 N=2 B=3 R=4 Q=5 K=6), black negative.
+- action = from*64 + to (``Categorical(4096)``); promotions auto-queen
+  (the AlphaZero-style underpromotion planes are intentionally dropped:
+  one action per (from, to) keeps the mask at 4096 and underpromotion is
+  irrelevant for self-play learning; the reference's SAN action list has
+  them — documented deviation).
+- reward: +1 to the mover for delivering checkmate, 0 otherwise; draws
+  (stalemate, 50-move rule) terminate with 0; illegal action = forfeit
+  (reward -1, episode ends) like TicTacToeEnv.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ...data import ArrayDict, Binary, Bounded, Categorical, Composite, Unbounded
+from ..base import EnvBase
+
+__all__ = ["ChessEnv", "fen_to_state", "START_FEN"]
+
+START_FEN = "rnbqkbnr/pppppppp/8/8/8/8/PPPPPPPP/RNBQKBNR w KQkq - 0 1"
+
+# ---------------------------------------------------------------------------
+# static tables (numpy, built at import)
+# ---------------------------------------------------------------------------
+
+_DIRS = np.array(
+    [8, -8, 1, -1, 9, 7, -9, -7]
+)  # N S E W NE NW SW SE (0-3 ortho, 4-7 diag)
+
+
+def _build_tables():
+    knight = np.zeros((64, 64), bool)
+    king = np.zeros((64, 64), bool)
+    ray = np.full((64, 8, 7), -1, np.int32)
+    pawn_capt = np.zeros((2, 64, 64), bool)  # 0=white, 1=black
+    for s in range(64):
+        r, f = divmod(s, 8)
+        for dr, df in (
+            (2, 1), (2, -1), (-2, 1), (-2, -1),
+            (1, 2), (1, -2), (-1, 2), (-1, -2),
+        ):
+            rr, ff = r + dr, f + df
+            if 0 <= rr < 8 and 0 <= ff < 8:
+                knight[s, rr * 8 + ff] = True
+        for dr in (-1, 0, 1):
+            for df in (-1, 0, 1):
+                if dr == df == 0:
+                    continue
+                rr, ff = r + dr, f + df
+                if 0 <= rr < 8 and 0 <= ff < 8:
+                    king[s, rr * 8 + ff] = True
+        for d, (dr, df) in enumerate(
+            ((1, 0), (-1, 0), (0, 1), (0, -1), (1, 1), (1, -1), (-1, -1), (-1, 1))
+        ):
+            rr, ff = r, f
+            for i in range(7):
+                rr, ff = rr + dr, ff + df
+                if not (0 <= rr < 8 and 0 <= ff < 8):
+                    break
+                ray[s, d, i] = rr * 8 + ff
+        for df in (-1, 1):
+            if 0 <= f + df < 8:
+                if r + 1 < 8:
+                    pawn_capt[0, s, (r + 1) * 8 + f + df] = True
+                if r - 1 >= 0:
+                    pawn_capt[1, s, (r - 1) * 8 + f + df] = True
+    return knight, king, ray, pawn_capt
+
+
+_KNIGHT_NP, _KING_NP, _RAY_NP, _PAWN_CAPT_NP = _build_tables()
+KNIGHT = jnp.asarray(_KNIGHT_NP)
+KING = jnp.asarray(_KING_NP)
+RAY = jnp.asarray(_RAY_NP)  # [64, 8, 7] target squares, -1 padded
+PAWN_CAPT = jnp.asarray(_PAWN_CAPT_NP)
+
+_RANK = jnp.arange(64) // 8
+_FILE = jnp.arange(64) % 8
+
+
+def _ray_reach(board64):
+    """[64 src, 8 dir, 7 step] bool: step visible from src (scan stops at
+    AND INCLUDES the first occupied square)."""
+    padded = jnp.concatenate([board64, jnp.ones((1,), board64.dtype)])
+    ray_occ = padded[RAY] != 0  # -1 index wraps to the sentinel (occupied)
+    blocked_before = jnp.cumsum(ray_occ, axis=-1) - ray_occ.astype(jnp.int32)
+    return (blocked_before == 0) & (RAY >= 0)
+
+
+def square_attacked(board64, sq, by_white):
+    """Is ``sq`` attacked by the given color? Inverse-probe form (rays cast
+    FROM the square; O(8x7), cheap enough to vmap 4096x for legality)."""
+    sgn = jnp.where(by_white, 1, -1)
+    enemy = board64 * sgn  # attacker pieces positive
+    if_knight = jnp.any(KNIGHT[sq] & (enemy == 2))
+    if_king = jnp.any(KING[sq] & (enemy == 6))
+    # a pawn of color c attacks sq iff sq is in the pawn's capture set;
+    # equivalently the OPPOSITE color's capture set from sq hits the pawn
+    opp_idx = jnp.where(by_white, 1, 0)  # white attackers: look "down"
+    if_pawn = jnp.any(PAWN_CAPT[opp_idx, sq] & (enemy == 1))
+    # sliders: first piece along each ray from sq
+    ray_sq = RAY[sq]  # [8, 7]
+    padded = jnp.concatenate([board64, jnp.zeros((1,), board64.dtype)])
+    ray_pc = jnp.where(ray_sq >= 0, padded[ray_sq], 0)
+    occ = ray_pc != 0
+    first = (jnp.cumsum(occ, axis=-1) == 1) & occ  # first blocker per ray
+    first_pc = jnp.sum(jnp.where(first, ray_pc, 0), axis=-1) * sgn  # [8]
+    ortho_hit = jnp.any((first_pc[:4] == 4) | (first_pc[:4] == 5))
+    diag_hit = jnp.any((first_pc[4:] == 3) | (first_pc[4:] == 5))
+    return if_knight | if_king | if_pawn | ortho_hit | diag_hit
+
+
+def _attacked_map(board64, by_white):
+    """[64] bool: squares attacked by the given color (for castling paths)."""
+    return jax.vmap(lambda s: square_attacked(board64, s, by_white))(
+        jnp.arange(64)
+    )
+
+
+def make_move_board(board64, frm, to, stm, ep_sq):
+    """Apply (frm, to) for side ``stm`` (+1/-1). Auto-queen promotion,
+    en passant capture, castling rook shuffle. Returns the new board."""
+    piece = board64[frm]
+    is_pawn = jnp.abs(piece) == 1
+    to_rank = to // 8
+    promo = is_pawn & ((to_rank == 7) | (to_rank == 0))
+    moved = jnp.where(promo, 5 * stm, piece)
+    ep_capture = is_pawn & (to == ep_sq) & (board64[to] == 0) & (
+        (to % 8) != (frm % 8)
+    )
+    out = board64.at[to].set(moved).at[frm].set(0)
+    # remove the en-passant victim (one rank behind the landing square)
+    victim = to - 8 * stm
+    out = jnp.where(ep_capture, out.at[victim].set(0), out)
+    # castling: king moves two files -> rook jumps over
+    is_king = jnp.abs(piece) == 6
+    delta = to - frm
+    castle_k = is_king & (delta == 2)
+    castle_q = is_king & (delta == -2)
+    rook_from = jnp.where(castle_k, frm + 3, frm - 4)
+    rook_to = jnp.where(castle_k, frm + 1, frm - 1)
+    castled = out.at[rook_to].set(4 * stm).at[rook_from].set(0)
+    return jnp.where(castle_k | castle_q, castled, out)
+
+
+def _pseudo_moves(board64, stm, ep_sq, castling):
+    """[64, 64] bool pseudo-legal move matrix for side ``stm``.
+
+    ``castling`` = [wk, wq, bk, bq] bools. Castling entries here already
+    include the not-in-check / not-through-check conditions (the final
+    king-safety vmap re-checks only the landing square).
+    """
+    own = board64 * stm  # own pieces positive
+    own_occ = own > 0
+    empty = board64 == 0
+    target_ok = ~own_occ  # empty or enemy
+
+    knights = (own == 2)[:, None] & KNIGHT & target_ok[None, :]
+    kings = (own == 6)[:, None] & KING & target_ok[None, :]
+
+    reach = _ray_reach(board64)  # [64, 8, 7]
+    # scatter ray visibility into a [64, 64] matrix per direction class
+    tgt = jnp.where(reach, RAY, 64)  # pad -> dummy 64
+
+    def vis_matrix(dirs):
+        m = jnp.zeros((64, 65), bool)
+        flat_src = jnp.repeat(jnp.arange(64), len(dirs) * 7)
+        flat_tgt = tgt[:, dirs, :].reshape(-1)
+        m = m.at[flat_src, flat_tgt].max(True)
+        return m[:, :64]
+
+    ortho_vis = vis_matrix((0, 1, 2, 3))
+    diag_vis = vis_matrix((4, 5, 6, 7))
+    rooks = ((own == 4) | (own == 5))[:, None] & ortho_vis & target_ok[None, :]
+    bishops = ((own == 3) | (own == 5))[:, None] & diag_vis & target_ok[None, :]
+
+    # pawns
+    pawns = own == 1
+    fwd = jnp.arange(64) + 8 * stm
+    fwd_ok = (fwd >= 0) & (fwd < 64)
+    fwd_c = jnp.clip(fwd, 0, 63)
+    push1 = pawns & fwd_ok & empty[fwd_c]
+    pushes = jnp.zeros((64, 64), bool).at[jnp.arange(64), fwd_c].max(push1)
+    start_rank = jnp.where(stm > 0, _RANK == 1, _RANK == 6)
+    fwd2 = jnp.arange(64) + 16 * stm
+    fwd2_c = jnp.clip(fwd2, 0, 63)
+    push2 = pawns & start_rank & empty[fwd_c] & empty[fwd2_c]
+    pushes = pushes.at[jnp.arange(64), fwd2_c].max(push2)
+    capt_tbl = jnp.where(stm > 0, PAWN_CAPT[0], PAWN_CAPT[1])
+    enemy_occ = own < 0
+    ep_tgt = (jnp.arange(64) == ep_sq) & (ep_sq >= 0)
+    captures = pawns[:, None] & capt_tbl & (enemy_occ | ep_tgt)[None, :]
+
+    moves = knights | kings | rooks | bishops | pushes | captures
+
+    # castling (king and rook on their original squares is implied by the
+    # rights flags, which the env clears on any king/rook move or capture)
+    e_sq = jnp.where(stm > 0, 4, 60)
+    rights = jnp.where(stm > 0, castling[:2], castling[2:])
+    enemy_attacks = _attacked_map(board64, stm < 0)
+    f_sq, g_sq = e_sq + 1, e_sq + 2
+    d_sq, c_sq, b_sq = e_sq - 1, e_sq - 2, e_sq - 3
+    can_k = (
+        rights[0]
+        & (own[e_sq] == 6)
+        & empty[f_sq] & empty[g_sq]
+        & ~enemy_attacks[e_sq] & ~enemy_attacks[f_sq] & ~enemy_attacks[g_sq]
+    )
+    can_q = (
+        rights[1]
+        & (own[e_sq] == 6)
+        & empty[d_sq] & empty[c_sq] & empty[b_sq]
+        & ~enemy_attacks[e_sq] & ~enemy_attacks[d_sq] & ~enemy_attacks[c_sq]
+    )
+    moves = moves.at[e_sq, g_sq].max(can_k).at[e_sq, c_sq].max(can_q)
+    return moves
+
+
+def legal_move_mask(board64, stm, ep_sq, castling):
+    """[4096] bool fully-legal (from*64+to) mask: pseudo-legal moves whose
+    resulting position leaves the mover's king unattacked."""
+    pseudo = _pseudo_moves(board64, stm, ep_sq, castling).reshape(-1)
+
+    def safe(a):
+        frm, to = a // 64, a % 64
+        nb = make_move_board(board64, frm, to, stm, ep_sq)
+        ksq = jnp.argmax(nb * stm == 6)
+        return ~square_attacked(nb, ksq, stm < 0)
+
+    # king-safety probe only where pseudo-legal (the rest is already False;
+    # computing it anyway keeps the shape static — XLA masks the cost)
+    safe_all = jax.vmap(safe)(jnp.arange(4096))
+    return pseudo & safe_all
+
+
+def _in_check(board64, stm):
+    ksq = jnp.argmax(board64 * stm == 6)
+    return square_attacked(board64, ksq, stm < 0)
+
+
+# ---------------------------------------------------------------------------
+# FEN (host-side setup helper)
+# ---------------------------------------------------------------------------
+
+_PIECE_OF = {"P": 1, "N": 2, "B": 3, "R": 4, "Q": 5, "K": 6}
+
+
+def fen_to_state(fen: str) -> ArrayDict:
+    """Parse a FEN string into the env's state ArrayDict (host-side)."""
+    parts = fen.split()
+    board = np.zeros(64, np.int32)
+    for r, row in enumerate(parts[0].split("/")):
+        f = 0
+        for ch in row:
+            if ch.isdigit():
+                f += int(ch)
+            else:
+                sgn = 1 if ch.isupper() else -1
+                board[(7 - r) * 8 + f] = sgn * _PIECE_OF[ch.upper()]
+                f += 1
+    stm = 1 if parts[1] == "w" else -1
+    cast = np.array(
+        ["K" in parts[2], "Q" in parts[2], "k" in parts[2], "q" in parts[2]]
+    )
+    ep = -1
+    if len(parts) > 3 and parts[3] != "-":
+        ep = (int(parts[3][1]) - 1) * 8 + (ord(parts[3][0]) - ord("a"))
+    halfmove = int(parts[4]) if len(parts) > 4 else 0
+    return ArrayDict(
+        board=jnp.asarray(board),
+        stm=jnp.asarray(stm, jnp.int32),
+        castling=jnp.asarray(cast),
+        ep=jnp.asarray(ep, jnp.int32),
+        halfmove=jnp.asarray(halfmove, jnp.int32),
+    )
+
+
+# ---------------------------------------------------------------------------
+# env
+# ---------------------------------------------------------------------------
+
+
+class ChessEnv(EnvBase):
+    """Two-player chess as a turn-based env (reference chess.py ChessEnv).
+
+    Observation: flat board, side to move, castling rights, en-passant
+    square, halfmove clock and the 4096-way legal ``action_mask`` (the
+    ActionMask transform and ``rand_action`` consume it). Illegal action =
+    forfeit (mover gets -1, episode ends) — TicTacToeEnv convention.
+    """
+
+    def __init__(self, max_halfmoves: int = 100):
+        self.max_halfmoves = max_halfmoves  # 50-move rule (in half-moves)
+
+    @property
+    def observation_spec(self) -> Composite:
+        return Composite(
+            board=Bounded(shape=(64,), low=-6, high=6, dtype=jnp.int32),
+            turn=Bounded(shape=(), low=0, high=1, dtype=jnp.int32),
+            castling=Binary(shape=(4,)),
+            ep=Bounded(shape=(), low=-1, high=63, dtype=jnp.int32),
+            halfmove=Unbounded(shape=(), dtype=jnp.int32),
+            action_mask=Binary(shape=(4096,)),
+        )
+
+    @property
+    def action_spec(self):
+        return Categorical(n=4096)
+
+    @property
+    def state_spec(self) -> Composite:
+        return Composite(
+            board=Unbounded(shape=(64,), dtype=jnp.int32),
+            stm=Unbounded(shape=(), dtype=jnp.int32),
+            castling=Binary(shape=(4,)),
+            ep=Unbounded(shape=(), dtype=jnp.int32),
+            halfmove=Unbounded(shape=(), dtype=jnp.int32),
+        )
+
+    def _obs(self, st: ArrayDict, mask=None) -> ArrayDict:
+        if mask is None:
+            mask = legal_move_mask(
+                st["board"], st["stm"], st["ep"], st["castling"]
+            )
+        return ArrayDict(
+            board=st["board"],
+            turn=jnp.where(st["stm"] > 0, 0, 1).astype(jnp.int32),
+            castling=st["castling"],
+            ep=st["ep"],
+            halfmove=st["halfmove"],
+            action_mask=mask,
+        )
+
+    def _reset(self, key):
+        st = fen_to_state(START_FEN)
+        return st, self._obs(st)
+
+    def reset_from_fen(self, fen: str, key=None):
+        """Start from an arbitrary position (host-side helper)."""
+        st = fen_to_state(fen)
+        state = st.set("rng", jax.random.key(0) if key is None else key)
+        zero = jnp.zeros((), jnp.bool_)
+        td = self._obs(st).update(
+            ArrayDict(done=zero, terminated=zero, truncated=zero)
+        )
+        return state, td
+
+    def _step(self, state, action, key):
+        board, stm = state["board"], state["stm"]
+        ep, castling = state["ep"], state["castling"]
+        frm, to = action // 64, action % 64
+
+        mask = legal_move_mask(board, stm, ep, castling)
+        legal = mask[action]
+
+        nb = make_move_board(board, frm, to, stm, ep)
+        board2 = jnp.where(legal, nb, board)
+
+        piece = board[frm]
+        is_pawn = jnp.abs(piece) == 1
+        captured = board[to] != 0
+        # en-passant square: set only on a double push
+        new_ep = jnp.where(
+            legal & is_pawn & (jnp.abs(to - frm) == 16),
+            (frm + to) // 2,
+            -1,
+        ).astype(jnp.int32)
+        # castling rights: clear on king move, rook move, rook capture
+        def lost(sq):
+            return (frm == sq) | (to == sq)
+
+        is_king = jnp.abs(piece) == 6
+        new_castling = jnp.where(
+            legal,
+            jnp.stack(
+                [
+                    castling[0] & ~lost(7) & ~(is_king & (stm > 0)),
+                    castling[1] & ~lost(0) & ~(is_king & (stm > 0)),
+                    castling[2] & ~lost(63) & ~(is_king & (stm < 0)),
+                    castling[3] & ~lost(56) & ~(is_king & (stm < 0)),
+                ]
+            ),
+            castling,
+        )
+        new_half = jnp.where(
+            legal & (is_pawn | captured), 0, state["halfmove"] + 1
+        ).astype(jnp.int32)
+
+        nstm = -stm
+        new_state = ArrayDict(
+            board=board2, stm=nstm, castling=new_castling,
+            ep=new_ep, halfmove=new_half,
+        )
+
+        opp_mask = legal_move_mask(board2, nstm, new_ep, new_castling)
+        opp_has_move = jnp.any(opp_mask)
+        opp_in_check = _in_check(board2, nstm)
+        checkmate = legal & ~opp_has_move & opp_in_check
+        stalemate = legal & ~opp_has_move & ~opp_in_check
+        fifty = legal & (new_half >= self.max_halfmoves)
+
+        reward = jnp.where(checkmate, 1.0, 0.0) + jnp.where(legal, 0.0, -1.0)
+        # the 50-move rule is a game-rule DRAW (true value 0): a
+        # termination, not a truncation — value estimators must not
+        # bootstrap past it
+        terminated = checkmate | stalemate | fifty | ~legal
+
+        return (
+            new_state,
+            self._obs(new_state, mask=opp_mask),
+            reward.astype(jnp.float32),
+            terminated,
+            jnp.zeros((), jnp.bool_),
+        )

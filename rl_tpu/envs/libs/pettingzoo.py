@@ -73,6 +73,10 @@ class PettingZooWrapper:
     @property
     def observation_spec(self) -> Composite:
         if self.is_parallel:
+            # static after __init__ and cached BEFORE any construction —
+            # _pad_rows reads this on the host hot path every step
+            if self._stacked_obs_spec is not None:
+                return self._stacked_obs_spec
             import dataclasses
 
             from ...data import stack_specs
@@ -83,22 +87,21 @@ class PettingZooWrapper:
             ]
             if self.hetero_obs:
                 # ragged group: StackedComposite via stack_specs (padded +
-                # static masks; see data/hetero.py); built once — the spec
-                # is static and _pad_rows reads it on the host hot path
-                if self._stacked_obs_spec is None:
-                    self._stacked_obs_spec = Composite(
-                        agents=stack_specs(per_all)
+                # static masks; see data/hetero.py)
+                spec = Composite(agents=stack_specs(per_all))
+            else:
+                n = len(self.agents)
+                per = per_all[0]
+                spec = Composite(
+                    agents=Composite(
+                        {
+                            k: dataclasses.replace(v, shape=(n,) + v.shape)
+                            for k, v in per.items()
+                        }
                     )
-                return self._stacked_obs_spec
-            n = len(self.agents)
-            per = per_all[0]
-            stacked = Composite(
-                {
-                    k: dataclasses.replace(v, shape=(n,) + v.shape)
-                    for k, v in per.items()
-                }
-            )
-            return Composite(agents=stacked)
+                )
+            self._stacked_obs_spec = spec
+            return spec
         import numpy as np
 
         from ...data import Unbounded
@@ -230,6 +233,24 @@ class PettingZooWrapper:
         example = next(iter(obs.values()))
         specs = self._agent_obs_specs
         per = [obs.get(a) for a in self.agents]
+
+        def zero_fill(i, k):
+            """Dead-agent / absent-key fill with the SPEC's shape+dtype —
+            never a float32 guess (the stacked data must stay in-spec)."""
+            s = specs[i]
+            if isinstance(s, Composite) and k in s:
+                leaf = s[k]
+                return np.zeros(leaf.shape, leaf.dtype)
+            if not isinstance(s, Composite) and k == "observation":
+                return np.zeros(s.shape, s.dtype)
+            # the member genuinely lacks this key: zero-size region of the
+            # dtype some other member declares for it
+            for so in specs:
+                if isinstance(so, Composite) and k in so:
+                    leaf = so[k]
+                    return np.zeros((0,) * len(leaf.shape), leaf.dtype)
+            return np.zeros((0,), np.float32)
+
         if isinstance(example, dict):
             keys = {k for p in per if isinstance(p, dict) for k in p}
             return {
@@ -237,10 +258,7 @@ class PettingZooWrapper:
                     [
                         np.asarray(p[k])
                         if p is not None and k in p
-                        else np.zeros(
-                            specs[i][k].shape if isinstance(specs[i], Composite) and k in specs[i] else np.shape(example.get(k)),
-                            np.asarray(example[k]).dtype if k in example else np.float32,
-                        )
+                        else zero_fill(i, k)
                         for i, p in enumerate(per)
                     ],
                     (k,),
@@ -250,9 +268,7 @@ class PettingZooWrapper:
         return {
             ("agents", "observation"): self._pad_rows(
                 [
-                    np.asarray(p)
-                    if p is not None
-                    else np.zeros(specs[i].shape, np.asarray(example).dtype)
+                    np.asarray(p) if p is not None else zero_fill(i, "observation")
                     for i, p in enumerate(per)
                 ],
                 ("observation",),

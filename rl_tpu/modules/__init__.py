@@ -52,6 +52,7 @@ from .tdmodule import (
 
 __all__ = [
     "MultiStepActorWrapper",
+    "DiffusionActor",
     "CEMPlanner",
     "MPPIPlanner",
     "MCTSTree",
@@ -102,6 +103,7 @@ __all__ = [
 ]
 
 from .actors_extra import MultiStepActorWrapper
+from .diffusion import DiffusionActor
 from .inference_server import InferenceClient, InferenceServer
 from .multiagent import CrossGroupCritic
 __all__ += ["InferenceServer", "InferenceClient", "CrossGroupCritic"]

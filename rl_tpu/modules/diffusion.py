@@ -1,0 +1,141 @@
+"""Diffusion policy: DDPM actor (round-3 VERDICT missing #4).
+
+Redesign of the reference's diffusion actor (reference:
+torchrl/modules/tensordict_module/actors.py — ``_DDPMModule``:2705 with the
+fixed linear-beta scheduler / ``add_noise``:2745 forward process /
+``forward``:2774 reverse chain; ``DiffusionActor``:2827). The reference
+runs the reverse chain as a Python loop over ``num_steps``; here the whole
+chain is ONE ``lax.scan`` over the (static) schedule, so sampling an
+action is a single fused XLA program and the actor composes with the
+collector's rollout scan. Deterministic mode (no stochastic injection —
+the reference's ``InteractionType.DETERMINISTIC``) follows the framework's
+exploration-type context / ``key=None`` convention.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..data import ArrayDict
+from .networks import MLP
+
+__all__ = ["DiffusionActor"]
+
+
+class DiffusionActor:
+    """Score-based policy: denoise latent actions conditioned on obs.
+
+    Score network input is ``concat(noisy_action, observation, t)`` —
+    the reference's layout (actors.py:2803) — output is the predicted
+    noise. The policy contract matches other actors:
+    ``actor(params, td, key) -> td.set("action", ...)``.
+
+    Args:
+        action_dim: action dimensionality.
+        score_network: optional flax module ``(B, A+O+1) -> (B, A)``;
+            default = MLP(256, 256, silu) (reference default).
+        num_steps: DDPM steps (default 100).
+        beta_start / beta_end: linear beta schedule endpoints.
+    """
+
+    in_keys = ["observation"]
+    out_keys = ["action"]
+
+    def __init__(
+        self,
+        action_dim: int,
+        score_network: Any = None,
+        num_steps: int = 100,
+        beta_start: float = 1e-4,
+        beta_end: float = 0.02,
+        obs_key: str = "observation",
+    ):
+        self.action_dim = action_dim
+        self.num_steps = num_steps
+        self.obs_key = obs_key if isinstance(obs_key, tuple) else (obs_key,)
+        self.net = score_network or MLP(
+            out_features=action_dim, num_cells=(256, 256), activation="silu"
+        )
+        betas = np.linspace(beta_start, beta_end, num_steps, dtype=np.float32)
+        alphas = 1.0 - betas
+        self.betas = jnp.asarray(betas)
+        self.alphas = jnp.asarray(alphas)
+        self.alphas_cumprod = jnp.asarray(np.cumprod(alphas))
+
+    # -- params ---------------------------------------------------------------
+
+    def init(self, key: jax.Array, td: ArrayDict):
+        obs = td[self.obs_key]
+        x = jnp.zeros(obs.shape[:-1] + (self.action_dim + obs.shape[-1] + 1,))
+        return self.net.init(key, x)
+
+    # -- training-side hooks (consumed by DiffusionBCLoss) --------------------
+
+    def add_noise(
+        self, clean_action: jax.Array, t: jax.Array, key: jax.Array
+    ) -> tuple[jax.Array, jax.Array]:
+        """Forward process: ``x_t = sqrt(abar_t) a + sqrt(1-abar_t) eps``
+        (reference add_noise:2745). Returns ``(noisy_action, noise)``."""
+        abar = self.alphas_cumprod[t]
+        abar = abar.reshape(abar.shape + (1,) * (clean_action.ndim - abar.ndim))
+        noise = jax.random.normal(key, clean_action.shape, clean_action.dtype)
+        noisy = jnp.sqrt(abar) * clean_action + jnp.sqrt(1.0 - abar) * noise
+        return noisy, noise
+
+    def score(self, params, noisy_action, observation, t) -> jax.Array:
+        """Predicted noise for ``(x_t, obs, t)``; ``t`` scalar or [B]."""
+        t = jnp.asarray(t, jnp.float32)
+        t = jnp.broadcast_to(
+            t.reshape(t.shape + (1,) * (noisy_action.ndim - t.ndim)),
+            noisy_action.shape[:-1] + (1,),
+        )
+        return self.net.apply(
+            params, jnp.concatenate([noisy_action, observation, t], axis=-1)
+        )
+
+    # -- sampling (the policy path) -------------------------------------------
+
+    def sample(
+        self, params, observation: jax.Array, key: jax.Array | None
+    ) -> jax.Array:
+        """Full reverse chain as one ``lax.scan`` (reference forward:2774).
+
+        ``key=None`` (or the DETERMINISTIC exploration context) disables
+        the stochastic injection, yielding the mean trajectory.
+        """
+        from ..envs.utils import ExplorationType, exploration_type
+
+        deterministic = (
+            key is None or exploration_type() == ExplorationType.DETERMINISTIC
+        )
+        batch_shape = observation.shape[:-1]
+        if key is None:
+            key = jax.random.key(0)
+        k0, kchain = jax.random.split(key)
+        x0 = jax.random.normal(k0, batch_shape + (self.action_dim,))
+
+        def step(carry, t):
+            x, k = carry
+            k, kn = jax.random.split(k)
+            eps = self.score(params, x, observation, t)
+            beta_t = self.betas[t]
+            alpha_t = self.alphas[t]
+            abar_t = self.alphas_cumprod[t]
+            x = (x - beta_t / jnp.sqrt(1.0 - abar_t) * eps) / jnp.sqrt(alpha_t)
+            if not deterministic:
+                noise = jax.random.normal(kn, x.shape, x.dtype)
+                # no injection on the final (t == 0) step
+                x = x + jnp.where(t > 0, jnp.sqrt(beta_t), 0.0) * noise
+            return (x, k), None
+
+        (x, _), _ = jax.lax.scan(
+            step, (x0, kchain), jnp.arange(self.num_steps - 1, -1, -1)
+        )
+        return x
+
+    def __call__(self, params, td: ArrayDict, key: jax.Array | None = None):
+        return td.set("action", self.sample(params, td[self.obs_key], key))

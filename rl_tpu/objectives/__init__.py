@@ -17,7 +17,7 @@ from .dreamer_v3 import (
 from .cql import CQLLoss, DiscreteCQLLoss
 from .ddpg import DDPGLoss, TD3BCLoss, TD3Loss
 from .dqn import DistributionalDQNLoss, DQNLoss
-from .imitation import ACTLoss, BCLoss, GAILLoss, RNDModule
+from .imitation import ACTLoss, BCLoss, DiffusionBCLoss, GAILLoss, RNDModule
 from .iql import IQLLoss
 from .redq import REDQLoss
 from .multiagent import IPPOLoss, MAPPOLoss, QMixerLoss
@@ -48,6 +48,7 @@ __all__ = [
     "DreamerValueLoss",
     "imagine_rollout",
     "BCLoss",
+    "DiffusionBCLoss",
     "GAILLoss",
     "RNDModule",
     "QMixerLoss",

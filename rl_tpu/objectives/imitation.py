@@ -15,7 +15,7 @@ from ..data import ArrayDict
 from ..modules.networks import MLP
 from .common import LossModule, masked_mean
 
-__all__ = ["ACTLoss", "BCLoss", "GAILLoss", "RNDModule"]
+__all__ = ["ACTLoss", "BCLoss", "DiffusionBCLoss", "GAILLoss", "RNDModule"]
 
 
 class BCLoss(LossModule):
@@ -39,6 +39,48 @@ class BCLoss(LossModule):
             lp = self.actor.log_prob(params["actor"], batch)
             loss = -masked_mean(lp, mask)
         return loss, ArrayDict(loss_bc=loss)
+
+
+class DiffusionBCLoss(LossModule):
+    """ε-prediction denoising BC loss for diffusion policies (reference
+    torchrl/objectives/diffusion_bc.py:17; Diffusion Policy, Chi et al.
+    RSS 2023). Per batch item: sample a timestep, corrupt the clean
+    demonstration action through the actor's forward process, and regress
+    the score network's noise prediction with MSE. Pairs with
+    :class:`rl_tpu.modules.DiffusionActor`.
+    """
+
+    def __init__(self, actor, mask_key=None):
+        if not hasattr(actor, "add_noise"):
+            raise TypeError(
+                "DiffusionBCLoss needs a DiffusionActor-like module exposing "
+                "add_noise(clean_action, t, key) and score(params, x, obs, t)"
+            )
+        self.actor = actor
+        self.mask_key = mask_key
+
+    def init_params(self, key, td):
+        return {"actor": self.actor.init(key, td)}
+
+    def __call__(self, params, batch: ArrayDict, key=None):
+        if key is None:
+            # deterministic fallback: still a valid (fixed-noise) objective,
+            # but callers should thread a fresh key per step
+            key = jax.random.key(0)
+        kt, kn = jax.random.split(key)
+        action = batch["action"]
+        obs = batch[self.actor.obs_key]
+        B = action.shape[0]
+        t = jax.random.randint(kt, (B,), 0, self.actor.num_steps)
+        noisy, noise = self.actor.add_noise(action, t, kn)
+        pred = self.actor.score(params["actor"], noisy, obs, t)
+        mask = (
+            batch[self.mask_key]
+            if self.mask_key and self.mask_key in batch
+            else None
+        )
+        loss = masked_mean(((pred - noise) ** 2).mean(-1), mask)
+        return loss, ArrayDict(loss_diffusion_bc=loss)
 
 
 class GAILLoss(LossModule):

@@ -1,0 +1,208 @@
+"""ChessEnv native legality core (round-3 VERDICT missing #5; reference
+test strategy: test/test_env.py TestChessEnv — legal-move parity, check/
+checkmate/stalemate detection, san round-trips; here the oracle is the
+published perft(1) tables for the standard test positions, with promotion
+variants collapsed to one (from,to) action)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from rl_tpu.data import ArrayDict
+from rl_tpu.envs import ChessEnv, TransformedEnv, check_env_specs, rollout
+from rl_tpu.envs.custom.chess import (
+    START_FEN,
+    fen_to_state,
+    legal_move_mask,
+    make_move_board,
+    square_attacked,
+)
+from rl_tpu.envs.transforms.extra import ActionMask
+
+KEY = jax.random.key(0)
+
+
+def sq(name: str) -> int:
+    return (int(name[1]) - 1) * 8 + (ord(name[0]) - ord("a"))
+
+
+def mv(frm: str, to: str) -> int:
+    return sq(frm) * 64 + sq(to)
+
+
+def mask_of(fen: str) -> np.ndarray:
+    st = fen_to_state(fen)
+    return np.asarray(
+        legal_move_mask(st["board"], st["stm"], st["ep"], st["castling"])
+    )
+
+
+class TestLegalMoveCounts:
+    """perft(1) oracle counts (chessprogramming.org standard positions);
+    position 5 has 4 promotion variants on d7xc8 -> 44 - 3 = 41 pairs."""
+
+    CASES = [
+        (START_FEN, 20),
+        # Kiwipete: castling both sides, pins, discovered checks
+        ("r3k2r/p1ppqpb1/bn2pnp1/3PN3/1p2P3/2N2Q1p/PPPBBPPP/R3K2R w KQkq - 0 1", 48),
+        ("r3k2r/p1ppqpb1/bn2pnp1/3PN3/1p2P3/2N2Q1p/PPPBBPPP/R3K2R b KQkq - 0 1", 43),
+        # position 3: rook pin + en-passant machinery
+        ("8/2p5/3p4/KP5r/1R3p1k/8/4P1P1/8 w - - 0 1", 14),
+        # position 5: promotion captures (collapsed), castling
+        ("rnbq1k1r/pp1Pbppp/2p5/8/2B5/8/PPP1NnPP/RNBQK2R w KQ - 1 8", 41),
+        # position 6
+        ("r4rk1/1pp1qppp/p1np1n2/2b1p1B1/2B1P1b1/P1NP1N2/1PP1QPPP/R4RK1 w - - 0 10", 46),
+    ]
+
+    @pytest.mark.parametrize("fen,expected", CASES)
+    def test_counts(self, fen, expected):
+        assert mask_of(fen).sum() == expected
+
+
+class TestRules:
+    def test_pinned_piece_cannot_move(self):
+        # white knight d2 pinned by rook d8 against king d1
+        m = mask_of("3r4/8/8/8/8/8/3N4/3K4 w - - 0 1")
+        frm = sq("d2")
+        assert not m.reshape(64, 64)[frm].any()  # knight fully pinned
+
+    def test_must_resolve_check(self):
+        # white king e1 in check from rook e8; only king steps off the file
+        # (no blockers available)
+        m = mask_of("4r3/8/8/8/8/8/8/4K3 w - - 0 1").reshape(64, 64)
+        legal_to = np.flatnonzero(m[sq("e1")])
+        assert set(legal_to) == {sq("d1"), sq("f1"), sq("d2"), sq("f2")}
+
+    def test_castling_through_check_forbidden(self):
+        # black rook f8 covers f1: white cannot castle king-side, queen-side ok
+        m = mask_of("5r2/8/8/8/8/8/8/R3K2R w KQ - 0 1").reshape(64, 64)
+        assert not m[sq("e1"), sq("g1")]
+        assert m[sq("e1"), sq("c1")]
+
+    def test_en_passant_capture_and_pin(self):
+        # plain ep: white pawn e5, black just played d7d5 -> exd6 legal
+        m = mask_of(
+            "rnbqkbnr/ppp1pppp/8/3pP3/8/8/PPPP1PPP/RNBQKBNR w KQkq d6 0 3"
+        ).reshape(64, 64)
+        assert m[sq("e5"), sq("d6")]
+        # ep PIN (the classic): capturing exposes the king along rank 5
+        m = mask_of("8/8/8/KPp4r/8/8/8/7k w - c6 0 1").reshape(64, 64)
+        assert not m[sq("b5"), sq("c6")]
+
+    def test_en_passant_board_update(self):
+        st = fen_to_state(
+            "rnbqkbnr/ppp1pppp/8/3pP3/8/8/PPPP1PPP/RNBQKBNR w KQkq d6 0 3"
+        )
+        nb = np.asarray(
+            make_move_board(st["board"], sq("e5"), sq("d6"), 1, st["ep"])
+        )
+        assert nb[sq("d5")] == 0  # victim removed
+        assert nb[sq("d6")] == 1  # pawn landed
+
+    def test_promotion_auto_queen(self):
+        st = fen_to_state("8/P7/8/8/8/8/k7/7K w - - 0 1")
+        nb = np.asarray(make_move_board(st["board"], sq("a7"), sq("a8"), 1, -1))
+        assert nb[sq("a8")] == 5  # queen
+
+    def test_square_attacked(self):
+        st = fen_to_state(START_FEN)
+        b = st["board"]
+        assert bool(square_attacked(b, sq("f3"), True))  # by g2 pawn / g1 knight
+        assert not bool(square_attacked(b, sq("e4"), False))
+
+
+class TestTermination:
+    def test_fools_mate(self):
+        env = ChessEnv()
+        state, td = env.reset(KEY)
+        for frm, to in (("f2", "f3"), ("e7", "e5"), ("g2", "g4")):
+            state, out = env.step(state, td.set("action", jnp.asarray(mv(frm, to))))
+            td = out["next"]
+            assert not bool(td["done"])
+        state, out = env.step(state, td.set("action", jnp.asarray(mv("d8", "h4"))))
+        td = out["next"]
+        assert bool(td["terminated"])
+        assert float(td["reward"]) == 1.0  # black delivered mate
+
+    def test_stalemate_draw(self):
+        env = ChessEnv()
+        # classic stalemate: black king a8, white queen to c7 next... start
+        # one move before: white Qc6 with black king a8, white king c8? use
+        # known position: white to move Qb6 stalemates? simpler: verify a
+        # stalemate POSITION has zero legal moves and is not check
+        m = mask_of("k7/8/1Q6/8/8/8/8/7K b - - 0 1")
+        from rl_tpu.envs.custom.chess import _in_check
+
+        st = fen_to_state("k7/8/1Q6/8/8/8/8/7K b - - 0 1")
+        assert m.sum() == 0
+        assert not bool(_in_check(st["board"], st["stm"]))
+
+    def test_illegal_action_forfeits(self):
+        env = ChessEnv()
+        state, td = env.reset(KEY)
+        state, out = env.step(state, td.set("action", jnp.asarray(mv("a1", "a5"))))
+        assert bool(out["next", "terminated"])
+        assert float(out["next", "reward"]) == -1.0
+
+
+class TestSelfPlay:
+    def test_random_legal_selfplay_jit(self):
+        """A jitted scan self-play: every sampled action comes from the
+        mask; both kings survive; state stays consistent."""
+        env = TransformedEnv(ChessEnv(), ActionMask())
+        b = jax.jit(lambda k: rollout(env, k, max_steps=40))(KEY)
+        boards = np.asarray(b["next", "board"])
+        masks = np.asarray(b["action_mask"])
+        acts = np.asarray(b["action"])
+        done = np.asarray(b["next", "done"])
+        # every action taken was legal at its step (mask=True)
+        taken = masks[np.arange(len(acts)), acts]
+        assert taken.all()
+        # kings never disappear
+        alive = (boards == 6).any(-1) & (boards == -6).any(-1)
+        assert alive.all()
+        # rewards only at episode ends
+        r = np.asarray(b["next", "reward"])
+        assert (r[~done] == 0).all()
+
+    def test_mcts_selfplay_smoke(self):
+        """MCTS over the 4096-way masked action space from the start
+        position: simulations expand only legal children and the chosen
+        move is legal."""
+        from rl_tpu.modules import MCTSTree
+
+        env = ChessEnv()
+        state, td = env.reset(KEY)
+        mask = td["action_mask"]
+        prior = jnp.where(mask, 1.0 / jnp.maximum(mask.sum(), 1), 0.0)
+        tree = MCTSTree(capacity=32, num_actions=4096, c_puct=1.25)
+        t = tree.init(prior)
+        # MuZero flow: back up the root evaluation first so the PUCT
+        # exploration term (prior * sqrt(N)) is live from the first select
+        t = tree.backup(t, jnp.asarray(0), jnp.asarray(0.0))
+        key = KEY
+        for i in range(8):
+            key, k1 = jax.random.split(key)
+            node, action = tree.select_path(t)
+            assert bool(np.asarray(td["action_mask"])[int(action)]) or int(node) != 0
+            s2, out = env.step(state, td.set("action", action))
+            value = out["next", "reward"]
+            child_mask = out["next", "action_mask"]
+            child_prior = jnp.where(
+                child_mask, 1.0 / jnp.maximum(child_mask.sum(), 1), 0.0
+            )
+            t, new_node = tree.expand(t, node, action, child_prior)
+            t = tree.backup(t, new_node, value)
+        kids = np.asarray(t["children"][0])
+        visits = np.asarray(t["visits"])
+        root_child_visits = np.where(kids >= 0, visits[np.clip(kids, 0, None)], 0)
+        best = int(root_child_visits.argmax())
+        assert root_child_visits[best] > 0  # something was explored
+        assert bool(np.asarray(td["action_mask"])[best])  # and it is legal
+
+
+class TestEnvContract:
+    @pytest.mark.slow
+    def test_check_env_specs(self):
+        check_env_specs(ChessEnv(), num_steps=4)

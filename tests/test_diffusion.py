@@ -1,0 +1,157 @@
+"""Diffusion policy + diffusion-BC (round-3 VERDICT missing #4; reference
+test strategy: test_actors.py DiffusionActor shape/determinism tests +
+test_cost.py diffusion_bc convergence)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from rl_tpu.data import ArrayDict
+from rl_tpu.modules import MLP, DiffusionActor
+from rl_tpu.objectives import BCLoss, DiffusionBCLoss
+
+KEY = jax.random.key(0)
+
+
+def _bimodal_batch(key, B=256, obs_dim=3):
+    """Expert data with TWO action modes per obs: a = +g(obs) or -g(obs).
+    A unimodal (MSE) BC policy regresses to the useless mean (~0); a
+    diffusion policy can represent both modes."""
+    k1, k2 = jax.random.split(key)
+    obs = jax.random.normal(k1, (B, obs_dim))
+    target = jnp.tanh(obs[:, :2])  # the mode magnitude, |target| ~ O(1)
+    sign = jnp.where(jax.random.bernoulli(k2, 0.5, (B, 1)), 1.0, -1.0)
+    return ArrayDict(observation=obs, action=sign * target)
+
+
+class TestDDPMScheduler:
+    def test_add_noise_statistics(self):
+        actor = DiffusionActor(action_dim=2, num_steps=50)
+        a = jnp.zeros((4096, 2))
+        # zero actions at the last timestep: x_t ~ N(0, 1 - abar_T)
+        t = jnp.full((4096,), 49)
+        noisy, noise = actor.add_noise(a, t, KEY)
+        expect_std = float(jnp.sqrt(1.0 - actor.alphas_cumprod[49]))
+        assert abs(float(noisy.std()) - expect_std) < 0.05
+        # at t=0 the action is barely corrupted
+        noisy0, _ = actor.add_noise(jnp.ones((4096, 2)), jnp.zeros((4096,), int), KEY)
+        assert abs(float(noisy0.mean()) - 1.0) < 0.02
+
+    def test_noise_consistency(self):
+        # the returned noise is exactly the injected one (epsilon target)
+        actor = DiffusionActor(action_dim=2, num_steps=10)
+        a = jax.random.normal(KEY, (8, 2))
+        t = jnp.full((8,), 5)
+        noisy, noise = actor.add_noise(a, t, jax.random.key(7))
+        abar = actor.alphas_cumprod[5]
+        np.testing.assert_allclose(
+            np.asarray(noisy),
+            np.sqrt(abar) * np.asarray(a) + np.sqrt(1 - abar) * np.asarray(noise),
+            rtol=1e-5,
+        )
+
+
+class TestDiffusionActor:
+    def test_sample_shape_and_jit(self):
+        actor = DiffusionActor(action_dim=2, num_steps=10)
+        td = ArrayDict(observation=jnp.zeros((4, 3)))
+        params = actor.init(KEY, td)
+        out = jax.jit(actor)(params, td, jax.random.key(1))
+        assert out["action"].shape == (4, 2)
+
+    def test_deterministic_mode(self):
+        actor = DiffusionActor(action_dim=2, num_steps=10)
+        td = ArrayDict(observation=jnp.ones((4, 3)))
+        params = actor.init(KEY, td)
+        # key=None => deterministic reverse chain, but the x0 draw is
+        # fixed-seed: two calls agree exactly
+        a1 = actor(params, td, None)["action"]
+        a2 = actor(params, td, None)["action"]
+        np.testing.assert_allclose(np.asarray(a1), np.asarray(a2))
+
+    def test_exploration_context(self):
+        from rl_tpu.envs import set_exploration_type, ExplorationType
+
+        actor = DiffusionActor(action_dim=2, num_steps=10)
+        td = ArrayDict(observation=jnp.ones((4, 3)))
+        params = actor.init(KEY, td)
+        with set_exploration_type(ExplorationType.DETERMINISTIC):
+            a1 = actor(params, td, jax.random.key(1))["action"]
+            a2 = actor(params, td, jax.random.key(2))["action"]
+        # same x0 seed path differs, but no stochastic injection: the
+        # chains may still differ through x0 — so just check finiteness
+        assert np.isfinite(np.asarray(a1)).all()
+        assert np.isfinite(np.asarray(a2)).all()
+
+
+class TestDiffusionBC:
+    def _train(self, loss, params, data, steps, lr=1e-3):
+        opt = optax.adam(lr)
+        opt_state = opt.init(params)
+
+        @jax.jit
+        def step(params, opt_state, key):
+            (v, m), g = jax.value_and_grad(
+                lambda p: loss(p, data, key), has_aux=True
+            )(params)
+            upd, opt_state = opt.update(g, opt_state)
+            return optax.apply_updates(params, upd), opt_state, v
+
+        vals = []
+        for i in range(steps):
+            params, opt_state, v = step(params, opt_state, jax.random.fold_in(KEY, i))
+            vals.append(float(v))
+        return params, vals
+
+    def test_loss_decreases(self):
+        actor = DiffusionActor(action_dim=2, num_steps=20,
+                               score_network=MLP(out_features=2, num_cells=(64, 64), activation="silu"))
+        data = _bimodal_batch(KEY)
+        loss = DiffusionBCLoss(actor)
+        params = loss.init_params(KEY, data)
+        _, vals = self._train(loss, params, data, 150)
+        assert np.mean(vals[-10:]) < np.mean(vals[:10]) * 0.7, (vals[0], vals[-1])
+
+    @pytest.mark.slow
+    def test_beats_unimodal_bc_on_bimodal_expert(self):
+        """The VERDICT acceptance test: diffusion imitation beats BC on a
+        task BC cannot represent (two expert modes). Metric: distance of
+        the generated action to the NEAREST expert mode."""
+        data = _bimodal_batch(KEY, B=512)
+        obs = data["observation"]
+        modes = jnp.tanh(obs[:, :2])  # +-modes
+
+        diff_actor = DiffusionActor(
+            action_dim=2, num_steps=30,
+            score_network=MLP(out_features=2, num_cells=(128, 128), activation="silu"),
+        )
+        dloss = DiffusionBCLoss(diff_actor)
+        dparams = dloss.init_params(KEY, data)
+        dparams, _ = self._train(dloss, dparams, data, 800, lr=2e-3)
+
+        class DetActor:
+            net = MLP(out_features=2, num_cells=(128, 128), activation="silu")
+
+            def init(self, key, td):
+                return self.net.init(key, td["observation"])
+
+            def __call__(self, params, td, key=None):
+                return td.set("action", self.net.apply(params, td["observation"]))
+
+        bc = BCLoss(DetActor(), loss_function="mse")
+        bparams = bc.init_params(KEY, data)
+        bparams, _ = self._train(bc, bparams, data, 800, lr=2e-3)
+
+        def nearest_mode_err(actions):
+            d1 = jnp.linalg.norm(actions - modes, axis=-1)
+            d2 = jnp.linalg.norm(actions + modes, axis=-1)
+            return float(jnp.minimum(d1, d2).mean())
+
+        da = diff_actor(dparams["actor"], data, jax.random.key(5))["action"]
+        ba = bc.actor(bparams["actor"], data)["action"]
+        derr, berr = nearest_mode_err(da), nearest_mode_err(ba)
+        # BC collapses to the mean (error ~ |mode|); diffusion commits to
+        # a mode per sample
+        assert derr < berr * 0.6, (derr, berr)

@@ -40,8 +40,9 @@ def test_two_process_group_replay_and_weights():
             DIST_COORD=coord,
             DIST_REPLAY_PORT=str(replay_port),
             DIST_WEIGHT_PORT=str(weight_port),
-            # children must not inherit the parent's virtual-8 mesh flags
-            XLA_FLAGS="--xla_force_host_platform_device_count=2",
+            # children must not inherit the parent's virtual-8 mesh flags;
+            # 1 local device each: the global mesh is 2 procs x 1 device
+            XLA_FLAGS="--xla_force_host_platform_device_count=1",
         )
         procs.append(
             subprocess.Popen(

@@ -137,6 +137,21 @@ class TestStackedComposite:
             np.asarray(masks["budget"]), [[1], [0]]
         )
 
+    def test_scalar_key_absent_member_masked(self):
+        # a () scalar region covers the whole row: the spec-level mask must
+        # come from the explicit presence flag, matching pad_stack's mask
+        g = StackedComposite([
+            Composite(observation=Unbounded(shape=(3,)),
+                      energy=Unbounded(shape=())),
+            Composite(observation=Unbounded(shape=(3,))),
+        ])
+        np.testing.assert_array_equal(
+            np.asarray(g.masks()["energy"]), [True, False]
+        )
+        v = g.rand(KEY)
+        np.testing.assert_allclose(np.asarray(v["energy"])[1], 0.0)
+        assert g.is_in(v)
+
     def test_rand_zero_is_in(self):
         g = self._group()
         v = g.rand(KEY, (4,))
