@@ -31,6 +31,8 @@ from .arraydict import ArrayDict
 
 __all__ = [
     "VLA_KEYS",
+    "UniformActionTokenizer",
+    "VocabTailActionTokenizer",
     "validate_vla_arraydict",
     "build_action_chunks",
     "AddActionChunks",
@@ -122,3 +124,150 @@ class AddActionChunks:
         ep_len = td[self.episode_len_key] if self.episode_len_key else None
         chunks, pad = build_action_chunks(td["action"], self.chunk, ep_len)
         return td.set(("vla_action", "chunk"), chunks).set("action_is_pad", pad)
+
+
+# ---------------------------------------------------------------------------
+# action tokenizers (reference torchrl/data/vla/tokenizers.py)
+# ---------------------------------------------------------------------------
+
+
+class UniformActionTokenizer:
+    """Per-dimension uniform-bin codec (RT-2 / OpenVLA style; reference
+    tokenizers.py ``UniformActionTokenizer``:54): each action dim is
+    discretized into ``num_bins`` equal-width bins over ``[low, high]``;
+    decode returns bin centers (round-trip error <= half a bin width).
+    Element-wise over the trailing dim, so per-step actions
+    ``[*B, action_dim]`` and chunks ``[*B, T, chunk, action_dim]`` both
+    work; encode/decode are pure jnp (jit/vmap-safe).
+    """
+
+    def __init__(self, num_bins: int, *, low, high, action_dim: int | None = None):
+        if num_bins < 1:
+            raise ValueError(f"num_bins must be >= 1, got {num_bins}")
+        low = jnp.asarray(low, jnp.float32)
+        high = jnp.asarray(high, jnp.float32)
+        if action_dim is not None:
+            if low.ndim == 0:
+                low = jnp.full((action_dim,), low)
+            if high.ndim == 0:
+                high = jnp.full((action_dim,), high)
+        if low.shape != high.shape:
+            raise ValueError(f"low/high shape mismatch: {low.shape} vs {high.shape}")
+        if not bool(jnp.all(high > low)):
+            raise ValueError("high must be strictly greater than low everywhere")
+        self.num_bins = int(num_bins)
+        self.low, self.high = low, high
+
+    @property
+    def vocab_size(self) -> int:
+        return self.num_bins
+
+    @property
+    def action_dim(self) -> int | None:
+        return self.low.shape[-1] if self.low.ndim else None
+
+    def encode(self, actions) -> jnp.ndarray:
+        scaled = (jnp.asarray(actions) - self.low) / (self.high - self.low)
+        tokens = jnp.floor(scaled * self.num_bins).astype(jnp.int32)
+        return jnp.clip(tokens, 0, self.num_bins - 1)
+
+    def decode(self, tokens) -> jnp.ndarray:
+        centers = (jnp.asarray(tokens, jnp.float32) + 0.5) / self.num_bins
+        return self.low + centers * (self.high - self.low)
+
+
+class VocabTailActionTokenizer:
+    """OpenVLA-style vocab-tail codec (reference tokenizers.py
+    ``VocabTailActionTokenizer``:154; arXiv:2406.09246): actions in
+    ``[-1, 1]`` are digitized over the EDGES of ``num_bins`` uniform bins
+    and written into the tail of the language-model vocabulary:
+    ``token = vocab_size - digitize(a)``. Decode maps back to the bin
+    center (``num_bins - 1`` centers). Window ids (default) live in
+    ``[0, num_bins)``; pass ``full_vocab_size`` (e.g. 32000 for LLaMA-2)
+    for raw LM ids.
+
+    Optional OpenVLA ``norm_stats``: the affine q01/q99 map normalizes
+    before encoding and un-normalizes after decoding on the dims selected
+    by ``norm_mask``; unmasked (gripper) dims can be binarized to ±1
+    and/or sign-flipped. The stats are kept in float64 numpy (checkpoint
+    JSON precision); jnp decode computes in float32.
+    """
+
+    def __init__(
+        self,
+        num_bins: int = 256,
+        *,
+        full_vocab_size: int | None = None,
+        norm_low=None,
+        norm_high=None,
+        norm_mask=None,
+        gripper_binarize: bool = False,
+        gripper_binarize_threshold: float = 0.0,
+        gripper_invert: bool = False,
+    ):
+        if num_bins < 2:
+            raise ValueError(f"num_bins must be >= 2, got {num_bins}")
+        if full_vocab_size is not None and full_vocab_size < num_bins:
+            raise ValueError(
+                f"full_vocab_size ({full_vocab_size}) must be >= num_bins"
+            )
+        if (norm_low is None) != (norm_high is None):
+            raise ValueError("norm_low and norm_high go together")
+        self.num_bins = int(num_bins)
+        self.full_vocab_size = None if full_vocab_size is None else int(full_vocab_size)
+        self.bins = jnp.linspace(-1.0, 1.0, num_bins)
+        self.bin_centers = (self.bins[:-1] + self.bins[1:]) / 2.0
+        self.gripper_binarize = bool(gripper_binarize)
+        self.gripper_binarize_threshold = float(gripper_binarize_threshold)
+        self.gripper_invert = bool(gripper_invert)
+        if norm_low is not None:
+            self.norm_low = np.asarray(norm_low, np.float64)
+            self.norm_high = np.asarray(norm_high, np.float64)
+            self.norm_mask = (
+                np.ones_like(self.norm_low, bool)
+                if norm_mask is None
+                else np.asarray(norm_mask, bool)
+            )
+        else:
+            self.norm_low = self.norm_high = self.norm_mask = None
+
+    @property
+    def vocab_size(self) -> int:
+        return self.full_vocab_size or self.num_bins
+
+    def encode(self, actions) -> jnp.ndarray:
+        a = jnp.asarray(actions, jnp.float32)
+        if self.norm_low is not None:
+            span = jnp.asarray(
+                self.norm_high - self.norm_low + 1e-8, jnp.float32
+            )
+            lo = jnp.asarray(self.norm_low, jnp.float32)
+            normed = 2.0 * (a - lo) / span - 1.0
+            a = jnp.where(jnp.asarray(self.norm_mask), normed, a)
+        # digitize: index of the first bin edge strictly greater, in
+        # [1, num_bins] (np.digitize convention the reference ports)
+        d = jnp.clip(
+            jnp.digitize(jnp.clip(a, -1.0, 1.0), self.bins), 1, self.num_bins
+        )
+        return (self.vocab_size - d).astype(jnp.int32)
+
+    def decode(self, tokens) -> jnp.ndarray:
+        d = self.vocab_size - jnp.asarray(tokens, jnp.int32)
+        idx = jnp.clip(d - 1, 0, self.num_bins - 2)
+        a = self.bin_centers[idx]
+        if self.norm_low is not None:
+            span = jnp.asarray(
+                self.norm_high - self.norm_low + 1e-8, jnp.float32
+            )
+            lo = jnp.asarray(self.norm_low, jnp.float32)
+            unnormed = 0.5 * (a + 1.0) * span + lo
+            mask = jnp.asarray(self.norm_mask)
+            a = jnp.where(mask, unnormed, a)
+            if self.gripper_binarize:
+                binar = jnp.where(
+                    a > self.gripper_binarize_threshold, 1.0, -1.0
+                )
+                a = jnp.where(mask, a, binar)
+            if self.gripper_invert:
+                a = jnp.where(mask, a, -a)
+        return a

@@ -87,19 +87,27 @@ def _build_tables():
     return knight, king, ray, pawn_capt
 
 
+# numpy at module level — import must NOT touch the JAX backend (the
+# driver forces platforms after import; see tests/test_import_hygiene.py).
+# jnp consumes these as constants inside traced functions.
 _KNIGHT_NP, _KING_NP, _RAY_NP, _PAWN_CAPT_NP = _build_tables()
-KNIGHT = jnp.asarray(_KNIGHT_NP)
-KING = jnp.asarray(_KING_NP)
-RAY = jnp.asarray(_RAY_NP)  # [64, 8, 7] target squares, -1 padded
-PAWN_CAPT = jnp.asarray(_PAWN_CAPT_NP)
+_RANK_NP = np.arange(64) // 8
 
-_RANK = jnp.arange(64) // 8
-_FILE = jnp.arange(64) % 8
+
+def _tables():
+    """Device-resident copies, materialized on first traced use."""
+    return (
+        jnp.asarray(_KNIGHT_NP),
+        jnp.asarray(_KING_NP),
+        jnp.asarray(_RAY_NP),  # [64, 8, 7] target squares, -1 padded
+        jnp.asarray(_PAWN_CAPT_NP),
+    )
 
 
 def _ray_reach(board64):
     """[64 src, 8 dir, 7 step] bool: step visible from src (scan stops at
     AND INCLUDES the first occupied square)."""
+    _, _, RAY, _ = _tables()
     padded = jnp.concatenate([board64, jnp.ones((1,), board64.dtype)])
     ray_occ = padded[RAY] != 0  # -1 index wraps to the sentinel (occupied)
     blocked_before = jnp.cumsum(ray_occ, axis=-1) - ray_occ.astype(jnp.int32)
@@ -109,6 +117,7 @@ def _ray_reach(board64):
 def square_attacked(board64, sq, by_white):
     """Is ``sq`` attacked by the given color? Inverse-probe form (rays cast
     FROM the square; O(8x7), cheap enough to vmap 4096x for legality)."""
+    KNIGHT, KING, RAY, PAWN_CAPT = _tables()
     sgn = jnp.where(by_white, 1, -1)
     enemy = board64 * sgn  # attacker pieces positive
     if_knight = jnp.any(KNIGHT[sq] & (enemy == 2))
@@ -169,6 +178,7 @@ def _pseudo_moves(board64, stm, ep_sq, castling):
     include the not-in-check / not-through-check conditions (the final
     king-safety vmap re-checks only the landing square).
     """
+    KNIGHT, KING, RAY, PAWN_CAPT = _tables()
     own = board64 * stm  # own pieces positive
     own_occ = own > 0
     empty = board64 == 0
@@ -179,7 +189,7 @@ def _pseudo_moves(board64, stm, ep_sq, castling):
 
     reach = _ray_reach(board64)  # [64, 8, 7]
     # scatter ray visibility into a [64, 64] matrix per direction class
-    tgt = jnp.where(reach, RAY, 64)  # pad -> dummy 64
+    tgt = jnp.where(reach, _tables()[2], 64)  # pad -> dummy 64
 
     def vis_matrix(dirs):
         m = jnp.zeros((64, 65), bool)
@@ -200,7 +210,8 @@ def _pseudo_moves(board64, stm, ep_sq, castling):
     fwd_c = jnp.clip(fwd, 0, 63)
     push1 = pawns & fwd_ok & empty[fwd_c]
     pushes = jnp.zeros((64, 64), bool).at[jnp.arange(64), fwd_c].max(push1)
-    start_rank = jnp.where(stm > 0, _RANK == 1, _RANK == 6)
+    rank = jnp.asarray(_RANK_NP)
+    start_rank = jnp.where(stm > 0, rank == 1, rank == 6)
     fwd2 = jnp.arange(64) + 16 * stm
     fwd2_c = jnp.clip(fwd2, 0, 63)
     push2 = pawns & start_rank & empty[fwd_c] & empty[fwd2_c]
@@ -334,12 +345,21 @@ class ChessEnv(EnvBase):
             castling=Binary(shape=(4,)),
             ep=Unbounded(shape=(), dtype=jnp.int32),
             halfmove=Unbounded(shape=(), dtype=jnp.int32),
+            legal_mask=Binary(shape=(4096,)),
         )
 
     def _obs(self, st: ArrayDict, mask=None) -> ArrayDict:
+        # the legal mask of the side to move is CARRIED in the state: it
+        # was already computed as the previous step's opponent mask, and
+        # legal_move_mask (4096 vmapped make-move+king probes) dominates
+        # the per-step cost — never compute it twice
         if mask is None:
-            mask = legal_move_mask(
-                st["board"], st["stm"], st["ep"], st["castling"]
+            mask = (
+                st["legal_mask"]
+                if "legal_mask" in st
+                else legal_move_mask(
+                    st["board"], st["stm"], st["ep"], st["castling"]
+                )
             )
         return ArrayDict(
             board=st["board"],
@@ -352,11 +372,19 @@ class ChessEnv(EnvBase):
 
     def _reset(self, key):
         st = fen_to_state(START_FEN)
+        st = st.set(
+            "legal_mask",
+            legal_move_mask(st["board"], st["stm"], st["ep"], st["castling"]),
+        )
         return st, self._obs(st)
 
     def reset_from_fen(self, fen: str, key=None):
         """Start from an arbitrary position (host-side helper)."""
         st = fen_to_state(fen)
+        st = st.set(
+            "legal_mask",
+            legal_move_mask(st["board"], st["stm"], st["ep"], st["castling"]),
+        )
         state = st.set("rng", jax.random.key(0) if key is None else key)
         zero = jnp.zeros((), jnp.bool_)
         td = self._obs(st).update(
@@ -369,7 +397,7 @@ class ChessEnv(EnvBase):
         ep, castling = state["ep"], state["castling"]
         frm, to = action // 64, action % 64
 
-        mask = legal_move_mask(board, stm, ep, castling)
+        mask = state["legal_mask"]  # computed when this position was reached
         legal = mask[action]
 
         nb = make_move_board(board, frm, to, stm, ep)
@@ -406,12 +434,12 @@ class ChessEnv(EnvBase):
         ).astype(jnp.int32)
 
         nstm = -stm
+        opp_mask = legal_move_mask(board2, nstm, new_ep, new_castling)
         new_state = ArrayDict(
             board=board2, stm=nstm, castling=new_castling,
-            ep=new_ep, halfmove=new_half,
+            ep=new_ep, halfmove=new_half, legal_mask=opp_mask,
         )
 
-        opp_mask = legal_move_mask(board2, nstm, new_ep, new_castling)
         opp_has_move = jnp.any(opp_mask)
         opp_in_check = _in_check(board2, nstm)
         checkmate = legal & ~opp_has_move & opp_in_check

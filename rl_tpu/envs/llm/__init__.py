@@ -1,6 +1,8 @@
 from .chat import ChatEnv, DatasetChatEnv
-from .datasets import QADataset, arithmetic_dataset, copy_dataset
-from .reward import ExactMatchScorer, FormatScorer, SumScorer, combine_scorers
+from .datasets import (QADataset, arithmetic_dataset, copy_dataset,
+                       gsm8k_dataset, math_expression_dataset)
+from .reward import (ExactMatchScorer, FormatScorer, GSM8KScorer,
+                     SumScorer, combine_scorers, extract_gsm8k_answer)
 from .transforms import KLRewardTransform, PolicyVersion, PythonToolTransform
 
 __all__ = [
@@ -9,9 +11,13 @@ __all__ = [
     "QADataset",
     "arithmetic_dataset",
     "copy_dataset",
+    "gsm8k_dataset",
+    "math_expression_dataset",
     "ExactMatchScorer",
     "FormatScorer",
+    "GSM8KScorer",
     "SumScorer",
+    "extract_gsm8k_answer",
     "combine_scorers",
     "KLRewardTransform",
     "PolicyVersion",

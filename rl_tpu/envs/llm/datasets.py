@@ -14,7 +14,8 @@ import numpy as np
 
 from ...data.llm.history import History
 
-__all__ = ["arithmetic_dataset", "copy_dataset", "QADataset"]
+__all__ = ["arithmetic_dataset", "copy_dataset", "gsm8k_dataset",
+           "math_expression_dataset", "QADataset"]
 
 
 class QADataset:
@@ -64,3 +65,96 @@ def copy_dataset(n: int = 64, length: int = 3, seed: int = 0) -> QADataset:
         s = " ".join(letters[i] for i in rng.integers(0, len(letters), length))
         items.append((f"copy: {s} =", s))
     return QADataset(items)
+
+
+def gsm8k_dataset(n: int = 128, seed: int = 0) -> QADataset:
+    """GSM8K-FORMAT fixture dataset (reference envs/llm/datasets/gsm8k.py —
+    same on-disk answer conventions, locally generated): multi-step word
+    problems whose gold answers carry step-by-step reasoning with
+    ``<<a+b=c>>`` calculator annotations and the ``#### <number>`` final
+    marker. :class:`~rl_tpu.envs.llm.GSM8KScorer` parses exactly this
+    format, so the full tokenizer -> DatasetChatEnv -> GRPO recipe runs
+    against verifiable ground truth without hub egress.
+    """
+    rng = np.random.default_rng(seed)
+    names = ["Ava", "Ben", "Cleo", "Dan", "Eli", "Fay"]
+    items = ["apples", "books", "coins", "pens", "shells", "stamps"]
+    out = []
+    for _ in range(n):
+        name = names[rng.integers(0, len(names))]
+        item = items[rng.integers(0, len(items))]
+        kind = int(rng.integers(0, 3))
+        if kind == 0:  # a + b - c
+            a, b = int(rng.integers(2, 20)), int(rng.integers(2, 20))
+            c = int(rng.integers(1, a + b))
+            q = (
+                f"{name} has {a} {item}. {name} buys {b} more {item} and "
+                f"then gives away {c}. How many {item} does {name} have now?"
+            )
+            s1, s2 = a + b, a + b - c
+            ans = (
+                f"{name} starts with {a}+{b}=<<{a}+{b}={s1}>>{s1} {item}.\n"
+                f"After giving away, {s1}-{c}=<<{s1}-{c}={s2}>>{s2} {item}.\n"
+                f"#### {s2}"
+            )
+        elif kind == 1:  # a * b
+            a, b = int(rng.integers(2, 12)), int(rng.integers(2, 12))
+            q = (
+                f"Each box holds {a} {item}. {name} fills {b} boxes. "
+                f"How many {item} in total?"
+            )
+            s1 = a * b
+            ans = f"{name} packs {a}*{b}=<<{a}*{b}={s1}>>{s1} {item}.\n#### {s1}"
+        else:  # a * b + c
+            a, b = int(rng.integers(2, 10)), int(rng.integers(2, 10))
+            c = int(rng.integers(1, 15))
+            q = (
+                f"{name} earns {a} dollars a day for {b} days and finds "
+                f"{c} more dollars. How much money does {name} have?"
+            )
+            s1, s2 = a * b, a * b + c
+            ans = (
+                f"Earnings: {a}*{b}=<<{a}*{b}={s1}>>{s1} dollars.\n"
+                f"Total: {s1}+{c}=<<{s1}+{c}={s2}>>{s2} dollars.\n"
+                f"#### {s2}"
+            )
+        out.append((q, ans))
+    return QADataset(
+        out,
+        system=(
+            "Solve the math problem. Show your steps, then give the final "
+            "answer after '#### '."
+        ),
+    )
+
+
+def math_expression_dataset(
+    n: int = 128, depth: int = 2, max_operand: int = 9, seed: int = 0
+) -> QADataset:
+    """Nested arithmetic expressions with precedence/parentheses
+    (reference envs/llm/datasets/math.py task shape): "(3+5)*2-4=" -> the
+    evaluated integer. ``depth`` controls nesting."""
+    rng = np.random.default_rng(seed)
+
+    def expr(d):
+        """Returns (string, value, is_leaf); rendering parenthesizes so the
+        string's standard-precedence reading matches the tree's value."""
+        if d == 0:
+            v = int(rng.integers(0, max_operand + 1))
+            return str(v), v, True
+        op = "+-*"[rng.integers(0, 3)]
+        ls, lv, lleaf = expr(d - 1)
+        rs, rv, rleaf = expr(d - 1)
+        if op == "*":
+            ls = ls if lleaf else f"({ls})"
+            rs = rs if rleaf else f"({rs})"
+        elif op == "-" and not rleaf:
+            rs = f"({rs})"  # a-(b+c) must not read as a-b+c
+        s = f"{ls}{op}{rs}"
+        return s, {"+": lv + rv, "-": lv - rv, "*": lv * rv}[op], False
+
+    out = []
+    for _ in range(n):
+        s, v, _ = expr(depth)
+        out.append((f"{s}=", str(v)))
+    return QADataset(out)

@@ -12,7 +12,8 @@ from __future__ import annotations
 import re
 from typing import Any, Callable, Sequence
 
-__all__ = ["ExactMatchScorer", "FormatScorer", "SumScorer", "combine_scorers"]
+__all__ = ["ExactMatchScorer", "FormatScorer", "GSM8KScorer", "SumScorer",
+           "combine_scorers", "extract_gsm8k_answer"]
 
 
 def _last_user(history) -> str:
@@ -68,10 +69,13 @@ class SumScorer:
         gold = self.answers.get(_last_user(history))
         if gold is None:
             return 0.0
+        if "####" in gold:  # GSM8K-format gold: score its final number
+            gold = extract_gsm8k_answer(gold) or gold
+        gm = re.search(r"-?\d+", gold)
         m = re.search(r"-?\d+", _assistant_text(history))
-        if not m:
+        if not m or not gm:
             return 0.0
-        return 1.0 / (1.0 + abs(int(m.group()) - int(gold)))
+        return 1.0 / (1.0 + abs(int(m.group()) - int(gm.group())))
 
 
 def combine_scorers(*scorers: Callable, weights: Sequence[float] | None = None):
@@ -81,3 +85,72 @@ def combine_scorers(*scorers: Callable, weights: Sequence[float] | None = None):
         return float(sum(w * s(history, response_tokens) for w, s in zip(ws, scorers)))
 
     return scorer
+
+
+def extract_gsm8k_answer(text: str) -> str | None:
+    """Final-answer extraction with the reference's precedence
+    (reference envs/llm/reward/gsm8k.py): the ``<answer>...</answer>`` tag
+    first (GRPO response convention), else the LAST ``#### <number>``
+    marker (GSM8K gold convention). Numbers are normalized (commas/space
+    stripped)."""
+    m = re.findall(r"<answer>\s*(.*?)\s*</answer>", text, re.DOTALL)
+    if m:
+        num = re.search(r"-?[\d,\.]+", m[-1])
+        return num.group().replace(",", "").rstrip(".") if num else None
+    m = re.findall(r"####\s*(-?[\d,\.]+)", text)
+    if m:
+        return m[-1].replace(",", "").rstrip(".")
+    return None
+
+
+class GSM8KScorer:
+    """GSM8K reward parser (reference envs/llm/reward/gsm8k.py:18
+    ``GSM8KRewardParser``) with the standard GRPO reward levels:
+
+    - ``correct_reward`` (1.0) — extracted answer matches the gold final
+      number after normalization;
+    - ``format_reward`` (0.1) — a parseable answer is present but wrong;
+    - 0.0 — no parseable answer;
+    - plus ``think_bonus`` (reference ``reward_think``) when the response
+      carries a non-empty ``<think>...</think>`` block.
+    """
+
+    def __init__(
+        self,
+        answers: dict[str, str],
+        correct_reward: float = 1.0,
+        format_reward: float = 0.1,
+        think_bonus: float = 0.0,
+    ):
+        self.answers = answers
+        self.correct_reward = correct_reward
+        self.format_reward = format_reward
+        self.think_bonus = think_bonus
+
+    def __call__(self, history, response_tokens) -> float:
+        gold_text = self.answers.get(_last_user(history))
+        if gold_text is None:
+            return 0.0
+        gold = extract_gsm8k_answer(gold_text)
+        if gold is None:  # plain-number gold (arithmetic-style datasets)
+            m = re.search(r"-?\d+", gold_text)
+            gold = m.group() if m else gold_text.strip()
+        resp = _assistant_text(history)
+        pred = extract_gsm8k_answer(resp)
+        if pred is None:
+            # tolerate tag-free numeric answers at format level only; keep
+            # comma-grouped/decimal numbers whole and normalize like the
+            # extractor ('1,234' -> '1234', not ['1','234'])
+            nums = re.findall(r"-?\d[\d,\.]*", resp)
+            pred = (
+                nums[-1].replace(",", "").rstrip(".") if nums else None
+            )
+            base = 0.0 if pred is None else (
+                self.correct_reward if pred == gold else 0.0
+            )
+        else:
+            base = (
+                self.correct_reward if pred == gold else self.format_reward
+            )
+        think = re.search(r"<think>\s*\S.*?</think>", resp, re.DOTALL)
+        return float(base + (self.think_bonus if think else 0.0))

@@ -53,6 +53,7 @@ from .tdmodule import (
 __all__ = [
     "MultiStepActorWrapper",
     "DiffusionActor",
+    "GPWorldModel",
     "CEMPlanner",
     "MPPIPlanner",
     "MCTSTree",
@@ -104,6 +105,7 @@ __all__ = [
 
 from .actors_extra import MultiStepActorWrapper
 from .diffusion import DiffusionActor
+from .gp import GPWorldModel
 from .inference_server import InferenceClient, InferenceServer
 from .multiagent import CrossGroupCritic
 __all__ += ["InferenceServer", "InferenceClient", "CrossGroupCritic"]

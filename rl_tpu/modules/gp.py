@@ -1,0 +1,258 @@
+"""GP world model with PILCO moment matching (round-3 VERDICT missing #6).
+
+Redesign of the reference's GP layer (reference:
+torchrl/modules/models/gp.py — ``GPWorldModel``: one independent RBF-ARD GP
+per state dimension predicting the transition residual Δ = x_t − x_{t−1}
+from x̃ = [x, u]; deterministic posterior Eqs. 7-8 and analytic
+moment-matching propagation of a Gaussian belief Eqs. 10-23 of Deisenroth
+& Rasmussen (2011), "PILCO"). The reference fits hyperparameters with
+gpytorch/botorch; here the negative log marginal likelihood is minimized
+directly with optax/jax autodiff — no GP library needed — and every
+inference path (posterior, moment matching) is pure jnp, jit/vmap-safe,
+so the whole PILCO policy-evaluation rollout differentiates end-to-end.
+
+State is explicit (functional): :meth:`fit` returns a ``gp_state``
+ArrayDict carrying hyperparameters and cached solves; all prediction
+methods take it as the first argument.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ..data import ArrayDict
+
+__all__ = ["GPWorldModel"]
+
+
+def _rbf_gram(X1, X2, log_ls, log_sf):
+    """k(x, x') = σf² exp(−½ (x−x')ᵀ Λ⁻¹ (x−x')) with Λ = diag(ℓ²)."""
+    inv_ls = jnp.exp(-log_ls)  # 1/ℓ
+    d = (X1[:, None, :] - X2[None, :, :]) * inv_ls
+    return jnp.exp(2.0 * log_sf) * jnp.exp(-0.5 * jnp.sum(d * d, -1))
+
+
+def _noise_var(log_sf, log_sn):
+    """σn² with a floor of 1e-4·σf²: keeps cond(K) ~< 1e4, which float32
+    linear algebra handles; unconstrained ML happily drives σn → 0 on
+    near-deterministic data and the Gram inverse turns to garbage."""
+    return jnp.exp(2.0 * log_sn) + 1e-4 * jnp.exp(2.0 * log_sf) + 1e-8
+
+
+def _nlml(log_ls, log_sf, log_sn, X, y):
+    """Negative log marginal likelihood of one output GP (Eq. 6/7 model)."""
+    n = X.shape[0]
+    K = _rbf_gram(X, X, log_ls, log_sf) + _noise_var(log_sf, log_sn) * jnp.eye(n)
+    L = jnp.linalg.cholesky(K)
+    alpha = jax.scipy.linalg.cho_solve((L, True), y)
+    return (
+        0.5 * y @ alpha
+        + jnp.sum(jnp.log(jnp.diagonal(L)))
+        + 0.5 * n * jnp.log(2.0 * jnp.pi)
+    )
+
+
+class GPWorldModel:
+    """One RBF-ARD GP per state dim over x̃ = [x, u] (reference gp.py:31).
+
+    TensorDict contract (MeanActionSelector belief keys, reference
+    in_keys): ``__call__`` reads ``("observation","mean"/"var")`` and
+    ``("action","mean"/"var"/"cross_covariance")`` and writes
+    ``("next","observation","mean"/"var")`` via moment matching.
+    """
+
+    in_keys = [
+        ("action", "mean"), ("action", "var"), ("action", "cross_covariance"),
+        ("observation", "mean"), ("observation", "var"),
+    ]
+    out_keys = [("next", "observation", "mean"), ("next", "observation", "var")]
+
+    def __init__(self, obs_dim: int, action_dim: int, jitter: float = 1e-6):
+        self.obs_dim = obs_dim
+        self.action_dim = action_dim
+        self.jitter = jitter
+
+    # -- fitting ---------------------------------------------------------------
+
+    def fit(
+        self,
+        dataset: ArrayDict,
+        num_steps: int = 200,
+        learning_rate: float = 0.05,
+    ) -> ArrayDict:
+        """Type-II ML hyperparameters (ℓ_a, σf_a, σn_a per output dim, Eq. 6)
+        by NLML gradient descent, then cache (K+σ²I)⁻¹ and β (Eq. 7)."""
+        X = jnp.concatenate(
+            [dataset["observation"], dataset["action"]], axis=-1
+        )
+        Y = dataset["next", "observation"] - dataset["observation"]  # Δ
+        D, Din = self.obs_dim, X.shape[-1]
+        # init: unit length-scales on standardized inputs, σn = 0.1 σf
+        params0 = {
+            "log_ls": jnp.log(jnp.std(X, 0) + 1e-3)[None, :].repeat(D, 0),
+            "log_sf": jnp.log(jnp.std(Y, 0) + 1e-3),
+            "log_sn": jnp.log(0.1 * jnp.std(Y, 0) + 1e-3),
+        }
+
+        def loss(p):
+            per = jax.vmap(
+                lambda ls, sf, sn, y: _nlml(ls, sf, sn, X, y)
+            )(p["log_ls"], p["log_sf"], p["log_sn"], Y.T)
+            return per.sum()
+
+        opt = optax.adam(learning_rate)
+        ostate = opt.init(params0)
+
+        @jax.jit
+        def step(p, o):
+            v, g = jax.value_and_grad(loss)(p)
+            upd, o = opt.update(g, o)
+            return optax.apply_updates(p, upd), o, v
+
+        p = params0
+        for _ in range(num_steps):
+            p, ostate, v = step(p, ostate)
+
+        n = X.shape[0]
+
+        def cache(ls, sf, sn, y):
+            K = _rbf_gram(X, X, ls, sf) + (
+                _noise_var(sf, sn) + self.jitter
+            ) * jnp.eye(n)
+            L = jnp.linalg.cholesky(K)
+            K_inv = jax.scipy.linalg.cho_solve((L, True), jnp.eye(n))
+            return K_inv, K_inv @ y
+
+        K_inv, beta = jax.vmap(cache)(
+            p["log_ls"], p["log_sf"], p["log_sn"], Y.T
+        )
+        return ArrayDict(
+            X=X, Y=Y, K_inv=K_inv, beta=beta,
+            log_ls=p["log_ls"], log_sf=p["log_sf"], log_sn=p["log_sn"],
+            nlml=v,
+        )
+
+    # -- deterministic posterior (Eqs. 7-8) ------------------------------------
+
+    def predict(self, gp: ArrayDict, obs, action):
+        """Posterior mean/var of the NEXT STATE at point inputs."""
+        x = jnp.concatenate([obs, action], axis=-1)
+        squeeze = x.ndim == 1
+        xb = jnp.atleast_2d(x)
+
+        def per_dim(ls, sf, sn, K_inv, beta):
+            k = _rbf_gram(xb, gp["X"], ls, sf)  # [B, n]
+            mean = k @ beta
+            var = (
+                jnp.exp(2.0 * sf)
+                - jnp.sum((k @ K_inv) * k, -1)
+                + _noise_var(sf, sn)
+            )
+            return mean, jnp.maximum(var, 1e-12)
+
+        mean, var = jax.vmap(per_dim)(
+            gp["log_ls"], gp["log_sf"], gp["log_sn"], gp["K_inv"], gp["beta"]
+        )  # [D, B]
+        mu = obs + (mean.T[0] if squeeze else mean.T)
+        return mu, (var.T[0] if squeeze else var.T)
+
+    # -- moment matching (Eqs. 10-23) ------------------------------------------
+
+    def propagate(self, gp: ArrayDict, mu, Sigma):
+        """Propagate the joint state-action belief N(μ̃, Σ̃) through the GP.
+
+        ``mu`` [Din], ``Sigma`` [Din, Din] over x̃ = [x, u]. Returns the
+        next-STATE belief ``(μ_t, Σ_t)`` (Eqs. 10-11): the Δ moments plus
+        the input-output cross-covariance folded back onto the state part.
+        """
+        X, beta, K_inv = gp["X"], gp["beta"], gp["K_inv"]
+        D = self.obs_dim
+        Din = X.shape[-1]
+        zeta = X - mu  # [n, Din]
+        Lam = jnp.exp(2.0 * gp["log_ls"])  # [D, Din] diag of Λ_a
+        sf2 = jnp.exp(2.0 * gp["log_sf"])
+        sn2 = _noise_var(gp["log_sf"], gp["log_sn"])
+        I = jnp.eye(Din)
+
+        # -- mean (Eqs. 14-15) + input-output covariance (Eq. 2.70) ----------
+        def mean_one(lam, sf2_a, beta_a):
+            SL = Sigma / lam[None, :]  # Σ Λ⁻¹
+            det = jnp.linalg.det(SL + I)
+            Sinv = jnp.linalg.inv(Sigma + jnp.diag(lam))
+            quad = jnp.einsum("ni,ij,nj->n", zeta, Sinv, zeta)
+            q = sf2_a * det ** -0.5 * jnp.exp(-0.5 * quad)  # [n]
+            mu_a = beta_a @ q
+            # cov(x̃, Δ_a) = Σ (Σ+Λ)⁻¹ Σᵢ βᵢ qᵢ ζᵢ
+            c_a = Sigma @ Sinv @ (zeta.T @ (beta_a * q))
+            return mu_a, q, c_a
+
+        mu_d, q_all, C = jax.vmap(mean_one)(Lam, sf2, beta)  # [D], [D,n], [D,Din]
+
+        # -- covariance (Eqs. 17-23) ----------------------------------------
+        log_k = (  # log k_a(x̃ᵢ, μ̃) = log σf² − ½ ζᵢᵀ Λ_a⁻¹ ζᵢ   [D, n]
+            jnp.log(sf2)[:, None]
+            - 0.5 * jnp.einsum("ni,ai->an", zeta * zeta, 1.0 / Lam)
+        )
+
+        def cov_ab(a, b):
+            iLa, iLb = 1.0 / Lam[a], 1.0 / Lam[b]
+            R = Sigma * (iLa + iLb)[None, :] + I
+            R_inv_S = jnp.linalg.solve(R, Sigma)
+            det_R = jnp.linalg.det(R)
+            za = zeta * iLa[None, :]  # Λ_a⁻¹ζᵢ  [n, Din]
+            zb = zeta * iLb[None, :]
+            # z_ijᵀ R⁻¹Σ z_ij expanded into i/j/cross terms
+            t_aa = jnp.einsum("ni,ij,nj->n", za, R_inv_S, za)
+            t_bb = jnp.einsum("ni,ij,nj->n", zb, R_inv_S, zb)
+            t_ab = jnp.einsum("ni,ij,mj->nm", za, R_inv_S, zb)
+            expo = (
+                log_k[a][:, None] + log_k[b][None, :]
+                + 0.5 * (t_aa[:, None] + t_bb[None, :] + 2.0 * t_ab)
+            )
+            Q = jnp.exp(expo) / jnp.sqrt(det_R)
+            e2 = beta[a] @ Q @ beta[b]
+            cov = e2 - mu_d[a] * mu_d[b]
+            # diagonal: expected model variance (Eq. 23) + process noise
+            extra = sf2[a] - jnp.trace(K_inv[a] @ Q) + sn2[a]
+            return jnp.where(a == b, cov + extra, cov)
+
+        idx = jnp.arange(D)
+        S_d = jax.vmap(
+            lambda a: jax.vmap(lambda b: cov_ab(a, b))(idx)
+        )(idx)  # [D, D]
+
+        # -- next-state moments (Eqs. 10-11) --------------------------------
+        mu_t = mu[:D] + mu_d
+        Cx = C[:, :D].T  # state rows of cov(x̃, Δ): [D(state), D(out)]
+        S_t = Sigma[:D, :D] + S_d + Cx + Cx.T
+        S_t = 0.5 * (S_t + S_t.T)  # symmetrize against float drift
+        return mu_t, S_t
+
+    # -- TensorDict interface (reference forward) ------------------------------
+
+    def __call__(self, gp: ArrayDict, td: ArrayDict) -> ArrayDict:
+        mx = td["observation", "mean"]
+        Sx = td["observation", "var"]
+        mu_ = jnp.concatenate([mx, td["action", "mean"]], axis=-1)
+        D, F = self.obs_dim, self.action_dim
+        Su = td["action", "var"]
+        if Su.ndim < 2 or Su.shape[-1] != F or Su.shape[-2] != F:
+            Su = jnp.broadcast_to(
+                jnp.eye(F) * jnp.reshape(Su, (-1,))[..., None], (F, F)
+            )
+        Cxu = (
+            td[("action", "cross_covariance")]
+            if ("action", "cross_covariance") in td
+            else jnp.zeros((D, F))
+        )
+        Sigma = jnp.block([[Sx, Cxu], [Cxu.T, Su]])
+        mu_t, S_t = self.propagate(gp, mu_, Sigma)
+        return (
+            td.set(("next", "observation", "mean"), mu_t)
+            .set(("next", "observation", "var"), S_t)
+        )

@@ -19,6 +19,7 @@ from .ddpg import DDPGLoss, TD3BCLoss, TD3Loss
 from .dqn import DistributionalDQNLoss, DQNLoss
 from .imitation import ACTLoss, BCLoss, DiffusionBCLoss, GAILLoss, RNDModule
 from .iql import IQLLoss
+from .pilco import ExponentialQuadraticCost, pilco_cost
 from .redq import REDQLoss
 from .multiagent import IPPOLoss, MAPPOLoss, QMixerLoss
 from .ppo import A2CLoss, ClipPPOLoss, KLPENPPOLoss, PPOLoss, ReinforceLoss
@@ -67,6 +68,8 @@ __all__ = [
     "DDPGLoss",
     "TD3Loss",
     "IQLLoss",
+    "ExponentialQuadraticCost",
+    "pilco_cost",
     "CQLLoss",
     "DiscreteCQLLoss",
     "REDQLoss",

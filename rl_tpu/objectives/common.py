@@ -137,9 +137,18 @@ class ActorCriticLossMixin(LossModule):
 
     def _ensure_advantage(self, params: dict, batch: ArrayDict) -> ArrayDict:
         if "advantage" not in batch:
+            from .value import VTrace
+
             if getattr(self, "value_estimator", None) is None:
                 self.make_value_estimator()
-            batch = self.value_estimator(params["critic"], batch)
+            if isinstance(self.value_estimator, VTrace):
+                # off-policy correction needs the CURRENT actor's log-probs
+                # of the stored actions (IMPALA; reference a2c.py vtrace path)
+                batch = self.value_estimator(
+                    params["critic"], batch, actor_params=params["actor"]
+                )
+            else:
+                batch = self.value_estimator(params["critic"], batch)
         return batch
 
     def _value(self, params: dict, batch: ArrayDict) -> jax.Array:

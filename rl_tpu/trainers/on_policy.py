@@ -63,7 +63,9 @@ class OnPolicyProgram:
         if advantage is None:
             if loss.value_estimator is None:
                 loss.make_value_estimator()
-            advantage = lambda params, b: loss.value_estimator(params["critic"], b)  # noqa: E731
+            # single dispatch point: the loss mixin already knows how to
+            # drive its estimator (incl. the VTrace actor-params path)
+            advantage = loss._ensure_advantage
         self.advantage = advantage
 
         frames = collector.frames_per_batch

@@ -1,0 +1,160 @@
+"""GP world model + PILCO objective (round-3 VERDICT missing #6;
+reference test strategy: moment matching vs Monte Carlo oracle, cost
+closed form vs sampling, end-to-end analytic policy search)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from rl_tpu.data import ArrayDict
+from rl_tpu.modules import GPWorldModel
+from rl_tpu.objectives import ExponentialQuadraticCost, pilco_cost
+
+KEY = jax.random.key(0)
+
+
+def _fit_gp(n=80, steps=200):
+    """x' = x + 0.1 sin(x) + 0.2 u — smooth nonlinear dynamics."""
+    x = jax.random.uniform(KEY, (n, 2), minval=-2, maxval=2)
+    u = jax.random.uniform(jax.random.key(1), (n, 1), minval=-1, maxval=1)
+    nx = x + 0.1 * jnp.sin(x) + 0.2 * u
+    ds = ArrayDict(observation=x, action=u, next=ArrayDict(observation=nx))
+    gp = GPWorldModel(2, 1)
+    return gp, gp.fit(ds, num_steps=steps)
+
+
+class TestGPFit:
+    def test_posterior_accuracy(self):
+        gp, st = _fit_gp()
+        obs = jnp.asarray([0.5, -0.3])
+        act = jnp.asarray([0.2])
+        mu, var = gp.predict(st, obs, act)
+        true = obs + 0.1 * jnp.sin(obs) + 0.2 * act
+        np.testing.assert_allclose(np.asarray(mu), np.asarray(true), atol=5e-3)
+        assert (np.asarray(var) < 1e-2).all()  # confident in-distribution
+
+    def test_batched_predict(self):
+        gp, st = _fit_gp(steps=50)
+        mu, var = gp.predict(
+            st, jnp.zeros((5, 2)), jnp.zeros((5, 1))
+        )
+        assert mu.shape == (5, 2) and var.shape == (5, 2)
+        assert (np.asarray(var) > 0).all()
+
+
+class TestMomentMatching:
+    def test_matches_monte_carlo(self):
+        """The Eqs. 10-23 closed form vs a 8k-sample MC oracle through the
+        SAME GP posterior (mean, full covariance incl. cross-terms)."""
+        gp, st = _fit_gp()
+        mu0 = jnp.asarray([0.3, -0.5, 0.1])
+        S0 = jnp.diag(jnp.asarray([0.05, 0.04, 0.02]))
+        mt, St = gp.propagate(st, mu0, S0)
+        samp = jax.random.multivariate_normal(jax.random.key(2), mu0, S0, (8000,))
+        pm, pv = jax.vmap(lambda s: gp.predict(st, s[:2], s[2:]))(samp)
+        mc_mean = pm.mean(0)
+        mc_cov = jnp.cov(pm.T) + jnp.diag(pv.mean(0))
+        np.testing.assert_allclose(np.asarray(mt), np.asarray(mc_mean), atol=0.01)
+        np.testing.assert_allclose(np.asarray(St), np.asarray(mc_cov), atol=0.01)
+
+    def test_tensordict_interface(self):
+        gp, st = _fit_gp(steps=50)
+        td = ArrayDict(
+            observation=ArrayDict(
+                mean=jnp.asarray([0.1, 0.2]),
+                var=0.01 * jnp.eye(2),
+            ),
+            action=ArrayDict(
+                mean=jnp.asarray([0.0]),
+                var=0.01 * jnp.eye(1),
+            ),
+        )
+        out = gp(st, td)
+        assert out["next", "observation", "mean"].shape == (2,)
+        S = np.asarray(out["next", "observation", "var"])
+        assert S.shape == (2, 2)
+        assert (np.linalg.eigvalsh(S) > 0).all()  # a valid covariance
+
+    def test_jit_and_grad(self):
+        gp, st = _fit_gp(steps=50)
+        mu0 = jnp.asarray([0.3, -0.5, 0.1])
+        S0 = 0.02 * jnp.eye(3)
+
+        def f(mu):
+            mt, St = gp.propagate(st, mu, S0)
+            return jnp.sum(mt) + jnp.trace(St)
+
+        g = jax.jit(jax.grad(f))(mu0)
+        assert np.isfinite(np.asarray(g)).all()
+
+
+class TestExpectedCost:
+    def test_matches_monte_carlo(self):
+        m = jnp.asarray([0.4, -0.2])
+        A = jnp.asarray([[0.3, 0.1], [0.1, 0.2]])
+        S = A @ A.T
+        W = jnp.asarray([[2.0, 0.3], [0.3, 1.0]])
+        t = jnp.asarray([0.1, 0.1])
+        c = float(pilco_cost(m, S, target=t, weights=W))
+        samp = jax.random.multivariate_normal(KEY, m, S, (200000,))
+        d = samp - t
+        mc = float(
+            jnp.mean(1.0 - jnp.exp(-0.5 * jnp.einsum("bi,ij,bj->b", d, W, d)))
+        )
+        assert abs(c - mc) < 5e-3, (c, mc)
+
+    def test_zero_variance_reduces_to_point_cost(self):
+        m = jnp.asarray([1.0, 0.0])
+        c = float(pilco_cost(m, jnp.zeros((2, 2))))
+        assert abs(c - (1.0 - np.exp(-0.5))) < 1e-4
+
+    def test_loss_module(self):
+        loss = ExponentialQuadraticCost()
+        batch = ArrayDict(
+            observation=ArrayDict(
+                mean=jnp.zeros((4, 2)),
+                var=jnp.broadcast_to(0.1 * jnp.eye(2), (4, 2, 2)),
+            )
+        )
+        v, m = loss({}, batch)
+        assert np.isfinite(float(v)) and 0.0 <= float(v) <= 1.0
+
+
+class TestPILCOPolicySearch:
+    @pytest.mark.slow
+    def test_analytic_policy_improvement(self):
+        """The whole PILCO loop: fit GP, differentiate the expected cost of
+        a moment-matched belief rollout w.r.t. a linear policy, descend —
+        the expected cost must drop (target: drive the state to 0)."""
+        gp, st = _fit_gp()
+        H = 8
+        # same-sign start: the (shared) scalar action can push both dims
+        # toward the target; a wide cost keeps gradient signal alive far
+        # from the target (W=I saturates at this distance)
+        mu0 = jnp.asarray([1.2, 0.8])
+        S0 = 0.01 * jnp.eye(2)
+        W = 0.25 * jnp.eye(2)
+
+        def rollout_cost(theta):
+            def body(carry, _):
+                mu_x, S_x = carry
+                a = jnp.tanh(theta @ mu_x)[None]  # linear-tanh policy mean
+                # deterministic policy: zero action variance, zero cross-cov
+                mu_ = jnp.concatenate([mu_x, a])
+                S_ = jnp.zeros((3, 3)).at[:2, :2].set(S_x).at[2, 2].set(1e-6)
+                mu_t, S_t = gp.propagate(st, mu_, S_)
+                c = pilco_cost(mu_t, S_t, weights=W)
+                return (mu_t, S_t), c
+
+            _, costs = jax.lax.scan(body, (mu0, S0), None, length=H)
+            return costs.sum()
+
+        theta = jnp.zeros((2,))
+        grad_fn = jax.jit(jax.value_and_grad(rollout_cost))
+        c0, _ = grad_fn(theta)
+        for _ in range(30):
+            c, g = grad_fn(theta)
+            theta = theta - 0.5 * g
+        c1, _ = grad_fn(theta)
+        assert float(c1) < float(c0) - 0.05, (float(c0), float(c1))

@@ -1,0 +1,31 @@
+"""New round-4 recipes run end-to-end at tiny scale (reference test
+strategy: sota-check smoke runs)."""
+
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "examples"))
+
+
+@pytest.mark.slow
+def test_impala_recipe_runs():
+    import impala_cartpole
+
+    impala_cartpole.main(total_steps=3, n_envs=8, frames=256)
+
+
+@pytest.mark.slow
+def test_dreamerv3_recipe_runs():
+    import dreamerv3_pendulum as d
+
+    d.N_ENVS, d.T, d.HORIZON = 4, 8, 5
+    d.main(num_steps=2, log_interval=1)
+
+
+@pytest.mark.slow
+def test_mappo_recipe_runs():
+    import mappo_navigation
+
+    mappo_navigation.main(total_steps=3, n_envs=4, frames=128)

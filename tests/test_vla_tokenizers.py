@@ -1,0 +1,132 @@
+"""VLA action tokenizers (round-3 VERDICT missing #7; reference
+test/test_vla.py tokenizer round-trips + the tokenizers.py doctest
+values)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from rl_tpu.data import (
+    ArrayDict,
+    AddActionChunks,
+    UniformActionTokenizer,
+    VocabTailActionTokenizer,
+    build_action_chunks,
+)
+
+KEY = jax.random.key(0)
+
+
+class TestUniform:
+    def test_reference_doctest_values(self):
+        tok = UniformActionTokenizer(256, low=-1.0, high=1.0)
+        np.testing.assert_array_equal(
+            np.asarray(tok.encode(jnp.asarray([-1.0, 0.0, 1.0]))), [0, 128, 255]
+        )
+        np.testing.assert_allclose(
+            np.asarray(tok.decode(jnp.asarray([0, 128, 255]))),
+            [-0.998, 0.002, 0.998], atol=1e-2,
+        )
+        assert tok.vocab_size == 256
+
+    def test_roundtrip_error_bound(self):
+        tok = UniformActionTokenizer(128, low=-2.0, high=3.0)
+        a = jax.random.uniform(KEY, (1000, 4), minval=-2.0, maxval=3.0)
+        err = jnp.abs(tok.decode(tok.encode(a)) - a)
+        assert float(err.max()) <= 5.0 / (2 * 128) + 1e-6  # half bin width
+
+    def test_per_dim_bounds(self):
+        tok = UniformActionTokenizer(
+            64, low=jnp.asarray([-1.0, 0.0]), high=jnp.asarray([1.0, 10.0])
+        )
+        assert tok.action_dim == 2
+        a = jnp.asarray([[0.0, 5.0]])
+        assert float(jnp.abs(tok.decode(tok.encode(a)) - a).max()) < 0.1
+
+    def test_chunk_shapes_jit(self):
+        tok = UniformActionTokenizer(256, low=-1.0, high=1.0)
+        chunks = jax.random.uniform(KEY, (2, 5, 8, 7), minval=-1, maxval=1)
+        toks = jax.jit(tok.encode)(chunks)
+        assert toks.shape == chunks.shape and toks.dtype == jnp.int32
+        assert jax.jit(tok.decode)(toks).shape == chunks.shape
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="num_bins"):
+            UniformActionTokenizer(0, low=-1.0, high=1.0)
+        with pytest.raises(ValueError, match="strictly greater"):
+            UniformActionTokenizer(8, low=1.0, high=1.0)
+
+
+class TestVocabTail:
+    def test_reference_doctest_values(self):
+        tok = VocabTailActionTokenizer(256)
+        np.testing.assert_array_equal(
+            np.asarray(tok.encode(jnp.asarray([-1.0, 0.0, 1.0]))), [255, 128, 0]
+        )
+        np.testing.assert_allclose(
+            np.asarray(tok.decode(jnp.asarray([255, 128, 0]))),
+            [-0.9961, 0.0, 0.9961], atol=1e-4,
+        )
+        full = VocabTailActionTokenizer(256, full_vocab_size=32000)
+        np.testing.assert_array_equal(
+            np.asarray(full.encode(jnp.asarray([-1.0, 0.0, 1.0]))),
+            [31999, 31872, 31744],
+        )
+        assert full.vocab_size == 32000
+
+    def test_roundtrip_in_unit_box(self):
+        tok = VocabTailActionTokenizer(256)
+        a = jax.random.uniform(KEY, (500, 7), minval=-1, maxval=1)
+        err = jnp.abs(tok.decode(tok.encode(a)) - a)
+        assert float(err.max()) <= 2.0 / 255 + 1e-6
+
+    def test_norm_stats_roundtrip(self):
+        q01 = np.asarray([-0.3, -2.0, 0.0])
+        q99 = np.asarray([0.3, 2.0, 1.0])
+        tok = VocabTailActionTokenizer(256, norm_low=q01, norm_high=q99)
+        a = jnp.asarray([[0.0, 1.5, 0.25], [-0.29, -1.9, 0.9]])
+        dec = tok.decode(tok.encode(a))
+        np.testing.assert_allclose(np.asarray(dec), np.asarray(a), atol=2e-2)
+
+    def test_gripper_binarize_and_invert(self):
+        q01, q99 = np.asarray([-1.0, -1.0]), np.asarray([1.0, 1.0])
+        mask = np.asarray([True, False])  # dim 1 = gripper
+        tok = VocabTailActionTokenizer(
+            256, norm_low=q01, norm_high=q99, norm_mask=mask,
+            gripper_binarize=True, gripper_invert=True,
+        )
+        a = jnp.asarray([[0.5, 0.7], [0.5, -0.7]])
+        dec = np.asarray(tok.decode(tok.encode(a)))
+        # gripper: binarized to +-1 then inverted
+        np.testing.assert_allclose(dec[:, 1], [-1.0, 1.0])
+        np.testing.assert_allclose(dec[:, 0], 0.5, atol=1e-2)
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="full_vocab_size"):
+            VocabTailActionTokenizer(256, full_vocab_size=8)
+        with pytest.raises(ValueError, match="together"):
+            VocabTailActionTokenizer(256, norm_low=np.zeros(2))
+
+
+class TestPolicyPath:
+    def test_tokenized_chunks_through_schema(self):
+        """The VLA pipeline: trajectory actions -> chunks -> tokens (the
+        autoregressive policy's targets) -> decode -> env actions."""
+        tok = UniformActionTokenizer(256, low=-1.0, high=1.0)
+        actions = jax.random.uniform(KEY, (2, 6, 3), minval=-1, maxval=1)
+        td = AddActionChunks(chunk=4)(ArrayDict(action=actions))
+        chunks = td["vla_action", "chunk"]  # [2, 6, 4, 3]
+        tokens = tok.encode(chunks)
+        assert tokens.shape == (2, 6, 4, 3)
+        # a token-head policy emits these ids; decode feeds the env
+        env_actions = tok.decode(tokens)
+        np.testing.assert_allclose(
+            np.asarray(env_actions), np.asarray(chunks), atol=1.0 / 255
+        )
+
+    def test_lm_head_targets_in_vocab(self):
+        tok = VocabTailActionTokenizer(64, full_vocab_size=1000)
+        a = jax.random.uniform(KEY, (16, 8, 7), minval=-1, maxval=1)
+        ids = np.asarray(tok.encode(a))
+        assert ids.min() >= 1000 - 64 and ids.max() < 1000
