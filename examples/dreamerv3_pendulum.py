@@ -2,7 +2,8 @@
 actor-critic (reference analog: sota-implementations/dreamer_v3/).
 
 The end-to-end loop the losses are built for:
-  1. collect real trajectories with the current latent-space actor;
+  1. collect real trajectories with the current latent-space actor
+     (online belief filtering via rssm.filter_step inside one scan);
   2. model update — symlog recon + two-hot reward CE + balanced KL
      (DreamerV3ModelLoss);
   3. posterior states from ``rssm.observe`` seed imagination;
@@ -17,7 +18,7 @@ import numpy as np
 import optax
 
 from rl_tpu.data import ArrayDict
-from rl_tpu.envs import PendulumEnv, VmapEnv, rollout
+from rl_tpu.envs import PendulumEnv, VmapEnv
 from rl_tpu.models import RSSMv3, RSSMv3Config
 from rl_tpu.modules import MLP, TanhNormal
 from rl_tpu.objectives import (
@@ -100,21 +101,43 @@ def main(num_steps: int = 100, log_interval: int = 10):
         "value": opts["value"].init(params["value"]),
     }
 
-    # latent-space collection: carry (h, z) through the real env rollout
-    def policy(p, td, k):
-        return actor(p, td, k)
-
+    # latent-space collection with the CURRENT actor: the (h, z) belief is
+    # filtered online (rssm.filter_step) and the actor acts on it — the
+    # Dreamer deployment loop, one fused scan
     @jax.jit
     def collect(params, key):
-        k1, k2 = jax.random.split(key)
-        b = rollout(env, k1, max_steps=T)  # random-action exploration base
-        # re-tag with is_first/reward layout the model loss expects [B, T]
-        return ArrayDict(
-            observation=jnp.swapaxes(b["observation"], 0, 1),
-            action=jnp.swapaxes(b["action"], 0, 1).reshape(N_ENVS, T, act_dim),
-            reward=jnp.swapaxes(b["next", "reward"], 0, 1),
-            terminated=jnp.swapaxes(b["next", "terminated"], 0, 1),
-            is_first=jnp.zeros((N_ENVS, T), bool).at[:, 0].set(True),
+        k0, k1, kroll = jax.random.split(key, 3)
+        env_state, td = env.reset(k0)
+        h = jnp.zeros((N_ENVS, cfg.deter_dim))
+        z = jnp.zeros((N_ENVS, cfg.stoch_dim))
+        h, z = rssm.filter_step(
+            params["rssm"], h, z, jnp.zeros((N_ENVS, act_dim)),
+            td["observation"], jnp.ones((N_ENVS,), bool), k1,
+        )
+
+        def body(carry, k):
+            env_state, td, h, z = carry
+            ka, kf = jax.random.split(k)
+            a = actor(params["actor"], ArrayDict(h=h, z=z), ka)["action"]
+            env_state, out = env.step(env_state, td.set("action", a))
+            nxt = out["next"]
+            h, z = rssm.filter_step(
+                params["rssm"], h, z, a, nxt["observation"], nxt["done"], kf
+            )
+            step = ArrayDict(
+                observation=td["observation"], action=a,
+                reward=nxt["reward"], terminated=nxt["terminated"],
+            )
+            # carry only the step_mdp keys (the reset td has no reward)
+            carry_td = nxt.select("observation", "done", "terminated", "truncated")
+            return (env_state, carry_td, h, z), step
+
+        _, steps = jax.lax.scan(
+            body, (env_state, td, h, z), jax.random.split(kroll, T)
+        )
+        batch = jax.tree.map(lambda x: jnp.swapaxes(x, 0, 1), steps)  # [B, T]
+        return batch.set(
+            "is_first", jnp.zeros((N_ENVS, T), bool).at[:, 0].set(True)
         )
 
     @jax.jit

@@ -47,6 +47,9 @@ def main(total_steps: int = 50, n_envs: int = 32, frames: int = 2048):
         coll,
         loss,
         OnPolicyConfig(num_epochs=4, minibatch_size=max(64, frames // 2), learning_rate=5e-4),
+        # the point of V-trace: recompute the importance-corrected
+        # advantage against the CURRENT policy at every epoch
+        recompute_advantage=True,
     )
     trainer = Trainer(program, total_steps=total_steps, logger=CSVLogger("impala_cartpole"))
     trainer.train(0)

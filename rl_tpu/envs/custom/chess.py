@@ -189,7 +189,7 @@ def _pseudo_moves(board64, stm, ep_sq, castling):
 
     reach = _ray_reach(board64)  # [64, 8, 7]
     # scatter ray visibility into a [64, 64] matrix per direction class
-    tgt = jnp.where(reach, _tables()[2], 64)  # pad -> dummy 64
+    tgt = jnp.where(reach, RAY, 64)  # pad -> dummy 64
 
     def vis_matrix(dirs):
         m = jnp.zeros((64, 65), bool)

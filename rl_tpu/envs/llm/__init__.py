@@ -1,7 +1,9 @@
 from .chat import ChatEnv, DatasetChatEnv
 from .datasets import (QADataset, arithmetic_dataset, copy_dataset,
-                       gsm8k_dataset, math_expression_dataset)
-from .reward import (ExactMatchScorer, FormatScorer, GSM8KScorer,
+                       countdown_dataset, gsm8k_dataset,
+                       ifeval_dataset, math_expression_dataset)
+from .reward import (CountdownScorer, ExactMatchScorer, FormatScorer,
+                     GSM8KScorer, IFEvalScorer,
                      SumScorer, combine_scorers, extract_gsm8k_answer)
 from .transforms import KLRewardTransform, PolicyVersion, PythonToolTransform
 
@@ -11,11 +13,15 @@ __all__ = [
     "QADataset",
     "arithmetic_dataset",
     "copy_dataset",
+    "countdown_dataset",
     "gsm8k_dataset",
+    "ifeval_dataset",
     "math_expression_dataset",
     "ExactMatchScorer",
     "FormatScorer",
+    "CountdownScorer",
     "GSM8KScorer",
+    "IFEvalScorer",
     "SumScorer",
     "extract_gsm8k_answer",
     "combine_scorers",

@@ -14,8 +14,9 @@ import numpy as np
 
 from ...data.llm.history import History
 
-__all__ = ["arithmetic_dataset", "copy_dataset", "gsm8k_dataset",
-           "math_expression_dataset", "QADataset"]
+__all__ = ["arithmetic_dataset", "copy_dataset", "countdown_dataset",
+           "gsm8k_dataset", "ifeval_dataset", "math_expression_dataset",
+           "QADataset"]
 
 
 class QADataset:
@@ -157,4 +158,67 @@ def math_expression_dataset(
     for _ in range(n):
         s, v, _ = expr(depth)
         out.append((f"{s}=", str(v)))
+    return QADataset(out)
+
+
+def countdown_dataset(
+    n: int = 128, n_numbers: int = 4, max_number: int = 20, seed: int = 0
+) -> QADataset:
+    """Countdown number-game tasks (reference envs/llm/datasets/countdown.py
+    ``CountdownEnv`` problem generator): given a set of numbers and a
+    target, produce an arithmetic expression over (a subset of) the
+    numbers that evaluates to the target. Problems are generated
+    solvable-by-construction: the target IS the value of a random
+    expression over the numbers; the gold answer records one solution, and
+    :class:`~rl_tpu.envs.llm.CountdownScorer` accepts ANY valid one
+    (verifiable reward, not string match).
+    """
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(n):
+        nums = [int(x) for x in rng.integers(1, max_number + 1, n_numbers)]
+        order = rng.permutation(n_numbers)
+        expr = str(nums[order[0]])
+        val = nums[order[0]]
+        for i in order[1:]:
+            op = "+-*"[rng.integers(0, 3)]
+            if op == "*" and (val > 100 or nums[i] > 10):
+                op = "+"  # keep targets in a sane range
+            expr = f"({expr}){op}{nums[i]}" if op == "*" else f"{expr}{op}{nums[i]}"
+            val = {"+": val + nums[i], "-": val - nums[i], "*": val * nums[i]}[op]
+        q = (
+            f"Using the numbers {nums} and the operations + - *, write an "
+            f"expression that equals {val}. Answer with the expression "
+            "inside <answer></answer> tags."
+        )
+        out.append((q, f"<answer>{expr}</answer>"))
+    return QADataset(out)
+
+
+def ifeval_dataset(n: int = 64, seed: int = 0) -> QADataset:
+    """IFEval-format instruction-following tasks (reference
+    envs/llm/datasets/ifeval.py): each prompt carries PROGRAMMATICALLY
+    VERIFIABLE constraints (word count, keyword inclusion, casing);
+    :class:`~rl_tpu.envs.llm.IFEvalScorer` checks them mechanically —
+    the gold answer is one satisfying response, the reward accepts any.
+    """
+    rng = np.random.default_rng(seed)
+    words = ["ocean", "tiger", "maple", "ember", "stone", "cloud", "river"]
+    out = []
+    for _ in range(n):
+        kind = int(rng.integers(0, 3))
+        if kind == 0:
+            k = int(rng.integers(2, 6))
+            w = words[rng.integers(0, len(words))]
+            q = f"[words={k}] [include={w}] Write exactly {k} words including the word '{w}'."
+            gold = " ".join([w] + ["and"] * (k - 1))
+        elif kind == 1:
+            w = words[rng.integers(0, len(words))]
+            q = f"[lowercase] [include={w}] Reply in all lowercase and include '{w}'."
+            gold = f"i like {w}"
+        else:
+            k = int(rng.integers(3, 7))
+            q = f"[words={k}] Answer with exactly {k} words."
+            gold = " ".join(["word"] * k)
+        out.append((q, gold))
     return QADataset(out)

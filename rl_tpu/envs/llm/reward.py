@@ -12,8 +12,9 @@ from __future__ import annotations
 import re
 from typing import Any, Callable, Sequence
 
-__all__ = ["ExactMatchScorer", "FormatScorer", "GSM8KScorer", "SumScorer",
-           "combine_scorers", "extract_gsm8k_answer"]
+__all__ = ["CountdownScorer", "ExactMatchScorer", "FormatScorer",
+           "GSM8KScorer", "IFEvalScorer", "SumScorer", "combine_scorers",
+           "extract_gsm8k_answer"]
 
 
 def _last_user(history) -> str:
@@ -154,3 +155,81 @@ class GSM8KScorer:
             )
         think = re.search(r"<think>\s*\S.*?</think>", resp, re.DOTALL)
         return float(base + (self.think_bonus if think else 0.0))
+
+
+class CountdownScorer:
+    """Verifiable countdown reward (reference envs/llm/datasets/
+    countdown.py reward): parse the <answer> expression, safe-evaluate it,
+    check it reaches the target stated in the question using only the
+    given numbers. 1.0 solved / ``format_reward`` parseable-but-wrong /
+    0.0 unparseable. Any valid solution scores — not string match."""
+
+    def __init__(self, format_reward: float = 0.1):
+        self.format_reward = format_reward
+
+    @staticmethod
+    def _parse_question(q: str):
+        nums = re.search(r"numbers \[([\d, ]+)\]", q)
+        target = re.search(r"equals (-?\d+)", q)
+        if not nums or not target:
+            return None, None
+        return (
+            [int(x) for x in nums.group(1).split(",")],
+            int(target.group(1)),
+        )
+
+    @staticmethod
+    def _safe_eval(expr: str):
+        if not re.fullmatch(r"[\d\s\+\-\*\(\)]+", expr):
+            return None
+        try:
+            return eval(expr, {"__builtins__": {}}, {})  # digits/ops only
+        except Exception:  # noqa: BLE001 - malformed arithmetic
+            return None
+
+    def __call__(self, history, response_tokens) -> float:
+        nums, target = self._parse_question(_last_user(history))
+        if nums is None:
+            return 0.0
+        m = re.search(
+            r"<answer>\s*(.*?)\s*</answer>", _assistant_text(history), re.DOTALL
+        )
+        if not m:
+            return 0.0
+        expr = m.group(1)
+        val = self._safe_eval(expr)
+        if val is None:
+            return 0.0
+        used = [int(x) for x in re.findall(r"\d+", expr)]
+        pool = list(nums)
+        legal = True
+        for u in used:
+            if u in pool:
+                pool.remove(u)
+            else:
+                legal = False
+                break
+        return 1.0 if (legal and val == target) else self.format_reward
+
+
+class IFEvalScorer:
+    """Mechanical instruction-following checks (reference
+    envs/llm/reward/ifeval/_scorer.py): constraints are encoded in the
+    prompt as ``[words=N]`` / ``[include=w]`` / ``[lowercase]`` tags; the
+    reward is the fraction of constraints satisfied (the reference's
+    per-instruction partial credit)."""
+
+    def __call__(self, history, response_tokens) -> float:
+        q = _last_user(history)
+        resp = _assistant_text(history).strip()
+        checks = []
+        m = re.search(r"\[words=(\d+)\]", q)
+        if m:
+            checks.append(len(resp.split()) == int(m.group(1)))
+        for w in re.findall(r"\[include=(\w+)\]", q):
+            checks.append(w.lower() in resp.lower())
+        if "[lowercase]" in q:
+            checks.append(bool(resp) and resp == resp.lower())
+        if not checks:
+            return 0.0
+        return float(sum(checks) / len(checks))

@@ -38,10 +38,23 @@ __all__ = [
 ]
 
 
+# default-key selection must NEVER touch the MDP bookkeeping leaves: at
+# runtime the hook sees the whole next-td (done flags, reward), and a
+# keyed transform silently normalizing/casting `done` corrupts the rollout
+# (caught by tests/test_depth_regressions.py batched spec checks)
+_RESERVED_KEYS = frozenset(
+    {"done", "terminated", "truncated", "reward", "action"}
+)
+
+
 def _obs_keys(spec_or_td, in_keys):
     if in_keys is not None:
         return [k if isinstance(k, tuple) else (k,) for k in in_keys]
-    return list(spec_or_td.keys(nested=True, leaves_only=True))
+    return [
+        k
+        for k in spec_or_td.keys(nested=True, leaves_only=True)
+        if k[-1] not in _RESERVED_KEYS
+    ]
 
 
 class _KeyedTransform(Transform):

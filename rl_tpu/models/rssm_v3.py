@@ -201,6 +201,17 @@ class _RSSMv3Core(nn.Module):
         recon, reward_logits, cont = self.decode(h, z)
         return h, z, recon, reward_logits, cont
 
+    def filter_step(self, h, z, a, obs, is_first, key):
+        """ONE online posterior step (latent-state policy deployment /
+        actor-driven collection): advance the prior with the taken action,
+        condition on the observed obs. ``is_first`` zeroes the carry at
+        episode starts, matching :meth:`observe`'s scan body."""
+        mask = (1.0 - is_first.astype(jnp.float32))[:, None]
+        h, z, a = h * mask, z * mask, a * mask
+        h, _ = self.step_prior(h, z, a)
+        post_logits = self.posterior(h, obs)
+        return h, self._sample(post_logits, key)
+
     def __call__(self, obs_seq, action_seq, is_first, key):
         # init path: touch every submodule once outside lax.scan
         c = self.cfg
@@ -235,6 +246,12 @@ class RSSMv3:
     def imagine_step(self, params, h, z, a, key):
         return self.core.apply(
             {"params": params}, h, z, a, key, method=_RSSMv3Core.imagine_step
+        )
+
+    def filter_step(self, params, h, z, a, obs, is_first, key):
+        return self.core.apply(
+            {"params": params}, h, z, a, obs, is_first, key,
+            method=_RSSMv3Core.filter_step,
         )
 
     def reward_value(self, reward_logits):

@@ -116,7 +116,10 @@ class GPWorldModel:
 
         p = params0
         for _ in range(num_steps):
-            p, ostate, v = step(p, ostate)
+            p, ostate, _ = step(p, ostate)
+        # NLML of the hyperparameters actually cached (the loop's last `v`
+        # is one optimizer step stale; num_steps=0 must also work)
+        final_nlml = loss(p)
 
         n = X.shape[0]
 
@@ -134,7 +137,7 @@ class GPWorldModel:
         return ArrayDict(
             X=X, Y=Y, K_inv=K_inv, beta=beta,
             log_ls=p["log_ls"], log_sf=p["log_sf"], log_sn=p["log_sn"],
-            nlml=v,
+            nlml=final_nlml,
         )
 
     # -- deterministic posterior (Eqs. 7-8) ------------------------------------

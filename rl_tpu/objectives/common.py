@@ -137,13 +137,12 @@ class ActorCriticLossMixin(LossModule):
 
     def _ensure_advantage(self, params: dict, batch: ArrayDict) -> ArrayDict:
         if "advantage" not in batch:
-            from .value import VTrace
-
             if getattr(self, "value_estimator", None) is None:
                 self.make_value_estimator()
-            if isinstance(self.value_estimator, VTrace):
-                # off-policy correction needs the CURRENT actor's log-probs
-                # of the stored actions (IMPALA; reference a2c.py vtrace path)
+            if getattr(self.value_estimator, "needs_actor_params", False):
+                # estimators with an off-policy correction (VTrace/IMPALA)
+                # declare the dependency; they read the CURRENT actor's
+                # log-probs of the stored actions
                 batch = self.value_estimator(
                     params["critic"], batch, actor_params=params["actor"]
                 )

@@ -175,6 +175,8 @@ class VTrace(ValueEstimatorBase):
     """V-trace with importance ratios from ("sample_log_prob" vs the current
     policy's log-prob of the stored action) (reference :2473)."""
 
+    needs_actor_params = True  # read by ActorCriticLossMixin._ensure_advantage
+
     def __init__(
         self,
         value_network,

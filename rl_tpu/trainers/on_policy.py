@@ -56,6 +56,7 @@ class OnPolicyProgram:
         loss: LossModule,
         config: OnPolicyConfig = OnPolicyConfig(),
         advantage: Callable[[dict, ArrayDict], ArrayDict] | None = None,
+        recompute_advantage: bool = False,
     ):
         self.collector = collector
         self.loss = loss
@@ -67,6 +68,9 @@ class OnPolicyProgram:
             # drive its estimator (incl. the VTrace actor-params path)
             advantage = loss._ensure_advantage
         self.advantage = advantage
+        # IMPALA/V-trace: later epochs are off-policy w.r.t. the behavior
+        # batch; recomputing per epoch keeps the importance correction live
+        self.recompute_advantage = recompute_advantage
 
         frames = collector.frames_per_batch
         if frames % config.minibatch_size:
@@ -102,12 +106,17 @@ class OnPolicyProgram:
     def train_step(self, ts: dict) -> tuple[dict, ArrayDict]:
         params = ts["params"]
         batch, cstate = self.collector.collect(params, ts["collector"])
-        batch = self.advantage(params, batch)
-        flat = batch.flatten_batch()
-        n = flat.batch_shape[0]
+        if not self.recompute_advantage:
+            batch = self.advantage(params, batch)
 
         def epoch_body(carry, epoch_key):
             params, opt_state = carry
+            if self.recompute_advantage:
+                # V-trace path: ratios against the CURRENT policy per epoch
+                flat = self.advantage(params, batch).flatten_batch()
+            else:
+                flat = batch.flatten_batch()
+            n = flat.batch_shape[0]
             perm = jax.random.permutation(epoch_key, n)
             mb_idx = perm.reshape(self.num_minibatches, self.config.minibatch_size)
 

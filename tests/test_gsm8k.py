@@ -136,3 +136,53 @@ class TestLongSequenceGRPO:
         assert np.isfinite(m["loss"]) and np.isfinite(m["reward"])
         batch = t.collector.collect(t.params, t._key)
         assert batch["tokens"].shape[-1] == 512
+
+
+class TestCountdown:
+    def test_gold_solutions_score_full(self):
+        from rl_tpu.envs.llm import CountdownScorer, countdown_dataset
+
+        ds = countdown_dataset(32, seed=2)
+        sc = CountdownScorer()
+        for q, gold in ds.items:
+            assert sc(_h(q, gold), None) == 1.0, (q, gold)
+
+    def test_any_valid_solution_scores(self):
+        from rl_tpu.envs.llm import CountdownScorer
+
+        sc = CountdownScorer()
+        q = ("Using the numbers [2, 3, 4, 5] and the operations + - *, "
+             "write an expression that equals 14. Answer with the "
+             "expression inside <answer></answer> tags.")
+        assert sc(_h(q, "<answer>2*5+4</answer>"), None) == 1.0
+        assert sc(_h(q, "<answer>3*4+2</answer>"), None) == 1.0
+        # wrong value -> format credit
+        assert sc(_h(q, "<answer>2+3</answer>"), None) == 0.1
+        # uses a number not given (or reuses one too often) -> format only
+        assert sc(_h(q, "<answer>7+7</answer>"), None) == 0.1
+        assert sc(_h(q, "<answer>5+5+4</answer>"), None) == 0.1
+        # unparseable / unsafe -> 0
+        assert sc(_h(q, "fourteen"), None) == 0.0
+        assert sc(_h(q, "<answer>__import__('os')</answer>"), None) == 0.0
+
+
+class TestIFEval:
+    def test_constraint_fractions(self):
+        from rl_tpu.envs.llm import IFEvalScorer
+
+        sc = IFEvalScorer()
+        q = "[words=3] [include=ocean] Write exactly 3 words including 'ocean'."
+        assert sc(_h(q, "ocean is blue"), None) == 1.0
+        assert sc(_h(q, "the sea is blue"), None) == 0.0  # both fail
+        assert sc(_h(q, "ocean is very blue"), None) == 0.5  # keyword only
+        q2 = "[lowercase] [include=tiger] Reply in all lowercase."
+        assert sc(_h(q2, "i saw a tiger"), None) == 1.0
+        assert sc(_h(q2, "I saw a Tiger"), None) == 0.5  # include only
+
+    def test_gold_answers_satisfy(self):
+        from rl_tpu.envs.llm import IFEvalScorer, ifeval_dataset
+
+        ds = ifeval_dataset(32, seed=1)
+        sc = IFEvalScorer()
+        for q, gold in ds.items:
+            assert sc(_h(q, gold), None) == 1.0, (q, gold)

@@ -326,3 +326,90 @@ class TestTerminateTransform:
             state, out = env.step(state, env.rand_action(td, KEY))
             td = out["next"]
         assert bool(td["terminated"]) and not bool(td["done"])
+
+
+class TestMacroPrimitive:
+    def test_move_interpolates_to_target(self):
+        from rl_tpu.envs import MacroPrimitiveTransform, TargetMacroAction
+
+        t = MacroPrimitiveTransform(macro_steps=4, settle_steps=2)
+        macro = TargetMacroAction.move(jnp.asarray([1.0, -2.0]), steps=4)
+        out = t.inv(ArrayDict(action=macro))
+        seq = np.asarray(out["action"])
+        assert seq.shape == (6, 2)
+        np.testing.assert_allclose(seq[0], [0.25, -0.5])
+        np.testing.assert_allclose(seq[3], [1.0, -2.0])
+        np.testing.assert_allclose(seq[4:], [[1.0, -2.0]] * 2)  # settle holds
+
+    def test_short_macro_holds_target(self):
+        from rl_tpu.envs import MacroPrimitiveTransform, TargetMacroAction
+
+        t = MacroPrimitiveTransform(macro_steps=4)
+        macro = TargetMacroAction.move(jnp.asarray([2.0]), steps=2)
+        seq = np.asarray(t.inv(ArrayDict(action=macro))["action"])
+        np.testing.assert_allclose(seq[:, 0], [1.0, 2.0, 2.0, 2.0])
+
+    def test_wait_holds_current(self):
+        from rl_tpu.envs import MacroPrimitiveTransform, TargetMacroAction
+
+        t = MacroPrimitiveTransform(macro_steps=3)
+        macro = TargetMacroAction.wait(action_dim=2, steps=3)
+        td = ArrayDict(action=macro, current_action=jnp.asarray([0.5, 0.5]))
+        seq = np.asarray(t.inv(td)["action"])
+        np.testing.assert_allclose(seq, [[0.5, 0.5]] * 3)
+
+    def test_raw_tensor_is_move_target(self):
+        from rl_tpu.envs import MacroPrimitiveTransform
+
+        t = MacroPrimitiveTransform(macro_steps=2)
+        seq = np.asarray(t.inv(ArrayDict(action=jnp.asarray([1.0])))["action"])
+        np.testing.assert_allclose(seq[:, 0], [0.5, 1.0])
+
+    def test_executes_through_multiaction_env(self):
+        from rl_tpu.envs import MacroPrimitiveTransform, MultiActionEnv, TargetMacroAction, TransformedEnv
+        from rl_tpu.testing import ContinuousActionMock
+
+        T = 4
+        env = TransformedEnv(
+            MultiActionEnv(ContinuousActionMock(act_dim=2), T),
+            MacroPrimitiveTransform(macro_steps=3, settle_steps=1, action_dim=2),
+        )
+        state, td = env.reset(KEY)
+        macro = TargetMacroAction.move(jnp.asarray([0.5, -0.5]), steps=3)
+        state, out = env.step(state, td.set("action", macro))
+        # one outer step executed T low-level steps (reward accumulated)
+        assert np.isfinite(float(out["next", "reward"]))
+
+
+class TestActionTokenizerTransform:
+    def test_rb_encode_decode(self):
+        from rl_tpu.data import UniformActionTokenizer
+        from rl_tpu.envs import ActionTokenizerTransform
+
+        tok = UniformActionTokenizer(256, low=-1.0, high=1.0)
+        t = ActionTokenizerTransform(tok)
+        batch = ArrayDict(action=jnp.asarray([[0.5, -0.5]]))
+        enc = t(batch)
+        assert enc["action_tokens"].dtype == jnp.int32
+        dec = ActionTokenizerTransform(tok, mode="decode")(enc.exclude("action"))
+        np.testing.assert_allclose(
+            np.asarray(dec["action"]), [[0.5, -0.5]], atol=1.0 / 255
+        )
+
+    def test_env_inv_decodes_policy_tokens(self):
+        from rl_tpu.data import UniformActionTokenizer
+        from rl_tpu.envs import ActionTokenizerTransform, TransformedEnv
+        from rl_tpu.testing import ContinuousActionMock
+
+        tok = UniformActionTokenizer(64, low=-1.0, high=1.0)
+        env = TransformedEnv(
+            ContinuousActionMock(act_dim=2), ActionTokenizerTransform(tok)
+        )
+        from rl_tpu.data import Categorical as CatSpec
+
+        assert isinstance(env.action_spec, CatSpec)
+        assert env.action_spec.n == 64
+        state, td = env.reset(KEY)
+        tokens = jnp.asarray([10, 50], jnp.int32)
+        state, out = env.step(state, td.set("action", tokens))
+        assert np.isfinite(np.asarray(out["next", "observation"])).all()
