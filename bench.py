@@ -643,7 +643,7 @@ def bench_all():
     3. the headline line is printed again with the sub-bench dicts
        nested — the LAST stdout line also carries the headline value.
     """
-    weights = {"ppo": 1.6, "rlhf": 1.4, "sac": 1.0, "per": 1.0}
+    weights = {"ppo": 2.0, "rlhf": 1.4, "sac": 1.0, "per": 1.0}
     deadline = _START + _TIMEOUT - 30.0  # safety margin for the final print
     pending = list(weights)
     results: dict = {}

@@ -116,29 +116,33 @@ def main(num_steps: int = 100, log_interval: int = 10):
         )
 
         def body(carry, k):
-            env_state, td, h, z = carry
+            env_state, td, h, z, was_done = carry
             ka, kf = jax.random.split(k)
             a = actor(params["actor"], ArrayDict(h=h, z=z), ka)["action"]
-            env_state, out = env.step(env_state, td.set("action", a))
-            nxt = out["next"]
-            h, z = rssm.filter_step(
-                params["rssm"], h, z, a, nxt["observation"], nxt["done"], kf
+            # auto-reset: finished sub-envs restart and the NEXT stored
+            # step is flagged is_first (the model loss cuts sequences
+            # there; filter_step zeroes the belief the same way)
+            env_state, out, carry_td = env.step_and_reset(
+                env_state, td.set("action", a)
             )
+            nxt = out["next"]
             step = ArrayDict(
                 observation=td["observation"], action=a,
                 reward=nxt["reward"], terminated=nxt["terminated"],
+                is_first=was_done,
             )
-            # carry only the step_mdp keys (the reset td has no reward)
-            carry_td = nxt.select("observation", "done", "terminated", "truncated")
-            return (env_state, carry_td, h, z), step
+            h, z = rssm.filter_step(
+                params["rssm"], h, z, a, carry_td["observation"],
+                nxt["done"], kf,
+            )
+            return (env_state, carry_td, h, z, nxt["done"]), step
 
         _, steps = jax.lax.scan(
-            body, (env_state, td, h, z), jax.random.split(kroll, T)
+            body,
+            (env_state, td, h, z, jnp.ones((N_ENVS,), bool)),
+            jax.random.split(kroll, T),
         )
-        batch = jax.tree.map(lambda x: jnp.swapaxes(x, 0, 1), steps)  # [B, T]
-        return batch.set(
-            "is_first", jnp.zeros((N_ENVS, T), bool).at[:, 0].set(True)
-        )
+        return jax.tree.map(lambda x: jnp.swapaxes(x, 0, 1), steps)  # [B, T]
 
     @jax.jit
     def update(params, ostates, batch, key):

@@ -180,10 +180,16 @@ class CountdownScorer:
 
     @staticmethod
     def _safe_eval(expr: str):
+        # charset allowlist alone still admits '**' (two '*'), and
+        # 9**9**9 would hang eval materializing a ~370M-digit int —
+        # a policy can emit anything, so reject power explicitly and
+        # bound the expression length
+        if len(expr) > 200 or "**" in expr:
+            return None
         if not re.fullmatch(r"[\d\s\+\-\*\(\)]+", expr):
             return None
         try:
-            return eval(expr, {"__builtins__": {}}, {})  # digits/ops only
+            return eval(expr, {"__builtins__": {}}, {})  # digits, + - * ( )
         except Exception:  # noqa: BLE001 - malformed arithmetic
             return None
 

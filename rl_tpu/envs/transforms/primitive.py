@@ -138,23 +138,24 @@ class MacroPrimitiveTransform(Transform):
         # hold; longer requests are compressed to fit (never silently cut
         # short of the target). The per-macro settle field is advisory
         # duration accounting; holding after arrival covers its semantics.
+        # Built batch-major directly: target [*B, A], steps/mode [*B] ->
+        # seq [*B, T, A] (the MultiActionEnv layout).
         steps_eff = jnp.clip(
-            steps.astype(jnp.float32), 1.0, float(self.macro_steps)
+            jnp.asarray(steps, jnp.float32), 1.0, float(self.macro_steps)
         )
-        t = jnp.arange(1, T + 1, dtype=jnp.float32)
+        t = jnp.arange(1, T + 1, dtype=jnp.float32)  # [T]
         frac = jnp.clip(
-            t.reshape((T,) + (1,) * target.ndim) / steps_eff,
+            t.reshape((1,) * (target.ndim - 1) + (T, 1))
+            / steps_eff[..., None, None],
             0.0,
             1.0,
-        )
-        move_seq = start[None] + frac * (target - start)[None]
-        wait_seq = jnp.broadcast_to(start[None], move_seq.shape)
-        is_move = (mode == int(MacroPrimitive.MOVE)).reshape(
-            (1,) * (move_seq.ndim)
-        )
-        seq = jnp.where(is_move, move_seq, wait_seq)
-        # batch-major layout MultiActionEnv expects: [*batch, T, act]
-        seq = jnp.moveaxis(seq, 0, -2) if target.ndim > 1 else seq
+        )  # [*B, T, 1]
+        move_seq = start[..., None, :] + frac * (target - start)[..., None, :]
+        wait_seq = jnp.broadcast_to(start[..., None, :], move_seq.shape)
+        is_move = (jnp.asarray(mode) == int(MacroPrimitive.MOVE))[
+            ..., None, None
+        ]
+        seq = jnp.where(is_move, move_seq, wait_seq)  # [*B, T, A]
         return td.set(self.action_key, seq)
 
     def transform_action_spec(self, spec):

@@ -413,3 +413,19 @@ class TestActionTokenizerTransform:
         tokens = jnp.asarray([10, 50], jnp.int32)
         state, out = env.step(state, td.set("action", tokens))
         assert np.isfinite(np.asarray(out["next", "observation"])).all()
+
+    def test_batched_structured_macros(self):
+        from rl_tpu.envs import MacroPrimitiveTransform
+
+        t = MacroPrimitiveTransform(macro_steps=4)
+        macro = ArrayDict(
+            mode=jnp.asarray([1, 0], jnp.int32),  # MOVE, WAIT
+            steps=jnp.asarray([4, 2], jnp.int32),
+            settle_steps=jnp.zeros((2,), jnp.int32),
+            target=jnp.asarray([[1.0, -1.0], [9.0, 9.0]]),
+        )
+        seq = np.asarray(t.inv(ArrayDict(action=macro))["action"])
+        assert seq.shape == (2, 4, 2)
+        np.testing.assert_allclose(seq[0, 3], [1.0, -1.0])  # MOVE arrives
+        np.testing.assert_allclose(seq[0, 0], [0.25, -0.25])
+        np.testing.assert_allclose(seq[1], 0.0)  # WAIT holds zeros
