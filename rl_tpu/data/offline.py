@@ -50,7 +50,8 @@ from .replay import (
     RoundRobinWriter,
 )
 
-__all__ = ["MinariH5Dataset", "AtariDQNDataset", "atari_name_to_key"]
+__all__ = ["MinariH5Dataset", "AtariDQNDataset", "LeRobotDataset",
+           "atari_name_to_key", "lerobot_key"]
 
 # reference minari_data.py:57 _NAME_MATCH
 _MINARI_NAME_MATCH = {
@@ -81,7 +82,31 @@ def _episode_leaves(group) -> dict[tuple, np.ndarray]:
     return out
 
 
-class MinariH5Dataset:
+def _sealed_buffer(items, n, *, sampler, batch_size, scratch_dir):
+    """Shared tail of every offline loader: memmap storage, one extend,
+    then seal behind ImmutableDatasetWriter."""
+    rb = ReplayBuffer(
+        MemmapStorage(n, scratch_dir=scratch_dir),
+        sampler or RandomSampler(),
+        RoundRobinWriter(),
+        batch_size=batch_size,
+    )
+    state = rb.init(items[0])
+    state = rb.extend(state, items)
+    rb.writer = ImmutableDatasetWriter()
+    return rb, state
+
+
+class _OfflineDataset:
+    """Shared sample() surface of the offline loaders."""
+
+    def sample(self, key, batch_size: int | None = None):
+        batch, state = self.buffer.sample(self.state, key, batch_size)
+        self.state = state
+        return batch
+
+
+class MinariH5Dataset(_OfflineDataset):
     """Load a Minari ``main_data.hdf5`` file into a replay buffer.
 
     Args:
@@ -181,17 +206,10 @@ class MinariH5Dataset:
         self.n_episodes = len(rows)
         self.n_steps = int(flat["episode"].shape[0])
 
-        storage = MemmapStorage(self.n_steps, scratch_dir=scratch_dir)
-        rb = ReplayBuffer(
-            storage,
-            sampler or RandomSampler(),
-            RoundRobinWriter(),
-            batch_size=batch_size,
+        self.buffer, self.state = _sealed_buffer(
+            flat, self.n_steps, sampler=sampler, batch_size=batch_size,
+            scratch_dir=scratch_dir,
         )
-        state = rb.init(flat[0])
-        state = rb.extend(state, flat)
-        rb.writer = ImmutableDatasetWriter()
-        self.buffer, self.state = rb, state
 
         self.trajectories = None
         if split_trajs:
@@ -219,11 +237,6 @@ class MinariH5Dataset:
             self.trajectories = jax.tree.map(
                 lambda *xs: jnp.stack(xs, axis=0), *padded
             )
-
-    def sample(self, key, batch_size: int | None = None):
-        batch, state = self.buffer.sample(self.state, key, batch_size)
-        self.state = state
-        return batch
 
 
 def atari_name_to_key(name: str) -> tuple:
@@ -258,7 +271,7 @@ class _ShiftedNextObsStorage(MemmapStorage):
         )
 
 
-class AtariDQNDataset:
+class AtariDQNDataset(_OfflineDataset):
     """Load one run of DQN-Replay-format shards from a directory.
 
     Expects the reference's file naming (atari_dqn.py:608):
@@ -370,7 +383,120 @@ class AtariDQNDataset:
         rb.writer = ImmutableDatasetWriter()
         self.buffer, self.state = rb, state
 
-    def sample(self, key, batch_size: int | None = None):
-        batch, state = self.buffer.sample(self.state, key, batch_size)
-        self.state = state
-        return batch
+
+# reference lerobot.py:39 _DEFAULT_KEY_MAP
+_LEROBOT_KEY_MAP = {
+    "action": ("action",),
+    "observation.state": ("observation", "state"),
+    "episode_index": ("episode",),
+    "frame_index": ("frame",),
+    "task": ("language_instruction",),
+    "next.reward": ("next", "reward"),
+    "next.done": ("next", "done"),
+}
+_LEROBOT_IMAGE_PREFIX = "observation.images."
+
+
+def lerobot_key(name: str) -> tuple:
+    """LeRobot column name -> framework nested key (reference
+    lerobot.py:52 ``_map_lerobot_key``): the canonical map, the camera
+    prefix rule, else dotted-name splitting."""
+    if name in _LEROBOT_KEY_MAP:
+        return _LEROBOT_KEY_MAP[name]
+    if name.startswith(_LEROBOT_IMAGE_PREFIX):
+        return ("observation", "image", name[len(_LEROBOT_IMAGE_PREFIX):])
+    return tuple(name.split(".")) if "." in name else (name,)
+
+
+class LeRobotDataset(_OfflineDataset):
+    """Direct reader for the LeRobot v2.x on-disk layout (reference
+    torchrl/data/datasets/lerobot.py ``_LeRobotSnapshot``/
+    ``LeRobotExperienceReplay`` — no `datasets` library needed, pyarrow
+    reads the parquets):
+
+    - ``meta/info.json`` — fps + feature schema facts;
+    - ``meta/episodes.jsonl`` — per-episode lengths/tasks;
+    - ``meta/tasks.jsonl`` — task_index -> instruction strings;
+    - ``data/**/episode_*.parquet`` (or chunked files) — the frames, with
+      the reference's column conventions (``observation.state``,
+      ``action``, ``episode_index``, ``frame_index``, ``task_index``,
+      optional ``next.reward``/``next.done``).
+
+    Frames reassemble into the framework replay layout; ``task_index``
+    resolves to the instruction string list (host-side). Videos are out
+    of scope (zero-egress image has no clips; VideoCodecStorage covers
+    the decode path).
+    """
+
+    def __init__(
+        self,
+        root: str | Path,
+        *,
+        batch_size: int | None = 256,
+        sampler=None,
+        scratch_dir: str | None = None,
+    ):
+        import json
+
+        import pyarrow.parquet as pq
+
+        root = Path(root)
+        with open(root / "meta" / "info.json") as f:
+            self.info = json.load(f)
+        tasks: dict[int, str] = {}
+        tasks_path = root / "meta" / "tasks.jsonl"
+        if tasks_path.exists():
+            for line in tasks_path.read_text().splitlines():
+                if line.strip():
+                    row = json.loads(line)
+                    tasks[int(row["task_index"])] = row["task"]
+        self.tasks = tasks
+        self.episodes_meta = []
+        ep_path = root / "meta" / "episodes.jsonl"
+        if ep_path.exists():
+            for line in ep_path.read_text().splitlines():
+                if line.strip():
+                    self.episodes_meta.append(json.loads(line))
+
+        files = sorted((root / "data").rglob("*.parquet"))
+        if not files:
+            raise ValueError(f"no data parquet files under {root / 'data'}")
+        tables = [pq.read_table(str(p)) for p in files]
+        cols: dict[str, np.ndarray] = {}
+        for name in tables[0].column_names:
+            parts = [t.column(name).to_numpy(zero_copy_only=False) for t in tables]
+            arr = np.concatenate(parts)
+            if arr.dtype == object:  # list-typed columns (state/action vecs)
+                arr = np.stack([np.asarray(x) for x in arr])
+            cols[name] = arr
+        n = len(next(iter(cols.values())))
+        self.n_steps = n
+
+        td = ArrayDict()
+        for name, arr in cols.items():
+            if name == "task_index":
+                idx = arr.astype(np.int64)
+                self.instructions = [tasks.get(int(i), "") for i in idx]
+                td = td.set(("task_index",), idx.astype(np.int32))
+                continue
+            if name in ("index", "timestamp"):
+                td = td.set((name,), arr)
+                continue
+            key = lerobot_key(name)
+            if arr.dtype == np.float64:
+                arr = arr.astype(np.float32)
+            td = td.set(key, arr)
+
+        # episode boundaries: the reference derives done from episode_index
+        # changes when next.done is absent
+        if ("next", "done") not in td and ("episode",) in td:
+            ep = np.asarray(td[("episode",)])
+            done = np.zeros(n, bool)
+            done[:-1] = ep[:-1] != ep[1:]
+            done[-1] = True
+            td = td.set(("next", "done"), done)
+
+        self.buffer, self.state = _sealed_buffer(
+            td, n, sampler=sampler, batch_size=batch_size,
+            scratch_dir=scratch_dir,
+        )

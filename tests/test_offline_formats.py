@@ -258,3 +258,97 @@ class TestOfflineToOnline:
             max_steps=8,
         )
         assert np.isfinite(np.asarray(b["next", "reward"])).all()
+
+
+def write_lerobot_fixture(root, episodes=((5, 0), (3, 1)), state_dim=4, act_dim=2):
+    """Write the exact LeRobot v2.x layout: meta/info.json,
+    meta/episodes.jsonl, meta/tasks.jsonl, data/chunk-000/*.parquet."""
+    import json
+
+    import pandas as pd
+
+    root = os.fspath(root)
+    os.makedirs(os.path.join(root, "meta"), exist_ok=True)
+    os.makedirs(os.path.join(root, "data", "chunk-000"), exist_ok=True)
+    with open(os.path.join(root, "meta", "info.json"), "w") as f:
+        json.dump({"fps": 30, "codebase_version": "v2.1",
+                   "total_episodes": len(episodes)}, f)
+    with open(os.path.join(root, "meta", "tasks.jsonl"), "w") as f:
+        f.write(json.dumps({"task_index": 0, "task": "pick the cube"}) + "\n")
+        f.write(json.dumps({"task_index": 1, "task": "open the drawer"}) + "\n")
+    idx = 0
+    with open(os.path.join(root, "meta", "episodes.jsonl"), "w") as f:
+        for e, (T, task) in enumerate(episodes):
+            f.write(json.dumps({"episode_index": e, "length": T,
+                                "tasks": [task]}) + "\n")
+    for e, (T, task) in enumerate(episodes):
+        rows = {
+            "observation.state": [
+                (np.arange(state_dim) + e * 100 + t).astype(np.float32)
+                for t in range(T)
+            ],
+            "action": [np.full(act_dim, 0.1 * t, np.float32) for t in range(T)],
+            "episode_index": np.full(T, e, np.int64),
+            "frame_index": np.arange(T, dtype=np.int64),
+            "task_index": np.full(T, task, np.int64),
+            "timestamp": np.arange(T, dtype=np.float64) / 30.0,
+            "index": np.arange(idx, idx + T, dtype=np.int64),
+        }
+        idx += T
+        pd.DataFrame(rows).to_parquet(
+            os.path.join(root, "data", "chunk-000", f"episode_{e:06d}.parquet")
+        )
+
+
+class TestLeRobot:
+    def test_format_reassembly(self, tmp_path):
+        from rl_tpu.data import LeRobotDataset
+
+        write_lerobot_fixture(tmp_path / "ds")
+        ds = LeRobotDataset(tmp_path / "ds", scratch_dir=str(tmp_path / "mm"))
+        assert ds.n_steps == 8
+        assert ds.info["fps"] == 30
+        data = ds.buffer.storage.get(ds.state["storage"], np.arange(8))
+        st = np.asarray(data["observation", "state"])
+        assert st.shape == (8, 4) and st.dtype == np.float32
+        # episode 1 rows offset by +100 (fixture pattern)
+        np.testing.assert_allclose(st[5, 0], 100.0)
+        np.testing.assert_array_equal(
+            np.asarray(data["episode"]), [0] * 5 + [1] * 3
+        )
+        # derived done at episode boundaries (no next.done column)
+        np.testing.assert_array_equal(
+            np.asarray(data["next", "done"]),
+            [0, 0, 0, 0, 1, 0, 0, 1],
+        )
+
+    def test_task_resolution(self, tmp_path):
+        from rl_tpu.data import LeRobotDataset
+
+        write_lerobot_fixture(tmp_path / "ds")
+        ds = LeRobotDataset(tmp_path / "ds", scratch_dir=str(tmp_path / "mm"))
+        assert ds.instructions[0] == "pick the cube"
+        assert ds.instructions[-1] == "open the drawer"
+
+    def test_key_map(self):
+        from rl_tpu.data.offline import lerobot_key
+
+        assert lerobot_key("observation.state") == ("observation", "state")
+        assert lerobot_key("observation.images.wrist") == ("observation", "image", "wrist")
+        assert lerobot_key("next.reward") == ("next", "reward")
+        assert lerobot_key("custom.nested.key") == ("custom", "nested", "key")
+
+    def test_sampling_and_chunking(self, tmp_path):
+        from rl_tpu.data import AddActionChunks, LeRobotDataset
+
+        write_lerobot_fixture(tmp_path / "ds", episodes=((8, 0),))
+        ds = LeRobotDataset(tmp_path / "ds", batch_size=4,
+                            scratch_dir=str(tmp_path / "mm"))
+        batch = ds.sample(KEY)
+        assert batch["observation", "state"].shape == (4, 4)
+        # the VLA chunking transform consumes the loaded trajectory
+        data = ds.buffer.storage.get(ds.state["storage"], np.arange(8))
+        td = AddActionChunks(chunk=3)(
+            ArrayDict(action=jnp.asarray(np.asarray(data["action"]))[None])
+        )
+        assert td["vla_action", "chunk"].shape == (1, 8, 3, 2)
