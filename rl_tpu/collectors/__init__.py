@@ -7,9 +7,11 @@ backend was unreachable — importing *anything* must not import *everything*.
 """
 
 from .host import HostCollector, ProcessEnvPool, ThreadedEnvPool, compact_collected
+from .distributed import MeshCollector
 from .single import Collector, CollectorState
 
 __all__ = [
+    "MeshCollector",
     "Collector",
     "CollectorState",
     "HostCollector",

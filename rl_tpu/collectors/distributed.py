@@ -1,0 +1,103 @@
+"""Multi-process distributed collection over one global mesh.
+
+Redesign of the reference's distributed collectors (reference:
+torchrl/collectors/distributed/ — ``DistributedDataCollector`` generic.py,
+``RPCDataCollector``, ``DistributedSyncDataCollector``, ``RayCollector``:
+worker processes run collectors and ship batches to a trainer over
+NCCL/RPC/Ray). The TPU-native inversion: every process runs the SAME
+program under ``jax.distributed`` on one global ``Mesh``; each process
+collects its own env shard with a local in-jit :class:`Collector`, the
+shards are assembled into ONE globally-sharded batch
+(``jax.make_array_from_process_local_data``), and the learner's jitted
+update consumes it directly — the gradient all-reduce over ICI/DCN is
+inserted by XLA, not hand-written NCCL. Verified end-to-end by the
+two-process Gloo test (tests/dist_worker.py phase 2).
+
+Control-plane services (weight broadcast to non-SPMD actors, remote
+replay) stay on the TCP stack (rl_tpu.comm); THIS module is the SPMD data
+plane.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import numpy as np
+
+import jax
+
+from ..data import ArrayDict
+from .single import Collector
+
+__all__ = ["MeshCollector"]
+
+
+class MeshCollector:
+    """Per-process wrapper: local in-jit collection -> global sharded batch.
+
+    Every process constructs the same MeshCollector (same arguments) after
+    ``jax.distributed.initialize`` / ``JaxDistributedRendezvous``. The
+    env is the LOCAL shard (its batch size is this process's share);
+    :meth:`collect` returns a batch whose leading axis is globally sharded
+    over ``axis`` — feed it straight to a jitted/sharded train step.
+
+    Args:
+        env: this process's env shard (VmapEnv over local envs).
+        policy: ``(params, td, key) -> td`` — same tree on every process
+            (replicate params over the mesh).
+        frames_per_batch: frames contributed PER PROCESS per collect.
+        mesh: the global ``jax.sharding.Mesh`` (built from
+            ``jax.devices()``, which spans all processes).
+        axis: mesh axis name the batch shards over. Default "dp".
+        flatten: flatten [T, N_local] time/env dims into one leading axis
+            before assembly (the global batch is then [world*T*N, ...]).
+            Set False to keep [T, N] and shard over envs (N must then be
+            the per-process size of a mesh-divisible global dim).
+    """
+
+    def __init__(
+        self,
+        env: Any,
+        policy: Callable,
+        frames_per_batch: int,
+        mesh: Any,
+        axis: str = "dp",
+        flatten: bool = True,
+        postproc: Callable | None = None,
+    ):
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        self.local = Collector(
+            env, policy, frames_per_batch=frames_per_batch, postproc=postproc
+        )
+        self.mesh = mesh
+        self.axis = axis
+        self.flatten = flatten
+        # flattened batches shard their single leading axis; [T, N] batches
+        # shard the ENV axis (dim 1) — time must never interleave across
+        # processes (n-step/GAE/sequence consumers read dim 0 as time)
+        self._shard = NamedSharding(
+            mesh, PartitionSpec(axis) if flatten else PartitionSpec(None, axis)
+        )
+        self.frames_per_batch = frames_per_batch * jax.process_count()
+        self._collect = jax.jit(self.local.collect)
+
+    def init(self, key: jax.Array) -> ArrayDict:
+        """Local collector state; fold the process index into the key so
+        shards explore independently."""
+        return self.local.init(jax.random.fold_in(key, jax.process_index()))
+
+    def collect(self, params: Any, cstate: ArrayDict):
+        """One global batch. Returns ``(batch, cstate)`` where every leaf
+        of ``batch`` is a globally-sharded jax.Array ([world * local_rows,
+        ...] when ``flatten``)."""
+        batch, cstate = self._collect(params, cstate)
+        if self.flatten:
+            batch = batch.flatten_batch()
+
+        def assemble(x):
+            return jax.make_array_from_process_local_data(
+                self._shard, np.asarray(x)
+            )
+
+        return jax.tree.map(assemble, batch), cstate

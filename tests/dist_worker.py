@@ -111,35 +111,40 @@ def main() -> int:
     # process boundary + per-process env-shard collection into one learner)
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-    from rl_tpu.collectors import Collector
     from rl_tpu.envs import VmapEnv
     from rl_tpu.testing import CountingEnv
 
     assert len(jax.devices()) == world  # 2 procs x 1 local device
     mesh = Mesh(np.array(jax.devices()), ("dp",))
-    dp = NamedSharding(mesh, P("dp"))
     repl = NamedSharding(mesh, P())
 
-    # each process collects ITS OWN env shard with a local collector
+    # each process collects ITS OWN env shard through the first-class API
+    from rl_tpu.collectors import MeshCollector
+
     n_envs, T = 4, 8
     env = VmapEnv(CountingEnv(max_count=100), n_envs)
-    coll = Collector(
+    coll = MeshCollector(
         env,
         lambda p, td, k: td.set(
             "action", jnp.zeros(td["done"].shape, jnp.int32)
         ),
         frames_per_batch=n_envs * T,
+        mesh=mesh,
+        axis="dp",
     )
-    cstate = coll.init(jax.random.key(100 + rank))
-    batch, cstate = jax.jit(coll.collect)(None, cstate)
-    # local shard [T, n_envs]: flatten and keep (obs, reward) for the learner
-    obs_local = np.asarray(batch["observation"]).reshape(-1, 1)
-    rew_local = np.asarray(batch["next", "reward"]).reshape(-1)
-
-    # assemble the global batch: every process contributes its shard along dp
-    g_obs = jax.make_array_from_process_local_data(dp, obs_local)
-    g_rew = jax.make_array_from_process_local_data(dp, rew_local)
+    assert coll.frames_per_batch == world * n_envs * T
+    cstate = coll.init(jax.random.key(100))
+    gbatch, cstate = coll.collect(None, cstate)
+    g_obs = gbatch["observation"].reshape(-1, 1)
+    g_rew = gbatch["next", "reward"].reshape(-1)
     assert g_obs.shape == (world * n_envs * T, 1)
+    # local shard view for the oracle below
+    obs_local = np.asarray(
+        [s.data for s in g_obs.addressable_shards][0]
+    ).reshape(-1, 1)
+    rew_local = np.asarray(
+        [s.data for s in g_rew.addressable_shards][0]
+    ).reshape(-1)
 
     # one jitted DP train step over the global mesh: the mean-loss gradient
     # reduction IS the cross-process psum (inserted by XLA over Gloo)
