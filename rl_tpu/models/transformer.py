@@ -47,6 +47,13 @@ class TransformerConfig:
     flash_interpret: bool = False  # pallas interpret mode (CPU testing)
     mesh: Any = None  # required for "ring"
     context_axis: str = "context"
+    # Mixture-of-Experts FFN (0 = dense FFN). Experts shard over the
+    # "expert" mesh axis via param_sharding_rules; rl_tpu.parallel.moe
+    # holds the explicit all_to_all EP path + the dense oracle this
+    # in-model formulation matches.
+    moe_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
 
     @property
     def head_dim(self) -> int:
@@ -160,6 +167,45 @@ class _Attention(nn.Module):
         return o, new_cache
 
 
+class _MoEFFN(nn.Module):
+    """Switch/Mixtral-style MoE FFN (the §2.13 EP slot — beyond the
+    reference, which has no expert parallelism).
+
+    The dense-einsum formulation from rl_tpu.parallel.moe: with w1/w2
+    sharded over the "expert" mesh axis (param_sharding_rules), GSPMD
+    partitions the expert einsums and inserts the dispatch/combine
+    collectives — the in-model EP path; parallel.moe.moe_ffn_ep is the
+    explicit shard_map+all_to_all equivalent (oracle-tested identical).
+    """
+
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, y):
+        from ..parallel.moe import moe_ffn_dense, moe_param_specs
+
+        cfg = self.cfg
+        specs = moe_param_specs(cfg.d_model, cfg.d_ff, cfg.moe_experts)
+        params = {
+            name: self.param(
+                name, nn.initializers.normal(std), shape, jnp.float32
+            ).astype(cfg.dtype)
+            for name, (shape, std) in specs.items()
+        }
+        B, T, d = y.shape
+        n = B * T
+        flat = y.reshape(-1, d).astype(cfg.dtype)
+        # decode steps (T=1) route with FULL capacity: a capacity drop
+        # there would make a sequence's tokens depend on which other
+        # requests share the batch (per-request determinism)
+        capacity = n if T == 1 else None
+        out = moe_ffn_dense(
+            params, flat, cfg.moe_top_k, cfg.moe_capacity_factor,
+            capacity=capacity,
+        )
+        return out.reshape(B, T, d).astype(cfg.dtype)
+
+
 class _Block(nn.Module):
     cfg: TransformerConfig
 
@@ -171,9 +217,12 @@ class _Block(nn.Module):
         )
         x = x + h
         y = nn.LayerNorm(dtype=cfg.dtype, name="ln2")(x)
-        y = nn.Dense(cfg.d_ff, dtype=cfg.dtype, name="up")(y)
-        y = nn.gelu(y)
-        y = nn.Dense(cfg.d_model, dtype=cfg.dtype, name="down")(y)
+        if cfg.moe_experts:
+            y = _MoEFFN(cfg, name="moe")(y)
+        else:
+            y = nn.Dense(cfg.d_ff, dtype=cfg.dtype, name="up")(y)
+            y = nn.gelu(y)
+            y = nn.Dense(cfg.d_model, dtype=cfg.dtype, name="down")(y)
         return x + y, new_cache
 
 
@@ -220,7 +269,7 @@ class TransformerLM(nn.Module):
         ]
 
 
-def param_sharding_rules(params, model_axis: str = "model"):
+def param_sharding_rules(params, model_axis: str = "model", expert_axis: str = "expert"):
     """Megatron-style PartitionSpecs for TransformerLM params.
 
     Column-parallel (split output features over ``model_axis``): attention
@@ -232,6 +281,12 @@ def param_sharding_rules(params, model_axis: str = "model"):
     def rule(path: tuple, x) -> P:
         names = [getattr(p, "key", str(p)) for p in path]
         joined = "/".join(names)
+        if "/moe/" in f"/{joined}/":
+            if "w1" in names:  # [E, d_model, d_ff]: EP x TP
+                return P(expert_axis, None, model_axis)
+            if "w2" in names:  # [E, d_ff, d_model]
+                return P(expert_axis, model_axis, None)
+            return P()  # router [d, E]: tiny, replicated
         if x.ndim < 2:
             return P()  # biases, norms
         if (

@@ -9,11 +9,16 @@ from .mesh import (
     shard_train_state,
     sharded,
 )
+from .moe import init_moe_params, moe_dispatch, moe_ffn_dense, moe_ffn_ep
 from .pipeline import AXIS_PIPE, pipe_mesh, pipeline_apply, stack_stage_params
 from .ring_attention import attention_reference, ring_attention
 from .ulysses import ulysses_attention
 
 __all__ = [
+    "init_moe_params",
+    "moe_dispatch",
+    "moe_ffn_dense",
+    "moe_ffn_ep",
     "AXIS_DATA",
     "AXIS_MODEL",
     "AXIS_CONTEXT",

@@ -1,0 +1,200 @@
+"""Mixture-of-Experts + expert parallelism (§2.13 EP — the one parallelism
+slot the reference lacks; oracle strategy mirrors the ring-attention
+tests: explicit-collective path vs dense single-device reference)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from rl_tpu.parallel.moe import (
+    init_moe_params,
+    moe_dispatch,
+    moe_ffn_dense,
+    moe_ffn_ep,
+)
+
+KEY = jax.random.key(0)
+
+
+class TestDispatch:
+    def test_topk_assignment_and_gates(self):
+        logits = jnp.asarray([[5.0, 0.0, -5.0], [0.0, 5.0, 4.0]])
+        dispatch, combine = moe_dispatch(logits, top_k=2, capacity=2)
+        d = np.asarray(dispatch)
+        # token 0 -> experts 0,1; token 1 -> experts 1,2
+        assert d[0, 0].sum() == 1 and d[0, 1].sum() == 1 and d[0, 2].sum() == 0
+        assert d[1, 1].sum() == 1 and d[1, 2].sum() == 1
+        c = np.asarray(combine)
+        # combine weights renormalize over the top-k
+        np.testing.assert_allclose(c[0].sum(), 1.0, rtol=1e-5)
+        np.testing.assert_allclose(c[1].sum(), 1.0, rtol=1e-5)
+        # token 0's expert-0 gate dominates its expert-1 gate
+        assert c[0, 0].sum() > c[0, 1].sum()
+
+    def test_capacity_drops_overflow_first_choices_win(self):
+        # 4 tokens all best at expert 0, capacity 2: exactly 2 first
+        # choices keep their slot, the rest lose that expert
+        logits = jnp.tile(jnp.asarray([[9.0, 1.0]]), (4, 1))
+        dispatch, _ = moe_dispatch(logits, top_k=1, capacity=2)
+        d = np.asarray(dispatch)
+        assert d[:, 0].sum() == 2
+        # slots are distinct
+        assert d[:2, 0].sum(0).max() == 1
+
+    def test_unique_slots_per_expert(self):
+        logits = jax.random.normal(KEY, (64, 8))
+        dispatch, _ = moe_dispatch(logits, top_k=2, capacity=16)
+        per_slot = np.asarray(dispatch).sum(0)  # [E, C]
+        assert per_slot.max() <= 1  # no two tokens share a slot
+
+
+class TestDenseMoE:
+    def test_matches_per_token_oracle(self):
+        """Dense MoE == explicit per-token loop over top-k experts (no
+        capacity pressure)."""
+        p = init_moe_params(KEY, 8, 16, 4)
+        x = jax.random.normal(jax.random.key(1), (16, 8))
+        y = moe_ffn_dense(p, x, top_k=2, capacity_factor=8.0)
+        logits = x @ p["router"]
+        gates = jax.nn.softmax(logits, -1)
+        topv, topi = jax.lax.top_k(gates, 2)
+        topv = topv / topv.sum(-1, keepdims=True)
+        ref = np.zeros((16, 8), np.float32)
+        for t in range(16):
+            for j in range(2):
+                e = int(topi[t, j])
+                h = jax.nn.gelu(x[t] @ p["w1"][e])
+                ref[t] += float(topv[t, j]) * np.asarray(h @ p["w2"][e])
+        np.testing.assert_allclose(np.asarray(y), ref, atol=1e-4)
+
+    def test_grads_flow_to_all_parts(self):
+        p = init_moe_params(KEY, 8, 16, 4)
+        x = jax.random.normal(jax.random.key(2), (32, 8))
+        g = jax.grad(lambda p: moe_ffn_dense(p, x).sum())(p)
+        for k in ("router", "w1", "w2"):
+            assert float(jnp.abs(g[k]).max()) > 0, k
+
+
+@pytest.mark.mesh
+class TestExpertParallel:
+    def _mesh(self, ep):
+        from jax.sharding import Mesh
+
+        devs = np.array(jax.devices()[: ep * 2]).reshape(1, ep, 2)
+        return Mesh(devs, ("data", "expert", "model"))
+
+    @pytest.mark.parametrize("ep", [2, 4])
+    def test_ep_matches_per_shard_dense_oracle(self, mesh8, ep):
+        mesh = self._mesh(ep)
+        p = init_moe_params(KEY, 16, 32, 8)
+        x = jax.random.normal(jax.random.key(1), (64, 16))
+        y_ep = moe_ffn_ep(p, x, mesh, top_k=2, capacity_factor=2.0)
+        nl = 64 // ep
+        # per-shard dense oracle: EP routes each token shard independently
+        # with the per-shard capacity — identical math, zero tolerance
+        y_ref = jnp.concatenate(
+            [
+                moe_ffn_dense(p, x[i * nl : (i + 1) * nl], 2, 2.0)
+                for i in range(ep)
+            ]
+        )
+        np.testing.assert_allclose(
+            np.asarray(y_ep), np.asarray(y_ref), atol=1e-5
+        )
+
+    def test_ep_grads_match_oracle(self, mesh8):
+        mesh = self._mesh(2)
+        p = init_moe_params(KEY, 8, 16, 4)
+        x = jax.random.normal(jax.random.key(3), (16, 8))
+        g_ep = jax.grad(lambda p: moe_ffn_ep(p, x, mesh, 2, 2.0).sum())(p)
+        nl = 8
+
+        def ref_loss(p):
+            return sum(
+                moe_ffn_dense(p, x[i * nl : (i + 1) * nl], 2, 2.0).sum()
+                for i in range(2)
+            )
+
+        g_ref = jax.grad(ref_loss)(p)
+        for k in g_ep:
+            np.testing.assert_allclose(
+                np.asarray(g_ep[k]), np.asarray(g_ref[k]), atol=1e-4
+            )
+
+    def test_validation(self, mesh8):
+        mesh = self._mesh(4)
+        p = init_moe_params(KEY, 8, 16, 6)  # 6 experts, ep=4: no divide
+        with pytest.raises(ValueError, match="divide"):
+            moe_ffn_ep(p, jnp.zeros((16, 8)), mesh)
+
+
+@pytest.mark.mesh
+class TestMoETransformer:
+    def test_epxtp_sharded_forward_matches_local(self, mesh8):
+        from jax.sharding import NamedSharding
+
+        from rl_tpu.models import (
+            TransformerConfig,
+            TransformerLM,
+            param_sharding_rules,
+        )
+        from rl_tpu.parallel import make_mesh
+
+        cfg = TransformerConfig(
+            vocab_size=64, d_model=32, n_layers=2, n_heads=4, d_ff=64,
+            max_seq_len=32, dtype=jnp.float32, moe_experts=4,
+        )
+        lm = TransformerLM(cfg)
+        toks = jax.random.randint(KEY, (4, 16), 0, 64)
+        p = lm.init(jax.random.key(0), toks)["params"]
+        mesh = make_mesh(data=2, expert=2, model=2)
+        rules = param_sharding_rules(p)
+        # the MoE params actually got expert-axis placements
+        assert rules["h0"]["moe"]["w1"] == __import__("jax").sharding.PartitionSpec(
+            "expert", None, "model"
+        )
+        sharded = jax.tree.map(
+            lambda x, sp: jax.device_put(x, NamedSharding(mesh, sp)), p, rules
+        )
+        with mesh:
+            logits = jax.jit(lambda p, t: lm.apply({"params": p}, t))(sharded, toks)
+            jax.block_until_ready(logits)
+        local = lm.apply({"params": p}, toks)
+        np.testing.assert_allclose(
+            np.asarray(logits), np.asarray(local), atol=2e-3
+        )
+
+    def test_moe_lm_trains(self, mesh8):
+        import optax
+
+        from rl_tpu.models import TransformerConfig, TransformerLM
+
+        cfg = TransformerConfig(
+            vocab_size=32, d_model=32, n_layers=1, n_heads=4, d_ff=64,
+            max_seq_len=16, dtype=jnp.float32, moe_experts=4,
+        )
+        lm = TransformerLM(cfg)
+        toks = jax.random.randint(KEY, (8, 12), 0, 32)
+        p = lm.init(jax.random.key(0), toks)["params"]
+
+        def loss(p):
+            logits = lm.apply({"params": p}, toks)
+            lp = jax.nn.log_softmax(logits[:, :-1])
+            tgt = jax.nn.one_hot(toks[:, 1:], 32)
+            return -(lp * tgt).sum(-1).mean()
+
+        opt = optax.adam(3e-3)
+        ost = opt.init(p)
+
+        @jax.jit
+        def step(p, ost):
+            v, g = jax.value_and_grad(loss)(p)
+            upd, ost = opt.update(g, ost)
+            return optax.apply_updates(p, upd), ost, v
+
+        vals = []
+        for _ in range(40):
+            p, ost, v = step(p, ost)
+            vals.append(float(v))
+        assert vals[-1] < vals[0] * 0.8, (vals[0], vals[-1])
