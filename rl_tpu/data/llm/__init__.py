@@ -1,4 +1,5 @@
 from .history import History, Message
+from .preference import PairwiseDataset, RewardData
 from .tokenizer import SimpleTokenizer
 
-__all__ = ["History", "Message", "SimpleTokenizer"]
+__all__ = ["History", "Message", "PairwiseDataset", "RewardData", "SimpleTokenizer"]

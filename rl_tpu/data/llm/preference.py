@@ -1,0 +1,105 @@
+"""Pairwise preference data for reward-model training.
+
+Redesign of the reference's RLHF reward layer (reference:
+torchrl/data/llm/reward.py — ``RewardData``:19 token/mask/(reward,
+end_scores) container; ``PairwiseDataset``:29 chosen/rejected pair
+memmaps built from the hub CarperAI comparison set). Zero-egress form:
+pairs are built locally from (prompt, chosen, rejected) text triples with
+any tokenizer exposing ``encode``; arrays are dense [n, L] with padding
+masks — the layout a Bradley-Terry reward model consumes
+(:class:`rl_tpu.objectives.PairwiseRewardLoss`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Sequence
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..arraydict import ArrayDict
+
+__all__ = ["RewardData", "PairwiseDataset"]
+
+
+@dataclasses.dataclass
+class RewardData:
+    """Token batch for one side of the comparison (reference reward.py:19):
+    ``input_ids``/``attention_mask`` [n, L]; ``rewards``/``end_scores``
+    are filled by the reward model at scoring time."""
+
+    input_ids: Any
+    attention_mask: Any
+    rewards: Any | None = None
+    end_scores: Any | None = None
+
+    @property
+    def batch(self) -> ArrayDict:
+        return ArrayDict(
+            input_ids=self.input_ids, attention_mask=self.attention_mask
+        )
+
+
+def _encode_block(tokenizer, prompts, responses, max_length: int):
+    """Tokenize prompt+response with RESPONSE-preserving truncation: an
+    over-long prompt is cut from the LEFT so the response (the part that
+    differs between chosen and rejected) always survives — joint tail
+    truncation would make both sides of a long pair byte-identical and
+    silently zero their gradient."""
+    ids = np.zeros((len(prompts), max_length), np.int32)
+    mask = np.zeros((len(prompts), max_length), np.float32)
+    for i, (p, r) in enumerate(zip(prompts, responses)):
+        ptoks = list(tokenizer.encode(p))
+        rtoks = list(tokenizer.encode(r))[:max_length]
+        keep_p = max(0, max_length - len(rtoks))
+        toks = ptoks[len(ptoks) - keep_p :] + rtoks if keep_p else rtoks
+        ids[i, : len(toks)] = toks
+        mask[i, : len(toks)] = 1.0
+    return jnp.asarray(ids), jnp.asarray(mask)
+
+
+@dataclasses.dataclass
+class PairwiseDataset:
+    """Chosen/rejected comparison pairs (reference reward.py:29).
+
+    Build with :meth:`from_pairs`; feed ``chosen_data``/``rejected_data``
+    through a reward model and train with
+    :class:`rl_tpu.objectives.PairwiseRewardLoss` (Bradley-Terry).
+    """
+
+    chosen_data: RewardData
+    rejected_data: RewardData
+
+    @classmethod
+    def from_pairs(
+        cls,
+        tokenizer,
+        pairs: Sequence[tuple[str, str, str]],
+        max_length: int = 256,
+    ) -> "PairwiseDataset":
+        """``pairs`` = (prompt, chosen_response, rejected_response) text
+        triples; both sides tokenize as prompt+response (the reference's
+        comparison layout)."""
+        prompts = [p for p, _, _ in pairs]
+        cids, cmask = _encode_block(
+            tokenizer, prompts, [c for _, c, _ in pairs], max_length
+        )
+        rids, rmask = _encode_block(
+            tokenizer, prompts, [r for _, _, r in pairs], max_length
+        )
+        return cls(
+            chosen_data=RewardData(cids, cmask),
+            rejected_data=RewardData(rids, rmask),
+        )
+
+    @property
+    def batch(self) -> ArrayDict:
+        """One ArrayDict view: {chosen: {...}, rejected: {...}}."""
+        return ArrayDict(
+            chosen=self.chosen_data.batch, rejected=self.rejected_data.batch
+        )
+
+    def __len__(self) -> int:
+        return int(self.chosen_data.input_ids.shape[0])

@@ -181,7 +181,7 @@ class _MoEFFN(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, y):
+    def __call__(self, y, serving: bool = False):
         from ..parallel.moe import moe_ffn_dense, moe_param_specs
 
         cfg = self.cfg
@@ -195,10 +195,11 @@ class _MoEFFN(nn.Module):
         B, T, d = y.shape
         n = B * T
         flat = y.reshape(-1, d).astype(cfg.dtype)
-        # decode steps (T=1) route with FULL capacity: a capacity drop
-        # there would make a sequence's tokens depend on which other
-        # requests share the batch (per-request determinism)
-        capacity = n if T == 1 else None
+        # serving (cache live: prefill OR decode) routes with FULL
+        # capacity: any capacity drop would make one request's logits/KV
+        # depend on which other requests share the batch, and pad tokens
+        # could displace real ones (per-request determinism)
+        capacity = n if serving else None
         out = moe_ffn_dense(
             params, flat, cfg.moe_top_k, cfg.moe_capacity_factor,
             capacity=capacity,
@@ -218,7 +219,7 @@ class _Block(nn.Module):
         x = x + h
         y = nn.LayerNorm(dtype=cfg.dtype, name="ln2")(x)
         if cfg.moe_experts:
-            y = _MoEFFN(cfg, name="moe")(y)
+            y = _MoEFFN(cfg, name="moe")(y, serving=cache is not None)
         else:
             y = nn.Dense(cfg.d_ff, dtype=cfg.dtype, name="up")(y)
             y = nn.gelu(y)

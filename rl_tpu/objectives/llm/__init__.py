@@ -1,3 +1,5 @@
-from .grpo import CISPOLoss, DAPOLoss, GRPOLoss, SFTLoss, mc_advantage
+from .grpo import CISPOLoss, DAPOLoss, GRPOLoss, SFTLoss, mc_advantage, minor_sft_loss
+from .preference import DPOLoss, PairwiseRewardLoss
 
-__all__ = ["GRPOLoss", "DAPOLoss", "CISPOLoss", "SFTLoss", "mc_advantage"]
+__all__ = ["GRPOLoss", "DAPOLoss", "CISPOLoss", "DPOLoss", "PairwiseRewardLoss",
+           "SFTLoss", "mc_advantage", "minor_sft_loss"]

@@ -20,7 +20,8 @@ import jax.numpy as jnp
 from ...data import ArrayDict
 from ..common import LossModule
 
-__all__ = ["GRPOLoss", "DAPOLoss", "CISPOLoss", "SFTLoss", "mc_advantage"]
+__all__ = ["GRPOLoss", "DAPOLoss", "CISPOLoss", "SFTLoss", "mc_advantage",
+           "minor_sft_loss"]
 
 
 def _masked_token_mean(x, mask, per_seq_norm: bool = False):
@@ -160,21 +161,70 @@ def mc_advantage(
     return adv
 
 
+def minor_sft_loss(log_probs, ref_log_probs, beta: float):
+    """MinorSFT (reference sft.py:38; arXiv:2408.10642): a DPO-inspired,
+    less aggressive SFT — ``-logsigmoid(beta * (lp − ref_lp))`` over
+    per-sequence summed assistant log-probs. KL regularization to the
+    reference policy is implicit."""
+    return -jax.nn.log_sigmoid(beta * (log_probs - ref_log_probs))
+
+
 class SFTLoss(LossModule):
     """Supervised fine-tuning on assistant tokens (reference sft.py:104):
-    NLL of target tokens over the assistant span, optional label smoothing."""
+    NLL of target tokens over the assistant span; optional label
+    smoothing; optional KL-to-reference penalty (``kl_to_ref_coeff``,
+    reads per-token ``ref_log_probs`` from the batch); or the
+    ``loss_function="minor_sft"`` DPO-flavored variant (implicit KL)."""
 
-    def __init__(self, log_prob_fn, label_smoothing: float = 0.0, logits_fn=None):
+    def __init__(
+        self,
+        log_prob_fn,
+        label_smoothing: float = 0.0,
+        logits_fn=None,
+        loss_function: str = "sft",
+        beta: float = 0.1,
+        kl_to_ref_coeff: float | None = None,
+    ):
+        if loss_function not in ("sft", "minor_sft"):
+            raise ValueError(f"loss_function must be sft|minor_sft, got {loss_function!r}")
+        if loss_function == "minor_sft" and label_smoothing > 0.0:
+            raise ValueError(
+                "label_smoothing is not applicable to minor_sft (the loss "
+                "is a logistic over sequence log-ratios, not a token NLL)"
+            )
         self.log_prob_fn = log_prob_fn
         self.label_smoothing = label_smoothing
         self.logits_fn = logits_fn  # needed when label_smoothing > 0
+        self.loss_function = loss_function
+        self.beta = beta
+        # minor_sft's KL regularization is implicit (reference sft.py:291)
+        self.kl_to_ref_coeff = None if loss_function == "minor_sft" else kl_to_ref_coeff
 
     def init_params(self, key, td):
         raise NotImplementedError("SFTLoss wraps an externally-initialized model")
 
+    def _ref_log_probs(self, batch, mask):
+        if "ref_log_probs" not in batch:
+            raise ValueError(
+                "batch must carry 'ref_log_probs' (per-token reference "
+                "log-probs) for minor_sft / kl_to_ref_coeff"
+            )
+        return jnp.where(mask, batch["ref_log_probs"], 0.0)
+
     def __call__(self, params, batch: ArrayDict, key=None):
         mask = batch["assistant_mask"].astype(bool)
         log_prob = self.log_prob_fn(params, batch)
+        metrics = ArrayDict()
+        if self.loss_function == "minor_sft":
+            # SUMMED per-sequence log-probs — the reference/paper form
+            # (sft.py:38); beta hyperparameters transfer directly
+            lp_seq = jnp.sum(jnp.where(mask, log_prob, 0.0), axis=-1)
+            ref_seq = jnp.sum(self._ref_log_probs(batch, mask), axis=-1)
+            loss = jnp.mean(minor_sft_loss(lp_seq, ref_seq, self.beta))
+            return loss, ArrayDict(
+                loss=loss,
+                log_ratio=jax.lax.stop_gradient(jnp.mean(lp_seq - ref_seq)),
+            )
         nll = -_masked_token_mean(log_prob, mask)
         loss = nll
         if self.label_smoothing > 0.0:
@@ -185,4 +235,17 @@ class SFTLoss(LossModule):
             uniform = jnp.concatenate([jnp.zeros_like(uniform[:, :1]), uniform], axis=1)
             smooth = _masked_token_mean(uniform, mask)
             loss = (1.0 - self.label_smoothing) * nll + self.label_smoothing * smooth
-        return loss, ArrayDict(loss=loss, nll=jax.lax.stop_gradient(nll))
+        if self.kl_to_ref_coeff is not None:
+            # k3 KL estimator (Schulman): E[exp(d) - 1 - d], d = ref - lp.
+            # Nonnegative with a curvature-bearing gradient that actually
+            # pulls toward the reference — a plain E[lp - ref] penalty has
+            # a ref-independent gradient and only rescales the SFT step
+            d = self._ref_log_probs(batch, mask) - jnp.where(
+                mask, log_prob, 0.0
+            )
+            kl = _masked_token_mean(jnp.exp(d) - 1.0 - d, mask)
+            loss = loss + self.kl_to_ref_coeff * kl
+            metrics = metrics.set("kl_to_ref", jax.lax.stop_gradient(kl))
+        return loss, metrics.update(
+            ArrayDict(loss=loss, nll=jax.lax.stop_gradient(nll))
+        )

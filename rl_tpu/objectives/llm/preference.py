@@ -1,0 +1,96 @@
+"""Preference losses: Bradley-Terry reward modeling + DPO.
+
+The reference ships the pairwise DATA layer (torchrl/data/llm/reward.py)
+and trains reward models in its RLHF example; the Bradley-Terry loss here
+is that trainer's objective as a first-class LossModule, and
+:class:`DPOLoss` (Rafailov et al. 2023) completes the preference story —
+direct policy optimization from the same pairs, no reward model or RL
+loop. Both are pure jnp over the
+:class:`rl_tpu.data.PairwiseDataset` layout.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...data import ArrayDict
+from ..common import LossModule
+
+__all__ = ["PairwiseRewardLoss", "DPOLoss"]
+
+
+class PairwiseRewardLoss(LossModule):
+    """Bradley-Terry reward-model loss: ``-logsigmoid(r_chosen −
+    r_rejected)`` over end-of-sequence scores.
+
+    ``reward_fn(params, input_ids, attention_mask) -> [B]`` scores a
+    sequence (typically the LM trunk + a scalar head read at the last
+    real token). Metrics report pair accuracy and the score margin.
+    """
+
+    def __init__(self, reward_fn):
+        self.reward_fn = reward_fn
+
+    def init_params(self, key, td):
+        raise NotImplementedError("wraps an externally-initialized model")
+
+    def __call__(self, params, batch: ArrayDict, key=None):
+        rc = self.reward_fn(
+            params, batch["chosen", "input_ids"], batch["chosen", "attention_mask"]
+        )
+        rr = self.reward_fn(
+            params,
+            batch["rejected", "input_ids"],
+            batch["rejected", "attention_mask"],
+        )
+        margin = rc - rr
+        loss = -jnp.mean(jax.nn.log_sigmoid(margin))
+        return loss, ArrayDict(
+            loss=loss,
+            accuracy=jax.lax.stop_gradient((margin > 0).mean()),
+            margin=jax.lax.stop_gradient(margin.mean()),
+        )
+
+
+class DPOLoss(LossModule):
+    """Direct Preference Optimization (Rafailov et al. 2023):
+    ``-logsigmoid(beta * ((lp_c − ref_c) − (lp_r − ref_r)))`` over
+    per-sequence response log-probs.
+
+    ``log_prob_fn(params, input_ids, attention_mask) -> [B]`` returns the
+    SUMMED response log-prob; the frozen reference's values come in the
+    batch (``("chosen"/"rejected", "ref_log_prob")``), computed once.
+    """
+
+    def __init__(self, log_prob_fn, beta: float = 0.1):
+        self.log_prob_fn = log_prob_fn
+        self.beta = beta
+
+    def init_params(self, key, td):
+        raise NotImplementedError("wraps an externally-initialized model")
+
+    def __call__(self, params, batch: ArrayDict, key=None):
+        lp_c = self.log_prob_fn(
+            params, batch["chosen", "input_ids"], batch["chosen", "attention_mask"]
+        )
+        lp_r = self.log_prob_fn(
+            params,
+            batch["rejected", "input_ids"],
+            batch["rejected", "attention_mask"],
+        )
+        logits = (lp_c - batch["chosen", "ref_log_prob"]) - (
+            lp_r - batch["rejected", "ref_log_prob"]
+        )
+        loss = -jnp.mean(jax.nn.log_sigmoid(self.beta * logits))
+        # implicit-reward bookkeeping (the standard DPO diagnostics)
+        return loss, ArrayDict(
+            loss=loss,
+            accuracy=jax.lax.stop_gradient((logits > 0).mean()),
+            chosen_reward=jax.lax.stop_gradient(
+                self.beta * (lp_c - batch["chosen", "ref_log_prob"]).mean()
+            ),
+            rejected_reward=jax.lax.stop_gradient(
+                self.beta * (lp_r - batch["rejected", "ref_log_prob"]).mean()
+            ),
+        )
