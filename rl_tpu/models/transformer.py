@@ -195,6 +195,12 @@ class _MoEFFN(nn.Module):
         B, T, d = y.shape
         n = B * T
         flat = y.reshape(-1, d).astype(cfg.dtype)
+        # the ONE router projection: used for dispatch below and sown for
+        # the Switch aux loss (apply(..., mutable=["intermediates"]) then
+        # moe_load_balancing_loss over each router_logits entry, passing
+        # the flattened attention mask so pads don't count)
+        router_logits = flat @ params["router"]
+        self.sow("intermediates", "router_logits", router_logits)
         # serving (cache live: prefill OR decode) routes with FULL
         # capacity: any capacity drop would make one request's logits/KV
         # depend on which other requests share the batch, and pad tokens
@@ -202,7 +208,7 @@ class _MoEFFN(nn.Module):
         capacity = n if serving else None
         out = moe_ffn_dense(
             params, flat, cfg.moe_top_k, cfg.moe_capacity_factor,
-            capacity=capacity,
+            capacity=capacity, logits=router_logits,
         )
         return out.reshape(B, T, d).astype(cfg.dtype)
 

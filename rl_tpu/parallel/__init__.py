@@ -9,7 +9,8 @@ from .mesh import (
     shard_train_state,
     sharded,
 )
-from .moe import init_moe_params, moe_dispatch, moe_ffn_dense, moe_ffn_ep
+from .moe import (init_moe_params, moe_dispatch, moe_ffn_dense,
+                  moe_ffn_ep, moe_load_balancing_loss, moe_param_specs)
 from .pipeline import AXIS_PIPE, pipe_mesh, pipeline_apply, stack_stage_params
 from .ring_attention import attention_reference, ring_attention
 from .ulysses import ulysses_attention
@@ -19,6 +20,8 @@ __all__ = [
     "moe_dispatch",
     "moe_ffn_dense",
     "moe_ffn_ep",
+    "moe_load_balancing_loss",
+    "moe_param_specs",
     "AXIS_DATA",
     "AXIS_MODEL",
     "AXIS_CONTEXT",

@@ -32,6 +32,7 @@ __all__ = [
     "moe_ffn_dense",
     "moe_ffn_ep",
     "moe_dispatch",
+    "moe_load_balancing_loss",
 ]
 
 
@@ -104,18 +105,22 @@ def moe_ffn_dense(
     top_k: int = 2,
     capacity_factor: float = 1.25,
     capacity: int | None = None,
+    logits: Any | None = None,
 ):
     """Single-device MoE forward — the oracle the EP path must match.
 
     ``x`` [n, d_model] -> [n, d_model]. ``capacity=None`` derives the
     Switch capacity from ``capacity_factor``; pass ``capacity=n`` for
     exact no-drop routing (the decode/serving path, where a dropped token
-    would make generation depend on batch composition)."""
+    would make generation depend on batch composition). ``logits``
+    overrides the router projection so callers that also need the logits
+    (aux loss, sowing) compute them ONCE."""
     n, d = x.shape
     E = params["router"].shape[-1]
     if capacity is None:
         capacity = max(1, int(capacity_factor * top_k * n / E))
-    logits = x @ params["router"]
+    if logits is None:
+        logits = x @ params["router"]
     dispatch, combine = moe_dispatch(logits, top_k, capacity)
     xin = jnp.einsum("nd,nec->ecd", x, dispatch)
     out = _expert_ffn(xin, params["w1"], params["w2"])
@@ -180,3 +185,30 @@ def moe_ffn_ep(
         return jnp.einsum("ecd,nec->nd", out, combine)
 
     return run(params, x)
+
+
+def moe_load_balancing_loss(logits, mask=None):
+    """Switch-Transformer auxiliary load-balancing loss (Fedus et al.):
+    ``E * sum_e f_e * P_e`` where ``f_e`` is the fraction of tokens whose
+    TOP-1 choice is expert e and ``P_e`` the mean router probability —
+    minimized (value 1) at perfectly uniform routing. Add
+    ``aux_coeff * loss`` to the training objective to keep experts from
+    collapsing onto a few favorites.
+
+    ``mask`` [n] (or broadcastable) excludes positions — pass the
+    flattened attention mask so PADDING tokens don't count toward the
+    balance (balancing pads would leave real-token routing skewed).
+    """
+    E = logits.shape[-1]
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    top1 = jnp.argmax(probs, axis=-1)
+    onehot = jax.nn.one_hot(top1, E, dtype=jnp.float32)
+    if mask is None:
+        f = jnp.mean(onehot, axis=0)
+        p = jnp.mean(probs, axis=0)
+    else:
+        m = jnp.reshape(mask, (-1, 1)).astype(jnp.float32)
+        denom = jnp.clip(m.sum(), 1.0)
+        f = jnp.sum(onehot * m, axis=0) / denom
+        p = jnp.sum(probs * m, axis=0) / denom
+    return E * jnp.sum(f * p)

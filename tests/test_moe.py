@@ -198,3 +198,76 @@ class TestMoETransformer:
             p, ost, v = step(p, ost)
             vals.append(float(v))
         assert vals[-1] < vals[0] * 0.8, (vals[0], vals[-1])
+
+
+class TestLoadBalancing:
+    def test_uniform_routing_is_optimal(self):
+        from rl_tpu.parallel import moe_load_balancing_loss
+
+        # uniform logits -> loss == 1 (the minimum)
+        uniform = jnp.zeros((256, 8))
+        v = float(moe_load_balancing_loss(uniform))
+        assert abs(v - 1.0) < 1e-5
+        # collapsed routing -> loss ~ E
+        collapsed = jnp.zeros((256, 8)).at[:, 0].set(10.0)
+        assert float(moe_load_balancing_loss(collapsed)) > 4.0
+
+    def test_aux_reduces_collapse(self):
+        import optax
+
+        from rl_tpu.parallel import moe_load_balancing_loss
+        from rl_tpu.parallel.moe import init_moe_params, moe_ffn_dense
+
+        p = init_moe_params(KEY, 8, 16, 4)
+        # bias the router hard toward expert 0
+        p["router"] = p["router"].at[:, 0].add(3.0)
+        x = jax.random.normal(jax.random.key(5), (128, 8))
+
+        def aux(p):
+            return moe_load_balancing_loss(x @ p["router"])
+
+        v0 = float(aux(p))
+        opt = optax.adam(5e-2)
+        ost = opt.init(p)
+        for _ in range(50):
+            g = jax.grad(aux)(p)
+            upd, ost = opt.update(g, ost)
+            p = optax.apply_updates(p, upd)
+        v1 = float(aux(p))
+        assert v1 < v0 - 0.3 and abs(v1 - 1.0) < 0.05  # near the optimum
+
+    def test_router_logits_sown_from_model(self):
+        from rl_tpu.models import TransformerConfig, TransformerLM
+        from rl_tpu.parallel import moe_load_balancing_loss
+
+        cfg = TransformerConfig(
+            vocab_size=32, d_model=16, n_layers=2, n_heads=2, d_ff=32,
+            max_seq_len=16, dtype=jnp.float32, moe_experts=4,
+        )
+        lm = TransformerLM(cfg)
+        toks = jnp.zeros((2, 8), jnp.int32)
+        p = lm.init(KEY, toks)["params"]
+        _, inter = lm.apply(
+            {"params": p}, toks, mutable=["intermediates"]
+        )
+        leaves = [
+            v
+            for path, v in jax.tree_util.tree_flatten_with_path(inter)[0]
+            if "router_logits" in str(path)
+        ]
+        assert len(leaves) == cfg.n_layers
+        aux = sum(moe_load_balancing_loss(l.reshape(-1, 4)) for l in leaves)
+        assert np.isfinite(float(aux))
+
+    def test_mask_excludes_padding(self):
+        from rl_tpu.parallel import moe_load_balancing_loss
+
+        # real tokens route uniformly; pads collapse onto expert 0
+        real = jnp.zeros((64, 4))
+        pads = jnp.zeros((64, 4)).at[:, 0].set(10.0)
+        logits = jnp.concatenate([real, pads])
+        mask = jnp.concatenate([jnp.ones(64), jnp.zeros(64)])
+        v_masked = float(moe_load_balancing_loss(logits, mask))
+        v_unmasked = float(moe_load_balancing_loss(logits))
+        assert abs(v_masked - 1.0) < 1e-5  # pads excluded: uniform = optimal
+        assert v_unmasked > v_masked + 0.2  # pads would skew it
