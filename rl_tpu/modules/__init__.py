@@ -54,6 +54,8 @@ __all__ = [
     "MultiStepActorWrapper",
     "DiffusionActor",
     "GPWorldModel",
+    "TinyVLA",
+    "hash_instruction",
     "CEMPlanner",
     "MPPIPlanner",
     "MCTSTree",
@@ -106,6 +108,7 @@ __all__ = [
 from .actors_extra import MultiStepActorWrapper
 from .diffusion import DiffusionActor
 from .gp import GPWorldModel
+from .vla import TinyVLA, hash_instruction
 from .inference_server import InferenceClient, InferenceServer
 from .multiagent import CrossGroupCritic
 __all__ += ["InferenceServer", "InferenceClient", "CrossGroupCritic"]

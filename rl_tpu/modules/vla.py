@@ -1,0 +1,200 @@
+"""VLA policies: TinyVLA reference model + the wrapper contract.
+
+Redesign of the reference's VLA module layer (reference:
+torchrl/modules/vla/common.py:40 ``VLAWrapperBase`` — images + optional
+proprioceptive state + a language instruction -> continuous action chunk
+or discrete action tokens under ``("vla_action", ...)``;
+models.py:31 ``TinyVLA`` — the dependency-free CI policy: small conv
+encoder + state MLP + HASHED instruction embedding, continuous-chunk or
+token head). Pretrained VLA backbones can't exist in a zero-egress image;
+TinyVLA exercises the whole VLA pipeline (schema, tokenizers,
+chunk-playout actors, losses) end-to-end with real language conditioning.
+
+JAX-native differences: images are HWC uint8 (the framework's VLA schema;
+XLA conv layout), instruction hashing is a HOST-side helper producing
+int32 ids (strings can't enter jit), and sampling follows the framework's
+``key=None`` => deterministic convention / exploration-type context.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..data import ArrayDict
+from .networks import ConvNet
+
+__all__ = ["TinyVLA", "hash_instruction"]
+
+
+def hash_instruction(texts: Sequence[str] | str, vocab: int = 256) -> jnp.ndarray:
+    """Deterministic, tokenizer-free instruction ids (reference TinyVLA's
+    hashed embedding): md5(text) mod vocab. Host-side — call before jit."""
+    if isinstance(texts, str):
+        texts = [texts]
+    ids = [
+        int(hashlib.md5(t.encode()).hexdigest(), 16) % vocab for t in texts
+    ]
+    return jnp.asarray(ids, jnp.int32)
+
+
+class _TinyVLANet(nn.Module):
+    action_dim: int
+    chunk_size: int
+    action_head: str
+    vocab_size: int
+    use_state: bool
+    hidden_dim: int
+    text_vocab: int
+    text_dim: int
+
+    @nn.compact
+    def __call__(self, image, state, instr_ids):
+        # image [B, H, W, C] uint8 -> the shared ConvNet feature extractor
+        x = ConvNet(channels=(16, 32), kernel_sizes=(3, 3), strides=(2, 2))(
+            image.astype(jnp.float32) / 255.0
+        )
+        parts = [nn.relu(nn.Dense(self.hidden_dim)(x))]
+        if self.use_state and state is not None:
+            parts.append(nn.relu(nn.Dense(self.hidden_dim)(state)))
+        emb = nn.Embed(self.text_vocab, self.text_dim)(instr_ids)
+        parts.append(emb)
+        h = jnp.concatenate(parts, axis=-1)
+        h = nn.relu(nn.Dense(self.hidden_dim)(h))
+        if self.action_head == "continuous":
+            out = nn.Dense(self.chunk_size * self.action_dim)(h)
+            return out.reshape(-1, self.chunk_size, self.action_dim)
+        out = nn.Dense(self.chunk_size * self.action_dim * self.vocab_size)(h)
+        return out.reshape(
+            -1, self.chunk_size, self.action_dim, self.vocab_size
+        )
+
+
+class TinyVLA:
+    """Dependency-free VLA policy (reference models.py:31).
+
+    Contract (framework actor conventions):
+    ``policy(params, td, key=None) -> td`` reading
+    ``("observation", "image")`` [B, H, W, C] uint8,
+    ``("observation", "state")`` [B, S] (optional), and
+    ``"language_instruction"`` int32 ids (use :meth:`hash` — bound to
+    this policy's ``text_vocab``);
+    writing ``("vla_action", "chunk")`` [B, H, A] (continuous head) or
+    ``("vla_action", "tokens")`` [B, H, A] ids + ``("vla_action",
+    "log_probs")`` (token head; sampled with ``key``, argmax when
+    ``key=None``), plus ``"action"`` = the chunk's first step. With an
+    ``action_tokenizer`` the token head also decodes the continuous
+    chunk (``output_mode="both"`` semantics).
+    """
+
+    in_keys = [("observation", "image"), ("observation", "state"), ("language_instruction",)]
+
+    def __init__(
+        self,
+        action_dim: int,
+        chunk_size: int,
+        action_head: str = "continuous",
+        vocab_size: int = 256,
+        use_state: bool = True,
+        hidden_dim: int = 128,
+        text_vocab: int = 256,
+        text_dim: int = 32,
+        action_tokenizer: Any = None,
+        log_probs_mode: str = "sequence",
+    ):
+        if action_head not in ("continuous", "tokens"):
+            raise ValueError(f"action_head must be continuous|tokens, got {action_head!r}")
+        if log_probs_mode not in ("sequence", "token"):
+            raise ValueError(f"log_probs_mode must be sequence|token, got {log_probs_mode!r}")
+        if action_tokenizer is not None and action_tokenizer.vocab_size != vocab_size:
+            raise ValueError(
+                f"tokenizer vocab ({action_tokenizer.vocab_size}) != head vocab ({vocab_size})"
+            )
+        self.action_dim = action_dim
+        self.chunk_size = chunk_size
+        self.action_head = action_head
+        self.vocab_size = vocab_size
+        self.action_tokenizer = action_tokenizer
+        self.log_probs_mode = log_probs_mode
+        # honest output contract: the token head WITHOUT a tokenizer has
+        # no continuous representation, so it cannot emit "action"/"chunk"
+        if action_head == "continuous":
+            self.out_keys = [("vla_action", "chunk"), ("action",)]
+        elif action_tokenizer is not None:
+            self.out_keys = [
+                ("vla_action", "tokens"), ("vla_action", "log_probs"),
+                ("vla_action", "chunk"), ("action",),
+            ]
+        else:
+            self.out_keys = [("vla_action", "tokens"), ("vla_action", "log_probs")]
+        self.text_vocab = text_vocab
+        self.net = _TinyVLANet(
+            action_dim=action_dim,
+            chunk_size=chunk_size,
+            action_head=action_head,
+            vocab_size=vocab_size,
+            use_state=use_state,
+            hidden_dim=hidden_dim,
+            text_vocab=text_vocab,
+            text_dim=text_dim,
+        )
+        self.use_state = use_state
+
+    def hash(self, texts):
+        """Instruction ids bound to THIS policy's embedding table size —
+        the module-level :func:`hash_instruction` takes an independent
+        ``vocab`` and out-of-range ids would be silently clamped by the
+        embedding gather, collapsing distinct instructions."""
+        return hash_instruction(texts, vocab=self.text_vocab)
+
+    def _inputs(self, td: ArrayDict):
+        image = td["observation", "image"]
+        state = (
+            td["observation", "state"]
+            if self.use_state and ("observation", "state") in td
+            else None
+        )
+        return image, state, td["language_instruction"]
+
+    def init(self, key: jax.Array, td: ArrayDict):
+        return self.net.init(key, *self._inputs(td))
+
+    def logits(self, params, td: ArrayDict):
+        """Token head only: [B, H, A, V] action-token logits."""
+        if self.action_head != "tokens":
+            raise ValueError("logits are only defined for the token head")
+        return self.net.apply(params, *self._inputs(td))
+
+    def __call__(self, params, td: ArrayDict, key: jax.Array | None = None):
+        out = self.net.apply(params, *self._inputs(td))
+        if self.action_head == "continuous":
+            chunk = out  # [B, H, A]
+            td = td.set(("vla_action", "chunk"), chunk)
+            return td.set("action", chunk[:, 0])
+        logits = out  # [B, H, A, V]
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        if key is None:  # deterministic readout
+            tokens = jnp.argmax(logits, axis=-1)
+        else:
+            tokens = jax.random.categorical(key, logits, axis=-1)
+        tok_logp = jnp.take_along_axis(
+            logp, tokens[..., None], axis=-1
+        )[..., 0]  # [B, H, A]
+        if self.log_probs_mode == "sequence":
+            lp = tok_logp.sum(axis=(-2, -1))
+        else:
+            lp = tok_logp
+        td = (
+            td.set(("vla_action", "tokens"), tokens.astype(jnp.int32))
+            .set(("vla_action", "log_probs"), lp)
+        )
+        if self.action_tokenizer is not None:
+            chunk = self.action_tokenizer.decode(tokens)
+            td = td.set(("vla_action", "chunk"), chunk)
+            td = td.set("action", chunk[:, 0])
+        return td
