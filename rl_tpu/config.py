@@ -248,4 +248,27 @@ _BUILTINS.update({
     "llm_transform/kl_reward": "rl_tpu.envs.llm.KLRewardTransform",
     "llm_transform/policy_version": "rl_tpu.envs.llm.PolicyVersion",
     "llm_transform/python_tool": "rl_tpu.envs.llm.PythonToolTransform",
+    # round-4 components
+    "env/chess": "rl_tpu.envs.ChessEnv",
+    "env/dm_control": "rl_tpu.envs.libs.dm_control.DMControlEnv",
+    "actor/diffusion": "rl_tpu.modules.DiffusionActor",
+    "actor/tiny_vla": "rl_tpu.modules.TinyVLA",
+    "model/gp_world": "rl_tpu.modules.GPWorldModel",
+    "loss/diffusion_bc": "rl_tpu.objectives.DiffusionBCLoss",
+    "loss/pilco_cost": "rl_tpu.objectives.ExponentialQuadraticCost",
+    "loss/dpo": "rl_tpu.objectives.llm.DPOLoss",
+    "loss/pairwise_reward": "rl_tpu.objectives.llm.PairwiseRewardLoss",
+    "dataset/gsm8k": "rl_tpu.envs.llm.gsm8k_dataset",
+    "dataset/countdown": "rl_tpu.envs.llm.countdown_dataset",
+    "dataset/ifeval": "rl_tpu.envs.llm.ifeval_dataset",
+    "dataset/math_expression": "rl_tpu.envs.llm.math_expression_dataset",
+    "dataset/minari_h5": "rl_tpu.data.MinariH5Dataset",
+    "dataset/atari_dqn": "rl_tpu.data.AtariDQNDataset",
+    "dataset/lerobot": "rl_tpu.data.LeRobotDataset",
+    "scorer/gsm8k": "rl_tpu.envs.llm.GSM8KScorer",
+    "scorer/countdown": "rl_tpu.envs.llm.CountdownScorer",
+    "scorer/ifeval": "rl_tpu.envs.llm.IFEvalScorer",
+    "tokenizer/action_uniform": "rl_tpu.data.UniformActionTokenizer",
+    "tokenizer/action_vocab_tail": "rl_tpu.data.VocabTailActionTokenizer",
+    "collector/mesh": "rl_tpu.collectors.MeshCollector",
 })

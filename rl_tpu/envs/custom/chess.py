@@ -35,7 +35,7 @@ import jax.numpy as jnp
 from ...data import ArrayDict, Binary, Bounded, Categorical, Composite, Unbounded
 from ..base import EnvBase
 
-__all__ = ["ChessEnv", "fen_to_state", "START_FEN"]
+__all__ = ["ChessEnv", "fen_to_state", "state_to_fen", "START_FEN"]
 
 START_FEN = "rnbqkbnr/pppppppp/8/8/8/8/PPPPPPPP/RNBQKBNR w KQkq - 0 1"
 
@@ -296,12 +296,14 @@ def fen_to_state(fen: str) -> ArrayDict:
     if len(parts) > 3 and parts[3] != "-":
         ep = (int(parts[3][1]) - 1) * 8 + (ord(parts[3][0]) - ord("a"))
     halfmove = int(parts[4]) if len(parts) > 4 else 0
+    fullmove = int(parts[5]) if len(parts) > 5 else 1
     return ArrayDict(
         board=jnp.asarray(board),
         stm=jnp.asarray(stm, jnp.int32),
         castling=jnp.asarray(cast),
         ep=jnp.asarray(ep, jnp.int32),
         halfmove=jnp.asarray(halfmove, jnp.int32),
+        fullmove=jnp.asarray(fullmove, jnp.int32),
     )
 
 
@@ -345,6 +347,7 @@ class ChessEnv(EnvBase):
             castling=Binary(shape=(4,)),
             ep=Unbounded(shape=(), dtype=jnp.int32),
             halfmove=Unbounded(shape=(), dtype=jnp.int32),
+            fullmove=Unbounded(shape=(), dtype=jnp.int32),
             legal_mask=Binary(shape=(4096,)),
         )
 
@@ -438,6 +441,8 @@ class ChessEnv(EnvBase):
         new_state = ArrayDict(
             board=board2, stm=nstm, castling=new_castling,
             ep=new_ep, halfmove=new_half, legal_mask=opp_mask,
+            # the fullmove counter advances after BLACK's move
+            fullmove=(state["fullmove"] + (stm < 0)).astype(jnp.int32),
         )
 
         opp_has_move = jnp.any(opp_mask)
@@ -459,3 +464,41 @@ class ChessEnv(EnvBase):
             terminated,
             jnp.zeros((), jnp.bool_),
         )
+
+
+_CHAR_OF = {v: k for k, v in _PIECE_OF.items()}
+
+
+def state_to_fen(state: ArrayDict) -> str:
+    """Serialize an env/engine state back to FEN (host-side; the inverse
+    of :func:`fen_to_state` — the reference exposes the board as FEN
+    strings via ``include_fen``; here the native state is arrays and FEN
+    is the debugging/interop view)."""
+    board = np.asarray(state["board"]).reshape(8, 8)
+    rows = []
+    for r in range(7, -1, -1):
+        row, run = "", 0
+        for f in range(8):
+            p = int(board[r, f])
+            if p == 0:
+                run += 1
+                continue
+            if run:
+                row += str(run)
+                run = 0
+            ch = _CHAR_OF[abs(p)]
+            row += ch if p > 0 else ch.lower()
+        if run:
+            row += str(run)
+        rows.append(row)
+    stm = "w" if int(state["stm"]) > 0 else "b"
+    cast = "".join(
+        ch
+        for ch, on in zip("KQkq", np.asarray(state["castling"]))
+        if bool(on)
+    ) or "-"
+    ep = int(state["ep"])
+    ep_s = "-" if ep < 0 else chr(ord("a") + ep % 8) + str(ep // 8 + 1)
+    half = int(state["halfmove"])
+    full = int(state["fullmove"]) if "fullmove" in state else 1
+    return f"{'/'.join(rows)} {stm} {cast} {ep_s} {half} {full}"

@@ -154,11 +154,18 @@ class TinyVLA:
 
     def _inputs(self, td: ArrayDict):
         image = td["observation", "image"]
-        state = (
-            td["observation", "state"]
-            if self.use_state and ("observation", "state") in td
-            else None
-        )
+        if self.use_state:
+            # architecture must be keyed off config, not td contents: a
+            # missing state at init would build state-blind params that
+            # later apply() calls (with state present) cannot use
+            if ("observation", "state") not in td:
+                raise KeyError(
+                    "use_state=True but ('observation', 'state') is absent; "
+                    "pass use_state=False for state-less observations"
+                )
+            state = td["observation", "state"]
+        else:
+            state = None
         return image, state, td["language_instruction"]
 
     def init(self, key: jax.Array, td: ArrayDict):

@@ -206,3 +206,30 @@ class TestEnvContract:
     @pytest.mark.slow
     def test_check_env_specs(self):
         check_env_specs(ChessEnv(), num_steps=4)
+
+
+class TestFEN:
+    FENS = [
+        START_FEN,
+        "r3k2r/p1ppqpb1/bn2pnp1/3PN3/1p2P3/2N2Q1p/PPPBBPPP/R3K2R w KQkq - 0 1",
+        "8/2p5/3p4/KP5r/1R3p1k/8/4P1P1/8 w - - 0 1",
+        "rnbqkbnr/ppp1pppp/8/3pP3/8/8/PPPP1PPP/RNBQKBNR b KQkq d6 3 12",
+        "k7/8/1Q6/8/8/8/8/7K b - - 42 17",
+    ]
+
+    @pytest.mark.parametrize("fen", FENS)
+    def test_roundtrip(self, fen):
+        from rl_tpu.envs.custom.chess import state_to_fen
+
+        st = fen_to_state(fen)
+        assert state_to_fen(st) == fen
+
+    def test_fen_view_after_moves(self):
+        from rl_tpu.envs.custom.chess import state_to_fen
+
+        env = ChessEnv()
+        state, td = env.reset(KEY)
+        state, out = env.step(state, td.set("action", jnp.asarray(mv("e2", "e4"))))
+        fen = state_to_fen(state)
+        assert fen.startswith("rnbqkbnr/pppppppp/8/8/4P3/8/PPPP1PPP/RNBQKBNR b")
+        assert " e3 " in fen  # double push set the en-passant square
