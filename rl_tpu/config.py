@@ -250,6 +250,7 @@ _BUILTINS.update({
     "llm_transform/python_tool": "rl_tpu.envs.llm.PythonToolTransform",
     # round-4 components
     "env/chess": "rl_tpu.envs.ChessEnv",
+    "env/toy_vla": "rl_tpu.envs.ToyVLAEnv",
     "env/dm_control": "rl_tpu.envs.libs.dm_control.DMControlEnv",
     "actor/diffusion": "rl_tpu.modules.DiffusionActor",
     "actor/tiny_vla": "rl_tpu.modules.TinyVLA",

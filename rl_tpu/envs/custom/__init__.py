@@ -2,5 +2,6 @@ from .chess import ChessEnv
 from .navigation import NavigationEnv
 from .tictactoe import TicTacToeEnv
 from .trading import TradingEnv
+from .vla_env import ToyVLAEnv
 
-__all__ = ["ChessEnv", "NavigationEnv", "TicTacToeEnv", "TradingEnv"]
+__all__ = ["ChessEnv", "NavigationEnv", "TicTacToeEnv", "TradingEnv", "ToyVLAEnv"]

@@ -1,0 +1,152 @@
+"""ToyVLAEnv: a synthetic env speaking the canonical VLA schema
+(reference: torchrl/envs/custom/vla.py:24 — random camera image +
+proprioceptive state echoing the previous action, constant language
+instruction; echo mode for plumbing smoke tests, tracking mode with a
+per-episode target action and consecutive-success termination).
+
+Pure-JAX redesign: the whole env is jit/vmap/scan-native (images are HWC
+uint8, the framework's VLA layout), so TinyVLA + MultiStepActorWrapper +
+collectors run as one fused program against it. The language instruction
+is a hashed int32 id in the observation (strings cannot cross into XLA);
+the string itself stays on the env object for host-side consumers.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...data import ArrayDict, Binary, Bounded, Categorical, Composite, Unbounded
+from ..base import EnvBase
+
+__all__ = ["ToyVLAEnv"]
+
+
+class ToyVLAEnv(EnvBase):
+    """Echo mode (``success_steps=None``): reward = −‖action‖, never
+    terminates — the VLA plumbing smoke test. Tracking mode
+    (``success_steps=k``): a target action sampled at reset sits in
+    ``state[action_dim:2*action_dim]``; reward = −‖action − target‖; a
+    ``success`` flag turns True (and the episode ends) after ``k``
+    consecutive steps within ``success_tol`` (∞-norm). An oracle reading
+    the target succeeds surely; uniform random almost never — success
+    rate is a real learning signal.
+    """
+
+    def __init__(
+        self,
+        action_dim: int = 4,
+        state_dim: int = 6,
+        image_shape: tuple[int, int, int] = (16, 16, 3),
+        instruction: str = "push the T-shaped block onto the target",
+        success_steps: int | None = None,
+        success_tol: float = 0.25,
+        text_vocab: int = 256,
+    ):
+        need = 2 * action_dim if success_steps is not None else action_dim
+        if state_dim < need:
+            raise ValueError(
+                f"state_dim ({state_dim}) must be >= {need} for this mode"
+            )
+        self.action_dim = action_dim
+        self.state_dim = state_dim
+        self.image_shape = tuple(image_shape)  # HWC (framework VLA layout)
+        self.instruction = instruction
+        self.success_steps = success_steps
+        self.success_tol = success_tol
+        self.text_vocab = text_vocab
+        from ...modules.vla import hash_instruction
+
+        self._instr_id = hash_instruction(instruction, vocab=text_vocab)[0]
+
+    @property
+    def observation_spec(self) -> Composite:
+        spec = Composite(
+            observation=Composite(
+                image=Bounded(
+                    shape=self.image_shape, low=0, high=255, dtype=jnp.uint8
+                ),
+                state=Unbounded(shape=(self.state_dim,)),
+            ),
+            language_instruction=Categorical(n=self.text_vocab, dtype=jnp.int32),
+        )
+        if self.success_steps is not None:
+            spec = spec.set("success", Binary(shape=()))
+        return spec
+
+    @property
+    def action_spec(self):
+        return Bounded(shape=(self.action_dim,), low=-1.0, high=1.0)
+
+    @property
+    def state_spec(self) -> Composite:
+        spec = Composite(
+            state_vec=Unbounded(shape=(self.state_dim,)),
+        )
+        if self.success_steps is not None:
+            spec = spec.set(
+                "hits", Unbounded(shape=(), dtype=jnp.int32)
+            ).set("target", Bounded(shape=(self.action_dim,), low=-1.0, high=1.0))
+        return spec
+
+    def _obs(self, key, state_vec, success=None):
+        image = jax.random.randint(
+            key, self.image_shape, 0, 256, jnp.int32
+        ).astype(jnp.uint8)
+        td = ArrayDict(
+            observation=ArrayDict(image=image, state=state_vec),
+            language_instruction=self._instr_id,
+        )
+        if self.success_steps is not None:
+            td = td.set(
+                "success",
+                jnp.asarray(False) if success is None else success,
+            )
+        return td
+
+    def _reset(self, key):
+        k_img, k_tgt = jax.random.split(key)
+        state_vec = jnp.zeros((self.state_dim,))
+        st = ArrayDict()
+        if self.success_steps is not None:
+            st = st.set("hits", jnp.asarray(0, jnp.int32))
+        if self.success_steps is not None:
+            target = jax.random.uniform(
+                k_tgt, (self.action_dim,), minval=-1.0, maxval=1.0
+            )
+            state_vec = jax.lax.dynamic_update_slice(
+                state_vec, target, (self.action_dim,)
+            )
+            st = st.set("target", target)
+        st = st.set("state_vec", state_vec)
+        return st, self._obs(k_img, state_vec)
+
+    def _step(self, state, action, key):
+        a = jnp.clip(action, -1.0, 1.0)
+        # the state echoes the executed action (chunk cadence observable)
+        state_vec = jax.lax.dynamic_update_slice(
+            state["state_vec"], a, (0,)
+        )
+        if self.success_steps is None:
+            reward = -jnp.linalg.norm(a)
+            new_state = state.set("state_vec", state_vec)
+            return (
+                new_state,
+                self._obs(key, state_vec),
+                reward,
+                jnp.asarray(False),
+                jnp.asarray(False),
+            )
+        target = state["target"]
+        err = jnp.max(jnp.abs(a - target))
+        reward = -jnp.linalg.norm(a - target)
+        hits = jnp.where(err <= self.success_tol, state["hits"] + 1, 0)
+        success = hits >= self.success_steps
+        new_state = state.replace(state_vec=state_vec, hits=hits.astype(jnp.int32))
+        return (
+            new_state,
+            self._obs(key, state_vec, success=success),
+            reward,
+            success,
+            jnp.asarray(False),
+        )

@@ -42,13 +42,36 @@ def _norm_keys(keys) -> list[tuple[str, ...]]:
 
 
 class TDModule:
-    """Wrap a flax module / callable with declared in/out keys."""
+    """Wrap a flax module / callable with declared in/out keys.
 
-    def __init__(self, module: Any, in_keys: Sequence, out_keys: Sequence):
+    ``safe_specs`` maps out-keys to Specs whose :meth:`~rl_tpu.data.Spec.
+    project` is applied to the produced values — the reference's
+    SafeModule/``safe=True`` contract (modules/tensordict_module/common.py):
+    outputs are guaranteed in-spec (clipped/renormalized) no matter what
+    the network emits.
+    """
+
+    def __init__(
+        self,
+        module: Any,
+        in_keys: Sequence,
+        out_keys: Sequence,
+        safe_specs: dict | None = None,
+    ):
         self.module = module
         self.in_keys = _norm_keys(in_keys)
         self.out_keys = _norm_keys(out_keys)
         self._is_flax = isinstance(module, nn.Module)
+        self.safe_specs = {
+            (k if isinstance(k, tuple) else (k,)): v
+            for k, v in (safe_specs or {}).items()
+        }
+        unknown = set(self.safe_specs) - set(self.out_keys)
+        if unknown:
+            # a misspelled key would otherwise silently disable projection
+            raise ValueError(
+                f"safe_specs keys {sorted(unknown)} not in out_keys {self.out_keys}"
+            )
 
     # -- params ---------------------------------------------------------------
 
@@ -78,6 +101,8 @@ class TDModule:
                 f"out_keys {self.out_keys}"
             )
         for k, v in zip(self.out_keys, out):
+            if k in self.safe_specs:
+                v = self.safe_specs[k].project(v)
             td = td.set(k, v)
         return td
 

@@ -285,3 +285,32 @@ class TestSmallExplorationModels:
         s = d.sample(KEY)
         assert float(s.sum()) == 1.0
         assert np.isfinite(float(d.log_prob(d.mode)))
+
+
+class TestSafeModule:
+    def test_safe_specs_project_outputs(self):
+        """The reference's SafeModule contract: declared out-key specs
+        clip/renormalize whatever the network emits."""
+        from rl_tpu.data import Bounded
+        from rl_tpu.modules import MLP, TDModule
+
+        net = MLP(out_features=2, num_cells=(8,))
+        mod = TDModule(
+            net, ["observation"], ["action"],
+            safe_specs={"action": Bounded(shape=(2,), low=-0.5, high=0.5)},
+        )
+        td = ArrayDict(observation=jnp.full((4, 3), 100.0))  # drives outputs big
+        params = mod.init(KEY, td)
+        out = mod(params, td)
+        a = np.asarray(out["action"])
+        assert (a >= -0.5).all() and (a <= 0.5).all()
+
+    def test_unsafe_passthrough(self):
+        from rl_tpu.modules import MLP, TDModule
+
+        net = MLP(out_features=2, num_cells=(8,))
+        mod = TDModule(net, ["observation"], ["action"])
+        td = ArrayDict(observation=jnp.full((4, 3), 100.0))
+        params = mod.init(KEY, td)
+        out = mod(params, td)
+        assert float(np.abs(np.asarray(out["action"])).max()) > 0.5
