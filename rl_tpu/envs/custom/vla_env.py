@@ -110,7 +110,6 @@ class ToyVLAEnv(EnvBase):
         st = ArrayDict()
         if self.success_steps is not None:
             st = st.set("hits", jnp.asarray(0, jnp.int32))
-        if self.success_steps is not None:
             target = jax.random.uniform(
                 k_tgt, (self.action_dim,), minval=-1.0, maxval=1.0
             )

@@ -1,11 +1,12 @@
 from .chat import ChatEnv, DatasetChatEnv
-from .datasets import (QADataset, arithmetic_dataset, copy_dataset,
-                       countdown_dataset, gsm8k_dataset,
+from .datasets import (QADataset, TopKRewardSelector, arithmetic_dataset,
+                       copy_dataset, countdown_dataset, gsm8k_dataset,
                        ifeval_dataset, math_expression_dataset)
 from .reward import (CountdownScorer, ExactMatchScorer, FormatScorer,
                      GSM8KScorer, IFEvalScorer,
                      SumScorer, combine_scorers, extract_gsm8k_answer)
-from .transforms import KLRewardTransform, PolicyVersion, PythonToolTransform
+from .transforms import (AdaptiveKLController, ConstantKLController,
+                         KLRewardTransform, PolicyVersion, PythonToolTransform)
 
 __all__ = [
     "ChatEnv",
@@ -25,7 +26,10 @@ __all__ = [
     "SumScorer",
     "extract_gsm8k_answer",
     "combine_scorers",
+    "AdaptiveKLController",
+    "ConstantKLController",
     "KLRewardTransform",
+    "TopKRewardSelector",
     "PolicyVersion",
     "PythonToolTransform",
 ]

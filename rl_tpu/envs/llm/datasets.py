@@ -16,7 +16,7 @@ from ...data.llm.history import History
 
 __all__ = ["arithmetic_dataset", "copy_dataset", "countdown_dataset",
            "gsm8k_dataset", "ifeval_dataset", "math_expression_dataset",
-           "QADataset"]
+           "QADataset", "TopKRewardSelector"]
 
 
 class QADataset:
@@ -222,3 +222,56 @@ def ifeval_dataset(n: int = 64, seed: int = 0) -> QADataset:
             gold = " ".join(["word"] * k)
         out.append((q, gold))
     return QADataset(out)
+
+
+class TopKRewardSelector:
+    """Expert-iteration data gate (reference data/llm/topk.py:16
+    ``TopKRewardSelector``): buffer writes accumulate responses per
+    prompt; once ``total_dialog_turns`` responses for a prompt have been
+    seen, only the ``topk_size`` highest-reward ones pass through to
+    storage (the SFT-on-best-samples recipe). Host-side pre-insert
+    filter: ``select(batch) -> filtered batch or None``.
+    """
+
+    def __init__(
+        self,
+        total_dialog_turns: int,
+        topk_size: int,
+        prompt_key: str = "prompt_id",
+        reward_key=("reward",),
+    ):
+        if topk_size > total_dialog_turns:
+            raise ValueError(
+                f"topk_size ({topk_size}) must be <= total_dialog_turns "
+                f"({total_dialog_turns})"
+            )
+        self.total = total_dialog_turns
+        self.k = topk_size
+        self.prompt_key = prompt_key
+        self.reward_key = reward_key
+        self._pending: dict = {}
+
+    def select(self, batch):
+        """Accumulate rows by prompt id; emit the top-k rows of every
+        prompt that completed its quota (None when nothing is ready)."""
+        import jax
+        import numpy as np
+
+        # ONE device->host transfer for the whole batch; rows index the
+        # host copy (per-row tree.map would re-transfer every leaf per row)
+        host = jax.tree.map(np.asarray, batch)
+        pid = np.asarray(host[self.prompt_key]).reshape(-1)
+        ready_rows = []
+        for i, p in enumerate(pid):
+            self._pending.setdefault(int(p), []).append(
+                jax.tree.map(lambda x: x[i], host)
+            )
+            rows = self._pending[int(p)]
+            if len(rows) >= self.total:
+                rewards = [float(np.asarray(r[self.reward_key])) for r in rows]
+                order = np.argsort(rewards)[::-1][: self.k]
+                ready_rows.extend(rows[j] for j in order)
+                self._pending[int(p)] = []
+        if not ready_rows:
+            return None
+        return jax.tree.map(lambda *xs: np.stack(xs), *ready_rows)

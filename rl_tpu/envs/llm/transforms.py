@@ -18,7 +18,7 @@ from typing import Any, Callable
 
 import numpy as np
 
-__all__ = ["KLRewardTransform", "PolicyVersion", "PythonToolTransform"]
+__all__ = ["KLRewardTransform", "PolicyVersion", "PythonToolTransform", "AdaptiveKLController", "ConstantKLController"]
 
 
 class KLRewardTransform:
@@ -172,3 +172,55 @@ class PythonToolTransform:
             return history
         out = "\n".join(self.run(b) for b in blocks)
         return history.append("tool", out)
+
+
+class ConstantKLController:
+    """Fixed KL coefficient (reference data/llm/utils.py:35): ``update``
+    is a no-op; exists so recipes can swap controllers freely."""
+
+    def __init__(self, kl_coef: float = 0.1, transform: "KLRewardTransform | None" = None):
+        self.coef = float(kl_coef)
+        self.transform = transform
+        if transform is not None:
+            transform.coeff = self.coef
+
+    def update(self, kl_values) -> float:
+        return self.coef
+
+
+class AdaptiveKLController:
+    """Adaptive KL coefficient (reference data/llm/utils.py:70; Ziegler
+    et al. 2019 §2.2): when the observed KL exceeds ``target`` the
+    coefficient grows (pulling the policy toward the reference); when it
+    is below, the penalty relaxes. ``transform`` (a
+    :class:`KLRewardTransform`) is updated in place each ``update``.
+    """
+
+    def __init__(
+        self,
+        init_kl_coef: float,
+        target: float,
+        horizon: int,
+        transform: "KLRewardTransform | None" = None,
+    ):
+        self.coef = float(init_kl_coef)
+        self.target = float(target)
+        self.horizon = int(horizon)
+        self.transform = transform
+        if transform is not None:
+            transform.coeff = self.coef
+
+    def update(self, kl_values) -> float:
+        """``kl_values``: RAW per-sample KL estimates for this batch —
+        the masked sums of (log pi − log pi_ref), NOT multiplied by the
+        coefficient (a coefficient-scaled input would self-excite: once
+        coef grows, coef*KL stays above target and the controller pumps
+        the coefficient exponentially regardless of the true policy KL).
+        Returns the new coefficient."""
+        kl = np.mean(np.asarray(kl_values, np.float64))
+        n_steps = np.size(kl_values)
+        proportional_error = float(np.clip(kl / self.target - 1.0, -0.2, 0.2))
+        self.coef *= 1.0 + proportional_error * n_steps / self.horizon
+        if self.transform is not None:
+            self.transform.coeff = self.coef
+        return self.coef

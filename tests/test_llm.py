@@ -473,3 +473,87 @@ class TestLLMReviewFixes:
         mask = jnp.ones((1, 2), bool)
         _, extra = loss._objective(ratio, jnp.ones((1, 1)), mask)
         np.testing.assert_allclose(float(extra["clip_fraction"]), 0.5)
+
+
+class TestKLControllers:
+    def test_constant_noop(self):
+        from rl_tpu.envs.llm import ConstantKLController, KLRewardTransform
+
+        t = KLRewardTransform(coeff=0.5)
+        c = ConstantKLController(kl_coef=0.2, transform=t)
+        assert t.coeff == 0.2
+        c.update([1.0, 2.0])
+        assert t.coeff == 0.2
+
+    def test_adaptive_tracks_target(self):
+        from rl_tpu.envs.llm import AdaptiveKLController, KLRewardTransform
+
+        t = KLRewardTransform(coeff=0.1)
+        c = AdaptiveKLController(
+            init_kl_coef=0.1, target=1.0, horizon=100, transform=t
+        )
+        # observed KL far ABOVE target -> coefficient grows
+        for _ in range(10):
+            c.update(np.full(16, 5.0))
+        assert c.coef > 0.1
+        assert t.coeff == c.coef
+        # observed KL far BELOW target -> coefficient shrinks again
+        high = c.coef
+        for _ in range(10):
+            c.update(np.full(16, 0.01))
+        assert c.coef < high
+
+    def test_update_rule_matches_ziegler(self):
+        from rl_tpu.envs.llm import AdaptiveKLController
+
+        c = AdaptiveKLController(init_kl_coef=0.2, target=2.0, horizon=50)
+        out = c.update(np.full(10, 4.0))  # kl/target - 1 = 1 -> clipped 0.2
+        expect = 0.2 * (1.0 + 0.2 * 10 / 50)
+        np.testing.assert_allclose(out, expect, rtol=1e-9)
+
+
+class TestTopKRewardSelector:
+    def test_selects_best_per_prompt(self):
+        from rl_tpu.envs.llm import TopKRewardSelector
+
+        sel = TopKRewardSelector(total_dialog_turns=4, topk_size=2)
+        out = None
+        for i in range(4):
+            batch = ArrayDict(
+                prompt_id=jnp.asarray([7]),
+                reward=jnp.asarray([float(i)]),
+                tokens=jnp.asarray([[i, i]]),
+            )
+            got = sel.select(batch)
+            if got is not None:
+                out = got
+        assert out is not None
+        # the two HIGHEST rewards (3, 2) survive, best first
+        np.testing.assert_allclose(np.asarray(out["reward"]), [3.0, 2.0])
+        np.testing.assert_array_equal(np.asarray(out["tokens"])[:, 0], [3, 2])
+        # the quota reset: nothing pending for prompt 7
+        assert sel.select(ArrayDict(
+            prompt_id=jnp.asarray([7]), reward=jnp.asarray([9.0]),
+            tokens=jnp.asarray([[9, 9]]),
+        )) is None
+
+    def test_interleaved_prompts(self):
+        from rl_tpu.envs.llm import TopKRewardSelector
+
+        sel = TopKRewardSelector(total_dialog_turns=2, topk_size=1)
+        sel.select(ArrayDict(prompt_id=jnp.asarray([1, 2]),
+                             reward=jnp.asarray([0.1, 0.9]),
+                             tokens=jnp.asarray([[1], [2]])))
+        out = sel.select(ArrayDict(prompt_id=jnp.asarray([2, 1]),
+                                   reward=jnp.asarray([0.2, 0.8]),
+                                   tokens=jnp.asarray([[3], [4]])))
+        # both prompts complete in this call: best of prompt 2 (0.9) and
+        # best of prompt 1 (0.8)
+        r = sorted(np.asarray(out["reward"]).tolist(), reverse=True)
+        np.testing.assert_allclose(r, [0.9, 0.8])
+
+    def test_validation(self):
+        from rl_tpu.envs.llm import TopKRewardSelector
+
+        with pytest.raises(ValueError, match="topk_size"):
+            TopKRewardSelector(total_dialog_turns=2, topk_size=3)
