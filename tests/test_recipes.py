@@ -29,3 +29,17 @@ def test_mappo_recipe_runs():
     import mappo_navigation
 
     mappo_navigation.main(total_steps=3, n_envs=4, frames=128)
+
+
+@pytest.mark.slow
+def test_grpo_gsm8k_recipe_runs():
+    import grpo_gsm8k
+
+    grpo_gsm8k.main(steps=1, max_prompt_len=48, max_new_tokens=8)
+
+
+@pytest.mark.slow
+def test_pilco_recipe_runs():
+    import pilco_pendulum_like
+
+    pilco_pendulum_like.main(n_data=40, horizon=4, iters=5)
