@@ -666,7 +666,17 @@ def bench_all():
                     "error": head.get("error"),
                 }
             )
-            print(json.dumps(head), flush=True)  # headline FIRST
+            # always the FULL metric schema, even when the child only
+            # produced an error dict (a schema-less first line would read
+            # as garbage to a driver parsing the first JSON line)
+            print(
+                json.dumps(
+                    _headline_dict(
+                        _headline["value"], _headline["mfu"], _headline["error"]
+                    )
+                ),
+                flush=True,
+            )  # headline FIRST
     _report_extras.update({k: v for k, v in results.items() if k != "ppo"})
     _report(
         _headline.get("value", 0.0),
