@@ -2,56 +2,26 @@
 sota-implementations/impala/).
 
 The IMPALA recipe = policy-gradient learning from STALE behavior data with
-V-trace off-policy correction (Espeholt et al. 2018). The TPU-native shape:
-collection and learning are two jitted programs sharing one param tree;
-each collected batch is reused for several learner epochs, so later epochs
-train on data from an older policy — exactly the actor-lag V-trace absorbs
-(importance ratios between the stored ``sample_log_prob`` and the current
-policy). Run: python examples/impala_cartpole.py
+V-trace off-policy correction (Espeholt et al. 2018), the correction
+recomputed against the CURRENT policy at every learner epoch. This script
+is the thin twin of ``make_impala_trainer`` (and of
+examples/configs/impala_cartpole.yaml). Run: python examples/impala_cartpole.py
 """
 
-import jax
-
-from rl_tpu.collectors import Collector
 from rl_tpu.envs import CartPoleEnv, RewardSum, TransformedEnv, VmapEnv
-from rl_tpu.modules import MLP, Categorical, ProbabilisticActor, TDModule, ValueOperator
-from rl_tpu.objectives import A2CLoss
-from rl_tpu.objectives.value import VTrace
 from rl_tpu.record import CSVLogger
-from rl_tpu.trainers import OnPolicyConfig, OnPolicyProgram, Trainer
+from rl_tpu.trainers import make_impala_trainer
 
 
 def main(total_steps: int = 50, n_envs: int = 32, frames: int = 2048):
     env = TransformedEnv(VmapEnv(CartPoleEnv(), n_envs), RewardSum())
-    actor = ProbabilisticActor(
-        TDModule(MLP(out_features=2, num_cells=(128, 128)), ["observation"], ["logits"]),
-        Categorical,
-        dist_keys=("logits",),
+    trainer = make_impala_trainer(
+        env,
+        total_steps=total_steps,
+        frames_per_batch=frames,
+        logger=CSVLogger("impala_cartpole"),
+        log_interval=5,
     )
-    critic = ValueOperator(MLP(out_features=1, num_cells=(128, 128)))
-    loss = A2CLoss(actor, critic, entropy_coeff=0.01)
-    # V-trace instead of GAE: rho/c-clipped importance weighting makes the
-    # multi-epoch reuse below sound (each epoch after the first is
-    # off-policy w.r.t. the behavior policy that collected the batch)
-    loss.value_estimator = VTrace(
-        lambda p, td: critic(p, td),
-        lambda ap, td: actor.log_prob(ap, td),
-        gamma=0.99,
-        rho_clip=1.0,
-        c_clip=1.0,
-    )
-    coll = Collector(
-        env, lambda p, td, k: actor(p["actor"], td, k), frames_per_batch=frames
-    )
-    program = OnPolicyProgram(
-        coll,
-        loss,
-        OnPolicyConfig(num_epochs=4, minibatch_size=max(64, frames // 2), learning_rate=5e-4),
-        # the point of V-trace: recompute the importance-corrected
-        # advantage against the CURRENT policy at every epoch
-        recompute_advantage=True,
-    )
-    trainer = Trainer(program, total_steps=total_steps, logger=CSVLogger("impala_cartpole"))
     trainer.train(0)
 
 

@@ -3,26 +3,14 @@ sota-implementations/multiagent/mappo_ippo.py).
 
 Centralized-critic PPO over an agent group: per-agent observations under
 ("agents", ...), a shared-parameter agent MLP for the policy, a
-centralized state critic, team reward. The whole collect+GAE+update cycle
-is one jitted program on device.
+centralized state critic, team reward. Thin twin of
+``make_mappo_trainer`` (and of examples/configs/mappo_navigation.yaml).
 Run: python examples/mappo_navigation.py
 """
 
-import jax
-import jax.numpy as jnp
-
-from rl_tpu.collectors import Collector
 from rl_tpu.envs import NavigationEnv, RewardSum, TransformedEnv, VmapEnv
-from rl_tpu.modules import (
-    MLP,
-    MultiAgentMLP,
-    ProbabilisticActor,
-    TanhNormal,
-    ValueOperator,
-)
-from rl_tpu.objectives import MAPPOLoss
 from rl_tpu.record import CSVLogger
-from rl_tpu.trainers import OnPolicyConfig, OnPolicyProgram, Trainer
+from rl_tpu.trainers import make_mappo_trainer
 
 N_AGENTS = 4
 
@@ -31,38 +19,14 @@ def main(total_steps: int = 60, n_envs: int = 16, frames: int = 1024):
     env = TransformedEnv(
         VmapEnv(NavigationEnv(n_agents=N_AGENTS), n_envs), RewardSum()
     )
-    act_dim = env.action_spec.shape[-1]
-    manet = MultiAgentMLP(N_AGENTS, out_features=2 * act_dim, num_cells=(128, 128))
-
-    class GroupActorNet:
-        in_keys = [("agents", "observation")]
-        out_keys = [("loc",), ("scale",)]
-
-        def init(self, key, td):
-            return manet.init(key, td["agents", "observation"])
-
-        def __call__(self, params, td, key=None):
-            loc, raw = jnp.split(
-                manet(params, td["agents", "observation"]), 2, axis=-1
-            )
-            return td.set("loc", loc).set(
-                "scale", jax.nn.softplus(raw + 0.5413) + 1e-4
-            )
-
-    actor = ProbabilisticActor(GroupActorNet(), TanhNormal, dist_keys=("loc", "scale"))
-    critic = ValueOperator(MLP(out_features=1, num_cells=(256, 256)), in_keys=["state"])
-    loss = MAPPOLoss(actor, critic, normalize_advantage=True, entropy_coeff=0.01)
-    loss.make_value_estimator(gamma=0.99, lmbda=0.95)
-
-    coll = Collector(
-        env, lambda p, td, k: actor(p["actor"], td, k), frames_per_batch=frames
+    trainer = make_mappo_trainer(
+        env,
+        total_steps=total_steps,
+        n_agents=N_AGENTS,
+        frames_per_batch=frames,
+        logger=CSVLogger("mappo_navigation"),
+        log_interval=5,
     )
-    program = OnPolicyProgram(
-        coll,
-        loss,
-        OnPolicyConfig(num_epochs=4, minibatch_size=max(64, frames // 4), learning_rate=3e-4),
-    )
-    trainer = Trainer(program, total_steps=total_steps, logger=CSVLogger("mappo_navigation"))
     trainer.train(0)
 
 

@@ -233,6 +233,8 @@ _BUILTINS.update({
     "program/off_policy_config": "rl_tpu.trainers.OffPolicyConfig",
     "trainer/ppo": "rl_tpu.trainers.make_ppo_trainer",
     "trainer/a2c": "rl_tpu.trainers.make_a2c_trainer",
+    "trainer/impala": "rl_tpu.trainers.make_impala_trainer",
+    "trainer/mappo": "rl_tpu.trainers.make_mappo_trainer",
     "trainer/sac": "rl_tpu.trainers.make_sac_trainer",
     "trainer/dqn": "rl_tpu.trainers.make_dqn_trainer",
     "trainer/td3": "rl_tpu.trainers.make_td3_trainer",

@@ -56,6 +56,8 @@ __all__ = [
     "make_td3_trainer",
     "default_continuous_actor",
     "default_discrete_actor",
+    "make_impala_trainer",
+    "make_mappo_trainer",
 ]
 
 
@@ -276,6 +278,104 @@ def make_a2c_trainer(
         coll,
         loss,
         OnPolicyConfig(num_epochs=1, minibatch_size=frames_per_batch, learning_rate=learning_rate),
+    )
+    return _std_hooks(Trainer(program, total_steps, logger=logger), log_interval)
+
+
+def make_impala_trainer(
+    env: EnvBase,
+    total_steps: int,
+    frames_per_batch: int = 2048,
+    num_epochs: int = 4,
+    gamma: float = 0.99,
+    rho_clip: float = 1.0,
+    c_clip: float = 1.0,
+    learning_rate: float = 5e-4,
+    logger: Logger | None = None,
+    log_interval: int = 10,
+    **loss_kwargs,
+) -> Trainer:
+    """IMPALA-style trainer (reference sota-implementations/impala/):
+    A2C objective with the V-trace off-policy correction RECOMPUTED
+    against the current policy at every epoch, so multi-epoch batch reuse
+    is sound (examples/impala_cartpole.py is the script twin)."""
+    from ..data.specs import Categorical as CatSpec
+    from ..objectives import A2CLoss
+    from ..objectives.value import VTrace
+
+    discrete = isinstance(env.action_spec, CatSpec)
+    actor = default_discrete_actor(env) if discrete else default_continuous_actor(env)
+    critic = ValueOperator(MLP(out_features=1, num_cells=(256, 256)))
+    loss = A2CLoss(actor, critic, **loss_kwargs)
+    loss.value_estimator = VTrace(
+        critic, actor.log_prob, gamma=gamma, rho_clip=rho_clip, c_clip=c_clip
+    )
+    coll = Collector(env, lambda p, td, k: actor(p["actor"], td, k), frames_per_batch=frames_per_batch)
+    program = OnPolicyProgram(
+        coll,
+        loss,
+        OnPolicyConfig(
+            num_epochs=num_epochs,
+            minibatch_size=max(64, frames_per_batch // 2),
+            learning_rate=learning_rate,
+        ),
+        recompute_advantage=True,
+    )
+    return _std_hooks(Trainer(program, total_steps, logger=logger), log_interval)
+
+
+def make_mappo_trainer(
+    env: EnvBase,
+    total_steps: int,
+    n_agents: int,
+    frames_per_batch: int = 1024,
+    gamma: float = 0.99,
+    lmbda: float = 0.95,
+    learning_rate: float = 3e-4,
+    logger: Logger | None = None,
+    log_interval: int = 10,
+    **loss_kwargs,
+) -> Trainer:
+    """Centralized-critic MAPPO over an agent group (reference
+    sota-implementations/multiagent/mappo_ippo.py): shared-parameter
+    per-agent policy on ("agents", "observation"), central critic on
+    "state" (examples/mappo_navigation.py is the script twin)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..modules import MultiAgentMLP, TanhNormal
+    from ..objectives import MAPPOLoss
+
+    act_dim = env.action_spec.shape[-1]
+    manet = MultiAgentMLP(n_agents, out_features=2 * act_dim, num_cells=(128, 128))
+
+    class GroupActorNet:
+        in_keys = [("agents", "observation")]
+        out_keys = [("loc",), ("scale",)]
+
+        def init(self, key, td):
+            return manet.init(key, td["agents", "observation"])
+
+        def __call__(self, params, td, key=None):
+            loc, raw = jnp.split(
+                manet(params, td["agents", "observation"]), 2, axis=-1
+            )
+            return td.set("loc", loc).set(
+                "scale", jax.nn.softplus(raw + 0.5413) + 1e-4
+            )
+
+    actor = ProbabilisticActor(GroupActorNet(), TanhNormal, dist_keys=("loc", "scale"))
+    critic = ValueOperator(MLP(out_features=1, num_cells=(256, 256)), in_keys=["state"])
+    loss = MAPPOLoss(actor, critic, normalize_advantage=True, **loss_kwargs)
+    loss.make_value_estimator(gamma=gamma, lmbda=lmbda)
+    coll = Collector(env, lambda p, td, k: actor(p["actor"], td, k), frames_per_batch=frames_per_batch)
+    program = OnPolicyProgram(
+        coll,
+        loss,
+        OnPolicyConfig(
+            minibatch_size=max(64, frames_per_batch // 4),
+            learning_rate=learning_rate,
+        ),
     )
     return _std_hooks(Trainer(program, total_steps, logger=logger), log_interval)
 

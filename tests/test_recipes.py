@@ -43,3 +43,26 @@ def test_pilco_recipe_runs():
     import pilco_pendulum_like
 
     pilco_pendulum_like.main(n_data=40, horizon=4, iters=5)
+
+
+def _run_yaml_twin(name, monkeypatch, tmp_path, **overrides):
+    from rl_tpu.config import instantiate, load_yaml
+
+    cfg = load_yaml(
+        os.path.join(os.path.dirname(__file__), "..", "examples", "configs", name)
+    )
+    cfg["trainer"].update(overrides)
+    monkeypatch.chdir(tmp_path)  # CSV logger writes under cwd
+    instantiate(cfg["trainer"]).train(0)
+
+
+@pytest.mark.slow
+def test_impala_yaml_twin_runs(monkeypatch, tmp_path):
+    _run_yaml_twin("impala_cartpole.yaml", monkeypatch, tmp_path,
+                   total_steps=2, frames_per_batch=256)
+
+
+@pytest.mark.slow
+def test_mappo_yaml_twin_runs(monkeypatch, tmp_path):
+    _run_yaml_twin("mappo_navigation.yaml", monkeypatch, tmp_path,
+                   total_steps=2, frames_per_batch=128)
