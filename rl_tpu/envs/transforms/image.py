@@ -15,9 +15,17 @@ import jax
 import jax.numpy as jnp
 
 from ...data import Bounded, Unbounded
+from .base import Transform
 from .common import _KeyedTransform
 
-__all__ = ["ToFloatImage", "GrayScale", "Resize", "CenterCrop"]
+__all__ = [
+    "ToFloatImage",
+    "GrayScale",
+    "Resize",
+    "CenterCrop",
+    "PixelRender",
+    "cartpole_pixels",
+]
 
 
 class ToFloatImage(_KeyedTransform):
@@ -79,6 +87,92 @@ class Resize(_KeyedTransform):
             leaf = spec[k]
             new_shape = leaf.shape[:-3] + (self.h, self.w, leaf.shape[-1])
             spec = spec.set(k, Unbounded(shape=new_shape, dtype=jnp.float32))
+        return spec
+
+
+def cartpole_pixels(obs, size: int = 84, channels: int = 4):
+    """Render CartPole state vectors to ``[..., size, size, channels]``
+    float32 images in [0, 1], fully on device (pure jnp; vmappable).
+
+    Channel 0: cart marker (gaussian bump along the track at the cart x);
+    channel 1: pole (gaussian splats along the pole segment at angle theta);
+    channels 2/3 (if present): linear / angular velocity broadcast planes.
+    The drawing is smooth (gaussians, not rasterized lines) so the render is
+    differentiable — usable for pixels-based world-model losses too.
+    """
+    x, x_dot, th, th_dot = (obs[..., i] for i in range(4))
+    xs = jnp.linspace(-2.4, 2.4, size)  # track coords, left -> right
+    ys = jnp.linspace(1.2, 0.0, size)  # world y, top row first (image layout)
+    # cart: bump at (x, y=0.1) -------------------------------------------------
+    col = jnp.exp(-((xs - x[..., None]) ** 2) / 0.05)  # [..., W]
+    row = jnp.exp(-((ys - 0.1) ** 2) / 0.01)  # [H]
+    cart = row[..., :, None] * col[..., None, :]  # [..., H, W]
+    # pole: K gaussian splats from the cart pivot to the tip ------------------
+    K, length = 8, 1.0
+    ts = jnp.linspace(0.1, 1.0, K)  # fractions along the pole
+    px = x[..., None] + jnp.sin(th)[..., None] * length * ts  # [..., K]
+    py = 0.1 + jnp.cos(th)[..., None] * length * ts
+    dx2 = (xs - px[..., :, None]) ** 2  # [..., K, W]
+    dy2 = (ys - py[..., :, None]) ** 2  # [..., K, H]
+    splat = jnp.einsum("...kh,...kw->...hw", jnp.exp(-dy2 / 0.01), jnp.exp(-dx2 / 0.01))
+    pole = jnp.clip(splat, 0.0, 1.0)
+    planes = [cart, pole]
+    if channels >= 3:
+        planes.append(jnp.broadcast_to(jnp.tanh(x_dot / 5.0)[..., None, None] * 0.5 + 0.5, cart.shape))
+    if channels >= 4:
+        planes.append(jnp.broadcast_to(jnp.tanh(th_dot / 5.0)[..., None, None] * 0.5 + 0.5, cart.shape))
+    return jnp.stack(planes[:channels], axis=-1).astype(jnp.float32)
+
+
+class PixelRender(Transform):
+    """Device-side state -> pixels renderer, staged into the rollout program.
+
+    The reference gets pixel observations by calling the simulator's host
+    ``render()`` every step (torchrl/envs/libs/gym.py ``from_pixels=True``
+    path) — a host round-trip per frame. On TPU the winning layout is to
+    *draw on device*: ``render_fn`` maps the low-dim observation to an HWC
+    image with pure jnp ops, so pixel PPO/DQN rollouts stay inside one XLA
+    program end to end (no host sync, fusable with the conv policy).
+
+    Args:
+        render_fn: ``obs[..., D] -> image[..., H, W, C]`` pure function
+            (e.g. :func:`cartpole_pixels`).
+        shape: the produced image shape ``(H, W, C)`` for spec transformation.
+        in_key / out_key: source observation key and produced pixels key.
+        keep_obs: if False the source key is dropped from the observation.
+    """
+
+    def __init__(self, render_fn, shape=(84, 84, 4), in_key="observation",
+                 out_key="pixels", keep_obs: bool = True):
+        self.render_fn = render_fn
+        self.shape = tuple(shape)
+        self.in_key, self.out_key = in_key, out_key
+        self.keep_obs = keep_obs
+
+    def _render(self, td):
+        img = self.render_fn(td[self.in_key])
+        if img.shape[-3:] != self.shape:
+            raise ValueError(
+                f"PixelRender: render_fn produced {img.shape[-3:]}, but the "
+                f"declared spec shape is {self.shape} — pass a render_fn "
+                f"matching `shape` (e.g. functools.partial(cartpole_pixels, "
+                f"size=..., channels=...))"
+            )
+        td = td.set(self.out_key, img)
+        if not self.keep_obs:
+            td = td.delete(self.in_key)
+        return td
+
+    def reset(self, tstate, td):
+        return tstate, self._render(td)
+
+    def step(self, tstate, next_td):
+        return tstate, self._render(next_td)
+
+    def transform_observation_spec(self, spec):
+        spec = spec.set(self.out_key, Bounded(shape=self.shape, low=0.0, high=1.0))
+        if not self.keep_obs and self.in_key in spec:
+            spec = spec.delete(self.in_key)
         return spec
 
 

@@ -120,19 +120,23 @@ def apply_ensemble(module: Any, stacked_params, *inputs):
 
 class ConvNet(nn.Module):
     """Conv feature extractor (reference ConvNet, models.py:305): conv stack
-    then flatten. Input layout NHWC (TPU-native; the reference is NCHW)."""
+    then flatten. Input layout NHWC (TPU-native; the reference is NCHW).
+    Default padding is VALID — the reference's torch ``Conv2d`` default
+    (padding=0) — so the Nature-CNN spatial dims match (84x84 -> 20x20 ->
+    9x9 -> 7x7, flatten 3136)."""
 
     channels: Sequence[int] = (32, 64, 64)
     kernel_sizes: Sequence[int] = (8, 4, 3)
     strides: Sequence[int] = (4, 2, 1)
     activation: Any = "relu"
+    padding: str = "VALID"
     dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x):
         act = _activation(self.activation)
         for ch, k, s in zip(self.channels, self.kernel_sizes, self.strides):
-            x = nn.Conv(ch, (k, k), strides=(s, s), dtype=self.dtype)(x)
+            x = nn.Conv(ch, (k, k), strides=(s, s), padding=self.padding, dtype=self.dtype)(x)
             x = act(x)
         return x.reshape(x.shape[:-3] + (-1,))
 

@@ -56,7 +56,7 @@ class _TinyVLANet(nn.Module):
     @nn.compact
     def __call__(self, image, state, instr_ids):
         # image [B, H, W, C] uint8 -> the shared ConvNet feature extractor
-        x = ConvNet(channels=(16, 32), kernel_sizes=(3, 3), strides=(2, 2))(
+        x = ConvNet(channels=(16, 32), kernel_sizes=(3, 3), strides=(2, 2), padding="SAME")(
             image.astype(jnp.float32) / 255.0
         )
         parts = [nn.relu(nn.Dense(self.hidden_dim)(x))]

@@ -314,3 +314,15 @@ class TestSafeModule:
         params = mod.init(KEY, td)
         out = mod(params, td)
         assert float(np.abs(np.asarray(out["action"])).max()) > 0.5
+
+
+class TestConvNetValidPadding:
+    def test_nature_cnn_dims_match_reference(self):
+        """VALID padding (torch Conv2d padding=0 parity): 84x84 -> 3136."""
+        from rl_tpu.modules import ConvNet
+
+        net = ConvNet()
+        x = jnp.zeros((2, 84, 84, 4))
+        params = net.init(KEY, x)["params"]
+        out = net.apply({"params": params}, x)
+        assert out.shape == (2, 3136)

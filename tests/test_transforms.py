@@ -217,3 +217,59 @@ class TestWrappersAndPooling:
             state, td = env.reset(jax.random.key(s))
             assert not bool(td["done"]), "reset returned a done state"
             assert float(td["observation"][0]) <= 1.0  # stops pre-terminal
+
+
+class TestPixelRender:
+    """Device-side state->pixels rendering (round-5; reference analog:
+    gym from_pixels=True host render, torchrl/envs/libs/gym.py)."""
+
+    def test_spec_and_rollout(self):
+        from rl_tpu.envs import CartPoleEnv, PixelRender, cartpole_pixels
+
+        env = TransformedEnv(
+            VmapEnv(CartPoleEnv(), 3),
+            PixelRender(cartpole_pixels, shape=(84, 84, 4), keep_obs=False),
+        )
+        check_env_specs(env, jax.random.key(0))
+        state, td = env.reset(jax.random.key(1))
+        assert td["pixels"].shape == (3, 84, 84, 4)
+        assert "observation" not in td
+        img = np.asarray(td["pixels"])
+        assert img.min() >= 0.0 and img.max() <= 1.0
+        assert img[..., 1].max() > 0.5  # the pole is actually drawn
+
+    def test_render_moves_with_state(self):
+        from rl_tpu.envs import CartPoleEnv, PixelRender, cartpole_pixels
+
+        env = TransformedEnv(
+            CartPoleEnv(), PixelRender(cartpole_pixels, shape=(84, 84, 4))
+        )
+        state, td = env.reset(jax.random.key(0))
+        frames = rollout(env, jax.random.key(1), None, max_steps=8)
+        f = np.asarray(frames["pixels"])
+        assert f.shape == (8, 84, 84, 4)
+        # the cart/pole channels change as the state evolves
+        assert np.abs(f[0, ..., :2] - f[-1, ..., :2]).max() > 0.01
+
+    def test_shape_mismatch_raises(self):
+        from rl_tpu.envs import CartPoleEnv, PixelRender, cartpole_pixels
+
+        env = TransformedEnv(
+            CartPoleEnv(), PixelRender(cartpole_pixels, shape=(64, 64, 2))
+        )
+        with pytest.raises(ValueError, match="declared spec shape"):
+            env.reset(jax.random.key(0))
+
+    def test_partial_render_fn_matches_declared_shape(self):
+        import functools
+
+        from rl_tpu.envs import CartPoleEnv, PixelRender, cartpole_pixels
+
+        env = TransformedEnv(
+            CartPoleEnv(),
+            PixelRender(
+                functools.partial(cartpole_pixels, size=32, channels=2),
+                shape=(32, 32, 2),
+            ),
+        )
+        check_env_specs(env, jax.random.key(0))
