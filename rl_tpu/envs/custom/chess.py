@@ -23,6 +23,13 @@ Conventions:
 - reward: +1 to the mover for delivering checkmate, 0 otherwise; draws
   (stalemate, 50-move rule) terminate with 0; illegal action = forfeit
   (reward -1, episode ends) like TicTacToeEnv.
+- documented deviation: draws by THREEFOLD REPETITION and INSUFFICIENT
+  MATERIAL are not implemented (the reference's python-chess backend ends
+  games on both). A repetition draw needs a position-hash history table —
+  O(history) state per env that the array core deliberately omits;
+  episodes stay bounded via the 50-move counter, but terminal values in
+  shuffle endgames (e.g. bare-kings) can disagree with the reference
+  until the counter trips.
 """
 
 from __future__ import annotations

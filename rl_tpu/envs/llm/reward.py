@@ -139,9 +139,13 @@ class GSM8KScorer:
         resp = _assistant_text(history)
         pred = extract_gsm8k_answer(resp)
         if pred is None:
-            # tolerate tag-free numeric answers at format level only; keep
-            # comma-grouped/decimal numbers whole and normalize like the
-            # extractor ('1,234' -> '1234', not ['1','234'])
+            # Tag-free fallback — intentionally asymmetric shaping: a
+            # CORRECT bare number still earns correct_reward (we don't
+            # punish a right answer for missing '####'), but format_reward
+            # is credit for producing the answer FORMAT, so a wrong
+            # tag-free answer earns 0.0 while a wrong tagged one earns
+            # format_reward. Keep comma-grouped/decimal numbers whole and
+            # normalize like the extractor ('1,234' -> '1234').
             nums = re.findall(r"-?\d[\d,\.]*", resp)
             pred = (
                 nums[-1].replace(",", "").rstrip(".") if nums else None

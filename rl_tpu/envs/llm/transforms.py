@@ -210,15 +210,23 @@ class AdaptiveKLController:
         if transform is not None:
             transform.coeff = self.coef
 
-    def update(self, kl_values) -> float:
+    def update(self, kl_values, n_steps: int | None = None) -> float:
         """``kl_values``: RAW per-sample KL estimates for this batch —
         the masked sums of (log pi − log pi_ref), NOT multiplied by the
         coefficient (a coefficient-scaled input would self-excite: once
         coef grows, coef*KL stays above target and the controller pumps
         the coefficient exponentially regardless of the true policy KL).
-        Returns the new coefficient."""
+
+        ``n_steps``: environment steps since the last ``update`` call —
+        the Ziegler et al. adaptation interval (reference
+        AdaptiveKLController.update, torchrl/envs/llm/transforms/kl.py).
+        Defaults to the batch size, which is correct ONLY when every
+        sample is one step and updates run every batch; with accumulation
+        or large batches pass the true step count (``horizon`` must be in
+        the same units). Returns the new coefficient."""
         kl = np.mean(np.asarray(kl_values, np.float64))
-        n_steps = np.size(kl_values)
+        if n_steps is None:
+            n_steps = np.size(kl_values)
         proportional_error = float(np.clip(kl / self.target - 1.0, -0.2, 0.2))
         self.coef *= 1.0 + proportional_error * n_steps / self.horizon
         if self.transform is not None:

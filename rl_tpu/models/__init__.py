@@ -1,5 +1,5 @@
 from .decision_transformer import DecisionTransformer, DTConfig, DTLoss
-from .generate import GenerateOutput, generate, token_log_probs
+from .generate import GenerateOutput, generate, token_log_probs, token_log_probs_with_aux
 from .act import ACTConfig, ACTModel
 from .rssm import RSSM, DreamerModelLoss, RSSMConfig, dreamer_lambda_returns
 from .rssm_v3 import (
@@ -31,6 +31,7 @@ __all__ = [
     "param_sharding_rules",
     "generate",
     "token_log_probs",
+    "token_log_probs_with_aux",
     "GenerateOutput",
     "RSSM",
     "RSSMConfig",

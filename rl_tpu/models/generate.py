@@ -23,7 +23,12 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 
-__all__ = ["GenerateOutput", "generate", "token_log_probs"]
+__all__ = [
+    "GenerateOutput",
+    "generate",
+    "token_log_probs",
+    "token_log_probs_with_aux",
+]
 
 
 @jax.tree_util.register_dataclass
@@ -136,16 +141,71 @@ def token_log_probs(
     sequences). Padding masks are supported on every attention impl,
     including ``"flash"`` (threaded as ``kv_mask`` into the kernel).
     """
-    if attention_mask is None:
-        positions = None
-        mask = None
-    else:
-        positions = _positions_from_mask(attention_mask)
-        mask = attention_mask.astype(bool)
+    mask, positions = _mask_and_positions(attention_mask)
     logits = model.apply(
         {"params": params}, tokens, attention_mask=mask, positions=positions
     )
+    return _gather_token_log_probs(logits, tokens, temperature)
+
+
+def _mask_and_positions(attention_mask):
+    if attention_mask is None:
+        return None, None
+    return attention_mask.astype(bool), _positions_from_mask(attention_mask)
+
+
+def _gather_token_log_probs(logits, tokens, temperature):
     lp = jax.nn.log_softmax(logits[:, :-1] / jnp.maximum(temperature, 1e-6), axis=-1)
     tgt = tokens[:, 1:]
     out = jnp.take_along_axis(lp, tgt[..., None], axis=-1)[..., 0]
     return jnp.concatenate([jnp.zeros_like(out[:, :1]), out], axis=1)
+
+
+def _collect_sown(tree, name):
+    """All sown values stored under ``name`` anywhere in a mutable-collection
+    tree (flax sow stores tuples of values per call site)."""
+    out = []
+    for k, v in tree.items():
+        if k == name:
+            out.extend(v if isinstance(v, tuple) else (v,))
+        elif hasattr(v, "items"):
+            out.extend(_collect_sown(v, name))
+    return out
+
+
+def token_log_probs_with_aux(
+    model,
+    params,
+    tokens: jax.Array,
+    attention_mask: jax.Array | None = None,
+    temperature: float = 1.0,
+) -> tuple[jax.Array, jax.Array]:
+    """:func:`token_log_probs` variant that ALSO returns the mean Switch
+    load-balancing auxiliary loss over every MoE layer, from ONE forward.
+
+    Pass the result straight into the LM losses — they accept a
+    ``log_prob_fn`` returning ``(log_probs, aux)`` and add
+    ``aux_coeff * aux`` to the objective — so MoE models train with load
+    balancing by default instead of silently collapsing onto a few experts
+    (round-4 ADVICE: the sown ``router_logits`` had no consumer). The
+    attention mask (when given) excludes padding from the balance. For a
+    dense model the aux term is 0.
+    """
+    mask, positions = _mask_and_positions(attention_mask)
+    logits, state = model.apply(
+        {"params": params},
+        tokens,
+        attention_mask=mask,
+        positions=positions,
+        mutable=["intermediates"],
+    )
+    lps = _gather_token_log_probs(logits, tokens, temperature)
+
+    from ..parallel.moe import moe_load_balancing_loss
+
+    router = _collect_sown(dict(state.get("intermediates", {})), "router_logits")
+    if not router:
+        return lps, jnp.zeros((), jnp.float32)
+    flat_mask = None if attention_mask is None else attention_mask.reshape(-1)
+    aux = sum(moe_load_balancing_loss(r, flat_mask) for r in router) / len(router)
+    return lps, aux

@@ -196,9 +196,11 @@ class _MoEFFN(nn.Module):
         n = B * T
         flat = y.reshape(-1, d).astype(cfg.dtype)
         # the ONE router projection: used for dispatch below and sown for
-        # the Switch aux loss (apply(..., mutable=["intermediates"]) then
-        # moe_load_balancing_loss over each router_logits entry, passing
-        # the flattened attention mask so pads don't count)
+        # the Switch aux loss. Consumed by
+        # rl_tpu.models.token_log_probs_with_aux, which the LM losses
+        # (GRPO/CISPO/SFT, aux_coeff=0.01 default) accept as a
+        # (log_probs, aux)-returning log_prob_fn — use it for any MoE
+        # training run or routing WILL collapse onto few experts
         router_logits = flat @ params["router"]
         self.sow("intermediates", "router_logits", router_logits)
         # serving (cache live: prefill OR decode) routes with FULL

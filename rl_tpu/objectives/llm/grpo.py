@@ -24,6 +24,15 @@ __all__ = ["GRPOLoss", "DAPOLoss", "CISPOLoss", "SFTLoss", "mc_advantage",
            "minor_sft_loss"]
 
 
+def _split_lp_aux(out):
+    """log_prob_fn contract: returns [B, T] log-probs, or a
+    (log_probs, aux) tuple (token_log_probs_with_aux) whose aux term the
+    loss adds as ``aux_coeff * aux`` (MoE load balancing)."""
+    if isinstance(out, tuple):
+        return out
+    return out, None
+
+
 def _masked_token_mean(x, mask, per_seq_norm: bool = False):
     m = mask.astype(x.dtype)
     if per_seq_norm:
@@ -36,7 +45,10 @@ class GRPOLoss(LossModule):
     """Group-relative PPO over assistant tokens (reference grpo.py:354).
 
     ``log_prob_fn(params, batch) -> [B, T]`` per-token log-probs of the
-    current policy (rl_tpu.models.token_log_probs partial-applied).
+    current policy (rl_tpu.models.token_log_probs partial-applied) — or
+    ``-> ([B, T], aux)`` (rl_tpu.models.token_log_probs_with_aux) to add
+    ``aux_coeff * aux`` to the objective from the same forward (the MoE
+    Switch load-balancing term; 0.01 is the Fedus et al. default).
     KL regularization vs a frozen reference via the k3 estimator
     (Schulman), coefficient ``kl_coeff``; entropy bonus optional.
     """
@@ -48,8 +60,10 @@ class GRPOLoss(LossModule):
         kl_coeff: float = 0.0,
         entropy_coeff: float = 0.0,
         per_seq_norm: bool = False,
+        aux_coeff: float = 0.01,
     ):
         self.log_prob_fn = log_prob_fn
+        self.aux_coeff = aux_coeff
         if isinstance(clip_epsilon, tuple):
             self.eps_low, self.eps_high = clip_epsilon
         else:
@@ -74,7 +88,7 @@ class GRPOLoss(LossModule):
 
     def __call__(self, params, batch: ArrayDict, key=None):
         mask = batch["assistant_mask"].astype(bool)
-        log_prob = self.log_prob_fn(params, batch)
+        log_prob, aux = _split_lp_aux(self.log_prob_fn(params, batch))
         behav = jax.lax.stop_gradient(batch["sample_log_prob"])
         log_ratio = jnp.where(mask, log_prob - behav, 0.0)
         ratio = jnp.exp(log_ratio)
@@ -106,6 +120,10 @@ class GRPOLoss(LossModule):
             total = total - self.entropy_coeff * ent
             metrics = metrics.set("entropy", jax.lax.stop_gradient(ent))
 
+        if aux is not None and self.aux_coeff:
+            total = total + self.aux_coeff * aux
+            metrics = metrics.set("loss_aux", jax.lax.stop_gradient(aux))
+
         return total, metrics.set("loss", total)
 
 
@@ -123,7 +141,7 @@ class CISPOLoss(GRPOLoss):
 
     def __call__(self, params, batch: ArrayDict, key=None):
         mask = batch["assistant_mask"].astype(bool)
-        log_prob = self.log_prob_fn(params, batch)
+        log_prob, aux = _split_lp_aux(self.log_prob_fn(params, batch))
         behav = jax.lax.stop_gradient(batch["sample_log_prob"])
         log_ratio = jnp.where(mask, log_prob - behav, 0.0)
         ratio = jax.lax.stop_gradient(
@@ -134,9 +152,13 @@ class CISPOLoss(GRPOLoss):
             adv = adv[:, None]
         adv = jax.lax.stop_gradient(adv)
         loss = -_masked_token_mean(ratio * adv * log_prob, mask, self.per_seq_norm)
-        return loss, ArrayDict(
-            loss=loss, kl_approx=_masked_token_mean(jax.lax.stop_gradient(-log_ratio), mask)
+        metrics = ArrayDict(
+            kl_approx=_masked_token_mean(jax.lax.stop_gradient(-log_ratio), mask)
         )
+        if aux is not None and self.aux_coeff:
+            loss = loss + self.aux_coeff * aux
+            metrics = metrics.set("loss_aux", jax.lax.stop_gradient(aux))
+        return loss, metrics.set("loss", loss)
 
 
 def mc_advantage(
@@ -184,6 +206,7 @@ class SFTLoss(LossModule):
         loss_function: str = "sft",
         beta: float = 0.1,
         kl_to_ref_coeff: float | None = None,
+        aux_coeff: float = 0.01,
     ):
         if loss_function not in ("sft", "minor_sft"):
             raise ValueError(f"loss_function must be sft|minor_sft, got {loss_function!r}")
@@ -199,6 +222,7 @@ class SFTLoss(LossModule):
         self.beta = beta
         # minor_sft's KL regularization is implicit (reference sft.py:291)
         self.kl_to_ref_coeff = None if loss_function == "minor_sft" else kl_to_ref_coeff
+        self.aux_coeff = aux_coeff
 
     def init_params(self, key, td):
         raise NotImplementedError("SFTLoss wraps an externally-initialized model")
@@ -213,7 +237,9 @@ class SFTLoss(LossModule):
 
     def __call__(self, params, batch: ArrayDict, key=None):
         mask = batch["assistant_mask"].astype(bool)
-        log_prob = self.log_prob_fn(params, batch)
+        log_prob, aux = _split_lp_aux(self.log_prob_fn(params, batch))
+        if aux is not None and not self.aux_coeff:
+            aux = None
         metrics = ArrayDict()
         if self.loss_function == "minor_sft":
             # SUMMED per-sequence log-probs — the reference/paper form
@@ -221,10 +247,13 @@ class SFTLoss(LossModule):
             lp_seq = jnp.sum(jnp.where(mask, log_prob, 0.0), axis=-1)
             ref_seq = jnp.sum(self._ref_log_probs(batch, mask), axis=-1)
             loss = jnp.mean(minor_sft_loss(lp_seq, ref_seq, self.beta))
-            return loss, ArrayDict(
-                loss=loss,
+            metrics = ArrayDict(
                 log_ratio=jax.lax.stop_gradient(jnp.mean(lp_seq - ref_seq)),
             )
+            if aux is not None:
+                loss = loss + self.aux_coeff * aux
+                metrics = metrics.set("loss_aux", jax.lax.stop_gradient(aux))
+            return loss, metrics.set("loss", loss)
         nll = -_masked_token_mean(log_prob, mask)
         loss = nll
         if self.label_smoothing > 0.0:
@@ -246,6 +275,9 @@ class SFTLoss(LossModule):
             kl = _masked_token_mean(jnp.exp(d) - 1.0 - d, mask)
             loss = loss + self.kl_to_ref_coeff * kl
             metrics = metrics.set("kl_to_ref", jax.lax.stop_gradient(kl))
+        if aux is not None:
+            loss = loss + self.aux_coeff * aux
+            metrics = metrics.set("loss_aux", jax.lax.stop_gradient(aux))
         return loss, metrics.update(
             ArrayDict(loss=loss, nll=jax.lax.stop_gradient(nll))
         )

@@ -31,7 +31,13 @@ from ..envs.llm.chat import DatasetChatEnv
 from ..envs.llm.datasets import QADataset
 from ..envs.llm.reward import ExactMatchScorer, SumScorer, combine_scorers
 from ..envs.llm.transforms import KLRewardTransform, PolicyVersion
-from ..models import TransformerConfig, TransformerLM, generate, token_log_probs
+from ..models import (
+    TransformerConfig,
+    TransformerLM,
+    generate,
+    token_log_probs,
+    token_log_probs_with_aux,
+)
 from ..objectives.llm.grpo import GRPOLoss
 from ..weight_update.schemes import DevicePutScheme
 
@@ -149,8 +155,15 @@ class GRPOTrainer:
             weight_scheme=self.scheme,
             reward_transform=reward_transform,
         )
+        # MoE configs score through the aux-returning path so the Switch
+        # load-balancing term trains by default (routing collapses without it)
+        _score = (
+            token_log_probs_with_aux
+            if getattr(self.train_model.cfg, "moe_experts", 0)
+            else token_log_probs
+        )
         self.loss = GRPOLoss(
-            lambda p, b: token_log_probs(
+            lambda p, b: _score(
                 self.train_model, p, b["tokens"], b["attention_mask"]
             ),
             clip_epsilon=clip_epsilon,
