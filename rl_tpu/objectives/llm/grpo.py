@@ -33,6 +33,15 @@ def _split_lp_aux(out):
     return out, None
 
 
+def _apply_aux(loss, metrics, aux, aux_coeff):
+    """Add ``aux_coeff * aux`` (when both are present) and record the metric."""
+    if aux is None or not aux_coeff:
+        return loss, metrics
+    return loss + aux_coeff * aux, metrics.set(
+        "loss_aux", jax.lax.stop_gradient(aux)
+    )
+
+
 def _masked_token_mean(x, mask, per_seq_norm: bool = False):
     m = mask.astype(x.dtype)
     if per_seq_norm:
@@ -120,9 +129,7 @@ class GRPOLoss(LossModule):
             total = total - self.entropy_coeff * ent
             metrics = metrics.set("entropy", jax.lax.stop_gradient(ent))
 
-        if aux is not None and self.aux_coeff:
-            total = total + self.aux_coeff * aux
-            metrics = metrics.set("loss_aux", jax.lax.stop_gradient(aux))
+        total, metrics = _apply_aux(total, metrics, aux, self.aux_coeff)
 
         return total, metrics.set("loss", total)
 
@@ -155,9 +162,7 @@ class CISPOLoss(GRPOLoss):
         metrics = ArrayDict(
             kl_approx=_masked_token_mean(jax.lax.stop_gradient(-log_ratio), mask)
         )
-        if aux is not None and self.aux_coeff:
-            loss = loss + self.aux_coeff * aux
-            metrics = metrics.set("loss_aux", jax.lax.stop_gradient(aux))
+        loss, metrics = _apply_aux(loss, metrics, aux, self.aux_coeff)
         return loss, metrics.set("loss", loss)
 
 
@@ -238,8 +243,6 @@ class SFTLoss(LossModule):
     def __call__(self, params, batch: ArrayDict, key=None):
         mask = batch["assistant_mask"].astype(bool)
         log_prob, aux = _split_lp_aux(self.log_prob_fn(params, batch))
-        if aux is not None and not self.aux_coeff:
-            aux = None
         metrics = ArrayDict()
         if self.loss_function == "minor_sft":
             # SUMMED per-sequence log-probs — the reference/paper form
@@ -250,9 +253,7 @@ class SFTLoss(LossModule):
             metrics = ArrayDict(
                 log_ratio=jax.lax.stop_gradient(jnp.mean(lp_seq - ref_seq)),
             )
-            if aux is not None:
-                loss = loss + self.aux_coeff * aux
-                metrics = metrics.set("loss_aux", jax.lax.stop_gradient(aux))
+            loss, metrics = _apply_aux(loss, metrics, aux, self.aux_coeff)
             return loss, metrics.set("loss", loss)
         nll = -_masked_token_mean(log_prob, mask)
         loss = nll
@@ -275,9 +276,7 @@ class SFTLoss(LossModule):
             kl = _masked_token_mean(jnp.exp(d) - 1.0 - d, mask)
             loss = loss + self.kl_to_ref_coeff * kl
             metrics = metrics.set("kl_to_ref", jax.lax.stop_gradient(kl))
-        if aux is not None:
-            loss = loss + self.aux_coeff * aux
-            metrics = metrics.set("loss_aux", jax.lax.stop_gradient(aux))
+        loss, metrics = _apply_aux(loss, metrics, aux, self.aux_coeff)
         return loss, metrics.update(
             ArrayDict(loss=loss, nll=jax.lax.stop_gradient(nll))
         )

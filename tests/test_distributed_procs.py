@@ -67,3 +67,59 @@ def test_two_process_group_replay_and_weights():
     for rank, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"rank {rank} failed:\n{out}"
         assert f"DIST_OK rank={rank}" in out, out
+
+
+def _spawn_mesh_workers(mode: str, world: int, timeout: float = 420.0):
+    worker = os.path.join(os.path.dirname(__file__), "dist_worker_mesh.py")
+    coord = f"127.0.0.1:{_free_port()}"
+    procs = []
+    for rank in range(world):
+        env = dict(os.environ)
+        env.update(
+            DIST_RANK=str(rank),
+            DIST_WORLD=str(world),
+            DIST_COORD=coord,
+            DIST_MODE=mode,
+            XLA_FLAGS="--xla_force_host_platform_device_count=1",
+        )
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, worker],
+                env=env,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+                text=True,
+            )
+        )
+    outs = [None] * world
+    import time as _time
+
+    deadline = _time.monotonic() + timeout  # SHARED budget, not per-rank
+    try:
+        for i, p in enumerate(procs):
+            out, _ = p.communicate(timeout=max(1.0, deadline - _time.monotonic()))
+            outs[i] = out
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        pytest.fail(f"{mode} workers wedged; partial output: {outs}")
+    for rank, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"{mode} rank {rank} failed:\n{out}"
+        assert f"DIST_OK rank={rank}" in out, out
+
+
+@pytest.mark.dist
+def test_eight_process_dp_mesh_collect_and_train():
+    """8 procs x 1 device: MeshCollector shards -> one global batch -> DP
+    train step with cross-process psum checked vs the analytic oracle
+    (round-4 VERDICT next-step #2a)."""
+    _spawn_mesh_workers("dp8", 8)
+
+
+@pytest.mark.dist
+def test_four_process_2x2_dp_tp_transformer_forward():
+    """4 procs as a 2x2 (data, model) mesh: the Megatron-sharded
+    TransformerLM forward's TP all-reduces cross real process boundaries;
+    logits match the unsharded local oracle on every rank (round-4
+    VERDICT next-step #2b)."""
+    _spawn_mesh_workers("dptp4", 4)

@@ -294,7 +294,10 @@ class TestAuxLossWiring:
         m, params, toks = self._moe_model()
         lp, aux = token_log_probs_with_aux(m, params, toks)
         assert jnp.allclose(lp, token_log_probs(m, params, toks), atol=1e-5)
-        assert float(aux) >= 1.0  # E * sum f*p is minimized at 1
+        # balanced EXPECTATION is 1, but the finite-sample value can dip
+        # below when top-1 fractions anti-correlate with mean probs —
+        # assert positivity only (gradient engagement has its own test)
+        assert float(aux) > 0.0
 
     def test_grpo_engages_router_gradient(self):
         from rl_tpu.data import ArrayDict
