@@ -51,6 +51,7 @@ from .replay import (
 )
 
 __all__ = ["MinariH5Dataset", "AtariDQNDataset", "LeRobotDataset",
+           "D4RLH5Dataset", "OpenXDataset",
            "atari_name_to_key", "lerobot_key"]
 
 # reference minari_data.py:57 _NAME_MATCH
@@ -498,5 +499,268 @@ class LeRobotDataset(_OfflineDataset):
 
         self.buffer, self.state = _sealed_buffer(
             td, n, sampler=sampler, batch_size=batch_size,
+            scratch_dir=scratch_dir,
+        )
+
+
+class D4RLH5Dataset(_OfflineDataset):
+    """Load a D4RL HDF5 file (the direct-download layout) into a replay
+    buffer — format-exact with the reference's processing pipeline
+    (reference torchrl/data/datasets/d4rl.py:250 ``_get_dataset_direct_
+    download`` -> :377 ``_process_data_from_env`` -> :450
+    ``_shift_reward_done``).
+
+    On-disk keys: ``observations`` / ``actions`` / ``rewards`` /
+    ``terminals`` (+ optional ``timeouts``, ``next_observations``,
+    ``infos/*``, ``metadata/*``), all with T rows (D4RL stores reward and
+    the terminal flag aligned with the transition ``(s_t, a_t)``).
+
+    Reference-exact quirks reproduced here:
+
+    - ``use_truncated_as_done`` (default True): ``done = terminals |
+      timeouts``; otherwise ``done = terminals`` only.
+    - reward/done/terminated/truncated land UNSHIFTED under ``next``
+      (the reward earned BY this transition), then the ROOT copies are
+      shifted forward one step with a zero first row
+      (``_shift_reward_done``): root flags mark "the previous transition
+      ended an episode".
+    - with ``next_observations`` present, rows align 1:1 and the LAST
+      row is dropped (reference ``dataset[:-1]``); without it, next obs
+      is the global ``observations[1:]`` shift and the last row is
+      dropped — episode-boundary transitions are KEPT, exactly as the
+      reference's direct-download path keeps them (its d4rl
+      ``qlearning_dataset`` path is the one that filters; callers who
+      want boundary-free data filter on ``next.done``).
+    - ``metadata/*`` is exposed as :attr:`metadata`, not stored;
+      ``infos/*`` lands under ``info`` (root and shifted-next views).
+
+    Shape deviation (deliberate): reward and the done flags are stored
+    with the framework's scalar-per-step convention ``[T]`` — this
+    framework's reward specs are shape ``()`` — not the reference's
+    trailing-singleton ``[T, 1]``.
+    """
+
+    def __init__(
+        self,
+        path: str | Path,
+        *,
+        use_truncated_as_done: bool = True,
+        batch_size: int | None = 256,
+        sampler=None,
+        scratch_dir: str | None = None,
+    ):
+        import h5py
+
+        raw: dict[tuple, np.ndarray] = {}
+        self.metadata: dict = {}
+        with h5py.File(str(path), "r") as f:
+            def visit(name, node):
+                if not hasattr(node, "shape"):  # group
+                    return
+                parts = tuple(name.split("/"))
+                if parts[0] == "metadata":
+                    self.metadata["/".join(parts[1:])] = np.asarray(node[()])
+                    return
+                raw[parts] = np.asarray(node[()])
+
+            f.visititems(lambda n, o: visit(n, o))
+
+        for req in ("observations", "actions", "rewards", "terminals"):
+            if (req,) not in raw:
+                raise ValueError(f"{path}: missing required D4RL key {req!r}")
+        T = raw[("rewards",)].shape[0]
+
+        obs = raw.pop(("observations",))
+        act = raw.pop(("actions",))
+        rew = np.asarray(raw.pop(("rewards",)), np.float32).reshape(T)
+        terminated = np.asarray(raw.pop(("terminals",)), bool).reshape(T)
+        truncated = (
+            np.asarray(raw.pop(("timeouts",)), bool).reshape(T)
+            if ("timeouts",) in raw
+            else None
+        )
+        next_obs = raw.pop(("next_observations",), None)
+        infos = {p[1:]: a for p, a in raw.items() if p[0] == "infos"}
+
+        if truncated is not None and use_truncated_as_done:
+            done = terminated | truncated
+        else:
+            done = terminated.copy()
+
+        # next view: unshifted flags/reward; root view: shifted (+zero row 0)
+        def shift(x):
+            out = np.zeros_like(x)
+            out[1:] = x[:-1]
+            return out
+
+        n = T - 1  # reference: dataset = dataset[:-1]
+        td = ArrayDict(
+            observation=obs[:-1],
+            action=act[:-1],
+            reward=shift(rew)[:-1],
+            done=shift(done)[:-1],
+            terminated=shift(terminated)[:-1],
+        )
+        nxt = ArrayDict(
+            observation=(next_obs[:-1] if next_obs is not None else obs[1:]),
+            reward=rew[:-1],
+            done=done[:-1],
+            terminated=terminated[:-1],
+        )
+        if truncated is not None:
+            td = td.set("truncated", shift(truncated)[:-1])
+            nxt = nxt.set("truncated", truncated[:-1])
+        for sub, arr in infos.items():
+            td = td.set(("info",) + sub, arr[:-1])
+            nxt = nxt.set(("info",) + sub, arr[1:])
+        td = td.set("next", nxt)
+
+        self.n_steps = n
+        self.buffer, self.state = _sealed_buffer(
+            td, n, sampler=sampler, batch_size=batch_size, scratch_dir=scratch_dir
+        )
+
+
+# reference openx.py:752 OPENX_KEY_MAP (RLDS step schema -> TED layout)
+_OPENX_KEY_MAP = {
+    "is_first": ("is_init",),
+    "is_last": ("next", "done"),
+    "is_terminal": ("next", "terminated"),
+    "reward": ("next", "reward"),
+}
+
+
+class OpenXDataset(_OfflineDataset):
+    """Open X-Embodiment episodes (the RLDS step schema) into a replay
+    buffer — format-exact with the reference's conversion (reference
+    torchrl/data/datasets/openx.py:760 ``_format_data``; the reference
+    reads the HF mirror's ``data.pickle["steps"]`` records and this
+    loader accepts exactly that step layout).
+
+    Args:
+        episodes: an iterable of episodes; each episode is either a list
+            of RLDS step dicts (keys ``observation`` (possibly nested),
+            ``action``, ``reward``, ``is_first``, ``is_last``,
+            ``is_terminal``, optional ``language_instruction`` /
+            ``discount``) or a dict with a ``"steps"`` list (the
+            ``data.pickle`` record shape). Pickle files holding either
+            form are accepted as paths.
+
+    Reference-exact conversion, per episode:
+
+    - ``next.observation`` = observations shifted by one, ZERO-padded at
+      the end (reference ``pad(observation_[1:], [0, 1])`` — the final
+      step keeps a zero successor, not a copy);
+    - ``is_first -> is_init``, ``is_last -> next.done``, ``is_terminal ->
+      next.terminated``, ``reward -> next.reward``;
+    - ``next.truncated = next.done & ~next.terminated``;
+    - root done/terminated/truncated are ZERO (the reference zeroes them;
+      root ``is_init`` carries the episode-start marker);
+    - an ``episode`` id column is added. Flags/reward keep the
+      framework's scalar-per-step shape ``[T]`` (deviation from the
+      reference's trailing singleton, matching this framework's specs).
+
+    ``language_instruction`` (when present) is exposed per-step via
+    :attr:`instructions` (host strings — the reference stores NonTensorData).
+    """
+
+    def __init__(
+        self,
+        episodes,
+        *,
+        batch_size: int | None = 256,
+        sampler=None,
+        scratch_dir: str | None = None,
+    ):
+        rows = []
+        self.instructions: list[str] = []
+        n_eps = 0
+        for ep_id, episode in enumerate(episodes):
+            if isinstance(episode, (str, Path)):
+                import pickle
+
+                with open(episode, "rb") as fh:
+                    episode = pickle.load(fh)
+            if isinstance(episode, dict):
+                episode = episode["steps"]
+            steps = list(episode)
+            if not steps:
+                raise ValueError(f"episode {ep_id}: empty step list")
+            T = len(steps)
+            n_eps += 1
+
+            def stack(key_path):
+                vals = []
+                for s in steps:
+                    v = s
+                    for k in key_path:
+                        v = v[k]
+                    vals.append(np.asarray(v))
+                return np.stack(vals, axis=0)
+
+            td = ArrayDict(episode=np.full((T,), ep_id, np.int32))
+            nxt = ArrayDict()
+
+            # observation subtree (possibly nested dicts)
+            def obs_leaves(prefix, node):
+                if isinstance(node, dict):
+                    for k, v in node.items():
+                        yield from obs_leaves(prefix + (k,), v)
+                else:
+                    yield prefix
+
+            for leaf in obs_leaves((), steps[0]["observation"]):
+                arr = stack(("observation",) + leaf)
+                pad = np.concatenate(
+                    [arr[1:], np.zeros_like(arr[:1])], axis=0
+                )  # zero-padded successor, reference pad(observation_[1:], [0,1])
+                td = td.set(("observation",) + leaf, arr)
+                nxt = nxt.set(("observation",) + leaf, pad)
+
+            td = td.set("action", stack(("action",)))
+            if "discount" in steps[0]:
+                td = td.set("discount", np.asarray(stack(("discount",)), np.float32))
+
+            flags = {}
+            for src, dst in _OPENX_KEY_MAP.items():
+                arr = stack((src,))
+                arr = np.asarray(arr, np.float32 if src == "reward" else bool)
+                flags[dst] = arr.reshape(T)
+            td = td.set("is_init", flags[("is_init",)])
+            nxt = nxt.set("done", flags[("next", "done")])
+            nxt = nxt.set("terminated", flags[("next", "terminated")])
+            nxt = nxt.set("reward", flags[("next", "reward")])
+            nxt = nxt.set(
+                "truncated", nxt["done"] & ~nxt["terminated"]
+            )
+            # reference zeroes the root copies of every flag
+            for k in ("done", "terminated", "truncated"):
+                td = td.set(k, np.zeros_like(nxt[k]))
+
+            # per-ROW list (padded with "" for instruction-less episodes) so
+            # instructions[i] always matches global row i
+            self.instructions.extend(
+                str(s.get("language_instruction", "")) for s in steps
+            )
+            rows.append(td.set("next", nxt))
+
+        flat = rows[0]
+        if len(rows) > 1:
+            import jax
+
+            ref_keys = set(rows[0].keys(nested=True, leaves_only=True))
+            for i, r in enumerate(rows[1:], 1):
+                keys = set(r.keys(nested=True, leaves_only=True))
+                if keys != ref_keys:
+                    raise ValueError(
+                        f"episode {i} schema mismatch vs episode 0: "
+                        f"missing {sorted(ref_keys - keys)}, "
+                        f"extra {sorted(keys - ref_keys)}"
+                    )
+            flat = jax.tree.map(lambda *xs: np.concatenate(xs, axis=0), *rows)
+        self.n_episodes = n_eps
+        self.n_steps = int(flat["episode"].shape[0])
+        self.buffer, self.state = _sealed_buffer(
+            flat, self.n_steps, sampler=sampler, batch_size=batch_size,
             scratch_dir=scratch_dir,
         )

@@ -352,3 +352,270 @@ class TestLeRobot:
             ArrayDict(action=jnp.asarray(np.asarray(data["action"]))[None])
         )
         assert td["vla_action", "chunk"].shape == (1, 8, 3, 2)
+
+
+def write_d4rl_fixture(path, T=20, obs_dim=3, act_dim=2, *, with_next_obs=False,
+                       with_timeouts=True, with_infos=True, seed=0):
+    """The exact D4RL direct-download HDF5 layout: flat T-row arrays
+    observations/actions/rewards/terminals (+timeouts, infos/*, metadata/*)."""
+    import h5py
+
+    rng = np.random.default_rng(seed)
+    data = {
+        "observations": rng.normal(size=(T, obs_dim)).astype(np.float32),
+        "actions": rng.normal(size=(T, act_dim)).astype(np.float32),
+        "rewards": rng.normal(size=(T,)).astype(np.float32),
+        "terminals": np.zeros((T,), bool),
+    }
+    data["terminals"][T // 2] = True  # mid-dataset episode end
+    if with_timeouts:
+        data["timeouts"] = np.zeros((T,), bool)
+        data["timeouts"][3 * T // 4] = True  # truncation-only boundary
+    if with_next_obs:
+        data["next_observations"] = rng.normal(size=(T, obs_dim)).astype(np.float32)
+    with h5py.File(path, "w") as f:
+        for k, v in data.items():
+            f.create_dataset(k, data=v)
+        if with_infos:
+            f.create_dataset("infos/qpos", data=rng.normal(size=(T, 2)).astype(np.float32))
+        f.create_dataset("metadata/algorithm", data=np.bytes_(b"sac"))
+    return data
+
+
+class TestD4RL:
+    """Oracle = the reference pipeline applied by hand (d4rl.py:377+450):
+    next carries UNSHIFTED reward/flags, root carries the one-step shift,
+    next_obs = observations[1:] (or next_observations[:-1]), last row dropped."""
+
+    def test_shift_and_next_semantics(self, tmp_path):
+        from rl_tpu.data import D4RLH5Dataset
+
+        raw = write_d4rl_fixture(tmp_path / "d.hdf5", T=20)
+        ds = D4RLH5Dataset(tmp_path / "d.hdf5", scratch_dir=str(tmp_path / "mm"))
+        assert ds.n_steps == 19
+        state = ds.state
+        batch = ds.sample(jax.random.key(1), 512)  # the sampling surface works
+        # bit-match rows read deterministically through the storage
+        got = jax.tree.map(np.asarray, ds.buffer.storage.get(state["storage"], jnp.arange(19)))
+        done = raw["terminals"] | raw["timeouts"]
+        np.testing.assert_array_equal(got["observation"], raw["observations"][:-1])
+        np.testing.assert_array_equal(got["action"], raw["actions"][:-1])
+        np.testing.assert_array_equal(
+            got["next"]["observation"], raw["observations"][1:]
+        )
+        # next = unshifted
+        np.testing.assert_allclose(
+            got["next"]["reward"], raw["rewards"][:-1], rtol=1e-6
+        )
+        np.testing.assert_array_equal(got["next"]["terminated"], raw["terminals"][:-1])
+        np.testing.assert_array_equal(got["next"]["truncated"], raw["timeouts"][:-1])
+        np.testing.assert_array_equal(got["next"]["done"], done[:-1])
+        # root = shifted by one with zero row 0 (reference _shift_reward_done)
+        np.testing.assert_allclose(got["reward"][1:], raw["rewards"][:-2], rtol=1e-6)
+        assert float(got["reward"][0]) == 0.0
+        np.testing.assert_array_equal(got["done"][1:], done[:-2])
+        assert not bool(got["done"][0])
+        # infos present under both views
+        assert got["info"]["qpos"].shape == (19, 2)
+        assert ds.metadata["algorithm"] == b"sac"
+        assert batch["observation"].shape[0] == 512
+
+    def test_next_observations_key_wins(self, tmp_path):
+        from rl_tpu.data import D4RLH5Dataset
+
+        raw = write_d4rl_fixture(tmp_path / "d.hdf5", T=12, with_next_obs=True)
+        ds = D4RLH5Dataset(tmp_path / "d.hdf5", scratch_dir=str(tmp_path / "mm"))
+        got = jax.tree.map(np.asarray, ds.buffer.storage.get(ds.state["storage"], jnp.arange(11)))
+        np.testing.assert_array_equal(
+            got["next"]["observation"], raw["next_observations"][:-1]
+        )
+
+    def test_use_truncated_as_done_false(self, tmp_path):
+        from rl_tpu.data import D4RLH5Dataset
+
+        raw = write_d4rl_fixture(tmp_path / "d.hdf5", T=16)
+        ds = D4RLH5Dataset(
+            tmp_path / "d.hdf5", use_truncated_as_done=False,
+            scratch_dir=str(tmp_path / "mm"),
+        )
+        got = jax.tree.map(np.asarray, ds.buffer.storage.get(ds.state["storage"], jnp.arange(15)))
+        # timeouts no longer fold into done
+        np.testing.assert_array_equal(got["next"]["done"], raw["terminals"][:-1])
+
+    def test_missing_required_key_raises(self, tmp_path):
+        import h5py
+
+        from rl_tpu.data import D4RLH5Dataset
+
+        with h5py.File(tmp_path / "bad.hdf5", "w") as f:
+            f.create_dataset("observations", data=np.zeros((4, 2), np.float32))
+        with pytest.raises(ValueError, match="missing required D4RL key"):
+            D4RLH5Dataset(tmp_path / "bad.hdf5")
+
+
+def make_openx_episode(T, terminal=True, instruction="pick up the block", seed=0):
+    """RLDS step records exactly as the reference reads them from the HF
+    mirror's data.pickle['steps'] (openx.py:513)."""
+    rng = np.random.default_rng(seed)
+    steps = []
+    for t in range(T):
+        steps.append(
+            {
+                "observation": {
+                    "state": rng.normal(size=(4,)).astype(np.float32),
+                    "image": rng.integers(0, 255, size=(6, 6, 3)).astype(np.uint8),
+                },
+                "action": rng.normal(size=(3,)).astype(np.float32),
+                "reward": np.float32(t * 0.5),
+                "is_first": t == 0,
+                "is_last": t == T - 1,
+                "is_terminal": terminal and t == T - 1,
+                "language_instruction": instruction,
+            }
+        )
+    return steps
+
+
+class TestOpenX:
+    """Oracle = reference _format_data (openx.py:760): zero-padded next
+    obs, key map, truncated = done & ~terminated, zeroed root flags."""
+
+    def test_format_exact_conversion(self, tmp_path):
+        from rl_tpu.data import OpenXDataset
+
+        eps = [make_openx_episode(5, terminal=True, seed=1),
+               make_openx_episode(3, terminal=False, seed=2)]
+        ds = OpenXDataset(eps, scratch_dir=str(tmp_path / "mm"))
+        assert ds.n_episodes == 2 and ds.n_steps == 8
+        got = jax.tree.map(np.asarray, ds.buffer.storage.get(ds.state["storage"], jnp.arange(8)))
+
+        obs0 = np.stack([s["observation"]["state"] for s in eps[0]])
+        np.testing.assert_array_equal(got["observation"]["state"][:5], obs0)
+        # next obs: shifted with a ZERO final row (reference pad)
+        np.testing.assert_array_equal(got["next"]["observation"]["state"][:4], obs0[1:])
+        np.testing.assert_array_equal(
+            got["next"]["observation"]["state"][4], np.zeros(4, np.float32)
+        )
+        # key map
+        np.testing.assert_array_equal(
+            got["is_init"][:5], [True, False, False, False, False]
+        )
+        np.testing.assert_array_equal(
+            got["next"]["done"][:5], [False, False, False, False, True]
+        )
+        np.testing.assert_array_equal(
+            got["next"]["terminated"][:5], [False, False, False, False, True]
+        )
+        np.testing.assert_allclose(got["next"]["reward"][:5], np.arange(5) * 0.5)
+        # ep 2 ends is_last but NOT terminal -> truncated
+        np.testing.assert_array_equal(got["next"]["truncated"][5:], [False, False, True])
+        np.testing.assert_array_equal(got["next"]["terminated"][5:], [False, False, False])
+        # root flags all zero (reference zeroes them)
+        for k in ("done", "terminated", "truncated"):
+            assert not got[k].any()
+        np.testing.assert_array_equal(got["episode"], [0] * 5 + [1] * 3)
+        assert ds.instructions[0] == "pick up the block"
+        assert got["observation"]["image"].dtype == np.uint8
+
+    def test_pickle_record_form(self, tmp_path):
+        import pickle
+
+        from rl_tpu.data import OpenXDataset
+
+        rec = {"steps": make_openx_episode(4, seed=3)}
+        p = tmp_path / "ep0.pkl"
+        with open(p, "wb") as fh:
+            pickle.dump(rec, fh)
+        ds = OpenXDataset([p], scratch_dir=str(tmp_path / "mm"))
+        assert ds.n_steps == 4
+
+    def test_empty_episode_raises(self):
+        from rl_tpu.data import OpenXDataset
+
+        with pytest.raises(ValueError, match="empty step list"):
+            OpenXDataset([[]])
+
+
+class TestD4RLFeedsOffline:
+    @pytest.mark.slow
+    def test_d4rl_feeds_td3bc(self, tmp_path):
+        """The D4RL loader drives TD3+BC end to end (round-4 VERDICT
+        next-step #3: the new formats must feed the offline algorithms)."""
+        import optax
+
+        from rl_tpu.modules import ConcatMLP, TDModule, TanhPolicy
+        from rl_tpu.objectives import TD3BCLoss
+        from rl_tpu.data import D4RLH5Dataset
+
+        # structured expert: a = tanh(obs[:, :2]) — learnable by the BC term
+        import h5py
+
+        rng = np.random.default_rng(5)
+        T = 64
+        obs = rng.normal(size=(T, 4)).astype(np.float32)
+        with h5py.File(tmp_path / "d.hdf5", "w") as f:
+            f.create_dataset("observations", data=obs)
+            f.create_dataset("actions", data=np.tanh(obs[:, :2]))
+            f.create_dataset("rewards", data=rng.normal(size=(T,)).astype(np.float32))
+            f.create_dataset("terminals", data=np.zeros((T,), bool))
+        ds = D4RLH5Dataset(tmp_path / "d.hdf5", scratch_dir=str(tmp_path / "mm"),
+                         batch_size=32)
+
+        actor = TDModule(
+            TanhPolicy(action_dim=2, num_cells=(32,)), ["observation"], ["action"]
+        )
+        loss = TD3BCLoss(
+            actor, ConcatMLP(out_features=1, num_cells=(32,)),
+            action_low=-1.0, action_high=1.0,
+        )
+        batch0 = ds.sample(KEY)
+        params = loss.init_params(KEY, batch0)
+        opt = optax.adam(3e-4)
+        opt_state = opt.init(loss.trainable(params))
+        from rl_tpu.objectives import SoftUpdate
+
+        updater = SoftUpdate(loss, tau=0.05)
+
+        @jax.jit
+        def step(params, opt_state, batch, k):
+            v, grads, m = loss.grad(params, batch, k)
+            upd, opt_state = opt.update(grads, opt_state, loss.trainable(params))
+            trained = optax.apply_updates(loss.trainable(params), upd)
+            params = updater(loss.merge(trained, params))
+            return params, opt_state, v, m
+
+        vals, bc = [], []
+        for i in range(40):
+            k = jax.random.key(10 + i)
+            batch = ds.sample(k)
+            params, opt_state, v, m = step(params, opt_state, batch, k)
+            vals.append(float(v))
+            bc.append(float(m["bc_loss"]))
+        assert np.isfinite(vals).all()
+        # the deterministic signal is the BC term: pi(s) moves toward the
+        # dataset actions (total loss is noisy through the critic)
+        assert np.mean(bc[-5:]) < np.mean(bc[:5])
+
+
+class TestOpenXEdgeCases:
+    def test_instructions_align_per_row(self, tmp_path):
+        from rl_tpu.data import OpenXDataset
+
+        ep_plain = make_openx_episode(2, seed=7)
+        for s in ep_plain:
+            del s["language_instruction"]
+        ep_lang = make_openx_episode(3, seed=8, instruction="stack cups")
+        ds = OpenXDataset([ep_plain, ep_lang], scratch_dir=str(tmp_path / "mm"))
+        assert len(ds.instructions) == ds.n_steps == 5
+        assert ds.instructions[:2] == ["", ""]
+        assert ds.instructions[2] == "stack cups"
+
+    def test_schema_mismatch_raises_clearly(self):
+        from rl_tpu.data import OpenXDataset
+
+        ep_a = make_openx_episode(2, seed=9)
+        for s in ep_a:
+            s["discount"] = np.float32(1.0)
+        ep_b = make_openx_episode(2, seed=10)
+        with pytest.raises(ValueError, match="schema mismatch"):
+            OpenXDataset([ep_a, ep_b])
