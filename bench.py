@@ -334,6 +334,80 @@ def bench_pixel(report: bool = True) -> dict:
     return out
 
 
+def bench_hopper(report: bool = True) -> dict:
+    """BENCH_MODE=hopper: PPO env-steps/sec on the native planar Hopper
+    (round-4 VERDICT next-step #8 — BASELINE.md config #1 is *MuJoCo*
+    steps/s; this is the physics-shaped workload, not CartPole's 4-float
+    toy). The Lagrangian dynamics (autodiff mass matrix + contact) run
+    INSIDE the fused collect+GAE+ClipPPO program: 5 physics substeps per
+    env step, all on device."""
+    jax = _setup_jax()
+
+    from rl_tpu.collectors import Collector
+    from rl_tpu.envs import HopperEnv, RewardSum, TransformedEnv, VmapEnv
+    from rl_tpu.modules import (
+        MLP,
+        NormalParamExtractor,
+        ProbabilisticActor,
+        TDModule,
+        TDSequential,
+        TanhNormal,
+        ValueOperator,
+    )
+    from rl_tpu.objectives import ClipPPOLoss
+    from rl_tpu.trainers import OnPolicyConfig, OnPolicyProgram
+
+    n_envs = _T(smoke=8, cpu=64, full=512)
+    rollout = _T(smoke=4, cpu=16, full=32)
+    train_steps = _T(smoke=1, cpu=2, full=6)
+    frames = n_envs * rollout
+
+    env = TransformedEnv(VmapEnv(HopperEnv(), n_envs), RewardSum())
+    actor = ProbabilisticActor(
+        TDSequential(
+            TDModule(MLP(out_features=6, num_cells=(256, 256)), ["observation"], ["raw"]),
+            TDModule(NormalParamExtractor(), ["raw"], ["loc", "scale"]),
+        ),
+        TanhNormal,
+        dist_keys=("loc", "scale"),
+    )
+    critic = ValueOperator(MLP(out_features=1, num_cells=(256, 256)))
+    loss = ClipPPOLoss(actor, critic, normalize_advantage=True)
+    loss.make_value_estimator(gamma=0.99, lmbda=0.95)
+    coll = Collector(
+        env, lambda p, td, k: actor(p["actor"], td, k), frames_per_batch=frames
+    )
+    program = OnPolicyProgram(
+        coll,
+        loss,
+        OnPolicyConfig(num_epochs=4, minibatch_size=min(frames, 4096)),
+    )
+    ts = program.init(jax.random.key(0))
+    step = jax.jit(program.train_step)
+    ts, metrics = step(ts)
+    jax.block_until_ready(metrics)
+
+    t0 = time.perf_counter()
+    for _ in range(train_steps):
+        ts, metrics = step(ts)
+    jax.block_until_ready(metrics)
+    dt = time.perf_counter() - t0
+    sps = train_steps * frames / dt
+    out = {
+        "metric": "hopper_ppo_env_steps_per_sec_per_chip",
+        "value": round(sps, 1),
+        "unit": "env_steps/s",
+        "vs_baseline": round(sps / PER_CHIP_TARGET, 3),
+        "n_envs": n_envs,
+        "physics_substeps_per_sec": round(sps * HopperEnv.FRAME_SKIP, 1),
+        "error": None,
+    }
+    out.update(_platform_tag(jax))
+    if report:
+        print(json.dumps(out), flush=True)
+    return out
+
+
 def bench_attention():
     """BENCH_MODE=attention: Pallas flash attention vs plain XLA attention,
     forward + full backward (the training path; flash bwd kernels), on the
@@ -856,7 +930,8 @@ def bench_all():
     _report_extras["probe"] = probe
     print(json.dumps({"probe": probe}), flush=True)
 
-    weights = {"ppo": 2.0, "rlhf": 1.4, "pixel": 1.2, "sac": 1.0, "per": 1.0}
+    weights = {"ppo": 2.0, "rlhf": 1.4, "pixel": 1.2, "hopper": 1.0,
+               "sac": 1.0, "per": 1.0}
     deadline = _START + _TIMEOUT - 30.0  # safety margin for the final print
     pending = list(weights)
     results: dict = {}
@@ -951,6 +1026,7 @@ if __name__ == "__main__":
             "probe": bench_probe,
             "ppo": main,
             "pixel": bench_pixel,
+            "hopper": bench_hopper,
             "attention": bench_attention,
             "hostenv": bench_hostenv,
             "rlhf": bench_rlhf,

@@ -210,6 +210,8 @@ _add_group("program", "rl_tpu.trainers", [
 _BUILTINS.update({
     # aliases kept from the round-1 registry + builder entry points
     "env/cartpole": "rl_tpu.envs.CartPoleEnv",
+    "env/hopper": "rl_tpu.envs.HopperEnv",
+    "env/walker2d": "rl_tpu.envs.Walker2dEnv",
     "env/mountaincar": "rl_tpu.envs.MountainCarEnv",
     "env/tictactoe": "rl_tpu.envs.TicTacToeEnv",
     "actor/qvalue": "rl_tpu.modules.QValueActor",

@@ -675,6 +675,9 @@ class OpenXDataset(_OfflineDataset):
         rows = []
         self.instructions: list[str] = []
         n_eps = 0
+        episodes = list(episodes)
+        if not episodes:
+            raise ValueError("OpenXDataset: no episodes given (empty iterable)")
         for ep_id, episode in enumerate(episodes):
             if isinstance(episode, (str, Path)):
                 import pickle
@@ -738,9 +741,13 @@ class OpenXDataset(_OfflineDataset):
                 td = td.set(k, np.zeros_like(nxt[k]))
 
             # per-ROW list (padded with "" for instruction-less episodes) so
-            # instructions[i] always matches global row i
+            # instructions[i] always matches global row i; RLDS/TF-origin
+            # records store bytes — decode rather than str() them
+            def _instr(v):
+                return v.decode("utf-8", "replace") if isinstance(v, bytes) else str(v)
+
             self.instructions.extend(
-                str(s.get("language_instruction", "")) for s in steps
+                _instr(s.get("language_instruction", "")) for s in steps
             )
             rows.append(td.set("next", nxt))
 

@@ -1,7 +1,8 @@
 from .chess import ChessEnv
+from .locomotion import HopperEnv, PlanarModel, Walker2dEnv
 from .navigation import NavigationEnv
 from .tictactoe import TicTacToeEnv
 from .trading import TradingEnv
 from .vla_env import ToyVLAEnv
 
-__all__ = ["ChessEnv", "NavigationEnv", "TicTacToeEnv", "TradingEnv", "ToyVLAEnv"]
+__all__ = ["ChessEnv", "HopperEnv", "Walker2dEnv", "PlanarModel", "NavigationEnv", "TicTacToeEnv", "TradingEnv", "ToyVLAEnv"]

@@ -1,0 +1,392 @@
+"""Planar locomotion suite: pure-JAX articulated rigid-body dynamics
+(round-4 VERDICT next-step #8 — the MuJoCo-shaped north-star workload).
+
+Native re-design of the reference's custom MuJoCo envs (reference:
+torchrl/envs/custom/mujoco/base.py ``MujocoEnv`` over a selectable physics
+backend; ``hopper.py`` / ``walker.py`` define obs/reward/termination on
+top). The reference delegates dynamics to MuJoCo/mjx; neither is in this
+image, and a host physics engine cannot live inside an XLA program — so
+the dynamics here are a from-scratch planar Lagrangian simulator built on
+autodiff, small enough to read and fully jit/vmap/scan-native:
+
+- Generalized coordinates ``q = [x, z, theta_root, joint angles...]``,
+  one kinematic tree of rigid links (2D: position + absolute angle).
+- Kinetic energy ``T(q, qdot)`` is computed from link COM velocities via
+  ``jax.jvp`` through forward kinematics; the mass matrix is
+  ``M(q) = d^2T/dqdot^2`` (one ``jax.hessian``), the bias forces come from
+  the Euler-Lagrange equation
+  ``M(q) qddot = Q + dT/dq - dV/dq - Mdot(q, qdot) qdot``
+  with every derivative taken by autodiff — no hand-derived equations of
+  motion to get wrong, and the whole step is one XLA program.
+- Ground contact is a smooth spring-damper penalty on named contact
+  points with Coulomb-style friction (``-mu N tanh(vx/v_ref)``), mapped
+  to generalized forces through ``jax.vjp`` (J^T F).
+- Semi-implicit Euler at ``dt=0.002`` with ``frame_skip`` inner steps in
+  a ``lax.scan`` (reference FRAME_SKIP=5).
+
+Obs / reward / termination follow the reference exactly:
+``obs = [qpos[1:], clip(qvel, +-10)]``; ``reward = forward_vel +
+healthy_reward - ctrl_cost_weight * ||a||^2``; done when unhealthy
+(hopper: z >= 0.7 and |angle| <= 0.2, hopper.py:28-30; walker:
+0.8 <= z <= 2.0 and |angle| <= 1.0, walker.py:28-31).
+
+Deliberate deviations (documented): link masses/inertias are round
+approximations of the MuJoCo capsule-density values, contact is a penalty
+model rather than MuJoCo's LCP solver, and actuator gears are scaled to
+the penalty-contact regime — the task structure, shapes, and reward
+semantics match; trajectories are not bit-comparable to MuJoCo.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ...data import ArrayDict, Bounded, Composite, Unbounded
+from ..base import EnvBase
+
+__all__ = ["PlanarModel", "HopperEnv", "Walker2dEnv", "planar_dynamics_step"]
+
+
+@dataclasses.dataclass(frozen=True)
+class PlanarModel:
+    """A planar kinematic tree.
+
+    Link 0 is the floating root (its pose is ``q[0:3] = x, z, theta``).
+    Every other link attaches to the DISTAL end of its parent through a
+    revolute joint: absolute angle = parent angle + rest_angle + q[3 + j].
+    Angles measure from the downward vertical (0 = link hangs down).
+    """
+
+    parents: tuple  # per link: parent index (-1 for the root)
+    lengths: tuple  # link lengths (m)
+    masses: tuple  # link masses (kg)
+    rest_angles: tuple  # joint rest offset vs parent (root entry ignored)
+    com_fracs: tuple  # COM position as a fraction of length from the
+    # proximal end
+    contacts: tuple  # (link index, fraction along link) contact points
+    gears: tuple  # actuator torque scale per joint (len = n_links - 1)
+    joint_ranges: tuple = ()  # (lo, hi) per joint; () = unlimited
+    joint_damping: float = 0.1
+    root_half: float = 0.2  # root link extends +-root_half from (x, z)
+
+    @property
+    def n_links(self) -> int:
+        return len(self.parents)
+
+    @property
+    def nq(self) -> int:
+        return 3 + self.n_links - 1
+
+    def inertias(self):
+        # slender-rod inertia about the COM: m L^2 / 12
+        return tuple(
+            m * (l**2) / 12.0 for m, l in zip(self.masses, self.lengths)
+        )
+
+
+def _link_frames(model: PlanarModel, q):
+    """Forward kinematics: per-link (proximal point, absolute angle).
+
+    The root link is centered at (x, z) with absolute angle q[2]; its
+    proximal ("hip") end sits ``root_half`` DOWN-link from the center.
+    """
+
+    def u(theta):  # down-link direction for absolute angle theta
+        return jnp.stack([jnp.sin(theta), -jnp.cos(theta)])
+
+    x, z, th0 = q[0], q[1], q[2]
+    center = jnp.stack([x, z])
+    # the root's PROXIMAL point is the hip, root_half below the stored
+    # center; the torso link extends UPWARD from it (dir sign -1 below)
+    starts = [center + model.root_half * u(th0)]
+    angles = [th0]
+    joint = 3
+    for i in range(1, model.n_links):
+        p = model.parents[i]
+        ang = angles[p] + model.rest_angles[i] + q[joint]
+        # child attaches at the parent's distal end
+        if p == 0:
+            attach = starts[0]  # hip: the root's proximal end
+        else:
+            attach = starts[p] + model.lengths[p] * u(angles[p])
+        starts.append(attach)
+        angles.append(ang)
+        joint += 1
+    return jnp.stack(starts), jnp.stack(angles)
+
+
+def _dir_signs(model: PlanarModel):
+    # the root link extends UP from its proximal (hip) point; every child
+    # extends down-link (+u) from its attachment
+    return jnp.asarray([-1.0] + [1.0] * (model.n_links - 1))[:, None]
+
+
+def _coms_and_angles(model: PlanarModel, q):
+    starts, angles = _link_frames(model, q)
+    dirs = jnp.stack([jnp.sin(angles), -jnp.cos(angles)], axis=-1)
+    dirs = dirs * _dir_signs(model)
+    fr = jnp.asarray(model.com_fracs)[:, None]
+    L = jnp.asarray(model.lengths)[:, None]
+    coms = starts + fr * L * dirs
+    return coms, angles
+
+
+def _contact_points(model: PlanarModel, q):
+    starts, angles = _link_frames(model, q)
+    dirs = jnp.stack([jnp.sin(angles), -jnp.cos(angles)], axis=-1)
+    dirs = dirs * _dir_signs(model)
+    pts = []
+    for link, frac in model.contacts:
+        pts.append(starts[link] + frac * model.lengths[link] * dirs[link])
+    return jnp.stack(pts)  # [C, 2]
+
+
+_G = 9.81
+_K_P = 2.0e4  # contact spring
+_K_D = 300.0  # contact damper
+_MU = 1.0  # friction coefficient
+_V_REF = 0.1  # friction smoothing velocity
+
+
+def _kinetic(model: PlanarModel, q, qdot):
+    def pose(qq):
+        return _coms_and_angles(model, qq)
+
+    (coms, angles), (vels, omegas) = jax.jvp(pose, (q,), (qdot,))
+    m = jnp.asarray(model.masses)
+    inertia = jnp.asarray(model.inertias())
+    return 0.5 * jnp.sum(m * jnp.sum(vels**2, axis=-1)) + 0.5 * jnp.sum(
+        inertia * omegas**2
+    )
+
+
+def _potential(model: PlanarModel, q):
+    coms, _ = _coms_and_angles(model, q)
+    return _G * jnp.sum(jnp.asarray(model.masses) * coms[:, 1])
+
+
+def planar_dynamics_step(model: PlanarModel, q, qdot, tau_joints, dt):
+    """One semi-implicit Euler step of the Euler-Lagrange dynamics.
+
+    ``tau_joints`` [nq-3] are actuator torques on the joint coordinates.
+    Returns (q_next, qdot_next).
+    """
+    nq = model.nq
+
+    # M(q) = Hessian of T in qdot (T is quadratic in qdot, so exact)
+    M = jax.hessian(lambda qd: _kinetic(model, q, qd))(qdot)
+    dT_dq = jax.grad(lambda qq: _kinetic(model, qq, qdot))(q)
+    dV_dq = jax.grad(lambda qq: _potential(model, qq))(q)
+    # Mdot qdot via a jvp through q -> M(q)
+    Mdot = jax.jvp(
+        lambda qq: jax.hessian(lambda qd: _kinetic(model, qq, qd))(qdot), (q,), (qdot,)
+    )[1]
+
+    # contact: spring-damper normal + smooth Coulomb friction, J^T F
+    def cpts(qq):
+        return _contact_points(model, qq)
+
+    pts, vels = jax.jvp(cpts, (q,), (qdot,))
+    pen = jnp.maximum(-pts[:, 1], 0.0)  # penetration depth
+    active = pen > 0.0
+    fz = jnp.where(active, _K_P * pen - _K_D * vels[:, 1], 0.0)
+    fz = jnp.maximum(fz, 0.0)
+    fx = -_MU * fz * jnp.tanh(vels[:, 0] / _V_REF)
+    F = jnp.stack([fx, fz], axis=-1)  # [C, 2]
+    _, vjp = jax.vjp(cpts, q)
+    (q_contact,) = vjp(F)
+
+    # actuation + joint damping act on the joint coordinates only
+    tau = jnp.concatenate([jnp.zeros(3), tau_joints])
+    damping = -model.joint_damping * jnp.concatenate([jnp.zeros(3), qdot[3:]])
+
+    # soft joint limits: a stiff restoring torque past the range ends
+    # (MuJoCo expresses these as joint range constraints; penalty form here)
+    if model.joint_ranges:
+        lo = jnp.asarray([r[0] for r in model.joint_ranges])
+        hi = jnp.asarray([r[1] for r in model.joint_ranges])
+        phi = q[3:]
+        k_lim, d_lim = 400.0, 20.0
+        over = jnp.maximum(phi - hi, 0.0)
+        under = jnp.maximum(lo - phi, 0.0)
+        engaged = (over > 0) | (under > 0)
+        tau_lim = -k_lim * over + k_lim * under - jnp.where(
+            engaged, d_lim * qdot[3:], 0.0
+        )
+        damping = damping + jnp.concatenate([jnp.zeros(3), tau_lim])
+
+    rhs = tau + damping + q_contact + dT_dq - dV_dq - Mdot @ qdot
+    qddot = jnp.linalg.solve(M + 1e-9 * jnp.eye(nq), rhs)
+    qdot_next = qdot + dt * qddot
+    q_next = q + dt * qdot_next
+    return q_next, qdot_next
+
+
+HOPPER_MODEL = PlanarModel(
+    # torso, thigh, leg, foot — the MuJoCo hopper tree (hopper.xml)
+    parents=(-1, 0, 1, 2),
+    lengths=(0.4, 0.45, 0.5, 0.39),
+    masses=(3.7, 4.0, 2.8, 5.3),  # ~ capsule-density masses, rounded
+    rest_angles=(0.0, 0.0, 0.0, jnp.pi / 2),  # foot sticks out forward
+    com_fracs=(0.5, 0.5, 0.5, 0.17),  # foot COM near the ankle
+    # heel + toe, plus body points (torso top via root frac, hip, knee,
+    # ankle) so a collapsing body rests ON the ground instead of passing
+    # through it (MuJoCo collides every geom with the floor)
+    contacts=((3, -0.33), (3, 0.67), (0, 1.0), (0, 0.0), (1, 1.0), (2, 1.0)),
+    gears=(60.0, 60.0, 40.0),
+    joint_ranges=((-1.2, 1.2), (-1.5, 1.5), (-0.8, 0.8)),
+)
+
+WALKER_MODEL = PlanarModel(
+    # torso, r-thigh, r-leg, r-foot, l-thigh, l-leg, l-foot (walker2d.xml)
+    parents=(-1, 0, 1, 2, 0, 4, 5),
+    lengths=(0.4, 0.45, 0.5, 0.2, 0.45, 0.5, 0.2),
+    masses=(3.7, 4.0, 2.8, 3.2, 4.0, 2.8, 3.2),
+    rest_angles=(0.0, 0.0, 0.0, jnp.pi / 2, 0.0, 0.0, jnp.pi / 2),
+    com_fracs=(0.5, 0.5, 0.5, 0.17, 0.5, 0.5, 0.17),
+    contacts=(
+        (3, -0.33), (3, 0.67), (6, -0.33), (6, 0.67),
+        (0, 1.0), (0, 0.0), (1, 1.0), (2, 1.0), (4, 1.0), (5, 1.0),
+    ),
+    gears=(60.0, 60.0, 40.0, 60.0, 60.0, 40.0),
+    joint_ranges=(
+        (-1.2, 1.2), (-1.5, 1.5), (-0.8, 0.8),
+        (-1.2, 1.2), (-1.5, 1.5), (-0.8, 0.8),
+    ),
+)
+
+
+class _PlanarLocomotionEnv(EnvBase):
+    """Shared env surface (reference mujoco/base.py MujocoEnv)."""
+
+    MODEL: PlanarModel
+    FRAME_SKIP = 5  # reference FRAME_SKIP
+    DT = 0.002  # per-substep integrator dt
+    SKIP_QPOS = 1  # x excluded from obs (reference SKIP_QPOS)
+    HEALTHY_REWARD = 1.0
+    CTRL_COST_WEIGHT = 1e-3
+    INIT_Z = 1.25
+    RESET_NOISE = 5e-3
+
+    def __init__(self, max_episode_steps: int = 1000):
+        self.max_episode_steps = max_episode_steps
+
+    # -- specs ---------------------------------------------------------------
+
+    @property
+    def nq(self) -> int:
+        return self.MODEL.nq
+
+    @property
+    def observation_spec(self) -> Composite:
+        return Composite(
+            observation=Unbounded(shape=(2 * self.nq - self.SKIP_QPOS,))
+        )
+
+    @property
+    def action_spec(self):
+        n_act = self.nq - 3
+        return Bounded(shape=(n_act,), low=-1.0, high=1.0)
+
+    @property
+    def state_spec(self) -> Composite:
+        return Composite(
+            qpos=Unbounded(shape=(self.nq,)),
+            qvel=Unbounded(shape=(self.nq,)),
+            step_count=Unbounded(shape=(), dtype=jnp.int32),
+        )
+
+    # -- reference-structure hooks ------------------------------------------
+
+    def _is_healthy(self, qpos):
+        raise NotImplementedError
+
+    def _obs(self, qpos, qvel) -> ArrayDict:
+        return ArrayDict(
+            observation=jnp.concatenate(
+                [qpos[self.SKIP_QPOS:], jnp.clip(qvel, -10.0, 10.0)]
+            )
+        )
+
+    # -- env protocol --------------------------------------------------------
+
+    def _init_qpos(self):
+        q = jnp.zeros(self.nq)
+        return q.at[1].set(self.INIT_Z)
+
+    def _reset(self, key):
+        kq, kv = jax.random.split(key)
+        noise = self.RESET_NOISE
+        qpos = self._init_qpos() + jax.random.uniform(
+            kq, (self.nq,), minval=-noise, maxval=noise
+        )
+        qvel = jax.random.uniform(kv, (self.nq,), minval=-noise, maxval=noise)
+        state = ArrayDict(
+            qpos=qpos, qvel=qvel, step_count=jnp.asarray(0, jnp.int32)
+        )
+        return state, self._obs(qpos, qvel)
+
+    def _step(self, state, action, key):
+        qpos, qvel = state["qpos"], state["qvel"]
+        a = jnp.clip(action, -1.0, 1.0)
+        tau = a * jnp.asarray(self.MODEL.gears)
+
+        def sub(carry, _):
+            q, qd = carry
+            q, qd = planar_dynamics_step(self.MODEL, q, qd, tau, self.DT)
+            return (q, qd), None
+
+        (q2, qd2), _ = jax.lax.scan(
+            sub, (qpos, qvel), None, length=self.FRAME_SKIP
+        )
+
+        dt_total = self.DT * self.FRAME_SKIP
+        forward_vel = (q2[0] - qpos[0]) / dt_total
+        ctrl_cost = self.CTRL_COST_WEIGHT * jnp.sum(a**2)
+        healthy = self._is_healthy(q2)
+        reward = (
+            forward_vel + self.HEALTHY_REWARD * healthy.astype(jnp.float32)
+            - ctrl_cost
+        )
+
+        count = state["step_count"] + 1
+        new_state = ArrayDict(qpos=q2, qvel=qd2, step_count=count)
+        terminated = ~healthy
+        truncated = count >= self.max_episode_steps
+        return new_state, self._obs(q2, qd2), reward, terminated, truncated
+
+
+class HopperEnv(_PlanarLocomotionEnv):
+    """Single-legged hopping (reference hopper.py:14): 4-link chain,
+    3 actuators, obs 11 = qpos[1:] (5) + qvel (6)."""
+
+    MODEL = HOPPER_MODEL
+    HEALTHY_Z_MIN = 0.7
+    HEALTHY_ANGLE_MAX = 0.2
+
+    def _is_healthy(self, qpos):
+        return (qpos[1] >= self.HEALTHY_Z_MIN) & (
+            jnp.abs(qpos[2]) <= self.HEALTHY_ANGLE_MAX
+        )
+
+
+class Walker2dEnv(_PlanarLocomotionEnv):
+    """Two-legged walking (reference walker.py:14): 7-link tree,
+    6 actuators, obs 17 = qpos[1:] (8) + qvel (9)."""
+
+    MODEL = WALKER_MODEL
+    HEALTHY_Z_LOW = 0.8
+    HEALTHY_Z_HIGH = 2.0
+    HEALTHY_ANGLE_MAX = 1.0
+
+    def _is_healthy(self, qpos):
+        z, angle = qpos[1], qpos[2]
+        return (
+            (z >= self.HEALTHY_Z_LOW)
+            & (z <= self.HEALTHY_Z_HIGH)
+            & (jnp.abs(angle) <= self.HEALTHY_ANGLE_MAX)
+        )

@@ -66,3 +66,19 @@ def test_impala_yaml_twin_runs(monkeypatch, tmp_path):
 def test_mappo_yaml_twin_runs(monkeypatch, tmp_path):
     _run_yaml_twin("mappo_navigation.yaml", monkeypatch, tmp_path,
                    total_steps=2, frames_per_batch=128)
+
+
+@pytest.mark.slow
+def test_ppo_hopper_recipe_runs(monkeypatch, tmp_path):
+    monkeypatch.chdir(tmp_path)
+    import ppo_hopper
+
+    ppo_hopper.main(total_steps=2, num_envs=8)
+
+
+@pytest.mark.slow
+def test_ppo_hopper_yaml_twin_runs(monkeypatch, tmp_path):
+    _run_yaml_twin(
+        "ppo_hopper.yaml", monkeypatch, tmp_path,
+        total_steps=2, frames_per_batch=1024,
+    )
