@@ -1,5 +1,6 @@
 from .decision_transformer import DecisionTransformer, DTConfig, DTLoss
 from .generate import GenerateOutput, generate, token_log_probs, token_log_probs_with_aux
+from .serving import ContinuousBatchingEngine, FinishedRequest, Request
 from .act import ACTConfig, ACTModel
 from .rssm import RSSM, DreamerModelLoss, RSSMConfig, dreamer_lambda_returns
 from .rssm_v3 import (
@@ -32,6 +33,9 @@ __all__ = [
     "generate",
     "token_log_probs",
     "token_log_probs_with_aux",
+    "ContinuousBatchingEngine",
+    "FinishedRequest",
+    "Request",
     "GenerateOutput",
     "RSSM",
     "RSSMConfig",

@@ -1,0 +1,362 @@
+"""Continuous batching over the paged KV cache (round-4 VERDICT
+next-step #6).
+
+The reference delegates LLM serving to vLLM — continuous batching, paged
+KV, multi-replica load balancing (reference
+torchrl/modules/llm/backends/vllm/vllm_async.py:515 ``AsyncVLLM``,
+:1559 ``LoadBalancer``). There is no serving engine to delegate to on
+TPU-in-this-image, so this is the native equivalent, built the XLA way:
+
+- **Static shapes.** The engine owns ``n_slots`` sequence slots and a
+  block pool (``TransformerLM.init_paged_cache``). Every jitted program —
+  one prefill per prompt-length bucket, ONE decode step — has a fixed
+  shape; dynamism lives in block tables, per-slot lengths, and active
+  masks (data, not shapes).
+- **Slot admission (the continuous part).** When a sequence finishes, its
+  blocks return to the pool and the slot is immediately re-filled from
+  the queue while the other slots keep decoding — a batch never waits
+  for its slowest member, which is where the mixed-length throughput win
+  comes from (the fixed-batch ``generate`` runs every row to the batch
+  max).
+- **Paged KV.** Slots own block tables into a shared pool, so HBM holds
+  ~sum(actual lengths), not n_slots x max_len; the attention reads run an
+  online softmax over the table's blocks
+  (``transformer._paged_attention``).
+- **Host-side allocator.** Block bookkeeping (free list, table mirrors,
+  per-slot lengths) is plain numpy on the host — it costs microseconds
+  per step and keeps the device programs shape-static. The host mirror of
+  each length is exact by construction (prefill sets it, decode adds 1),
+  so no device->host sync is needed in the loop.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["ContinuousBatchingEngine", "Request", "FinishedRequest"]
+
+
+@dataclasses.dataclass
+class Request:
+    rid: int
+    prompt: np.ndarray  # [P] int32
+    max_new_tokens: int
+
+
+@dataclasses.dataclass
+class FinishedRequest:
+    rid: int
+    prompt: np.ndarray
+    tokens: np.ndarray  # [N] generated ids (eos included if hit)
+    finished_reason: str  # "eos" | "length"
+
+
+def _bucket(n: int, buckets) -> int:
+    for b in buckets:
+        if n <= b:
+            return b
+    raise ValueError(f"prompt length {n} exceeds the largest bucket {buckets[-1]}")
+
+
+class ContinuousBatchingEngine:
+    """Slot-based continuous batching for :class:`TransformerLM`.
+
+    Args:
+        model / params: the language model (any TransformerConfig).
+        n_slots: concurrent sequences on device (the decode batch).
+        block_size: tokens per KV block.
+        n_blocks: pool size (block 0 is reserved scratch; usable pool is
+            ``n_blocks - 1`` blocks ~= ``(n_blocks-1)*block_size`` tokens).
+        max_seq_len: per-sequence cap (defines the block-table width).
+        prompt_buckets: prefill compile buckets (one program per bucket).
+        eos_id: stop token (None = run every request to max_new_tokens).
+        temperature / greedy: sampling controls.
+    """
+
+    def __init__(
+        self,
+        model: Any,
+        params: Any,
+        *,
+        n_slots: int = 8,
+        block_size: int = 16,
+        n_blocks: int = 257,
+        max_seq_len: int | None = None,
+        prompt_buckets: tuple = (32, 128, 512),
+        eos_id: int | None = None,
+        temperature: float = 1.0,
+        greedy: bool = False,
+        seed: int = 0,
+    ):
+        self.model, self.params = model, params
+        self.n_slots, self.block = n_slots, block_size
+        self.max_seq_len = max_seq_len or model.cfg.max_seq_len
+        self.max_blocks = -(-self.max_seq_len // block_size)
+        self.buckets = tuple(sorted(prompt_buckets))
+        self.eos_id = eos_id
+        self.temperature, self.greedy = temperature, greedy
+        self._key = jax.random.key(seed)
+
+        self.cache = model.init_paged_cache(
+            n_slots, n_blocks, block_size, self.max_blocks
+        )
+        # host mirrors (the allocator's source of truth)
+        self.free_blocks = list(range(1, n_blocks))  # 0 = reserved scratch
+        self.table = np.full((n_slots, self.max_blocks), -1, np.int32)
+        self.lens = np.zeros(n_slots, np.int64)
+        self.slot_rid = np.full(n_slots, -1, np.int64)  # -1 = free slot
+        self.slot_budget = np.zeros(n_slots, np.int64)  # max_new remaining
+        self.slot_tokens: list[list[int]] = [[] for _ in range(n_slots)]
+        self.slot_prompt: dict[int, np.ndarray] = {}
+
+        self.queue: list[Request] = []
+        self.finished: list[FinishedRequest] = []
+        self._next_rid = 0
+        # instrumentation for throughput accounting
+        self.decode_steps = 0
+        self.prefill_token_slots = 0
+
+        self._decode = jax.jit(self._decode_fn)
+        self._prefills: dict[int, Any] = {}  # bucket -> jitted prefill
+
+    # -- jitted programs -------------------------------------------------------
+
+    def _sync_cache_tables(self, active):
+        table_dev = jnp.asarray(self.table)
+        active_dev = jnp.asarray(active)
+        lens_dev = jnp.asarray(self.lens, jnp.int32)
+        for layer in self.cache:
+            layer["block_table"] = table_dev
+            layer["active"] = active_dev
+            layer["len"] = lens_dev
+
+    def _prefill_fn(self, params, pools, table_rows, tokens, token_mask, key):
+        """COMPACT bucketed prefill: only the admitted slots' rows ride
+        the forward — tokens [A, B] (pads beyond each prompt), token_mask
+        [A, B] marks real prompt tokens, table_rows [A, max_blocks] are
+        the admitted slots' block tables. The pools are shared with the
+        decode cache, so the writes land in place; the compact batch keeps
+        per-admission cost at A x bucket instead of n_slots x bucket.
+        Samples each admitted slot's FIRST response token."""
+        A = tokens.shape[0]
+        cache = [
+            {
+                "pool_k": pk,
+                "pool_v": pv,
+                "block_table": table_rows,
+                "len": jnp.zeros((A,), jnp.int32),
+                "active": token_mask,
+            }
+            for pk, pv in pools
+        ]
+        logits, cache = self.model.apply({"params": params}, tokens, cache=cache)
+        last = jnp.maximum(token_mask.sum(axis=1) - 1, 0)  # [A]
+        last_logits = jnp.take_along_axis(
+            logits, last[:, None, None], axis=1
+        )[:, 0]
+        tok = self._sample(last_logits, key)
+        new_pools = [(c["pool_k"], c["pool_v"]) for c in cache]
+        return tok, new_pools
+
+    def _decode_fn(self, params, cache, last_tokens, active, key):
+        cache = [dict(c, active=active) for c in cache]
+        logits, cache = self.model.apply(
+            {"params": params}, last_tokens[:, None], cache=cache
+        )
+        tok = self._sample(logits[:, 0], key)
+        return tok, cache
+
+    def _sample(self, logits, key):
+        if self.greedy:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        t = jnp.maximum(jnp.asarray(self.temperature, jnp.float32), 1e-6)
+        return jax.random.categorical(key, logits.astype(jnp.float32) / t).astype(
+            jnp.int32
+        )
+
+    # -- allocator -------------------------------------------------------------
+
+    def _blocks_needed(self, length: int) -> int:
+        return -(-length // self.block)
+
+    def _ensure_blocks(self, slot: int, new_len: int) -> bool:
+        """Grow the slot's table to cover ``new_len`` tokens; False if the
+        pool is exhausted (caller defers the work). ``have`` is counted
+        from the table itself — recomputing it from ``lens`` undercounts
+        when the previous allocation already covered len+1 (prompt length
+        an exact block multiple), which would overwrite and LEAK a block."""
+        have = int((self.table[slot] >= 0).sum())
+        need = self._blocks_needed(new_len)
+        if need - have > len(self.free_blocks):
+            return False
+        for j in range(have, need):
+            self.table[slot, j] = self.free_blocks.pop()
+        return True
+
+    def _free_slot(self, slot: int, reason: str):
+        rid = int(self.slot_rid[slot])
+        self.finished.append(
+            FinishedRequest(
+                rid=rid,
+                prompt=self.slot_prompt.pop(rid),
+                tokens=np.asarray(self.slot_tokens[slot], np.int32),
+                finished_reason=reason,
+            )
+        )
+        used = self.table[slot]
+        self.free_blocks.extend(int(b) for b in used[used >= 0])
+        self.table[slot] = -1
+        self.lens[slot] = 0
+        self.slot_rid[slot] = -1
+        self.slot_tokens[slot] = []
+
+    # -- public surface --------------------------------------------------------
+
+    def submit(self, prompt, max_new_tokens: int) -> int:
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1 (prefill always samples one token)")
+        if len(prompt) + max_new_tokens > self.max_seq_len:
+            raise ValueError(
+                f"prompt ({len(prompt)}) + max_new_tokens ({max_new_tokens}) "
+                f"exceeds max_seq_len ({self.max_seq_len})"
+            )
+        if len(prompt) > self.buckets[-1]:
+            raise ValueError(
+                f"prompt length {len(prompt)} exceeds the largest prefill "
+                f"bucket {self.buckets[-1]}; raise prompt_buckets"
+            )
+        rid = self._next_rid
+        self._next_rid += 1
+        self.queue.append(Request(rid, prompt, max_new_tokens))
+        return rid
+
+    def _admit(self):
+        """Fill free slots from the queue; one bucketed prefill per
+        admission round (requests grouped into the round's max bucket)."""
+        free = [s for s in range(self.n_slots) if self.slot_rid[s] < 0]
+        if not free or not self.queue:
+            return
+        batch: list[tuple[int, Request]] = []
+        for s in free:
+            if not self.queue:
+                break
+            req = self.queue[0]
+            if not self._ensure_blocks_for_new(s, req):
+                break  # pool exhausted: retry after sequences finish
+            batch.append((s, self.queue.pop(0)))
+        if not batch:
+            return
+        bucket = _bucket(max(len(r.prompt) for _, r in batch), self.buckets)
+        tokens = np.zeros((self.n_slots, bucket), np.int32)
+        mask = np.zeros((self.n_slots, bucket), bool)  # rows gathered below
+        for s, req in batch:
+            P = len(req.prompt)
+            tokens[s, :P] = req.prompt
+            mask[s, :P] = True
+            self.slot_rid[s] = req.rid
+            self.slot_budget[s] = req.max_new_tokens
+            self.slot_prompt[req.rid] = req.prompt
+            self.slot_tokens[s] = []
+        # compact rows: only the admitted slots ride the prefill forward
+        A = len(batch)
+        slots = [s for s, _ in batch]
+        self._key, k = jax.random.split(self._key)
+        fn = self._prefills.get((A, bucket))
+        if fn is None:
+            fn = self._prefills[(A, bucket)] = jax.jit(self._prefill_fn)
+        pools = [(layer["pool_k"], layer["pool_v"]) for layer in self.cache]
+        tok, new_pools = fn(
+            self.params,
+            pools,
+            jnp.asarray(self.table[slots]),
+            jnp.asarray(tokens[slots]),
+            jnp.asarray(mask[slots]),
+            k,
+        )
+        for layer, (pk, pv) in zip(self.cache, new_pools):
+            layer["pool_k"], layer["pool_v"] = pk, pv
+        self.prefill_token_slots += A * bucket
+        tok_host = np.asarray(tok)
+        for i, (s, req) in enumerate(batch):
+            self.lens[s] = len(req.prompt)
+            self._push_token(s, int(tok_host[i]))
+
+    def _ensure_blocks_for_new(self, slot: int, req: Request) -> bool:
+        need = self._blocks_needed(len(req.prompt) + 1)  # prompt + 1st token
+        if need > len(self.free_blocks):
+            return False
+        for j in range(need):
+            self.table[slot, j] = self.free_blocks.pop()
+        return True
+
+    def _push_token(self, slot: int, tok: int):
+        self.slot_tokens[slot].append(tok)
+        self.slot_budget[slot] -= 1
+        if self.eos_id is not None and tok == self.eos_id:
+            self._free_slot(slot, "eos")
+        elif self.slot_budget[slot] <= 0:
+            self._free_slot(slot, "length")
+
+    def step(self) -> bool:
+        """Admit + one decode step. Returns False when all work is done."""
+        self._admit()
+        active_np = self.slot_rid >= 0
+        if not active_np.any():
+            if self.queue:
+                # nothing in flight, yet admission failed: the pool cannot
+                # hold the front request at all — no progress is possible
+                raise RuntimeError(
+                    f"block pool too small: request rid="
+                    f"{self.queue[0].rid} needs "
+                    f"{self._blocks_needed(len(self.queue[0].prompt) + 1)} "
+                    f"blocks, pool has {len(self.free_blocks)} free"
+                )
+            return False
+        # grow tables for the upcoming token; slots that cannot get a
+        # block this round stall (stay active=False) until blocks free up
+        stalled = 0
+        for s in np.nonzero(active_np)[0]:
+            if not self._ensure_blocks(int(s), int(self.lens[s]) + 1):
+                active_np[s] = False
+                stalled += 1
+        if not active_np.any():
+            # every in-flight sequence needs a block and none can decode:
+            # no completion can ever free one — fail loudly instead of
+            # spinning (a PARTIAL stall is fine; the running slots'
+            # completions will free blocks)
+            raise RuntimeError(
+                f"block pool exhausted with all {stalled} in-flight "
+                f"sequences stalled ({len(self.free_blocks)} free blocks); "
+                f"the pool cannot hold this working set"
+            )
+        last = np.array(
+            [
+                self.slot_tokens[s][-1] if self.slot_tokens[s] else 0
+                for s in range(self.n_slots)
+            ],
+            np.int32,
+        )
+        self._sync_cache_tables(active=active_np)
+        self._key, k = jax.random.split(self._key)
+        tok, self.cache = self._decode(
+            self.params, self.cache, jnp.asarray(last), jnp.asarray(active_np), k
+        )
+        self.decode_steps += 1
+        tok_host = np.asarray(tok)
+        for s in np.nonzero(active_np)[0]:
+            self.lens[s] += 1
+            self._push_token(int(s), int(tok_host[s]))
+        return bool(self.queue) or bool((self.slot_rid >= 0).any())
+
+    def run(self) -> dict[int, FinishedRequest]:
+        """Drain the queue; returns {rid: FinishedRequest}."""
+        while self.step():
+            pass
+        # flush: step() returns False when idle, but completions recorded
+        return {f.rid: f for f in self.finished}

@@ -64,6 +64,93 @@ class TransformerConfig:
         return self.n_kv_heads or self.n_heads
 
 
+def _paged_attention(cfg, q, k, v, cache, active):
+    """Attention over a paged KV cache + block-table writes.
+
+    Layout: ``pool_k``/``pool_v`` [n_blocks, block, Hk, D] shared across
+    slots; ``block_table`` [S, max_blocks] int32 (block 0 = reserved
+    scratch); ``len`` [S] int32 per-slot lengths. New tokens (q/k/v
+    [S, T, ...]) land at slot-local positions ``len[s] + t``; reads run an
+    online-softmax over the table's blocks (the flash-attention recurrence,
+    unrolled over max_blocks), so the slot's KV is never materialized
+    contiguously — the gather per block is the only copy (a Pallas kernel
+    reading the pool in place is the chip-side upgrade path).
+    """
+    pool_k, pool_v = cache["pool_k"], cache["pool_v"]
+    table, lens = cache["block_table"], cache["len"]
+    S, T = q.shape[0], q.shape[1]
+    n_blocks, block = pool_k.shape[0], pool_k.shape[1]
+    max_blocks = table.shape[1]
+    # `active` is [S] (whole slots) or [S, T] (token-level — bucketed
+    # prefill pads prompts up to the bucket; padded tokens must not land
+    # in the cache or advance the length)
+    if active is None:
+        active_t = jnp.ones((S, T), bool)
+    elif active.ndim == 1:
+        active_t = jnp.broadcast_to(active[:, None], (S, T))
+    else:
+        active_t = active
+
+    # -- write the new K/V into the pool --------------------------------------
+    pos = lens[:, None] + jnp.arange(T)[None, :]  # [S, T] slot-local
+    blk_slot = pos // block
+    off = pos % block
+    blk_global = jnp.take_along_axis(
+        table, jnp.clip(blk_slot, 0, max_blocks - 1), axis=1
+    )  # [S, T]
+    # inactive tokens write into scratch block 0 (reserved, never read)
+    blk_global = jnp.where(active_t, blk_global, 0)
+    flat_blk = blk_global.reshape(-1)
+    flat_off = off.reshape(-1)
+    pool_k = pool_k.at[flat_blk, flat_off].set(
+        k.reshape(S * T, *k.shape[2:]), mode="drop"
+    )
+    pool_v = pool_v.at[flat_blk, flat_off].set(
+        v.reshape(S * T, *v.shape[2:]), mode="drop"
+    )
+
+    # -- online-softmax read over the slot's blocks ---------------------------
+    if cfg.kv_heads != cfg.n_heads:
+        rep = cfg.n_heads // cfg.kv_heads
+    else:
+        rep = 1
+    scale = cfg.head_dim**-0.5
+    m = jnp.full((S, cfg.n_heads, T), -jnp.inf, jnp.float32)
+    l = jnp.zeros((S, cfg.n_heads, T), jnp.float32)
+    acc = jnp.zeros((S, cfg.n_heads, T, cfg.head_dim), jnp.float32)
+    qf = q.astype(jnp.float32)
+    for b in range(max_blocks):
+        kb = pool_k[table[:, b]].astype(jnp.float32)  # [S, block, Hk, D]
+        vb = pool_v[table[:, b]].astype(jnp.float32)
+        if rep > 1:
+            kb = jnp.repeat(kb, rep, axis=2)
+            vb = jnp.repeat(vb, rep, axis=2)
+        s_blk = jnp.einsum("sthd,sjhd->shtj", qf, kb) * scale  # [S,H,T,block]
+        kv_pos = b * block + jnp.arange(block)  # slot-local positions
+        # causal: q token t (at position len+t) sees kv_pos <= len + t
+        valid = kv_pos[None, None, :] <= pos[:, :, None]  # [S, T, block]
+        valid = valid & (table[:, b] > 0)[:, None, None]  # unassigned/scratch
+        s_blk = jnp.where(valid[:, None], s_blk, -jnp.inf)
+        m_new = jnp.maximum(m, s_blk.max(axis=-1))
+        # renormalize the running accumulator (guard the all-masked case)
+        corr = jnp.where(jnp.isfinite(m), jnp.exp(m - m_new), 0.0)
+        p = jnp.exp(s_blk - m_new[..., None])
+        p = jnp.where(valid[:, None], p, 0.0)
+        l = l * corr + p.sum(axis=-1)
+        acc = acc * corr[..., None] + jnp.einsum("shtj,sjhd->shtd", p, vb)
+        m = m_new
+    o = acc / jnp.maximum(l, 1e-9)[..., None]  # [S, H, T, D]
+    o = jnp.moveaxis(o, 1, 2).astype(cfg.dtype)  # [S, T, H, D]
+
+    new_cache = dict(cache)
+    new_cache.update(
+        pool_k=pool_k,
+        pool_v=pool_v,
+        len=lens + active_t.sum(axis=1, dtype=lens.dtype),
+    )
+    return o, new_cache
+
+
 class _Attention(nn.Module):
     cfg: TransformerConfig
 
@@ -101,7 +188,24 @@ class _Attention(nn.Module):
             return jnp.einsum("bhqk,bkhd->bqhd", p, v_)
 
         new_cache = None
-        if cache is not None:
+        if cache is not None and "pool_k" in cache:
+            # PAGED cache (vLLM-style, reference delegates to vllm's paged
+            # attention — modules/llm/backends/vllm/vllm_async.py:515): KV
+            # lives in a shared block pool; each SLOT (batch row) owns a
+            # block table and its own length, so rows admitted at
+            # different times coexist in one decode batch (continuous
+            # batching). Block 0 is a reserved scratch target for
+            # inactive slots' writes.
+            if mask is not None:
+                raise ValueError(
+                    "the paged cache path ignores attention_mask — padding "
+                    "is expressed through cache['active'] and per-slot "
+                    "lens; pass attention_mask=None"
+                )
+            o, new_cache = _paged_attention(
+                cfg, q, k, v, cache, cache.get("active")
+            )
+        elif cache is not None:
             # decode step: append to the KV cache at position `positions`
             ck, cv, cache_len = cache["k"], cache["v"], cache["len"]
             ck = jax.lax.dynamic_update_slice_in_dim(ck, k, cache_len, axis=1)
@@ -246,7 +350,11 @@ class TransformerLM(nn.Module):
         emb = nn.Embed(cfg.vocab_size, cfg.d_model, dtype=cfg.dtype, name="wte")
         if positions is None:
             if cache is not None:
-                positions = cache[0]["len"] + jnp.arange(tokens.shape[1])
+                lens = cache[0]["len"]
+                if lens.ndim:  # paged cache: per-slot lengths [S]
+                    positions = lens[:, None] + jnp.arange(tokens.shape[1])[None, :]
+                else:
+                    positions = lens + jnp.arange(tokens.shape[1])
             else:
                 positions = jnp.arange(tokens.shape[1])
         pos_emb = nn.Embed(cfg.max_seq_len, cfg.d_model, dtype=cfg.dtype, name="wpe")
@@ -273,6 +381,31 @@ class TransformerLM(nn.Module):
                 "k": jnp.zeros((batch_size, max_len, cfg.kv_heads, cfg.head_dim), cfg.dtype),
                 "v": jnp.zeros((batch_size, max_len, cfg.kv_heads, cfg.head_dim), cfg.dtype),
                 "len": jnp.asarray(0, jnp.int32),
+            }
+            for _ in range(cfg.n_layers)
+        ]
+
+    def init_paged_cache(
+        self, n_slots: int, n_blocks: int, block_size: int, max_blocks: int
+    ) -> list[dict]:
+        """Paged KV cache (vLLM layout): a pool of ``n_blocks`` KV blocks
+        of ``block_size`` tokens shared by ``n_slots`` sequences, each
+        owning up to ``max_blocks`` table entries. Block 0 is reserved as
+        the scratch write target for inactive slots; -1 marks unassigned
+        table entries. Managed by
+        :class:`rl_tpu.models.serving.ContinuousBatchingEngine`."""
+        cfg = self.cfg
+        return [
+            {
+                "pool_k": jnp.zeros(
+                    (n_blocks, block_size, cfg.kv_heads, cfg.head_dim), cfg.dtype
+                ),
+                "pool_v": jnp.zeros(
+                    (n_blocks, block_size, cfg.kv_heads, cfg.head_dim), cfg.dtype
+                ),
+                "block_table": jnp.full((n_slots, max_blocks), -1, jnp.int32),
+                "len": jnp.zeros((n_slots,), jnp.int32),
+                "active": jnp.zeros((n_slots,), bool),
             }
             for _ in range(cfg.n_layers)
         ]

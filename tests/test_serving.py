@@ -1,0 +1,249 @@
+"""Continuous batching + paged KV serving (round-4 VERDICT next-step #6;
+reference: vLLM delegation in torchrl/modules/llm/backends/vllm/
+vllm_async.py — continuous batching :515, paged KV, load balancing :1559).
+
+Strategy: (1) the paged-attention cache path must be numerically
+identical to the dense-cache path; (2) the engine must recycle blocks and
+match fixed-batch greedy outputs; (3) at mixed sequence lengths the
+engine must beat fixed-batch generate by >= 1.5x on decode work per
+useful token (the continuous-batching claim, asserted on deterministic
+work accounting; wall-clock is printed for reference)."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from rl_tpu.models import (
+    ContinuousBatchingEngine,
+    TransformerConfig,
+    TransformerLM,
+    generate,
+)
+
+KEY = jax.random.key(0)
+
+
+def small_model(**kw):
+    cfg = TransformerConfig(
+        vocab_size=97, d_model=64, n_layers=2, n_heads=4, d_ff=128,
+        max_seq_len=128, dtype=jnp.float32, **kw,
+    )
+    m = TransformerLM(cfg)
+    params = m.init(KEY, jnp.zeros((1, 8), jnp.int32))["params"]
+    return m, params
+
+
+class TestPagedAttention:
+    @pytest.mark.parametrize("gqa", [False, True])
+    def test_prefill_and_decode_match_dense(self, gqa):
+        m, params = small_model(n_kv_heads=2 if gqa else None)
+        toks = jax.random.randint(KEY, (3, 10), 0, 97)
+        S, block, nb, maxb = 3, 4, 16, 8
+        cache = m.init_paged_cache(S, nb, block, maxb)
+        table = np.full((S, maxb), -1, np.int32)
+        for s in range(S):
+            table[s, :4] = 1 + s * 4 + np.arange(4)
+        for layer in cache:
+            layer["block_table"] = jnp.asarray(table)
+            layer["active"] = jnp.ones((S,), bool)
+        lg, cache = m.apply({"params": params}, toks, cache=cache)
+        ref = m.apply({"params": params}, toks)
+        assert float(jnp.abs(lg - ref).max()) < 1e-3
+
+        nxt = jax.random.randint(jax.random.key(1), (3, 5), 0, 97)
+        full = jnp.concatenate([toks, nxt], axis=1)
+        ref_full = m.apply({"params": params}, full)
+        cur = cache
+        for t in range(5):
+            lgt, cur = m.apply({"params": params}, nxt[:, t : t + 1], cache=cur)
+            err = float(jnp.abs(lgt[:, 0] - ref_full[:, 10 + t]).max())
+            assert err < 1e-3, (t, err)
+
+    def test_ragged_bucketed_prefill(self):
+        """Token-level active masks: padded prompts of different lengths
+        in ONE prefill call, each matching its unpadded oracle."""
+        m, params = small_model()
+        toks = jax.random.randint(KEY, (3, 10), 0, 97)
+        lens = [4, 7, 10]
+        S, block, nb, maxb = 3, 4, 16, 8
+        cache = m.init_paged_cache(S, nb, block, maxb)
+        table = np.full((S, maxb), -1, np.int32)
+        for s in range(S):
+            table[s, :4] = 1 + s * 4 + np.arange(4)
+        for layer in cache:
+            layer["block_table"] = jnp.asarray(table)
+            layer["active"] = (
+                jnp.arange(10)[None, :] < jnp.asarray(lens)[:, None]
+            )
+        lg, cache = m.apply({"params": params}, toks, cache=cache)
+        for s, L in enumerate(lens):
+            ref = m.apply({"params": params}, toks[s : s + 1, :L])
+            assert float(jnp.abs(lg[s, :L] - ref[0]).max()) < 1e-3
+            assert int(cache[0]["len"][s]) == L
+
+
+class TestEngine:
+    def test_drain_recycle_and_greedy_equivalence(self):
+        m, params = small_model()
+        eng = ContinuousBatchingEngine(
+            m, params, n_slots=4, block_size=8, n_blocks=65,
+            prompt_buckets=(16, 32), greedy=True,
+        )
+        rng = np.random.default_rng(0)
+        rids = [
+            eng.submit(rng.integers(0, 97, int(rng.integers(4, 20))),
+                       int(rng.integers(4, 24)))
+            for _ in range(10)
+        ]
+        out = eng.run()
+        assert set(out) == set(rids)
+        assert len(eng.free_blocks) == 64  # every block returned
+
+        f0 = out[rids[0]]
+        P = len(f0.prompt)
+        g = generate(
+            m, params, jnp.asarray(f0.prompt)[None], jnp.ones((1, P)),
+            jax.random.key(9), max_new_tokens=len(f0.tokens), greedy=True,
+            eos_id=None,
+        )
+        assert (f0.tokens == np.asarray(g.response_tokens[0])).all()
+
+    def test_eos_frees_slot_early(self):
+        m, params = small_model()
+        eng = ContinuousBatchingEngine(
+            m, params, n_slots=2, block_size=8, n_blocks=33,
+            prompt_buckets=(16,), greedy=True, eos_id=None,
+        )
+        # find the greedy first token for a prompt, then rerun with that
+        # token as eos: the request must finish in exactly 1 token
+        rid = eng.submit(np.arange(5), 8)
+        out = eng.run()
+        first = int(out[rid].tokens[0])
+        eng2 = ContinuousBatchingEngine(
+            m, params, n_slots=2, block_size=8, n_blocks=33,
+            prompt_buckets=(16,), greedy=True, eos_id=first,
+        )
+        rid2 = eng2.submit(np.arange(5), 8)
+        out2 = eng2.run()
+        assert out2[rid2].finished_reason == "eos"
+        assert len(out2[rid2].tokens) == 1
+        assert len(eng2.free_blocks) == 32
+
+    def test_pool_too_small_raises(self):
+        m, params = small_model()
+        eng = ContinuousBatchingEngine(
+            m, params, n_slots=2, block_size=8, n_blocks=2,  # 1 usable block
+            prompt_buckets=(16,), greedy=True,
+        )
+        eng.submit(np.arange(12), 8)  # needs 2 blocks for prompt+1
+        with pytest.raises(RuntimeError, match="block pool too small"):
+            eng.run()
+
+
+class TestThroughput:
+    @pytest.mark.slow
+    def test_continuous_beats_fixed_batch_at_mixed_lengths(self):
+        """The headline claim: >= 1.5x less decode work per useful token
+        than fixed batching when lengths vary (reference vLLM's win)."""
+        m, params = small_model()
+        S = 4
+        # the vLLM scenario: mostly short responses with a heavy tail —
+        # fixed batching runs every row to the batch max, so one long
+        # request stalls its whole batch
+        rng = np.random.default_rng(1)
+        lengths = [8, 8, 12, 64] * 4
+        reqs = [
+            (rng.integers(0, 97, int(rng.integers(4, 16))), n)
+            for n in lengths
+        ]
+        useful = sum(n for _, n in reqs)
+
+        eng = ContinuousBatchingEngine(
+            m, params, n_slots=S, block_size=8, n_blocks=129,
+            prompt_buckets=(16,), greedy=True,
+        )
+        t0 = time.perf_counter()
+        for p, n in reqs:
+            eng.submit(p, n)
+        out = eng.run()
+        t_engine = time.perf_counter() - t0
+        assert len(out) == len(reqs)
+        engine_work = eng.decode_steps * S + eng.prefill_token_slots
+
+        # fixed batching: groups of S in submission order; every row runs
+        # to the batch max (what generate() computes), prompts padded to
+        # the same bucket the engine uses
+        fixed_work = 0
+        t1 = time.perf_counter()
+        for i in range(0, len(reqs), S):
+            chunk = reqs[i : i + S]
+            maxp = max(len(p) for p, _ in chunk)
+            maxn = max(n for _, n in chunk)
+            toks = np.zeros((len(chunk), maxp), np.int32)
+            mask = np.zeros((len(chunk), maxp), np.float32)
+            for j, (p, _) in enumerate(chunk):
+                toks[j, maxp - len(p):] = p  # left-pad (generate convention)
+                mask[j, maxp - len(p):] = 1.0
+            generate(m, params, jnp.asarray(toks), jnp.asarray(mask),
+                     jax.random.key(i), max_new_tokens=maxn, greedy=True,
+                     eos_id=None)
+            fixed_work += len(chunk) * (16 + maxn)  # bucketed prefill + decode
+        t_fixed = time.perf_counter() - t1
+
+        eff_engine = useful / engine_work
+        eff_fixed = useful / fixed_work
+        ratio = eff_engine / eff_fixed
+        print(
+            f"\nuseful={useful} engine_work={engine_work} fixed_work={fixed_work} "
+            f"work-efficiency ratio={ratio:.2f}x | wall: engine={t_engine:.2f}s "
+            f"fixed={t_fixed:.2f}s"
+        )
+        assert ratio >= 1.5, f"continuous batching only {ratio:.2f}x over fixed"
+
+
+class TestAllocatorEdgeCases:
+    def test_block_multiple_prompt_leaks_no_block(self):
+        """P == block_size: the first decode growth must not overwrite the
+        pre-allocated second block (round-5 review finding)."""
+        m, params = small_model()
+        eng = ContinuousBatchingEngine(
+            m, params, n_slots=2, block_size=8, n_blocks=17,
+            prompt_buckets=(16,), greedy=True,
+        )
+        for _ in range(3):  # several generations through the same pool
+            rid = eng.submit(np.arange(8), 10)  # P exactly one block
+            eng.run()
+        assert len(eng.free_blocks) == 16  # nothing leaked
+
+    def test_all_stalled_raises_not_livelock(self):
+        m, params = small_model()
+        eng = ContinuousBatchingEngine(
+            m, params, n_slots=2, block_size=8, n_blocks=5,  # 4 usable
+            prompt_buckets=(16,), greedy=True,
+        )
+        eng.submit(np.arange(7), 20)
+        eng.submit(np.arange(7), 20)
+        with pytest.raises(RuntimeError, match="stalled"):
+            eng.run()
+
+    def test_submit_validation(self):
+        m, params = small_model()
+        eng = ContinuousBatchingEngine(
+            m, params, n_slots=2, block_size=8, n_blocks=17,
+            prompt_buckets=(16,), greedy=True,
+        )
+        with pytest.raises(ValueError, match="max_new_tokens"):
+            eng.submit(np.arange(4), 0)
+        with pytest.raises(ValueError, match="largest prefill bucket"):
+            eng.submit(np.arange(40), 4)
+
+    def test_paged_cache_rejects_attention_mask(self):
+        m, params = small_model()
+        cache = m.init_paged_cache(2, 8, 4, 4)
+        toks = jnp.zeros((2, 4), jnp.int32)
+        with pytest.raises(ValueError, match="paged cache path ignores"):
+            m.apply({"params": params}, toks,
+                    attention_mask=jnp.ones((2, 4), bool), cache=cache)
