@@ -9,6 +9,13 @@ pytree — the whole env runs INSIDE the fused program (collectors scan it,
 vmap batches it, shard_map shards it).
 
 Import-gated: brax is optional; construction raises ImportError without it.
+
+STATUS — EXPERIMENTAL: brax is not in this image, so this bridge has
+never executed against the real library. It IS contract-tested against
+an in-repo fake implementing exactly the API surface it touches
+(tests/fakes/, tests/test_brax_jumanji.py) — spec extraction, step
+conversion, and termination/truncation mapping all run; real-library
+behavior may still differ in untested corners.
 """
 
 from __future__ import annotations

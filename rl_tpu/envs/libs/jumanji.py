@@ -8,6 +8,13 @@ brax, jumanji is already functional JAX, so the bridge relabels:
 directly onto the EnvBase hooks and run inside the fused program.
 
 Import-gated: jumanji is optional; construction raises ImportError.
+
+STATUS — EXPERIMENTAL: jumanji is not in this image, so this bridge has
+never executed against the real library. It IS contract-tested against
+an in-repo fake implementing exactly the API surface it touches
+(tests/fakes/, tests/test_brax_jumanji.py) — spec extraction, step
+conversion, and termination/truncation mapping all run; real-library
+behavior may still differ in untested corners.
 """
 
 from __future__ import annotations

@@ -1,0 +1,81 @@
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class State:
+    pipeline: jax.Array  # [4] pos/vel
+    obs: jax.Array
+    reward: jax.Array
+    done: jax.Array
+    info: dict
+    step: jax.Array
+
+
+class _PointMass:
+    observation_size = 3
+    action_size = 2
+
+    def reset(self, key):
+        pos = jax.random.uniform(key, (2,), minval=-0.5, maxval=0.5)
+        pl = jnp.concatenate([pos, jnp.zeros(2)])
+        return State(
+            pipeline=pl,
+            obs=self._obs(pl),
+            reward=jnp.asarray(0.0),
+            done=jnp.asarray(0.0),
+            info={},
+            step=jnp.asarray(0, jnp.int32),
+        )
+
+    def _obs(self, pl):
+        return jnp.concatenate([pl[:2], jnp.linalg.norm(pl[2:])[None]])
+
+    def step(self, state, action):
+        a = jnp.clip(action, -1.0, 1.0)
+        vel = state.pipeline[2:] * 0.9 + 0.1 * a
+        pos = state.pipeline[:2] + 0.1 * vel
+        pl = jnp.concatenate([pos, vel])
+        done = (jnp.abs(pos) > 2.0).any().astype(jnp.float32)
+        return State(
+            pipeline=pl,
+            obs=self._obs(pl),
+            reward=-jnp.linalg.norm(pos),
+            done=done,
+            info=dict(state.info),
+            step=state.step + 1,
+        )
+
+
+class _EpisodeWrapped(_PointMass):
+    def __init__(self, episode_length):
+        self.episode_length = episode_length
+
+    def reset(self, key):
+        s = super().reset(key)
+        s.info["truncation"] = jnp.asarray(0.0)
+        return s
+
+    def step(self, state, action):
+        s = super().step(state, action)
+        trunc = (s.step >= self.episode_length).astype(jnp.float32) * (1.0 - s.done)
+        s.info["truncation"] = trunc
+        # brax folds truncation into done (the bridge must un-fold it)
+        s.done = jnp.maximum(s.done, trunc)
+        return s
+
+
+_REGISTRY = {"pointmass": _PointMass}
+
+
+def get_environment(name, **kwargs):
+    return _REGISTRY[name]()
+
+
+def create(name, episode_length=None, auto_reset=True, **kwargs):
+    assert auto_reset is False, "the bridge must disable brax auto-reset"
+    env = _EpisodeWrapped(episode_length)
+    return env

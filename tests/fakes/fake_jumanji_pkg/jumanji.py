@@ -1,0 +1,81 @@
+"""In-repo fake of the jumanji API surface rl_tpu.envs.libs.jumanji
+touches: make(), specs with the REAL class names (spec_from_jumanji
+dispatches on type name), functional (state, timestep) protocol with
+dm_env step_type/discount semantics."""
+
+import collections
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+
+class DiscreteArray:
+    def __init__(self, num_values):
+        self.num_values = num_values
+
+
+class BoundedArray:
+    def __init__(self, shape, dtype, minimum, maximum):
+        self.shape, self.dtype = shape, dtype
+        self.minimum, self.maximum = minimum, maximum
+
+
+class Array:
+    def __init__(self, shape, dtype):
+        self.shape, self.dtype = shape, dtype
+
+
+TimeStep = collections.namedtuple(
+    "TimeStep", ["step_type", "reward", "discount", "observation"]
+)
+
+Observation = collections.namedtuple("Observation", ["grid_pos", "steps"])
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class State:
+    pos: jax.Array
+    t: jax.Array
+
+
+class _GridWorld:
+    """5x5 grid walk to the corner: reach (4,4) -> LAST with discount 0
+    (termination); 20-step limit -> LAST with discount 1 (truncation)."""
+
+    observation_spec = type("ObsSpec", (), {"_specs": {
+        "grid_pos": Array(shape=(2,), dtype=jnp.int32),
+        "steps": Array(shape=(), dtype=jnp.int32),
+    }})()
+    action_spec = DiscreteArray(num_values=4)
+
+    def _ts(self, state, step_type, reward, discount):
+        return TimeStep(
+            step_type=jnp.asarray(step_type, jnp.int32),
+            reward=jnp.asarray(reward, jnp.float32),
+            discount=jnp.asarray(discount, jnp.float32),
+            observation=Observation(grid_pos=state.pos, steps=state.t),
+        )
+
+    def reset(self, key):
+        pos = jax.random.randint(key, (2,), 0, 3)
+        state = State(pos=pos, t=jnp.asarray(0, jnp.int32))
+        return state, self._ts(state, 0, 0.0, 1.0)
+
+    def step(self, state, action):
+        moves = jnp.asarray([[0, 1], [0, -1], [1, 0], [-1, 0]], jnp.int32)
+        pos = jnp.clip(state.pos + moves[action], 0, 4)
+        t = state.t + 1
+        state = State(pos=pos, t=t)
+        at_goal = (pos == 4).all()
+        timeout = t >= 20
+        step_type = jnp.where(at_goal | timeout, 2, 1)
+        discount = jnp.where(at_goal, 0.0, 1.0)
+        reward = jnp.where(at_goal, 1.0, -0.05)
+        return state, self._ts(state, step_type, reward, discount)
+
+
+def make(name, **kwargs):
+    assert name == "GridWorld-v0"
+    return _GridWorld()

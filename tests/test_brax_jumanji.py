@@ -2,6 +2,9 @@
 importability; spec translation unit-tested without the lib via stand-in
 spec classes, since neither package ships in this image)."""
 
+import os
+
+import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -117,3 +120,139 @@ class TestJumanjiLive:
         from rl_tpu.envs import check_env_specs
 
         check_env_specs(env)
+
+
+# -- contract tests against in-repo fakes (round-5; round-4 VERDICT #7) -------
+# The real libraries are not in this image, so the wrappers above had never
+# executed. The fakes in tests/fakes/ implement exactly the API surface the
+# bridges touch; these tests drive the REAL wrapper code through it.
+
+
+@pytest.fixture
+def fake_brax(monkeypatch):
+    import sys
+
+    base = os.path.join(os.path.dirname(__file__), "fakes", "fake_brax_pkg")
+    monkeypatch.syspath_prepend(base)
+    for mod in [m for m in sys.modules if m == "brax" or m.startswith("brax.")]:
+        monkeypatch.delitem(sys.modules, mod, raising=False)
+    yield
+    for mod in [m for m in list(sys.modules) if m == "brax" or m.startswith("brax.")]:
+        sys.modules.pop(mod, None)
+
+
+@pytest.fixture
+def fake_jumanji(monkeypatch):
+    import sys
+
+    base = os.path.join(os.path.dirname(__file__), "fakes", "fake_jumanji_pkg")
+    monkeypatch.syspath_prepend(base)
+    monkeypatch.delitem(sys.modules, "jumanji", raising=False)
+    yield
+    sys.modules.pop("jumanji", None)
+
+
+class TestBraxContract:
+    def test_specs_and_rollout(self, fake_brax):
+        from rl_tpu.envs.libs.brax import BraxEnv
+        from rl_tpu.envs.utils import check_env_specs, rollout
+
+        env = BraxEnv("pointmass")
+        check_env_specs(env, jax.random.key(0))
+        assert env.observation_spec["observation"].shape == (3,)
+        assert env.action_spec.shape == (2,)
+        steps = rollout(env, jax.random.key(1), None, max_steps=6)
+        assert steps["observation"].shape == (6, 3)
+
+    def test_truncation_unfolding(self, fake_brax):
+        """brax folds truncation into done; the bridge must report
+        truncated=True terminated=False at the episode_length limit."""
+        import numpy as np
+
+        from rl_tpu.envs.libs.brax import BraxEnv
+
+        env = BraxEnv("pointmass", episode_length=3)
+        state, td = env.reset(jax.random.key(0))
+        for i in range(3):
+            td = td.set("action", jnp.zeros(2))
+            state, out = env.step(state, td)
+            td = out["next"].delete("reward").delete("done").delete(
+                "terminated").delete("truncated")
+        assert bool(out["next", "truncated"])
+        assert not bool(out["next", "terminated"])
+
+    def test_termination_is_not_truncation(self, fake_brax):
+        """Exceeding the position bound terminates (done from the base
+        env, no truncation flag). Drive there with max thrust."""
+        from rl_tpu.envs.libs.brax import BraxEnv
+
+        env = BraxEnv("pointmass")
+        state, td = env.reset(jax.random.key(0))
+        terminated = False
+        for _ in range(60):
+            td_in = td.set("action", jnp.ones(2))
+            state, out = env.step(state, td_in)
+            td = out["next"]
+            if bool(out["next", "terminated"]):
+                terminated = True
+                assert not bool(out["next", "truncated"])
+                break
+        assert terminated
+
+    def test_vmapped_inside_jit(self, fake_brax):
+        from rl_tpu.envs import VmapEnv
+        from rl_tpu.envs.libs.brax import BraxEnv
+        from rl_tpu.envs.utils import rollout
+
+        env = VmapEnv(BraxEnv("pointmass"), 4)
+        steps = rollout(env, jax.random.key(2), None, max_steps=5)
+        assert steps["observation"].shape == (5, 4, 3)
+
+
+class TestJumanjiContract:
+    def test_spec_translation(self, fake_jumanji):
+        from rl_tpu.data import Categorical as CatSpec
+        from rl_tpu.envs.libs.jumanji import JumanjiEnv
+
+        env = JumanjiEnv("GridWorld-v0")
+        assert isinstance(env.action_spec, CatSpec)
+        assert env.action_spec.n == 4
+        assert env.observation_spec["grid_pos"].shape == (2,)
+
+    def test_specs_and_rollout(self, fake_jumanji):
+        from rl_tpu.envs.libs.jumanji import JumanjiEnv
+        from rl_tpu.envs.utils import check_env_specs, rollout
+
+        env = JumanjiEnv("GridWorld-v0")
+        check_env_specs(env, jax.random.key(0))
+        steps = rollout(env, jax.random.key(1), None, max_steps=8)
+        assert steps["grid_pos"].shape == (8, 2)
+
+    def test_dm_env_termination_semantics(self, fake_jumanji):
+        """LAST + discount 0 -> terminated; LAST + discount 1 -> truncated."""
+        from rl_tpu.envs.libs.jumanji import JumanjiEnv
+
+        env = JumanjiEnv("GridWorld-v0")
+        # walk to the corner: +y then +x alternating reaches (4,4) well
+        # inside the 20-step limit from any reset cell -> terminated
+        state, td = env.reset(jax.random.key(0))
+        terminated = False
+        for i in range(16):
+            a = jnp.asarray(0 if i % 2 == 0 else 2)
+            state, out = env.step(state, td.set("action", a))
+            td = out["next"].delete("reward").delete("done").delete(
+                "terminated").delete("truncated")
+            if bool(out["next", "terminated"]):
+                terminated = True
+                assert not bool(out["next", "truncated"])
+                break
+        assert terminated
+
+        # pace back and forth: never reaches the goal -> 20-step truncation
+        state2, td2 = env.reset(jax.random.key(1))
+        for i in range(20):
+            a = jnp.asarray(1)  # -y forever, clipped at the wall
+            state2, out2 = env.step(state2, td2.set("action", a))
+            td2 = out2["next"].delete("reward").delete("done").delete(
+                "terminated").delete("truncated")
+        assert bool(out2["next", "truncated"]) and not bool(out2["next", "terminated"])
