@@ -1,0 +1,23 @@
+"""DDPG on Pendulum (reference analog: sota-implementations/ddpg/).
+Run: python examples/ddpg_pendulum.py"""
+
+from rl_tpu.envs import PendulumEnv, VmapEnv
+from rl_tpu.record import CSVLogger
+from rl_tpu.trainers import OffPolicyConfig
+from rl_tpu.trainers.algorithms import make_ddpg_trainer
+
+
+def main(total_steps: int = 100, n_envs: int = 16, frames: int = 1024):
+    trainer = make_ddpg_trainer(
+        VmapEnv(PendulumEnv(), n_envs),
+        total_steps=total_steps,
+        frames_per_batch=frames,
+        config=OffPolicyConfig(init_random_frames=2048, batch_size=256),
+        logger=CSVLogger("ddpg_pendulum"),
+        log_interval=5,
+    )
+    trainer.train(0)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,159 @@
+"""Dreamer (v1) on Pendulum (reference analog: sota-implementations/
+dreamer/): Gaussian-latent RSSM world model + imagination actor-critic
+with lambda-returns. The v3 twin (examples/dreamerv3_pendulum.py) uses
+the discrete-latent stack; this is the original recipe.
+Run: python examples/dreamer_pendulum.py"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from rl_tpu.data import ArrayDict
+from rl_tpu.envs import PendulumEnv, VmapEnv
+from rl_tpu.models import RSSM, RSSMConfig
+from rl_tpu.models.rssm import DreamerModelLoss
+from rl_tpu.modules import MLP, TanhNormal
+from rl_tpu.objectives import DreamerActorLoss, DreamerValueLoss
+from rl_tpu.record import CSVLogger
+
+N_ENVS, T, HORIZON = 16, 32, 15
+
+
+class LatentActor:
+    def __init__(self, action_dim):
+        self.mlp = MLP(out_features=2 * action_dim, num_cells=(128, 128))
+
+    def _dist(self, params, td):
+        feat = jnp.concatenate([td["h"], td["z"]], axis=-1)
+        loc, raw = jnp.split(self.mlp.apply(params, feat), 2, axis=-1)
+        return TanhNormal(loc, jax.nn.softplus(raw + 0.5413) + 1e-4)
+
+    def init(self, key, td):
+        feat = jnp.concatenate([td["h"], td["z"]], axis=-1)
+        return self.mlp.init(key, feat)
+
+    def __call__(self, params, td, key=None):
+        dist = self._dist(params, td)
+        a = dist.mode if key is None else dist.sample(key)
+        return td.set("action", a)
+
+
+def main(num_steps: int = 60, log_interval: int = 10):
+    env = VmapEnv(PendulumEnv(), N_ENVS)
+    obs_dim = env.observation_spec["observation"].shape[-1]
+    act_dim = env.action_spec.shape[-1]
+    cfg = RSSMConfig(obs_dim=obs_dim, action_dim=act_dim,
+                     deter_dim=128, stoch_dim=32, hidden=128)
+    rssm = RSSM(cfg)
+    actor = LatentActor(act_dim)
+    value_mlp = MLP(out_features=1, num_cells=(128, 128))
+
+    def value_fn(vp, feat):
+        return value_mlp.apply(vp, feat)[..., 0]
+
+    model_loss = DreamerModelLoss(rssm)
+    actor_loss = DreamerActorLoss(
+        rssm, lambda p, td, k: actor(p, td, k), value_fn, horizon=HORIZON
+    )
+    value_loss = DreamerValueLoss(
+        rssm, lambda p, td, k: actor(p, td, k), value_fn, horizon=HORIZON
+    )
+
+    key = jax.random.key(0)
+    feat_dim = cfg.deter_dim + cfg.stoch_dim
+    td0 = ArrayDict(h=jnp.zeros((1, cfg.deter_dim)), z=jnp.zeros((1, cfg.stoch_dim)))
+    params = {
+        "rssm": rssm.init(key),
+        "actor": actor.init(key, td0),
+        "value": value_mlp.init(key, jnp.zeros((1, feat_dim))),
+    }
+    opts = {
+        "rssm": optax.adam(3e-4),
+        "actor": optax.adam(8e-5),
+        "value": optax.adam(8e-5),
+    }
+    ostates = {k: opts[k].init(params[k]) for k in opts}
+
+    @jax.jit
+    def collect(params, key):
+        """Latent-actor collection: online belief filtering
+        (rssm.filter_step) + act on (h, z) — the Dreamer deployment loop."""
+        k0, k1, kroll = jax.random.split(key, 3)
+        env_state, td = env.reset(k0)
+        h = jnp.zeros((N_ENVS, cfg.deter_dim))
+        z = jnp.zeros((N_ENVS, cfg.stoch_dim))
+        h, z = rssm.filter_step(
+            params["rssm"], h, z, jnp.zeros((N_ENVS, act_dim)),
+            td["observation"], jnp.ones((N_ENVS,), bool), k1,
+        )
+
+        def body(carry, k):
+            env_state, td, h, z, was_done = carry
+            ka, kf = jax.random.split(k)
+            a = actor(params["actor"], ArrayDict(h=h, z=z), ka)["action"]
+            env_state, out, carry_td = env.step_and_reset(
+                env_state, td.set("action", a)
+            )
+            nxt = out["next"]
+            step = ArrayDict(
+                observation=td["observation"], action=a,
+                reward=nxt["reward"], terminated=nxt["terminated"],
+                is_first=was_done,
+            )
+            h, z = rssm.filter_step(
+                params["rssm"], h, z, a, carry_td["observation"],
+                nxt["done"], kf,
+            )
+            return (env_state, carry_td, h, z, nxt["done"]), step
+
+        keys = jax.random.split(kroll, T)
+        _, steps = jax.lax.scan(
+            body,
+            (env_state, td, h, z, jnp.zeros((N_ENVS,), bool)),
+            keys,
+        )
+        return jax.tree.map(lambda x: jnp.moveaxis(x, 0, 1), steps)  # [B, T]
+
+    @jax.jit
+    def update(params, ostates, batch, key):
+        km, ka, kv = jax.random.split(key, 3)
+        # DreamerModelLoss takes the rssm params directly
+        (lm, mm), gm = jax.value_and_grad(
+            lambda rp: model_loss(rp, batch, km), has_aux=True
+        )(params["rssm"])
+        upd, ostates["rssm"] = opts["rssm"].update(gm, ostates["rssm"])
+        params = {**params, "rssm": optax.apply_updates(params["rssm"], upd)}
+
+        out = rssm.observe(
+            params["rssm"], batch["observation"], batch["action"],
+            batch["is_first"], km,
+        )
+        latents = ArrayDict(h=out["h"], z=out["z"])
+        (la, ma), ga = jax.value_and_grad(
+            lambda p: actor_loss({**params, "actor": p}, latents, ka), has_aux=True
+        )(params["actor"])
+        upd, ostates["actor"] = opts["actor"].update(ga, ostates["actor"])
+        params = {**params, "actor": optax.apply_updates(params["actor"], upd)}
+
+        (lv, mv), gv = jax.value_and_grad(
+            lambda p: value_loss({**params, "value": p}, latents, kv), has_aux=True
+        )(params["value"])
+        upd, ostates["value"] = opts["value"].update(gv, ostates["value"])
+        params = {**params, "value": optax.apply_updates(params["value"], upd)}
+        return params, ostates, ArrayDict(loss_model=lm, loss_actor=la, loss_value=lv)
+
+    logger = CSVLogger("dreamer_pendulum")
+    for step in range(num_steps):
+        key, kc, ku = jax.random.split(key, 3)
+        batch = collect(params, kc)
+        params, ostates, metrics = update(params, ostates, batch, ku)
+        if step % log_interval == 0:
+            vals = {k: float(v) for k, v in metrics.items()}
+            logger.log_scalars(vals, step=step)
+            print(step, vals)
+    return params
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,106 @@
+"""IQL offline -> online on Pendulum (reference analog:
+sota-implementations/iql/ with a D4RL dataset): synthesize a dataset by
+rolling a random policy, write it in the exact D4RL HDF5 layout, load it
+back through D4RLH5Dataset, pretrain with train_iql, then fine-tune the
+SAME params online on freshly collected transitions.
+Run: python examples/iql_offline_to_online.py"""
+
+import os
+import tempfile
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from rl_tpu.collectors import Collector
+from rl_tpu.data import D4RLH5Dataset
+from rl_tpu.envs import PendulumEnv, VmapEnv
+from rl_tpu.modules import MLP, ConcatMLP
+from rl_tpu.objectives import IQLLoss, SoftUpdate
+from rl_tpu.trainers.algorithms import (
+    _offline_continuous_actor,
+    _offline_example,
+    train_iql,
+)
+
+
+def synthesize_d4rl(path, n_envs=8, steps=64, seed=0):
+    """Random-policy Pendulum transitions in the D4RL on-disk layout."""
+    import h5py
+
+    from rl_tpu.envs.utils import rollout
+
+    env = VmapEnv(PendulumEnv(), n_envs)
+    steps_td = rollout(env, jax.random.key(seed), None, max_steps=steps)
+
+    # rollout() is TIME-major [T, B, ...]; D4RL's on-disk layout is one
+    # flat stream whose next-obs is the global [1:] shift, so rows must be
+    # ENV-major (each env's trajectory contiguous) and each env's last row
+    # must be flagged timeout — otherwise the shift would pair a
+    # transition with another trajectory's observation
+    def env_major(x):
+        return np.moveaxis(np.asarray(x), 0, 1).reshape((-1,) + x.shape[2:])
+
+    obs = env_major(steps_td["observation"])
+    act = env_major(steps_td["action"])
+    rew = env_major(steps_td["next", "reward"])
+    term = env_major(steps_td["next", "terminated"])
+    trunc = env_major(steps_td["next", "truncated"]).copy()
+    trunc[steps - 1 :: steps] = True  # episode boundary at each env's tail
+    with h5py.File(path, "w") as f:
+        f.create_dataset("observations", data=obs)
+        f.create_dataset("actions", data=act)
+        f.create_dataset("rewards", data=rew)
+        f.create_dataset("terminals", data=term)
+        f.create_dataset("timeouts", data=trunc)
+    return path
+
+
+def main(offline_steps: int = 200, online_steps: int = 20, workdir=None):
+    workdir = workdir or tempfile.mkdtemp()
+    h5 = synthesize_d4rl(os.path.join(workdir, "pendulum_random.hdf5"))
+    ds = D4RLH5Dataset(h5, scratch_dir=os.path.join(workdir, "mm"), batch_size=256)
+
+    # -- offline phase (reference IQLTrainer path) ---------------------------
+    params = train_iql(ds.buffer, ds.state, total_steps=offline_steps,
+                       batch_size=128, log_interval=50)
+
+    # -- online fine-tune: SAME params, fresh env data -----------------------
+    actor = _offline_continuous_actor(_offline_example(ds.buffer, ds.state))
+    # architectures must match the offline phase (train_iql defaults)
+    loss = IQLLoss(
+        actor,
+        ConcatMLP(out_features=1, num_cells=(256, 256)),
+        MLP(out_features=1, num_cells=(256, 256)),
+    )
+    env = VmapEnv(PendulumEnv(), 8)
+    coll = Collector(env, lambda p, td, k: actor(p["actor"], td, k),
+                     frames_per_batch=256)
+    cstate = coll.init(jax.random.key(1))
+    opt = optax.adam(3e-4)
+    ost = opt.init(loss.trainable(params))
+    updater = SoftUpdate(loss, tau=0.005)
+
+    @jax.jit
+    def online_step(params, ost, cstate, key):
+        batch, cstate = coll.collect(params, cstate)
+        flat = batch.flatten_batch()
+        v, grads, m = loss.grad(params, flat, key)
+        upd, ost = opt.update(grads, ost, loss.trainable(params))
+        params = updater(
+            loss.merge(optax.apply_updates(loss.trainable(params), upd), params)
+        )
+        return params, ost, cstate, v, m
+
+    for i in range(online_steps):
+        params, ost, cstate, v, m = online_step(
+            params, ost, cstate, jax.random.key(100 + i)
+        )
+        if i % 5 == 0:
+            print(f"online step {i}: loss {float(v):.4f}")
+    return params
+
+
+if __name__ == "__main__":
+    main()

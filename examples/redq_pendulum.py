@@ -1,0 +1,24 @@
+"""REDQ on Pendulum (reference analog: sota-implementations/redq/):
+10-critic ensemble, random 2-subset targets, UTD 8.
+Run: python examples/redq_pendulum.py"""
+
+from rl_tpu.envs import PendulumEnv, VmapEnv
+from rl_tpu.record import CSVLogger
+from rl_tpu.trainers import OffPolicyConfig
+from rl_tpu.trainers.algorithms import make_redq_trainer
+
+
+def main(total_steps: int = 100, n_envs: int = 16, frames: int = 1024):
+    trainer = make_redq_trainer(
+        VmapEnv(PendulumEnv(), n_envs),
+        total_steps=total_steps,
+        frames_per_batch=frames,
+        config=OffPolicyConfig(init_random_frames=2048, batch_size=256, utd_ratio=8),
+        logger=CSVLogger("redq_pendulum"),
+        log_interval=5,
+    )
+    trainer.train(0)
+
+
+if __name__ == "__main__":
+    main()

@@ -211,6 +211,7 @@ _BUILTINS.update({
     # aliases kept from the round-1 registry + builder entry points
     "env/cartpole": "rl_tpu.envs.CartPoleEnv",
     "env/hopper": "rl_tpu.envs.HopperEnv",
+    "env/team_counting": "rl_tpu.testing.MultiAgentCountingEnv",
     "env/walker2d": "rl_tpu.envs.Walker2dEnv",
     "env/mountaincar": "rl_tpu.envs.MountainCarEnv",
     "env/tictactoe": "rl_tpu.envs.TicTacToeEnv",
@@ -240,6 +241,10 @@ _BUILTINS.update({
     "trainer/sac": "rl_tpu.trainers.make_sac_trainer",
     "trainer/dqn": "rl_tpu.trainers.make_dqn_trainer",
     "trainer/td3": "rl_tpu.trainers.make_td3_trainer",
+    "trainer/ddpg": "rl_tpu.trainers.make_ddpg_trainer",
+    "trainer/redq": "rl_tpu.trainers.make_redq_trainer",
+    "trainer/crossq": "rl_tpu.trainers.make_crossq_trainer",
+    "trainer/qmix": "rl_tpu.trainers.make_qmix_trainer",
     "trainer/iql_offline": "rl_tpu.trainers.train_iql",
     "trainer/cql_offline": "rl_tpu.trainers.train_cql",
     "trainer/grpo": "rl_tpu.trainers.GRPOTrainer",

@@ -126,6 +126,17 @@ class _RSSMCore(nn.Module):
         recon, reward, cont = self.decode(h, z)
         return h, z, recon, reward, cont
 
+    def filter_step(self, h, z, a, obs, is_first, key):
+        """One online belief update (deployment-time filtering): zero the
+        belief + previous action where an episode restarts, advance the
+        prior, then sample the posterior given ``obs``."""
+        mask = (1.0 - is_first.astype(jnp.float32))[:, None]
+        h, z, a = h * mask, z * mask, a * mask
+        h, _, _ = self.step_prior(h, z, a)
+        qmean, qstd = self.posterior(h, obs)
+        z = qmean + qstd * jax.random.normal(key, qmean.shape)
+        return h, z
+
     def __call__(self, obs_seq, action_seq, is_first, key):
         # init path: touch every submodule once OUTSIDE lax.scan (flax cannot
         # create params inside a scanned body); apply() uses observe/imagine
@@ -159,6 +170,12 @@ class RSSM:
     def imagine_step(self, params, h, z, a, key):
         return self.core.apply(
             {"params": params}, h, z, a, key, method=_RSSMCore.imagine_step
+        )
+
+    def filter_step(self, params, h, z, a, obs, is_first, key):
+        return self.core.apply(
+            {"params": params}, h, z, a, obs, is_first, key,
+            method=_RSSMCore.filter_step,
         )
 
     def world_model_fn(self):

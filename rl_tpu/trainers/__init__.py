@@ -31,6 +31,7 @@ def __getattr__(name):
     _builders = {
         "make_ppo_trainer", "make_sac_trainer", "make_dqn_trainer",
         "make_td3_trainer", "make_a2c_trainer", "make_impala_trainer", "make_mappo_trainer", "train_iql", "train_cql",
+        "make_ddpg_trainer", "make_redq_trainer", "make_crossq_trainer", "make_qmix_trainer",
         "default_continuous_actor", "default_discrete_actor",
     }
     if name in _builders:

@@ -48,6 +48,10 @@ from .trainer import CountFramesLog, LogScalar, Trainer
 
 __all__ = [
     "make_a2c_trainer",
+    "make_ddpg_trainer",
+    "make_redq_trainer",
+    "make_crossq_trainer",
+    "make_qmix_trainer",
     "train_iql",
     "train_cql",
     "make_ppo_trainer",
@@ -511,3 +515,185 @@ def _offline_continuous_actor(example) -> ProbabilisticActor:
         TDModule(NormalParamExtractor(), ["raw"], ["loc", "scale"]),
     )
     return ProbabilisticActor(net, TanhNormal)
+
+
+def make_ddpg_trainer(
+    env: EnvBase,
+    total_steps: int,
+    buffer_capacity: int = 1_000_000,
+    frames_per_batch: int = 1024,
+    config: OffPolicyConfig | None = None,
+    gamma: float = 0.99,
+    exploration_sigma: float = 0.1,
+    logger: Logger | None = None,
+    log_interval: int = 10,
+    **loss_kwargs,
+) -> Trainer:
+    """DDPG (reference sota-implementations/ddpg/): deterministic tanh
+    actor + single critic, additive Gaussian exploration."""
+    from ..modules import AdditiveGaussianModule
+    from ..objectives import DDPGLoss
+
+    spec = env.action_spec
+    act_dim = _action_dims(env)
+    low = float(jnp.min(jnp.asarray(spec.low)))
+    high = float(jnp.max(jnp.asarray(spec.high)))
+    actor = TDModule(
+        TanhPolicy(action_dim=act_dim, low=low, high=high), ["observation"], ["action"]
+    )
+    loss = DDPGLoss(
+        actor, ConcatMLP(out_features=1, num_cells=(256, 256)), gamma=gamma,
+        **loss_kwargs,
+    )
+    noise = AdditiveGaussianModule(
+        spec, sigma_init=exploration_sigma, sigma_end=exploration_sigma
+    )
+
+    def policy(params, td, key):
+        td = actor(params["actor"], td)
+        return noise(td, key)
+
+    coll = Collector(
+        env, policy, frames_per_batch=frames_per_batch,
+        policy_state=noise.init_state(),
+    )
+    buffer = ReplayBuffer(DeviceStorage(buffer_capacity))
+    cfg = config or OffPolicyConfig(init_random_frames=5000)
+    program = OffPolicyProgram(coll, loss, buffer, cfg)
+    return _std_hooks(Trainer(program, total_steps, logger=logger), log_interval)
+
+
+def make_redq_trainer(
+    env: EnvBase,
+    total_steps: int,
+    buffer_capacity: int = 1_000_000,
+    frames_per_batch: int = 1024,
+    config: OffPolicyConfig | None = None,
+    num_qvalue_nets: int = 10,
+    sub_sample_len: int = 2,
+    gamma: float = 0.99,
+    logger: Logger | None = None,
+    log_interval: int = 10,
+    **loss_kwargs,
+) -> Trainer:
+    """REDQ (reference sota-implementations/redq/): SAC with a large
+    critic ensemble, targets from a random sub-sample, high UTD."""
+    from ..objectives import REDQLoss
+
+    actor = default_continuous_actor(env)
+    loss = REDQLoss(
+        actor,
+        ConcatMLP(out_features=1, num_cells=(256, 256)),
+        num_qvalue_nets=num_qvalue_nets,
+        sub_sample_len=sub_sample_len,
+        gamma=gamma,
+        **loss_kwargs,
+    )
+
+    def policy(params, td, key):
+        return actor(params["actor"], td, key)
+
+    coll = Collector(env, policy, frames_per_batch=frames_per_batch)
+    buffer = ReplayBuffer(DeviceStorage(buffer_capacity))
+    # REDQ's signature: update-to-data ratio >> 1 (the ensemble keeps the
+    # critic stable under aggressive reuse)
+    cfg = config or OffPolicyConfig(init_random_frames=5000, utd_ratio=8)
+    program = OffPolicyProgram(coll, loss, buffer, cfg)
+    return _std_hooks(Trainer(program, total_steps, logger=logger), log_interval)
+
+
+def make_crossq_trainer(
+    env: EnvBase,
+    total_steps: int,
+    buffer_capacity: int = 1_000_000,
+    frames_per_batch: int = 1024,
+    config: OffPolicyConfig | None = None,
+    gamma: float = 0.99,
+    logger: Logger | None = None,
+    log_interval: int = 10,
+    **loss_kwargs,
+) -> Trainer:
+    """CrossQ (reference sota-implementations/crossq/): SAC-style with
+    joint-batch-norm critics and NO target networks."""
+    from ..objectives import CrossQLoss
+
+    actor = default_continuous_actor(env)
+    loss = CrossQLoss(actor, gamma=gamma, **loss_kwargs)
+
+    def policy(params, td, key):
+        return actor(params["actor"], td, key)
+
+    coll = Collector(env, policy, frames_per_batch=frames_per_batch)
+    buffer = ReplayBuffer(DeviceStorage(buffer_capacity))
+    cfg = config or OffPolicyConfig(init_random_frames=5000)
+    program = OffPolicyProgram(coll, loss, buffer, cfg)
+    return _std_hooks(Trainer(program, total_steps, logger=logger), log_interval)
+
+
+class _MultiAgentQNet:
+    """MultiAgentMLP -> the TDModule protocol QMixerLoss expects
+    (per-agent action values under "action_value")."""
+
+    def __init__(self, n_agents: int, n_actions: int, num_cells=(64, 64)):
+        from ..modules import MultiAgentMLP
+
+        self.net = MultiAgentMLP(n_agents, out_features=n_actions, num_cells=num_cells)
+        self.in_keys = [("agents", "observation")]
+        self.out_keys = [("action_value",)]
+
+    def init(self, key, td):
+        return self.net.init(key, td["agents", "observation"])
+
+    def __call__(self, params, td, key=None):
+        return td.set("action_value", self.net(params, td["agents", "observation"]))
+
+
+def make_qmix_trainer(
+    env: EnvBase,
+    total_steps: int,
+    buffer_capacity: int = 100_000,
+    frames_per_batch: int = 512,
+    config: OffPolicyConfig | None = None,
+    gamma: float = 0.99,
+    eps_init: float = 1.0,
+    eps_end: float = 0.05,
+    annealing_num_steps: int = 50_000,
+    mixing_embed_dim: int = 32,
+    state_key: str = "state",
+    logger: Logger | None = None,
+    log_interval: int = 10,
+) -> Trainer:
+    """QMIX (reference sota-implementations/multiagent/qmix_vdn.py):
+    per-agent Q nets + a monotonic state-conditioned mixer, epsilon-greedy
+    per-agent actions, off-policy with replay.
+
+    The env must expose per-agent obs under ("agents", "observation"), a
+    Categorical per-agent action, and a global ``state_key`` for the mixer
+    (the MARL convention — NavigationEnv/MultiAgentCountingEnv shape).
+    """
+    from ..modules import QMixer
+    from ..objectives import QMixerLoss
+
+    n_agents = env.observation_spec["agents", "observation"].shape[0]
+    n_actions = env.action_spec.n
+    qnet = _MultiAgentQNet(n_agents, n_actions)
+    loss = QMixerLoss(
+        qnet, QMixer(n_agents, mixing_dim=mixing_embed_dim),
+        state_key=state_key, gamma=gamma,
+    )
+    # annealed, exploration-type-aware epsilon-greedy (eval runs greedy)
+    eg = EGreedyModule(env.action_spec, eps_init, eps_end, annealing_num_steps)
+
+    def policy(params, td, key):
+        td = qnet(params["qvalue"], td)
+        td = td.set("action", jnp.argmax(td["action_value"], axis=-1))
+        return eg(td, key)
+
+    coll = Collector(
+        env, policy, frames_per_batch=frames_per_batch,
+        policy_state=eg.init_state(),
+    )
+    buffer = ReplayBuffer(DeviceStorage(buffer_capacity))
+    cfg = config or OffPolicyConfig(init_random_frames=1000)
+    program = OffPolicyProgram(coll, loss, buffer, cfg)
+    return _std_hooks(Trainer(program, total_steps, logger=logger), log_interval)
