@@ -1,0 +1,43 @@
+"""Docs don't rot: every fenced python block in docs/ must parse, and
+every `from rl_tpu...` import it shows must resolve against the real
+package (round-4 VERDICT next-step #5b)."""
+
+import ast
+import importlib
+import os
+import re
+
+import pytest
+
+DOCS = os.path.join(os.path.dirname(__file__), "..", "docs")
+
+
+def _python_blocks():
+    for name in sorted(os.listdir(DOCS)):
+        if not name.endswith(".md"):
+            continue
+        text = open(os.path.join(DOCS, name)).read()
+        for i, block in enumerate(re.findall(r"```python\n(.*?)```", text, re.S)):
+            yield f"{name}#{i}", block
+
+
+BLOCKS = list(_python_blocks())
+
+
+def test_docs_have_python_blocks():
+    assert len(BLOCKS) >= 8
+
+
+@pytest.mark.parametrize("label,code", BLOCKS, ids=[b[0] for b in BLOCKS])
+def test_block_parses_and_imports_resolve(label, code):
+    tree = ast.parse(code)  # syntax must be valid
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module and (
+            node.module == "rl_tpu" or node.module.startswith("rl_tpu.")
+        ):
+            mod = importlib.import_module(node.module)
+            for alias in node.names:
+                assert hasattr(mod, alias.name), (
+                    f"{label}: `from {node.module} import {alias.name}` "
+                    f"does not resolve"
+                )
