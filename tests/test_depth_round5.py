@@ -1,0 +1,233 @@
+"""Round-5 test-depth push (round-4 VERDICT next-step #9): the three
+named holes — a collector x env x transform matrix (reference
+test/test_collectors.py's combinatorial strategy), a REAL checkpoint
+schema upgrade (v1 on-disk layout -> v2 code), and GRPO TRAINING at 2048
+context with ring attention inside the loss."""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from rl_tpu.collectors import Collector
+from rl_tpu.data import ArrayDict
+from rl_tpu.envs import (
+    CatFrames,
+    PendulumEnv,
+    RenameTransform,
+    RewardSum,
+    StepCounter,
+    TransformedEnv,
+    VecNorm,
+    VmapEnv,
+)
+from rl_tpu.testing import ContinuousActionMock, CountingEnv
+
+KEY = jax.random.key(0)
+
+
+# -- 1. collector x env x transform matrix ------------------------------------
+
+ENVS = {
+    "counting": lambda: CountingEnv(max_count=5),
+    "pendulum": lambda: PendulumEnv(max_episode_steps=20),
+    "mock_continuous": lambda: ContinuousActionMock(obs_dim=3, act_dim=2),
+}
+TRANSFORMS = {
+    "none": lambda: [],
+    "reward_sum": lambda: [RewardSum()],
+    "stack_norm": lambda: [VecNorm(), CatFrames(2)],
+    "rename_count": lambda: [
+        StepCounter(max_steps=7),
+        RenameTransform(["observation"], ["obs2"]),
+    ],
+}
+
+
+class TestCollectorEnvTransformMatrix:
+    @pytest.mark.slow
+    @pytest.mark.parametrize("env_name", sorted(ENVS))
+    @pytest.mark.parametrize("tf_name", sorted(TRANSFORMS))
+    def test_device_collector_grid(self, env_name, tf_name):
+        """Every combination must: collect the declared frame count,
+        agree with the transformed env's specs, stay finite, and respect
+        autoreset bookkeeping."""
+        base = VmapEnv(ENVS[env_name](), 4)
+        tfs = TRANSFORMS[tf_name]()
+        env = TransformedEnv(base, tfs) if tfs else base
+        coll = Collector(env, None, frames_per_batch=32)  # random policy
+        batch, state = coll.collect({}, coll.init(KEY))
+        obs_key = "obs2" if tf_name == "rename_count" else "observation"
+        assert batch[obs_key].shape[:2] == (8, 4)  # [T, B]
+        spec = env.observation_spec[obs_key]
+        assert batch[obs_key].shape[2:] == tuple(spec.shape)
+        leaves = jax.tree.leaves(batch)
+        assert all(np.isfinite(np.asarray(x)).all() for x in leaves
+                   if np.issubdtype(np.asarray(x).dtype, np.floating))
+        # a second collection continues from carried state (no reset leak)
+        batch2, _ = coll.collect({}, state)
+        if env_name == "counting" and tf_name == "none":
+            # counting obs strictly advance unless an autoreset happened
+            o1 = np.asarray(batch["observation"])
+            assert o1.max() <= 5.0
+        if tf_name == "rename_count":
+            assert "step_count" in batch
+            assert int(np.asarray(batch["step_count"]).max()) <= 7
+        if tf_name == "reward_sum":
+            assert "episode_reward" in batch
+
+    @pytest.mark.slow
+    def test_host_collector_grid(self):
+        """Host pool x gym env: the host path produces the same batch
+        layout the device collectors do (transform application on host
+        envs happens via gym wrappers; the device-side transform matrix
+        above is the transform surface)."""
+        gym = pytest.importorskip("gymnasium")
+        from rl_tpu.collectors import HostCollector, ThreadedEnvPool
+        from rl_tpu.envs.libs import GymEnv
+
+        pool = ThreadedEnvPool([lambda: GymEnv("CartPole-v1") for _ in range(2)])
+        coll = HostCollector(pool, None, frames_per_batch=16)
+        batch = coll.collect({}, KEY)
+        if isinstance(batch, tuple):
+            batch = batch[0]
+        assert batch["observation"].shape[-1] == 4
+        assert np.isfinite(np.asarray(batch["next", "reward"])).all()
+        pool.close()
+
+
+# -- 2. checkpoint schema upgrade: v1 state -> v2 code ------------------------
+
+
+class TestCheckpointSchemaUpgrade:
+    def test_v1_layout_loads_into_v2_code(self, tmp_path):
+        """A REAL migration: v1 stored params as {'w': [...]}: v2 code
+        expects {'linear': {'kernel': [...]}}. The migration rewrites the
+        on-disk component; load restores into the new structure with
+        values intact, and the schema stamp prevents re-application."""
+        from rl_tpu.checkpoint import Checkpoint, JSONAdapter
+        from rl_tpu.checkpoint.checkpoint import SCHEMA_VERSION
+
+        # ---- "v1 code" writes the old layout --------------------------------
+        old_state = {"params": {"w": [1.0, 2.0, 3.0]}}
+        ck_v1 = Checkpoint(str(tmp_path / "ck"))
+        ck_v1.register("model", lambda: old_state, old_state.update,
+                       adapter=JSONAdapter())
+        d = ck_v1.save(step=5)
+        meta = json.load(open(os.path.join(d, "meta.json")))
+        meta["schema_version"] = SCHEMA_VERSION - 1  # stamp as previous era
+        json.dump(meta, open(os.path.join(d, "meta.json"), "w"))
+
+        # ---- "v2 code" with a layout change + its migration ------------------
+        new_state = {"params": {"linear": {"kernel": None}}}
+        ck_v2 = Checkpoint(str(tmp_path / "ck"))
+        ck_v2.register(
+            "model", lambda: new_state,
+            lambda v: new_state.update(v), adapter=JSONAdapter(),
+        )
+
+        def migrate_v0(path):
+            comp = os.path.join(path, "model")
+            data = JSONAdapter().load(comp)
+            data["params"] = {"linear": {"kernel": data["params"].pop("w")}}
+            JSONAdapter().save(comp, data)
+
+        ck_v2.register_migration(SCHEMA_VERSION - 1, migrate_v0)
+        ck_v2.load(step=5)
+        assert new_state["params"]["linear"]["kernel"] == [1.0, 2.0, 3.0]
+        assert "w" not in new_state["params"]
+
+        # the stamp advanced: a fresh Checkpoint WITHOUT the migration loads
+        probe_state = {"params": None}
+        ck_v3 = Checkpoint(str(tmp_path / "ck"))
+        ck_v3.register("model", lambda: probe_state, probe_state.update,
+                       adapter=JSONAdapter())
+        ck_v3.load(step=5)  # would raise if the migration were needed again
+
+
+# -- 3. GRPO training at 2048 context with ring attention in the loss ---------
+
+
+class TestGRPOLongContextRing:
+    @pytest.mark.mesh
+    @pytest.mark.slow
+    def test_grpo_trains_at_2048_through_ring_attention(self):
+        """Ring attention has so far only been exercised in forwards; this
+        runs the GRPO VALUE-AND-GRAD at T=2048 with the sequence sharded
+        over a 4-way context axis — the configuration the kernel exists
+        for — and checks the update against the local-attention oracle."""
+        import optax
+
+        from rl_tpu.models import TransformerConfig, TransformerLM, token_log_probs
+        from rl_tpu.objectives.llm.grpo import GRPOLoss, mc_advantage
+        from rl_tpu.parallel import make_mesh
+
+        mesh = make_mesh(data=1, context=4)
+        T, B = 2048, 2
+        common = dict(
+            vocab_size=256, d_model=64, n_layers=2, n_heads=4, d_ff=128,
+            max_seq_len=T, dtype=jnp.float32,
+        )
+        ring_lm = TransformerLM(TransformerConfig(
+            attention_impl="ring", mesh=mesh, **common))
+        local_lm = TransformerLM(TransformerConfig(**common))
+
+        toks = jax.random.randint(KEY, (B, T), 1, 256)
+        params = local_lm.init(KEY, toks[:, :8])["params"]
+        lp0 = token_log_probs(local_lm, params, toks)
+        amask = jnp.concatenate(
+            [jnp.zeros((B, T // 2), bool), jnp.ones((B, T // 2), bool)], axis=1
+        )
+        reward = jnp.asarray([1.0, -1.0])
+        adv = mc_advantage(reward, jnp.arange(B) // 2, 1)
+        batch = ArrayDict(
+            tokens=toks, sample_log_prob=lp0,
+            assistant_mask=amask, advantage=adv,
+        )
+
+        def loss_of(lm):
+            return GRPOLoss(lambda p, b: token_log_probs(lm, p, b["tokens"]))
+
+        with mesh:
+            (v_ring, m_ring), g_ring = jax.jit(
+                jax.value_and_grad(
+                    lambda p: loss_of(ring_lm)(p, batch), has_aux=True
+                )
+            )(params)
+            jax.block_until_ready(v_ring)
+        (v_loc, m_loc), g_loc = jax.jit(
+            jax.value_and_grad(
+                lambda p: loss_of(local_lm)(p, batch), has_aux=True
+            )
+        )(params)
+
+        assert np.isfinite(float(v_ring))
+        np.testing.assert_allclose(float(v_ring), float(v_loc), rtol=1e-3, atol=1e-5)
+        # gradients agree leaf-wise: the ring collective path backprops
+        # identically to the local oracle
+        ring_leaves = {
+            jax.tree_util.keystr(kp): g
+            for kp, g in jax.tree_util.tree_leaves_with_path(g_ring)
+        }
+        loc_leaves = {
+            jax.tree_util.keystr(kp): g
+            for kp, g in jax.tree_util.tree_leaves_with_path(g_loc)
+        }
+        assert ring_leaves.keys() == loc_leaves.keys()
+        for name in ring_leaves:
+            np.testing.assert_allclose(
+                np.asarray(ring_leaves[name]), np.asarray(loc_leaves[name]),
+                rtol=5e-3, atol=1e-5, err_msg=name,
+            )
+
+        # and one optimizer step applies cleanly on the ring path
+        opt = optax.adam(1e-4)
+        ost = opt.init(params)
+        upd, ost = opt.update(g_ring, ost)
+        new_params = optax.apply_updates(params, upd)
+        assert all(
+            np.isfinite(np.asarray(x)).all() for x in jax.tree.leaves(new_params)
+        )

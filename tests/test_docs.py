@@ -16,7 +16,7 @@ def _python_blocks():
     for name in sorted(os.listdir(DOCS)):
         if not name.endswith(".md"):
             continue
-        text = open(os.path.join(DOCS, name)).read()
+        text = open(os.path.join(DOCS, name), encoding="utf-8").read()
         for i, block in enumerate(re.findall(r"```python\n(.*?)```", text, re.S)):
             yield f"{name}#{i}", block
 
