@@ -52,6 +52,7 @@ from .replay import (
 
 __all__ = ["MinariH5Dataset", "AtariDQNDataset", "LeRobotDataset",
            "D4RLH5Dataset", "OpenXDataset",
+           "RobosetDataset", "VD4RLDataset", "OpenMLDataset",
            "atari_name_to_key", "lerobot_key"]
 
 # reference minari_data.py:57 _NAME_MATCH
@@ -81,6 +82,28 @@ def _episode_leaves(group) -> dict[tuple, np.ndarray]:
 
     walk((), group)
     return out
+
+
+def _zero_shift(arr: np.ndarray) -> np.ndarray:
+    """Successor view: rows [1:] with a ZERO final row (the convention the
+    RLDS-flavored loaders share for the last step of an episode)."""
+    out = np.zeros_like(arr)
+    out[:-1] = arr[1:]
+    return out
+
+
+def _check_row_schemas(rows, what: str):
+    """Equal leaf-key sets across per-episode rows, with a useful error
+    (a raw pytree concat mismatch names no episode or key)."""
+    ref_keys = set(rows[0].keys(nested=True, leaves_only=True))
+    for i, r in enumerate(rows[1:], 1):
+        keys = set(r.keys(nested=True, leaves_only=True))
+        if keys != ref_keys:
+            raise ValueError(
+                f"{what} {i} schema mismatch vs {what} 0: "
+                f"missing {sorted(ref_keys - keys)}, "
+                f"extra {sorted(keys - ref_keys)}"
+            )
 
 
 def _sealed_buffer(items, n, *, sampler, batch_size, scratch_dir):
@@ -714,11 +737,9 @@ class OpenXDataset(_OfflineDataset):
 
             for leaf in obs_leaves((), steps[0]["observation"]):
                 arr = stack(("observation",) + leaf)
-                pad = np.concatenate(
-                    [arr[1:], np.zeros_like(arr[:1])], axis=0
-                )  # zero-padded successor, reference pad(observation_[1:], [0,1])
+                # zero-padded successor, reference pad(observation_[1:], [0,1])
                 td = td.set(("observation",) + leaf, arr)
-                nxt = nxt.set(("observation",) + leaf, pad)
+                nxt = nxt.set(("observation",) + leaf, _zero_shift(arr))
 
             td = td.set("action", stack(("action",)))
             if "discount" in steps[0]:
@@ -755,15 +776,7 @@ class OpenXDataset(_OfflineDataset):
         if len(rows) > 1:
             import jax
 
-            ref_keys = set(rows[0].keys(nested=True, leaves_only=True))
-            for i, r in enumerate(rows[1:], 1):
-                keys = set(r.keys(nested=True, leaves_only=True))
-                if keys != ref_keys:
-                    raise ValueError(
-                        f"episode {i} schema mismatch vs episode 0: "
-                        f"missing {sorted(ref_keys - keys)}, "
-                        f"extra {sorted(keys - ref_keys)}"
-                    )
+            _check_row_schemas(rows, "episode")
             flat = jax.tree.map(lambda *xs: np.concatenate(xs, axis=0), *rows)
         self.n_episodes = n_eps
         self.n_steps = int(flat["episode"].shape[0])
@@ -771,3 +784,276 @@ class OpenXDataset(_OfflineDataset):
             flat, self.n_steps, sampler=sampler, batch_size=batch_size,
             scratch_dir=scratch_dir,
         )
+
+
+class RobosetDataset(_OfflineDataset):
+    """RoboHive/RoboSet trajectory H5 files (reference
+    torchrl/data/datasets/roboset.py:246 ``_preproc_h5``): each file holds
+    ``Trial<n>`` groups with T-row ``observations`` / ``actions`` /
+    ``rewards`` / ``done`` (+ ``env_infos/*`` subgroups).
+
+    Reference-exact reassembly: observations/env_infos keep their full T
+    rows at the root with ``next`` = rows ``[1:]`` and a ZERO final
+    successor (roboset.py:324 copies ``val[1:]`` into ``next[:-1]`` of a
+    zero-initialized buffer); rewards land under ``next`` only; ``done``
+    lands at BOTH root and next with ``next.terminated`` copied from
+    ``next.done`` (roboset.py:333); ``episode`` and ``seed`` provenance
+    columns. Scalar per-step shapes (framework convention — the
+    reference's trailing unsqueeze is dropped, as with the other loaders).
+    """
+
+    def __init__(
+        self,
+        h5_files,
+        *,
+        batch_size: int | None = 256,
+        sampler=None,
+        scratch_dir: str | None = None,
+    ):
+        import h5py
+
+        if isinstance(h5_files, (str, Path)):
+            h5_files = [h5_files]
+        rows = []
+        n_eps = 0
+        for seed, path in enumerate(h5_files):
+            with h5py.File(str(path), "r") as f:
+                trials = sorted(
+                    (k for k in f.keys() if k.startswith("Trial")),
+                    key=lambda k: int(k[len("Trial"):]),
+                )
+                if not trials:
+                    raise ValueError(f"{path}: no Trial<n> groups found")
+                for tk in trials:
+                    g = f[tk]
+                    ep_num = int(tk[len("Trial"):])
+                    T = g["actions"].shape[0]
+                    td = ArrayDict(
+                        episode=np.full((T,), ep_num, np.int32),
+                        seed=np.full((T,), seed, np.int32),
+                        action=np.asarray(g["actions"][()]),
+                    )
+                    nxt = ArrayDict()
+                    for name, node in g.items():
+                        if name in ("actions",):
+                            continue
+                        if name == "observations":
+                            arr = np.asarray(node[()])
+                            self._check_T(arr, T, name, tk)
+                            td = td.set("observation", arr)
+                            nxt = nxt.set("observation", _zero_shift(arr))
+                        elif name == "env_infos":
+                            for sub, leaf in _episode_leaves(node).items():
+                                self._check_T(leaf, T, name, tk)
+                                td = td.set(("info",) + sub, leaf)
+                                nxt = nxt.set(("info",) + sub, _zero_shift(leaf))
+                        elif name == "rewards":
+                            arr = np.asarray(node[()], np.float32)
+                            self._check_T(arr, T, name, tk)
+                            nxt = nxt.set("reward", arr.reshape(T))
+                        elif name == "done":
+                            arr = np.asarray(node[()], bool)
+                            self._check_T(arr, T, name, tk)
+                            arr = arr.reshape(T)
+                            td = td.set("done", arr)
+                            nxt = nxt.set("done", arr)
+                            nxt = nxt.set("terminated", arr.copy())
+                        else:  # pass-through (reference identity NAME_MATCH)
+                            arr = np.asarray(node[()])
+                            self._check_T(arr, T, name, tk)
+                            td = td.set(name, arr)
+                    rows.append(td.set("next", nxt))
+                    n_eps += 1
+
+        flat = rows[0]
+        if len(rows) > 1:
+            import jax
+
+            _check_row_schemas(rows, "trial")
+            flat = jax.tree.map(lambda *xs: np.concatenate(xs, axis=0), *rows)
+        self.n_episodes = n_eps
+        self.n_steps = int(flat["episode"].shape[0])
+        self.buffer, self.state = _sealed_buffer(
+            flat, self.n_steps, sampler=sampler, batch_size=batch_size,
+            scratch_dir=scratch_dir,
+        )
+
+    @staticmethod
+    def _check_T(arr, T, name, trial):
+        if arr.shape[0] != T:
+            raise RuntimeError(
+                f"Mismatching number of steps for key {name} in {trial}: "
+                f"expected {T} but got {arr.shape[0]}."
+            )
+
+
+# reference vd4rl.py:420 _NAME_MATCH (identity default)
+_VD4RL_NAME_MATCH = {
+    "is_first": ("is_init",),
+    "is_last": ("next", "done"),
+    "is_terminal": ("next", "terminated"),
+    "reward": ("next", "reward"),
+    "image": ("pixels",),
+    "observation": ("pixels",),
+    "discount": ("discount",),
+    "action": ("action",),
+}
+
+
+class VD4RLDataset(_OfflineDataset):
+    """V-D4RL episode files — npz or hdf5 with flat T-row arrays in the
+    RLDS-flavored V-D4RL schema (reference torchrl/data/datasets/
+    vd4rl.py:270 ``_download_and_preproc`` + :340 ``_process_data``).
+
+    Reference-exact conversion: ``image``/``observation`` -> ``pixels``;
+    ``is_first -> is_init``, ``is_last -> next.done``, ``is_terminal ->
+    next.terminated``, ``reward -> next.reward``; every UNMATCHED key
+    lands under ``("state", name)`` (proprioception); ``next.pixels`` /
+    ``next.state`` are the one-row shift with a ZERO final successor;
+    ``next.truncated = next.done & ~next.terminated``; root flags zeroed.
+    Scalar per-step shapes (framework convention).
+    """
+
+    def __init__(
+        self,
+        files,
+        *,
+        batch_size: int | None = 256,
+        sampler=None,
+        scratch_dir: str | None = None,
+    ):
+        if isinstance(files, (str, Path)):
+            files = [files]
+        rows = []
+        for ep_id, path in enumerate(files):
+            arrays = self._load_file(path)
+            if "action" not in arrays:
+                raise ValueError(f"{path}: no 'action' key")
+            T = arrays["action"].shape[0]
+            td = ArrayDict(episode=np.full((T,), ep_id, np.int32))
+            nxt = ArrayDict()
+            state_keys = []
+            for name, arr in arrays.items():
+                if arr.shape[0] != T:
+                    raise RuntimeError(
+                        f"{path}: key {name} has {arr.shape[0]} rows, expected {T}"
+                    )
+                dest = _VD4RL_NAME_MATCH.get(name)
+                if dest is None:
+                    td = td.set(("state", name), arr)
+                    state_keys.append(name)
+                elif dest[0] == "next":
+                    kind = dest[1]
+                    arr = np.asarray(
+                        arr, np.float32 if kind == "reward" else bool
+                    ).reshape(T)
+                    nxt = nxt.set(kind, arr)
+                elif dest == ("is_init",):
+                    td = td.set("is_init", np.asarray(arr, bool).reshape(T))
+                else:
+                    td = td.set(dest, arr)
+
+            if "image" in arrays and "observation" in arrays:
+                raise ValueError(
+                    f"{path}: both 'image' and 'observation' present — "
+                    f"both map to pixels and one would be silently dropped"
+                )
+            if "pixels" in td:
+                nxt = nxt.set("pixels", _zero_shift(td["pixels"]))
+            for name in state_keys:
+                nxt = nxt.set(("state", name), _zero_shift(td["state", name]))
+            if "done" not in nxt:
+                raise ValueError(f"{path}: no 'is_last' key")
+            if "terminated" not in nxt:
+                nxt = nxt.set("terminated", np.zeros(T, bool))
+            nxt = nxt.set("truncated", nxt["done"] & ~nxt["terminated"])
+            for k in ("done", "terminated", "truncated"):
+                td = td.set(k, np.zeros(T, bool))
+            rows.append(td.set("next", nxt))
+
+        flat = rows[0]
+        if len(rows) > 1:
+            import jax
+
+            _check_row_schemas(rows, "file")
+            flat = jax.tree.map(lambda *xs: np.concatenate(xs, axis=0), *rows)
+        self.n_episodes = len(rows)
+        self.n_steps = int(flat["episode"].shape[0])
+        self.buffer, self.state = _sealed_buffer(
+            flat, self.n_steps, sampler=sampler, batch_size=batch_size,
+            scratch_dir=scratch_dir,
+        )
+
+    @staticmethod
+    def _load_file(path) -> dict[str, np.ndarray]:
+        path = str(path)
+        if path.endswith(".npz"):
+            npz = np.load(path)
+            return {name: np.asarray(npz[name]) for name in npz.files}
+        import h5py
+
+        out = {}
+        with h5py.File(path, "r") as f:
+            for name, node in f.items():
+                if hasattr(node, "shape"):
+                    out[name] = np.asarray(node[()])
+        return out
+
+
+class OpenMLDataset(_OfflineDataset):
+    """Tabular contextual-bandit datasets (reference torchrl/data/
+    datasets/openml.py:23 ``OpenMLExperienceReplay``): rows are
+    ``{"X": features, "y": integer outcome}``; :attr:`max_outcome_val`
+    mirrors the reference attribute (openml.py:88).
+
+    Construct with arrays (``OpenMLDataset(X, y)`` /
+    :meth:`from_arrays`); the NAMED form is the classmethod
+    :meth:`from_name` (``OpenMLDataset.from_name("adult_num")``), which
+    needs scikit-learn + pandas and network access exactly like the
+    reference — it is import-gated.
+    """
+
+    def __init__(self, X, y, *, batch_size: int | None = 256, sampler=None,
+                 scratch_dir: str | None = None):
+        X = np.asarray(X, np.float32)
+        y = np.asarray(y, np.int64)
+        if X.shape[0] != y.shape[0]:
+            raise ValueError(f"X has {X.shape[0]} rows, y has {y.shape[0]}")
+        self.max_outcome_val = int(y.max())
+        self.n_steps = int(X.shape[0])
+        td = ArrayDict(X=X, y=y.astype(np.int32))
+        self.buffer, self.state = _sealed_buffer(
+            td, self.n_steps, sampler=sampler, batch_size=batch_size,
+            scratch_dir=scratch_dir,
+        )
+
+    @classmethod
+    def from_arrays(cls, X, y, **kw) -> "OpenMLDataset":
+        return cls(X, y, **kw)
+
+    @classmethod
+    def from_name(cls, name: str, **kw) -> "OpenMLDataset":
+        try:
+            from sklearn.datasets import fetch_openml  # noqa: F401
+        except ImportError as e:  # pragma: no cover - optional dep
+            raise ImportError(
+                "OpenMLDataset.from_name requires scikit-learn + pandas "
+                "(not in this image); use from_arrays"
+            ) from e
+        from sklearn.preprocessing import LabelEncoder, StandardScaler
+
+        fetch_map = {
+            "adult_num": ("adult", 1), "mushroom_num": ("mushroom", 1),
+            "covertype": ("covertype", 3), "shuttle": ("shuttle", 1),
+            "magic": ("MagicTelescope", 1),
+        }
+        if name not in fetch_map:
+            raise KeyError(f"unsupported OpenML dataset {name!r}")
+        ds, version = fetch_map[name]
+        X, y = fetch_openml(ds, version=version, return_X_y=True)
+        enc = LabelEncoder()
+        for col in X.select_dtypes(include=["category"]).columns:
+            X[col] = enc.fit_transform(X[col])
+        y = enc.fit_transform(y)
+        X = StandardScaler().fit_transform(X)
+        return cls(X, y, **kw)

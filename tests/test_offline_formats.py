@@ -619,3 +619,149 @@ class TestOpenXEdgeCases:
         ep_b = make_openx_episode(2, seed=10)
         with pytest.raises(ValueError, match="schema mismatch"):
             OpenXDataset([ep_a, ep_b])
+
+
+def write_roboset_fixture(path, trials=((6, False), (4, True)), obs_dim=3,
+                          act_dim=2, seed=0):
+    """The RoboHive H5 layout: Trial<n> groups with T-row arrays and an
+    env_infos subgroup."""
+    import h5py
+
+    rng = np.random.default_rng(seed)
+    raw = {}
+    with h5py.File(path, "w") as f:
+        for n, (T, ends) in enumerate(trials):
+            g = f.create_group(f"Trial{n}")
+            obs = rng.normal(size=(T, obs_dim)).astype(np.float32)
+            done = np.zeros(T, bool)
+            done[-1] = ends
+            g.create_dataset("observations", data=obs)
+            g.create_dataset("actions", data=rng.normal(size=(T, act_dim)).astype(np.float32))
+            g.create_dataset("rewards", data=rng.normal(size=(T,)).astype(np.float32))
+            g.create_dataset("done", data=done)
+            gi = g.create_group("env_infos")
+            gi.create_dataset("qpos", data=rng.normal(size=(T, 2)).astype(np.float32))
+            raw[n] = dict(obs=obs, done=done)
+    return raw
+
+
+class TestRoboset:
+    def test_reassembly_matches_reference_semantics(self, tmp_path):
+        from rl_tpu.data import RobosetDataset
+
+        raw = write_roboset_fixture(tmp_path / "r.h5")
+        ds = RobosetDataset(tmp_path / "r.h5", scratch_dir=str(tmp_path / "mm"))
+        assert ds.n_episodes == 2 and ds.n_steps == 10
+        got = jax.tree.map(
+            np.asarray, ds.buffer.storage.get(ds.state["storage"], jnp.arange(10))
+        )
+        # trial 0: next obs = obs[1:], zero final successor (roboset.py:324)
+        np.testing.assert_array_equal(got["observation"][:6], raw[0]["obs"])
+        np.testing.assert_array_equal(got["next"]["observation"][:5], raw[0]["obs"][1:])
+        np.testing.assert_array_equal(
+            got["next"]["observation"][5], np.zeros(3, np.float32)
+        )
+        # done at BOTH root and next; next.terminated copied from next.done
+        np.testing.assert_array_equal(got["done"], got["next"]["done"])
+        np.testing.assert_array_equal(got["next"]["terminated"], got["next"]["done"])
+        assert bool(got["next"]["done"][9]) and not bool(got["next"]["done"][5])
+        # provenance + infos at both views
+        np.testing.assert_array_equal(got["episode"], [0] * 6 + [1] * 4)
+        assert got["info"]["qpos"].shape == (10, 2)
+        assert got["next"]["info"]["qpos"].shape == (10, 2)
+
+    def test_mismatched_rows_raise(self, tmp_path):
+        import h5py
+
+        from rl_tpu.data import RobosetDataset
+
+        with h5py.File(tmp_path / "bad.h5", "w") as f:
+            g = f.create_group("Trial0")
+            g.create_dataset("actions", data=np.zeros((4, 2), np.float32))
+            g.create_dataset("observations", data=np.zeros((5, 3), np.float32))
+            g.create_dataset("rewards", data=np.zeros((4,), np.float32))
+            g.create_dataset("done", data=np.zeros(4, bool))
+        with pytest.raises(RuntimeError, match="Mismatching number of steps"):
+            RobosetDataset(tmp_path / "bad.h5")
+
+
+def write_vd4rl_npz(path, T=6, terminal=True, seed=0):
+    rng = np.random.default_rng(seed)
+    data = {
+        "observation": rng.integers(0, 255, size=(T, 8, 8, 3)).astype(np.uint8),
+        "action": rng.normal(size=(T, 2)).astype(np.float32),
+        "reward": rng.normal(size=(T,)).astype(np.float32),
+        "discount": np.ones(T, np.float32),
+        "is_first": np.eye(1, T, 0, dtype=bool)[0],
+        "is_last": np.eye(1, T, T - 1, dtype=bool)[0],
+        "is_terminal": np.eye(1, T, T - 1, dtype=bool)[0] if terminal else np.zeros(T, bool),
+        "proprio": rng.normal(size=(T, 4)).astype(np.float32),  # unmatched
+    }
+    np.savez(path, **data)
+    return data
+
+
+class TestVD4RL:
+    def test_npz_conversion(self, tmp_path):
+        from rl_tpu.data import VD4RLDataset
+
+        d1 = write_vd4rl_npz(tmp_path / "e1.npz", T=6, terminal=True, seed=1)
+        d2 = write_vd4rl_npz(tmp_path / "e2.npz", T=4, terminal=False, seed=2)
+        ds = VD4RLDataset([tmp_path / "e1.npz", tmp_path / "e2.npz"],
+                          scratch_dir=str(tmp_path / "mm"))
+        assert ds.n_episodes == 2 and ds.n_steps == 10
+        got = jax.tree.map(
+            np.asarray, ds.buffer.storage.get(ds.state["storage"], jnp.arange(10))
+        )
+        # observation -> pixels; next = zero-padded shift
+        np.testing.assert_array_equal(got["pixels"][:6], d1["observation"])
+        np.testing.assert_array_equal(got["next"]["pixels"][:5], d1["observation"][1:])
+        assert not got["next"]["pixels"][5].any()
+        # unmatched key under ("state", name), shifted too
+        np.testing.assert_array_equal(got["state"]["proprio"][:6], d1["proprio"])
+        np.testing.assert_array_equal(
+            got["next"]["state"]["proprio"][:5], d1["proprio"][1:]
+        )
+        # episode 1 terminal; episode 2 is_last without terminal -> truncated
+        assert bool(got["next"]["terminated"][5]) and not bool(got["next"]["truncated"][5])
+        assert bool(got["next"]["truncated"][9]) and not bool(got["next"]["terminated"][9])
+        for k in ("done", "terminated", "truncated"):
+            assert not got[k].any()
+        np.testing.assert_array_equal(got["is_init"][:6], d1["is_first"])
+
+    def test_h5_equivalent(self, tmp_path):
+        import h5py
+
+        from rl_tpu.data import VD4RLDataset
+
+        d = write_vd4rl_npz(tmp_path / "tmp.npz", T=5, seed=3)
+        with h5py.File(tmp_path / "e.hdf5", "w") as f:
+            for k, v in d.items():
+                f.create_dataset(k, data=v)
+        ds = VD4RLDataset(tmp_path / "e.hdf5", scratch_dir=str(tmp_path / "mm"))
+        assert ds.n_steps == 5
+
+
+class TestOpenML:
+    def test_from_arrays_bandit_layout(self, tmp_path):
+        from rl_tpu.data import OpenMLDataset
+
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(50, 7)).astype(np.float32)
+        y = rng.integers(0, 5, size=(50,))
+        ds = OpenMLDataset(X, y, scratch_dir=str(tmp_path / "mm"), batch_size=16)
+        assert ds.max_outcome_val == int(y.max())
+        batch = ds.sample(jax.random.key(0))
+        assert batch["X"].shape == (16, 7)
+        assert batch["y"].shape == (16,)
+        got = jax.tree.map(
+            np.asarray, ds.buffer.storage.get(ds.state["storage"], jnp.arange(50))
+        )
+        np.testing.assert_allclose(got["X"], X, rtol=1e-6)
+        np.testing.assert_array_equal(got["y"], y)
+
+    def test_row_mismatch_raises(self):
+        from rl_tpu.data import OpenMLDataset
+
+        with pytest.raises(ValueError, match="rows"):
+            OpenMLDataset(np.zeros((4, 2)), np.zeros(5))
