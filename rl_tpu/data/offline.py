@@ -52,7 +52,7 @@ from .replay import (
 
 __all__ = ["MinariH5Dataset", "AtariDQNDataset", "LeRobotDataset",
            "D4RLH5Dataset", "OpenXDataset",
-           "RobosetDataset", "VD4RLDataset", "OpenMLDataset",
+           "RobosetDataset", "VD4RLDataset", "OpenMLDataset", "GenDGRLDataset",
            "atari_name_to_key", "lerobot_key"]
 
 # reference minari_data.py:57 _NAME_MATCH
@@ -90,6 +90,17 @@ def _zero_shift(arr: np.ndarray) -> np.ndarray:
     out = np.zeros_like(arr)
     out[:-1] = arr[1:]
     return out
+
+
+def _concat_rows(rows, what: str):
+    """Concatenate per-episode ArrayDict rows into one flat dataset
+    (shared epilogue of every multi-episode loader)."""
+    if len(rows) == 1:
+        return rows[0]
+    import jax
+
+    _check_row_schemas(rows, what)
+    return jax.tree.map(lambda *xs: np.concatenate(xs, axis=0), *rows)
 
 
 def _check_row_schemas(rows, what: str):
@@ -220,13 +231,7 @@ class MinariH5Dataset(_OfflineDataset):
             nxt = nxt.set("done", nxt["terminated"] | nxt["truncated"])
             rows.append(td.set("next", nxt))
 
-        flat = rows[0]
-        if len(rows) > 1:
-            import jax  # tree-structured concat only; leaves stay numpy
-
-            flat = jax.tree.map(
-                lambda *xs: np.concatenate(xs, axis=0), *rows
-            )
+        flat = _concat_rows(rows, "episode")
         self.n_episodes = len(rows)
         self.n_steps = int(flat["episode"].shape[0])
 
@@ -772,12 +777,7 @@ class OpenXDataset(_OfflineDataset):
             )
             rows.append(td.set("next", nxt))
 
-        flat = rows[0]
-        if len(rows) > 1:
-            import jax
-
-            _check_row_schemas(rows, "episode")
-            flat = jax.tree.map(lambda *xs: np.concatenate(xs, axis=0), *rows)
+        flat = _concat_rows(rows, "episode")
         self.n_episodes = n_eps
         self.n_steps = int(flat["episode"].shape[0])
         self.buffer, self.state = _sealed_buffer(
@@ -865,12 +865,7 @@ class RobosetDataset(_OfflineDataset):
                     rows.append(td.set("next", nxt))
                     n_eps += 1
 
-        flat = rows[0]
-        if len(rows) > 1:
-            import jax
-
-            _check_row_schemas(rows, "trial")
-            flat = jax.tree.map(lambda *xs: np.concatenate(xs, axis=0), *rows)
+        flat = _concat_rows(rows, "trial")
         self.n_episodes = n_eps
         self.n_steps = int(flat["episode"].shape[0])
         self.buffer, self.state = _sealed_buffer(
@@ -971,12 +966,7 @@ class VD4RLDataset(_OfflineDataset):
                 td = td.set(k, np.zeros(T, bool))
             rows.append(td.set("next", nxt))
 
-        flat = rows[0]
-        if len(rows) > 1:
-            import jax
-
-            _check_row_schemas(rows, "file")
-            flat = jax.tree.map(lambda *xs: np.concatenate(xs, axis=0), *rows)
+        flat = _concat_rows(rows, "file")
         self.n_episodes = len(rows)
         self.n_steps = int(flat["episode"].shape[0])
         self.buffer, self.state = _sealed_buffer(
@@ -1057,3 +1047,103 @@ class OpenMLDataset(_OfflineDataset):
         y = enc.fit_transform(y)
         X = StandardScaler().fit_transform(X)
         return cls(X, y, **kw)
+
+
+class GenDGRLDataset(_OfflineDataset):
+    """Gen-DGRL (ProcGen) trajectories (reference torchrl/data/datasets/
+    gen_dgrl.py:179 ``_download_and_preproc``): each trajectory is a
+    pickled-dict ``.npy`` with ``observations`` (T+1 uint8 frames),
+    ``actions`` / ``rewards`` / ``dones`` (T rows), shipped inside
+    ``tar`` / ``tar.xz`` archives.
+
+    Accepts a tar(.xz) path, a directory of ``.npy`` files, a list of
+    ``.npy`` paths, or a list of already-loaded dicts. Reference-exact
+    conversion (gen_dgrl.py:273-295): observation rows ``[:-1]`` at the
+    root with ``next.observation = observations[1:]`` (uint8 preserved);
+    ``dones -> next.done`` with ``next.terminated = next.done`` and
+    ``next.truncated`` zeros; root flags zeroed; ``rewards ->
+    next.reward``. Scalar per-step shapes (framework convention); an
+    ``episode`` id column is added.
+    """
+
+    def __init__(
+        self,
+        source,
+        *,
+        batch_size: int | None = 256,
+        sampler=None,
+        scratch_dir: str | None = None,
+    ):
+        rows = []
+        for ep_id, traj in enumerate(self._iter_trajs(source)):
+            for req in ("observations", "actions", "rewards", "dones"):
+                if req not in traj:
+                    raise ValueError(f"trajectory {ep_id}: missing key {req!r}")
+            obs = np.asarray(traj["observations"], np.uint8)
+            act = np.asarray(traj["actions"])
+            rew = np.asarray(traj["rewards"], np.float32)
+            done = np.asarray(traj["dones"], bool)
+            T = obs.shape[0] - 1  # observations carry the final successor
+            for name, arr in (("actions", act), ("rewards", rew), ("dones", done)):
+                if arr.shape[0] != T:
+                    raise RuntimeError(
+                        f"trajectory {ep_id}: key {name} has {arr.shape[0]} "
+                        f"rows, expected {T} (observations has {T + 1})"
+                    )
+            td = ArrayDict(
+                episode=np.full((T,), ep_id, np.int32),
+                observation=obs[:-1],
+                action=act,
+                done=np.zeros(T, bool),
+                terminated=np.zeros(T, bool),
+                truncated=np.zeros(T, bool),
+            )
+            nxt = ArrayDict(
+                observation=obs[1:],
+                reward=rew.reshape(T),
+                done=done.reshape(T),
+                terminated=done.reshape(T).copy(),
+                truncated=np.zeros(T, bool),
+            )
+            rows.append(td.set("next", nxt))
+        if not rows:
+            raise ValueError("GenDGRLDataset: no trajectories found")
+
+        flat = _concat_rows(rows, "trajectory")
+        self.n_episodes = len(rows)
+        self.n_steps = int(flat["episode"].shape[0])
+        self.buffer, self.state = _sealed_buffer(
+            flat, self.n_steps, sampler=sampler, batch_size=batch_size,
+            scratch_dir=scratch_dir,
+        )
+
+    @staticmethod
+    def _iter_trajs(source):
+        import tarfile
+
+        if isinstance(source, (str, Path)):
+            s = str(source)
+            if s.endswith((".tar", ".tar.xz", ".txz")):
+                mode = "r:xz" if s.endswith(("xz",)) else "r"
+                with tarfile.open(s, mode) as tar:
+                    # name-sorted: episode ids must not depend on packaging
+                    for member in sorted(tar.getmembers(), key=lambda m: m.name):
+                        if not member.isfile() or not member.name.endswith(".npy"):
+                            continue
+                        buf = tar.extractfile(member)
+                        yield np.load(buf, allow_pickle=True).tolist()
+                return
+            if os.path.isdir(s):
+                for name in sorted(os.listdir(s)):
+                    if name.endswith(".npy"):
+                        yield np.load(
+                            os.path.join(s, name), allow_pickle=True
+                        ).tolist()
+                return
+            yield np.load(s, allow_pickle=True).tolist()
+            return
+        for item in source:
+            if isinstance(item, dict):
+                yield item
+            else:
+                yield np.load(str(item), allow_pickle=True).tolist()

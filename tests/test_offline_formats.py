@@ -765,3 +765,75 @@ class TestOpenML:
 
         with pytest.raises(ValueError, match="rows"):
             OpenMLDataset(np.zeros((4, 2)), np.zeros(5))
+
+
+def write_gen_dgrl_fixture(tmp_path, trajs=((5, True), (3, False)), seed=0,
+                           as_tar=False):
+    """Pickled-dict .npy trajectories (observations T+1 uint8,
+    actions/rewards/dones T), optionally inside a tar archive."""
+    import tarfile
+
+    rng = np.random.default_rng(seed)
+    paths, raws = [], []
+    for n, (T, ends) in enumerate(trajs):
+        done = np.zeros(T, bool)
+        done[-1] = ends
+        d = {
+            "observations": rng.integers(0, 255, size=(T + 1, 6, 6, 3)).astype(np.uint8),
+            "actions": rng.integers(0, 15, size=(T,)).astype(np.int64),
+            "rewards": rng.normal(size=(T,)).astype(np.float32),
+            "dones": done,
+        }
+        p = tmp_path / f"traj_{n}.npy"
+        np.save(p, d, allow_pickle=True)
+        paths.append(p)
+        raws.append(d)
+    if as_tar:
+        tarp = tmp_path / "ds.tar"
+        with tarfile.open(tarp, "w") as tar:
+            for p in paths:
+                tar.add(p, arcname=p.name)
+        return tarp, raws
+    return paths, raws
+
+
+class TestGenDGRL:
+    def test_npy_list_conversion(self, tmp_path):
+        from rl_tpu.data import GenDGRLDataset
+
+        paths, raws = write_gen_dgrl_fixture(tmp_path)
+        ds = GenDGRLDataset(paths, scratch_dir=str(tmp_path / "mm"))
+        assert ds.n_episodes == 2 and ds.n_steps == 8
+        got = jax.tree.map(
+            np.asarray, ds.buffer.storage.get(ds.state["storage"], jnp.arange(8))
+        )
+        # reference: root obs = [:-1], next obs = [1:], uint8 preserved
+        np.testing.assert_array_equal(got["observation"][:5], raws[0]["observations"][:-1])
+        np.testing.assert_array_equal(
+            got["next"]["observation"][:5], raws[0]["observations"][1:]
+        )
+        assert got["observation"].dtype == np.uint8
+        np.testing.assert_allclose(got["next"]["reward"][:5], raws[0]["rewards"])
+        # dones -> next.done with terminated copied, truncated zeros
+        assert bool(got["next"]["done"][4]) and bool(got["next"]["terminated"][4])
+        assert not got["next"]["truncated"].any()
+        for k in ("done", "terminated", "truncated"):
+            assert not got[k].any()
+
+    def test_tar_archive(self, tmp_path):
+        from rl_tpu.data import GenDGRLDataset
+
+        tarp, raws = write_gen_dgrl_fixture(tmp_path, as_tar=True)
+        ds = GenDGRLDataset(tarp, scratch_dir=str(tmp_path / "mm"))
+        assert ds.n_steps == 8
+
+    def test_row_mismatch_raises(self, tmp_path):
+        from rl_tpu.data import GenDGRLDataset
+
+        with pytest.raises(RuntimeError, match="expected"):
+            GenDGRLDataset([{
+                "observations": np.zeros((6, 2, 2, 3), np.uint8),
+                "actions": np.zeros(3, np.int64),
+                "rewards": np.zeros(5, np.float32),
+                "dones": np.zeros(5, bool),
+            }])
