@@ -1,0 +1,23 @@
+"""A2C on vectorized CartPole (reference analog: sota-implementations/a2c/).
+Run: python examples/a2c_cartpole.py"""
+
+from rl_tpu.envs import CartPoleEnv, RewardSum, TransformedEnv, VmapEnv
+from rl_tpu.record import CSVLogger
+from rl_tpu.trainers.algorithms import make_a2c_trainer
+
+
+def main(total_steps: int = 100, n_envs: int = 32, frames: int = 1024):
+    env = TransformedEnv(VmapEnv(CartPoleEnv(), n_envs), RewardSum())
+    trainer = make_a2c_trainer(
+        env,
+        total_steps=total_steps,
+        frames_per_batch=frames,
+        learning_rate=7e-4,
+        logger=CSVLogger("a2c_cartpole"),
+        log_interval=5,
+    )
+    trainer.train(0)
+
+
+if __name__ == "__main__":
+    main()

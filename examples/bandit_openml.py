@@ -1,0 +1,59 @@
+"""Contextual bandits over an OpenML-style tabular dataset (reference
+analog: sota-implementations/bandits/dqn.py): a Q-network over
+(context, arm) trained on logged one-step data; greedy accuracy tracks
+how often the argmax arm equals the true label.
+Run: python examples/bandit_openml.py"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from rl_tpu.data import OpenMLDataset
+from rl_tpu.modules import MLP
+
+
+def synth_tabular(n=4096, d=16, classes=5, seed=0):
+    """Separable synthetic stand-in for the sklearn-fetched datasets
+    (network access is gated exactly like the reference)."""
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(size=(classes, d)) * 2.0
+    y = rng.integers(0, classes, n)
+    X = centers[y] + rng.normal(size=(n, d))
+    return X.astype(np.float32), y
+
+
+def main(steps: int = 300, batch_size: int = 256, log_interval: int = 50):
+    X, y = synth_tabular()
+    ds = OpenMLDataset(X, y, batch_size=batch_size)
+    n_arms = ds.max_outcome_val + 1
+    qnet = MLP(out_features=n_arms, num_cells=(128, 128))
+    params = qnet.init(jax.random.key(0), X[:1])["params"]
+    opt = optax.adam(1e-3)
+    ost = opt.init(params)
+
+    @jax.jit
+    def step(params, ost, batch):
+        def loss(p):
+            q = qnet.apply({"params": p}, batch["X"])  # [B, arms]
+            # logged bandit feedback: reward 1 for the true arm
+            chosen = jnp.take_along_axis(q, batch["y"][:, None], axis=1)[:, 0]
+            others = (q.sum(axis=1) - chosen) / (n_arms - 1)
+            return jnp.mean((chosen - 1.0) ** 2) + jnp.mean(others**2)
+
+        v, g = jax.value_and_grad(loss)(params)
+        upd, ost = opt.update(g, ost)
+        return optax.apply_updates(params, upd), ost, v
+
+    for i in range(steps):
+        batch = ds.sample(jax.random.key(i))
+        params, ost, v = step(params, ost, batch)
+        if i % log_interval == 0:
+            q = qnet.apply({"params": params}, X[:1024])
+            acc = float((jnp.argmax(q, axis=1) == jnp.asarray(y[:1024])).mean())
+            print(f"step {i}: loss {float(v):.4f} greedy-acc {acc:.3f}")
+    return params
+
+
+if __name__ == "__main__":
+    main()

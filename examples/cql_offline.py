@@ -1,0 +1,24 @@
+"""Offline CQL over a D4RL-format dataset (reference analog:
+sota-implementations/cql/): conservative Q regularization on top of SAC.
+Run: python examples/cql_offline.py"""
+
+import os
+import tempfile
+
+from rl_tpu.data import D4RLH5Dataset
+from rl_tpu.trainers.algorithms import train_cql
+
+
+def main(steps: int = 200, workdir=None):
+    workdir = workdir or tempfile.mkdtemp()
+    from iql_offline_to_online import synthesize_d4rl
+
+    h5 = synthesize_d4rl(os.path.join(workdir, "pendulum_random.hdf5"))
+    ds = D4RLH5Dataset(h5, scratch_dir=os.path.join(workdir, "mm"), batch_size=256)
+    params = train_cql(ds.buffer, ds.state, total_steps=steps, batch_size=128,
+                       log_interval=50)
+    return params
+
+
+if __name__ == "__main__":
+    main()

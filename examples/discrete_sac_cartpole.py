@@ -1,0 +1,45 @@
+"""Discrete SAC on CartPole (reference analog:
+sota-implementations/discrete_sac/): categorical policy + twin discrete
+critics, entropy-regularized off-policy updates.
+Run: python examples/discrete_sac_cartpole.py"""
+
+import jax
+
+from rl_tpu.collectors import Collector
+from rl_tpu.data.replay import DeviceStorage, ReplayBuffer
+from rl_tpu.envs import CartPoleEnv, VmapEnv
+from rl_tpu.modules import MLP, Categorical, ProbabilisticActor, TDModule
+from rl_tpu.objectives import DiscreteSACLoss
+from rl_tpu.record import CSVLogger
+from rl_tpu.trainers import OffPolicyConfig, OffPolicyProgram, Trainer
+from rl_tpu.trainers.trainer import CountFramesLog, LogScalar
+
+
+def main(total_steps: int = 100, n_envs: int = 16, frames: int = 512):
+    env = VmapEnv(CartPoleEnv(), n_envs)
+    n_actions = env.action_spec.n
+    actor = ProbabilisticActor(
+        TDModule(MLP(out_features=n_actions, num_cells=(256, 256)),
+                 ["observation"], ["logits"]),
+        Categorical,
+        dist_keys=("logits",),
+    )
+    loss = DiscreteSACLoss(
+        actor, MLP(out_features=n_actions, num_cells=(256, 256)),
+        num_actions=n_actions,
+    )
+    coll = Collector(
+        env, lambda p, td, k: actor(p["actor"], td, k), frames_per_batch=frames
+    )
+    program = OffPolicyProgram(
+        coll, loss, ReplayBuffer(DeviceStorage(100_000)),
+        OffPolicyConfig(init_random_frames=1024, batch_size=256),
+    )
+    trainer = Trainer(program, total_steps, logger=CSVLogger("discrete_sac"))
+    trainer.register_op("post_step", LogScalar(interval=5))
+    trainer.register_op("post_step", CountFramesLog(interval=5))
+    trainer.train(0)
+
+
+if __name__ == "__main__":
+    main()

@@ -1083,6 +1083,12 @@ class GenDGRLDataset(_OfflineDataset):
             act = np.asarray(traj["actions"])
             rew = np.asarray(traj["rewards"], np.float32)
             done = np.asarray(traj["dones"], bool)
+            if obs.shape[0] < 2:
+                raise ValueError(
+                    f"trajectory {ep_id}: needs >= 2 observation rows "
+                    f"(got {obs.shape[0]}) — observations carry the final "
+                    f"successor"
+                )
             T = obs.shape[0] - 1  # observations carry the final successor
             for name, arr in (("actions", act), ("rewards", rew), ("dones", done)):
                 if arr.shape[0] != T:

@@ -837,3 +837,15 @@ class TestGenDGRL:
                 "rewards": np.zeros(5, np.float32),
                 "dones": np.zeros(5, bool),
             }])
+
+
+def test_gen_dgrl_degenerate_trajectory_raises():
+    from rl_tpu.data import GenDGRLDataset
+
+    with pytest.raises(ValueError, match="needs >= 2 observation rows"):
+        GenDGRLDataset([{
+            "observations": np.zeros((1, 2, 2, 3), np.uint8),
+            "actions": np.zeros(0, np.int64),
+            "rewards": np.zeros(0, np.float32),
+            "dones": np.zeros(0, bool),
+        }])

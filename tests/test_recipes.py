@@ -150,3 +150,25 @@ def test_qmix_yaml_twin_runs(monkeypatch, tmp_path):
         config={"_target_": "program/off_policy_config",
                 "init_random_frames": 64, "batch_size": 32},
     )
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("name,kw", [
+    ("a2c_cartpole", dict(total_steps=2, n_envs=4, frames=64)),
+    ("discrete_sac_cartpole", dict(total_steps=2, n_envs=4, frames=64)),
+    ("gail_pendulum", dict(total_steps=2, n_envs=4, frames=64)),
+    ("bandit_openml", dict(steps=5, log_interval=2)),
+    ("dt_offline", dict(steps=5, log_interval=2)),
+])
+def test_round5_extra_recipes_run(name, kw, monkeypatch, tmp_path):
+    monkeypatch.chdir(tmp_path)
+    mod = __import__(name)
+    mod.main(**kw)
+
+
+@pytest.mark.slow
+def test_cql_offline_recipe_runs(monkeypatch, tmp_path):
+    monkeypatch.chdir(tmp_path)
+    import cql_offline
+
+    cql_offline.main(steps=5, workdir=str(tmp_path))
