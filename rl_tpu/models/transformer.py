@@ -67,19 +67,21 @@ class TransformerConfig:
 def _paged_attention(cfg, q, k, v, cache, active):
     """Attention over a paged KV cache + block-table writes.
 
-    Layout: ``pool_k``/``pool_v`` [n_blocks, block, Hk, D] shared across
-    slots; ``block_table`` [S, max_blocks] int32 (block 0 = reserved
-    scratch); ``len`` [S] int32 per-slot lengths. New tokens (q/k/v
+    Layout: ``pool_k``/``pool_v`` [n_blocks, Hk, block, D] (HEAD-MAJOR)
+    shared across slots; ``block_table`` [S, max_blocks] int32 (block 0 =
+    reserved scratch); ``len`` [S] int32 per-slot lengths. New tokens (q/k/v
     [S, T, ...]) land at slot-local positions ``len[s] + t``; reads run an
     online-softmax over the table's blocks (the flash-attention recurrence,
     unrolled over max_blocks), so the slot's KV is never materialized
-    contiguously — the gather per block is the only copy (a Pallas kernel
-    reading the pool in place is the chip-side upgrade path).
+    contiguously — the gather per block is the only copy. With
+    ``cfg.flash_decode`` the T=1 read instead runs the Pallas
+    ``paged_flash_decode`` kernel, whose index map reads the block table
+    directly (the pool is read in place, no gather copy at all).
     """
     pool_k, pool_v = cache["pool_k"], cache["pool_v"]
     table, lens = cache["block_table"], cache["len"]
     S, T = q.shape[0], q.shape[1]
-    n_blocks, block = pool_k.shape[0], pool_k.shape[1]
+    n_blocks, block = pool_k.shape[0], pool_k.shape[2]
     max_blocks = table.shape[1]
     # `active` is [S] (whole slots) or [S, T] (token-level — bucketed
     # prefill pads prompts up to the bucket; padded tokens must not land
@@ -102,14 +104,31 @@ def _paged_attention(cfg, q, k, v, cache, active):
     blk_global = jnp.where(active_t, blk_global, 0)
     flat_blk = blk_global.reshape(-1)
     flat_off = off.reshape(-1)
-    pool_k = pool_k.at[flat_blk, flat_off].set(
+    # pools are HEAD-MAJOR [N, Hk, block, D] (the Pallas kernel views them
+    # as [N*Hk, block, D] for free — Mosaic needs (block, D) last dims);
+    # separated advanced indices put the gather dim first: value [M, Hk, D]
+    pool_k = pool_k.at[flat_blk, :, flat_off].set(
         k.reshape(S * T, *k.shape[2:]), mode="drop"
     )
-    pool_v = pool_v.at[flat_blk, flat_off].set(
+    pool_v = pool_v.at[flat_blk, :, flat_off].set(
         v.reshape(S * T, *v.shape[2:]), mode="drop"
     )
 
-    # -- online-softmax read over the slot's blocks ---------------------------
+    # -- read: Pallas paged-decode kernel or the XLA block loop ---------------
+    if cfg.flash_decode and T == 1:
+        # the block table drives the DMA; the pool is read in place
+        from ..ops.attention import paged_flash_decode
+
+        o = paged_flash_decode(
+            q,
+            pool_k,
+            pool_v,
+            table,
+            lens + 1,  # decode-after-write: positions 0..len inclusive
+            interpret=cfg.flash_interpret,
+        ).astype(cfg.dtype)
+        return o, _advance_paged_cache(cache, pool_k, pool_v, lens, active_t)
+
     if cfg.kv_heads != cfg.n_heads:
         rep = cfg.n_heads // cfg.kv_heads
     else:
@@ -120,12 +139,12 @@ def _paged_attention(cfg, q, k, v, cache, active):
     acc = jnp.zeros((S, cfg.n_heads, T, cfg.head_dim), jnp.float32)
     qf = q.astype(jnp.float32)
     for b in range(max_blocks):
-        kb = pool_k[table[:, b]].astype(jnp.float32)  # [S, block, Hk, D]
+        kb = pool_k[table[:, b]].astype(jnp.float32)  # [S, Hk, block, D]
         vb = pool_v[table[:, b]].astype(jnp.float32)
         if rep > 1:
-            kb = jnp.repeat(kb, rep, axis=2)
-            vb = jnp.repeat(vb, rep, axis=2)
-        s_blk = jnp.einsum("sthd,sjhd->shtj", qf, kb) * scale  # [S,H,T,block]
+            kb = jnp.repeat(kb, rep, axis=1)
+            vb = jnp.repeat(vb, rep, axis=1)
+        s_blk = jnp.einsum("sthd,shjd->shtj", qf, kb) * scale  # [S,H,T,block]
         kv_pos = b * block + jnp.arange(block)  # slot-local positions
         # causal: q token t (at position len+t) sees kv_pos <= len + t
         valid = kv_pos[None, None, :] <= pos[:, :, None]  # [S, T, block]
@@ -137,18 +156,23 @@ def _paged_attention(cfg, q, k, v, cache, active):
         p = jnp.exp(s_blk - m_new[..., None])
         p = jnp.where(valid[:, None], p, 0.0)
         l = l * corr + p.sum(axis=-1)
-        acc = acc * corr[..., None] + jnp.einsum("shtj,sjhd->shtd", p, vb)
+        acc = acc * corr[..., None] + jnp.einsum("shtj,shjd->shtd", p, vb)
         m = m_new
     o = acc / jnp.maximum(l, 1e-9)[..., None]  # [S, H, T, D]
     o = jnp.moveaxis(o, 1, 2).astype(cfg.dtype)  # [S, T, H, D]
+    return o, _advance_paged_cache(cache, pool_k, pool_v, lens, active_t)
 
+
+def _advance_paged_cache(cache, pool_k, pool_v, lens, active_t):
+    """The one statement of the cache-advance rule (shared by the kernel
+    and XLA read branches)."""
     new_cache = dict(cache)
     new_cache.update(
         pool_k=pool_k,
         pool_v=pool_v,
         len=lens + active_t.sum(axis=1, dtype=lens.dtype),
     )
-    return o, new_cache
+    return new_cache
 
 
 class _Attention(nn.Module):
@@ -397,11 +421,13 @@ class TransformerLM(nn.Module):
         cfg = self.cfg
         return [
             {
+                # HEAD-MAJOR [N, Hk, block, D]: the Pallas paged-decode
+                # kernel views the pool as [N*Hk, block, D] without a copy
                 "pool_k": jnp.zeros(
-                    (n_blocks, block_size, cfg.kv_heads, cfg.head_dim), cfg.dtype
+                    (n_blocks, cfg.kv_heads, block_size, cfg.head_dim), cfg.dtype
                 ),
                 "pool_v": jnp.zeros(
-                    (n_blocks, block_size, cfg.kv_heads, cfg.head_dim), cfg.dtype
+                    (n_blocks, cfg.kv_heads, block_size, cfg.head_dim), cfg.dtype
                 ),
                 "block_table": jnp.full((n_slots, max_blocks), -1, jnp.int32),
                 "len": jnp.zeros((n_slots,), jnp.int32),

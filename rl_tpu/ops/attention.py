@@ -45,7 +45,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
 
-__all__ = ["flash_attention", "flash_decode"]
+__all__ = ["flash_attention", "flash_decode", "paged_flash_decode"]
 
 _NEG_INF = -1e30
 
@@ -696,3 +696,144 @@ def _dense_reference(q, k, v, causal, scale):
         s = jnp.where(mask, s, _NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32)).astype(q.dtype)
+
+
+def _decode_softmax_update(q, k_blk, v_blk, valid, m_ref, l_ref, acc_ref):
+    """The shared decode-side online-softmax recurrence: score one KV
+    block, mask, and fold it into the running (m, l, acc) scratch state
+    (used by both the dense-cache and paged decode kernels)."""
+    s = jax.lax.dot_general(
+        q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    s = jnp.where(valid, s, _NEG_INF)
+    m = m_ref[:]
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+    p = jnp.exp(s - m_new[:, None])
+    corr = jnp.exp(m - m_new)
+    m_ref[:] = m_new
+    l_ref[:] = l_ref[:] * corr + jnp.sum(p, axis=-1)
+    acc_ref[:] = acc_ref[:] * corr[:, None] + jax.lax.dot_general(
+        p, v_blk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+
+
+def _paged_decode_kernel(table_ref, len_ref, *refs, block_k, n_heads):
+    """One grid step = one BLOCK-TABLE entry for one (slot, q-head).
+
+    The kv block fetched for grid cell (b, j) is chosen by the index map
+    from the scalar-prefetched block table — the pool is read IN PLACE,
+    no per-step gather of the slot's KV into a contiguous buffer (the
+    copy the XLA paged path pays). Trailing/unassigned entries re-point
+    at the slot's last valid block (Pallas skips the re-fetch) and
+    ``pl.when`` skips their compute.
+    """
+    q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref = refs
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+    num_j = pl.num_programs(1)
+    slot = b // n_heads
+    attend_len = len_ref[slot]  # number of attendable positions
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    kv_start = j * block_k
+    assigned = table_ref[slot, j] > 0  # 0 = reserved scratch, -1 = unassigned
+
+    @pl.when((kv_start < attend_len) & assigned)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)  # [8, D]
+        k_blk = k_ref[0].astype(jnp.float32)  # [block_k, D]
+        v_blk = v_ref[0].astype(jnp.float32)
+        kv_pos = kv_start + jax.lax.iota(jnp.int32, block_k)
+        valid = kv_pos[None, :] < attend_len
+        _decode_softmax_update(q, k_blk, v_blk, valid, m_ref, l_ref, acc_ref)
+
+    @pl.when(j == num_j - 1)
+    def _finish():
+        l = jnp.where(l_ref[:] == 0.0, 1.0, l_ref[:])
+        o_ref[0] = (acc_ref[:] / l[:, None]).astype(o_ref.dtype)
+
+
+def paged_flash_decode(
+    q: jax.Array,
+    pool_k: jax.Array,
+    pool_v: jax.Array,
+    block_table: jax.Array,
+    attend_lens: jax.Array,
+    scale: float | None = None,
+    interpret: bool = False,
+) -> jax.Array:
+    """Single-token decode attention over a PAGED KV pool (the vLLM
+    paged-attention read, Pallas-native — the chip-side upgrade of
+    ``rl_tpu.models.transformer._paged_attention``'s XLA gather path).
+
+    Args:
+        q: [S, 1, H, D] — one query per sequence slot.
+        pool_k, pool_v: [N, Hk, block, D] HEAD-MAJOR shared block pools
+            (``Hk`` may divide H — GQA); viewed as [N*Hk, block, D] so
+            the Mosaic block dims are (block, D). Block 0 is reserved
+            scratch (never read).
+        block_table: [S, max_blocks] int32 — per-slot pool indices;
+            -1 = unassigned.
+        attend_lens: [S] int32 — attendable positions per slot (for the
+            decode-after-write step this is ``len + 1``).
+
+    Returns [S, 1, H, D]. The index map reads the scalar-prefetched
+    block table, so each (slot, head, j) grid cell DMAs exactly its
+    block's single KV head from the pool — no contiguous per-slot copy.
+    """
+    from jax.experimental.pallas import tpu as pltpu
+
+    S, Tq, H, D = q.shape
+    if Tq != 1:
+        raise ValueError(f"paged_flash_decode is the T=1 step; got T={Tq}")
+    N, Hk, block_k, _ = pool_k.shape
+    if H % Hk:
+        raise ValueError(f"q heads ({H}) must be a multiple of kv heads ({Hk})")
+    group = H // Hk
+    max_blocks = block_table.shape[1]
+    scale = scale if scale is not None else D**-0.5
+
+    q_b = jnp.moveaxis(q * scale, 2, 1).reshape(S * H, 1, D)
+    q_b = jnp.pad(q_b, ((0, 0), (0, 7), (0, 0)))
+    table = jnp.asarray(block_table, jnp.int32)
+    lens = jnp.asarray(attend_lens, jnp.int32).reshape(S)
+    # head-major pool -> [N*Hk, block, D] (a reshape, not a copy)
+    k_flat = pool_k.reshape(N * Hk, block_k, D)
+    v_flat = pool_v.reshape(N * Hk, block_k, D)
+
+    def kv_index(b, j, table_ref, len_ref):
+        slot = b // H
+        kvh = (b % H) // group
+        # clamp trailing entries at the slot's last data-bearing block so
+        # Pallas re-points (and skips) instead of fetching garbage
+        last = jnp.maximum(len_ref[slot] - 1, 0) // block_k
+        jj = jnp.minimum(j, last)
+        blk = jnp.maximum(table_ref[slot, jj], 0)
+        return (blk * Hk + kvh, 0, 0)
+
+    kernel = functools.partial(
+        _paged_decode_kernel, block_k=block_k, n_heads=H
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(S * H, max_blocks),
+        in_specs=[
+            pl.BlockSpec((1, 8, D), lambda b, j, table_ref, len_ref: (b, 0, 0)),
+            pl.BlockSpec((1, block_k, D), kv_index),
+            pl.BlockSpec((1, block_k, D), kv_index),
+        ],
+        out_specs=pl.BlockSpec((1, 8, D), lambda b, j, table_ref, len_ref: (b, 0, 0)),
+        scratch_shapes=[_scratch((8,)), _scratch((8,)), _scratch((8, D))],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((S * H, 8, D), q.dtype),
+        interpret=interpret,
+    )(table, lens, q_b, k_flat, v_flat)
+    return jnp.moveaxis(out[:, :1].reshape(S, H, 1, D), 1, 2)
