@@ -39,6 +39,9 @@ class LLMCollector:
         ref_params: Any = None,
         weight_scheme: Any = None,
         reward_transform: Callable | None = None,
+        continuous_batching: bool = False,
+        engine_slots: int | None = None,
+        engine_block_size: int = 16,
     ):
         self.env = env
         self.model = model
@@ -48,6 +51,14 @@ class LLMCollector:
         self.eos_id = eos_id
         self.ref_params = ref_params
         self.weight_scheme = weight_scheme
+        # continuous batching: responses come from the paged-KV engine
+        # (slot admission mid-batch) instead of one fixed-batch generate —
+        # rows that hit eos early stop paying decode steps (the vLLM-side
+        # behavior the reference gets from its AsyncVLLM backend)
+        self.continuous_batching = continuous_batching
+        self.engine_slots = engine_slots
+        self.engine_block_size = engine_block_size
+        self._engine = None
         # (rewards, batch_arrays) -> rewards, applied BEFORE group advantages
         # (KLRewardTransform / PolicyVersion — reference envs/llm/transforms/)
         self.reward_transform = reward_transform
@@ -71,6 +82,66 @@ class LLMCollector:
                 lambda toks, mask: token_log_probs(model, ref_params, toks, mask)
             )
 
+    def _engine_generate(self, params, toks, pmask, key):
+        """Continuous-batching rollout shaped like ``generate``'s output:
+        the G requests stream through engine slots; early-eos rows free
+        their slot (and KV blocks) immediately."""
+        from ..models.generate import GenerateOutput
+        from ..models.serving import ContinuousBatchingEngine
+
+        G, P = toks.shape
+        if self._engine is None:
+            bucket = max(16, 1 << (P - 1).bit_length())
+            slots = self.engine_slots or min(G, 8)
+            self._engine = ContinuousBatchingEngine(
+                self.model,
+                params,
+                n_slots=slots,
+                block_size=self.engine_block_size,
+                n_blocks=slots
+                * (-(-self.model.cfg.max_seq_len // self.engine_block_size))
+                + 1,
+                prompt_buckets=(bucket,),
+                eos_id=self.eos_id,
+                temperature=self.temperature,
+            )
+        eng = self._engine
+        eng.params = params  # fresh policy weights each collect
+        # the per-call key drives sampling (key-deterministic, like the
+        # fixed-batch path): fold it into the engine's stream
+        eng._key = jax.random.fold_in(key, 0)
+        toks_np = np.asarray(toks)
+        mask_np = np.asarray(pmask) > 0
+        rids = [
+            eng.submit(toks_np[g][mask_np[g]], self.max_new_tokens)
+            for g in range(G)
+        ]
+        done = eng.run()
+        N = self.max_new_tokens
+        resp = np.zeros((G, N), np.int32)
+        rlp = np.zeros((G, N), np.float32)
+        rmask = np.zeros((G, N), bool)
+        for g, rid in enumerate(rids):
+            f = done[rid]
+            n = len(f.tokens)
+            resp[g, :n] = f.tokens
+            rlp[g, :n] = f.log_probs
+            # every produced token INCLUDING a terminal eos is real —
+            # generate()'s response_mask convention (valid = was_alive;
+            # the policy must see gradient on the stop decision)
+            rmask[g, :n] = True
+        full = jnp.concatenate([toks, jnp.asarray(resp)], axis=1)
+        full_mask = jnp.concatenate(
+            [jnp.asarray(mask_np), jnp.asarray(rmask)], axis=1
+        )
+        return GenerateOutput(
+            tokens=full,
+            response_tokens=jnp.asarray(resp),
+            response_mask=jnp.asarray(rmask),
+            response_log_probs=jnp.asarray(rlp),
+            full_mask=full_mask,
+        )
+
     def collect(self, params: Any, key: jax.Array) -> ArrayDict:
         """One GRPO batch: ArrayDict with tokens/attention_mask/
         assistant_mask/sample_log_prob/advantage/reward (+ref_log_prob)."""
@@ -79,7 +150,10 @@ class LLMCollector:
         state, group_ids = self.env.sample_batch(self.num_prompts)
         toks = jnp.asarray(state["tokens"])
         pmask = jnp.asarray(state["attention_mask"], jnp.float32)
-        out = self._gen(params, toks, pmask, key)
+        if self.continuous_batching:
+            out = self._engine_generate(params, toks, pmask, key)
+        else:
+            out = self._gen(params, toks, pmask, key)
 
         resp = np.asarray(out.response_tokens)
         rmask = np.asarray(out.response_mask)

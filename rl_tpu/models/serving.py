@@ -53,6 +53,7 @@ class FinishedRequest:
     rid: int
     prompt: np.ndarray
     tokens: np.ndarray  # [N] generated ids (eos included if hit)
+    log_probs: np.ndarray  # [N] behavior log-probs of the sampled tokens
     finished_reason: str  # "eos" | "length"
 
 
@@ -112,6 +113,7 @@ class ContinuousBatchingEngine:
         self.slot_rid = np.full(n_slots, -1, np.int64)  # -1 = free slot
         self.slot_budget = np.zeros(n_slots, np.int64)  # max_new remaining
         self.slot_tokens: list[list[int]] = [[] for _ in range(n_slots)]
+        self.slot_lps: list[list[float]] = [[] for _ in range(n_slots)]
         self.slot_prompt: dict[int, np.ndarray] = {}
 
         self.queue: list[Request] = []
@@ -159,25 +161,28 @@ class ContinuousBatchingEngine:
         last_logits = jnp.take_along_axis(
             logits, last[:, None, None], axis=1
         )[:, 0]
-        tok = self._sample(last_logits, key)
+        tok, lp = self._sample(last_logits, key)
         new_pools = [(c["pool_k"], c["pool_v"]) for c in cache]
-        return tok, new_pools
+        return tok, lp, new_pools
 
     def _decode_fn(self, params, cache, last_tokens, active, key):
         cache = [dict(c, active=active) for c in cache]
         logits, cache = self.model.apply(
             {"params": params}, last_tokens[:, None], cache=cache
         )
-        tok = self._sample(logits[:, 0], key)
-        return tok, cache
+        tok, lp = self._sample(logits[:, 0], key)
+        return tok, lp, cache
 
     def _sample(self, logits, key):
-        if self.greedy:
-            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        """(token, behavior log-prob of that token) per row."""
         t = jnp.maximum(jnp.asarray(self.temperature, jnp.float32), 1e-6)
-        return jax.random.categorical(key, logits.astype(jnp.float32) / t).astype(
-            jnp.int32
-        )
+        lps = jax.nn.log_softmax(logits.astype(jnp.float32) / t, axis=-1)
+        if self.greedy:
+            tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        else:
+            tok = jax.random.categorical(key, lps).astype(jnp.int32)
+        lp = jnp.take_along_axis(lps, tok[:, None], axis=-1)[:, 0]
+        return tok, lp
 
     # -- allocator -------------------------------------------------------------
 
@@ -205,6 +210,7 @@ class ContinuousBatchingEngine:
                 rid=rid,
                 prompt=self.slot_prompt.pop(rid),
                 tokens=np.asarray(self.slot_tokens[slot], np.int32),
+                log_probs=np.asarray(self.slot_lps[slot], np.float32),
                 finished_reason=reason,
             )
         )
@@ -214,6 +220,7 @@ class ContinuousBatchingEngine:
         self.lens[slot] = 0
         self.slot_rid[slot] = -1
         self.slot_tokens[slot] = []
+        self.slot_lps[slot] = []
 
     # -- public surface --------------------------------------------------------
 
@@ -263,6 +270,7 @@ class ContinuousBatchingEngine:
             self.slot_budget[s] = req.max_new_tokens
             self.slot_prompt[req.rid] = req.prompt
             self.slot_tokens[s] = []
+            self.slot_lps[s] = []
         # compact rows: only the admitted slots ride the prefill forward
         A = len(batch)
         slots = [s for s, _ in batch]
@@ -271,7 +279,7 @@ class ContinuousBatchingEngine:
         if fn is None:
             fn = self._prefills[(A, bucket)] = jax.jit(self._prefill_fn)
         pools = [(layer["pool_k"], layer["pool_v"]) for layer in self.cache]
-        tok, new_pools = fn(
+        tok, lp, new_pools = fn(
             self.params,
             pools,
             jnp.asarray(self.table[slots]),
@@ -282,10 +290,10 @@ class ContinuousBatchingEngine:
         for layer, (pk, pv) in zip(self.cache, new_pools):
             layer["pool_k"], layer["pool_v"] = pk, pv
         self.prefill_token_slots += A * bucket
-        tok_host = np.asarray(tok)
+        tok_host, lp_host = np.asarray(tok), np.asarray(lp)
         for i, (s, req) in enumerate(batch):
             self.lens[s] = len(req.prompt)
-            self._push_token(s, int(tok_host[i]))
+            self._push_token(s, int(tok_host[i]), float(lp_host[i]))
 
     def _ensure_blocks_for_new(self, slot: int, req: Request) -> bool:
         need = self._blocks_needed(len(req.prompt) + 1)  # prompt + 1st token
@@ -295,8 +303,9 @@ class ContinuousBatchingEngine:
             self.table[slot, j] = self.free_blocks.pop()
         return True
 
-    def _push_token(self, slot: int, tok: int):
+    def _push_token(self, slot: int, tok: int, lp: float = 0.0):
         self.slot_tokens[slot].append(tok)
+        self.slot_lps[slot].append(lp)
         self.slot_budget[slot] -= 1
         if self.eos_id is not None and tok == self.eos_id:
             self._free_slot(slot, "eos")
@@ -344,19 +353,24 @@ class ContinuousBatchingEngine:
         )
         self._sync_cache_tables(active=active_np)
         self._key, k = jax.random.split(self._key)
-        tok, self.cache = self._decode(
+        tok, lp, self.cache = self._decode(
             self.params, self.cache, jnp.asarray(last), jnp.asarray(active_np), k
         )
         self.decode_steps += 1
-        tok_host = np.asarray(tok)
+        tok_host, lp_host = np.asarray(tok), np.asarray(lp)
         for s in np.nonzero(active_np)[0]:
             self.lens[s] += 1
-            self._push_token(int(s), int(tok_host[s]))
+            self._push_token(int(s), int(tok_host[s]), float(lp_host[s]))
         return bool(self.queue) or bool((self.slot_rid >= 0).any())
 
     def run(self) -> dict[int, FinishedRequest]:
-        """Drain the queue; returns {rid: FinishedRequest}."""
+        """Drain the queue; returns THIS run's {rid: FinishedRequest}.
+
+        The internal finished list is cleared — a long-lived engine
+        (LLMCollector reuses one across collects) must not accumulate
+        every request it ever served."""
         while self.step():
             pass
-        # flush: step() returns False when idle, but completions recorded
-        return {f.rid: f for f in self.finished}
+        out = {f.rid: f for f in self.finished}
+        self.finished.clear()
+        return out

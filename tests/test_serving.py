@@ -324,3 +324,56 @@ class TestPagedDecodeKernel:
             l2, c2 = m_krn.apply({"params": params}, nxt[:, t : t + 1], cache=c2)
             err = float(jnp.abs(l1 - l2).max())
             assert err < 1e-3, (t, err)
+
+
+class TestLLMCollectorContinuousBatching:
+    def test_grpo_batch_through_the_engine(self):
+        """LLMCollector(continuous_batching=True) yields the same batch
+        SCHEMA as the fixed-batch path, with behavior log-probs from the
+        engine, early-eos rows masked, and the GRPO loss consuming it."""
+        from rl_tpu.collectors.llm import LLMCollector
+        from rl_tpu.envs.llm import DatasetChatEnv
+        from rl_tpu.objectives.llm.grpo import GRPOLoss
+        from rl_tpu.models import token_log_probs
+
+        m, params = small_model()
+
+        class TinyTok:
+            eos_token_id = 1
+
+            def encode(self, s):
+                return [ord(c) % 90 + 2 for c in s][:12]
+
+        from rl_tpu.data.llm import History
+
+        prompts = History.from_chats([
+            [{"role": "user", "content": p}]
+            for p in ("what is 2+2?", "name a color", "count to three")
+        ])
+        env = DatasetChatEnv(
+            prompts,
+            TinyTok(),
+            reward_fn=lambda h, toks: 0.5,
+            group_repeats=2,
+            max_prompt_len=16,
+        )
+        coll = LLMCollector(
+            env, m, num_prompts=2, max_new_tokens=8, eos_id=1,
+            continuous_batching=True, engine_slots=2,
+        )
+        batch = coll.collect(params, jax.random.key(0))
+        G = batch["tokens"].shape[0]
+        T = batch["tokens"].shape[1]
+        for k in ("tokens", "attention_mask", "assistant_mask", "sample_log_prob"):
+            assert batch[k].shape[:2] == (G, T), k
+        assert batch["advantage"].shape == (G,)
+        # behavior log-probs: where assistant_mask is on, they must be
+        # real log-probs (<= 0, not the 0 padding)
+        lp = np.asarray(batch["sample_log_prob"])
+        am = np.asarray(batch["assistant_mask"])
+        assert (lp[am] <= 0.0).all()
+        assert (lp[am] < -1e-6).any()
+
+        loss = GRPOLoss(lambda p, b: token_log_probs(m, p, b["tokens"]))
+        v, metrics = loss(params, batch)
+        assert np.isfinite(float(v))
