@@ -172,3 +172,11 @@ def test_cql_offline_recipe_runs(monkeypatch, tmp_path):
     import cql_offline
 
     cql_offline.main(steps=5, workdir=str(tmp_path))
+
+
+@pytest.mark.slow
+def test_a2c_yaml_twin_runs(monkeypatch, tmp_path):
+    _run_yaml_twin(
+        "a2c_cartpole.yaml", monkeypatch, tmp_path,
+        total_steps=2, frames_per_batch=64,
+    )
