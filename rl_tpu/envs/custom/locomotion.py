@@ -150,6 +150,11 @@ _K_P = 2.0e4  # contact spring
 _K_D = 300.0  # contact damper
 _MU = 1.0  # friction coefficient
 _V_REF = 0.1  # friction smoothing velocity
+_F_MAX = 5.0e4  # contact-force cap (deep-tunneling impulses stay bounded)
+_QVEL_MAX = 100.0  # hard generalized-velocity limit (explicit-integration
+# safety net: an aggressive learned policy can otherwise pump energy
+# through the stiff contacts faster than dt=0.002 can dissipate it,
+# spiraling to inf/NaN — observed ~100 PPO steps into training)
 
 
 def _kinetic(model: PlanarModel, q, qdot):
@@ -194,7 +199,7 @@ def planar_dynamics_step(model: PlanarModel, q, qdot, tau_joints, dt):
     pen = jnp.maximum(-pts[:, 1], 0.0)  # penetration depth
     active = pen > 0.0
     fz = jnp.where(active, _K_P * pen - _K_D * vels[:, 1], 0.0)
-    fz = jnp.maximum(fz, 0.0)
+    fz = jnp.clip(fz, 0.0, _F_MAX)
     fx = -_MU * fz * jnp.tanh(vels[:, 0] / _V_REF)
     F = jnp.stack([fx, fz], axis=-1)  # [C, 2]
     _, vjp = jax.vjp(cpts, q)
@@ -221,7 +226,7 @@ def planar_dynamics_step(model: PlanarModel, q, qdot, tau_joints, dt):
 
     rhs = tau + damping + q_contact + dT_dq - dV_dq - Mdot @ qdot
     qddot = jnp.linalg.solve(M + 1e-9 * jnp.eye(nq), rhs)
-    qdot_next = qdot + dt * qddot
+    qdot_next = jnp.clip(qdot + dt * qddot, -_QVEL_MAX, _QVEL_MAX)
     q_next = q + dt * qdot_next
     return q_next, qdot_next
 

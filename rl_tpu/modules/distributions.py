@@ -130,14 +130,24 @@ class TanhNormal(Distribution):
     [low, high] (reference TanhNormal, continuous.py:336, using the safe
     tanh/atanh pair for boundary stability).
 
-    ``upscale`` matches the reference's pre-tanh scaling of loc.
+    ``upscale`` is the reference's pre-tanh loc bounding
+    (continuous.py:118): ``loc <- upscale * tanh(loc / upscale)``. It is
+    load-bearing for training stability — without it a confident policy's
+    raw loc grows without bound, pre-tanh samples saturate, and PPO
+    ratios become exp(inf - inf) = NaN (observed ~100 PPO steps into
+    Hopper training).
     """
 
     loc: Any
     scale: Any
     low: Any = -1.0
     high: Any = 1.0
+    upscale: Any = 5.0
     event_ndim: ClassVar[int] = 1
+
+    @property
+    def _bounded_loc(self) -> jax.Array:
+        return self.upscale * jnp.tanh(self.loc / self.upscale)
 
     def _squash(self, pre: jax.Array) -> jax.Array:
         t = safetanh(pre)
@@ -149,7 +159,8 @@ class TanhNormal(Distribution):
 
     def sample(self, key, sample_shape=()):
         shape = sample_shape + jnp.shape(self.loc)
-        pre = self.loc + self.scale * jax.random.normal(key, shape, jnp.asarray(self.loc).dtype)
+        loc = self._bounded_loc
+        pre = loc + self.scale * jax.random.normal(key, shape, jnp.asarray(loc).dtype)
         return self._squash(pre)
 
     def sample_with_log_prob(self, key, sample_shape=()):
@@ -158,7 +169,7 @@ class TanhNormal(Distribution):
 
     def log_prob(self, x):
         pre = self._unsquash(x)
-        z = (pre - self.loc) / self.scale
+        z = (pre - self._bounded_loc) / self.scale
         base = -0.5 * (z * z + _LOG_2PI) - jnp.log(self.scale)
         # |dx/dpre| = (1 - tanh^2) * (high-low)/2
         t = safetanh(pre)
@@ -171,12 +182,12 @@ class TanhNormal(Distribution):
 
     @property
     def mode(self):
-        return self._squash(self.loc)
+        return self._squash(self._bounded_loc)
 
     @property
     def mean(self):
         # approximate (squashing is nonlinear); reference uses the same proxy
-        return self._squash(self.loc)
+        return self._squash(self._bounded_loc)
 
 
 @_register

@@ -172,3 +172,28 @@ class TestTransformCompat:
         np.testing.assert_allclose(
             np.asarray(f(locs)), -0.5 * np.log(2 * np.pi) * np.ones(4), rtol=1e-6
         )
+
+
+class TestTanhNormalUpscale:
+    """Round-5 regression: the reference's pre-tanh loc bounding
+    (continuous.py:118) is load-bearing — without it PPO on Hopper NaN'd
+    at ~100 train steps (ratio exp(inf - inf))."""
+
+    def test_extreme_loc_keeps_log_prob_finite(self):
+        from rl_tpu.modules import TanhNormal
+
+        d = TanhNormal(loc=jnp.asarray([1e6, -1e6]), scale=jnp.asarray([1e-4, 1e-4]))
+        x, lp = d.sample_with_log_prob(jax.random.key(0))
+        assert np.isfinite(np.asarray(lp)).all()
+        # log-prob of the OTHER extreme's sample also finite (the ratio
+        # numerator/denominator in PPO)
+        lp2 = d.log_prob(-x)
+        assert np.isfinite(np.asarray(lp2)).all()
+
+    def test_loc_bounded_by_upscale(self):
+        from rl_tpu.modules import TanhNormal
+
+        d = TanhNormal(loc=jnp.asarray([50.0]), scale=jnp.asarray([0.1]))
+        assert float(jnp.abs(d._bounded_loc).max()) <= 5.0 + 1e-6
+        # mode still lands at the positive edge of the squashed range
+        assert float(d.mode[0]) > 0.99

@@ -189,3 +189,25 @@ class TestPPOTrainSurface:
         for _ in range(2):
             ts, m = step(ts)
         assert np.isfinite(float(m["loss"]))
+
+
+class TestAggressivePolicyStability:
+    @pytest.mark.slow
+    def test_bang_bang_policy_stays_finite(self):
+        """Regression (round 5): an aggressive policy pumping energy
+        through the stiff contacts NaN'd the dynamics ~100 PPO steps into
+        training; the velocity/contact-force clamps must hold the state
+        finite under sustained max-torque bang-bang control."""
+        env = VmapEnv(HopperEnv(), 8)
+        state, td = env.reset(KEY)
+
+        @jax.jit
+        def step(state, td, k):
+            a = jnp.sign(jax.random.normal(k, (8, 3)))
+            s2, out, carry = env.step_and_reset(state, td.set("action", a))
+            return s2, carry, out
+
+        for i in range(300):
+            state, td, out = step(state, td, jax.random.key(i))
+        assert np.isfinite(np.asarray(out["next"]["observation"])).all()
+        assert np.isfinite(np.asarray(out["next"]["reward"])).all()
