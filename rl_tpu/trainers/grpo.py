@@ -75,6 +75,7 @@ class GRPOTrainer:
         temperature: float = 1.0,
         seed: int = 0,
         logger: Any = None,
+        continuous_batching: bool = False,
     ):
         self.tokenizer = tokenizer or SimpleTokenizer(dataset.corpus())
         self.dataset = dataset
@@ -154,6 +155,7 @@ class GRPOTrainer:
             ref_params=self.ref_params,
             weight_scheme=self.scheme,
             reward_transform=reward_transform,
+            continuous_batching=continuous_batching,
         )
         # MoE configs score through the aux-returning path so the Switch
         # load-balancing term trains by default (routing collapses without it)

@@ -197,3 +197,16 @@ class TestGRPOTrainer:
         t.train(60)
         h = t.history["reward"]
         assert np.mean(h[-10:]) > np.mean(h[:10]) + 0.1, h
+
+
+class TestGRPOTrainerContinuousBatching:
+    @pytest.mark.slow
+    def test_step_through_the_serving_engine(self):
+        """GRPOTrainer(continuous_batching=True): the rollout rides the
+        paged-KV engine with slot admission; training metrics stay
+        finite and the policy version advances."""
+        t = _tiny_trainer(continuous_batching=True)
+        m1 = t.step()
+        m2 = t.step()
+        assert np.isfinite(m1["loss"]) and np.isfinite(m2["reward"])
+        assert t.policy_version.version == 2

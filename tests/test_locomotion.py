@@ -193,17 +193,18 @@ class TestPPOTrainSurface:
 
 class TestAggressivePolicyStability:
     @pytest.mark.slow
-    def test_bang_bang_policy_stays_finite(self):
+    @pytest.mark.parametrize("cls,act_dim", [(HopperEnv, 3), (Walker2dEnv, 6)])
+    def test_bang_bang_policy_stays_finite(self, cls, act_dim):
         """Regression (round 5): an aggressive policy pumping energy
         through the stiff contacts NaN'd the dynamics ~100 PPO steps into
         training; the velocity/contact-force clamps must hold the state
         finite under sustained max-torque bang-bang control."""
-        env = VmapEnv(HopperEnv(), 8)
+        env = VmapEnv(cls(), 8)
         state, td = env.reset(KEY)
 
         @jax.jit
         def step(state, td, k):
-            a = jnp.sign(jax.random.normal(k, (8, 3)))
+            a = jnp.sign(jax.random.normal(k, (8, act_dim)))
             s2, out, carry = env.step_and_reset(state, td.set("action", a))
             return s2, carry, out
 
