@@ -952,6 +952,7 @@ def _run_sub_bench(name: str, budget: float, extra_env: dict | None = None) -> d
     # the child manages only its own slice; disable its outer watchdog so a
     # timeout is OUR kill (clean error field), not a nested 0.0 line
     env["BENCH_TIMEOUT"] = str(max(5.0, budget * 4))
+    t0 = time.monotonic()
     try:
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__)],
@@ -969,6 +970,8 @@ def _run_sub_bench(name: str, budget: float, extra_env: dict | None = None) -> d
         return {"error": f"sub-bench '{name}' exceeded its {budget:.0f}s slice"}
     got = _parse_last_json(proc.stdout)
     if got is not None:
+        # wall time incl. process start + compile: the slice-budget evidence
+        got["wall_s"] = round(time.monotonic() - t0, 1)
         return got
     return {
         "error": f"sub-bench '{name}' emitted no JSON (rc={proc.returncode}): "
