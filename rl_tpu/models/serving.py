@@ -38,7 +38,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["ContinuousBatchingEngine", "Request", "FinishedRequest"]
+__all__ = ["ContinuousBatchingEngine", "LoadBalancer", "Request", "FinishedRequest"]
 
 
 @dataclasses.dataclass
@@ -373,4 +373,101 @@ class ContinuousBatchingEngine:
             pass
         out = {f.rid: f for f in self.finished}
         self.finished.clear()
+        return out
+
+
+class LoadBalancer:
+    """Route requests across engine replicas with a strategy hierarchy
+    (reference torchrl/modules/llm/backends/vllm/vllm_async.py:1559
+    ``LoadBalancer`` — there over Ray-actor AsyncVLLM replicas; here over
+    :class:`ContinuousBatchingEngine` instances, e.g. one per host
+    process or per model copy).
+
+    Strategies, tried in order until one yields a pick:
+
+    - ``"prefix-aware"``: hash the prompt's first ``prefix_length`` tokens
+      to a replica (KV/prefix cache locality) — skipped when the chosen
+      replica is overloaded (> ``overload_threshold`` x mean load, with
+      the mean FLOORED AT 1.0 so single stray requests at near-idle
+      traffic don't defeat stickiness) or no prompt is given;
+    - ``"requests"``: fewest pending requests (queue + in-flight);
+    - ``"kv-cache"``: lowest KV block-pool utilization;
+    - ``"round-robin"``: next index.
+
+    ``submit`` forwards to the chosen replica and returns
+    ``(replica_index, rid)``; ``run_all`` drains every replica.
+    """
+
+    STRATEGIES = ("prefix-aware", "requests", "kv-cache", "round-robin")
+
+    def __init__(
+        self,
+        engines,
+        strategy="prefix-aware",
+        prefix_length: int = 8,
+        overload_threshold: float = 1.5,
+    ):
+        self.engines = list(engines)
+        if not self.engines:
+            raise ValueError("LoadBalancer needs at least one engine")
+        strategies = [strategy] if isinstance(strategy, str) else list(strategy)
+        for st in strategies:
+            if st not in self.STRATEGIES:
+                raise ValueError(f"unknown strategy {st!r}; want one of {self.STRATEGIES}")
+        # round-robin is the unconditional terminal fallback
+        if "round-robin" not in strategies:
+            strategies.append("round-robin")
+        self.strategies = strategies
+        self.prefix_length = prefix_length
+        self.overload_threshold = overload_threshold
+        self._rr = 0
+
+    # -- per-replica load signals ---------------------------------------------
+
+    def _pending(self, eng) -> int:
+        return len(eng.queue) + int((eng.slot_rid >= 0).sum())
+
+    def _kv_utilization(self, eng) -> float:
+        total = len(eng.free_blocks) + sum(
+            int((row >= 0).sum()) for row in eng.table
+        )
+        used = total - len(eng.free_blocks)
+        return used / max(total, 1)
+
+    # -- selection -------------------------------------------------------------
+
+    def select_engine(self, prompt=None) -> int:
+        loads = [self._pending(e) for e in self.engines]
+        mean_load = sum(loads) / len(loads)
+        for st in self.strategies:
+            if st == "prefix-aware":
+                if prompt is None:
+                    continue
+                prefix = tuple(np.asarray(prompt).reshape(-1)[: self.prefix_length].tolist())
+                idx = hash(prefix) % len(self.engines)
+                if loads[idx] <= self.overload_threshold * max(mean_load, 1.0):
+                    return idx
+                continue  # overloaded: fall through to the next strategy
+            if st == "requests":
+                return int(np.argmin(loads))
+            if st == "kv-cache":
+                return int(np.argmin([self._kv_utilization(e) for e in self.engines]))
+            if st == "round-robin":
+                idx = self._rr % len(self.engines)
+                self._rr += 1
+                return idx
+        raise AssertionError("unreachable: round-robin always selects")
+
+    # -- request surface --------------------------------------------------------
+
+    def submit(self, prompt, max_new_tokens: int) -> tuple[int, int]:
+        idx = self.select_engine(prompt)
+        return idx, self.engines[idx].submit(prompt, max_new_tokens)
+
+    def run_all(self) -> dict[tuple[int, int], FinishedRequest]:
+        """Drain every replica; keys are (replica_index, rid)."""
+        out = {}
+        for i, eng in enumerate(self.engines):
+            for rid, f in eng.run().items():
+                out[(i, rid)] = f
         return out

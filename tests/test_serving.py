@@ -377,3 +377,71 @@ class TestLLMCollectorContinuousBatching:
         loss = GRPOLoss(lambda p, b: token_log_probs(m, p, b["tokens"]))
         v, metrics = loss(params, batch)
         assert np.isfinite(float(v))
+
+
+class TestLoadBalancer:
+    def _engines(self, n=3):
+        m, params = small_model()
+        from rl_tpu.models import ContinuousBatchingEngine
+
+        return [
+            ContinuousBatchingEngine(
+                m, params, n_slots=2, block_size=8, n_blocks=33,
+                prompt_buckets=(16,), greedy=True, seed=i,
+            )
+            for i in range(n)
+        ]
+
+    def test_requests_strategy_picks_least_loaded(self):
+        from rl_tpu.models import LoadBalancer
+
+        engines = self._engines()
+        lb = LoadBalancer(engines, "requests")
+        engines[0].submit(np.arange(4), 4)
+        engines[0].submit(np.arange(4), 4)
+        engines[1].submit(np.arange(4), 4)
+        assert lb.select_engine() == 2
+
+    def test_prefix_aware_is_sticky_and_respects_overload(self):
+        from rl_tpu.models import LoadBalancer
+
+        engines = self._engines()
+        lb = LoadBalancer(engines, ["prefix-aware", "requests"])
+        p = np.arange(10)
+        first = lb.select_engine(p)
+        assert all(lb.select_engine(p) == first for _ in range(5))  # sticky
+        # overload the sticky replica far past threshold -> falls back
+        for _ in range(8):
+            engines[first].submit(np.arange(4), 2)
+        assert lb.select_engine(p) != first
+
+    def test_round_robin_cycles(self):
+        from rl_tpu.models import LoadBalancer
+
+        lb = LoadBalancer(self._engines(), "round-robin")
+        assert [lb.select_engine() for _ in range(4)] == [0, 1, 2, 0]
+
+    def test_submit_and_run_all_completes_everything(self):
+        from rl_tpu.models import LoadBalancer
+
+        engines = self._engines()
+        lb = LoadBalancer(engines, ["prefix-aware", "requests"])
+        rng = np.random.default_rng(0)
+        keys = [
+            lb.submit(rng.integers(0, 97, int(rng.integers(4, 12))),
+                      int(rng.integers(2, 6)))
+            for _ in range(9)
+        ]
+        out = lb.run_all()
+        assert set(out) == set(keys)
+        assert all(len(f.tokens) >= 1 for f in out.values())
+        # every pool fully recycled on every replica
+        assert all(len(e.free_blocks) == 32 for e in engines)
+
+    def test_validation(self):
+        from rl_tpu.models import LoadBalancer
+
+        with pytest.raises(ValueError, match="at least one"):
+            LoadBalancer([])
+        with pytest.raises(ValueError, match="unknown strategy"):
+            LoadBalancer(self._engines(1), "magic")
