@@ -453,6 +453,7 @@ def bench_serve(report: bool = True) -> dict:
             model, params, n_slots=S, block_size=16,
             n_blocks=S * (cfg.max_seq_len // 16) + 1,
             prompt_buckets=(bucket,), greedy=True,
+            decode_chunk=_T(smoke=1, cpu=4, full=8),
         )
         for p, n in reqs:
             eng.submit(p, n)

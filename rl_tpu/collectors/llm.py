@@ -42,6 +42,7 @@ class LLMCollector:
         continuous_batching: bool = False,
         engine_slots: int | None = None,
         engine_block_size: int = 16,
+        engine_decode_chunk: int = 1,
     ):
         self.env = env
         self.model = model
@@ -58,6 +59,7 @@ class LLMCollector:
         self.continuous_batching = continuous_batching
         self.engine_slots = engine_slots
         self.engine_block_size = engine_block_size
+        self.engine_decode_chunk = engine_decode_chunk
         self._engine = None
         # (rewards, batch_arrays) -> rewards, applied BEFORE group advantages
         # (KLRewardTransform / PolicyVersion — reference envs/llm/transforms/)
@@ -104,6 +106,7 @@ class LLMCollector:
                 prompt_buckets=(bucket,),
                 eos_id=self.eos_id,
                 temperature=self.temperature,
+                decode_chunk=self.engine_decode_chunk,
             )
         eng = self._engine
         eng.params = params  # fresh policy weights each collect

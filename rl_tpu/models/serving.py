@@ -93,6 +93,7 @@ class ContinuousBatchingEngine:
         temperature: float = 1.0,
         greedy: bool = False,
         seed: int = 0,
+        decode_chunk: int = 1,
     ):
         self.model, self.params = model, params
         self.n_slots, self.block = n_slots, block_size
@@ -101,6 +102,13 @@ class ContinuousBatchingEngine:
         self.buckets = tuple(sorted(prompt_buckets))
         self.eos_id = eos_id
         self.temperature, self.greedy = temperature, greedy
+        # decode_chunk > 1 amortizes the per-step host sync: K decode
+        # steps run inside ONE jitted lax.scan, then the host accepts
+        # tokens up to each slot's eos/budget and discards the tail
+        # (discarded positions are simply overwritten later — the host
+        # length mirror is authoritative, resynced before every launch).
+        # Trade-off: up to K-1 wasted token-slots per finishing sequence.
+        self.decode_chunk = max(1, int(decode_chunk))
         self._key = jax.random.key(seed)
 
         self.cache = model.init_paged_cache(
@@ -124,6 +132,7 @@ class ContinuousBatchingEngine:
         self.prefill_token_slots = 0
 
         self._decode = jax.jit(self._decode_fn)
+        self._decode_chunked = jax.jit(self._decode_chunk_fn)
         self._prefills: dict[int, Any] = {}  # bucket -> jitted prefill
 
     # -- jitted programs -------------------------------------------------------
@@ -172,6 +181,36 @@ class ContinuousBatchingEngine:
         )
         tok, lp = self._sample(logits[:, 0], key)
         return tok, lp, cache
+
+    def _decode_chunk_fn(self, params, cache, last_tokens, active, key):
+        """K = self.decode_chunk decode steps in one program (lax.scan):
+        one host round-trip instead of K. Returns tokens/log-probs
+        [S, K]; the host accepts per-slot prefixes."""
+
+        def body(carry, k):
+            cache, last = carry
+            c = [dict(layer, active=active) for layer in cache]
+            logits, c = self.model.apply(
+                {"params": params}, last[:, None], cache=c
+            )
+            # strip the non-array 'active' key so the scan carry structure
+            # stays identical across iterations
+            c = [
+                {kk: vv for kk, vv in layer.items() if kk != "active"}
+                for layer in c
+            ]
+            tok, lp = self._sample(logits[:, 0], k)
+            return (c, tok), (tok, lp)
+
+        cache = [
+            {kk: vv for kk, vv in layer.items() if kk != "active"}
+            for layer in cache
+        ]
+        keys = jax.random.split(key, self.decode_chunk)
+        (cache, _), (toks, lps) = jax.lax.scan(
+            body, (cache, last_tokens), keys
+        )
+        return jnp.moveaxis(toks, 0, 1), jnp.moveaxis(lps, 0, 1), cache
 
     def _sample(self, logits, key):
         """(token, behavior log-prob of that token) per row."""
@@ -329,9 +368,21 @@ class ContinuousBatchingEngine:
             return False
         # grow tables for the upcoming token; slots that cannot get a
         # block this round stall (stay active=False) until blocks free up
+        chunk = self.decode_chunk
         stalled = 0
+        chunk_ok = chunk > 1
         for s in np.nonzero(active_np)[0]:
-            if not self._ensure_blocks(int(s), int(self.lens[s]) + 1):
+            s = int(s)
+            # cover the chunk's worth of writes up front, CLAMPED by the
+            # slot's remaining budget (submit guarantees prompt+max_new <=
+            # max_seq_len, so the clamp also bounds the table index);
+            # speculative writes past the budget land in scratch (the
+            # attention's write-range guard) and the host discards them
+            want = min(chunk, max(1, int(self.slot_budget[s])))
+            if not self._ensure_blocks(s, int(self.lens[s]) + want):
+                if chunk > 1 and self._ensure_blocks(s, int(self.lens[s]) + 1):
+                    chunk_ok = False  # pool tight: single-step this round
+                    continue
                 active_np[s] = False
                 stalled += 1
         if not active_np.any():
@@ -353,6 +404,21 @@ class ContinuousBatchingEngine:
         )
         self._sync_cache_tables(active=active_np)
         self._key, k = jax.random.split(self._key)
+        if chunk_ok:
+            tok, lp, self.cache = self._decode_chunked(
+                self.params, self.cache, jnp.asarray(last),
+                jnp.asarray(active_np), k,
+            )
+            self.decode_steps += chunk
+            tok_host, lp_host = np.asarray(tok), np.asarray(lp)
+            for s in np.nonzero(active_np)[0]:
+                s = int(s)
+                for j in range(chunk):
+                    if self.slot_rid[s] < 0:
+                        break  # finished mid-chunk: discard the tail
+                    self.lens[s] += 1
+                    self._push_token(s, int(tok_host[s, j]), float(lp_host[s, j]))
+            return bool(self.queue) or bool((self.slot_rid >= 0).any())
         tok, lp, self.cache = self._decode(
             self.params, self.cache, jnp.asarray(last), jnp.asarray(active_np), k
         )

@@ -100,8 +100,11 @@ def _paged_attention(cfg, q, k, v, cache, active):
     blk_global = jnp.take_along_axis(
         table, jnp.clip(blk_slot, 0, max_blocks - 1), axis=1
     )  # [S, T]
-    # inactive tokens write into scratch block 0 (reserved, never read)
-    blk_global = jnp.where(active_t, blk_global, 0)
+    # inactive tokens AND positions beyond the table range write into
+    # scratch block 0 (reserved, never read) — without the range guard a
+    # clipped out-of-range position would silently corrupt the LAST
+    # block's rows (chunked decode can speculate past a slot's budget)
+    blk_global = jnp.where(active_t & (blk_slot < max_blocks), blk_global, 0)
     flat_blk = blk_global.reshape(-1)
     flat_off = off.reshape(-1)
     # pools are HEAD-MAJOR [N, Hk, block, D] (the Pallas kernel views them

@@ -445,3 +445,76 @@ class TestLoadBalancer:
             LoadBalancer([])
         with pytest.raises(ValueError, match="unknown strategy"):
             LoadBalancer(self._engines(1), "magic")
+
+
+class TestChunkedDecode:
+    def test_chunked_equals_single_step_greedy(self):
+        m, params = small_model()
+        rng = np.random.default_rng(0)
+        reqs = [
+            (rng.integers(0, 97, int(rng.integers(4, 16))),
+             int(rng.integers(3, 20)))
+            for _ in range(8)
+        ]
+
+        def run(chunk):
+            eng = ContinuousBatchingEngine(
+                m, params, n_slots=3, block_size=8, n_blocks=49,
+                prompt_buckets=(16,), greedy=True, decode_chunk=chunk,
+            )
+            rids = [eng.submit(p, n) for p, n in reqs]
+            out = eng.run()
+            assert len(eng.free_blocks) == 48
+            return {i: out[r].tokens.tolist() for i, r in enumerate(rids)}
+
+        assert run(1) == run(4)
+
+    def test_chunked_with_eos_discards_tail(self):
+        m, params = small_model()
+        # find the greedy continuation, then use its SECOND token as eos:
+        # the chunked engine must stop after it even mid-chunk
+        eng = ContinuousBatchingEngine(
+            m, params, n_slots=1, block_size=8, n_blocks=17,
+            prompt_buckets=(16,), greedy=True,
+        )
+        rid = eng.submit(np.arange(5), 8)
+        ref = eng.run()[rid].tokens
+        eos = int(ref[1])
+        eng2 = ContinuousBatchingEngine(
+            m, params, n_slots=1, block_size=8, n_blocks=17,
+            prompt_buckets=(16,), greedy=True, eos_id=eos, decode_chunk=4,
+        )
+        rid2 = eng2.submit(np.arange(5), 8)
+        out = eng2.run()[rid2]
+        assert out.finished_reason == "eos"
+        assert out.tokens.tolist() == ref[:2].tolist()
+        assert len(eng2.free_blocks) == 16
+
+
+def test_chunked_decode_at_max_seq_len_boundary():
+    """Round-5 review regression (verified crash): a sequence whose
+    prompt + budget reaches max_seq_len must neither index past the
+    block table nor corrupt the last block when decode_chunk speculates
+    past the budget."""
+    import jax.numpy as jnp
+
+    from rl_tpu.models import ContinuousBatchingEngine, TransformerConfig, TransformerLM
+
+    cfg = TransformerConfig(vocab_size=97, d_model=32, n_layers=1, n_heads=2,
+                            d_ff=64, max_seq_len=128, dtype=jnp.float32)
+    m = TransformerLM(cfg)
+    params = m.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    prompt = np.arange(121) % 97
+
+    def run(chunk):
+        eng = ContinuousBatchingEngine(
+            m, params, n_slots=1, block_size=8, n_blocks=33,
+            prompt_buckets=(128,), greedy=True, decode_chunk=chunk,
+        )
+        rid = eng.submit(prompt, 7)  # 121 + 7 == max_seq_len exactly
+        out = eng.run()[rid]
+        assert len(eng.free_blocks) == 32
+        return out.tokens.tolist()
+
+    assert run(4) == run(1)
+    assert len(run(4)) == 7
