@@ -1,6 +1,13 @@
 from .decision_transformer import DecisionTransformer, DTConfig, DTLoss
 from .generate import GenerateOutput, generate, token_log_probs, token_log_probs_with_aux
-from .serving import ContinuousBatchingEngine, FinishedRequest, LoadBalancer, Request
+from .serving import (
+    ContinuousBatchingEngine,
+    FinishedRequest,
+    LoadBalancer,
+    RemoteEngine,
+    Request,
+    ServingService,
+)
 from .act import ACTConfig, ACTModel
 from .rssm import RSSM, DreamerModelLoss, RSSMConfig, dreamer_lambda_returns
 from .rssm_v3 import (
@@ -35,6 +42,8 @@ __all__ = [
     "token_log_probs_with_aux",
     "ContinuousBatchingEngine",
     "LoadBalancer",
+    "ServingService",
+    "RemoteEngine",
     "FinishedRequest",
     "Request",
     "GenerateOutput",

@@ -38,7 +38,14 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["ContinuousBatchingEngine", "LoadBalancer", "Request", "FinishedRequest"]
+__all__ = [
+    "ContinuousBatchingEngine",
+    "LoadBalancer",
+    "Request",
+    "FinishedRequest",
+    "ServingService",
+    "RemoteEngine",
+]
 
 
 @dataclasses.dataclass
@@ -262,6 +269,10 @@ class ContinuousBatchingEngine:
         self.slot_lps[slot] = []
 
     # -- public surface --------------------------------------------------------
+
+    def pending(self) -> int:
+        """Outstanding work: queued + in-flight requests."""
+        return len(self.queue) + int((self.slot_rid >= 0).sum())
 
     def submit(self, prompt, max_new_tokens: int) -> int:
         prompt = np.asarray(prompt, np.int32).reshape(-1)
@@ -491,7 +502,7 @@ class LoadBalancer:
     # -- per-replica load signals ---------------------------------------------
 
     def _pending(self, eng) -> int:
-        return len(eng.queue) + int((eng.slot_rid >= 0).sum())
+        return eng.pending()
 
     def _kv_utilization(self, eng) -> float:
         total = len(eng.free_blocks) + sum(
@@ -537,3 +548,159 @@ class LoadBalancer:
             for rid, f in eng.run().items():
                 out[(i, rid)] = f
         return out
+
+
+class ServingService:
+    """The engine behind a TCP endpoint (the reference's serving shape:
+    AsyncVLLM is a long-lived SERVICE actors submit to,
+    vllm_async.py:180; here the transport is the framework's own
+    line-delimited-JSON control plane, rl_tpu.comm.TCPCommandServer).
+
+    A background thread drives ``engine.step()`` whenever work is
+    pending; handlers and the stepper share one lock (the engine is not
+    thread-safe). Commands:
+
+    - ``submit`` {"prompt": [ids], "max_new_tokens": n} -> rid
+    - ``collect`` -> {rid: {"tokens": [...], "log_probs": [...],
+      "finished_reason": ...}} — finished since the last collect
+    - ``stats`` -> {"pending": ..., "free_blocks": ..., "decode_steps": ...}
+    """
+
+    def __init__(self, engine: ContinuousBatchingEngine, host: str = "127.0.0.1",
+                 port: int = 0):
+        import threading
+
+        from ..comm import TCPCommandServer
+
+        self.engine = engine
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._done: dict[int, FinishedRequest] = {}
+        self._error: str | None = None  # fatal stepper error, surfaced to clients
+        self._server = TCPCommandServer(host=host, port=port)
+        self._server.register_handler("submit", self._h_submit)
+        self._server.register_handler("collect", self._h_collect)
+        self._server.register_handler("stats", self._h_stats)
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+
+    # -- lifecycle -------------------------------------------------------------
+
+    @property
+    def address(self):
+        return self._server.address
+
+    def start(self) -> "ServingService":
+        self._server.start()
+        self._thread.start()
+        return self
+
+    def shutdown(self):
+        self._stop.set()
+        self._thread.join(timeout=10)
+        self._server.shutdown()
+
+    # -- stepper ---------------------------------------------------------------
+
+    def _loop(self):
+        import time as _time
+        import traceback as _tb
+
+        while not self._stop.is_set():
+            with self._lock:
+                busy = self.engine.pending() > 0
+                if busy:
+                    try:
+                        self.engine.step()
+                    except Exception:
+                        # a dead stepper must not look like a healthy
+                        # service: record and refuse further work
+                        self._error = _tb.format_exc(limit=5)
+                        return
+                    self._done.update(
+                        {f.rid: f for f in self.engine.finished}
+                    )
+                    self.engine.finished.clear()
+            if not busy:
+                _time.sleep(0.005)
+
+    # -- handlers --------------------------------------------------------------
+
+    def _h_submit(self, payload):
+        with self._lock:
+            if self._error is not None:
+                raise RuntimeError(f"serving stepper died:\n{self._error}")
+            return self.engine.submit(
+                np.asarray(payload["prompt"], np.int32),
+                int(payload["max_new_tokens"]),
+            )
+
+    def _h_collect(self, payload):
+        """Return (and remove) finished requests. ``payload`` may carry
+        {"rids": [...]} to take ONLY those — concurrent waiters must not
+        drain each other's results; with no rids, takes everything."""
+        with self._lock:
+            if self._error is not None and not self._done:
+                raise RuntimeError(f"serving stepper died:\n{self._error}")
+            want = payload.get("rids") if isinstance(payload, dict) else None
+            rids = list(self._done) if want is None else [
+                r for r in map(int, want) if r in self._done
+            ]
+            out = {
+                str(rid): {
+                    "tokens": self._done[rid].tokens.tolist(),
+                    "log_probs": self._done[rid].log_probs.tolist(),
+                    "finished_reason": self._done[rid].finished_reason,
+                }
+                for rid in rids
+            }
+            for rid in rids:
+                del self._done[rid]
+        return out
+
+    def _h_stats(self, _payload):
+        with self._lock:
+            return {
+                "pending": self.engine.pending(),
+                "free_blocks": len(self.engine.free_blocks),
+                "decode_steps": self.engine.decode_steps,
+                "error": self._error,
+            }
+
+
+class RemoteEngine:
+    """Client for :class:`ServingService` — the same submit surface over
+    TCP (reference: actors talk to AsyncVLLM via Ray handles)."""
+
+    def __init__(self, host: str, port: int, timeout: float = 30.0):
+        from ..comm import TCPCommandClient
+
+        self._client = TCPCommandClient(host, port, timeout=timeout)
+
+    def submit(self, prompt, max_new_tokens: int) -> int:
+        return int(self._client.call(
+            "submit",
+            {"prompt": np.asarray(prompt, np.int32).tolist(),
+             "max_new_tokens": int(max_new_tokens)},
+        ))
+
+    def collect(self, rids=None) -> dict[int, dict]:
+        payload = None if rids is None else {"rids": [int(r) for r in rids]}
+        return {int(k): v for k, v in self._client.call("collect", payload).items()}
+
+    def stats(self) -> dict:
+        return self._client.call("stats")
+
+    def wait_all(self, rids, poll_s: float = 0.05, timeout: float = 120.0) -> dict:
+        import time as _time
+
+        want = set(rids)
+        got: dict[int, dict] = {}
+        deadline = _time.monotonic() + timeout
+        while want - set(got) and _time.monotonic() < deadline:
+            got.update(self.collect(sorted(want - set(got))))
+            if want - set(got):
+                _time.sleep(poll_s)
+        missing = want - set(got)
+        if missing:
+            raise TimeoutError(f"requests {sorted(missing)} not finished in {timeout}s")
+        return {r: got[r] for r in want}

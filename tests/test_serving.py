@@ -518,3 +518,61 @@ def test_chunked_decode_at_max_seq_len_boundary():
 
     assert run(4) == run(1)
     assert len(run(4)) == 7
+
+
+class TestServingService:
+    def test_remote_submit_collect_matches_local_greedy(self):
+        from rl_tpu.models import ContinuousBatchingEngine, RemoteEngine, ServingService
+
+        m, params = small_model()
+
+        def fresh():
+            return ContinuousBatchingEngine(
+                m, params, n_slots=2, block_size=8, n_blocks=33,
+                prompt_buckets=(16,), greedy=True,
+            )
+
+        svc = ServingService(fresh()).start()
+        try:
+            host, port = svc.address
+            client = RemoteEngine(host, port)
+            rng = np.random.default_rng(0)
+            reqs = [(rng.integers(0, 97, int(rng.integers(4, 12))),
+                     int(rng.integers(2, 8))) for _ in range(6)]
+            rids = [client.submit(p, n) for p, n in reqs]
+            out = client.wait_all(rids)
+            assert set(out) == set(rids)
+            # greedy: remote tokens equal a local engine's for each prompt
+            local = fresh()
+            lr = [local.submit(p, n) for p, n in reqs]
+            lout = local.run()
+            for rid, (p, n), l in zip(rids, reqs, lr):
+                assert out[rid]["tokens"] == lout[l].tokens.tolist()
+            stats = client.stats()
+            assert stats["pending"] == 0
+            assert stats["free_blocks"] == 32
+        finally:
+            svc.shutdown()
+
+
+def test_serving_service_concurrent_waiters_keep_their_results():
+    """collect(rids) takes only the named results; a second waiter's
+    finished request must survive the first waiter's polling."""
+    from rl_tpu.models import ContinuousBatchingEngine, RemoteEngine, ServingService
+
+    m, params = small_model()
+    svc = ServingService(ContinuousBatchingEngine(
+        m, params, n_slots=2, block_size=8, n_blocks=33,
+        prompt_buckets=(16,), greedy=True,
+    )).start()
+    try:
+        host, port = svc.address
+        c = RemoteEngine(host, port)
+        r1 = c.submit(np.arange(5), 3)
+        r2 = c.submit(np.arange(7), 3)
+        out1 = c.wait_all([r1])  # polls collect([r1]) only
+        assert set(out1) == {r1}
+        out2 = c.wait_all([r2], timeout=30)  # r2 must still be there
+        assert set(out2) == {r2}
+    finally:
+        svc.shutdown()
