@@ -208,9 +208,12 @@ def main():
     # at this model size.
     step = jax.jit(program.train_step)
 
-    # warmup/compile
+    # warmup/compile — timed separately so the steady-state number and the
+    # one-off compile cost are never conflated (compile_s vs wall_s)
+    tc0 = time.perf_counter()
     ts, metrics = step(ts)
     jax.block_until_ready(metrics)
+    compile_s = time.perf_counter() - tc0
 
     t0 = time.perf_counter()
     for _ in range(TRAIN_STEPS):
@@ -223,6 +226,7 @@ def main():
     mfu = _model_flops_per_train_step() * TRAIN_STEPS / dt / _peak_flops(jax)
     _headline.update(_headline_dict(steps_per_sec, mfu))
     _report_extras.update(_platform_tag(jax))
+    _report_extras["compile_s"] = round(compile_s, 2)
     _report(steps_per_sec, mfu)
 
 
@@ -292,8 +296,10 @@ def bench_pixel(report: bool = True) -> dict:
     )
     ts = program.init(jax.random.key(0))
     step = jax.jit(program.train_step)
+    tc0 = time.perf_counter()
     ts, metrics = step(ts)
     jax.block_until_ready(metrics)
+    compile_s = time.perf_counter() - tc0
 
     t0 = time.perf_counter()
     for _ in range(train_steps):
@@ -326,6 +332,7 @@ def bench_pixel(report: bool = True) -> dict:
         "vs_baseline": round(sps / PER_CHIP_TARGET, 3),
         "mfu": round(mfu, 4),
         "n_envs": n_envs,
+        "compile_s": round(compile_s, 2),
         "error": None,
     }
     out.update(_platform_tag(jax))
@@ -384,8 +391,10 @@ def bench_hopper(report: bool = True) -> dict:
     )
     ts = program.init(jax.random.key(0))
     step = jax.jit(program.train_step)
+    tc0 = time.perf_counter()
     ts, metrics = step(ts)
     jax.block_until_ready(metrics)
+    compile_s = time.perf_counter() - tc0
 
     t0 = time.perf_counter()
     for _ in range(train_steps):
@@ -400,6 +409,7 @@ def bench_hopper(report: bool = True) -> dict:
         "vs_baseline": round(sps / PER_CHIP_TARGET, 3),
         "n_envs": n_envs,
         "physics_substeps_per_sec": round(sps * HopperEnv.FRAME_SKIP, 1),
+        "compile_s": round(compile_s, 2),
         "error": None,
     }
     out.update(_platform_tag(jax))
@@ -448,25 +458,34 @@ def bench_serve(report: bool = True) -> dict:
             for n in lengths]
     useful = sum(n for _, n in reqs)
 
+    # decode_chunk="auto": the engine's tuner sizes the chunk from measured
+    # chunk wall-time vs host/sync overhead — no per-tier constants. The
+    # SAME engine instance runs warm-up and the timed pass so the timed pass
+    # reuses compiled decode programs AND an already-converged tuner.
+    eng = ContinuousBatchingEngine(
+        model, params, n_slots=S, block_size=16,
+        n_blocks=S * (cfg.max_seq_len // 16) + 1,
+        prompt_buckets=(bucket,), greedy=True,
+        decode_chunk="auto",
+    )
+
     def run_engine():
-        eng = ContinuousBatchingEngine(
-            model, params, n_slots=S, block_size=16,
-            n_blocks=S * (cfg.max_seq_len // 16) + 1,
-            prompt_buckets=(bucket,), greedy=True,
-            decode_chunk=_T(smoke=1, cpu=4, full=8),
-        )
         for p, n in reqs:
             eng.submit(p, n)
         t0 = time.perf_counter()
         out = eng.run()
         return time.perf_counter() - t0, len(out)
 
-    t_warm, _ = run_engine()  # compile prefill buckets + decode
+    t_warm, _ = run_engine()  # compile prefill buckets + decode ladder
+    steps0 = eng.decode_steps
     t_engine, n_done = run_engine()
     assert n_done == len(reqs)
+    # token-slot work accounting: every decode step computes n_slots rows
+    engine_token_slots = (eng.decode_steps - steps0) * S
 
     def run_fixed():
         t0 = time.perf_counter()
+        slots = 0
         for i in range(0, len(reqs), S):
             chunk = reqs[i : i + S]
             maxp = max(len(p) for p, _ in chunk)
@@ -480,10 +499,11 @@ def bench_serve(report: bool = True) -> dict:
                            jax.random.key(i), max_new_tokens=maxn, greedy=True,
                            eos_id=None)
             jax.block_until_ready(out.tokens)
-        return time.perf_counter() - t0
+            slots += len(chunk) * maxn
+        return time.perf_counter() - t0, slots
 
-    run_fixed()  # compile
-    t_fixed = run_fixed()
+    t_fixed_warm, _ = run_fixed()  # compile
+    t_fixed, fixed_token_slots = run_fixed()
 
     out = {
         "metric": "serve_continuous_batching_tokens_per_sec",
@@ -491,7 +511,13 @@ def bench_serve(report: bool = True) -> dict:
         "unit": "tokens/s",
         "vs_baseline": round(t_fixed / t_engine, 3),
         "speedup_vs_fixed_batch": round(t_fixed / t_engine, 3),
+        "work_efficiency_token_slots": round(
+            fixed_token_slots / max(1, engine_token_slots), 3
+        ),
+        "decode_chunk": eng.decode_chunk_last,
+        "engine_decode_steps": int(eng.decode_steps - steps0),
         "fixed_tokens_per_sec": round(useful / t_fixed, 1),
+        "compile_s": round(t_warm + t_fixed_warm, 2),
         "n_requests": len(reqs),
         "n_slots": S,
         "error": None,
@@ -547,15 +573,17 @@ def bench_attention():
             return sum(o.astype(jnp.float32).sum() for o in out)
 
         jit_chain = jax.jit(chain)
+        tc0 = time.perf_counter()
         float(jit_chain((q, k, v)))  # compile + warm
+        compile_s = time.perf_counter() - tc0
         t0 = time.perf_counter()
         float(jit_chain((q, k, v)))
-        return (time.perf_counter() - t0) / reps
+        return (time.perf_counter() - t0) / reps, compile_s
 
-    t_flash = run(
+    t_flash, c_flash = run(
         lambda q, k, v: flash_attention(q, k, v, causal=True, interpret=interpret)
     )
-    t_xla = run(xla_attn)
+    t_xla, c_xla = run(xla_attn)
     # causal attention fwd+bwd: (2 fwd + 5 bwd) matmuls x 2*B*H*T^2*D FLOPs
     # each, halved by the causal mask (ideal algorithm FLOPs, recompute not
     # counted — standard MFU accounting)
@@ -573,6 +601,7 @@ def bench_attention():
                 "xla_ms": round(t_xla * 1e3, 3),
                 "flash_mfu": round(flops / t_flash / peak, 4),
                 "shape": [B, T, H, D],
+                "compile_s": round(c_flash + c_xla, 2),
                 "error": None,
             }
         ),
@@ -607,7 +636,9 @@ def bench_hostenv():
 
     coll = HostCollector(pool, policy, frames_per_batch=frames)
     key = jax.random.key(0)
+    tc0 = time.perf_counter()
     coll.collect(params, key)  # warm (compile the policy, prime envs)
+    compile_s = time.perf_counter() - tc0
     t0 = time.perf_counter()
     batch = coll.collect(params, key)
     dt = time.perf_counter() - t0
@@ -621,6 +652,7 @@ def bench_hostenv():
                 "unit": "env_steps/s",
                 "vs_baseline": round(fps / 4400.0, 3),
                 "n_envs": n_envs,
+                "compile_s": round(compile_s, 2),
                 "error": None,
             }
         ),
@@ -733,9 +765,11 @@ def bench_rlhf(report: bool = True) -> dict:
 
     # warm/compile both programs
     k1, k2 = jax.random.split(key)
+    tc0 = time.perf_counter()
     tokens, lp, amask = rollout(params, k1)
     params2, opt_state2, v = train_step(params, opt_state, tokens, lp, amask, k2)
     jax.block_until_ready(v)
+    compile_s = time.perf_counter() - tc0
 
     reps = 1 if _TIER != "full" else 3
     # time generation and training separately (different bound regimes),
@@ -777,6 +811,7 @@ def bench_rlhf(report: bool = True) -> dict:
         "train_tokens_per_sec": round(B * T / t_train, 1),
         "n_params": n_params,
         "shape": [B, Tp, Tn],
+        "compile_s": round(compile_s, 2),
         "error": None,
     }
     out.update(_platform_tag(jax))
@@ -837,8 +872,10 @@ def bench_sac(report: bool = True) -> dict:
     )
     ts = program.init(jax.random.key(0))
     step = jax.jit(program.train_step)
+    tc0 = time.perf_counter()
     ts, m = step(ts)
     jax.block_until_ready(m)
+    compile_s = time.perf_counter() - tc0
     reps = _T(smoke=2, cpu=4, full=8)
     t0 = time.perf_counter()
     for _ in range(reps):
@@ -853,12 +890,172 @@ def bench_sac(report: bool = True) -> dict:
         "vs_baseline": round(sps / PER_CHIP_TARGET, 3),
         "grad_updates_per_sec": round(reps * 4 / dt, 2),
         "loss": float(jnp.asarray(m["loss"])),
+        "compile_s": round(compile_s, 2),
         "error": None,
     }
     out.update(_platform_tag(jax))
     if report:
         print(json.dumps(out), flush=True)
     return out
+
+
+def _per_end_to_end(jax) -> tuple[dict, float]:
+    """End-to-end PER: the SAME fused SAC train step (collect -> extend ->
+    UTD x (sample -> grad -> polyak)) run two ways — the jit-resident
+    PrioritizedSampler in-program vs the host C++ segment tree driving
+    sampling and priority write-back from outside the program (one
+    device->host td_error sync + one index/weight upload per update, the
+    reference's architecture). The micro cycle above isolates the sampler;
+    this measures what the sampler placement does to a whole train step.
+    Returns (report fields, compile seconds)."""
+    import numpy as np
+    import jax.numpy as jnp
+    import optax
+
+    from rl_tpu.collectors import Collector
+    from rl_tpu.csrc import SumSegmentTree
+    from rl_tpu.data.replay import DeviceStorage, ReplayBuffer
+    from rl_tpu.data.replay.samplers import PrioritizedSampler
+    from rl_tpu.envs import PendulumEnv, VmapEnv
+    from rl_tpu.modules import (
+        MLP,
+        ConcatMLP,
+        NormalParamExtractor,
+        ProbabilisticActor,
+        TDModule,
+        TDSequential,
+        TanhNormal,
+    )
+    from rl_tpu.objectives import SACLoss
+    from rl_tpu.trainers import OffPolicyConfig, OffPolicyProgram
+
+    n_envs = _T(smoke=4, cpu=16, full=64)
+    frames = _T(smoke=16, cpu=64, full=256)
+    bs = _T(smoke=32, cpu=128, full=256)
+    utd = 4
+    cap = _T(smoke=2048, cpu=8192, full=1 << 15)
+    reps = _T(smoke=1, cpu=3, full=6)
+    cells = (64, 64)
+
+    actor = ProbabilisticActor(
+        TDSequential(
+            TDModule(MLP(out_features=2, num_cells=cells), ["observation"], ["raw"]),
+            TDModule(NormalParamExtractor(), ["raw"], ["loc", "scale"]),
+        ),
+        TanhNormal,
+        dist_keys=("loc", "scale"),
+    )
+    sac = SACLoss(actor, ConcatMLP(out_features=1, num_cells=cells))
+    env = VmapEnv(PendulumEnv(), n_envs)
+    coll = Collector(
+        env, lambda p, td, k: sac.actor(p["actor"], td, k), frames_per_batch=frames
+    )
+    cfg_op = OffPolicyConfig(batch_size=bs, utd_ratio=utd, learning_rate=3e-4)
+    sampler = PrioritizedSampler()
+
+    # -- device: PER lives inside the one jitted program -----------------------
+    dev_prog = OffPolicyProgram(
+        coll,
+        sac,
+        ReplayBuffer(DeviceStorage(cap), sampler=sampler),
+        cfg_op,
+        priority_key="td_error",
+    )
+    ts = dev_prog.init(jax.random.key(1))
+    dstep = jax.jit(dev_prog.train_step)
+    tc0 = time.perf_counter()
+    ts, m = dstep(ts)
+    jax.block_until_ready(m)
+    compile_s = time.perf_counter() - tc0
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        ts, m = dstep(ts)
+    jax.block_until_ready(m)
+    t_dev = (time.perf_counter() - t0) / reps
+
+    # -- host: same update math, sampling + priorities through the C++ tree ----
+    host_buf = ReplayBuffer(DeviceStorage(cap))
+    hprog = OffPolicyProgram(coll, sac, host_buf, cfg_op)
+    hts = hprog.init(jax.random.key(1))
+
+    @jax.jit
+    def h_collect_extend(params, cstate, bstate):
+        batch, cstate = coll.collect(params, cstate)
+        bstate = host_buf.extend(bstate, hprog._flatten(batch), n=frames)
+        return cstate, bstate
+
+    @jax.jit
+    def h_update(params, opt_state, storage, idx, weight, key):
+        mb = host_buf.storage.get(storage, idx)
+        mb = mb.set("index", idx).set("_weight", weight)
+        _, grads, metrics = sac.grad(params, mb, key)
+        updates, opt_state = hprog.optimizer.update(
+            grads, opt_state, sac.trainable(params)
+        )
+        params = sac.merge(
+            optax.apply_updates(sac.trainable(params), updates), params
+        )
+        params = hprog.target_update(params)
+        return params, opt_state, metrics["td_error"]
+
+    tree = SumSegmentTree(cap)
+    prios = np.zeros(cap, np.float64)  # host mirror of p^alpha (tree has no read)
+    rng = np.random.default_rng(1)
+    alpha, beta, eps_p = sampler.alpha, sampler.beta0, sampler.eps
+
+    state = {
+        "params": hts["params"], "opt": hts["opt"],
+        "collector": hts["collector"], "buffer": hts["buffer"],
+        "wpos": 0, "size": 0, "key": jax.random.key(2),
+    }
+
+    def host_step(st):
+        cstate, bstate = h_collect_extend(st["params"], st["collector"], st["buffer"])
+        new_idx = (st["wpos"] + np.arange(frames)) % cap
+        pa = (1.0 + eps_p) ** alpha  # new items at max priority (PER convention)
+        prios[new_idx] = pa
+        tree[new_idx] = pa
+        wpos, size = st["wpos"] + frames, min(st["size"] + frames, cap)
+        params, opt_state, key = st["params"], st["opt"], st["key"]
+        for _ in range(utd):
+            key, k = jax.random.split(key)
+            us = rng.uniform(0.0, tree.reduce(), bs)
+            idx = tree.scan(us)
+            p = np.maximum(prios[idx], 1e-12)
+            w = (size * p / tree.reduce()) ** (-beta)
+            w = (w / w.max()).astype(np.float32)
+            params, opt_state, td = h_update(
+                params, opt_state, bstate["storage"],
+                jnp.asarray(idx, jnp.int32), jnp.asarray(w), k,
+            )
+            td_np = np.asarray(td)  # the per-update device->host sync
+            pa_new = (np.abs(td_np) + eps_p) ** alpha
+            prios[idx] = pa_new
+            tree[idx] = pa_new
+        return {
+            "params": params, "opt": opt_state, "collector": cstate,
+            "buffer": bstate, "wpos": wpos, "size": size, "key": key,
+        }
+
+    tc0 = time.perf_counter()
+    state = host_step(state)  # compile collect_extend + update
+    compile_s += time.perf_counter() - tc0
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        state = host_step(state)
+    jax.block_until_ready(state["params"])
+    t_host = (time.perf_counter() - t0) / reps
+
+    return (
+        {
+            "e2e_device_ms_per_step": round(t_dev * 1e3, 2),
+            "e2e_host_tree_ms_per_step": round(t_host * 1e3, 2),
+            "e2e_step_time_ratio": round(t_host / t_dev, 3),
+            "e2e_frames_per_batch": frames,
+            "e2e_utd": utd,
+        },
+        compile_s,
+    )
 
 
 def bench_per(report: bool = True) -> dict:
@@ -868,7 +1065,9 @@ def bench_per(report: bool = True) -> dict:
     priorities back. The device side runs the jit-resident
     PrioritizedSampler (two-level prefix sum + searchsorted); the host side
     runs the native C++ SumSegmentTree (set batch + prefix-search batch).
-    ``vs_baseline`` = host_time / device_time (>1 means on-device wins)."""
+    ``vs_baseline`` = host_time / device_time (>1 means on-device wins).
+    The ``e2e_*`` fields compare whole fused SAC train steps with the PER
+    sampler in-program vs host-tree-in-the-loop (``_per_end_to_end``)."""
     jax = _setup_jax()
     import jax.numpy as jnp
     import numpy as np
@@ -897,8 +1096,10 @@ def bench_per(report: bool = True) -> dict:
             return sstate, key
         return jax.lax.fori_loop(0, inner, body, (sstate, key))
 
+    tc0 = time.perf_counter()
     out_state, _ = device_cycles(sstate, key)
     jax.block_until_ready(out_state["priorities"])
+    compile_s = time.perf_counter() - tc0
     t0 = time.perf_counter()
     out_state, _ = device_cycles(sstate, key)
     jax.block_until_ready(out_state["priorities"])
@@ -915,6 +1116,9 @@ def bench_per(report: bool = True) -> dict:
         newp = rng.uniform(0.01, 1.01, batch) ** sampler.alpha
         tree[idx] = newp
     t_host = (time.perf_counter() - t0) / inner
+
+    e2e, e2e_compile = _per_end_to_end(jax)
+    compile_s += e2e_compile
     out = {
         "metric": "per_on_device_speedup_vs_host_tree",
         "value": round(t_host / t_dev, 3),
@@ -925,8 +1129,10 @@ def bench_per(report: bool = True) -> dict:
         "native_tree": bool(getattr(tree, "IS_NATIVE", False)),
         "capacity": capacity,
         "batch": batch,
+        "compile_s": round(compile_s, 2),
         "error": None,
     }
+    out.update(e2e)
     out.update(_platform_tag(jax))
     if report:
         print(json.dumps(out), flush=True)

@@ -41,6 +41,18 @@ __all__ = [
 SCHEMA_VERSION = 1
 
 
+def _is_typed_key(x: Any) -> bool:
+    import jax.numpy as jnp
+
+    return isinstance(x, jax.Array) and jnp.issubdtype(x.dtype, jax.dtypes.prng_key)
+
+
+def _unwrap_keys(tree: Any) -> Any:
+    """Typed PRNG key leaves -> raw uint32 key data (orbax can't serialize
+    the opaque key dtype)."""
+    return jax.tree.map(lambda x: jax.random.key_data(x) if _is_typed_key(x) else x, tree)
+
+
 class ArrayTreeAdapter:
     """Orbax-backed pytree-of-arrays adapter (sharding-aware restore)."""
 
@@ -48,14 +60,24 @@ class ArrayTreeAdapter:
         import orbax.checkpoint as ocp
 
         with ocp.PyTreeCheckpointer() as ckptr:
-            ckptr.save(os.path.abspath(path), obj, force=True)
+            ckptr.save(os.path.abspath(path), _unwrap_keys(obj), force=True)
 
     def load(self, path: str, template: Any | None = None) -> Any:
         import orbax.checkpoint as ocp
 
         with ocp.PyTreeCheckpointer() as ckptr:
             if template is not None:
-                return ckptr.restore(os.path.abspath(path), item=template)
+                restored = ckptr.restore(os.path.abspath(path), item=_unwrap_keys(template))
+                # rewrap leaves that were typed PRNG keys in the template
+                return jax.tree.map(
+                    lambda t, r: (
+                        jax.random.wrap_key_data(r, impl=jax.random.key_impl(t))
+                        if _is_typed_key(t)
+                        else r
+                    ),
+                    template,
+                    restored,
+                )
             return ckptr.restore(os.path.abspath(path))
 
 

@@ -42,7 +42,7 @@ class LLMCollector:
         continuous_batching: bool = False,
         engine_slots: int | None = None,
         engine_block_size: int = 16,
-        engine_decode_chunk: int = 1,
+        engine_decode_chunk: int | str = 1,
     ):
         self.env = env
         self.model = model
@@ -59,6 +59,9 @@ class LLMCollector:
         self.continuous_batching = continuous_batching
         self.engine_slots = engine_slots
         self.engine_block_size = engine_block_size
+        # 1 (default) keeps sampling key-deterministic vs the fixed-batch
+        # path; "auto" lets the engine tune its chunk from measured chunk
+        # wall-time vs sync overhead (throughput over reproducibility)
         self.engine_decode_chunk = engine_decode_chunk
         self._engine = None
         # (rewards, batch_arrays) -> rewards, applied BEFORE group advantages

@@ -159,20 +159,20 @@ class _RSSMv3Core(nn.Module):
 
     # -- programs --------------------------------------------------------------
 
+    def _observe_step(self, carry, xs):
+        h, z, key = carry
+        obs, act, first = xs
+        mask = (1.0 - first.astype(jnp.float32))[:, None]
+        h, z, act = h * mask, z * mask, act * mask
+        h, prior_logits = self.step_prior(h, z, act)
+        post_logits = self.posterior(h, obs)
+        key, k = jax.random.split(key)
+        z = self._sample(post_logits, k)
+        return (h, z, key), (h, z, prior_logits, post_logits)
+
     def observe(self, obs_seq, action_seq, is_first, key):
         B, T, _ = obs_seq.shape
         c = self.cfg
-
-        def body(carry, xs):
-            h, z, key = carry
-            obs, act, first = xs
-            mask = (1.0 - first.astype(jnp.float32))[:, None]
-            h, z, act = h * mask, z * mask, act * mask
-            h, prior_logits = self.step_prior(h, z, act)
-            post_logits = self.posterior(h, obs)
-            key, k = jax.random.split(key)
-            z = self._sample(post_logits, k)
-            return (h, z, key), (h, z, prior_logits, post_logits)
 
         h0 = jnp.zeros((B, c.deter_dim))
         z0 = jnp.zeros((B, c.stoch_dim))
@@ -181,7 +181,16 @@ class _RSSMv3Core(nn.Module):
             jnp.moveaxis(action_seq, 1, 0),
             jnp.moveaxis(is_first, 1, 0),
         )
-        _, (h, z, pl, ql) = jax.lax.scan(body, (h0, z0, key), xs)
+        # the LIFTED scan: submodule calls inside a raw jax.lax.scan body
+        # are rejected by flax (trace-level check in module construction)
+        scan = nn.scan(
+            _RSSMv3Core._observe_step,
+            variable_broadcast="params",
+            split_rngs={"params": False},
+            in_axes=0,
+            out_axes=0,
+        )
+        _, (h, z, pl, ql) = scan(self, (h0, z0, key), xs)
         to_bt = lambda x: jnp.moveaxis(x, 0, 1)  # noqa: E731
         h, z = to_bt(h), to_bt(z)
         recon, reward_logits, cont = self.decode(h, z)

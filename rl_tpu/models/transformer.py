@@ -70,10 +70,10 @@ def _paged_attention(cfg, q, k, v, cache, active):
     Layout: ``pool_k``/``pool_v`` [n_blocks, Hk, block, D] (HEAD-MAJOR)
     shared across slots; ``block_table`` [S, max_blocks] int32 (block 0 =
     reserved scratch); ``len`` [S] int32 per-slot lengths. New tokens (q/k/v
-    [S, T, ...]) land at slot-local positions ``len[s] + t``; reads run an
-    online-softmax over the table's blocks (the flash-attention recurrence,
-    unrolled over max_blocks), so the slot's KV is never materialized
-    contiguously — the gather per block is the only copy. With
+    [S, T, ...]) land at slot-local positions ``len[s] + t``; the read
+    gathers the slot's table blocks in ONE shot and runs a single masked
+    softmax over the assembled range — one gather + two einsums per layer
+    instead of an op chain per block. With
     ``cfg.flash_decode`` the T=1 read instead runs the Pallas
     ``paged_flash_decode`` kernel, whose index map reads the block table
     directly (the pool is read in place, no gather copy at all).
@@ -132,36 +132,38 @@ def _paged_attention(cfg, q, k, v, cache, active):
         ).astype(cfg.dtype)
         return o, _advance_paged_cache(cache, pool_k, pool_v, lens, active_t)
 
-    if cfg.kv_heads != cfg.n_heads:
-        rep = cfg.n_heads // cfg.kv_heads
-    else:
-        rep = 1
+    # ONE gather materializes every table block, then a single masked
+    # softmax attends over the whole [L = max_blocks*block] range. This
+    # replaces the old per-block online-softmax python loop, whose
+    # max_blocks x (gather + 2 einsums + renormalize) unrolled HLO
+    # dominated small-step decode wall-clock (and compile time) — the
+    # dispatch overhead of ~6*max_blocks tiny ops per layer per token
+    # dwarfed the flops. Rows with no valid key (inactive slots, all
+    # table entries unassigned) softmax over a uniform -1e9 score row and
+    # produce finite garbage; their outputs are never consumed (the
+    # engine discards inactive slots' tokens).
+    Hk = pool_k.shape[1]
+    rep = cfg.n_heads // cfg.kv_heads
     scale = cfg.head_dim**-0.5
-    m = jnp.full((S, cfg.n_heads, T), -jnp.inf, jnp.float32)
-    l = jnp.zeros((S, cfg.n_heads, T), jnp.float32)
-    acc = jnp.zeros((S, cfg.n_heads, T, cfg.head_dim), jnp.float32)
-    qf = q.astype(jnp.float32)
-    for b in range(max_blocks):
-        kb = pool_k[table[:, b]].astype(jnp.float32)  # [S, Hk, block, D]
-        vb = pool_v[table[:, b]].astype(jnp.float32)
-        if rep > 1:
-            kb = jnp.repeat(kb, rep, axis=1)
-            vb = jnp.repeat(vb, rep, axis=1)
-        s_blk = jnp.einsum("sthd,shjd->shtj", qf, kb) * scale  # [S,H,T,block]
-        kv_pos = b * block + jnp.arange(block)  # slot-local positions
-        # causal: q token t (at position len+t) sees kv_pos <= len + t
-        valid = kv_pos[None, None, :] <= pos[:, :, None]  # [S, T, block]
-        valid = valid & (table[:, b] > 0)[:, None, None]  # unassigned/scratch
-        s_blk = jnp.where(valid[:, None], s_blk, -jnp.inf)
-        m_new = jnp.maximum(m, s_blk.max(axis=-1))
-        # renormalize the running accumulator (guard the all-masked case)
-        corr = jnp.where(jnp.isfinite(m), jnp.exp(m - m_new), 0.0)
-        p = jnp.exp(s_blk - m_new[..., None])
-        p = jnp.where(valid[:, None], p, 0.0)
-        l = l * corr + p.sum(axis=-1)
-        acc = acc * corr[..., None] + jnp.einsum("shtj,shjd->shtd", p, vb)
-        m = m_new
-    o = acc / jnp.maximum(l, 1e-9)[..., None]  # [S, H, T, D]
+    L = max_blocks * block
+    safe_table = jnp.clip(table, 0, n_blocks - 1)  # -1 (unassigned) -> scratch
+    k_all = pool_k[safe_table]  # [S, max_blocks, Hk, block, D]
+    v_all = pool_v[safe_table]
+    k_all = jnp.moveaxis(k_all, 2, 1).reshape(S, Hk, L, -1).astype(jnp.float32)
+    v_all = jnp.moveaxis(v_all, 2, 1).reshape(S, Hk, L, -1).astype(jnp.float32)
+    # grouped heads: [S, T, H, D] -> [S, Hk, rep, T, D] (no KV repeat)
+    qf = jnp.moveaxis(q, 1, 2).astype(jnp.float32)
+    qf = qf.reshape(S, Hk, rep, T, cfg.head_dim)
+    s_all = jnp.einsum("shrtd,shld->shrtl", qf, k_all) * scale
+    kv_pos = jnp.arange(L)
+    # causal: q token t (at position len+t) sees kv_pos <= len + t;
+    # unassigned/scratch table entries are never valid keys
+    valid = kv_pos[None, None, :] <= pos[:, :, None]  # [S, T, L]
+    valid = valid & jnp.repeat(table > 0, block, axis=1)[:, None, :]
+    s_all = jnp.where(valid[:, None, None], s_all, -1e9)
+    p = jax.nn.softmax(s_all, axis=-1)
+    o = jnp.einsum("shrtl,shld->shrtd", p, v_all)
+    o = o.reshape(S, cfg.n_heads, T, cfg.head_dim)
     o = jnp.moveaxis(o, 1, 2).astype(cfg.dtype)  # [S, T, H, D]
     return o, _advance_paged_cache(cache, pool_k, pool_v, lens, active_t)
 

@@ -1,0 +1,30 @@
+"""jax version compatibility for ``shard_map``.
+
+Newer jax exports ``jax.shard_map`` with a ``check_vma`` kwarg; older
+releases ship it as ``jax.experimental.shard_map.shard_map`` where the
+same switch is spelled ``check_rep``. Callers import from here and always
+use the new spelling.
+"""
+
+from __future__ import annotations
+
+import functools
+import inspect
+
+try:
+    from jax import shard_map as _shard_map  # type: ignore[attr-defined]
+except ImportError:  # pragma: no cover - exercised on older jax only
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+if "check_vma" in inspect.signature(_shard_map).parameters:
+    shard_map = _shard_map
+else:
+
+    @functools.wraps(_shard_map)
+    def shard_map(*args, **kwargs):
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        return _shard_map(*args, **kwargs)
+
+
+__all__ = ["shard_map"]

@@ -27,6 +27,11 @@ if "xla_backend_optimization_level" not in flags:
     flags += " --xla_backend_optimization_level=0 --xla_llvm_disable_expensive_passes=true"
 os.environ["XLA_FLAGS"] = flags
 
+# headless container: no EGL/GLX. Render-less mujoco keeps the
+# dm_control/gymnasium-robotics/pettingzoo suites importable (none of the
+# tests here render frames).
+os.environ.setdefault("MUJOCO_GL", "disabled")
+
 import jax  # noqa: E402
 
 # This image's sitecustomize registers the TPU ('axon') PJRT plugin and pins

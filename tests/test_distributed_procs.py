@@ -25,6 +25,19 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+# some jaxlib CPU builds ship without gloo, so cross-process collectives
+# raise this at the first multihost device_put/psum. That is an install
+# limitation, not a code bug — skip instead of fail.
+_NO_CPU_COLLECTIVES = "Multiprocess computations aren't implemented on the CPU backend"
+
+
+def _check_worker(rank: int, p, out: str, label: str = "") -> None:
+    if p.returncode != 0 and _NO_CPU_COLLECTIVES in out:
+        pytest.skip("jaxlib CPU build lacks cross-process collectives (no gloo)")
+    assert p.returncode == 0, f"{label} rank {rank} failed:\n{out}"
+    assert f"DIST_OK rank={rank}" in out, out
+
+
 @pytest.mark.dist
 def test_two_process_group_replay_and_weights():
     worker = os.path.join(os.path.dirname(__file__), "dist_worker.py")
@@ -65,8 +78,7 @@ def test_two_process_group_replay_and_weights():
         pytest.fail(f"distributed workers wedged; partial output: {outs}")
 
     for rank, (p, out) in enumerate(zip(procs, outs)):
-        assert p.returncode == 0, f"rank {rank} failed:\n{out}"
-        assert f"DIST_OK rank={rank}" in out, out
+        _check_worker(rank, p, out)
 
 
 def _spawn_mesh_workers(mode: str, world: int, timeout: float = 420.0):
@@ -104,8 +116,7 @@ def _spawn_mesh_workers(mode: str, world: int, timeout: float = 420.0):
             p.kill()
         pytest.fail(f"{mode} workers wedged; partial output: {outs}")
     for rank, (p, out) in enumerate(zip(procs, outs)):
-        assert p.returncode == 0, f"{mode} rank {rank} failed:\n{out}"
-        assert f"DIST_OK rank={rank}" in out, out
+        _check_worker(rank, p, out, label=mode)
 
 
 @pytest.mark.dist

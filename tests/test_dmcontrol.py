@@ -4,7 +4,7 @@ two real domains, HostCollector batching, and the pixels path."""
 
 import os
 
-os.environ.setdefault("MUJOCO_GL", "egl")  # headless rendering backend
+os.environ.setdefault("MUJOCO_GL", "disabled")  # headless: no EGL in this container
 
 import jax
 import numpy as np

@@ -1,0 +1,101 @@
+"""Relay watcher state machine: probe-until-healthy -> bench -> commit.
+
+Exercises tools/relay_watch.py with an injected fake runner — no
+subprocesses, no TPU, no git. The round-5 failure mode this guards: a
+healthy window arrives and the watcher only logs it (ISSUE round-6
+satellite: the first healthy probe must SPEND the window)."""
+
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+
+from relay_watch import watch  # noqa: E402
+
+
+class FakeRunner:
+    """Scripted probe outcomes; records bench/commit invocations."""
+
+    def __init__(self, probes):
+        self.probes = list(probes)
+        self.bench_calls = []
+        self.commits = []
+
+    def probe(self, timeout):
+        rc, out = self.probes.pop(0)
+        return rc, out, 1.0
+
+    def bench_all(self, timeout):
+        self.bench_calls.append(timeout)
+        return 0, json.dumps({"metric": "ppo", "value": 123.0}) + "\n"
+
+    def commit(self, paths, message):
+        self.commits.append((list(paths), message))
+        return 0
+
+
+def _healthy(platform="tpu"):
+    return 0, json.dumps(
+        {"platform": platform, "device_kind": "TPU v5e", "n_devices": 1, "error": None}
+    )
+
+
+def test_first_healthy_probe_launches_bench_and_commits(tmp_path):
+    runner = FakeRunner([(124, ""), (124, ""), _healthy()])
+    lines = []
+    art = str(tmp_path / "bench.jsonl")
+    path = watch(runner, lines.append, max_probes=10, artifact=art, sleep=lambda s: None)
+    assert path == art
+    # the window was SPENT: exactly one bench, its stdout persisted, committed
+    assert len(runner.bench_calls) == 1
+    assert json.loads(open(art).read())["value"] == 123.0
+    assert len(runner.commits) == 1
+    assert runner.commits[0][0] == [art]
+    # log grammar matches the round-5 watcher (dead rc=... (Ns))
+    assert any("dead rc=124 (1s)" in ln for ln in lines)
+    assert any("healthy platform=tpu" in ln for ln in lines)
+
+
+def test_probe_budget_exhausted_never_benches(tmp_path):
+    runner = FakeRunner([(124, "")] * 3)
+    lines = []
+    path = watch(runner, lines.append, max_probes=3, sleep=lambda s: None)
+    assert path is None
+    assert runner.bench_calls == []
+    assert runner.commits == []
+    assert any("watcher stop" in ln for ln in lines)
+
+
+def test_cpu_fallback_probe_is_not_a_window(tmp_path):
+    """A probe that answers from the CPU backend (relay down, jax fell back)
+    must NOT trigger the bench: the window is defined by the chip."""
+    runner = FakeRunner([_healthy(platform="cpu"), _healthy()])
+    lines = []
+    art = str(tmp_path / "bench.jsonl")
+    path = watch(runner, lines.append, max_probes=2, artifact=art, sleep=lambda s: None)
+    assert path == art
+    assert len(runner.bench_calls) == 1  # only the real-TPU probe fired it
+    assert sum("dead rc=0" in ln for ln in lines) == 1
+
+
+def test_no_commit_flag(tmp_path):
+    runner = FakeRunner([_healthy()])
+    art = str(tmp_path / "bench.jsonl")
+    watch(runner, lambda s: None, max_probes=1, artifact=art, commit=False,
+          sleep=lambda s: None)
+    assert runner.commits == []
+    assert os.path.exists(art)
+
+
+def test_probe_crash_rc_nonzero_keeps_waiting():
+    runner = FakeRunner([(1, "Traceback ..."), _healthy()])
+    lines = []
+    path = watch(runner, lines.append, max_probes=2, artifact=None, commit=False,
+                 sleep=lambda s: None)
+    # artifact=None writes under logs/ — redirect not needed; just check flow
+    assert runner.bench_calls and path is not None
+    os.remove(path)  # don't leave a fake artifact in logs/
+    assert any("dead rc=1" in ln for ln in lines)

@@ -469,10 +469,70 @@ class TestChunkedDecode:
 
         assert run(1) == run(4)
 
+    def test_auto_chunk_equals_single_step_greedy(self):
+        """decode_chunk="auto" (the measured tuner) must stay token-identical
+        to single-step greedy — on a COLD engine (tuner at its init chunk)
+        and on the same engine re-run warm (tuner possibly at a larger
+        ladder rung, double-buffered drains in flight)."""
+        m, params = small_model()
+        rng = np.random.default_rng(3)
+        reqs = [
+            (rng.integers(0, 97, int(rng.integers(4, 16))),
+             int(rng.integers(3, 20)))
+            for _ in range(8)
+        ]
+
+        def run_fixed1():
+            eng = ContinuousBatchingEngine(
+                m, params, n_slots=3, block_size=8, n_blocks=49,
+                prompt_buckets=(16,), greedy=True, decode_chunk=1,
+            )
+            rids = [eng.submit(p, n) for p, n in reqs]
+            out = eng.run()
+            return {i: out[r].tokens.tolist() for i, r in enumerate(rids)}
+
+        ref = run_fixed1()
+        eng = ContinuousBatchingEngine(
+            m, params, n_slots=3, block_size=8, n_blocks=49,
+            prompt_buckets=(16,), greedy=True, decode_chunk="auto",
+        )
+        for round_ in range(2):  # cold, then warm-tuner
+            rids = [eng.submit(p, n) for p, n in reqs]
+            out = eng.run()
+            got = {i: out[r].tokens.tolist() for i, r in enumerate(rids)}
+            assert got == ref, f"auto-chunk mismatch on round {round_}"
+            assert len(eng.free_blocks) == 48
+
+    def test_host_sync_bound_per_generated_token(self):
+        """Host-sync regression guard: with decode_chunk=K the engine may
+        block on at most one device->host transfer per K decode steps (one
+        drain per chunk) plus one per admission round — NOT one per token,
+        the round-5 loop's failure mode. At full slot occupancy that is
+        <= 1/K transfers per generated token."""
+        m, params = small_model()
+        chunk, n, S = 4, 16, 4
+        reqs = [(np.arange(6), n) for _ in range(2 * S)]  # uniform: slots stay full
+        eng = ContinuousBatchingEngine(
+            m, params, n_slots=S, block_size=8, n_blocks=S * 16 + 1,
+            prompt_buckets=(16,), greedy=True, decode_chunk=chunk,
+        )
+        rids = [eng.submit(p, n_) for p, n_ in reqs]
+        out = eng.run()
+        gen = sum(len(out[r].tokens) for r in rids)
+        assert gen == len(reqs) * n
+        # every drain covers a whole chunk of decode steps
+        assert eng.decode_drains * chunk == eng.decode_steps
+        assert eng.decode_launches == eng.decode_drains
+        # total blocking transfers (drains + admission syncs) stay under
+        # one per chunk-of-generated-tokens
+        assert eng.host_transfers <= gen / chunk
+
     def test_chunked_with_eos_discards_tail(self):
         m, params = small_model()
         # find the greedy continuation, then use its SECOND token as eos:
-        # the chunked engine must stop after it even mid-chunk
+        # the chunked engine must stop at its FIRST occurrence even
+        # mid-chunk (the greedy continuation may repeat a token, so the
+        # expected cut is the first index of that value, not index 1)
         eng = ContinuousBatchingEngine(
             m, params, n_slots=1, block_size=8, n_blocks=17,
             prompt_buckets=(16,), greedy=True,
@@ -480,6 +540,7 @@ class TestChunkedDecode:
         rid = eng.submit(np.arange(5), 8)
         ref = eng.run()[rid].tokens
         eos = int(ref[1])
+        cut = ref.tolist().index(eos) + 1
         eng2 = ContinuousBatchingEngine(
             m, params, n_slots=1, block_size=8, n_blocks=17,
             prompt_buckets=(16,), greedy=True, eos_id=eos, decode_chunk=4,
@@ -487,7 +548,7 @@ class TestChunkedDecode:
         rid2 = eng2.submit(np.arange(5), 8)
         out = eng2.run()[rid2]
         assert out.finished_reason == "eos"
-        assert out.tokens.tolist() == ref[:2].tolist()
+        assert out.tokens.tolist() == ref[:cut].tolist()
         assert len(eng2.free_blocks) == 16
 
 
