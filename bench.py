@@ -1059,15 +1059,26 @@ def _per_end_to_end(jax) -> tuple[dict, float]:
 
 
 def bench_per(report: bool = True) -> dict:
-    """BENCH_MODE=per: on-device prioritized sampling vs the host C++
-    segment tree (BASELINE.md config #3's explicit target: on-device PER
-    >= host tree). One cycle = sample a batch by priority + write new
-    priorities back. The device side runs the jit-resident
-    PrioritizedSampler (two-level prefix sum + searchsorted); the host side
-    runs the native C++ SumSegmentTree (set batch + prefix-search batch).
-    ``vs_baseline`` = host_time / device_time (>1 means on-device wins).
-    The ``e2e_*`` fields compare whole fused SAC train steps with the PER
-    sampler in-program vs host-tree-in-the-loop (``_per_end_to_end``)."""
+    """BENCH_MODE=per: on-device prioritized replay vs the host C++ segment
+    tree (BASELINE.md config #3's target: on-device PER >= host tree),
+    measured three ways:
+
+    - **device**: the flat level-array PrioritizedSampler fully in-program —
+      the fused ``sample_and_update`` cycle (sample → gather the batch →
+      td-error → priority write-back, all inside one ``fori_loop``), plus
+      sample-only and update-only splits;
+    - **host pure loop**: the native SumSegmentTree driven entirely
+      host-side, device never involved — the sampler microcosm (this is
+      what the old bench measured, kept for transparency);
+    - **host in-program**: the tree serving a DEVICE learner, which is what
+      a real trainer pays — indices upload, the device gathers the batch
+      and produces td-errors, those download (blocking) to update the tree.
+
+    The headline ``per_on_device_speedup_vs_host_tree`` is
+    host_in-program / device_fused: both sides do the same work (sample by
+    priority, gather, derive new priorities, write back); only the sampler
+    placement differs. ``e2e_*`` fields compare whole fused SAC train
+    steps both ways (``_per_end_to_end``)."""
     jax = _setup_jax()
     import jax.numpy as jnp
     import numpy as np
@@ -1078,61 +1089,338 @@ def bench_per(report: bool = True) -> dict:
     capacity = _T(smoke=4096, cpu=1 << 16, full=1 << 20)
     batch = 256
     inner = _T(smoke=5, cpu=20, full=50)  # cycles per timed call
+    reps = _T(smoke=2, cpu=5, full=5)  # timed calls; best-of taken
     sampler = PrioritizedSampler()
-    sstate = sampler.init(capacity)
     key = jax.random.key(0)
     prio0 = jax.random.uniform(key, (capacity,)) + 0.01
-    sstate = sstate.set("priorities", prio0)
+    # initialize through the public API so both levels of the sum-tree are
+    # consistent (writing raw "priorities" into the state would desync the
+    # block sums — the old bench's init bug)
+    sstate = sampler.init(capacity)
+    sstate = sampler.update_priority(
+        sstate, jnp.arange(capacity), prio0, indices_sorted=True
+    )
     size = jnp.asarray(capacity, jnp.int32)
+    # stand-in stored transitions: the rows a learner gathers per sample
+    data = jax.random.normal(jax.random.key(1), (capacity, 8), jnp.float32)
+
+    def fake_td(idx):
+        return jnp.abs(data[idx].sum(axis=-1)) + 0.01
 
     @jax.jit
-    def device_cycles(sstate, key):
+    def fused_cycles(sstate, key):
+        def body(_, carry):
+            sstate, key = carry
+            key, k1 = jax.random.split(key)
+            _idx, _info, sstate = sampler.sample_and_update(
+                sstate, k1, batch, size, capacity, lambda i, _info: fake_td(i)
+            )
+            return sstate, key
+
+        return jax.lax.fori_loop(0, inner, body, (sstate, key))
+
+    @jax.jit
+    def sample_cycles(sstate, key):
         def body(_, carry):
             sstate, key = carry
             key, k1, k2 = jax.random.split(key, 3)
-            idx, info, sstate = sampler.sample(sstate, k1, batch, size, capacity)
+            idx, _info, sstate = sampler.sample(sstate, k1, batch, size, capacity)
+            # poke: XLA hoists loop-invariant work (the level cumsum, the
+            # row gather) out of fori_loop when the state never changes —
+            # touching one idx-dependent leaf keeps every iteration live
+            tiny = jax.random.uniform(k2, ()) * 1e-30
+            sstate = sstate.replace(
+                priorities=sstate["priorities"].at[idx[0]].add(tiny),
+                esum=sstate["esum"].at[idx[0] // sampler.fanout].add(tiny),
+            )
+            return sstate, key
+
+        return jax.lax.fori_loop(0, inner, body, (sstate, key))
+
+    @jax.jit
+    def update_cycles(sstate, key):
+        def body(_, carry):
+            sstate, key = carry
+            key, k1, k2 = jax.random.split(key, 3)
+            idx = jax.random.randint(k1, (batch,), 0, capacity)
             newp = jax.random.uniform(k2, (batch,)) + 0.01
             sstate = sampler.update_priority(sstate, idx, newp)
             return sstate, key
+
         return jax.lax.fori_loop(0, inner, body, (sstate, key))
 
-    tc0 = time.perf_counter()
-    out_state, _ = device_cycles(sstate, key)
-    jax.block_until_ready(out_state["priorities"])
-    compile_s = time.perf_counter() - tc0
-    t0 = time.perf_counter()
-    out_state, _ = device_cycles(sstate, key)
-    jax.block_until_ready(out_state["priorities"])
-    t_dev = (time.perf_counter() - t0) / inner
+    compile_s = 0.0
 
+    def time_device(fn):
+        nonlocal compile_s
+        t0 = time.perf_counter()
+        out, _ = fn(sstate, key)
+        jax.block_until_ready(out["priorities"])
+        compile_s += time.perf_counter() - t0
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            out, _ = fn(sstate, key)
+            jax.block_until_ready(out["priorities"])
+            best = min(best, (time.perf_counter() - t0) / inner)
+        return best
+
+    t_fused = time_device(fused_cycles)
+    t_sample = time_device(sample_cycles)
+    t_update = time_device(update_cycles)
+
+    # -- host comparators -----------------------------------------------------
+    alpha, beta, eps_p = sampler.alpha, sampler.beta0, sampler.eps
     tree = SumSegmentTree(capacity)
+    pa0 = (np.asarray(prio0, np.float64) + eps_p) ** alpha
+    tree[np.arange(capacity)] = pa0
+    prios = pa0.copy()  # host mirror of p^alpha (the tree has no read)
     rng = np.random.default_rng(0)
-    tree[np.arange(capacity)] = np.asarray(prio0, np.float64) ** sampler.alpha
-    idx = None
-    t0 = time.perf_counter()
-    for _ in range(inner):
+    consume = jax.jit(fake_td)
+    tc0 = time.perf_counter()
+    jax.block_until_ready(consume(jnp.arange(batch)))
+    compile_s += time.perf_counter() - tc0
+
+    def host_cycle(in_program: bool):
         us = rng.uniform(0, tree.reduce(), batch)
         idx = tree.scan(us)
-        newp = rng.uniform(0.01, 1.01, batch) ** sampler.alpha
-        tree[idx] = newp
-    t_host = (time.perf_counter() - t0) / inner
+        p = np.maximum(prios[idx], 1e-12)
+        w = (capacity * p / tree.reduce()) ** (-beta)
+        w = w / w.max()  # IS weights, same normalization as the device side
+        if in_program:
+            # upload indices, device gathers the batch + computes td-errors,
+            # download them — the two boundary crossings a device learner
+            # with a host-side tree cannot avoid
+            td = np.asarray(consume(jnp.asarray(idx, jnp.int32)))
+        else:
+            td = rng.uniform(0.01, 1.01, batch)
+        pa = (np.abs(td) + eps_p) ** alpha
+        prios[idx] = pa
+        tree[idx] = pa
+
+    def time_host(in_program: bool):
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            for _ in range(inner):
+                host_cycle(in_program)
+            best = min(best, (time.perf_counter() - t0) / inner)
+        return best
+
+    t_host_pure = time_host(False)
+    t_host_inprog = time_host(True)
 
     e2e, e2e_compile = _per_end_to_end(jax)
     compile_s += e2e_compile
     out = {
         "metric": "per_on_device_speedup_vs_host_tree",
-        "value": round(t_host / t_dev, 3),
+        "value": round(t_host_inprog / t_fused, 3),
         "unit": "x",
-        "vs_baseline": round(t_host / t_dev, 3),
-        "device_us_per_cycle": round(t_dev * 1e6, 1),
-        "host_us_per_cycle": round(t_host * 1e6, 1),
+        "vs_baseline": round(t_host_inprog / t_fused, 3),
+        "device_fused_us_per_cycle": round(t_fused * 1e6, 1),
+        "device_sample_us_per_cycle": round(t_sample * 1e6, 1),
+        "device_update_us_per_cycle": round(t_update * 1e6, 1),
+        "host_inprogram_us_per_cycle": round(t_host_inprog * 1e6, 1),
+        "host_pure_loop_us_per_cycle": round(t_host_pure * 1e6, 1),
+        "host_pure_loop_ratio": round(t_host_pure / t_fused, 3),
         "native_tree": bool(getattr(tree, "IS_NATIVE", False)),
         "capacity": capacity,
         "batch": batch,
+        "fanout": sampler.fanout,
         "compile_s": round(compile_s, 2),
         "error": None,
     }
     out.update(e2e)
+    out.update(_platform_tag(jax))
+    if report:
+        print(json.dumps(out), flush=True)
+    return out
+
+
+def bench_async_collect(report: bool = True) -> dict:
+    """BENCH_MODE=async_collect: overlapped vs serialized off-policy SAC on
+    host envs. Async = AsyncHostCollector + AsyncOffPolicyTrainer
+    (background env threads feeding a bounded queue, donated K-update
+    programs on the device side); sync = the SAME envs, policy, loss, and
+    K-update program driven serially through HostCollector (collect blocks,
+    then update blocks — nothing overlaps). Reports env-steps/s and
+    grad-updates/s for both paths, their ratios (>1 = async wins), and a
+    device-utilization estimate: fraction of wall spent inside the K-update
+    program, derived from a warm standalone timing of that same program.
+    ``compile_s`` covers both paths' warmup; timed windows are
+    compile-free."""
+    jax = _setup_jax()
+    import jax.numpy as jnp
+    import numpy as np
+
+    from rl_tpu.collectors import AsyncHostCollector, HostCollector, ThreadedEnvPool
+    from rl_tpu.data import ArrayDict
+    from rl_tpu.data.replay import DeviceStorage, ReplayBuffer
+    from rl_tpu.data.replay.samplers import PrioritizedSampler
+    from rl_tpu.envs.libs import GymEnv
+    from rl_tpu.modules import (
+        MLP,
+        ConcatMLP,
+        NormalParamExtractor,
+        ProbabilisticActor,
+        TDModule,
+        TDSequential,
+        TanhNormal,
+    )
+    from rl_tpu.objectives import SACLoss
+    from rl_tpu.trainers import AsyncOffPolicyTrainer, OffPolicyConfig
+
+    n_envs = _T(smoke=2, cpu=8, full=16)
+    fpb = _T(smoke=32, cpu=128, full=256)
+    total = _T(smoke=96, cpu=1536, full=4096)
+    utd = _T(smoke=1, cpu=2, full=4)
+    bs = _T(smoke=32, cpu=128, full=256)
+    cap = 1 << 14
+    cells = (64, 64)
+    act_dim = 1
+
+    def env_fn():
+        return GymEnv("Pendulum-v1")
+
+    actor = ProbabilisticActor(
+        TDSequential(
+            TDModule(MLP(out_features=2 * act_dim, num_cells=cells),
+                     ["observation"], ["raw"]),
+            TDModule(NormalParamExtractor(), ["raw"], ["loc", "scale"]),
+        ),
+        TanhNormal,
+        dist_keys=("loc", "scale"),
+    )
+    sac = SACLoss(actor, ConcatMLP(out_features=1, num_cells=cells))
+
+    def policy(p, td, k):
+        return sac.actor(p["actor"], td, k)
+
+    cfg = OffPolicyConfig(batch_size=bs, utd_ratio=utd, learning_rate=3e-4)
+    compile_s = 0.0
+
+    # -- async path ------------------------------------------------------------
+    pool_a = ThreadedEnvPool([env_fn for _ in range(n_envs)])
+    coll_a = AsyncHostCollector(pool_a, policy, frames_per_batch=fpb, seed=0)
+    tr = AsyncOffPolicyTrainer(
+        coll_a, sac, ReplayBuffer(DeviceStorage(cap), PrioritizedSampler()),
+        cfg, priority_key="td_error",
+    )
+    ts = tr.init(jax.random.key(0))
+    tc0 = time.perf_counter()
+    for ts, _m in tr.train(ts, total_frames=2 * fpb):  # compile pass
+        pass
+    jax.block_until_ready(ts["params"])
+    compile_s += time.perf_counter() - tc0
+
+    steps0 = coll_a.stats()["env_steps"]
+    updates0 = int(ts["update_count"])
+    t0 = time.perf_counter()
+    for ts, _m in tr.train(ts, total_frames=total):
+        pass
+    jax.block_until_ready(ts["params"])
+    wall_async = time.perf_counter() - t0
+    frames_async = coll_a.stats()["env_steps"] - steps0
+    updates_async = int(ts["update_count"]) - updates0
+    stats_a = coll_a.stats()
+
+    # warm standalone timing of the K-update program (donates + consumes the
+    # final async state, which is no longer needed)
+    t0 = time.perf_counter()
+    out, m = tr._k_updates(
+        ts["params"], ts["opt"], ts["buffer"], ts["rng"], ts["update_count"]
+    )
+    jax.block_until_ready(m)
+    t_kupd = time.perf_counter() - t0
+    pool_a.close()
+
+    # -- sync path -------------------------------------------------------------
+    pool_s = ThreadedEnvPool([env_fn for _ in range(n_envs)])
+    hc = HostCollector(pool_s, policy, frames_per_batch=fpb, seed=0)
+    # separate AsyncOffPolicyTrainer instance purely as the update/extend
+    # program factory — its collector is never started; the sync loop
+    # drives the SAME jitted K-update program serially
+    coll_dummy = AsyncHostCollector(pool_s, policy, frames_per_batch=fpb)
+    tr_s = AsyncOffPolicyTrainer(
+        coll_dummy, sac, ReplayBuffer(DeviceStorage(cap), PrioritizedSampler()),
+        cfg, priority_key="td_error",
+    )
+    ts_s = tr_s.init(jax.random.key(0))
+    scan_len = fpb // n_envs
+
+    def flatten_with_stamps(batch, version, step0):
+        # [T, N] -> [T*N] plus the stamp columns the async writer records,
+        # so both paths share one buffer schema. The actor writes dist
+        # intermediates (loc/scale/raw/sample_log_prob) into the td; the
+        # buffer schema has no slots for them, so keep transition keys only.
+        batch = batch.select("observation", "action", "next")
+        flat = batch.apply(lambda x: x.reshape((-1,) + x.shape[2:]))
+        stamps = ArrayDict(
+            policy_version=jnp.full((fpb,), version, jnp.int32),
+            env_ids=jnp.tile(jnp.arange(n_envs, dtype=jnp.int32), scan_len),
+            step=step0 + jnp.arange(fpb, dtype=jnp.int32),
+        )
+        return flat.set("collector", stamps)
+
+    key = jax.random.key(7)
+
+    def sync_iteration(ts_s, key, version, step0):
+        key, k = jax.random.split(key)
+        batch = hc.collect(ts_s["params"], k)  # serial: envs block the loop
+        flat = flatten_with_stamps(batch, version, step0)
+        bstate = tr_s._extend(ts_s["buffer"], flat)
+        out, _m = tr_s._k_updates(
+            ts_s["params"], ts_s["opt"], bstate, ts_s["rng"], ts_s["update_count"]
+        )
+        params, opt_state, bstate, rng, uc = out
+        return {
+            "params": params, "opt": opt_state, "buffer": bstate,
+            "rng": rng, "update_count": uc,
+        }, key
+
+    tc0 = time.perf_counter()
+    ts_s, key = sync_iteration(ts_s, key, 0, 0)  # compile pass
+    jax.block_until_ready(ts_s["params"])
+    compile_s += time.perf_counter() - tc0
+    n_iters = total // fpb
+    t0 = time.perf_counter()
+    for i in range(n_iters):
+        ts_s, key = sync_iteration(ts_s, key, i + 1, (i + 1) * fpb)
+    jax.block_until_ready(ts_s["params"])
+    wall_sync = time.perf_counter() - t0
+    frames_sync = n_iters * fpb
+    updates_sync = n_iters * utd
+    pool_s.close()
+
+    fps_async = frames_async / wall_async
+    fps_sync = frames_sync / wall_sync
+    ups_async = updates_async / wall_async
+    ups_sync = updates_sync / wall_sync
+    out = {
+        "metric": "async_collect_env_steps_per_sec",
+        "value": round(fps_async, 1),
+        "unit": "env_steps/s",
+        "vs_baseline": round(fps_async / max(fps_sync, 1e-9), 3),
+        "env_steps_per_sec_async": round(fps_async, 1),
+        "env_steps_per_sec_sync": round(fps_sync, 1),
+        "grad_updates_per_sec_async": round(ups_async, 2),
+        "grad_updates_per_sec_sync": round(ups_sync, 2),
+        "async_over_sync_env_steps": round(fps_async / max(fps_sync, 1e-9), 3),
+        "async_over_sync_grad_updates": round(ups_async / max(ups_sync, 1e-9), 3),
+        "device_utilization_async": round(
+            min(1.0, (updates_async / utd) * t_kupd / wall_async), 3
+        ),
+        "device_utilization_sync": round(
+            min(1.0, (updates_sync / utd) * t_kupd / wall_sync), 3
+        ),
+        "straggler_cutoffs": stats_a["straggler_cutoffs"],
+        "harvests": stats_a["harvests"],
+        "n_envs": n_envs,
+        "frames_per_batch": fpb,
+        "utd": utd,
+        "compile_s": round(compile_s, 2),
+        "error": None,
+    }
     out.update(_platform_tag(jax))
     if report:
         print(json.dumps(out), flush=True)
@@ -1234,7 +1522,7 @@ def bench_all():
     print(json.dumps({"probe": probe}), flush=True)
 
     weights = {"ppo": 2.0, "rlhf": 1.4, "pixel": 1.2, "hopper": 1.0,
-               "sac": 1.0, "per": 1.0, "serve": 0.8}
+               "sac": 1.0, "per": 1.0, "async_collect": 0.8, "serve": 0.8}
     deadline = _START + _TIMEOUT - 30.0  # safety margin for the final print
     pending = list(weights)
     results: dict = {}
@@ -1336,6 +1624,7 @@ if __name__ == "__main__":
             "rlhf": bench_rlhf,
             "sac": bench_sac,
             "per": bench_per,
+            "async_collect": bench_async_collect,
         }[mode]()
         timer.cancel()
     except BaseException:  # always emit the JSON line, whatever happened

@@ -6,6 +6,7 @@ and eager chaining of those imports is what broke the round-1 bench when the
 backend was unreachable — importing *anything* must not import *everything*.
 """
 
+from .async_host import AsyncHostCollector
 from .host import HostCollector, ProcessEnvPool, ThreadedEnvPool, compact_collected
 from .distributed import MeshCollector
 from .single import Collector, CollectorState
@@ -14,6 +15,7 @@ __all__ = [
     "MeshCollector",
     "Collector",
     "CollectorState",
+    "AsyncHostCollector",
     "HostCollector",
     "compact_collected",
     "ProcessEnvPool",

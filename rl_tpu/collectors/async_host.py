@@ -1,0 +1,317 @@
+"""Sebulba-style decoupled host collection (arXiv:2104.06272).
+
+:class:`HostCollector` serializes the pipeline: every env must finish its
+step before the batched policy call, and the trainer cannot touch the
+device while the host waits on the slowest simulator. This module splits
+the two halves onto different threads — a background actor thread steps the
+env pool and batches transitions, while the caller's thread keeps the
+device busy with (donated, fused) gradient updates:
+
+- **first-come batching**: envs are harvested as their steps complete
+  (``pool.step_ready``), not in lockstep; a fast env can contribute many
+  transitions to a batch while a slow one contributes none.
+- **straggler cutoff**: a harvest fires once ``min_ready_fraction`` of
+  in-flight envs are done, or after ``straggler_wait_s`` — slow workers
+  keep cooking and join a later batch instead of stalling everyone
+  (the Podracer/Sebulba actor-pool trick).
+- **bounded write-queue**: completed batches are handed over through a
+  ``queue.Queue(max_pending_batches)``; when the trainer falls behind, the
+  actor thread blocks on ``put`` — backpressure, not unbounded memory.
+- **per-item staleness stamps**: every transition records the
+  ``policy_version`` it was acted with (plus env id and a global step
+  counter) under ``("collector", ...)``; ``StalenessAwareSampler`` reads
+  the stamp on write so replay can down-weight stale experience.
+
+The reference analog is the prefetch thread inside torchrl's
+``ReplayBuffer`` plus ``aSyncDataCollector`` (torchrl/collectors/
+collectors.py:3013); here the split is at the env/device boundary instead,
+because on TPU the expensive half is the XLA program, not the sampler.
+"""
+
+from __future__ import annotations
+
+import math
+import queue
+import threading
+import time
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..data import ArrayDict
+from ..utils.seeding import seed_generator
+
+__all__ = ["AsyncHostCollector"]
+
+
+class AsyncHostCollector:
+    """Background-thread collector over a host env pool.
+
+    ``policy``: ``(params, td, key) -> td`` over the batched observation
+    ArrayDict, same contract as :class:`HostCollector`; ``None`` collects
+    spec-uniform random actions. Batches are flat ``[frames_per_batch]``
+    transition ArrayDicts in the standard ``{..., "next": ...}`` layout —
+    ready for ``ReplayBuffer.extend`` without reshaping.
+
+    Usage::
+
+        collector = AsyncHostCollector(pool, policy, frames_per_batch=256)
+        collector.start(params)
+        for batch in collector.batches(total_frames=10_000):
+            bstate = buffer.extend(bstate, batch, n=collector.frames_per_batch)
+            ts = k_updates(ts)                      # device runs; envs step
+            collector.update_params(ts["params"])   # bump policy_version
+        collector.stop()
+    """
+
+    def __init__(
+        self,
+        pool: Any,
+        policy: Callable | None = None,
+        frames_per_batch: int = 256,
+        seed: int = 0,
+        max_pending_batches: int = 2,
+        min_ready_fraction: float = 0.5,
+        straggler_wait_s: float = 0.01,
+        poll_interval_s: float = 2e-4,
+    ):
+        self.pool = pool
+        self.policy = jax.jit(policy) if policy is not None else None
+        self.frames_per_batch = frames_per_batch
+        self.max_pending_batches = max_pending_batches
+        self.min_ready_fraction = min_ready_fraction
+        self.straggler_wait_s = straggler_wait_s
+        self.poll_interval_s = poll_interval_s
+        self._seed = seed
+        self._queue: queue.Queue = queue.Queue(maxsize=max_pending_batches)
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._error: BaseException | None = None
+        # params handoff: the trainer publishes (params, version) under a
+        # lock; the actor thread snapshots the pair at each send phase so a
+        # whole policy call uses one consistent version
+        self._lock = threading.Lock()
+        self._params: Any = None
+        self._version = 0
+        # stats (actor-thread written, reader tolerates slight races)
+        self._env_steps = 0
+        self._batches_emitted = 0
+        self._harvests = 0
+        self._straggler_cutoffs = 0
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def start(self, params: Any = None, key: jax.Array | None = None) -> "AsyncHostCollector":
+        if self._thread is not None:
+            raise RuntimeError("AsyncHostCollector already started")
+        self._params = params
+        self._key = key if key is not None else jax.random.PRNGKey(self._seed)
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="rl-tpu-async-collector", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+        # drain so a re-start doesn't see stale batches
+        while True:
+            try:
+                self._queue.get_nowait()
+            except queue.Empty:
+                break
+
+    def __enter__(self):
+        if self._thread is None:
+            self.start(self._params)
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    # -- trainer-facing API ---------------------------------------------------
+
+    def update_params(self, params: Any, version: int | None = None) -> None:
+        """Publish fresh policy params; subsequent transitions are stamped
+        with the bumped ``policy_version``."""
+        with self._lock:
+            self._params = params
+            self._version = self._version + 1 if version is None else int(version)
+
+    @property
+    def policy_version(self) -> int:
+        return self._version
+
+    def get_batch(self, timeout: float | None = None) -> ArrayDict | None:
+        """Pop the next completed batch (first-come order). Returns ``None``
+        on timeout. Re-raises any actor-thread failure."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            if self._error is not None:
+                raise RuntimeError("AsyncHostCollector actor thread failed") from self._error
+            try:
+                return self._queue.get(timeout=0.05)
+            except queue.Empty:
+                if self._thread is None or not self._thread.is_alive():
+                    if self._error is not None:
+                        continue  # surface the error on the next spin
+                    return None
+                if deadline is not None and time.monotonic() >= deadline:
+                    return None
+
+    def batches(self, total_frames: int):
+        """Yield batches until ``total_frames`` transitions were delivered."""
+        delivered = 0
+        while delivered < total_frames:
+            b = self.get_batch()
+            if b is None:
+                return
+            delivered += self.frames_per_batch
+            yield b
+
+    def stats(self) -> dict:
+        return {
+            "env_steps": self._env_steps,
+            "batches_emitted": self._batches_emitted,
+            "harvests": self._harvests,
+            "straggler_cutoffs": self._straggler_cutoffs,
+            "policy_version": self._version,
+            "queue_depth": self._queue.qsize(),
+        }
+
+    # -- actor thread ---------------------------------------------------------
+
+    def _actions_for(self, obs: list[dict]) -> tuple[np.ndarray, int]:
+        """One batched policy call over ALL current observations (static
+        [n] shape → single jit trace), indexed down to the envs that need
+        an action. Rows for mid-step envs hold their last obs and are
+        discarded — constant-shape inference beats per-subset recompiles."""
+        n = self.pool.num_envs
+        keys = obs[0].keys()
+        td = ArrayDict({k: jnp.asarray(np.stack([o[k] for o in obs])) for k in keys})
+        self._key, k_act = jax.random.split(self._key)
+        with self._lock:
+            params, version = self._params, self._version
+        if self.policy is None:
+            actions = self.pool.action_spec.rand(k_act, (n,))
+        else:
+            actions = self.policy(params, td, k_act)["action"]
+        return np.asarray(actions), version
+
+    def _run(self) -> None:
+        try:
+            self._collect_loop()
+        except BaseException as e:  # surfaced to the trainer via get_batch
+            self._error = e
+
+    def _collect_loop(self) -> None:
+        pool = self.pool
+        n = pool.num_envs
+        min_ready = max(1, math.ceil(self.min_ready_fraction * n))
+        obs = pool.reset(seed=self._seed)
+        pending = [False] * n
+        sent_action = [None] * n
+        sent_obs: list[dict | None] = [None] * n
+        sent_version = [0] * n
+        needs_send = list(range(n))
+        records: list[tuple] = []
+        last_harvest = time.monotonic()
+
+        while not self._stop.is_set():
+            # -- send phase: dispatch actions to every env holding fresh obs
+            if needs_send:
+                actions, version = self._actions_for(obs)
+                for i in needs_send:
+                    sent_action[i] = actions[i]
+                    sent_obs[i] = obs[i]
+                    sent_version[i] = version
+                    pool.async_step_send(i, actions[i])
+                    pending[i] = True
+                needs_send = []
+
+            # -- harvest phase: first-come with straggler cutoff
+            ready = [i for i in range(n) if pending[i] and pool.step_ready(i)]
+            in_flight = sum(pending)
+            now = time.monotonic()
+            if not ready or (
+                len(ready) < min(min_ready, in_flight)
+                and now - last_harvest < self.straggler_wait_s
+            ):
+                time.sleep(self.poll_interval_s)
+                continue
+            if len(ready) < in_flight:
+                self._straggler_cutoffs += 1
+            self._harvests += 1
+            last_harvest = now
+
+            for i in ready:
+                next_obs, reward, term, trunc = pool.async_step_recv(i)[:4]
+                pending[i] = False
+                records.append(
+                    (
+                        sent_obs[i],
+                        sent_action[i],
+                        next_obs,
+                        np.float32(reward),
+                        bool(term),
+                        bool(trunc),
+                        sent_version[i],
+                        i,
+                        self._env_steps,
+                    )
+                )
+                self._env_steps += 1
+                if term or trunc:
+                    self._seed = seed_generator(self._seed)
+                    obs[i] = pool.reset_one(i, self._seed)
+                else:
+                    obs[i] = next_obs
+                needs_send.append(i)
+
+            # -- emit phase: hand over full batches through the bounded queue
+            while len(records) >= self.frames_per_batch:
+                batch = self._build_batch(records[: self.frames_per_batch])
+                records = records[self.frames_per_batch :]
+                if not self._put(batch):
+                    return
+
+    def _put(self, batch: ArrayDict) -> bool:
+        """Blocking put with stop-awareness — this is the backpressure point:
+        a full queue parks the actor thread (envs idle) until the trainer
+        drains a batch."""
+        while not self._stop.is_set():
+            try:
+                self._queue.put(batch, timeout=0.05)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _build_batch(self, recs: list[tuple]) -> ArrayDict:
+        keys = recs[0][0].keys()
+        obs = {k: jnp.asarray(np.stack([r[0][k] for r in recs])) for k in keys}
+        nxt = {k: jnp.asarray(np.stack([r[2][k] for r in recs])) for k in keys}
+        td = ArrayDict(obs)
+        td = td.set("action", jnp.asarray(np.stack([r[1] for r in recs])))
+        next_td = ArrayDict(nxt).update(
+            ArrayDict(
+                reward=jnp.asarray(np.asarray([r[3] for r in recs], np.float32)),
+                terminated=jnp.asarray(np.asarray([r[4] for r in recs])),
+                truncated=jnp.asarray(np.asarray([r[5] for r in recs])),
+                done=jnp.asarray(np.asarray([r[4] or r[5] for r in recs])),
+            )
+        )
+        stamps = ArrayDict(
+            policy_version=jnp.asarray(np.asarray([r[6] for r in recs], np.int32)),
+            env_ids=jnp.asarray(np.asarray([r[7] for r in recs], np.int32)),
+            step=jnp.asarray(np.asarray([r[8] for r in recs], np.int32)),
+        )
+        self._batches_emitted += 1
+        return td.set("next", next_td).set("collector", stamps)

@@ -94,6 +94,12 @@ class ProcessEnvPool:
     def async_step_recv(self, i: int):
         return self._conns[i].recv()
 
+    def step_ready(self, i: int) -> bool:
+        """Non-blocking: has worker ``i``'s in-flight step finished? Lets an
+        async collector harvest fast envs first and leave stragglers
+        cooking (first-come batching / straggler cutoff)."""
+        return self._conns[i].poll()
+
     def step_wait(self, actions) -> list[tuple]:
         for i in range(self.num_envs):
             self.async_step_send(i, actions[i])
@@ -160,6 +166,11 @@ class ThreadedEnvPool:
         out = self._futures[i].result()
         self._futures[i] = None
         return out
+
+    def step_ready(self, i: int) -> bool:
+        """Non-blocking readiness probe (see ProcessEnvPool.step_ready)."""
+        fut = self._futures[i]
+        return fut is not None and fut.done()
 
     def step_wait(self, actions: np.ndarray) -> list[tuple]:
         for i in range(self.num_envs):

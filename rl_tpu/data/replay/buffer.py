@@ -86,6 +86,24 @@ class ReplayBuffer:
         sstate = self.sampler.on_write(state["sampler"], idx, items)
         return ArrayDict(storage=bstorage, sampler=sstate, writer=wstate)
 
+    def make_extend(self, n: int, donate: bool = True) -> Callable[[ArrayDict, ArrayDict], ArrayDict]:
+        """Compiled chunked-write entry point for host-driven producers.
+
+        Un-jitted ``extend`` called from a host loop (e.g. draining an
+        ``AsyncHostCollector`` queue) dispatches one device op per leaf per
+        chunk — writer arange, a scatter per storage leaf, sampler
+        bookkeeping. This returns a jitted closure over a fixed chunk size
+        so each chunk is ONE fused XLA program, with the old buffer state
+        donated (the scatter updates in place instead of copying the whole
+        ring). The chunk size is static: feed it batches of exactly ``n``
+        items (the async collector's ``frames_per_batch``).
+        """
+        fn = jax.jit(
+            lambda state, items: self.extend(state, items, n=n),
+            donate_argnums=(0,) if donate else (),
+        )
+        return fn
+
     # -- reads ----------------------------------------------------------------
 
     def sample(

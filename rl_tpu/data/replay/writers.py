@@ -33,7 +33,17 @@ class RoundRobinWriter(Writer):
 
     def assign(self, wstate, bstate, items, n, capacity):
         cursor = bstate["cursor"]
-        idx = (cursor + jnp.arange(n)) % capacity
+        offs = jnp.arange(n)
+        idx = (cursor + offs) % capacity
+        if n > capacity:
+            # a chunk lapping the ring would scatter duplicate indices, and
+            # XLA's .at[].set winner among duplicates is unspecified — route
+            # all but the trailing `capacity` items to an always-out-of-bounds
+            # sentinel instead (scatter drops OOB indices), so later items
+            # deterministically win. INT32_MAX rather than `capacity` because
+            # PER's leaf array is padded past capacity and a write at
+            # `capacity` would leak mass into a pad slot.
+            idx = jnp.where(offs < n - capacity, jnp.iinfo(jnp.int32).max, idx)
         new_b = bstate.replace(
             cursor=(cursor + n) % capacity,
             size=jnp.minimum(bstate["size"] + n, capacity),

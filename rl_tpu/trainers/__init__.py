@@ -1,4 +1,4 @@
-from .off_policy import OffPolicyConfig, OffPolicyProgram
+from .off_policy import AsyncOffPolicyTrainer, OffPolicyConfig, OffPolicyProgram
 from .on_policy import OnPolicyConfig, OnPolicyProgram
 from .trainer import (
     CountFramesLog,
@@ -13,6 +13,7 @@ from .trainer import (
 __all__ = [
     "OnPolicyConfig",
     "OnPolicyProgram",
+    "AsyncOffPolicyTrainer",
     "OffPolicyConfig",
     "OffPolicyProgram",
     "Trainer",

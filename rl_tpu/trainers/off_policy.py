@@ -22,7 +22,7 @@ from ..data import ArrayDict, ReplayBuffer
 from ..collectors.single import Collector
 from ..objectives.common import LossModule, SoftUpdate
 
-__all__ = ["OffPolicyConfig", "OffPolicyProgram"]
+__all__ = ["OffPolicyConfig", "OffPolicyProgram", "AsyncOffPolicyTrainer"]
 
 
 @dataclasses.dataclass
@@ -40,7 +40,57 @@ class OffPolicyConfig:
     policy_key: str = "actor"  # params entry the delay applies to
 
 
-class OffPolicyProgram:
+class _GradUpdateMixin:
+    """The per-gradient-step body shared by the fused single-program trainer
+    (:class:`OffPolicyProgram`) and the overlapped host-env trainer
+    (:class:`AsyncOffPolicyTrainer`): sample → grad → (delayed) apply →
+    polyak → PER priority write-back, shaped as a ``lax.scan`` body so K
+    updates fuse into one XLA program.
+
+    Requires ``self.loss / self.buffer / self.config / self.optimizer /
+    self.target_update / self.priority_key``.
+    """
+
+    def _update_body(self, carry, xs):
+        params, opt_state, bstate = carry
+        upd_key, upd_idx = xs
+        k_sample, k_loss = jax.random.split(upd_key)
+        mb, bstate = self.buffer.sample(bstate, k_sample, self.config.batch_size)
+        loss_val, grads, metrics = self.loss.grad(params, mb, k_loss)
+        if self.config.policy_delay > 1:
+            do_policy = (upd_idx % self.config.policy_delay) == 0
+            pk = self.config.policy_key
+            if pk in grads:
+                grads = dict(grads)
+                grads[pk] = jax.tree.map(
+                    lambda g: g * do_policy.astype(g.dtype), grads[pk]
+                )
+        updates, opt_state = self.optimizer.update(
+            grads, opt_state, self.loss.trainable(params)
+        )
+        if self.config.policy_delay > 1 and self.config.policy_key in updates:
+            # Adam emits nonzero updates even for zero grads (moment
+            # decay) — mask the updates too so the policy truly freezes
+            updates = dict(updates)
+            updates[self.config.policy_key] = jax.tree.map(
+                lambda u: u * do_policy.astype(u.dtype),
+                updates[self.config.policy_key],
+            )
+        trainable = optax.apply_updates(self.loss.trainable(params), updates)
+        params = self.loss.merge(trainable, params)
+        params = self.target_update(params)
+        if self.priority_key is not None and self.priority_key in metrics:
+            bstate = self.buffer.update_priority(
+                bstate, mb["index"], metrics[self.priority_key]
+            )
+        # per-sample tensors don't reduce across the scan: drop them
+        scalar_metrics = ArrayDict(
+            {k: v for k, v in metrics.items() if jnp.ndim(v) == 0}
+        ).set("loss", loss_val)
+        return (params, opt_state, bstate), scalar_metrics
+
+
+class OffPolicyProgram(_GradUpdateMixin):
     """Bundles collector + replay buffer + loss + optax into one train step.
 
     Usage::
@@ -158,48 +208,10 @@ class OffPolicyProgram:
             ts["buffer"], flat, n=self.collector.frames_per_batch
         )
 
-        def update(carry, xs):
-            params, opt_state, bstate = carry
-            upd_key, upd_idx = xs
-            k_sample, k_loss = jax.random.split(upd_key)
-            mb, bstate = self.buffer.sample(bstate, k_sample, self.config.batch_size)
-            loss_val, grads, metrics = self.loss.grad(params, mb, k_loss)
-            if self.config.policy_delay > 1:
-                do_policy = (upd_idx % self.config.policy_delay) == 0
-                pk = self.config.policy_key
-                if pk in grads:
-                    grads = dict(grads)
-                    grads[pk] = jax.tree.map(
-                        lambda g: g * do_policy.astype(g.dtype), grads[pk]
-                    )
-            updates, opt_state = self.optimizer.update(
-                grads, opt_state, self.loss.trainable(params)
-            )
-            if self.config.policy_delay > 1 and self.config.policy_key in updates:
-                # Adam emits nonzero updates even for zero grads (moment
-                # decay) — mask the updates too so the policy truly freezes
-                updates = dict(updates)
-                updates[self.config.policy_key] = jax.tree.map(
-                    lambda u: u * do_policy.astype(u.dtype),
-                    updates[self.config.policy_key],
-                )
-            trainable = optax.apply_updates(self.loss.trainable(params), updates)
-            params = self.loss.merge(trainable, params)
-            params = self.target_update(params)
-            if self.priority_key is not None and self.priority_key in metrics:
-                bstate = self.buffer.update_priority(
-                    bstate, mb["index"], metrics[self.priority_key]
-                )
-            # per-sample tensors don't reduce across the scan: drop them
-            scalar_metrics = ArrayDict(
-                {k: v for k, v in metrics.items() if jnp.ndim(v) == 0}
-            ).set("loss", loss_val)
-            return (params, opt_state, bstate), scalar_metrics
-
         rng, *upd_keys = jax.random.split(ts["rng"], self.config.utd_ratio + 1)
         upd_idx = ts["update_count"] + jnp.arange(self.config.utd_ratio)
         (params, opt_state, bstate), metrics = jax.lax.scan(
-            update, (params, ts["opt"], bstate), (jnp.stack(upd_keys), upd_idx)
+            self._update_body, (params, ts["opt"], bstate), (jnp.stack(upd_keys), upd_idx)
         )
         mean_metrics = jax.tree.map(lambda x: x.mean(), metrics)
         mean_metrics = mean_metrics.set("reward_mean", jnp.mean(batch["next", "reward"]))
@@ -220,3 +232,179 @@ class OffPolicyProgram:
             "update_count": ts["update_count"] + self.config.utd_ratio,
         }
         return new_ts, mean_metrics
+
+    def jit_train_step(self, steps_per_call: int = 1, donate: bool = True):
+        """Compile ``train_step`` with the whole train state **donated** and
+        optionally ``steps_per_call`` steps fused per host dispatch.
+
+        Donation lets XLA update the replay ring, optimizer moments, and
+        target nets in place instead of copying them every step — the
+        per-update copy is what capped the SAC device-replay recipe at
+        ~2.5 grad-updates/s. Fusing K steps amortizes the remaining host
+        dispatch overhead; metrics come back averaged over the K steps.
+
+        The returned callable consumes its input state: keep only the
+        returned ``ts``. (Passing a donated ``ts`` twice raises — that is
+        the point.)
+        """
+        if steps_per_call == 1:
+            fn = self.train_step
+        else:
+
+            def fn(ts):
+                def one(ts, _):
+                    return self.train_step(ts)
+
+                ts, metrics = jax.lax.scan(one, ts, None, length=steps_per_call)
+                # nanmean: episode_reward_mean is NaN on batches where no
+                # episode finished; a plain mean would poison the window
+                return ts, jax.tree.map(lambda x: jnp.nanmean(x, axis=0), metrics)
+
+        return jax.jit(fn, donate_argnums=(0,) if donate else ())
+
+
+class AsyncOffPolicyTrainer(_GradUpdateMixin):
+    """Overlapped off-policy trainer: host envs feed a device replay while
+    the device runs donated K-update programs (the Sebulba split,
+    arXiv:2104.06272).
+
+    Three actors, two threads:
+
+    - the :class:`~rl_tpu.collectors.AsyncHostCollector` actor thread steps
+      the env pool and queues flat transition batches (first-come, straggler
+      cutoff, bounded queue);
+    - this thread drains the queue into the device replay through a jitted
+      **donated chunk write** (``ReplayBuffer.make_extend``) and dispatches
+      one jitted **donated K-update** program per batch;
+    - XLA's async dispatch overlaps the two: while the device crunches the
+      K updates, the host loop is already popping/queueing the next batch
+      and the env threads keep stepping.
+
+    Each K-update dispatch publishes fresh params back to the collector,
+    bumping ``policy_version`` — the per-item stamps that
+    ``StalenessAwareSampler`` consumes.
+    """
+
+    def __init__(
+        self,
+        collector,
+        loss: LossModule,
+        buffer: ReplayBuffer,
+        config: OffPolicyConfig = OffPolicyConfig(),
+        priority_key: str | None = None,
+    ):
+        self.collector = collector
+        self.loss = loss
+        self.buffer = buffer
+        self.config = config
+        self.priority_key = priority_key
+        tx = [optax.adam(config.learning_rate)]
+        if config.max_grad_norm is not None:
+            tx.insert(0, optax.clip_by_global_norm(config.max_grad_norm))
+        self.optimizer = optax.chain(*tx)
+        self.target_update = SoftUpdate(loss, tau=config.tau)
+        self._extend = buffer.make_extend(collector.frames_per_batch, donate=True)
+        # donate the big rotating state (optimizer moments + replay ring)
+        # but NOT params: the collector's actor thread keeps a live
+        # reference to the last published params for its policy calls, and
+        # donating them would hand XLA buffers another thread is reading
+        self._k_updates = jax.jit(self._k_updates_impl, donate_argnums=(1, 2))
+
+    # -- state ----------------------------------------------------------------
+
+    def example_item(self) -> ArrayDict:
+        """One zero transition in the collector's batch layout (from the env
+        pool's specs) — fixes the buffer schema before any env has stepped."""
+        pool = self.collector.pool
+        obs = pool.observation_spec.zero(())
+        next_td = obs.update(
+            ArrayDict(
+                reward=jnp.asarray(0.0, jnp.float32),
+                terminated=jnp.asarray(False),
+                truncated=jnp.asarray(False),
+                done=jnp.asarray(False),
+            )
+        )
+        stamps = ArrayDict(
+            policy_version=jnp.asarray(0, jnp.int32),
+            env_ids=jnp.asarray(0, jnp.int32),
+            step=jnp.asarray(0, jnp.int32),
+        )
+        return (
+            obs.set("action", pool.action_spec.zero(()))
+            .set("next", next_td)
+            .set("collector", stamps)
+        )
+
+    def init(self, key: jax.Array) -> dict:
+        k_params, k_rng = jax.random.split(key)
+        example = self.example_item()
+        params = self.loss.init_params(k_params, example.unsqueeze(0))
+        opt_state = self.optimizer.init(self.loss.trainable(params))
+        bstate = self.buffer.init(example)
+        return {
+            "params": params,
+            "opt": opt_state,
+            "buffer": bstate,
+            "rng": k_rng,
+            "update_count": jnp.asarray(0, jnp.int32),
+        }
+
+    # -- device side -----------------------------------------------------------
+
+    def _k_updates_impl(self, params, opt_state, bstate, rng, update_count):
+        k = self.config.utd_ratio
+        rng, *upd_keys = jax.random.split(rng, k + 1)
+        upd_idx = update_count + jnp.arange(k)
+        (params, opt_state, bstate), metrics = jax.lax.scan(
+            self._update_body,
+            (params, opt_state, bstate),
+            (jnp.stack(upd_keys), upd_idx),
+        )
+        out = (params, opt_state, bstate, rng, update_count + k)
+        return out, jax.tree.map(lambda x: x.mean(), metrics)
+
+    # -- host loop -------------------------------------------------------------
+
+    def train(
+        self,
+        ts: dict,
+        total_frames: int,
+        min_frames_before_update: int | None = None,
+    ):
+        """Generator driving the overlapped loop; yields ``(ts, metrics)``
+        per consumed batch (``metrics is None`` during warmup). Starts and
+        stops the collector; the caller owns the env pool."""
+        coll = self.collector
+        fpb = coll.frames_per_batch
+        min_frames = (
+            min_frames_before_update
+            if min_frames_before_update is not None
+            else max(self.config.init_random_frames, self.config.batch_size)
+        )
+        coll.start(ts["params"])
+        frames = 0
+        try:
+            while frames < total_frames:
+                batch = coll.get_batch()
+                if batch is None:
+                    break
+                ts = {**ts, "buffer": self._extend(ts["buffer"], batch)}
+                frames += fpb
+                metrics = None
+                if frames >= min_frames:
+                    out, metrics = self._k_updates(
+                        ts["params"], ts["opt"], ts["buffer"], ts["rng"], ts["update_count"]
+                    )
+                    params, opt_state, bstate, rng, update_count = out
+                    ts = {
+                        "params": params,
+                        "opt": opt_state,
+                        "buffer": bstate,
+                        "rng": rng,
+                        "update_count": update_count,
+                    }
+                    coll.update_params(params)
+                yield ts, metrics
+        finally:
+            coll.stop()

@@ -1,0 +1,410 @@
+"""Overlapped off-policy pipeline tests (PR: Sebulba-style decoupled
+collection + device-PER rewrite): device-vs-host PER distribution parity,
+staleness-stamp monotonicity, AsyncHostCollector behavior, a host-transfer
+bound on the fused PER cycle, and async-vs-sync SAC smoke/throughput."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from rl_tpu.collectors import AsyncHostCollector, HostCollector, ThreadedEnvPool
+from rl_tpu.data import (
+    ArrayDict,
+    DeviceStorage,
+    HostPrioritizedSampler,
+    PrioritizedSampler,
+    ReplayBuffer,
+)
+from rl_tpu.data.replay.samplers import StalenessAwareSampler
+from rl_tpu.data.specs import Bounded, Composite, Unbounded
+from rl_tpu.modules import (
+    MLP,
+    ConcatMLP,
+    NormalParamExtractor,
+    ProbabilisticActor,
+    TanhNormal,
+    TDModule,
+    TDSequential,
+)
+from rl_tpu.objectives import SACLoss
+from rl_tpu.trainers import AsyncOffPolicyTrainer, OffPolicyConfig
+
+KEY = jax.random.key(0)
+
+
+class _HostEnv:
+    """Tiny host env: 2-d noise obs, reward peaks at action 0.3 (so SAC has
+    something to learn), optional per-step delay (straggler stand-in)."""
+
+    def __init__(self, delay=0.0, horizon=64, seed=0):
+        self.delay = delay
+        self.horizon = horizon
+        self._rng = np.random.default_rng(seed)
+        self.t = 0
+
+    @property
+    def observation_spec(self):
+        return Composite(observation=Unbounded((2,)))
+
+    @property
+    def action_spec(self):
+        return Bounded(shape=(1,), low=-1.0, high=1.0)
+
+    def _obs(self):
+        return {"observation": self._rng.normal(size=2).astype(np.float32)}
+
+    def reset(self, seed=0):
+        self._rng = np.random.default_rng(seed)
+        self.t = 0
+        return self._obs()
+
+    def step(self, action):
+        if self.delay:
+            time.sleep(self.delay)
+        self.t += 1
+        a = float(np.asarray(action).ravel()[0])
+        r = 1.0 - (a - 0.3) ** 2
+        return self._obs(), r, False, self.t >= self.horizon
+
+    def close(self):
+        pass
+
+
+class TestDevicePERMatchesHostTree:
+    def test_distribution_parity(self):
+        """Empirical sampling frequencies of the fused device tree and the
+        host C++ segment tree must both match the exact PER distribution
+        p_i^alpha / sum on a fixed priority set."""
+        cap, alpha, beta = 256, 0.7, 0.5
+        prios = np.random.default_rng(3).uniform(0.1, 5.0, cap).astype(np.float32)
+        pa = (np.abs(prios) + 1e-8) ** alpha
+        exact = pa / pa.sum()
+
+        dev = PrioritizedSampler(alpha=alpha, beta=beta)
+        dstate = dev.init(cap)
+        dstate = dev.on_write(dstate, jnp.arange(cap), None)
+        dstate = dev.update_priority(
+            dstate, jnp.arange(cap), jnp.asarray(prios), indices_sorted=True
+        )
+
+        host = HostPrioritizedSampler(alpha=alpha, beta=beta)
+        hstate = host.init(cap)
+        hstate = host.on_write(hstate, np.arange(cap), None)
+        hstate = host.update_priority(hstate, np.arange(cap), prios)
+
+        draws, B = 128, 1024
+        size = jnp.asarray(cap)
+        samp = jax.jit(lambda st, k: dev.sample(st, k, B, size, cap))
+        counts_d = np.zeros(cap)
+        counts_h = np.zeros(cap)
+        for i in range(draws):
+            idx, info, dstate = samp(dstate, jax.random.fold_in(KEY, i))
+            counts_d += np.bincount(np.asarray(idx), minlength=cap)
+            hidx, _, _ = host.sample(
+                hstate, jax.random.fold_in(KEY, 10_000 + i), B, cap, cap
+            )
+            counts_h += np.bincount(np.asarray(hidx), minlength=cap)
+
+        emp_d = counts_d / counts_d.sum()
+        emp_h = counts_h / counts_h.sum()
+        # total-variation-ish L1 tolerances sized for 131072 draws / 256 cells
+        assert np.abs(emp_d - exact).sum() < 0.06, np.abs(emp_d - exact).sum()
+        assert np.abs(emp_h - exact).sum() < 0.06, np.abs(emp_h - exact).sum()
+        assert np.abs(emp_d - emp_h).sum() < 0.09, np.abs(emp_d - emp_h).sum()
+
+    def test_device_weights_match_exact_probs(self):
+        """IS weights from one device batch equal (N·P(i))^-beta normalized
+        by the batch max (stable-baselines convention), computed from the
+        exact probabilities."""
+        cap, alpha, beta = 128, 0.9, 0.6
+        prios = np.random.default_rng(7).uniform(0.2, 3.0, cap).astype(np.float32)
+        pa = (np.abs(prios) + 1e-8) ** alpha
+        exact = pa / pa.sum()
+
+        dev = PrioritizedSampler(alpha=alpha, beta=beta)
+        st = dev.init(cap)
+        st = dev.on_write(st, jnp.arange(cap), None)
+        st = dev.update_priority(
+            st, jnp.arange(cap), jnp.asarray(prios), indices_sorted=True
+        )
+        idx, info, _ = dev.sample(st, KEY, 64, jnp.asarray(cap), cap)
+        idx = np.asarray(idx)
+        expect = (cap * exact[idx]) ** -beta
+        expect = expect / expect.max()
+        np.testing.assert_allclose(np.asarray(info["_weight"]), expect, rtol=2e-3)
+
+
+class TestStalenessStamps:
+    def test_per_item_stamps_and_monotonic_version(self):
+        s = StalenessAwareSampler()
+        st = s.init(8)
+        items = ArrayDict(
+            collector=ArrayDict(policy_version=jnp.asarray([0, 1, 2, 2], jnp.int32))
+        )
+        st = s.on_write(st, jnp.arange(4), items)
+        assert np.asarray(st["written"])[:4].tolist() == [0, 1, 2, 2]
+        assert int(st["version"]) == 2
+        # a late batch carrying older stamps must not rewind the global
+        # version (staleness = version - written stays >= 0)
+        st = s.on_write(
+            st,
+            jnp.asarray([4, 5]),
+            ArrayDict(collector=ArrayDict(policy_version=jnp.asarray([1, 1], jnp.int32))),
+        )
+        assert int(st["version"]) == 2
+        assert np.asarray(st["written"])[4:6].tolist() == [1, 1]
+        _, info, _ = s.sample(st, KEY, 16, jnp.asarray(6), 8)
+        assert (np.asarray(info["staleness"]) >= 0).all()
+
+    def test_stampless_write_bumps_version(self):
+        s = StalenessAwareSampler()
+        st = s.init(4)
+        st = s.on_write(st, jnp.arange(2), ArrayDict())
+        assert int(st["version"]) == 1
+        st = s.on_write(st, jnp.arange(2), ArrayDict())
+        assert int(st["version"]) == 2
+        assert np.asarray(st["written"])[:2].tolist() == [2, 2]
+
+
+class TestFusedCycleTransferBound:
+    def test_fused_per_cycle_no_intermediate_host_sync(self):
+        """Host-sync regression guard (mirrors the serving bound test in
+        test_serving.py): the fused sample->learn->update PER cycle must
+        admit <=1 blocking host transfer per round. Here 8 rounds run under
+        ``jax.transfer_guard("disallow")`` — any implicit device<->host
+        sync inside the loop raises — with the single readout afterwards."""
+        cap, B = 1 << 10, 64
+        s = PrioritizedSampler(alpha=0.8)
+        st = s.init(cap)
+        st = s.on_write(st, jnp.arange(cap), None)
+        data = jax.random.normal(KEY, (cap, 4))
+        size = jnp.asarray(cap)
+
+        @jax.jit
+        def cycle(st, key):
+            key, k = jax.random.split(key)
+            _idx, _info, st = s.sample_and_update(
+                st, k, B, size, cap,
+                lambda i, _info: jnp.abs(data[i].sum(-1)) + 0.01,
+            )
+            return st, key
+
+        st, key = cycle(st, KEY)  # compile outside the guard
+        jax.block_until_ready(st["priorities"])
+        with jax.transfer_guard("disallow"):
+            for _ in range(8):
+                st, key = cycle(st, key)
+        total = np.asarray(jax.block_until_ready(st["priorities"])).sum()
+        assert np.isfinite(total) and total > 0
+
+
+class TestAsyncHostCollector:
+    def test_batch_schema_stamps_and_stats(self):
+        pool = ThreadedEnvPool([lambda: _HostEnv() for _ in range(2)])
+        coll = AsyncHostCollector(pool, None, frames_per_batch=32, seed=0)
+        try:
+            coll.start()
+            b1 = coll.get_batch(timeout=30)
+            b2 = coll.get_batch(timeout=30)
+        finally:
+            coll.stop()
+            pool.close()
+        assert b1 is not None and b2 is not None
+        assert b1.batch_shape == (32,)
+        assert b1["next", "reward"].dtype == jnp.float32
+        assert b1["collector", "policy_version"].dtype == jnp.int32
+        assert set(np.asarray(b1["collector", "env_ids"]).tolist()) <= {0, 1}
+        # the global step counter is strictly increasing in emit order,
+        # within and across batches
+        s1 = np.asarray(b1["collector", "step"])
+        s2 = np.asarray(b2["collector", "step"])
+        assert (np.diff(s1) > 0).all()
+        assert s2.min() > s1.max()
+        stats = coll.stats()
+        assert stats["env_steps"] >= 64
+        assert stats["batches_emitted"] >= 2
+
+    def test_straggler_cutoff_first_come(self):
+        """One slow env among three fast ones: harvests fire without the
+        straggler, so fast envs contribute more transitions per batch."""
+        pool = ThreadedEnvPool(
+            [lambda: _HostEnv(delay=0.05)] + [lambda: _HostEnv() for _ in range(3)]
+        )
+        coll = AsyncHostCollector(
+            pool, None, frames_per_batch=64,
+            min_ready_fraction=0.5, straggler_wait_s=0.005,
+        )
+        try:
+            coll.start()
+            b = coll.get_batch(timeout=30)
+        finally:
+            coll.stop()
+            pool.close()
+        ids = np.asarray(b["collector", "env_ids"])
+        assert (ids == 1).sum() > (ids == 0).sum()
+        assert coll.stats()["straggler_cutoffs"] > 0
+
+
+def _make_sac(act_dim=1, gamma=0.5):
+    net = TDSequential(
+        TDModule(MLP(out_features=2 * act_dim, num_cells=(32, 32)), ["observation"], ["raw"]),
+        TDModule(NormalParamExtractor(), ["raw"], ["loc", "scale"]),
+    )
+    actor = ProbabilisticActor(net, TanhNormal)
+    # small gamma bounds the value scale so the critic loss visibly
+    # decreases within a smoke-test budget (no slow bootstrap chase)
+    return SACLoss(actor, ConcatMLP(out_features=1, num_cells=(32, 32)), gamma=gamma)
+
+
+def _make_trainer(pool, sac, fpb=32):
+    def policy(params, td, key):
+        return sac.actor(params["actor"], td, key)
+
+    coll = AsyncHostCollector(pool, policy, frames_per_batch=fpb, seed=0)
+    cfg = OffPolicyConfig(
+        batch_size=32, utd_ratio=4, learning_rate=3e-3, init_random_frames=32
+    )
+    buffer = ReplayBuffer(DeviceStorage(4096), PrioritizedSampler())
+    return AsyncOffPolicyTrainer(coll, sac, buffer, cfg, priority_key="td_error")
+
+
+def _flatten_with_stamps(batch, n_envs, fpb, version, step0):
+    """[T, N] HostCollector batch -> flat [T*N] with the stamp columns the
+    async writer records, dropping actor dist intermediates — the sync
+    path's batches then share the async buffer schema."""
+    batch = batch.select("observation", "action", "next")
+    flat = batch.apply(lambda x: x.reshape((-1,) + x.shape[2:]))
+    scan_len = fpb // n_envs
+    stamps = ArrayDict(
+        policy_version=jnp.full((fpb,), version, jnp.int32),
+        env_ids=jnp.tile(jnp.arange(n_envs, dtype=jnp.int32), scan_len),
+        step=step0 + jnp.arange(fpb, dtype=jnp.int32),
+    )
+    return flat.set("collector", stamps)
+
+
+@pytest.mark.slow
+class TestAsyncVsSyncSAC:
+    def test_async_learning_smoke_matches_sync(self):
+        """Same-seed envs, async pipeline vs serial drive of the same
+        jitted programs: both critic-loss traces decrease and end in the
+        same ballpark."""
+        n_envs, fpb, total = 2, 32, 768
+
+        # -- async ------------------------------------------------------------
+        pool_a = ThreadedEnvPool([lambda i=i: _HostEnv(seed=i) for i in range(n_envs)])
+        sac = _make_sac()
+        tr = _make_trainer(pool_a, sac, fpb)
+        ts = tr.init(jax.random.key(1))
+        losses_a = []
+        try:
+            for ts, m in tr.train(ts, total_frames=total):
+                if m is not None:
+                    losses_a.append(float(m["loss_qvalue"]))
+        finally:
+            pool_a.close()
+
+        # -- sync: same envs/seeds, same update program, serial loop ----------
+        pool_s = ThreadedEnvPool([lambda i=i: _HostEnv(seed=i) for i in range(n_envs)])
+        sac_s = _make_sac()
+
+        def policy(params, td, key):
+            return sac_s.actor(params["actor"], td, key)
+
+        hc = HostCollector(pool_s, policy, frames_per_batch=fpb, seed=0)
+        tr_s = _make_trainer(pool_s, sac_s, fpb)
+        ts_s = tr_s.init(jax.random.key(1))
+        losses_s = []
+        try:
+            for it in range(total // fpb):
+                key = jax.random.fold_in(KEY, it)
+                flat = _flatten_with_stamps(
+                    hc.collect(ts_s["params"], key), n_envs, fpb, it, it * fpb
+                )
+                bstate = tr_s._extend(ts_s["buffer"], flat)
+                out, m = tr_s._k_updates(
+                    ts_s["params"], ts_s["opt"], bstate, ts_s["rng"], ts_s["update_count"]
+                )
+                params, opt_state, bstate, rng, uc = out
+                ts_s = {
+                    "params": params, "opt": opt_state, "buffer": bstate,
+                    "rng": rng, "update_count": uc,
+                }
+                losses_s.append(float(m["loss_qvalue"]))
+        finally:
+            pool_s.close()
+
+        assert len(losses_a) >= 6 and len(losses_s) >= 6
+        assert np.isfinite(losses_a).all() and np.isfinite(losses_s).all()
+        third_a, third_s = len(losses_a) // 3, len(losses_s) // 3
+        early_a, late_a = np.mean(losses_a[:third_a]), np.mean(losses_a[-third_a:])
+        early_s, late_s = np.mean(losses_s[:third_s]), np.mean(losses_s[-third_s:])
+        assert late_a < early_a, (early_a, late_a)
+        assert late_s < early_s, (early_s, late_s)
+        # loose parity: both pipelines land in the same ballpark
+        assert late_a < 10 * late_s + 1.0 and late_s < 10 * late_a + 1.0
+
+    def test_async_throughput_beats_sync(self):
+        """The acceptance bound: with env stepping overlapped against the
+        donated K-update program, async env-steps/s must strictly beat the
+        serial collect-then-update loop on delayed envs."""
+        delay, n_envs, fpb, total = 0.004, 4, 32, 320
+        sac = _make_sac()
+
+        # -- async ------------------------------------------------------------
+        pool_a = ThreadedEnvPool([lambda: _HostEnv(delay=delay) for _ in range(n_envs)])
+        tr = _make_trainer(pool_a, sac, fpb)
+        ts = tr.init(jax.random.key(2))
+        try:
+            for ts, _m in tr.train(ts, total_frames=2 * fpb):  # compile pass
+                pass
+            t0 = time.perf_counter()
+            for ts, _m in tr.train(ts, total_frames=total):
+                pass
+            wall_async = time.perf_counter() - t0
+        finally:
+            pool_a.close()
+
+        # -- sync -------------------------------------------------------------
+        pool_s = ThreadedEnvPool([lambda: _HostEnv(delay=delay) for _ in range(n_envs)])
+        sac_s = _make_sac()
+
+        def policy(params, td, key):
+            return sac_s.actor(params["actor"], td, key)
+
+        hc = HostCollector(pool_s, policy, frames_per_batch=fpb, seed=0)
+        tr_s = _make_trainer(pool_s, sac_s, fpb)
+        ts_s = tr_s.init(jax.random.key(2))
+
+        def sync_iteration(ts_s, it):
+            key = jax.random.fold_in(KEY, it)
+            flat = _flatten_with_stamps(
+                hc.collect(ts_s["params"], key), n_envs, fpb, it, it * fpb
+            )
+            bstate = tr_s._extend(ts_s["buffer"], flat)
+            out, _m = tr_s._k_updates(
+                ts_s["params"], ts_s["opt"], bstate, ts_s["rng"], ts_s["update_count"]
+            )
+            params, opt_state, bstate, rng, uc = out
+            return {
+                "params": params, "opt": opt_state, "buffer": bstate,
+                "rng": rng, "update_count": uc,
+            }
+
+        try:
+            ts_s = sync_iteration(ts_s, 0)  # compile pass
+            jax.block_until_ready(ts_s["params"])
+            t0 = time.perf_counter()
+            for it in range(total // fpb):
+                ts_s = sync_iteration(ts_s, it + 1)
+            jax.block_until_ready(ts_s["params"])
+            wall_sync = time.perf_counter() - t0
+        finally:
+            pool_s.close()
+
+        fps_async = total / wall_async
+        fps_sync = total / wall_sync
+        assert fps_async > fps_sync, (fps_async, fps_sync)
