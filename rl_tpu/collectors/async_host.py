@@ -41,6 +41,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..data import ArrayDict
+from ..obs import get_registry, get_tracer
 from ..utils.seeding import seed_generator
 
 __all__ = ["AsyncHostCollector"]
@@ -76,6 +77,7 @@ class AsyncHostCollector:
         min_ready_fraction: float = 0.5,
         straggler_wait_s: float = 0.01,
         poll_interval_s: float = 2e-4,
+        registry: Any = None,
     ):
         self.pool = pool
         self.policy = jax.jit(policy) if policy is not None else None
@@ -100,6 +102,32 @@ class AsyncHostCollector:
         self._batches_emitted = 0
         self._harvests = 0
         self._straggler_cutoffs = 0
+        # observability: registry series + trace events from the actor
+        # thread (registry metric ops are thread-safe; the tracer keeps a
+        # ring per thread, so the actor never contends with the trainer)
+        self._tracer = get_tracer()
+        self.registry = registry if registry is not None else get_registry()
+        p = "rl_tpu_collector"
+        reg = self.registry
+        self._m_env_steps = reg.counter(f"{p}_env_steps_total", "env transitions harvested")
+        self._m_batches = reg.counter(f"{p}_batches_total", "batches emitted to the trainer")
+        self._m_harvests = reg.counter(f"{p}_harvests_total", "harvest sweeps")
+        self._m_cutoffs = reg.counter(
+            f"{p}_straggler_cutoffs_total",
+            "harvests fired before every in-flight env finished",
+        )
+        self._m_queue = reg.gauge(f"{p}_queue_depth", "completed batches awaiting the trainer")
+        self._m_version = reg.gauge(f"{p}_policy_version", "latest published policy version")
+        self._m_staleness = reg.histogram(
+            f"{p}_staleness",
+            "policy-version lag of emitted transitions",
+            buckets=(0.0, 1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0),
+        )
+        self._m_harvest_s = reg.histogram(
+            f"{p}_harvest_seconds",
+            "time between consecutive harvest sweeps",
+            buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0),
+        )
 
     # -- lifecycle ------------------------------------------------------------
 
@@ -248,7 +276,12 @@ class AsyncHostCollector:
                 continue
             if len(ready) < in_flight:
                 self._straggler_cutoffs += 1
+                self._m_cutoffs.inc()
+                self._tracer.instant(
+                    "straggler_cutoff", {"ready": len(ready), "in_flight": in_flight}
+                )
             self._harvests += 1
+            self._m_harvest_s.observe(now - last_harvest)
             last_harvest = now
 
             for i in ready:
@@ -277,7 +310,8 @@ class AsyncHostCollector:
 
             # -- emit phase: hand over full batches through the bounded queue
             while len(records) >= self.frames_per_batch:
-                batch = self._build_batch(records[: self.frames_per_batch])
+                with self._tracer.span("collector/emit_batch"):
+                    batch = self._build_batch(records[: self.frames_per_batch])
                 records = records[self.frames_per_batch :]
                 if not self._put(batch):
                     return
@@ -308,10 +342,17 @@ class AsyncHostCollector:
                 done=jnp.asarray(np.asarray([r[4] or r[5] for r in recs])),
             )
         )
+        versions = np.asarray([r[6] for r in recs], np.int32)
         stamps = ArrayDict(
-            policy_version=jnp.asarray(np.asarray([r[6] for r in recs], np.int32)),
+            policy_version=jnp.asarray(versions),
             env_ids=jnp.asarray(np.asarray([r[7] for r in recs], np.int32)),
             step=jnp.asarray(np.asarray([r[8] for r in recs], np.int32)),
         )
         self._batches_emitted += 1
+        self._m_staleness.observe_many(self._version - versions)
+        self._m_env_steps.set_total(self._env_steps)
+        self._m_batches.set_total(self._batches_emitted)
+        self._m_harvests.set_total(self._harvests)
+        self._m_queue.set(self._queue.qsize())
+        self._m_version.set(self._version)
         return td.set("next", next_td).set("collector", stamps)

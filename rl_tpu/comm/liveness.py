@@ -86,6 +86,20 @@ class Watchdog:
                 if name not in self._dead and now - t > self.timeout:
                     self._dead.add(name)
                     newly.append(name)
+        if newly:
+            # liveness incidents show up in traces and /metrics, not just
+            # log lines (lazy import: comm must stay importable standalone)
+            from ..obs import get_registry, get_tracer
+
+            tracer = get_tracer()
+            counter = get_registry().counter(
+                "rl_tpu_watchdog_deaths_total",
+                "actors declared dead by the watchdog",
+                labels=("name",),
+            )
+            for name in newly:
+                tracer.instant("watchdog_death", {"name": name})
+                counter.inc(1, {"name": name})
         for name in newly:
             if self.on_death is not None:
                 self.on_death(name)

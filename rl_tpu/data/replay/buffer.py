@@ -97,12 +97,29 @@ class ReplayBuffer:
         donated (the scatter updates in place instead of copying the whole
         ring). The chunk size is static: feed it batches of exactly ``n``
         items (the async collector's ``frames_per_batch``).
+
+        The returned callable counts its writes into the process metrics
+        registry (host-side counters — the jitted program is untouched) so
+        ``/metrics`` carries write throughput alongside the collector's
+        queue-depth series.
         """
         fn = jax.jit(
             lambda state, items: self.extend(state, items, n=n),
             donate_argnums=(0,) if donate else (),
         )
-        return fn
+        from ...obs import get_registry
+
+        reg = get_registry()
+        m_ext = reg.counter("rl_tpu_replay_extends_total", "chunked buffer writes")
+        m_items = reg.counter("rl_tpu_replay_items_written_total", "items written to replay")
+
+        def counted(state, items):
+            out = fn(state, items)
+            m_ext.inc()
+            m_items.inc(n)
+            return out
+
+        return counted
 
     # -- reads ----------------------------------------------------------------
 

@@ -51,6 +51,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..obs.device import DeviceMetrics
+
 __all__ = [
     "ContinuousBatchingEngine",
     "LoadBalancer",
@@ -234,6 +236,14 @@ class ContinuousBatchingEngine:
         self.decode_drains = 0
         self.host_transfers = 0  # blocking device->host materializations
         self.decode_chunk_last = 1
+        self.admissions = 0
+        self.completions: dict[str, int] = {"eos": 0, "length": 0}
+        self._n_pool_blocks = n_blocks - 1
+        # on-device token accounting: the decode scan counts every token
+        # generated by an effectively-active slot, so throughput telemetry
+        # never adds a per-chunk host sync (read only at scrape time)
+        self._obs_spec = DeviceMetrics(counters=("tokens",))
+        self.dev_obs = self._obs_spec.init()
 
         self._decode_progs: dict[int, Any] = {}  # chunk K -> jitted program
         self._prefills: dict[tuple, Any] = {}  # (A, bucket) -> jitted prefill
@@ -275,19 +285,22 @@ class ContinuousBatchingEngine:
             return prog
 
         eos = self.eos_id
+        obs_spec = self._obs_spec
 
-        def fn(params, pools, table, lens, active, budget, last, run_mask, key):
+        def fn(params, pools, table, lens, active, budget, last, run_mask, key, dm):
             """K decode steps in one program, with the per-slot stop rule
             applied ON DEVICE: an active slot decrements its budget each
             step and deactivates itself when it samples eos or runs out —
             inactive slots write to scratch and freeze their length, so
             the host only needs the token values to DRAIN outputs, never
             to decide continuation. Returns tokens/log-probs [S, K] plus
-            the advanced device state."""
+            the advanced device state (and the on-device metrics state,
+            which counts tokens from effectively-active slots)."""
 
             def body(carry, k):
-                pools, lens, active, budget, last = carry
+                pools, lens, active, budget, last, dm = carry
                 eff = active & run_mask
+                dm = obs_spec.inc(dm, "tokens", eff.sum().astype(jnp.float32))
                 cache = [
                     {
                         "pool_k": pk,
@@ -310,11 +323,11 @@ class ContinuousBatchingEngine:
                     stop = stop | (tok == eos)
                 active = active & ~(stop & eff)
                 last = jnp.where(eff, tok, last)
-                return (new_pools, lens, active, budget, last), (tok, lp)
+                return (new_pools, lens, active, budget, last, dm), (tok, lp)
 
             keys = jax.random.split(key, chunk)
-            carry = (tuple(pools), lens, active, budget, last)
-            (pools, lens, active, budget, last), (toks, lps) = jax.lax.scan(
+            carry = (tuple(pools), lens, active, budget, last, dm)
+            (pools, lens, active, budget, last, dm), (toks, lps) = jax.lax.scan(
                 body, carry, keys
             )
             return (
@@ -325,6 +338,7 @@ class ContinuousBatchingEngine:
                 active,
                 budget,
                 last,
+                dm,
             )
 
         prog = self._decode_progs[chunk] = jax.jit(fn)
@@ -378,6 +392,7 @@ class ContinuousBatchingEngine:
         self._pending_table_writes.clear()
 
     def _free_slot(self, slot: int, reason: str):
+        self.completions[reason] = self.completions.get(reason, 0) + 1
         rid = int(self.slot_rid[slot])
         chunks = self.slot_tokens[slot]
         self.finished.append(
@@ -414,6 +429,32 @@ class ContinuousBatchingEngine:
         # entries before fresh blocks overwrite them
 
     # -- public surface --------------------------------------------------------
+
+    def metrics_snapshot(self) -> dict:
+        """Flat host dict of the engine's telemetry. The only device read
+        is the on-device token counter (one explicit transfer), so calling
+        this at scrape cadence costs nothing on the decode path."""
+        used = self._n_pool_blocks - len(self.free_blocks)
+        tokens = float(jax.device_get(self.dev_obs["counters"]["tokens"]))
+        return {
+            "tokens_generated": tokens,
+            "decode_steps": self.decode_steps,
+            "decode_launches": self.decode_launches,
+            "decode_drains": self.decode_drains,
+            "host_transfers": self.host_transfers,
+            "prefill_token_slots": self.prefill_token_slots,
+            "decode_chunk": self.decode_chunk_last,
+            "tuner_k": self._tuner.k if self._tuner is not None else None,
+            "admissions": self.admissions,
+            "completions_eos": self.completions.get("eos", 0),
+            "completions_length": self.completions.get("length", 0),
+            "queue_depth": len(self.queue),
+            "active_slots": int((self.slot_rid >= 0).sum()),
+            "pending": self.pending(),
+            "kv_blocks_used": used,
+            "kv_blocks_total": self._n_pool_blocks,
+            "kv_utilization": used / max(self._n_pool_blocks, 1),
+        }
 
     def pending(self) -> int:
         """Outstanding work: queued + in-flight requests."""
@@ -460,6 +501,7 @@ class ContinuousBatchingEngine:
             return
         bucket = _bucket(max(len(r.prompt) for _, r in batch), self.buckets)
         A = len(batch)
+        self.admissions += A
         tokens = np.zeros((A, bucket), np.int32)
         mask = np.zeros((A, bucket), bool)
         for i, (s, req) in enumerate(batch):
@@ -607,6 +649,7 @@ class ContinuousBatchingEngine:
             self.dev_active,
             self.dev_budget,
             self.dev_last,
+            self.dev_obs,
         ) = prog(
             self.params,
             pools,
@@ -617,6 +660,7 @@ class ContinuousBatchingEngine:
             self.dev_last,
             run_dev,
             k,
+            self.dev_obs,
         )
         for layer, (pk, pv) in zip(self.cache, new_pools):
             layer["pool_k"], layer["pool_v"] = pk, pv
@@ -852,10 +896,16 @@ class ServingService:
     - ``collect`` -> {rid: {"tokens": [...], "log_probs": [...],
       "finished_reason": ...}} — finished since the last collect
     - ``stats`` -> {"pending": ..., "free_blocks": ..., "decode_steps": ...}
+
+    Alongside the command port, a stdlib HTTP server exposes the engine's
+    telemetry as Prometheus text on ``GET /metrics`` (``metrics_port=0``
+    binds an ephemeral port, read back from ``metrics_address``; ``None``
+    disables it). The service owns its registry by default so replica
+    services never cross-publish.
     """
 
     def __init__(self, engine: ContinuousBatchingEngine, host: str = "127.0.0.1",
-                 port: int = 0):
+                 port: int = 0, metrics_port: int | None = 0, registry=None):
         import threading
 
         from ..comm import TCPCommandServer
@@ -870,6 +920,73 @@ class ServingService:
         self._server.register_handler("collect", self._h_collect)
         self._server.register_handler("stats", self._h_stats)
         self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._metrics_server = None
+        self.registry = registry
+        if metrics_port is not None:
+            from ..obs import MetricsHTTPServer, MetricsRegistry
+
+            if self.registry is None:
+                self.registry = MetricsRegistry()
+            self._metrics_server = MetricsHTTPServer(
+                self.registry, host=host, port=metrics_port
+            )
+        if self.registry is not None:
+            self._init_metrics(self.registry)
+
+    def _init_metrics(self, reg):
+        p = "rl_tpu_serving"
+        self._m_tokens = reg.counter(f"{p}_tokens_total", "tokens generated on device")
+        self._m_counters = {
+            name: reg.counter(f"{p}_{name}_total", help_)
+            for name, help_ in (
+                ("decode_steps", "decode steps dispatched"),
+                ("decode_launches", "decode chunk launches"),
+                ("decode_drains", "decode chunk drains"),
+                ("host_transfers", "blocking device->host transfers"),
+                ("prefill_token_slots", "prefill token-slots computed"),
+                ("admissions", "requests admitted to slots"),
+            )
+        }
+        self._m_completions = reg.counter(
+            f"{p}_completions_total", "finished requests", labels=("reason",)
+        )
+        self._m_gauges = {
+            name: reg.gauge(f"{p}_{name}", help_)
+            for name, help_ in (
+                ("kv_utilization", "fraction of KV pool blocks in use"),
+                ("queue_depth", "requests waiting for a slot"),
+                ("active_slots", "slots decoding"),
+                ("pending", "queued + in-flight requests"),
+                ("decode_chunk", "last decode chunk size K"),
+                ("tuner_k", "chunk auto-tuner's current K"),
+                ("tokens_per_second", "decode throughput since last scrape"),
+            )
+        }
+        self._tps_last: tuple[float, float] | None = None
+        reg.register_collector(self._update_metrics)
+
+    def _update_metrics(self):
+        with self._lock:
+            snap = self.engine.metrics_snapshot()
+        for name, c in self._m_counters.items():
+            c.set_total(snap[name])
+        self._m_tokens.set_total(snap["tokens_generated"])
+        self._m_completions.set_total(snap["completions_eos"], {"reason": "eos"})
+        self._m_completions.set_total(snap["completions_length"], {"reason": "length"})
+        for name in ("kv_utilization", "queue_depth", "active_slots", "pending",
+                     "decode_chunk"):
+            self._m_gauges[name].set(float(snap[name]))
+        if snap["tuner_k"] is not None:
+            self._m_gauges["tuner_k"].set(float(snap["tuner_k"]))
+        now = time.monotonic()
+        if self._tps_last is not None:
+            t0, tok0 = self._tps_last
+            dt = now - t0
+            if dt > 0:
+                self._m_gauges["tokens_per_second"].set(
+                    (snap["tokens_generated"] - tok0) / dt
+                )
+        self._tps_last = (now, snap["tokens_generated"])
 
     # -- lifecycle -------------------------------------------------------------
 
@@ -877,15 +994,27 @@ class ServingService:
     def address(self):
         return self._server.address
 
+    @property
+    def metrics_address(self):
+        if self._metrics_server is None:
+            return None
+        return self._metrics_server.address
+
     def start(self) -> "ServingService":
         self._server.start()
         self._thread.start()
+        if self._metrics_server is not None:
+            self._metrics_server.start()
         return self
 
     def shutdown(self):
         self._stop.set()
         self._thread.join(timeout=10)
         self._server.shutdown()
+        if self._metrics_server is not None:
+            self._metrics_server.shutdown()
+        if self.registry is not None:
+            self.registry.unregister_collector(self._update_metrics)
 
     # -- stepper ---------------------------------------------------------------
 

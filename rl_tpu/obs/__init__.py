@@ -1,0 +1,48 @@
+"""rl_tpu.obs — unified runtime observability.
+
+Three pillars:
+
+- :mod:`rl_tpu.obs.device` — ``DeviceMetrics``: metrics accumulated
+  *inside* jitted programs (scan carries), drained once per dispatch.
+- :mod:`rl_tpu.obs.trace` — ``TraceRecorder``: per-thread ring-buffer
+  spans/instants/counters, Perfetto/Chrome ``trace_event`` export.
+- :mod:`rl_tpu.obs.registry` + :mod:`rl_tpu.obs.http` —
+  ``MetricsRegistry`` with Prometheus text rendering, served as
+  ``GET /metrics``.
+
+Exports resolve lazily (PEP 562) so that light consumers — e.g.
+``rl_tpu.utils.timing`` importing the tracer — never pull in the
+jax-dependent device module.
+"""
+
+from __future__ import annotations
+
+_EXPORTS = {
+    "DeviceMetrics": ("rl_tpu.obs.device", "DeviceMetrics"),
+    "TraceRecorder": ("rl_tpu.obs.trace", "TraceRecorder"),
+    "get_tracer": ("rl_tpu.obs.trace", "get_tracer"),
+    "set_tracer": ("rl_tpu.obs.trace", "set_tracer"),
+    "Counter": ("rl_tpu.obs.registry", "Counter"),
+    "Gauge": ("rl_tpu.obs.registry", "Gauge"),
+    "Histogram": ("rl_tpu.obs.registry", "Histogram"),
+    "MetricsRegistry": ("rl_tpu.obs.registry", "MetricsRegistry"),
+    "get_registry": ("rl_tpu.obs.registry", "get_registry"),
+    "set_registry": ("rl_tpu.obs.registry", "set_registry"),
+    "MetricsHTTPServer": ("rl_tpu.obs.http", "MetricsHTTPServer"),
+}
+
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name: str):
+    try:
+        mod_name, attr = _EXPORTS[name]
+    except KeyError:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}") from None
+    import importlib
+
+    return getattr(importlib.import_module(mod_name), attr)
+
+
+def __dir__():
+    return __all__

@@ -1,0 +1,168 @@
+"""In-program device metrics: accumulate inside jit, drain once per dispatch.
+
+The async hot paths (the donated K-update ``lax.scan`` in
+``AsyncOffPolicyTrainer`` and serving's decode-chunk scan) must not pay a
+device→host sync per step — that property is what PR 1–2 bought and what
+the ``transfer_guard`` tests pin. So metrics live *on device* as a small
+pytree of float32 scalars and histogram-bucket arrays, are updated with
+pure functional ops inside the scan carry, and are read back at most once
+per dispatch: :func:`drain_async` starts ``copy_to_host_async`` right
+after dispatch (overlapping the copy with host work), then
+:func:`drain` materializes the host values with an explicit
+``jax.device_get`` — explicit transfers stay legal under
+``jax.transfer_guard("disallow")``.
+
+Counters and histogram buckets hold *running totals* (monotone), so a
+drain is a read, not a reset — publishing uses ``Counter.set_total`` /
+``Histogram.set_cumulative`` on the host registry rather than ``inc``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Mapping, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["DeviceMetrics"]
+
+
+@dataclasses.dataclass(frozen=True)
+class DeviceMetrics:
+    """Static schema for an on-device metrics pytree.
+
+    The schema (names, histogram edges) is host-side Python and hashable,
+    so it can be closed over by jitted programs; only the *state* returned
+    by :meth:`init` is traced. State layout (a plain dict pytree, safe as
+    a ``lax.scan`` carry leaf and under donation)::
+
+        {"counters": {name: f32[]}, "gauges": {name: f32[]},
+         "hist": {name: {"counts": f32[len(edges)+1], "sum": f32[]}}}
+
+    Counters are float32 rather than int32 deliberately: token counts on a
+    long-running server overflow int32 in hours, and exact integerness
+    past 2**24 is irrelevant for telemetry.
+    """
+
+    counters: tuple = ()
+    gauges: tuple = ()
+    histograms: Any = dataclasses.field(default_factory=dict)
+
+    def __post_init__(self):
+        object.__setattr__(self, "counters", tuple(self.counters))
+        object.__setattr__(self, "gauges", tuple(self.gauges))
+        # freeze edge lists to tuples so the schema stays hashable
+        object.__setattr__(
+            self,
+            "histograms",
+            {k: tuple(float(e) for e in v) for k, v in dict(self.histograms).items()},
+        )
+
+    def __hash__(self):
+        # the generated frozen-dataclass hash trips over the dict field
+        return hash(
+            (self.counters, self.gauges, tuple(sorted(self.histograms.items())))
+        )
+
+    # -- state ----------------------------------------------------------
+    def init(self) -> dict:
+        return {
+            "counters": {n: jnp.zeros((), jnp.float32) for n in self.counters},
+            "gauges": {n: jnp.zeros((), jnp.float32) for n in self.gauges},
+            "hist": {
+                n: {
+                    "counts": jnp.zeros((len(edges) + 1,), jnp.float32),
+                    "sum": jnp.zeros((), jnp.float32),
+                }
+                for n, edges in self.histograms.items()
+            },
+        }
+
+    # -- traced update ops (pure: state -> state) ------------------------
+    def inc(self, state: dict, name: str, value=1.0) -> dict:
+        c = dict(state["counters"])
+        c[name] = c[name] + jnp.asarray(value, jnp.float32)
+        return {**state, "counters": c}
+
+    def set_gauge(self, state: dict, name: str, value) -> dict:
+        g = dict(state["gauges"])
+        g[name] = jnp.asarray(value, jnp.float32)
+        return {**state, "gauges": g}
+
+    def observe(self, state: dict, name: str, values) -> dict:
+        """Bin ``values`` (any shape) into the histogram's running bucket
+        totals — no host interaction. Binning is searchsorted + a one-hot
+        reduction rather than a scatter-add: scatters serialize on TPU
+        (and are slow on CPU too), while an ``[N, buckets]`` comparison
+        matrix reduces in one vectorized pass."""
+        edges = jnp.asarray(self.histograms[name], jnp.float32)
+        vals = jnp.ravel(jnp.asarray(values, jnp.float32))
+        idx = jnp.searchsorted(edges, vals, side="left")
+        n_buckets = len(self.histograms[name]) + 1
+        onehot = idx[:, None] == jnp.arange(n_buckets, dtype=idx.dtype)[None, :]
+        h = {k: dict(v) for k, v in state["hist"].items()}
+        h[name] = {
+            "counts": h[name]["counts"] + jnp.sum(onehot, axis=0, dtype=jnp.float32),
+            "sum": h[name]["sum"] + jnp.sum(vals),
+        }
+        return {**state, "hist": h}
+
+    # -- drain (host side) ----------------------------------------------
+    @staticmethod
+    def drain_async(state: dict) -> dict:
+        """Start non-blocking device→host copies for every leaf and return
+        the state unchanged (call right after dispatching the next program
+        so the copy overlaps host-side work)."""
+        for leaf in jax.tree_util.tree_leaves(state):
+            if hasattr(leaf, "copy_to_host_async"):
+                leaf.copy_to_host_async()
+        return state
+
+    @staticmethod
+    def drain(state: dict) -> dict:
+        """Materialize host values (one explicit transfer batch; a no-op
+        cost-wise if :meth:`drain_async` already landed the copies).
+        Returns plain numpy/py floats in the same nested layout."""
+        host = jax.device_get(state)
+        return jax.tree_util.tree_map(np.asarray, host)
+
+    def publish(self, snapshot: Mapping, registry, prefix: str = "rl_tpu_device") -> None:
+        """Push a drained snapshot into a host ``MetricsRegistry``.
+
+        Counters/histograms are monotone running totals → ``set_total`` /
+        ``set_cumulative``; gauges are last-value → ``set``.
+        """
+        for n in self.counters:
+            registry.counter(f"{prefix}_{n}_total", f"device counter {n}").set_total(
+                float(snapshot["counters"][n])
+            )
+        for n in self.gauges:
+            registry.gauge(f"{prefix}_{n}", f"device gauge {n}").set(
+                float(snapshot["gauges"][n])
+            )
+        for n, edges in self.histograms.items():
+            registry.histogram(
+                f"{prefix}_{n}", f"device histogram {n}", buckets=edges
+            ).set_cumulative(
+                np.asarray(snapshot["hist"][n]["counts"]).tolist(),
+                float(snapshot["hist"][n]["sum"]),
+            )
+
+    # -- convenience -----------------------------------------------------
+    def to_flat(self, snapshot: Mapping) -> dict:
+        """Flatten a drained snapshot into ``{name: float | dict}`` for
+        logging or bench artifacts."""
+        out: dict[str, Any] = {}
+        for n in self.counters:
+            out[n] = float(snapshot["counters"][n])
+        for n in self.gauges:
+            out[n] = float(snapshot["gauges"][n])
+        for n, edges in self.histograms.items():
+            out[n] = {
+                "edges": list(edges),
+                "counts": np.asarray(snapshot["hist"][n]["counts"]).tolist(),
+                "sum": float(snapshot["hist"][n]["sum"]),
+            }
+        return out

@@ -1,0 +1,73 @@
+"""Minimal ``/metrics`` HTTP endpoint (Prometheus text exposition).
+
+The framework's control plane is line-delimited-JSON TCP
+(``rl_tpu.comm.TCPCommandServer``), which Prometheus can't scrape — so
+services that want scraping (``ServingService``, ``LoggerService``) run
+this tiny stdlib HTTP server alongside their command port. Stdlib only:
+no new dependencies, one daemon thread, content type
+``text/plain; version=0.0.4``.
+"""
+
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+__all__ = ["MetricsHTTPServer"]
+
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+class MetricsHTTPServer:
+    """Serve ``GET /metrics`` for one :class:`~rl_tpu.obs.registry.MetricsRegistry`.
+
+    ``port=0`` binds an ephemeral port; read it back from ``address``.
+    """
+
+    def __init__(self, registry, host: str = "127.0.0.1", port: int = 0):
+        self.registry = registry
+        outer = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 (stdlib API name)
+                if self.path.split("?", 1)[0] not in ("/metrics", "/"):
+                    self.send_error(404)
+                    return
+                try:
+                    body = outer.registry.render().encode()
+                except Exception as e:  # registry bug must not wedge the scraper
+                    self.send_error(500, str(e))
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", CONTENT_TYPE)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):  # silence per-request stderr spam
+                pass
+
+        self._server = ThreadingHTTPServer((host, port), _Handler)
+        self._server.daemon_threads = True
+        self._thread: threading.Thread | None = None
+
+    @property
+    def address(self) -> tuple[str, int]:
+        return self._server.server_address[:2]
+
+    def start(self) -> "MetricsHTTPServer":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._server.serve_forever,
+                name="metrics-http",
+                daemon=True,
+            )
+            self._thread.start()
+        return self
+
+    def shutdown(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None

@@ -1,0 +1,196 @@
+"""Cross-thread tracing with Perfetto/Chrome ``trace_event`` export.
+
+Each thread records into its own bounded ring buffer, so the hot paths
+(trainer dispatch loop, ``AsyncHostCollector`` actor, serving stepper /
+drain threads) never contend on a shared lock per event — the global
+recorder lock is only taken the first time a thread records (to register
+its ring) and at export. Events use the Chrome trace-event JSON schema
+(``"X"`` complete spans with ``ts``/``dur`` in microseconds, ``"i"``
+instants, ``"C"`` counters, ``"M"`` thread-name metadata), so an
+``export()`` file loads directly in Perfetto / ``chrome://tracing``.
+
+``rl_tpu.utils.timing.timeit`` and ``record_function`` are thin clients
+of this recorder: every timed block becomes a span here, and (when JAX
+profiling is on) the same name is forwarded to
+``jax.profiler.TraceAnnotation`` so host spans line up with XLA device
+tracks in a combined capture.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager
+from typing import Any, Iterator, Mapping
+
+__all__ = ["TraceRecorder", "get_tracer", "set_tracer"]
+
+DEFAULT_CAPACITY = 16384
+
+
+class _ThreadRing:
+    """Per-thread event ring. Only its owner thread appends, so no lock is
+    needed on the hot path; ``deque(maxlen=...)`` gives the ring-buffer
+    drop-oldest behaviour for free and its append is atomic under the GIL,
+    which makes the exporter's snapshot (``list(ring)``) safe too."""
+
+    __slots__ = ("tid", "name", "events")
+
+    def __init__(self, tid: int, name: str, capacity: int):
+        self.tid = tid
+        self.name = name
+        self.events: deque = deque(maxlen=capacity)
+
+
+class TraceRecorder:
+    """Span/instant/counter recorder, one ring buffer per thread."""
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY, enabled: bool = True):
+        self.capacity = int(capacity)
+        self._enabled = bool(enabled)
+        self._lock = threading.Lock()  # guards _rings registration + export
+        # a list, not a dict keyed by thread ident: the OS reuses idents
+        # once a thread exits, and a reused key would silently drop the
+        # finished thread's events from the export
+        self._rings: list[_ThreadRing] = []
+        self._next_tid = 1
+        self._local = threading.local()
+        self._pid = os.getpid()
+        # trace timestamps are perf_counter-based (monotonic, ns); remember
+        # the origin so ts starts near zero and stays readable.
+        self._t0_ns = time.perf_counter_ns()
+
+    # -- enable/disable -------------------------------------------------
+    @property
+    def enabled(self) -> bool:
+        return self._enabled
+
+    def set_enabled(self, enabled: bool) -> None:
+        self._enabled = bool(enabled)
+
+    # -- recording ------------------------------------------------------
+    def _ring(self) -> _ThreadRing:
+        ring = getattr(self._local, "ring", None)
+        if ring is None:
+            t = threading.current_thread()
+            with self._lock:
+                # synthetic per-recorder tid (registration order): stable,
+                # unique, and never recycled the way OS thread idents are
+                ring = _ThreadRing(self._next_tid, t.name, self.capacity)
+                self._next_tid += 1
+                self._rings.append(ring)
+            self._local.ring = ring
+        return ring
+
+    def _now_us(self) -> float:
+        return (time.perf_counter_ns() - self._t0_ns) / 1e3
+
+    @contextmanager
+    def span(self, name: str, args: Mapping[str, Any] | None = None) -> Iterator[None]:
+        """Time a block as a complete ("X") event on the calling thread."""
+        if not self._enabled:
+            yield
+            return
+        start = self._now_us()
+        try:
+            yield
+        finally:
+            end = self._now_us()
+            ev = {"ph": "X", "name": name, "ts": start, "dur": end - start}
+            if args:
+                ev["args"] = dict(args)
+            self._ring().events.append(ev)
+
+    def begin_span(self, name: str, args: Mapping[str, Any] | None = None) -> float:
+        """Manual span start for code that can't use a ``with`` block
+        (e.g. ``timeit.__enter__``); pair with :meth:`end_span`."""
+        return self._now_us()
+
+    def end_span(
+        self, name: str, start_us: float, args: Mapping[str, Any] | None = None
+    ) -> None:
+        if not self._enabled:
+            return
+        ev = {"ph": "X", "name": name, "ts": start_us, "dur": self._now_us() - start_us}
+        if args:
+            ev["args"] = dict(args)
+        self._ring().events.append(ev)
+
+    def instant(self, name: str, args: Mapping[str, Any] | None = None) -> None:
+        """Point event (watchdog death, preemption signal, straggler cut)."""
+        if not self._enabled:
+            return
+        ev = {"ph": "i", "name": name, "ts": self._now_us(), "s": "t"}
+        if args:
+            ev["args"] = dict(args)
+        self._ring().events.append(ev)
+
+    def counter(self, name: str, values: Mapping[str, float]) -> None:
+        """Counter track sample (queue depth over time, tokens/s)."""
+        if not self._enabled:
+            return
+        self._ring().events.append(
+            {
+                "ph": "C",
+                "name": name,
+                "ts": self._now_us(),
+                "args": {k: float(v) for k, v in values.items()},
+            }
+        )
+
+    # -- export ---------------------------------------------------------
+    def export(self, path: str | None = None) -> dict:
+        """Snapshot all rings as a Chrome ``trace_event`` JSON object
+        (``{"traceEvents": [...]}``); optionally also write it to ``path``.
+        Safe to call while other threads keep recording."""
+        with self._lock:
+            rings = list(self._rings)
+        events: list[dict] = []
+        for ring in rings:
+            events.append(
+                {
+                    "ph": "M",
+                    "name": "thread_name",
+                    "pid": self._pid,
+                    "tid": ring.tid,
+                    "args": {"name": ring.name},
+                }
+            )
+            for ev in list(ring.events):
+                out = dict(ev)
+                out["pid"] = self._pid
+                out["tid"] = ring.tid
+                events.append(out)
+        # Stable ordering helps diffs and makes nesting checks deterministic.
+        events.sort(key=lambda e: (e["tid"], e.get("ts", -1.0)))
+        trace = {"traceEvents": events, "displayTimeUnit": "ms"}
+        if path is not None:
+            with open(path, "w") as f:
+                json.dump(trace, f)
+        return trace
+
+    def clear(self) -> None:
+        with self._lock:
+            rings = list(self._rings)
+        for ring in rings:
+            ring.events.clear()
+
+
+_TRACER = TraceRecorder()
+
+
+def get_tracer() -> TraceRecorder:
+    """The process-default recorder (what ``timeit``/``record_function``
+    and the liveness/resilience hooks record into)."""
+    return _TRACER
+
+
+def set_tracer(tracer: TraceRecorder) -> TraceRecorder:
+    """Swap the process default (tests); returns the previous one."""
+    global _TRACER
+    prev = _TRACER
+    _TRACER = tracer
+    return prev

@@ -53,6 +53,16 @@ class Logger:
     def log_histogram(self, name: str, values: np.ndarray, step: int | None = None) -> None:
         pass
 
+    def close(self) -> None:
+        pass
+
+    def __enter__(self) -> "Logger":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.close()
+        return False
+
 
 class NullLogger(Logger):
     """Drops everything (reference monitoring.py NullLogger)."""
@@ -65,19 +75,31 @@ class NullLogger(Logger):
 
 
 class CSVLogger(Logger):
-    """One CSV per scalar stream + a JSON for hparams (reference csv.py)."""
+    """One CSV per scalar stream + a JSON for hparams (reference csv.py).
 
-    def __init__(self, exp_name: str, log_dir: str = "logs"):
+    Usable as a context manager; ``close()`` is idempotent. Open handles
+    are bounded by ``max_open_files`` (least-recently-used streams are
+    closed and transparently reopened in append mode), so a long run with
+    many scalar streams cannot exhaust the process fd limit.
+    """
+
+    def __init__(self, exp_name: str, log_dir: str = "logs", max_open_files: int = 64):
         super().__init__(exp_name, os.path.join(log_dir, exp_name))
         os.makedirs(self.log_dir, exist_ok=True)
-        self._files: dict[str, Any] = {}
+        self.max_open_files = max(1, int(max_open_files))
+        self._files: dict[str, Any] = {}  # insertion order == LRU order
 
     def _writer(self, name: str):
-        if name not in self._files:
-            safe = name.replace("/", "_")
-            f = open(os.path.join(self.log_dir, f"{safe}.csv"), "a", newline="")
-            self._files[name] = (f, _csv.writer(f))
-        return self._files[name]
+        if name in self._files:
+            self._files[name] = entry = self._files.pop(name)  # refresh LRU
+            return entry
+        while len(self._files) >= self.max_open_files:
+            old_f, _ = self._files.pop(next(iter(self._files)))
+            old_f.close()
+        safe = name.replace("/", "_")
+        f = open(os.path.join(self.log_dir, f"{safe}.csv"), "a", newline="")
+        self._files[name] = entry = (f, _csv.writer(f))
+        return entry
 
     def log_scalar(self, name, value, step=None):
         f, w = self._writer(name)
@@ -96,6 +118,7 @@ class CSVLogger(Logger):
     def close(self):
         for f, _ in self._files.values():
             f.close()
+        self._files.clear()
 
 
 class TensorboardLogger(Logger):
@@ -126,6 +149,9 @@ class TensorboardLogger(Logger):
 
     def log_histogram(self, name, values, step=None):
         self.writer.add_histogram(name, np.asarray(values), global_step=step)
+
+    def close(self):
+        self.writer.close()
 
 
 class WandbLogger(Logger):  # pragma: no cover - dep not in image
@@ -197,6 +223,16 @@ class MultiLogger(Logger):
     def log_histogram(self, name, values, step=None):
         for lg in self.loggers:
             lg.log_histogram(name, values, step)
+
+    def close(self):
+        errs = []
+        for lg in self.loggers:
+            try:
+                lg.close()
+            except Exception as e:  # close the rest before re-raising
+                errs.append(e)
+        if errs:
+            raise errs[0]
 
 
 _BACKENDS = {

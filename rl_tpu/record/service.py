@@ -21,9 +21,16 @@ __all__ = ["LoggerService", "RemoteLogger"]
 
 
 class LoggerService:
-    """Serve a concrete Logger over TCP."""
+    """Serve a concrete Logger over TCP.
 
-    def __init__(self, logger: Logger, host: str = "127.0.0.1", port: int = 0):
+    A stdlib HTTP sidecar exposes service telemetry (records ingested by
+    kind, plus whatever else lands in its registry) as Prometheus text on
+    ``GET /metrics`` — ``metrics_port=0`` binds an ephemeral port (read
+    ``metrics_address``), ``None`` disables the sidecar.
+    """
+
+    def __init__(self, logger: Logger, host: str = "127.0.0.1", port: int = 0,
+                 metrics_port: int | None = 0, registry=None):
         self.logger = logger
         # handler threads share one sink: serialize (CSV writers etc. are
         # not thread-safe; same hazard the ReplayService guards against)
@@ -32,31 +39,66 @@ class LoggerService:
         self.server.register_handler("log_scalar", self._scalar)
         self.server.register_handler("log_scalars", self._scalars)
         self.server.register_handler("log_hparams", self._hparams)
+        self._metrics_server = None
+        self.registry = registry
+        if metrics_port is not None:
+            from ..obs import MetricsHTTPServer, MetricsRegistry
+
+            if self.registry is None:
+                self.registry = MetricsRegistry()
+            self._metrics_server = MetricsHTTPServer(
+                self.registry, host=host, port=metrics_port
+            )
+        if self.registry is not None:
+            self._records = self.registry.counter(
+                "rl_tpu_logger_records_total",
+                "log records ingested by the service",
+                labels=("kind",),
+            )
+        else:
+            self._records = None
 
     @property
     def address(self):
         return self.server.address
 
+    @property
+    def metrics_address(self):
+        if self._metrics_server is None:
+            return None
+        return self._metrics_server.address
+
     def start(self) -> "LoggerService":
         self.server.start()
+        if self._metrics_server is not None:
+            self._metrics_server.start()
         return self
 
     def shutdown(self):
         self.server.shutdown()
+        if self._metrics_server is not None:
+            self._metrics_server.shutdown()
+
+    def _count(self, kind: str, n: int = 1):
+        if self._records is not None:
+            self._records.inc(n, {"kind": kind})
 
     def _scalar(self, p):
         with self._lock:
             self.logger.log_scalar(p["name"], float(p["value"]), p.get("step"))
+        self._count("scalar")
         return True
 
     def _scalars(self, p):
         with self._lock:
             self.logger.log_scalars(p["metrics"], p.get("step"))
+        self._count("scalar", len(p["metrics"]))
         return True
 
     def _hparams(self, p):
         with self._lock:
             self.logger.log_hparams(p["hparams"])
+        self._count("hparams")
         return True
 
 

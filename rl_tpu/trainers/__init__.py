@@ -1,4 +1,9 @@
-from .off_policy import AsyncOffPolicyTrainer, OffPolicyConfig, OffPolicyProgram
+from .off_policy import (
+    AsyncOffPolicyTrainer,
+    OffPolicyConfig,
+    OffPolicyProgram,
+    default_device_metrics,
+)
 from .on_policy import OnPolicyConfig, OnPolicyProgram
 from .trainer import (
     CountFramesLog,
@@ -6,6 +11,7 @@ from .trainer import (
     Evaluator,
     LogScalar,
     LogTiming,
+    MetricsHook,
     Trainer,
     UTDRHook,
 )
@@ -16,6 +22,7 @@ __all__ = [
     "AsyncOffPolicyTrainer",
     "OffPolicyConfig",
     "OffPolicyProgram",
+    "default_device_metrics",
     "Trainer",
     "LogScalar",
     "LogTiming",
@@ -23,6 +30,7 @@ __all__ = [
     "EarlyStopping",
     "UTDRHook",
     "Evaluator",
+    "MetricsHook",
 ]
 
 

@@ -21,8 +21,36 @@ import optax
 from ..data import ArrayDict, ReplayBuffer
 from ..collectors.single import Collector
 from ..objectives.common import LossModule, SoftUpdate
+from ..obs.device import DeviceMetrics
 
-__all__ = ["OffPolicyConfig", "OffPolicyProgram", "AsyncOffPolicyTrainer"]
+__all__ = [
+    "OffPolicyConfig",
+    "OffPolicyProgram",
+    "AsyncOffPolicyTrainer",
+    "default_device_metrics",
+]
+
+
+def default_device_metrics() -> DeviceMetrics:
+    """The standard on-device schema for off-policy programs: update count,
+    loss/grad-norm/param-norm gauges, |TD-error| + staleness histograms
+    (the latter two only accumulate when the loss/sampler produce them)."""
+    return DeviceMetrics(
+        counters=("updates",),
+        gauges=("loss", "grad_norm", "param_norm"),
+        histograms={
+            "td_error": (0.01, 0.03, 0.1, 0.3, 1.0, 3.0, 10.0, 30.0, 100.0),
+            "staleness": (0.0, 1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0),
+        },
+    )
+
+
+def _resolve_dm(device_metrics) -> DeviceMetrics | None:
+    if device_metrics is True:
+        return default_device_metrics()
+    if device_metrics is False:
+        return None
+    return device_metrics
 
 
 @dataclasses.dataclass
@@ -48,15 +76,24 @@ class _GradUpdateMixin:
     updates fuse into one XLA program.
 
     Requires ``self.loss / self.buffer / self.config / self.optimizer /
-    self.target_update / self.priority_key``.
+    self.target_update / self.priority_key / self.device_metrics``.
+
+    The carry's fourth slot is the on-device metrics state
+    (:class:`~rl_tpu.obs.device.DeviceMetrics`); it is ``None`` when
+    metrics are disabled, which JAX treats as an empty subtree — the scan
+    structure (and thus the compiled program) is unchanged in that case.
     """
 
+    device_metrics: DeviceMetrics | None = None
+
     def _update_body(self, carry, xs):
-        params, opt_state, bstate = carry
+        params, opt_state, bstate, dm = carry
         upd_key, upd_idx = xs
         k_sample, k_loss = jax.random.split(upd_key)
         mb, bstate = self.buffer.sample(bstate, k_sample, self.config.batch_size)
         loss_val, grads, metrics = self.loss.grad(params, mb, k_loss)
+        if self.device_metrics is not None:
+            dm = self._record_update_metrics(dm, params, loss_val, grads, metrics, mb)
         if self.config.policy_delay > 1:
             do_policy = (upd_idx % self.config.policy_delay) == 0
             pk = self.config.policy_key
@@ -87,7 +124,22 @@ class _GradUpdateMixin:
         scalar_metrics = ArrayDict(
             {k: v for k, v in metrics.items() if jnp.ndim(v) == 0}
         ).set("loss", loss_val)
-        return (params, opt_state, bstate), scalar_metrics
+        return (params, opt_state, bstate, dm), scalar_metrics
+
+    def _record_update_metrics(self, dm, params, loss_val, grads, metrics, mb):
+        """Accumulate into the on-device metrics state (traced, pure)."""
+        spec = self.device_metrics
+        dm = spec.inc(dm, "updates")
+        dm = spec.set_gauge(dm, "loss", loss_val)
+        dm = spec.set_gauge(dm, "grad_norm", optax.global_norm(grads))
+        dm = spec.set_gauge(
+            dm, "param_norm", optax.global_norm(self.loss.trainable(params))
+        )
+        if "td_error" in spec.histograms and "td_error" in metrics:
+            dm = spec.observe(dm, "td_error", jnp.abs(metrics["td_error"]))
+        if "staleness" in spec.histograms and "staleness" in mb:
+            dm = spec.observe(dm, "staleness", mb["staleness"])
+        return dm
 
 
 class OffPolicyProgram(_GradUpdateMixin):
@@ -110,6 +162,7 @@ class OffPolicyProgram(_GradUpdateMixin):
         buffer: ReplayBuffer,
         config: OffPolicyConfig = OffPolicyConfig(),
         priority_key: str | None = None,
+        device_metrics: DeviceMetrics | bool | None = None,
     ):
         self.collector = collector
         self.loss = loss
@@ -118,6 +171,8 @@ class OffPolicyProgram(_GradUpdateMixin):
         # when set (e.g. "td_error"), per-sample priorities from the loss
         # metrics update the PER sampler after each gradient step
         self.priority_key = priority_key
+        # True -> default schema; a DeviceMetrics -> custom; None/False -> off
+        self.device_metrics = _resolve_dm(device_metrics)
 
         tx = [optax.adam(config.learning_rate)]
         if config.max_grad_norm is not None:
@@ -138,7 +193,7 @@ class OffPolicyProgram(_GradUpdateMixin):
         batch_struct = jax.eval_shape(self.collector.collect, params, cstate)[0]
         example = batch_struct.apply(lambda s: jnp.zeros(s.shape[strip:], s.dtype))
         bstate = self.buffer.init(example)
-        return {
+        ts = {
             "params": params,
             "opt": opt_state,
             "collector": cstate,
@@ -146,6 +201,9 @@ class OffPolicyProgram(_GradUpdateMixin):
             "rng": k_rng,
             "update_count": jnp.asarray(0, jnp.int32),
         }
+        if self.device_metrics is not None:
+            ts["obs"] = self.device_metrics.init()
+        return ts
 
     def _flatten(self, batch: ArrayDict) -> ArrayDict:
         """[T, *env_batch, …] -> [T*prod(env_batch), …], **env-major**: each
@@ -210,8 +268,10 @@ class OffPolicyProgram(_GradUpdateMixin):
 
         rng, *upd_keys = jax.random.split(ts["rng"], self.config.utd_ratio + 1)
         upd_idx = ts["update_count"] + jnp.arange(self.config.utd_ratio)
-        (params, opt_state, bstate), metrics = jax.lax.scan(
-            self._update_body, (params, ts["opt"], bstate), (jnp.stack(upd_keys), upd_idx)
+        (params, opt_state, bstate, dm), metrics = jax.lax.scan(
+            self._update_body,
+            (params, ts["opt"], bstate, ts.get("obs")),
+            (jnp.stack(upd_keys), upd_idx),
         )
         mean_metrics = jax.tree.map(lambda x: x.mean(), metrics)
         mean_metrics = mean_metrics.set("reward_mean", jnp.mean(batch["next", "reward"]))
@@ -231,7 +291,22 @@ class OffPolicyProgram(_GradUpdateMixin):
             "rng": rng,
             "update_count": ts["update_count"] + self.config.utd_ratio,
         }
+        if self.device_metrics is not None:
+            new_ts["obs"] = dm
         return new_ts, mean_metrics
+
+    def publish_device_metrics(self, ts: dict, registry=None) -> dict | None:
+        """Drain the on-device metrics state (one explicit transfer) and
+        push it into a host registry; returns the flat snapshot."""
+        if self.device_metrics is None or "obs" not in ts:
+            return None
+        if registry is None:
+            from ..obs import get_registry
+
+            registry = get_registry()
+        snap = self.device_metrics.drain(ts["obs"])
+        self.device_metrics.publish(snap, registry)
+        return self.device_metrics.to_flat(snap)
 
     def jit_train_step(self, steps_per_call: int = 1, donate: bool = True):
         """Compile ``train_step`` with the whole train state **donated** and
@@ -292,12 +367,16 @@ class AsyncOffPolicyTrainer(_GradUpdateMixin):
         buffer: ReplayBuffer,
         config: OffPolicyConfig = OffPolicyConfig(),
         priority_key: str | None = None,
+        device_metrics: DeviceMetrics | bool | None = None,
+        metrics_registry=None,
     ):
         self.collector = collector
         self.loss = loss
         self.buffer = buffer
         self.config = config
         self.priority_key = priority_key
+        self.device_metrics = _resolve_dm(device_metrics)
+        self.metrics_registry = metrics_registry
         tx = [optax.adam(config.learning_rate)]
         if config.max_grad_norm is not None:
             tx.insert(0, optax.clip_by_global_norm(config.max_grad_norm))
@@ -342,26 +421,29 @@ class AsyncOffPolicyTrainer(_GradUpdateMixin):
         params = self.loss.init_params(k_params, example.unsqueeze(0))
         opt_state = self.optimizer.init(self.loss.trainable(params))
         bstate = self.buffer.init(example)
-        return {
+        ts = {
             "params": params,
             "opt": opt_state,
             "buffer": bstate,
             "rng": k_rng,
             "update_count": jnp.asarray(0, jnp.int32),
         }
+        if self.device_metrics is not None:
+            ts["obs"] = self.device_metrics.init()
+        return ts
 
     # -- device side -----------------------------------------------------------
 
-    def _k_updates_impl(self, params, opt_state, bstate, rng, update_count):
+    def _k_updates_impl(self, params, opt_state, bstate, rng, update_count, dm=None):
         k = self.config.utd_ratio
         rng, *upd_keys = jax.random.split(rng, k + 1)
         upd_idx = update_count + jnp.arange(k)
-        (params, opt_state, bstate), metrics = jax.lax.scan(
+        (params, opt_state, bstate, dm), metrics = jax.lax.scan(
             self._update_body,
-            (params, opt_state, bstate),
+            (params, opt_state, bstate, dm),
             (jnp.stack(upd_keys), upd_idx),
         )
-        out = (params, opt_state, bstate, rng, update_count + k)
+        out = (params, opt_state, bstate, rng, update_count + k, dm)
         return out, jax.tree.map(lambda x: x.mean(), metrics)
 
     # -- host loop -------------------------------------------------------------
@@ -384,6 +466,12 @@ class AsyncOffPolicyTrainer(_GradUpdateMixin):
         )
         coll.start(ts["params"])
         frames = 0
+        registry = self.metrics_registry
+        if registry is None and self.device_metrics is not None:
+            from ..obs import get_registry
+
+            registry = get_registry()
+        pending_obs = None  # previous dispatch's dm, copy already in flight
         try:
             while frames < total_frames:
                 batch = coll.get_batch()
@@ -394,9 +482,14 @@ class AsyncOffPolicyTrainer(_GradUpdateMixin):
                 metrics = None
                 if frames >= min_frames:
                     out, metrics = self._k_updates(
-                        ts["params"], ts["opt"], ts["buffer"], ts["rng"], ts["update_count"]
+                        ts["params"],
+                        ts["opt"],
+                        ts["buffer"],
+                        ts["rng"],
+                        ts["update_count"],
+                        ts.get("obs"),
                     )
-                    params, opt_state, bstate, rng, update_count = out
+                    params, opt_state, bstate, rng, update_count, dm = out
                     ts = {
                         "params": params,
                         "opt": opt_state,
@@ -404,7 +497,23 @@ class AsyncOffPolicyTrainer(_GradUpdateMixin):
                         "rng": rng,
                         "update_count": update_count,
                     }
+                    if self.device_metrics is not None:
+                        ts["obs"] = dm
+                        # start this dispatch's device→host copy now and
+                        # publish the PREVIOUS one (already landed): the
+                        # drain lags one dispatch so it never blocks on the
+                        # in-flight K-update program
+                        DeviceMetrics.drain_async(dm)
+                        if pending_obs is not None:
+                            self.device_metrics.publish(
+                                DeviceMetrics.drain(pending_obs), registry
+                            )
+                        pending_obs = dm
                     coll.update_params(params)
                 yield ts, metrics
+            if pending_obs is not None:
+                self.device_metrics.publish(
+                    DeviceMetrics.drain(pending_obs), registry
+                )
         finally:
             coll.stop()

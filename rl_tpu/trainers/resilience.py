@@ -70,6 +70,12 @@ class PreemptionHandler:
         if not self._flag.is_set() or self._handled:
             return
         self._handled = True
+        from ..obs import get_registry, get_tracer
+
+        get_tracer().instant("preemption", {"step": int(trainer.step_count)})
+        get_registry().counter(
+            "rl_tpu_preemptions_total", "preemption signals acted on"
+        ).inc()
         _log.info(
             "preemption at step %d: checkpointing and stopping", trainer.step_count
         )

@@ -30,7 +30,16 @@ from ..record.loggers import Logger, NullLogger
 from ..utils import logger as _log
 from ..utils.timing import timeit
 
-__all__ = ["Trainer", "LogScalar", "LogTiming", "CountFramesLog", "EarlyStopping", "UTDRHook", "Evaluator"]
+__all__ = [
+    "Trainer",
+    "LogScalar",
+    "LogTiming",
+    "CountFramesLog",
+    "EarlyStopping",
+    "UTDRHook",
+    "Evaluator",
+    "MetricsHook",
+]
 
 STAGES = ("pre_step", "post_step", "post_eval", "save_checkpoint")
 
@@ -268,6 +277,63 @@ class UTDRHook:
             updates * getattr(cfg, "batch_size", 1) / max(trainer.collected_frames, 1),
             step=trainer.collected_frames,
         )
+
+
+class MetricsHook:
+    """Bridge the train loop into a :class:`~rl_tpu.obs.MetricsRegistry`.
+
+    As a ``post_step`` hook it keeps step/frame counters current, mirrors
+    each scalar metric into a labelled gauge, and (every ``drain_interval``
+    steps) drains the program's on-device metrics state
+    (``OffPolicyProgram.publish_device_metrics``) so device-side
+    loss/grad-norm/TD-histogram series appear on the same ``/metrics``
+    surface — and optionally in the experiment logger.
+    """
+
+    def __init__(
+        self,
+        registry=None,
+        prefix: str = "rl_tpu_train",
+        drain_interval: int = 10,
+        bridge_to_logger: bool = False,
+    ):
+        if registry is None:
+            from ..obs import get_registry
+
+            registry = get_registry()
+        self.registry = registry
+        self.prefix = prefix
+        self.drain_interval = drain_interval
+        self.bridge_to_logger = bridge_to_logger
+        self._steps = registry.counter(f"{prefix}_steps_total", "fused train steps")
+        self._frames = registry.counter(f"{prefix}_frames_total", "env frames collected")
+        self._scalars = registry.gauge(
+            f"{prefix}_metric", "last scalar metric per fused step", labels=("name",)
+        )
+
+    def __call__(self, trainer: Trainer, metrics: ArrayDict | None = None) -> None:
+        self._steps.set_total(trainer.step_count)
+        self._frames.set_total(trainer.collected_frames)
+        if metrics is not None:
+            for k, v in metrics.items(nested=True, leaves_only=True):
+                arr = np.asarray(v)
+                if arr.ndim == 0 and np.issubdtype(arr.dtype, np.number):
+                    self._scalars.set(float(arr), {"name": "/".join(k)})
+        if (
+            self.drain_interval
+            and trainer.step_count % self.drain_interval == 0
+            and hasattr(trainer.program, "publish_device_metrics")
+        ):
+            flat = trainer.program.publish_device_metrics(trainer.ts, self.registry)
+            if flat and self.bridge_to_logger:
+                trainer.logger.log_scalars(
+                    {
+                        f"obs/{k}": v
+                        for k, v in flat.items()
+                        if not isinstance(v, dict)
+                    },
+                    step=trainer.collected_frames,
+                )
 
 
 class Evaluator:

@@ -4,17 +4,27 @@ Usable as decorator, context manager, or explicit start/stop. On TPU, wall
 timing of jitted calls measures dispatch unless the result is blocked on, so
 ``timeit`` optionally calls ``block_until_ready`` on the wrapped function's
 output. ``jax.profiler`` spans are layered via :func:`record_function`.
+
+``timeit`` is a thin client of :class:`rl_tpu.obs.trace.TraceRecorder`:
+every timed block is also recorded as a span on the calling thread, so a
+``get_tracer().export()`` shows the same names on trainer/collector/serving
+tracks. The registry itself is shared across threads (trainer loop and the
+``AsyncHostCollector`` actor both time into it), so all mutation is behind
+a class-level lock and per-call start times live in thread-local stacks.
 """
 
 from __future__ import annotations
 
 import contextlib
 import functools
+import threading
 import time
 from collections import defaultdict
 from typing import Any, Callable
 
 import jax
+
+from ..obs.trace import get_tracer
 
 __all__ = ["timeit", "record_function", "set_profiling_enabled"]
 
@@ -36,10 +46,15 @@ class timeit:
 
     _REG: dict[str, list[float]] = defaultdict(lambda: [0.0, 0.0, 0])
     # name -> [total_s, last_s, count]
+    _REG_LOCK = threading.Lock()
 
     def __init__(self, name: str, block: bool = False):
         self.name = name
         self.block = block
+        # one decorator instance can be entered concurrently from several
+        # threads (and re-entered recursively), so starts are a
+        # thread-local stack rather than a shared attribute.
+        self._starts = threading.local()
 
     def __call__(self, fn: Callable) -> Callable:
         @functools.wraps(fn)
@@ -53,42 +68,61 @@ class timeit:
         return wrapper
 
     def __enter__(self):
-        self.t0 = time.perf_counter()
+        stack = getattr(self._starts, "stack", None)
+        if stack is None:
+            stack = self._starts.stack = []
+        tracer = get_tracer()
+        stack.append((time.perf_counter(), tracer.begin_span(self.name)))
         return self
 
     def __exit__(self, *exc):
-        dt = time.perf_counter() - self.t0
-        rec = timeit._REG[self.name]
-        rec[0] += dt
-        rec[1] = dt
-        rec[2] += 1
+        t0, span_start = self._starts.stack.pop()
+        dt = time.perf_counter() - t0
+        tracer = get_tracer()
+        tracer.end_span(self.name, span_start)
+        with timeit._REG_LOCK:
+            rec = timeit._REG[self.name]
+            rec[0] += dt
+            rec[1] = dt
+            rec[2] += 1
         return False
 
     @classmethod
     def todict(cls, percall: bool = True) -> dict[str, float]:
+        with cls._REG_LOCK:
+            items = {k: list(v) for k, v in cls._REG.items()}
         if percall:
-            return {k: v[0] / max(v[2], 1) for k, v in cls._REG.items()}
-        return {k: v[0] for k, v in cls._REG.items()}
+            return {k: v[0] / max(v[2], 1) for k, v in items.items()}
+        return {k: v[0] for k, v in items.items()}
 
     @classmethod
     def print(cls, prefix: str = "") -> None:  # noqa: A003
-        for k, v in sorted(cls._REG.items()):
+        with cls._REG_LOCK:
+            items = sorted((k, list(v)) for k, v in cls._REG.items())
+        for k, v in items:
             print(f"{prefix}{k}: total={v[0]:.4f}s count={v[2]} percall={v[0] / max(v[2], 1):.4f}s")
 
     @classmethod
     def erase(cls) -> None:
-        cls._REG.clear()
+        with cls._REG_LOCK:
+            cls._REG.clear()
 
 
 @contextlib.contextmanager
 def record_function(name: str):
-    """``jax.profiler`` trace span, active only when profiling is enabled.
+    """Host trace span, plus a ``jax.profiler`` device annotation when
+    profiling is enabled.
 
     Analog of the reference's ``_maybe_record_function``
-    (torchrl/_utils.py:470) over ``torch.profiler.record_function``.
+    (torchrl/_utils.py:470) over ``torch.profiler.record_function``. The
+    host span always goes to the process :class:`TraceRecorder` (cheap:
+    one ring-buffer append); ``jax.profiler.TraceAnnotation`` is layered
+    on only under :func:`set_profiling_enabled` so the same name shows up
+    against XLA device tracks in a combined capture.
     """
-    if _PROFILING:
-        with jax.profiler.TraceAnnotation(name):
+    with get_tracer().span(name):
+        if _PROFILING:
+            with jax.profiler.TraceAnnotation(name):
+                yield
+        else:
             yield
-    else:
-        yield

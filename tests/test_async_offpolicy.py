@@ -200,6 +200,61 @@ class TestFusedCycleTransferBound:
         total = np.asarray(jax.block_until_ready(st["priorities"])).sum()
         assert np.isfinite(total) and total > 0
 
+    def test_metrics_enabled_cycle_adds_at_most_one_drain_transfer(self, monkeypatch):
+        """PR-3 bound: the same fused cycle with a DeviceMetrics pytree
+        threaded through the carry still runs clean under
+        ``transfer_guard("disallow")`` (accumulation is fully on-device),
+        and the once-per-dispatch drain costs exactly ONE explicit
+        ``device_get`` batch — i.e. metrics add <=1 blocking device->host
+        transfer per dispatch, keeping the fused cycle at <=2 total."""
+        from rl_tpu.obs.device import DeviceMetrics
+
+        cap, B, rounds = 1 << 10, 64, 8
+        spec = DeviceMetrics(
+            counters=("updates",),
+            gauges=("mean_td",),
+            histograms={"td": (0.1, 1.0, 10.0)},
+        )
+        s = PrioritizedSampler(alpha=0.8)
+        st = s.init(cap)
+        st = s.on_write(st, jnp.arange(cap), None)
+        data = jax.random.normal(KEY, (cap, 4))
+        size = jnp.asarray(cap)
+
+        @jax.jit
+        def cycle(st, key, dm):
+            key, k = jax.random.split(key)
+            idx, _info, st = s.sample_and_update(
+                st, k, B, size, cap,
+                lambda i, _info: jnp.abs(data[i].sum(-1)) + 0.01,
+            )
+            td = jnp.abs(data[idx].sum(-1)) + 0.01
+            dm = spec.inc(dm, "updates")
+            dm = spec.set_gauge(dm, "mean_td", td.mean())
+            dm = spec.observe(dm, "td", td)
+            return st, key, dm
+
+        # compile (and build both dm pytrees) outside the guard
+        dm = spec.init()
+        st, key, _ = cycle(st, KEY, dm)
+        jax.block_until_ready(st["priorities"])
+        dm = jax.block_until_ready(spec.init())
+        with jax.transfer_guard("disallow"):
+            for _ in range(rounds):
+                st, key, dm = cycle(st, key, dm)
+        # the per-dispatch drain: async copy + ONE explicit device_get
+        calls = []
+        real_get = jax.device_get
+        monkeypatch.setattr(
+            jax, "device_get", lambda x: (calls.append(1), real_get(x))[1]
+        )
+        DeviceMetrics.drain_async(dm)
+        flat = spec.to_flat(DeviceMetrics.drain(dm))
+        assert len(calls) == 1
+        assert flat["updates"] == rounds
+        counts = np.asarray(flat["td"]["counts"])
+        assert counts.sum() == rounds * B  # every td value binned, none lost
+
 
 class TestAsyncHostCollector:
     def test_batch_schema_stamps_and_stats(self):
@@ -328,7 +383,7 @@ class TestAsyncVsSyncSAC:
                 out, m = tr_s._k_updates(
                     ts_s["params"], ts_s["opt"], bstate, ts_s["rng"], ts_s["update_count"]
                 )
-                params, opt_state, bstate, rng, uc = out
+                params, opt_state, bstate, rng, uc, _dm = out
                 ts_s = {
                     "params": params, "opt": opt_state, "buffer": bstate,
                     "rng": rng, "update_count": uc,
@@ -388,7 +443,7 @@ class TestAsyncVsSyncSAC:
             out, _m = tr_s._k_updates(
                 ts_s["params"], ts_s["opt"], bstate, ts_s["rng"], ts_s["update_count"]
             )
-            params, opt_state, bstate, rng, uc = out
+            params, opt_state, bstate, rng, uc, _dm = out
             return {
                 "params": params, "opt": opt_state, "buffer": bstate,
                 "rng": rng, "update_count": uc,

@@ -90,6 +90,50 @@ def test_no_commit_flag(tmp_path):
     assert os.path.exists(art)
 
 
+def test_metrics_sections_extracted_and_committed(tmp_path):
+    """PR-3: when the bench stdout carries "metrics" sections (device-metric
+    drains, observability overhead), the watcher distills them into a
+    METRICS json committed alongside the raw artifact."""
+
+    class MetricsRunner(FakeRunner):
+        def bench_all(self, timeout):
+            self.bench_calls.append(timeout)
+            lines = [
+                {"probe": {"platform": "tpu", "error": None}},
+                {"metric": "ppo", "value": 123.0},
+                {"per": {"value": 1.5,
+                         "metrics": {"overhead_frac": 0.01,
+                                     "device": {"updates": 50.0}}}},
+            ]
+            return 0, "".join(json.dumps(ln) + "\n" for ln in lines)
+
+    runner = MetricsRunner([_healthy()])
+    art = str(tmp_path / "bench.jsonl")
+    mart = str(tmp_path / "METRICS.json")
+    path = watch(runner, lambda s: None, max_probes=1, artifact=art,
+                 metrics_artifact=mart, sleep=lambda s: None)
+    assert path == art
+    doc = json.loads(open(mart).read())
+    assert doc["bench_metrics"]["per"]["overhead_frac"] == 0.01
+    assert doc["bench_metrics"]["per"]["device"]["updates"] == 50.0
+    assert isinstance(doc["artifact"], str) and doc["artifact"]
+    # both files land in ONE commit
+    assert len(runner.commits) == 1
+    assert runner.commits[0][0] == [art, mart]
+
+
+def test_no_metrics_sections_no_metrics_file(tmp_path):
+    """A bench stream without metrics sections (old format) must not grow a
+    stale METRICS file or change the commit set."""
+    runner = FakeRunner([_healthy()])
+    art = str(tmp_path / "bench.jsonl")
+    mart = str(tmp_path / "METRICS.json")
+    watch(runner, lambda s: None, max_probes=1, artifact=art,
+          metrics_artifact=mart, sleep=lambda s: None)
+    assert not os.path.exists(mart)
+    assert runner.commits[0][0] == [art]
+
+
 def test_probe_crash_rc_nonzero_keeps_waiting():
     runner = FakeRunner([(1, "Traceback ..."), _healthy()])
     lines = []

@@ -616,6 +616,49 @@ class TestServingService:
             svc.shutdown()
 
 
+def test_serving_service_metrics_endpoint_scrapes_prometheus_text():
+    """PR-3 surface: GET /metrics on a running ServingService returns valid
+    Prometheus text carrying KV-utilization, tokens/s, and queue-depth
+    series, and the device-side token counter reflects the decode work
+    actually done (drained once per launch, never per step)."""
+    from urllib.request import urlopen
+
+    from rl_tpu.models import ContinuousBatchingEngine, RemoteEngine, ServingService
+
+    m, params = small_model()
+    svc = ServingService(ContinuousBatchingEngine(
+        m, params, n_slots=2, block_size=8, n_blocks=33,
+        prompt_buckets=(16,), greedy=True,
+    )).start()
+    try:
+        host, port = svc.address
+        c = RemoteEngine(host, port)
+        rids = [c.submit(np.arange(5), 4), c.submit(np.arange(7), 4)]
+        c.wait_all(rids, timeout=60)
+        mhost, mport = svc.metrics_address
+        with urlopen(f"http://{mhost}:{mport}/metrics", timeout=10) as r:
+            assert r.status == 200
+            assert r.headers["Content-Type"].startswith("text/plain")
+            body = r.read().decode()
+        for series in (
+            "rl_tpu_serving_tokens_total",
+            "rl_tpu_serving_kv_utilization",
+            "rl_tpu_serving_queue_depth",
+            "rl_tpu_serving_tokens_per_second",
+            'rl_tpu_serving_completions_total{reason="length"} 2',
+        ):
+            assert series in body, series
+        tokens = [
+            float(ln.split()[-1]) for ln in body.splitlines()
+            if ln.startswith("rl_tpu_serving_tokens_total ")
+        ][0]
+        # 2 requests x 4 new tokens; prefill emits the first, decode the
+        # other 3 each — the device counter counts decode tokens
+        assert tokens == 6.0
+    finally:
+        svc.shutdown()
+
+
 def test_serving_service_concurrent_waiters_keep_their_results():
     """collect(rids) takes only the named results; a second waiter's
     finished request must survive the first waiter's polling."""

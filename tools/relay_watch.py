@@ -43,6 +43,29 @@ def _parse_last_json(text: str) -> dict | None:
     return None
 
 
+def _extract_metrics(stdout: str) -> dict:
+    """Collect every ``"metrics"`` section from a bench stdout JSONL stream,
+    keyed by sub-bench name (PR-3: device-metrics drains and observability
+    overhead ride the bench artifact as structured data, not log grep)."""
+    sections: dict = {}
+    for ln in (stdout or "").strip().splitlines():
+        try:
+            d = json.loads(ln)
+        except ValueError:
+            continue
+        if not isinstance(d, dict):
+            continue
+        for k, v in d.items():
+            # lines are either {"<name>": {...result...}} wrappers or the
+            # final aggregate with sub-results nested under their names
+            if isinstance(v, dict) and isinstance(v.get("metrics"), dict):
+                sections[k] = v["metrics"]
+        if isinstance(d.get("metrics"), dict):
+            # a bare single-mode result line: key by its headline metric
+            sections.setdefault(str(d.get("metric", "headline")), d["metrics"])
+    return sections
+
+
 class Runner:
     """Real subprocess/git backend. Tests replace this with a fake that
     implements the same three methods."""
@@ -89,6 +112,7 @@ def watch(
     bench_timeout: float = 900.0,
     max_probes: int | None = None,
     artifact: str | None = None,
+    metrics_artifact: str | None = None,
     commit: bool = True,
     require_tpu: bool = True,
     sleep=time.sleep,
@@ -128,9 +152,25 @@ def watch(
         with open(path, "w") as f:
             f.write(bout or "")
         log(f"{_utcnow()} bench rc={brc} artifact={os.path.relpath(path, REPO)}")
+        paths = [path]
+        sections = _extract_metrics(bout)
+        if sections:
+            mpath = metrics_artifact or os.path.join(REPO, "METRICS_pr3.json")
+            with open(mpath, "w") as f:
+                json.dump(
+                    {
+                        "artifact": os.path.relpath(path, REPO),
+                        "generated": _utcnow(),
+                        "bench_metrics": sections,
+                    },
+                    f, indent=2, sort_keys=True,
+                )
+                f.write("\n")
+            paths.append(mpath)
+            log(f"{_utcnow()} metrics -> {os.path.relpath(mpath, REPO)}")
         if commit:
             crc = runner.commit(
-                [path],
+                paths,
                 f"bench: record BENCH_MODE=all artifact {os.path.basename(path)} "
                 "from first healthy relay probe",
             )
@@ -149,6 +189,8 @@ def main(argv=None) -> int:
     ap.add_argument("--max-probes", type=int, default=None)
     ap.add_argument("--artifact", default=None,
                     help="artifact path (default logs/bench_<ts>.jsonl)")
+    ap.add_argument("--metrics-artifact", default=None,
+                    help="metrics-sections path (default METRICS_pr3.json)")
     ap.add_argument("--no-commit", action="store_true")
     ap.add_argument("--log-file", default=os.path.join(REPO, "logs", "relay_watch.log"))
     args = ap.parse_args(argv)
@@ -167,6 +209,7 @@ def main(argv=None) -> int:
         bench_timeout=args.bench_timeout,
         max_probes=args.max_probes,
         artifact=args.artifact,
+        metrics_artifact=args.metrics_artifact,
         commit=not args.no_commit,
     )
     return 0 if path is not None else 1
