@@ -684,15 +684,22 @@ def bench_rlhf(report: bool = True) -> dict:
     jax = _setup_jax()
     import jax.numpy as jnp
 
+    import numpy as np
     import optax
 
+    from rl_tpu.data import ArrayDict
     from rl_tpu.models import (
         TransformerConfig,
         TransformerLM,
         generate,
         token_log_probs,
     )
+    from rl_tpu.models.generate import generate_flops, train_step_flops
+    from rl_tpu.models.serving import ContinuousBatchingEngine
+    from rl_tpu.obs import DeviceMetrics
     from rl_tpu.objectives.llm.grpo import GRPOLoss, mc_advantage
+    from rl_tpu.trainers.grpo import RolloutPipeline
+    from rl_tpu.weight_update.schemes import DevicePutScheme
 
     on_tpu = jax.devices()[0].platform != "cpu"
     if _TIER == "smoke":
@@ -734,10 +741,12 @@ def bench_rlhf(report: bool = True) -> dict:
     prompts = jax.random.randint(key, (B, Tp), 0, cfg.vocab_size)
     pmask = jnp.ones((B, Tp), jnp.float32)
 
+    eos_id = 0  # a real stop id: rows that sample it stop accruing mask
+
     @jax.jit
     def rollout(params, key):
         out = generate(
-            model, params, prompts, pmask, key, max_new_tokens=Tn, eos_id=None
+            model, params, prompts, pmask, key, max_new_tokens=Tn, eos_id=eos_id
         )
         lp = jnp.concatenate(
             [jnp.zeros((B, Tp)), out.response_log_probs], axis=1
@@ -751,8 +760,6 @@ def bench_rlhf(report: bool = True) -> dict:
     def train_step(params, opt_state, tokens, sample_lp, amask, key):
         reward = jax.random.normal(key, (B,))
         adv = mc_advantage(reward, jnp.arange(B) // 4, max(1, (B + 3) // 4))
-        from rl_tpu.data import ArrayDict
-
         batch = ArrayDict(
             tokens=tokens, sample_log_prob=sample_lp,
             assistant_mask=amask, advantage=adv,
@@ -763,12 +770,57 @@ def bench_rlhf(report: bool = True) -> dict:
         upd, opt_state = opt.update(g, opt_state, params)
         return optax.apply_updates(params, upd), opt_state, v
 
-    # warm/compile both programs
+    # the framework's actual update path (GRPOTrainer._update_impl shape):
+    # ONE donated dispatch, gradient-accumulation scan over microbatches
+    # with token-count weighting, step metrics accumulated on device
+    mbs = max(1, B // 2)
+    n_mb = B // mbs
+    dm_spec = DeviceMetrics(counters=("updates", "tokens"), gauges=("loss",))
+
+    def _mb_train(params, opt_state, dm, tokens, sample_lp, amask, key):
+        reward = jax.random.normal(key, (B,))
+        adv = mc_advantage(reward, jnp.arange(B) // 4, max(1, (B + 3) // 4))
+        full = dict(
+            tokens=tokens, sample_log_prob=sample_lp,
+            assistant_mask=amask, advantage=adv,
+        )
+        xs = jax.tree.map(
+            lambda x: x.reshape((n_mb, mbs) + x.shape[1:]), full
+        )
+
+        def body(carry, mb):
+            gsum, vsum, wsum = carry
+            w = loss.microbatch_weight(mb)
+            (v, _), g = jax.value_and_grad(
+                lambda p: loss(p, mb), has_aux=True
+            )(params)
+            gsum = jax.tree.map(lambda a, b: a + w * b, gsum, g)
+            return (gsum, vsum + w * v, wsum + w), None
+
+        zero = jnp.zeros((), jnp.float32)
+        (gsum, vsum, wsum), _ = jax.lax.scan(
+            body, (jax.tree.map(jnp.zeros_like, params), zero, zero), xs
+        )
+        wsum = jnp.maximum(wsum, 1e-8)
+        g = jax.tree.map(lambda a: a / wsum, gsum)
+        upd, opt_state = opt.update(g, opt_state, params)
+        dm = dm_spec.inc(dm, "updates", 1.0)
+        dm = dm_spec.inc(dm, "tokens", jnp.sum(amask.astype(jnp.float32)))
+        dm = dm_spec.set_gauge(dm, "loss", vsum / wsum)
+        return optax.apply_updates(params, upd), opt_state, dm
+
+    mb_train = jax.jit(_mb_train, donate_argnums=(1,))
+
+    # warm/compile the three programs
     k1, k2 = jax.random.split(key)
     tc0 = time.perf_counter()
     tokens, lp, amask = rollout(params, k1)
     params2, opt_state2, v = train_step(params, opt_state, tokens, lp, amask, k2)
+    dm = dm_spec.init()
+    os_live = jax.tree.map(jnp.copy, opt_state)  # mb_train donates its opt state
+    p_live, os_live, dm = mb_train(params, os_live, dm, tokens, lp, amask, k2)
     jax.block_until_ready(v)
+    jax.block_until_ready(jax.tree.leaves(p_live)[0])
     compile_s = time.perf_counter() - tc0
 
     reps = 1 if _TIER != "full" else 3
@@ -786,18 +838,111 @@ def bench_rlhf(report: bool = True) -> dict:
             params, opt_state, tokens, lp, amask, jax.random.key(20 + i)
         )
     jax.block_until_ready(v)
-    t_train = (time.perf_counter() - t0) / reps
+    t_train_single = (time.perf_counter() - t0) / reps
 
-    # train step model FLOPs: fwd+bwd = 6 * n_params_matmul * tokens, plus
-    # causal attention 12*L*B*T^2*D/2 each for fwd, doubled for bwd recompute
-    # excluded (standard MFU accounting counts algorithmic FLOPs only)
-    emb = cfg.vocab_size * cfg.d_model
-    matmul_params = n_params - emb  # positional+token embeds are gathers
-    flops_fwd = 2 * matmul_params * B * T + 2 * emb * B * T  # + lm head
-    attn_flops = cfg.n_layers * 4 * B * cfg.n_heads * T * T * cfg.head_dim / 2
-    train_flops = 3 * (flops_fwd + attn_flops)
+    t0 = time.perf_counter()
+    for i in range(reps):
+        p_live, os_live, dm = mb_train(
+            params, os_live, dm, tokens, lp, amask, jax.random.key(20 + i)
+        )
+    jax.block_until_ready(jax.tree.leaves(p_live)[0])
+    t_train = (time.perf_counter() - t0) / reps  # headline: microbatched
+
+    train_flops = train_step_flops(cfg, n_params, B, T)
     peak = _peak_flops(jax)
     train_mfu = train_flops / t_train / peak
+    gen_mfu = generate_flops(cfg, n_params, B, Tp, Tn) / t_gen / peak
+
+    # -- pipelined leg: engine rollout (per-request budgets stop decode at
+    # max(budget) steps, not Tn) overlapping the donated update via
+    # RolloutPipeline + DevicePutScheme. On a 1-core CPU slice the XLA
+    # programs serialize (overlap_frac ~ 0) and the win is structural —
+    # fewer decode steps + no blocking host syncs; on TPU generation and
+    # update overlap and overlap_frac reports how much.
+    # per-request response budgets: realistic rollouts stop at eos well
+    # before the cap, with varied lengths across the batch. The engine's
+    # on-device budget/eos stop means decode ends at max(budget) steps;
+    # the fixed-batch leg's static scan always pays Tn. max = 0.625*Tn.
+    budgets = [max(1, int(Tn * f)) for f in (0.625, 0.375, 0.5, 0.4375)]
+    chunk = max(1, Tn // 8)
+    slots = min(B, 8)
+    eng = ContinuousBatchingEngine(
+        model, params,
+        n_slots=slots, block_size=16,
+        n_blocks=slots * (-(-T // 16)) + 1,
+        prompt_buckets=(Tp,), eos_id=eos_id,
+        temperature=1.0, seed=0, decode_chunk=chunk,
+    )
+    scheme = DevicePutScheme(jax.devices()[0])
+    scheme.push(params)
+    prompts_np = np.asarray(prompts)
+    gen_times: list = []
+
+    def collect_fn(p, k):
+        tg0 = time.perf_counter()
+        eng.params = p
+        eng._key = jax.random.fold_in(k, 0)
+        rids = [
+            eng.submit(prompts_np[i], budgets[i % len(budgets)])
+            for i in range(B)
+        ]
+        rid_row = {r: i for i, r in enumerate(rids)}
+        resp = np.zeros((B, Tn), np.int32)
+        rlp = np.zeros((B, Tn), np.float32)
+        rm = np.zeros((B, Tn), bool)
+
+        def absorb(done):
+            for rid, f in done.items():
+                i = rid_row.pop(rid)
+                n = len(f.tokens)
+                resp[i, :n] = f.tokens
+                rlp[i, :n] = f.log_probs
+                rm[i, :n] = True
+
+        while eng.step():
+            absorb(eng.harvest())
+        absorb(eng.harvest())
+        toks = jnp.concatenate([prompts, jnp.asarray(resp)], axis=1)
+        slp = jnp.concatenate(
+            [jnp.zeros((B, Tp)), jnp.asarray(rlp)], axis=1
+        )
+        am = jnp.concatenate(
+            [jnp.zeros((B, Tp), bool), jnp.asarray(rm)], axis=1
+        )
+        gen_times.append(time.perf_counter() - tg0)
+        return toks, slp, am
+
+    pipe = RolloutPipeline(scheme, collect_fn, jax.random.key(7)).start()
+    p_live = params
+    # warm TWO pipelined cycles: the engine compiles on the first collect
+    # and again on the second (first collect against re-placed weights)
+    for j in range(2):
+        (ptok, plp, pam), _ = pipe.get()
+        p_live, os_live, dm = mb_train(
+            p_live, os_live, dm, ptok, plp, pam, jax.random.key(30 + j)
+        )
+        scheme.push(p_live)
+        jax.block_until_ready(jax.tree.leaves(p_live)[0])
+
+    reps_p = 2 if _TIER == "smoke" else 3
+    stale_max = 0
+    t0 = time.perf_counter()
+    for i in range(reps_p):
+        (ptok, plp, pam), ver = pipe.get()
+        stale_max = max(stale_max, scheme.version - ver)
+        p_live, os_live, dm = mb_train(
+            p_live, os_live, dm, ptok, plp, pam, jax.random.key(40 + i)
+        )
+        scheme.push(p_live)
+        DeviceMetrics.drain_async(dm)  # lagged drain: never blocks the update
+    jax.block_until_ready(jax.tree.leaves(p_live)[0])
+    cycle_p = (time.perf_counter() - t0) / reps_p
+    pipe.stop()
+    gen_p = sum(gen_times[-reps_p:]) / reps_p
+    overlap_frac = max(
+        0.0, (gen_p + t_train - cycle_p) / max(1e-9, min(gen_p, t_train))
+    )
+    dm_flat = dm_spec.to_flat(DeviceMetrics.drain(dm))
 
     cycle = t_gen + t_train
     toks_per_sec = B * T / cycle  # full-batch tokens through one RLHF cycle
@@ -807,11 +952,25 @@ def bench_rlhf(report: bool = True) -> dict:
         "unit": "tokens/s",
         "vs_baseline": round(train_mfu / 0.30, 3),
         "train_mfu": round(train_mfu, 4),
+        "train_mfu_single": round(train_flops / t_train_single / peak, 4),
+        "gen_mfu": round(gen_mfu, 4),
         "gen_tokens_per_sec": round(B * Tn / t_gen, 1),
         "train_tokens_per_sec": round(B * T / t_train, 1),
+        "microbatch": [n_mb, mbs],
         "n_params": n_params,
         "shape": [B, Tp, Tn],
         "compile_s": round(compile_s, 2),
+        "pipeline": {
+            "value": round(B * T / cycle_p, 1),
+            "unit": "tokens/s",
+            "cycle_s": round(cycle_p, 4),
+            "gen_s": round(gen_p, 4),
+            "train_s": round(t_train, 4),
+            "overlap_frac": round(overlap_frac, 3),
+            "budgets": budgets,
+            "staleness_max": int(stale_max),
+        },
+        "metrics": {"train": dm_flat, "engine": eng.metrics_snapshot()},
         "error": None,
     }
     out.update(_platform_tag(jax))

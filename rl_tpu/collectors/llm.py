@@ -87,10 +87,16 @@ class LLMCollector:
                 lambda toks, mask: token_log_probs(model, ref_params, toks, mask)
             )
 
-    def _engine_generate(self, params, toks, pmask, key):
+    def _engine_generate(self, params, toks, pmask, key, on_row_done=None):
         """Continuous-batching rollout shaped like ``generate``'s output:
         the G requests stream through engine slots; early-eos rows free
-        their slot (and KV blocks) immediately."""
+        their slot (and KV blocks) immediately.
+
+        ``on_row_done(row)`` fires as each request's tokens land on the
+        host — its row of the shared resp/rlp/rmask buffers is final at
+        that point — so callers can consume completions first-come
+        (score a prompt group's rewards while other groups still decode;
+        the ``AsyncHostCollector`` harvest pattern)."""
         from ..models.generate import GenerateOutput
         from ..models.serving import ContinuousBatchingEngine
 
@@ -122,20 +128,32 @@ class LLMCollector:
             eng.submit(toks_np[g][mask_np[g]], self.max_new_tokens)
             for g in range(G)
         ]
-        done = eng.run()
+        rid_row = {rid: g for g, rid in enumerate(rids)}
         N = self.max_new_tokens
         resp = np.zeros((G, N), np.int32)
         rlp = np.zeros((G, N), np.float32)
         rmask = np.zeros((G, N), bool)
-        for g, rid in enumerate(rids):
-            f = done[rid]
-            n = len(f.tokens)
-            resp[g, :n] = f.tokens
-            rlp[g, :n] = f.log_probs
-            # every produced token INCLUDING a terminal eos is real —
-            # generate()'s response_mask convention (valid = was_alive;
-            # the policy must see gradient on the stop decision)
-            rmask[g, :n] = True
+
+        def _absorb(done):
+            for rid, f in done.items():
+                g = rid_row.pop(rid)
+                n = len(f.tokens)
+                resp[g, :n] = f.tokens
+                rlp[g, :n] = f.log_probs
+                # every produced token INCLUDING a terminal eos is real —
+                # generate()'s response_mask convention (valid = was_alive;
+                # the policy must see gradient on the stop decision)
+                rmask[g, :n] = True
+                if on_row_done is not None:
+                    on_row_done(g, resp, rmask)
+
+        # drive the engine incrementally, consuming completions while the
+        # remaining slots keep decoding (run() would block to the end)
+        while eng.step():
+            _absorb(eng.harvest())
+        _absorb(eng.harvest())
+        if rid_row:
+            raise RuntimeError(f"engine lost requests: {sorted(rid_row)}")
         full = jnp.concatenate([toks, jnp.asarray(resp)], axis=1)
         full_mask = jnp.concatenate(
             [jnp.asarray(mask_np), jnp.asarray(rmask)], axis=1
@@ -148,22 +166,61 @@ class LLMCollector:
             full_mask=full_mask,
         )
 
+    def _engine_collect(self, params, toks, pmask, key, state, group_ids):
+        """Engine rollout with FIRST-COME group scoring: the moment a
+        prompt group's last response lands, its rewards are computed on
+        the host while the other groups' slots keep decoding — reward
+        work overlaps device decode instead of serializing after it.
+        Falls back to end-of-rollout scoring when the env has no
+        ``score_rows``."""
+        can_score = hasattr(self.env, "score_rows")
+        G = toks.shape[0]
+        rewards = np.zeros(G, np.float32)
+        group_rows: dict[int, list[int]] = {}
+        for row, g in enumerate(np.asarray(group_ids)):
+            group_rows.setdefault(int(g), []).append(row)
+        remaining = {g: len(rows) for g, rows in group_rows.items()}
+
+        def on_row_done(row, resp, rmask):
+            if not can_score:
+                return
+            g = int(group_ids[row])
+            remaining[g] -= 1
+            if remaining[g] == 0:
+                rows = group_rows[g]
+                rewards[rows] = self.env.score_rows(state, resp, rmask, rows)
+
+        out = self._engine_generate(params, toks, pmask, key, on_row_done)
+        if not can_score:
+            return out, None
+        return out, rewards
+
     def collect(self, params: Any, key: jax.Array) -> ArrayDict:
         """One GRPO batch: ArrayDict with tokens/attention_mask/
-        assistant_mask/sample_log_prob/advantage/reward (+ref_log_prob)."""
-        if self.weight_scheme is not None:
+        assistant_mask/sample_log_prob/advantage/reward (+ref_log_prob).
+
+        ``params=None`` pulls the weight scheme's latest snapshot;
+        explicitly-passed params win (a pipelined caller snapshots
+        ``(params, version)`` atomically and must generate with exactly
+        that snapshot, not whatever the scheme holds by generation time).
+        """
+        if params is None:
+            if self.weight_scheme is None:
+                raise ValueError("params=None requires a weight_scheme to pull from")
             params = self.weight_scheme.pull()
         state, group_ids = self.env.sample_batch(self.num_prompts)
         toks = jnp.asarray(state["tokens"])
         pmask = jnp.asarray(state["attention_mask"], jnp.float32)
         if self.continuous_batching:
-            out = self._engine_generate(params, toks, pmask, key)
+            out, rewards = self._engine_collect(params, toks, pmask, key, state, group_ids)
         else:
             out = self._gen(params, toks, pmask, key)
+            rewards = None
 
         resp = np.asarray(out.response_tokens)
         rmask = np.asarray(out.response_mask)
-        _, rewards, _ = self.env.step(state, resp, rmask)
+        if rewards is None:
+            _, rewards, _ = self.env.step(state, resp, rmask)
 
         G = toks.shape[0]
         P_len = toks.shape[1]

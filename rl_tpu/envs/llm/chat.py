@@ -62,19 +62,42 @@ class ChatEnv:
             **batch,
         }
 
+    def _score_one(self, history: History, tokens_row: np.ndarray, mask_row: np.ndarray) -> tuple[History, float]:
+        toks = tokens_row[mask_row.astype(bool)]
+        text = (
+            self.tokenizer.decode(toks.tolist())
+            if hasattr(self.tokenizer, "decode")
+            else " ".join(map(str, toks.tolist()))
+        )
+        h2 = history.append("assistant", text)
+        return h2, self.reward_fn(h2, toks)
+
+    def score_rows(
+        self,
+        state: dict,
+        response_tokens: np.ndarray,
+        response_mask: np.ndarray,
+        rows: Sequence[int],
+    ) -> np.ndarray:
+        """Score a SUBSET of the batch (first-come group harvesting: the
+        collector scores each prompt group as its last response completes,
+        overlapping host reward work with the remaining decode). Row
+        arrays are indexed by the FULL batch position; returns rewards
+        aligned with ``rows``. State histories are not advanced — this is
+        the scoring half of :meth:`step` only."""
+        rewards = np.zeros(len(rows), np.float32)
+        for j, i in enumerate(rows):
+            _, rewards[j] = self._score_one(
+                state["histories"][i], response_tokens[i], response_mask[i]
+            )
+        return rewards
+
     def step(self, state: dict, response_tokens: np.ndarray, response_mask: np.ndarray) -> tuple[dict, np.ndarray, np.ndarray]:
         """Append responses, score, report done. Returns (state, reward, done)."""
         histories = []
         rewards = np.zeros(len(state["histories"]), np.float32)
         for i, h in enumerate(state["histories"]):
-            toks = response_tokens[i][response_mask[i].astype(bool)]
-            text = (
-                self.tokenizer.decode(toks.tolist())
-                if hasattr(self.tokenizer, "decode")
-                else " ".join(map(str, toks.tolist()))
-            )
-            h2 = h.append("assistant", text)
-            rewards[i] = self.reward_fn(h2, toks)
+            h2, rewards[i] = self._score_one(h, response_tokens[i], response_mask[i])
             histories.append(h2)
         turns = state["turns"] + 1
         done = turns >= self.max_turns

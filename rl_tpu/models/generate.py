@@ -28,6 +28,8 @@ __all__ = [
     "generate",
     "token_log_probs",
     "token_log_probs_with_aux",
+    "train_step_flops",
+    "generate_flops",
 ]
 
 
@@ -124,6 +126,48 @@ def generate(
         response_log_probs=resp_lp,
         full_mask=full_mask,
     )
+
+
+def _matmul_flops_per_token(cfg, n_params: int) -> float:
+    """Forward matmul FLOPs per token: 2 FLOPs per weight per token for
+    every matmul parameter, plus the (tied) LM head. Embedding lookups are
+    gathers, not matmuls, so the token embedding is excluded from the body
+    and re-enters only through the head projection."""
+    emb = cfg.vocab_size * cfg.d_model
+    return 2.0 * (n_params - emb) + 2.0 * emb
+
+
+def train_step_flops(cfg, n_params: int, batch_size: int, seq_len: int) -> float:
+    """Model FLOPs of one fwd+bwd (+optimizer-excluded) step over a
+    [batch_size, seq_len] batch — the standard 3x-forward MFU accounting
+    (bwd ~= 2x fwd; remat recompute is NOT algorithmic work and is
+    excluded, so remat shows up as lower measured MFU, as it should)."""
+    n_tokens = batch_size * seq_len
+    fwd = _matmul_flops_per_token(cfg, n_params) * n_tokens
+    # causal attention: QK^T + AV, 2 matmuls x 2 FLOPs/MAC, triangular /2
+    attn = cfg.n_layers * 4 * batch_size * cfg.n_heads * seq_len * seq_len * cfg.head_dim / 2
+    return 3.0 * (fwd + attn)
+
+
+def generate_flops(
+    cfg, n_params: int, batch_size: int, prompt_len: int, new_tokens: float
+) -> float:
+    """Model FLOPs of one KV-cache rollout: a causal prefill over the
+    prompt, then ``new_tokens`` single-token decode steps each attending
+    over the growing context. ``new_tokens`` may be fractional (mean
+    tokens per row under early eos / per-request budgets)."""
+    per_tok = _matmul_flops_per_token(cfg, n_params)
+    prefill = per_tok * batch_size * prompt_len
+    prefill_attn = (
+        cfg.n_layers * 4 * batch_size * cfg.n_heads * prompt_len * prompt_len * cfg.head_dim / 2
+    )
+    decode = per_tok * batch_size * new_tokens
+    # decode step t attends over prompt_len + t keys (full rows, no /2)
+    mean_ctx = prompt_len + new_tokens / 2.0
+    decode_attn = (
+        cfg.n_layers * 4 * batch_size * cfg.n_heads * new_tokens * mean_ctx * cfg.head_dim
+    )
+    return prefill + prefill_attn + decode + decode_attn
 
 
 def token_log_probs(

@@ -761,6 +761,21 @@ class ContinuousBatchingEngine:
             self._drain_one()
         return True
 
+    def harvest(self) -> dict[int, FinishedRequest]:
+        """Pop the requests finished SO FAR without blocking on the rest.
+
+        First-come consumption: callers interleave ``step()`` /
+        ``harvest()`` to process completions (decode + score rewards on
+        the host) while the remaining slots keep decoding — the
+        ``AsyncHostCollector`` harvest pattern applied to serving. A
+        ``run()`` after harvesting returns only the not-yet-harvested
+        completions."""
+        if not self.finished:
+            return {}
+        out = {f.rid: f for f in self.finished}
+        self.finished.clear()
+        return out
+
     def run(self) -> dict[int, FinishedRequest]:
         """Drain the queue; returns THIS run's {rid: FinishedRequest}.
 

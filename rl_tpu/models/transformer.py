@@ -54,6 +54,13 @@ class TransformerConfig:
     moe_experts: int = 0
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
+    # rematerialize each block's activations in the backward pass (training
+    # forward only — cache paths never differentiate). remat_policy picks
+    # what XLA may keep: "none" recomputes everything, "dots" saves matmul
+    # outputs (jax.checkpoint_policies.checkpoint_dots) — the usual MFU/
+    # memory trade for gradient-accumulation microbatching.
+    remat: bool = False
+    remat_policy: str = "none"
 
     @property
     def head_dim(self) -> int:
@@ -368,6 +375,21 @@ class _Block(nn.Module):
         return x + y, new_cache
 
 
+def _remat_policy(name: str):
+    if name in (None, "none"):
+        return None  # save nothing: full recompute in the backward
+    policies = {
+        "dots": jax.checkpoint_policies.checkpoint_dots,
+        "dots_no_batch": jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims,
+    }
+    try:
+        return policies[name]
+    except KeyError:
+        raise ValueError(
+            f"remat_policy must be one of none|dots|dots_no_batch, got {name!r}"
+        ) from None
+
+
 class TransformerLM(nn.Module):
     """GPT-style LM: tokens [B, T] -> logits [B, T, V]."""
 
@@ -390,9 +412,15 @@ class TransformerLM(nn.Module):
         x = emb(tokens) + pos_emb(positions)
 
         new_caches = [] if cache is not None else None
+        block_cls = _Block
+        if cfg.remat and cache is None:
+            # per-block remat on the training forward only: the KV-cache
+            # serving path never runs a backward, so checkpointing it would
+            # just disable CSE for nothing
+            block_cls = nn.remat(_Block, policy=_remat_policy(cfg.remat_policy))
         for i in range(cfg.n_layers):
             layer_cache = cache[i] if cache is not None else None
-            x, nc = _Block(cfg, name=f"h{i}")(x, attention_mask, layer_cache)
+            x, nc = block_cls(cfg, name=f"h{i}")(x, attention_mask, layer_cache)
             if cache is not None:
                 new_caches.append(nc)
         x = nn.LayerNorm(dtype=cfg.dtype, name="ln_f")(x)

@@ -84,6 +84,22 @@ class GRPOLoss(LossModule):
     def init_params(self, key, td):
         raise NotImplementedError("GRPOLoss wraps an externally-initialized model")
 
+    def microbatch_weight(self, batch: ArrayDict) -> jax.Array:
+        """Weight making gradient accumulation over microbatches EXACT.
+
+        The loss normalizes over the batch — by assistant-token count
+        (default) or by sequence count (``per_seq_norm``) — so summing
+        per-microbatch gradients directly would over-weight short
+        microbatches. Scaling microbatch i's gradient by ``w_i`` and
+        dividing the accumulated sum by ``sum(w_i)`` reproduces the
+        full-batch gradient bit-for-bit (up to float reassociation):
+        each term's denominator cancels against its weight.
+        """
+        m = batch["assistant_mask"]
+        if self.per_seq_norm:
+            return jnp.asarray(m.shape[0], jnp.float32)
+        return jnp.sum(m.astype(jnp.float32))
+
     def _objective(self, ratio, adv, mask):
         clipped = jnp.clip(ratio, 1.0 - self.eps_low, 1.0 + self.eps_high)
         gain = jnp.minimum(ratio * adv, clipped * adv)

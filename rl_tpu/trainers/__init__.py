@@ -47,10 +47,10 @@ def __getattr__(name):
         from . import algorithms as _alg
 
         return getattr(_alg, name)
-    if name == "GRPOTrainer":
-        from .grpo import GRPOTrainer
+    if name in ("GRPOTrainer", "PipelinedGRPOTrainer", "RolloutPipeline"):
+        from . import grpo as _grpo
 
-        return GRPOTrainer
+        return getattr(_grpo, name)
     if name == "PreemptionHandler":
         from .resilience import PreemptionHandler
 

@@ -9,6 +9,21 @@ attention over a "context" mesh axis for long sequences), weights move
 through a :class:`~rl_tpu.weight_update.DevicePutScheme`, and the KL penalty
 is shaped into the reward before group advantages.
 
+Two trainers share the machinery:
+
+- :class:`GRPOTrainer` — the sequential cycle (collect → update → push),
+  with the update running as a donated gradient-accumulation microbatch
+  ``lax.scan`` and step metrics accumulated on device
+  (:class:`~rl_tpu.obs.DeviceMetrics`, drained lagged-one-dispatch — no
+  per-step blocking host sync).
+- :class:`PipelinedGRPOTrainer` — the grpo-async shape (reference
+  sota-implementations/grpo/grpo-async.py; Podracer arXiv:2104.06272):
+  generation for step k+1 runs in a background thread against the
+  previous weight version while the learner updates on batch k.
+  :class:`RolloutPipeline` bounds staleness at its queue depth — with the
+  default ``max_pending=1`` every consumed batch is at most ONE version
+  behind the trainer (off-by-one), which the trainer asserts.
+
 >>> ds = arithmetic_dataset(64, max_operand=4)
 >>> t = GRPOTrainer(ds)            # builds tokenizer/model/env/collector
 >>> hist = t.train(50)             # hist["reward"] rises
@@ -18,6 +33,8 @@ is shaped into the reward before group advantages.
 from __future__ import annotations
 
 import dataclasses
+import queue
+import threading
 from typing import Any, Callable
 
 import jax
@@ -26,6 +43,7 @@ import numpy as np
 import optax
 
 from ..collectors.llm import LLMCollector
+from ..data import ArrayDict
 from ..data.llm.tokenizer import SimpleTokenizer
 from ..envs.llm.chat import DatasetChatEnv
 from ..envs.llm.datasets import QADataset
@@ -38,10 +56,11 @@ from ..models import (
     token_log_probs,
     token_log_probs_with_aux,
 )
+from ..obs import DeviceMetrics
 from ..objectives.llm.grpo import GRPOLoss
 from ..weight_update.schemes import DevicePutScheme
 
-__all__ = ["GRPOTrainer"]
+__all__ = ["GRPOTrainer", "PipelinedGRPOTrainer", "RolloutPipeline"]
 
 
 class GRPOTrainer:
@@ -56,6 +75,16 @@ class GRPOTrainer:
         kl_coeff: KL(π‖π_ref) reward-shaping coefficient (π_ref = init).
         scorer: reward override; default exact-match + dense arithmetic
             credit against ``dataset.answers``.
+        microbatch_size: gradient-accumulation microbatch rows (must
+            divide ``num_prompts * group_repeats``). The update stays ONE
+            donated dispatch — a ``lax.scan`` over microbatches with
+            token-count-weighted accumulation, numerically equivalent to
+            the full-batch update — so activation memory scales with the
+            microbatch while the effective batch stays whole. ``None``
+            (default) = single microbatch (the full batch).
+        remat / remat_policy: per-block activation rematerialization on
+            the TRAINING forward (``TransformerConfig.remat``) — pairs
+            with small microbatches to fit long sequences.
     """
 
     def __init__(
@@ -76,6 +105,9 @@ class GRPOTrainer:
         seed: int = 0,
         logger: Any = None,
         continuous_batching: bool = False,
+        microbatch_size: int | None = None,
+        remat: bool = False,
+        remat_policy: str = "none",
     ):
         self.tokenizer = tokenizer or SimpleTokenizer(dataset.corpus())
         self.dataset = dataset
@@ -91,9 +123,21 @@ class GRPOTrainer:
                 max_seq_len=total_len,
                 dtype=jnp.float32,
             )
+        B = num_prompts * group_repeats
+        self.microbatch_size = microbatch_size
+        if microbatch_size is not None and B % microbatch_size:
+            raise ValueError(
+                f"microbatch_size ({microbatch_size}) must divide the batch "
+                f"(num_prompts * group_repeats = {B})"
+            )
         # one param tree, two attention routes: KV-cache generation cannot
         # ring (decode steps are T=1); the teacher-forced training forward can
         self.gen_model = TransformerLM(model_config)
+        train_cfg = model_config
+        if remat:
+            train_cfg = dataclasses.replace(
+                train_cfg, remat=True, remat_policy=remat_policy
+            )
         if mesh is not None:
             ctx = mesh.shape["context"]
             if total_len % ctx:
@@ -102,10 +146,8 @@ class GRPOTrainer:
                     f"length {total_len} for ring attention"
                 )
             train_cfg = dataclasses.replace(
-                model_config, attention_impl="ring", mesh=mesh
+                train_cfg, attention_impl="ring", mesh=mesh
             )
-        else:
-            train_cfg = model_config
         self.train_model = TransformerLM(train_cfg)
         self.mesh = mesh
 
@@ -175,14 +217,20 @@ class GRPOTrainer:
         self.opt_state = self.opt.init(self.params)
         self._key = jax.random.key(seed + 1)
 
-        def _update(params, opt_state, batch):
-            (v, m), g = jax.value_and_grad(
-                lambda p: self.loss(p, batch), has_aux=True
-            )(params)
-            upd, opt_state = self.opt.update(g, opt_state)
-            return optax.apply_updates(params, upd), opt_state, v, m
+        # step metrics accumulate ON DEVICE inside the update program and
+        # are drained lagged-one-dispatch (AsyncOffPolicyTrainer pattern):
+        # step() never blocks on the update it just dispatched
+        self._dm_spec = DeviceMetrics(
+            counters=("updates", "tokens"),
+            gauges=("loss", "reward", "kl_approx"),
+        )
+        self._dm = self._dm_spec.init()
+        self._pending_dm: dict | None = None
 
-        self._update = jax.jit(_update)
+        # donate the rotating optimizer state, NOT the params: the weight
+        # scheme (and a pipelined generator thread pulling from it) may
+        # alias the same device buffers a same-device device_put returns
+        self._update = jax.jit(self._update_impl, donate_argnums=(1,))
         self._eval_gen = jax.jit(
             lambda p, t, m, k: generate(
                 self.gen_model, p, t, m, k,
@@ -193,25 +241,111 @@ class GRPOTrainer:
         )
         self.history: dict[str, list[float]] = {"reward": [], "loss": []}
 
-    def step(self) -> dict[str, float]:
-        """collect → update → push weights. Returns step metrics."""
-        self._key, k = jax.random.split(self._key)
-        batch = self.collector.collect(self.params, k)
-        if self._mesh_replicated is not None:
-            batch = jax.device_put(batch, self._mesh_replicated)
-        self.params, self.opt_state, v, m = self._update(
-            self.params, self.opt_state, batch
+    # -- the donated, microbatched update program ------------------------
+
+    def _update_impl(self, params, opt_state, batch, dm):
+        """One dispatch: gradient-accumulation ``lax.scan`` over
+        microbatches, optimizer update, on-device metrics. Microbatch
+        gradients are weighted by ``GRPOLoss.microbatch_weight`` (the
+        assistant-token count) so the accumulated gradient equals the
+        full-batch gradient exactly — the loss normalizes per token, and
+        the per-microbatch denominators cancel against the weights."""
+        B = batch["tokens"].shape[0]
+        mbs = self.microbatch_size or B
+        n_mb = B // mbs
+
+        def loss_and_grad(mb):
+            return jax.value_and_grad(
+                lambda p: self.loss(p, mb), has_aux=True
+            )(params)
+
+        if n_mb == 1:
+            (v, m), g = loss_and_grad(batch)
+            kl = m["kl_approx"] if "kl_approx" in m else jnp.zeros(())
+        else:
+            xs = jax.tree.map(
+                lambda x: x.reshape((n_mb, mbs) + x.shape[1:]), batch
+            )
+
+            def body(carry, mb):
+                gsum, vsum, klsum, wsum = carry
+                w = self.loss.microbatch_weight(mb)
+                (v, m), g = loss_and_grad(mb)
+                kl = m["kl_approx"] if "kl_approx" in m else jnp.zeros(())
+                gsum = jax.tree.map(lambda a, b: a + w * b, gsum, g)
+                return (gsum, vsum + w * v, klsum + w * kl, wsum + w), None
+
+            zero_g = jax.tree.map(jnp.zeros_like, params)
+            zero = jnp.zeros((), jnp.float32)
+            (gsum, vsum, klsum, wsum), _ = jax.lax.scan(
+                body, (zero_g, zero, zero, zero), xs
+            )
+            wsum = jnp.maximum(wsum, 1e-8)
+            g = jax.tree.map(lambda a: a / wsum, gsum)
+            v = vsum / wsum
+            kl = klsum / wsum
+
+        upd, opt_state = self.opt.update(g, opt_state)
+        params = optax.apply_updates(params, upd)
+
+        spec = self._dm_spec
+        dm = spec.inc(dm, "updates", 1.0)
+        dm = spec.inc(
+            dm, "tokens", jnp.sum(batch["assistant_mask"].astype(jnp.float32))
         )
-        self.scheme.push(self.params)
+        dm = spec.set_gauge(dm, "loss", v)
+        dm = spec.set_gauge(dm, "reward", jnp.mean(batch["reward"]))
+        dm = spec.set_gauge(dm, "kl_approx", kl)
+        return params, opt_state, dm
+
+    # -- step / train ----------------------------------------------------
+
+    def _consume(self, batch: ArrayDict) -> dict[str, float]:
+        """Update on a collected batch, publish weights, drain metrics."""
+        self.params, self.opt_state, self._dm = self._update(
+            self.params, self.opt_state, batch, self._dm
+        )
+        self.scheme.push(self.params)  # non-blocking dispatch
         self.policy_version.bump()
-        out = {
-            "reward": float(batch["reward"].mean()),
-            "loss": float(v),
-            "kl_approx": float(m["kl_approx"]) if "kl_approx" in m else 0.0,
-        }
+        out = self._drain_metrics()
         self.history["reward"].append(out["reward"])
         self.history["loss"].append(out["loss"])
         return out
+
+    def _drain_metrics(self) -> dict[str, float]:
+        """Lagged-one-dispatch drain: start the async device→host copy for
+        THIS update's metrics, materialize the PREVIOUS update's (whose
+        copy landed while we collected the batch in between). The first
+        step drains its own dispatch — it blocks on compile anyway. Step
+        metrics therefore lag one step from the second step on."""
+        DeviceMetrics.drain_async(self._dm)
+        landed = self._pending_dm if self._pending_dm is not None else self._dm
+        self._pending_dm = self._dm
+        flat = self._dm_spec.to_flat(DeviceMetrics.drain(landed))
+        return {
+            "reward": flat["reward"],
+            "loss": flat["loss"],
+            "kl_approx": flat["kl_approx"],
+        }
+
+    def metrics_snapshot(self) -> dict:
+        """Host view of the on-device step metrics (and the serving
+        engine's, when rollouts run through it). Reads the already-landed
+        lagged state — never blocks an in-flight update."""
+        landed = self._pending_dm if self._pending_dm is not None else self._dm
+        out = dict(self._dm_spec.to_flat(DeviceMetrics.drain(landed)))
+        eng = getattr(self.collector, "_engine", None)
+        if eng is not None:
+            out["engine"] = eng.metrics_snapshot()
+        return out
+
+    def step(self) -> dict[str, float]:
+        """collect → update → push weights. Returns step metrics."""
+        self._key, k = jax.random.split(self._key)
+        batch = self.collector.collect(None, k)  # scheme snapshot
+        if self._mesh_replicated is not None:
+            batch = jax.device_put(batch, self._mesh_replicated)
+        return self._consume(batch)
 
     def train(self, steps: int, log_interval: int = 10) -> dict[str, list[float]]:
         for i in range(steps):
@@ -239,3 +373,180 @@ class GRPOTrainer:
             text = self.tokenizer.decode(toks.tolist())
             hits += em(h.append("assistant", text), toks)
         return hits / len(state["histories"])
+
+
+class RolloutPipeline:
+    """Background rollout producer with a BOUNDED staleness guarantee.
+
+    A daemon thread loops: atomically snapshot ``(params, version)`` from
+    the weight scheme (``pull_versioned``), run ``collect_fn(params,
+    key)``, and put ``(batch, version)`` on a bounded queue. The consumer
+    (the learner) pops batches, updates, and pushes new weights.
+
+    Staleness bound: a ticket semaphore (initially ``max_pending``)
+    gates every snapshot; the consumer releases one ticket when it POPS
+    a batch. A bounded queue alone is NOT enough — the blocked ``put``
+    unblocks the instant the consumer pops, letting the producer
+    snapshot again before the learner's update lands, and that batch
+    would trail by two versions by the time it is consumed. With
+    tickets, generation k+1 starts only after batch k is popped, which
+    itself happens only after update k−1 pushed version k — so the
+    snapshot is ≥ version k and the batch is consumed at version k+1:
+    staleness ≤ 1 (generalizing, ≤ ``max_pending``). Popping releases
+    the ticket BEFORE the update runs, so generation k+1 still overlaps
+    update k — that is the pipeline. The key stream splits identically
+    to the sequential trainer's, so the FIRST pipelined batch is
+    bit-identical to the first sequential batch from the same seed.
+    """
+
+    def __init__(
+        self,
+        scheme,
+        collect_fn: Callable[[Any, jax.Array], Any],
+        key: jax.Array,
+        max_pending: int = 1,
+    ):
+        self.scheme = scheme
+        self.collect_fn = collect_fn
+        self.max_pending = max_pending
+        self._key = key
+        self._q: queue.Queue = queue.Queue(maxsize=max_pending)
+        self._tickets = threading.Semaphore(max_pending)
+        self._stop = threading.Event()
+        self._error: BaseException | None = None
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> "RolloutPipeline":
+        if self._thread is not None:
+            return self
+        self._thread = threading.Thread(
+            target=self._run, name="grpo-rollout", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    @property
+    def running(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    def _run(self):
+        try:
+            while not self._stop.is_set():
+                if not self._tickets.acquire(timeout=0.05):
+                    continue
+                self._key, k = jax.random.split(self._key)
+                params, version = self.scheme.pull_versioned()
+                batch = self.collect_fn(params, k)
+                self._put((batch, version))
+        except BaseException as e:  # surfaced on the consumer's next get
+            self._error = e
+
+    def _put(self, item):
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.05)
+                return
+            except queue.Full:
+                continue
+
+    def get(self, timeout: float = 120.0) -> tuple[Any, int]:
+        """Pop the next ``(batch, version_generated_at)``. Re-raises any
+        producer-thread error."""
+        deadline = timeout
+        while True:
+            if self._error is not None:
+                raise RuntimeError("rollout pipeline producer failed") from self._error
+            try:
+                item = self._q.get(timeout=min(0.1, deadline))
+                # ticket back BEFORE the caller's update: generation for
+                # the next batch overlaps the update on this one
+                self._tickets.release()
+                return item
+            except queue.Empty:
+                deadline -= 0.1
+                if deadline <= 0:
+                    raise TimeoutError(
+                        f"no rollout batch within {timeout}s "
+                        f"(producer alive: {self.running})"
+                    ) from None
+
+    def stop(self):
+        self._stop.set()
+        # unblock a producer stuck on a full queue, then join
+        while True:
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                break
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            self._thread = None
+
+
+class PipelinedGRPOTrainer(GRPOTrainer):
+    """GRPO with generation/training overlap (off-by-one staleness).
+
+    While the learner runs the update for step k, the background
+    :class:`RolloutPipeline` already generates batch k+1 against the
+    previous pushed weights. Every consumed batch's ``policy_version``
+    (the scheme version its weights were pulled at) is asserted to be
+    ≥ the trainer's current version − ``max_pending`` — the off-by-one
+    invariant for the default depth of 1. Rollouts default to the
+    continuous-batching engine (EOS'd rows free their slots; completed
+    prompt groups are reward-scored first-come while others decode).
+
+    Call :meth:`close` (or use as a context manager) to stop the
+    generator thread; it is a daemon, so leaking it cannot hang exit.
+    """
+
+    def __init__(self, dataset, *args, max_pending: int = 1, **kw):
+        kw.setdefault("continuous_batching", True)
+        super().__init__(dataset, *args, **kw)
+        self.max_pending = max_pending
+        self.staleness_history: list[int] = []
+        self._pipeline: RolloutPipeline | None = None
+
+    def _ensure_pipeline(self) -> RolloutPipeline:
+        if self._pipeline is None:
+            self._pipeline = RolloutPipeline(
+                self.scheme,
+                lambda params, k: self.collector.collect(params, k),
+                self._key,
+                max_pending=self.max_pending,
+            ).start()
+        return self._pipeline
+
+    def step(self) -> dict[str, float]:
+        batch, version = self._ensure_pipeline().get()
+        staleness = self.scheme.version - version
+        self.staleness_history.append(int(staleness))
+        if staleness > self.max_pending:
+            raise RuntimeError(
+                f"staleness invariant violated: batch generated at version "
+                f"{version}, trainer at {self.scheme.version} "
+                f"(bound {self.max_pending})"
+            )
+        # restamp with the version the GENERATOR snapshotted — the
+        # PolicyVersion transform stamped inside collect, racing the
+        # learner's bump; the snapshot is the authoritative value
+        B = batch["reward"].shape[0]
+        batch = batch.set(
+            "policy_version", np.full(B, version, np.int32)
+        )
+        if self._mesh_replicated is not None:
+            batch = jax.device_put(batch, self._mesh_replicated)
+        out = self._consume(batch)
+        out["staleness"] = float(staleness)
+        return out
+
+    def close(self):
+        if self._pipeline is not None:
+            self._pipeline.stop()
+            self._pipeline = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

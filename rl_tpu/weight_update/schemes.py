@@ -24,6 +24,7 @@ from __future__ import annotations
 import json
 import os
 import tempfile
+import threading
 from typing import Any
 
 import jax
@@ -42,6 +43,19 @@ class WeightSyncScheme:
     def pull(self) -> Any:
         raise NotImplementedError
 
+    def pull_versioned(self) -> tuple[Any, int]:
+        """Atomic ``(params, version)`` snapshot.
+
+        A pipelined consumer (generation thread overlapping the learner's
+        update) must know WHICH weights it generated with — reading
+        ``pull()`` and ``version`` separately races with a concurrent
+        ``push`` between the two reads and can stamp a batch one version
+        off, breaking the off-by-one staleness invariant the learner
+        asserts. In-process schemes take their publish lock around both
+        reads; subclasses without internal locking may override.
+        """
+        return self.pull(), self.version
+
     @property
     def version(self) -> int:
         raise NotImplementedError
@@ -54,15 +68,21 @@ class SharedProgramScheme(WeightSyncScheme):
     def __init__(self):
         self._params = None
         self._version = 0
+        self._lock = threading.Lock()
 
     def push(self, params):
-        self._params = params
-        self._version += 1
+        with self._lock:
+            self._params = params
+            self._version += 1
 
     def pull(self):
         if self._params is None:
             raise RuntimeError("no params pushed yet")
         return self._params
+
+    def pull_versioned(self):
+        with self._lock:
+            return self.pull(), self._version
 
     @property
     def version(self):
@@ -70,26 +90,42 @@ class SharedProgramScheme(WeightSyncScheme):
 
 
 class DevicePutScheme(WeightSyncScheme):
-    """Re-placement onto the rollout sharding (mesh-to-mesh broadcast)."""
+    """Re-placement onto the rollout sharding (mesh-to-mesh broadcast).
+
+    ``push`` is **non-blocking**: ``jax.device_put`` only enqueues the
+    copy/collective and returns future-backed arrays, so the learner can
+    publish right after dispatching its update and the transfer cost hides
+    under the running program. Consumers that pass the pulled params into
+    a jitted call simply queue behind the copy — no host sync anywhere.
+    """
 
     def __init__(self, target_sharding):
         self.target_sharding = target_sharding
         self._params = None
         self._version = 0
+        self._lock = threading.Lock()
 
     def push(self, params):
+        # dispatch the placement OUTSIDE the lock (it can compile on first
+        # use); only the publication of (params, version) is serialized
         if isinstance(self.target_sharding, (dict,)) or hasattr(self.target_sharding, "keys"):
-            self._params = jax.tree.map(
+            placed = jax.tree.map(
                 lambda x, s: jax.device_put(x, s), params, self.target_sharding
             )
         else:
-            self._params = jax.device_put(params, self.target_sharding)
-        self._version += 1
+            placed = jax.device_put(params, self.target_sharding)
+        with self._lock:
+            self._params = placed
+            self._version += 1
 
     def pull(self):
         if self._params is None:
             raise RuntimeError("no params pushed yet")
         return self._params
+
+    def pull_versioned(self):
+        with self._lock:
+            return self.pull(), self._version
 
     @property
     def version(self):

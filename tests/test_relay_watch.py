@@ -122,6 +122,37 @@ def test_metrics_sections_extracted_and_committed(tmp_path):
     assert runner.commits[0][0] == [art, mart]
 
 
+def test_rlhf_pipeline_subresult_distilled(tmp_path):
+    """PR-4: the rlhf sub-bench reports an overlapped-cycle ``pipeline``
+    sub-result; the watcher must split it into the committed METRICS json
+    next to the device-metric sections (the PER/async_collect pattern)."""
+
+    class PipelineRunner(FakeRunner):
+        def bench_all(self, timeout):
+            self.bench_calls.append(timeout)
+            lines = [
+                {"metric": "ppo", "value": 123.0},
+                {"rlhf": {"value": 181.2,
+                          "pipeline": {"value": 265.3, "overlap_frac": 0.0,
+                                       "staleness_max": 1},
+                          "metrics": {"train": {"updates": 7.0},
+                                      "engine": {"decode_steps": 480}}}},
+            ]
+            return 0, "".join(json.dumps(ln) + "\n" for ln in lines)
+
+    runner = PipelineRunner([_healthy()])
+    art = str(tmp_path / "bench.jsonl")
+    mart = str(tmp_path / "METRICS.json")
+    watch(runner, lambda s: None, max_probes=1, artifact=art,
+          metrics_artifact=mart, sleep=lambda s: None)
+    doc = json.loads(open(mart).read())
+    rlhf = doc["bench_metrics"]["rlhf"]
+    assert rlhf["pipeline"]["value"] == 265.3
+    assert rlhf["pipeline"]["staleness_max"] == 1
+    assert rlhf["train"]["updates"] == 7.0  # metrics still ride along
+    assert runner.commits[0][0] == [art, mart]
+
+
 def test_no_metrics_sections_no_metrics_file(tmp_path):
     """A bench stream without metrics sections (old format) must not grow a
     stale METRICS file or change the commit set."""

@@ -46,7 +46,21 @@ def _parse_last_json(text: str) -> dict | None:
 def _extract_metrics(stdout: str) -> dict:
     """Collect every ``"metrics"`` section from a bench stdout JSONL stream,
     keyed by sub-bench name (PR-3: device-metrics drains and observability
-    overhead ride the bench artifact as structured data, not log grep)."""
+    overhead ride the bench artifact as structured data, not log grep).
+
+    The rlhf sub-bench's ``pipeline`` sub-result (overlapped-cycle
+    throughput, overlap_frac, staleness bound) is distilled the same way —
+    it lands under the sub-bench's key as a ``pipeline`` entry, like the
+    PER/async_collect timing splits."""
+
+    def _section(v: dict) -> dict:
+        sec: dict = {}
+        if isinstance(v.get("metrics"), dict):
+            sec.update(v["metrics"])
+        if isinstance(v.get("pipeline"), dict):
+            sec["pipeline"] = v["pipeline"]
+        return sec
+
     sections: dict = {}
     for ln in (stdout or "").strip().splitlines():
         try:
@@ -58,11 +72,14 @@ def _extract_metrics(stdout: str) -> dict:
         for k, v in d.items():
             # lines are either {"<name>": {...result...}} wrappers or the
             # final aggregate with sub-results nested under their names
-            if isinstance(v, dict) and isinstance(v.get("metrics"), dict):
-                sections[k] = v["metrics"]
-        if isinstance(d.get("metrics"), dict):
+            if isinstance(v, dict):
+                sec = _section(v)
+                if sec:
+                    sections[k] = {**sections.get(k, {}), **sec}
+        sec = _section(d)
+        if sec:
             # a bare single-mode result line: key by its headline metric
-            sections.setdefault(str(d.get("metric", "headline")), d["metrics"])
+            sections.setdefault(str(d.get("metric", "headline")), sec)
     return sections
 
 
