@@ -1637,6 +1637,197 @@ def bench_async_collect(report: bool = True) -> dict:
     return out
 
 
+def bench_chaos(report: bool = True) -> dict:
+    """BENCH_MODE=chaos: resilience-subsystem cost model — the two numbers
+    that decide whether the subsystem is allowed near production loops.
+
+    1. ``injector_overhead_frac``: steady-state cost of an ENABLED but idle
+       FaultInjector. The same off-policy SAC workload (host envs, async
+       collector, donated K-update program) is timed in alternating windows
+       with injection disabled and under an injector whose only fault can
+       never fire — every hook is then live and the update dispatch carries
+       the poison operand. Best-of-R per config; bound <2% (``overhead_ok``).
+    2. ``recovery_latency_s``: wall-clock cost of one supervised recovery.
+       The collector actor thread is crashed deterministically mid-run; the
+       latency is the excess wall of the batch that spans the crash
+       (supervisor backoff + env pool re-reset + queue refill) over the
+       clean-batch median.
+    """
+    jax = _setup_jax()
+    import numpy as np
+
+    from rl_tpu.collectors import AsyncHostCollector, ThreadedEnvPool
+    from rl_tpu.data import DeviceStorage, PrioritizedSampler, ReplayBuffer
+    from rl_tpu.data.specs import Bounded, Composite, Unbounded
+    from rl_tpu.modules import (
+        MLP,
+        ConcatMLP,
+        NormalParamExtractor,
+        ProbabilisticActor,
+        TDModule,
+        TDSequential,
+        TanhNormal,
+    )
+    from rl_tpu.objectives import SACLoss
+    from rl_tpu.obs import MetricsRegistry
+    from rl_tpu.resilience import Fault, FaultInjector, Supervisor, injection
+    from rl_tpu.trainers import AsyncOffPolicyTrainer, OffPolicyConfig
+
+    n_envs = _T(smoke=2, cpu=4, full=8)
+    fpb = _T(smoke=32, cpu=64, full=128)
+    window = _T(smoke=2 * 32, cpu=4 * 64, full=8 * 128)  # frames per window
+    reps = _T(smoke=2, cpu=3, full=4)
+    n_batches = _T(smoke=6, cpu=8, full=10)  # recovery run length
+
+    class _ChaosEnv:
+        """Pure-host toy env: no gymnasium, deterministic, microsecond
+        steps — the timing signal is the resilience machinery, not env
+        physics."""
+
+        def __init__(self, seed=0, horizon=64):
+            self._rng = np.random.default_rng(seed)
+            self._t = 0
+            self.horizon = horizon
+            self.observation_spec = Composite(observation=Unbounded((2,)))
+            self.action_spec = Bounded(shape=(1,), low=-1.0, high=1.0)
+
+        def _obs(self):
+            return {"observation": self._rng.normal(size=2).astype(np.float32)}
+
+        def reset(self, seed=None):
+            if seed is not None:
+                self._rng = np.random.default_rng(seed)
+            self._t = 0
+            return self._obs()
+
+        def step(self, action):
+            self._t += 1
+            a = float(np.asarray(action).reshape(-1)[0])
+            return (self._obs(), np.float32(1.0 - (a - 0.3) ** 2), False,
+                    self._t >= self.horizon)
+
+        def close(self):
+            pass
+
+    net = TDSequential(
+        TDModule(MLP(out_features=2, num_cells=(64, 64)),
+                 ["observation"], ["raw"]),
+        TDModule(NormalParamExtractor(), ["raw"], ["loc", "scale"]),
+    )
+    sac = SACLoss(ProbabilisticActor(net, TanhNormal),
+                  ConcatMLP(out_features=1, num_cells=(64, 64)))
+
+    def policy(p, td, k):
+        return sac.actor(p["actor"], td, k)
+
+    # a plan whose single fault can never fire: hooks live, zero chaos
+    idle_plan = {"offpolicy.update": Fault("nan", at=(10**9,))}
+
+    # -- 1. armed-but-idle injector overhead -----------------------------
+    pool = ThreadedEnvPool([lambda i=i: _ChaosEnv(seed=i)
+                            for i in range(n_envs)])
+    coll = AsyncHostCollector(pool, policy, frames_per_batch=fpb, seed=0)
+    cfg = OffPolicyConfig(batch_size=32, utd_ratio=1, learning_rate=3e-4,
+                          init_random_frames=fpb)
+    tr = AsyncOffPolicyTrainer(
+        coll, sac, ReplayBuffer(DeviceStorage(1 << 13), PrioritizedSampler()),
+        cfg, priority_key="td_error",
+        device_metrics=True, metrics_registry=MetricsRegistry(),
+    )
+    ts = tr.init(jax.random.key(0))
+    idle_reg = MetricsRegistry()
+    idle_inj = FaultInjector(idle_plan, registry=idle_reg)
+
+    def run(frames, armed):
+        nonlocal ts
+        if armed:
+            with injection(idle_inj):
+                for ts, _m in tr.train(ts, total_frames=frames):
+                    pass
+        else:
+            for ts, _m in tr.train(ts, total_frames=frames):
+                pass
+        jax.block_until_ready(ts["params"])
+
+    t0 = time.perf_counter()
+    run(2 * fpb, armed=False)  # compile the plain trace
+    run(2 * fpb, armed=True)  # compile the poison-carrying trace
+    compile_s = time.perf_counter() - t0
+
+    walls: dict = {False: [], True: []}
+    for _ in range(reps):
+        for armed in (False, True):  # interleave to decorrelate drift
+            t0 = time.perf_counter()
+            run(window, armed)
+            walls[armed].append(time.perf_counter() - t0)
+    pool.close()
+    wall_off = min(walls[False])
+    wall_armed = min(walls[True])
+    overhead_frac = wall_armed / wall_off - 1.0
+
+    # -- 2. supervised recovery latency ----------------------------------
+    reg = MetricsRegistry()
+    sup = Supervisor(max_restarts=3, backoff_base_s=0.01, backoff_max_s=0.05,
+                     registry=reg)
+    pool_r = ThreadedEnvPool([lambda i=i: _ChaosEnv(seed=i)
+                              for i in range(n_envs)])
+    coll_r = AsyncHostCollector(pool_r, None, frames_per_batch=fpb, seed=0,
+                                supervisor=sup)
+    crash_inj = FaultInjector(
+        {"collector.actor_loop": Fault("crash", at=(n_batches // 2,))},
+        registry=reg,
+    )
+    batch_walls = []
+    try:
+        with injection(crash_inj):
+            coll_r.start()
+            for _ in range(n_batches):
+                t0 = time.perf_counter()
+                coll_r.get_batch(timeout=120)
+                batch_walls.append(time.perf_counter() - t0)
+    finally:
+        coll_r.stop()
+        sup.stop()
+        pool_r.close()
+    clean_batch_s = float(np.median(batch_walls))
+    recovery_latency_s = max(0.0, max(batch_walls) - clean_batch_s)
+    restarts = sup.restarts("async-collector")
+
+    out = {
+        "metric": "chaos_recovery_latency_s",
+        "value": round(recovery_latency_s, 4),
+        "unit": "s",
+        # <1.0 = the idle injector is inside its 2% budget
+        "vs_baseline": round(overhead_frac / 0.02, 3),
+        "injector_overhead_frac": round(overhead_frac, 4),
+        "overhead_ok": bool(overhead_frac < 0.02),
+        "recovery_latency_s": round(recovery_latency_s, 4),
+        "clean_batch_s": round(clean_batch_s, 4),
+        "restarts": restarts,
+        "idle_faults_fired": len(idle_inj.fired),  # must be 0
+        "wall_off_s": round(wall_off, 3),
+        "wall_armed_s": round(wall_armed, 3),
+        "n_envs": n_envs,
+        "frames_per_batch": fpb,
+        "window_frames": window,
+        "reps": reps,
+        "compile_s": round(compile_s, 2),
+        "metrics": {
+            "injector_overhead_frac": round(overhead_frac, 4),
+            "overhead_ok": bool(overhead_frac < 0.02),
+            "recovery_latency_s": round(recovery_latency_s, 4),
+            "clean_batch_s": round(clean_batch_s, 4),
+            "restarts": restarts,
+            "idle_faults_fired": len(idle_inj.fired),
+        },
+        "error": None,
+    }
+    out.update(_platform_tag(jax))
+    if report:
+        print(json.dumps(out), flush=True)
+    return out
+
+
 def _parse_last_json(text: str) -> dict | None:
     for ln in reversed((text or "").strip().splitlines()):
         try:
@@ -1735,7 +1926,8 @@ def bench_all():
     print(json.dumps({"probe": probe}), flush=True)
 
     weights = {"ppo": 2.0, "rlhf": 1.4, "pixel": 1.2, "hopper": 1.0,
-               "sac": 1.0, "per": 1.0, "async_collect": 0.8, "serve": 0.8}
+               "sac": 1.0, "per": 1.0, "async_collect": 0.8, "serve": 0.8,
+               "chaos": 0.6}
     deadline = _START + _TIMEOUT - 30.0  # safety margin for the final print
     pending = list(weights)
     results: dict = {}
@@ -1875,6 +2067,7 @@ if __name__ == "__main__":
             "sac": bench_sac,
             "per": bench_per,
             "async_collect": bench_async_collect,
+            "chaos": bench_chaos,
         }[mode]()
         timer.cancel()
         _maybe_write_metrics(_result)

@@ -78,6 +78,7 @@ class AsyncHostCollector:
         straggler_wait_s: float = 0.01,
         poll_interval_s: float = 2e-4,
         registry: Any = None,
+        supervisor: Any = None,
     ):
         self.pool = pool
         self.policy = jax.jit(policy) if policy is not None else None
@@ -91,6 +92,11 @@ class AsyncHostCollector:
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self._error: BaseException | None = None
+        # optional rl_tpu.resilience.Supervisor: the actor loop becomes a
+        # supervised child — crashes restart it (the loop re-resets the
+        # pool) instead of silently landing in self._error
+        self._supervisor = supervisor
+        self._child: Any = None
         # params handoff: the trainer publishes (params, version) under a
         # lock; the actor thread snapshots the pair at each send phase so a
         # whole policy call uses one consistent version
@@ -132,19 +138,35 @@ class AsyncHostCollector:
     # -- lifecycle ------------------------------------------------------------
 
     def start(self, params: Any = None, key: jax.Array | None = None) -> "AsyncHostCollector":
-        if self._thread is not None:
+        if self._thread is not None or self._child is not None:
             raise RuntimeError("AsyncHostCollector already started")
         self._params = params
         self._key = key if key is not None else jax.random.PRNGKey(self._seed)
         self._stop.clear()
-        self._thread = threading.Thread(
-            target=self._run, name="rl-tpu-async-collector", daemon=True
-        )
-        self._thread.start()
+        if self._supervisor is not None:
+            self._child = self._supervisor.spawn(
+                "async-collector", self._collect_loop, on_giveup=self._on_giveup
+            )
+        else:
+            self._thread = threading.Thread(
+                target=self._run, name="rl-tpu-async-collector", daemon=True
+            )
+            self._thread.start()
         return self
+
+    def _on_giveup(self, exc: BaseException) -> None:
+        self._error = exc
+
+    def _alive(self) -> bool:
+        if self._child is not None:
+            return self._child.is_alive()
+        return self._thread is not None and self._thread.is_alive()
 
     def stop(self) -> None:
         self._stop.set()
+        if self._child is not None:
+            self._child.stop(timeout=10)
+            self._child = None
         if self._thread is not None:
             self._thread.join(timeout=10)
             self._thread = None
@@ -156,7 +178,7 @@ class AsyncHostCollector:
                 break
 
     def __enter__(self):
-        if self._thread is None:
+        if self._thread is None and self._child is None:
             self.start(self._params)
         return self
 
@@ -187,7 +209,7 @@ class AsyncHostCollector:
             try:
                 return self._queue.get(timeout=0.05)
             except queue.Empty:
-                if self._thread is None or not self._thread.is_alive():
+                if not self._alive():
                     if self._error is not None:
                         continue  # surface the error on the next spin
                     return None
@@ -240,6 +262,8 @@ class AsyncHostCollector:
             self._error = e
 
     def _collect_loop(self) -> None:
+        from ..resilience.faults import fault_point
+
         pool = self.pool
         n = pool.num_envs
         min_ready = max(1, math.ceil(self.min_ready_fraction * n))
@@ -253,6 +277,7 @@ class AsyncHostCollector:
         last_harvest = time.monotonic()
 
         while not self._stop.is_set():
+            fault_point("collector.actor_loop")  # chaos site (crash/delay)
             # -- send phase: dispatch actions to every env holding fresh obs
             if needs_send:
                 actions, version = self._actions_for(obs)

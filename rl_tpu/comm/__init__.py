@@ -281,6 +281,12 @@ class _JSONHandler(socketserver.StreamRequestHandler):
                 resp = {"status": "ok", "out": fn(req.get("payload"))}
         except Exception as e:  # noqa: BLE001
             resp = {"status": "error", "out": repr(e)}
+        # chaos site: the handler already ran — a drop here models a reply
+        # lost on the wire, which only a client-side retry can survive
+        from ..resilience.faults import should_drop
+
+        if should_drop("comm.server.reply"):
+            return
         self.wfile.write((json.dumps(resp) + "\n").encode())
 
 
@@ -310,10 +316,19 @@ class TCPCommandServer:
 
 
 class TCPCommandClient:
-    def __init__(self, host: str, port: int, timeout: float = 10.0):
-        self.host, self.port, self.timeout = host, port, timeout
+    """One-shot JSON-RPC client with optional transport retry.
 
-    def call(self, command: str, payload: Any = None) -> Any:
+    ``retry`` is a :class:`rl_tpu.resilience.RetryPolicy`; when set,
+    ``call(..., idempotent=True)`` survives refused connections, timeouts,
+    and dropped replies. Server-side handler errors come back as
+    ``RuntimeError`` and are never retried — the request reached the peer.
+    """
+
+    def __init__(self, host: str, port: int, timeout: float = 10.0, retry: Any = None):
+        self.host, self.port, self.timeout = host, port, timeout
+        self.retry = retry
+
+    def _call_once(self, command: str, payload: Any) -> Any:
         with socket.create_connection((self.host, self.port), timeout=self.timeout) as s:
             s.sendall((json.dumps({"command": command, "payload": payload}) + "\n").encode())
             data = b""
@@ -322,7 +337,18 @@ class TCPCommandClient:
                 if not chunk:
                     break
                 data += chunk
+        if not data:
+            # server accepted the connection but never replied (dropped
+            # reply / handler crash): transport-shaped, hence retryable
+            raise ConnectionError(
+                f"empty reply from {self.host}:{self.port} for {command!r}"
+            )
         resp = json.loads(data)
         if resp["status"] != "ok":
             raise RuntimeError(f"remote command {command!r} failed: {resp['out']}")
         return resp["out"]
+
+    def call(self, command: str, payload: Any = None, idempotent: bool = True) -> Any:
+        if self.retry is None:
+            return self._call_once(command, payload)
+        return self.retry.call(self._call_once, command, payload, idempotent=idempotent)

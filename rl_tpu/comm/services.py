@@ -115,15 +115,26 @@ class TCPServiceRegistry:
 
 
 class connect_registry:
-    """Client handle to a remote TCPServiceRegistry."""
+    """Client handle to a remote TCPServiceRegistry.
 
-    def __init__(self, host: str, port: int, timeout: float = 10.0):
+    ``retry`` (a :class:`rl_tpu.resilience.RetryPolicy`) makes lookups and
+    heartbeats survive transient transport failures. ``register`` with
+    ``replace=False`` is NOT idempotent — a dropped reply does not prove
+    the registration was dropped, and replaying it would raise a spurious
+    "already registered" — so it only retries when ``replace=True``.
+    """
+
+    def __init__(self, host: str, port: int, timeout: float = 10.0, retry: Any = None):
         from . import TCPCommandClient
 
-        self._cli = TCPCommandClient(host, port, timeout=timeout)
+        self._cli = TCPCommandClient(host, port, timeout=timeout, retry=retry)
 
     def register(self, name: str, value: Any, replace: bool = False) -> None:
-        self._cli.call("register", {"name": name, "value": value, "replace": replace})
+        self._cli.call(
+            "register",
+            {"name": name, "value": value, "replace": replace},
+            idempotent=bool(replace),
+        )
 
     def unregister(self, name: str) -> None:
         self._cli.call("unregister", {"name": name})

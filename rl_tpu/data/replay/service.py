@@ -96,21 +96,30 @@ class ReplayService:
 
 
 class RemoteReplayBuffer:
-    """Client view of a served buffer (reference _DistributedReplayClient)."""
+    """Client view of a served buffer (reference _DistributedReplayClient).
 
-    def __init__(self, host: str, port: int, timeout: float = 30.0):
-        self.client = TCPCommandClient(host, port, timeout=timeout)
+    With ``retry`` set, ``size``/``update_priority`` survive transport
+    failures. ``extend`` and ``sample`` never retry: the server mutates its
+    state before the reply is written, so replaying a call whose reply was
+    lost would double-insert (or burn an extra sampler step).
+    """
+
+    def __init__(self, host: str, port: int, timeout: float = 30.0, retry: Any = None):
+        self.client = TCPCommandClient(host, port, timeout=timeout, retry=retry)
 
     def extend(self, items: ArrayDict) -> int:
-        return self.client.call("extend", _encode(items))
+        return self.client.call("extend", _encode(items), idempotent=False)
 
     def sample(self, batch_size: int | None = None) -> ArrayDict:
-        return _decode(self.client.call("sample", {"batch_size": batch_size}))
+        return _decode(
+            self.client.call("sample", {"batch_size": batch_size}, idempotent=False)
+        )
 
     def size(self) -> int:
         return self.client.call("size")
 
     def update_priority(self, index, priority) -> None:
+        # idempotent: writing the same priorities twice lands the same state
         self.client.call(
             "update_priority",
             {"index": np.asarray(index).tolist(), "priority": np.asarray(priority).tolist()},

@@ -852,11 +852,10 @@ class LoadBalancer:
         return eng.pending()
 
     def _kv_utilization(self, eng) -> float:
-        total = len(eng.free_blocks) + sum(
-            int((row >= 0).sum()) for row in eng.table
-        )
-        used = total - len(eng.free_blocks)
-        return used / max(total, 1)
+        # O(1) from the engine's free-list accounting — select_engine runs
+        # per submit, so an O(blocks) table rescan here was pure overhead
+        used = eng._n_pool_blocks - len(eng.free_blocks)
+        return used / max(eng._n_pool_blocks, 1)
 
     # -- selection -------------------------------------------------------------
 
@@ -917,15 +916,28 @@ class ServingService:
     binds an ephemeral port, read back from ``metrics_address``; ``None``
     disables it). The service owns its registry by default so replica
     services never cross-publish.
+
+    Resilience: ``max_queue`` caps admission — a submit past the cap gets
+    an explicit ``{"saturated": true, "retry_after": s}`` shed reply
+    instead of silently deepening the queue (clients back off and retry);
+    passing a ``supervisor`` (:class:`rl_tpu.resilience.Supervisor`) puts
+    the stepper thread under supervision, so an engine crash restarts the
+    stepper within budget instead of wedging the service.
     """
 
     def __init__(self, engine: ContinuousBatchingEngine, host: str = "127.0.0.1",
-                 port: int = 0, metrics_port: int | None = 0, registry=None):
+                 port: int = 0, metrics_port: int | None = 0, registry=None,
+                 max_queue: int | None = None, retry_after_s: float = 0.25,
+                 supervisor=None):
         import threading
 
         from ..comm import TCPCommandServer
 
         self.engine = engine
+        self.max_queue = max_queue
+        self.retry_after_s = retry_after_s
+        self._supervisor = supervisor
+        self._stepper_child = None
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._done: dict[int, FinishedRequest] = {}
@@ -964,6 +976,9 @@ class ServingService:
         }
         self._m_completions = reg.counter(
             f"{p}_completions_total", "finished requests", labels=("reason",)
+        )
+        self._m_shed = reg.counter(
+            f"{p}_shed_total", "submits shed with retry-after (queue saturated)"
         )
         self._m_gauges = {
             name: reg.gauge(f"{p}_{name}", help_)
@@ -1017,14 +1032,23 @@ class ServingService:
 
     def start(self) -> "ServingService":
         self._server.start()
-        self._thread.start()
+        if self._supervisor is not None:
+            self._stepper_child = self._supervisor.spawn(
+                "serving-stepper", self._loop_supervised,
+                on_giveup=self._on_stepper_giveup,
+            )
+        else:
+            self._thread.start()
         if self._metrics_server is not None:
-            self._metrics_server.start()
+            self._metrics_server.start(supervisor=self._supervisor)
         return self
 
     def shutdown(self):
         self._stop.set()
-        self._thread.join(timeout=10)
+        if self._stepper_child is not None:
+            self._stepper_child.stop(timeout=10)
+        else:
+            self._thread.join(timeout=10)
         self._server.shutdown()
         if self._metrics_server is not None:
             self._metrics_server.shutdown()
@@ -1037,7 +1061,10 @@ class ServingService:
         import time as _time
         import traceback as _tb
 
+        from ..resilience.faults import fault_point
+
         while not self._stop.is_set():
+            fault_point("serving.stepper")  # chaos site, outside the lock
             with self._lock:
                 busy = self.engine.pending() > 0
                 if busy:
@@ -1055,12 +1082,49 @@ class ServingService:
             if not busy:
                 _time.sleep(0.005)
 
+    def _loop_supervised(self):
+        """Supervised variant: let exceptions escape so the supervisor
+        restarts the stepper instead of recording-and-wedging."""
+        import time as _time
+
+        from ..resilience.faults import fault_point
+
+        while not self._stop.is_set():
+            fault_point("serving.stepper")
+            with self._lock:
+                busy = self.engine.pending() > 0
+                if busy:
+                    self.engine.step()
+                    self._done.update({f.rid: f for f in self.engine.finished})
+                    self.engine.finished.clear()
+            if not busy:
+                _time.sleep(0.005)
+
+    def _on_stepper_giveup(self, exc: BaseException) -> None:
+        import traceback as _tb
+
+        self._error = "".join(
+            _tb.format_exception(type(exc), exc, exc.__traceback__, limit=5)
+        )
+
     # -- handlers --------------------------------------------------------------
 
     def _h_submit(self, payload):
         with self._lock:
             if self._error is not None:
                 raise RuntimeError(f"serving stepper died:\n{self._error}")
+            if self.max_queue is not None and self.engine.pending() >= self.max_queue:
+                # shed, don't hang: an explicit retry-after beats a queue
+                # that grows until every caller times out
+                if getattr(self, "_m_shed", None) is not None:
+                    self._m_shed.inc()
+                from ..obs import get_tracer
+
+                get_tracer().instant(
+                    "load_shed",
+                    {"pending": self.engine.pending(), "max_queue": self.max_queue},
+                )
+                return {"saturated": True, "retry_after": self.retry_after_s}
             return self.engine.submit(
                 np.asarray(payload["prompt"], np.int32),
                 int(payload["max_new_tokens"]),
@@ -1099,39 +1163,81 @@ class ServingService:
             }
 
 
+class ServiceSaturated(RuntimeError):
+    """The service shed the submit; retry after ``retry_after`` seconds."""
+
+    def __init__(self, retry_after: float):
+        super().__init__(f"service saturated, retry after {retry_after}s")
+        self.retry_after = retry_after
+
+
 class RemoteEngine:
     """Client for :class:`ServingService` — the same submit surface over
-    TCP (reference: actors talk to AsyncVLLM via Ray handles)."""
+    TCP (reference: actors talk to AsyncVLLM via Ray handles).
 
-    def __init__(self, host: str, port: int, timeout: float = 30.0):
+    ``retry`` (a :class:`rl_tpu.resilience.RetryPolicy`) makes the
+    transport survivable. ``submit`` is NOT transport-idempotent (a dropped
+    reply would re-enqueue the prompt), so it never retries on transport
+    errors — but it DOES honor the service's explicit shed replies:
+    ``max_shed_retries`` waits ``retry_after`` and resubmits (the shed
+    reply proves the request was rejected, so resubmitting is safe).
+    """
+
+    def __init__(self, host: str, port: int, timeout: float = 30.0, retry=None,
+                 max_shed_retries: int = 8):
         from ..comm import TCPCommandClient
 
-        self._client = TCPCommandClient(host, port, timeout=timeout)
+        self._client = TCPCommandClient(host, port, timeout=timeout, retry=retry)
+        self._retry = retry
+        self.max_shed_retries = max_shed_retries
 
     def submit(self, prompt, max_new_tokens: int) -> int:
-        return int(self._client.call(
-            "submit",
-            {"prompt": np.asarray(prompt, np.int32).tolist(),
-             "max_new_tokens": int(max_new_tokens)},
-        ))
+        import time as _time
+
+        payload = {"prompt": np.asarray(prompt, np.int32).tolist(),
+                   "max_new_tokens": int(max_new_tokens)}
+        for _ in range(self.max_shed_retries + 1):
+            out = self._client.call("submit", payload, idempotent=False)
+            if isinstance(out, dict) and out.get("saturated"):
+                retry_after = float(out.get("retry_after", 0.25))
+                _time.sleep(retry_after)
+                continue
+            return int(out)
+        raise ServiceSaturated(retry_after)
 
     def collect(self, rids=None) -> dict[int, dict]:
+        # collect REMOVES results server-side: a reply dropped after the
+        # handler ran loses them for good, so never auto-retry it
         payload = None if rids is None else {"rids": [int(r) for r in rids]}
-        return {int(k): v for k, v in self._client.call("collect", payload).items()}
+        return {
+            int(k): v
+            for k, v in self._client.call("collect", payload, idempotent=False).items()
+        }
 
     def stats(self) -> dict:
         return self._client.call("stats")
 
     def wait_all(self, rids, poll_s: float = 0.05, timeout: float = 120.0) -> dict:
+        """Poll ``collect`` until every rid finished. The poll interval
+        doubles from ``poll_s`` up to a 1 s cap (long generations don't
+        deserve a 50 ms busy-poll), charged against one shared deadline."""
         import time as _time
 
+        from ..resilience.retry import Deadline
+
+        dl = (
+            self._retry.deadline(timeout)
+            if self._retry is not None
+            else Deadline(timeout)
+        )
         want = set(rids)
         got: dict[int, dict] = {}
-        deadline = _time.monotonic() + timeout
-        while want - set(got) and _time.monotonic() < deadline:
+        delay = poll_s
+        while want - set(got) and not dl.expired:
             got.update(self.collect(sorted(want - set(got))))
             if want - set(got):
-                _time.sleep(poll_s)
+                _time.sleep(min(delay, max(dl.remaining(), 0.0)))
+                delay = min(delay * 2.0, 1.0)
         missing = want - set(got)
         if missing:
             raise TimeoutError(f"requests {sorted(missing)} not finished in {timeout}s")

@@ -50,13 +50,22 @@ class MetricsHTTPServer:
         self._server = ThreadingHTTPServer((host, port), _Handler)
         self._server.daemon_threads = True
         self._thread: threading.Thread | None = None
+        self._child = None  # supervised-mode handle
 
     @property
     def address(self) -> tuple[str, int]:
         return self._server.server_address[:2]
 
-    def start(self) -> "MetricsHTTPServer":
-        if self._thread is None:
+    def start(self, supervisor=None) -> "MetricsHTTPServer":
+        """Start serving; with a :class:`rl_tpu.resilience.Supervisor`, the
+        serve loop runs as a supervised child (restarted on crash) instead
+        of a bare daemon thread."""
+        if supervisor is not None:
+            if self._child is None:
+                self._child = supervisor.spawn(
+                    "metrics-http", self._server.serve_forever, escalate=False
+                )
+        elif self._thread is None:
             self._thread = threading.Thread(
                 target=self._server.serve_forever,
                 name="metrics-http",
@@ -68,6 +77,9 @@ class MetricsHTTPServer:
     def shutdown(self) -> None:
         self._server.shutdown()
         self._server.server_close()
+        if self._child is not None:
+            self._child.stop(timeout=5)
+            self._child = None
         if self._thread is not None:
             self._thread.join(timeout=5)
             self._thread = None

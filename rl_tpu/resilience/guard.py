@@ -1,0 +1,194 @@
+"""Last-good-state guard: skip bad steps, roll back, emergency-checkpoint.
+
+Three layers of defense around the update dispatch, cheapest first:
+
+1. **In-program finite check** (used by the trainers, see
+   :func:`tree_where`): ``ok = isfinite(loss) & isfinite(|grads|)`` gates
+   the parameter/optimizer/priority writes inside the jitted update — a
+   NaN/Inf step is a no-op on the train state, counted in the on-device
+   ``bad_steps`` counter. No extra host sync: the count rides the existing
+   lagged DeviceMetrics drain.
+2. **Host-side rollback** (:class:`LastGoodState`): a versioned in-memory
+   snapshot (params + opt_state, ``jnp.copy`` so donation can't invalidate
+   it) refreshed every ``snapshot_interval`` good steps; after
+   ``rollback_after`` consecutive bad steps the trainer restores the
+   snapshot — the finite check stops NaN propagation, the rollback stops
+   a persistently-degenerate state from spinning forever.
+3. **Preemption-triggered emergency checkpoint**
+   (:class:`EmergencyCheckpointer`): on a (synthetic or SIGTERM)
+   preemption the trainer drains its pipelines, blocks on the in-flight
+   dispatch, and writes a full orbax checkpoint (arrays + JSON meta) so a
+   later process resumes exactly.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["EmergencyCheckpointer", "LastGoodState", "tree_where"]
+
+
+def tree_where(pred, on_true, on_false):
+    """Per-leaf ``jnp.where(pred, a, b)`` — the in-program skip: select the
+    updated state when ``pred`` (scalar bool) else keep the old one.
+    ``where`` SELECTS, so NaNs in the rejected branch do not propagate."""
+    return jax.tree.map(lambda a, b: jnp.where(pred, a, b), on_true, on_false)
+
+
+def _copy(tree):
+    return jax.tree.map(jnp.copy, tree)
+
+
+class LastGoodState:
+    """Versioned in-memory emergency snapshot with K-consecutive rollback.
+
+    Host-side companion to the in-program finite check: feed it the
+    (lagged) drained ``bad_steps`` total each step via :meth:`observe`;
+    it snapshots (copies of) params+opt_state on good steps and returns a
+    restore tuple once ``rollback_after`` consecutive steps went bad.
+    Returned trees are fresh copies — safe to hand to a donating dispatch
+    while the snapshot stays valid for the next rollback.
+    """
+
+    def __init__(
+        self,
+        rollback_after: int = 3,
+        snapshot_interval: int = 10,
+        registry: Any = None,
+        tracer: Any = None,
+    ):
+        self.rollback_after = rollback_after
+        self.snapshot_interval = snapshot_interval
+        self.rollbacks = 0
+        self._snap: tuple[Any, Any] | None = None
+        self._snap_version = -1
+        self._last_bad = 0.0
+        self._consecutive = 0
+        if registry is None:
+            from ..obs import get_registry
+
+            registry = get_registry()
+        if tracer is None:
+            from ..obs import get_tracer
+
+            tracer = get_tracer()
+        self._tracer = tracer
+        self._c_rollbacks = registry.counter(
+            "rl_tpu_resilience_rollbacks_total",
+            "emergency-snapshot rollbacks after K consecutive bad steps",
+        )
+        self._c_bad = registry.counter(
+            "rl_tpu_resilience_bad_steps_skipped_total",
+            "update steps skipped by the in-program finite check",
+        )
+
+    @property
+    def snapshot_version(self) -> int:
+        return self._snap_version
+
+    def observe(
+        self, step: int, bad_total: float, params: Any, opt_state: Any
+    ) -> tuple[Any, Any, int] | None:
+        """Record one step's (lagged) bad-step total. Returns ``(params,
+        opt_state, version)`` copies to restore, or ``None``."""
+        bad_total = float(bad_total)
+        self._c_bad.set_total(bad_total)
+        delta = bad_total - self._last_bad
+        self._last_bad = bad_total
+        if delta > 0:
+            self._consecutive += 1
+        else:
+            self._consecutive = 0
+            if self._snap is None or step - self._snap_version >= self.snapshot_interval:
+                self._snap = (_copy(params), _copy(opt_state))
+                self._snap_version = step
+        if self._consecutive >= self.rollback_after and self._snap is not None:
+            self._consecutive = 0
+            self.rollbacks += 1
+            self._c_rollbacks.inc()
+            self._tracer.instant(
+                "rollback", {"step": step, "to_version": self._snap_version}
+            )
+            p, o = self._snap
+            return _copy(p), _copy(o), self._snap_version
+        return None
+
+
+class EmergencyCheckpointer:
+    """Orbax emergency checkpoints for preemption-exact resume.
+
+    Thin wrapper over :class:`~rl_tpu.checkpoint.Checkpoint` with two
+    components: an arrays pytree (``ArrayTreeAdapter`` — typed PRNG keys
+    round-trip via the template) and a JSON ``meta`` dict (step counters,
+    env RNG state, histories). ``meta.json`` is written last, so a partial
+    save never looks complete.
+    """
+
+    def __init__(self, root: str, registry: Any = None, tracer: Any = None):
+        self.root = root
+        if registry is None:
+            from ..obs import get_registry
+
+            registry = get_registry()
+        if tracer is None:
+            from ..obs import get_tracer
+
+            tracer = get_tracer()
+        self._tracer = tracer
+        self._c_saves = registry.counter(
+            "rl_tpu_resilience_emergency_checkpoints_total",
+            "emergency checkpoints written on preemption",
+        )
+
+    def _ckpt(self, arrays_ref: dict, meta_ref: dict, template: Callable[[], Any] | None):
+        from ..checkpoint import Checkpoint, JSONAdapter
+
+        ckpt = Checkpoint(self.root, capture_rng=False)
+        ckpt.register(
+            "arrays",
+            lambda: arrays_ref["v"],
+            lambda v: arrays_ref.__setitem__("v", v),
+            template=template,
+        )
+        ckpt.register(
+            "meta",
+            lambda: meta_ref["v"],
+            lambda v: meta_ref.__setitem__("v", v),
+            adapter=JSONAdapter(),
+        )
+        return ckpt
+
+    def save(self, step: int, arrays: Any, meta: dict | None = None) -> str:
+        path = self._ckpt({"v": arrays}, {"v": dict(meta or {})}, None).save(int(step))
+        self._c_saves.inc()
+        self._tracer.instant("emergency_checkpoint", {"step": int(step), "path": path})
+        return path
+
+    def latest_step(self) -> int | None:
+        from ..checkpoint import Checkpoint
+
+        return Checkpoint(self.root, capture_rng=False).latest_step()
+
+    def restore(
+        self, template: Any, step: int | None = None
+    ) -> tuple[Any, dict, int]:
+        """Load ``(arrays, meta, step)``; ``template`` is a same-structure
+        arrays pytree (typed PRNG keys are rewrapped against it)."""
+        if step is None:
+            step = self.latest_step()
+            if step is None:
+                raise FileNotFoundError(f"no emergency checkpoint under {self.root}")
+        arrays_ref: dict = {"v": None}
+        meta_ref: dict = {"v": None}
+        self._ckpt(arrays_ref, meta_ref, lambda: template).load(int(step))
+        # Rematerialize every leaf as a fresh XLA-owned buffer: restored
+        # arrays can be backed by checkpoint-loader memory, and feeding one
+        # into a donate_argnums position corrupts the heap when XLA frees it.
+        arrays = jax.tree.map(
+            lambda x: jnp.copy(x) if isinstance(x, jax.Array) else x,
+            arrays_ref["v"],
+        )
+        return arrays, meta_ref["v"] or {}, int(step)

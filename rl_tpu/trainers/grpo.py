@@ -58,6 +58,8 @@ from ..models import (
 )
 from ..obs import DeviceMetrics
 from ..objectives.llm.grpo import GRPOLoss
+from ..resilience.faults import fault_point, get_injector
+from ..resilience.guard import tree_where
 from ..weight_update.schemes import DevicePutScheme
 
 __all__ = ["GRPOTrainer", "PipelinedGRPOTrainer", "RolloutPipeline"]
@@ -221,11 +223,15 @@ class GRPOTrainer:
         # are drained lagged-one-dispatch (AsyncOffPolicyTrainer pattern):
         # step() never blocks on the update it just dispatched
         self._dm_spec = DeviceMetrics(
-            counters=("updates", "tokens"),
+            counters=("updates", "tokens", "bad_steps"),
             gauges=("loss", "reward", "kl_approx"),
         )
         self._dm = self._dm_spec.init()
         self._pending_dm: dict | None = None
+        # cached device zero for the chaos poison argument: keeps the
+        # injector-armed-but-idle path on ONE jit trace with no per-step
+        # host->device transfer
+        self._poison_zero: jax.Array | None = None
 
         # donate the rotating optimizer state, NOT the params: the weight
         # scheme (and a pipelined generator thread pulling from it) may
@@ -243,13 +249,19 @@ class GRPOTrainer:
 
     # -- the donated, microbatched update program ------------------------
 
-    def _update_impl(self, params, opt_state, batch, dm):
+    def _update_impl(self, params, opt_state, batch, dm, poison=None):
         """One dispatch: gradient-accumulation ``lax.scan`` over
         microbatches, optimizer update, on-device metrics. Microbatch
         gradients are weighted by ``GRPOLoss.microbatch_weight`` (the
         assistant-token count) so the accumulated gradient equals the
         full-batch gradient exactly — the loss normalizes per token, and
-        the per-microbatch denominators cancel against the weights."""
+        the per-microbatch denominators cancel against the weights.
+
+        A finite guard gates the writes: a non-finite loss or gradient
+        norm turns the step into an in-program no-op (old params/opt_state
+        selected, ``bad_steps`` counter bumped) with no extra host sync.
+        ``poison`` is the chaos injector's f32 scalar (NaN on a poisoned
+        step, a cached device zero otherwise) added to loss and grads."""
         B = batch["tokens"].shape[0]
         mbs = self.microbatch_size or B
         n_mb = B // mbs
@@ -285,26 +297,46 @@ class GRPOTrainer:
             v = vsum / wsum
             kl = klsum / wsum
 
-        upd, opt_state = self.opt.update(g, opt_state)
-        params = optax.apply_updates(params, upd)
+        if poison is not None:
+            v = v + poison
+            g = jax.tree.map(lambda a: a + poison, g)
+
+        ok = jnp.isfinite(v) & jnp.isfinite(optax.global_norm(g))
+        upd, new_opt_state = self.opt.update(g, opt_state)
+        new_params = optax.apply_updates(params, upd)
+        # jnp.where SELECTS, so a NaN in the rejected branch cannot leak
+        params = tree_where(ok, new_params, params)
+        opt_state = tree_where(ok, new_opt_state, opt_state)
+        okf = ok.astype(jnp.float32)
 
         spec = self._dm_spec
-        dm = spec.inc(dm, "updates", 1.0)
+        dm = spec.inc(dm, "updates", okf)
+        dm = spec.inc(dm, "bad_steps", 1.0 - okf)
         dm = spec.inc(
             dm, "tokens", jnp.sum(batch["assistant_mask"].astype(jnp.float32))
         )
-        dm = spec.set_gauge(dm, "loss", v)
+        dm = spec.set_gauge(dm, "loss", jnp.where(ok, v, 0.0))
         dm = spec.set_gauge(dm, "reward", jnp.mean(batch["reward"]))
-        dm = spec.set_gauge(dm, "kl_approx", kl)
+        dm = spec.set_gauge(dm, "kl_approx", jnp.where(ok, kl, 0.0))
         return params, opt_state, dm
 
     # -- step / train ----------------------------------------------------
 
     def _consume(self, batch: ArrayDict) -> dict[str, float]:
         """Update on a collected batch, publish weights, drain metrics."""
-        self.params, self.opt_state, self._dm = self._update(
-            self.params, self.opt_state, batch, self._dm
-        )
+        inj = get_injector()
+        if inj is None:
+            self.params, self.opt_state, self._dm = self._update(
+                self.params, self.opt_state, batch, self._dm
+            )
+        else:
+            p = inj.poison("grpo.update")
+            if self._poison_zero is None:
+                self._poison_zero = jnp.zeros((), jnp.float32)
+            pv = self._poison_zero if p == 0.0 else jnp.asarray(p, jnp.float32)
+            self.params, self.opt_state, self._dm = self._update(
+                self.params, self.opt_state, batch, self._dm, pv
+            )
         self.scheme.push(self.params)  # non-blocking dispatch
         self.policy_version.bump()
         out = self._drain_metrics()
@@ -326,6 +358,7 @@ class GRPOTrainer:
             "reward": flat["reward"],
             "loss": flat["loss"],
             "kl_approx": flat["kl_approx"],
+            "bad_steps": flat["bad_steps"],
         }
 
     def metrics_snapshot(self) -> dict:
@@ -347,14 +380,98 @@ class GRPOTrainer:
             batch = jax.device_put(batch, self._mesh_replicated)
         return self._consume(batch)
 
-    def train(self, steps: int, log_interval: int = 10) -> dict[str, list[float]]:
-        for i in range(steps):
+    def train(
+        self,
+        steps: int,
+        log_interval: int = 10,
+        preemption: Any = None,
+        emergency: Any = None,
+        guard: Any = None,
+        start_step: int = 0,
+    ) -> dict[str, list[float]]:
+        """Run ``steps`` training steps.
+
+        Resilience hooks (all optional): ``preemption`` is a
+        :class:`~rl_tpu.trainers.resilience.PreemptionHandler` — when its
+        flag raises, the loop drains in-flight work and writes an
+        ``emergency`` checkpoint (:class:`rl_tpu.resilience.EmergencyCheckpointer`)
+        before returning, so :meth:`emergency_restore` + ``train(...,
+        start_step=resumed)`` reproduces the uninterrupted run exactly.
+        ``guard`` is a :class:`rl_tpu.resilience.LastGoodState` fed the
+        lagged ``bad_steps`` total each step; a rollback replaces
+        params/opt_state with the last good snapshot and re-pushes weights.
+        """
+        for i in range(start_step, start_step + steps):
+            fault_point("trainer.preempt")  # chaos site (synthetic preemption)
+            if preemption is not None and preemption.preempted:
+                if emergency is not None:
+                    self.emergency_save(emergency, i)
+                break
             out = self.step()
+            if guard is not None:
+                restored = guard.observe(
+                    i, out.get("bad_steps", 0.0), self.params, self.opt_state
+                )
+                if restored is not None:
+                    self.params, self.opt_state, _version = restored
+                    self.scheme.push(self.params)
             if self.logger is not None and i % log_interval == 0:
                 self.logger.log_scalars(
                     {f"grpo/{k}": v for k, v in out.items()}, step=i
                 )
         return self.history
+
+    # -- emergency checkpoints -------------------------------------------
+
+    def _drain_for_checkpoint(self) -> None:
+        """Quiesce background work so the saved state is consistent; the
+        sequential trainer has none (the pipelined override closes its
+        rollout pipeline)."""
+
+    def emergency_save(self, emergency: Any, step: int) -> str:
+        """Drain pipelines, block on the in-flight dispatch, write a full
+        emergency checkpoint (arrays + meta) for exact resume."""
+        self._drain_for_checkpoint()
+        jax.block_until_ready(self.params)
+        arrays = {
+            "params": self.params,
+            "opt_state": self.opt_state,
+            "key": self._key,
+            "dm": self._dm,
+        }
+        meta = {
+            "step": int(step),
+            "history": {
+                k: [float(x) for x in v] for k, v in self.history.items()
+            },
+            # the chat env draws prompts from its own numpy Generator —
+            # without this state, resumed rollouts sample different prompts
+            "env_rng": self.env._rng.bit_generator.state,
+        }
+        return emergency.save(step, arrays, meta)
+
+    def emergency_restore(self, emergency: Any, step: int | None = None) -> int:
+        """Load the latest (or given) emergency checkpoint into this
+        trainer; returns the step to resume from (pass as ``start_step``)."""
+        template = {
+            "params": self.params,
+            "opt_state": self.opt_state,
+            "key": self._key,
+            "dm": self._dm,
+        }
+        arrays, meta, step = emergency.restore(template, step)
+        self.params = arrays["params"]
+        self.opt_state = arrays["opt_state"]
+        self._key = arrays["key"]
+        self._dm = arrays["dm"]
+        self._pending_dm = None
+        if self._mesh_replicated is not None:
+            self.params = jax.device_put(self.params, self._mesh_replicated)
+        self.history = {k: list(v) for k, v in meta.get("history", {}).items()}
+        if "env_rng" in meta:
+            self.env._rng.bit_generator.state = meta["env_rng"]
+        self.scheme.push(self.params)
+        return int(meta.get("step", step))
 
     def evaluate(self, num_prompts: int = 32, key: jax.Array | None = None) -> float:
         """Greedy-decode exact-match accuracy over dataset prompts."""
@@ -405,6 +522,7 @@ class RolloutPipeline:
         collect_fn: Callable[[Any, jax.Array], Any],
         key: jax.Array,
         max_pending: int = 1,
+        supervisor: Any = None,
     ):
         self.scheme = scheme
         self.collect_fn = collect_fn
@@ -415,31 +533,57 @@ class RolloutPipeline:
         self._stop = threading.Event()
         self._error: BaseException | None = None
         self._thread: threading.Thread | None = None
+        # optional rl_tpu.resilience.Supervisor: producer crashes restart
+        # the loop (the key stream and ticket pool survive on the instance)
+        self._supervisor = supervisor
+        self._child: Any = None
 
     def start(self) -> "RolloutPipeline":
-        if self._thread is not None:
+        if self._thread is not None or self._child is not None:
             return self
-        self._thread = threading.Thread(
-            target=self._run, name="grpo-rollout", daemon=True
-        )
-        self._thread.start()
+        if self._supervisor is not None:
+            self._child = self._supervisor.spawn(
+                "grpo-rollout", self._produce, on_giveup=self._on_giveup
+            )
+        else:
+            self._thread = threading.Thread(
+                target=self._run, name="grpo-rollout", daemon=True
+            )
+            self._thread.start()
         return self
+
+    def _on_giveup(self, exc: BaseException) -> None:
+        self._error = exc
 
     @property
     def running(self) -> bool:
+        if self._child is not None:
+            return self._child.is_alive()
         return self._thread is not None and self._thread.is_alive()
 
     def _run(self):
         try:
-            while not self._stop.is_set():
-                if not self._tickets.acquire(timeout=0.05):
-                    continue
+            self._produce()
+        except BaseException as e:  # surfaced on the consumer's next get
+            self._error = e
+
+    def _produce(self):
+        from ..resilience.faults import fault_point
+
+        while not self._stop.is_set():
+            fault_point("grpo.rollout")  # chaos site, before the ticket
+            if not self._tickets.acquire(timeout=0.05):
+                continue
+            try:
                 self._key, k = jax.random.split(self._key)
                 params, version = self.scheme.pull_versioned()
                 batch = self.collect_fn(params, k)
                 self._put((batch, version))
-        except BaseException as e:  # surfaced on the consumer's next get
-            self._error = e
+            except BaseException:
+                # a crash after the acquire must return the ticket, or a
+                # supervised restart would leak it and starve the pipeline
+                self._tickets.release()
+                raise
 
     def _put(self, item):
         while not self._stop.is_set():
@@ -478,6 +622,9 @@ class RolloutPipeline:
                 self._q.get_nowait()
             except queue.Empty:
                 break
+        if self._child is not None:
+            self._child.stop(timeout=10.0)
+            self._child = None
         if self._thread is not None:
             self._thread.join(timeout=10.0)
             self._thread = None
@@ -499,10 +646,11 @@ class PipelinedGRPOTrainer(GRPOTrainer):
     generator thread; it is a daemon, so leaking it cannot hang exit.
     """
 
-    def __init__(self, dataset, *args, max_pending: int = 1, **kw):
+    def __init__(self, dataset, *args, max_pending: int = 1, supervisor: Any = None, **kw):
         kw.setdefault("continuous_batching", True)
         super().__init__(dataset, *args, **kw)
         self.max_pending = max_pending
+        self.supervisor = supervisor
         self.staleness_history: list[int] = []
         self._pipeline: RolloutPipeline | None = None
 
@@ -513,8 +661,19 @@ class PipelinedGRPOTrainer(GRPOTrainer):
                 lambda params, k: self.collector.collect(params, k),
                 self._key,
                 max_pending=self.max_pending,
+                supervisor=self.supervisor,
             ).start()
         return self._pipeline
+
+    def _drain_for_checkpoint(self) -> None:
+        # stop the producer and throw away its in-flight batch: the saved
+        # state then needs no queue contents to be consistent — resume
+        # regenerates from the checkpointed key/weights. Adopt the
+        # producer's key position so resumed rollouts continue the stream
+        # instead of replaying consumed keys.
+        if self._pipeline is not None:
+            self._key = self._pipeline._key
+        self.close()
 
     def step(self) -> dict[str, float]:
         batch, version = self._ensure_pipeline().get()

@@ -22,6 +22,8 @@ from ..data import ArrayDict, ReplayBuffer
 from ..collectors.single import Collector
 from ..objectives.common import LossModule, SoftUpdate
 from ..obs.device import DeviceMetrics
+from ..resilience.faults import fault_point, get_injector
+from ..resilience.guard import tree_where
 
 __all__ = [
     "OffPolicyConfig",
@@ -34,9 +36,10 @@ __all__ = [
 def default_device_metrics() -> DeviceMetrics:
     """The standard on-device schema for off-policy programs: update count,
     loss/grad-norm/param-norm gauges, |TD-error| + staleness histograms
-    (the latter two only accumulate when the loss/sampler produce them)."""
+    (the latter two only accumulate when the loss/sampler produce them).
+    ``bad_steps`` counts updates skipped by the in-program finite guard."""
     return DeviceMetrics(
-        counters=("updates",),
+        counters=("updates", "bad_steps"),
         gauges=("loss", "grad_norm", "param_norm"),
         histograms={
             "td_error": (0.01, 0.03, 0.1, 0.3, 1.0, 3.0, 10.0, 30.0, 100.0),
@@ -88,12 +91,25 @@ class _GradUpdateMixin:
 
     def _update_body(self, carry, xs):
         params, opt_state, bstate, dm = carry
-        upd_key, upd_idx = xs
+        if len(xs) == 3:  # chaos path: per-update poison scalar rides the scan
+            upd_key, upd_idx, poison = xs
+        else:
+            upd_key, upd_idx = xs
+            poison = None
         k_sample, k_loss = jax.random.split(upd_key)
         mb, bstate = self.buffer.sample(bstate, k_sample, self.config.batch_size)
         loss_val, grads, metrics = self.loss.grad(params, mb, k_loss)
+        if poison is not None:
+            loss_val = loss_val + poison
+            grads = jax.tree.map(lambda g: g + poison, grads)
+        # in-program finite guard: a non-finite loss/grad turns this update
+        # into a no-op on params/opt_state/priorities (selected below) —
+        # no host sync, the skip count rides the lagged metrics drain
+        ok = jnp.isfinite(loss_val) & jnp.isfinite(optax.global_norm(grads))
         if self.device_metrics is not None:
-            dm = self._record_update_metrics(dm, params, loss_val, grads, metrics, mb)
+            dm = self._record_update_metrics(
+                dm, params, loss_val, grads, metrics, mb, ok
+            )
         if self.config.policy_delay > 1:
             do_policy = (upd_idx % self.config.policy_delay) == 0
             pk = self.config.policy_key
@@ -102,7 +118,7 @@ class _GradUpdateMixin:
                 grads[pk] = jax.tree.map(
                     lambda g: g * do_policy.astype(g.dtype), grads[pk]
                 )
-        updates, opt_state = self.optimizer.update(
+        updates, new_opt_state = self.optimizer.update(
             grads, opt_state, self.loss.trainable(params)
         )
         if self.config.policy_delay > 1 and self.config.policy_key in updates:
@@ -114,11 +130,21 @@ class _GradUpdateMixin:
                 updates[self.config.policy_key],
             )
         trainable = optax.apply_updates(self.loss.trainable(params), updates)
-        params = self.loss.merge(trainable, params)
-        params = self.target_update(params)
+        new_params = self.loss.merge(trainable, params)
+        new_params = self.target_update(new_params)
+        # jnp.where SELECTS, so NaNs in the rejected branch never propagate
+        params = tree_where(ok, new_params, params)
+        opt_state = tree_where(ok, new_opt_state, opt_state)
         if self.priority_key is not None and self.priority_key in metrics:
-            bstate = self.buffer.update_priority(
+            new_bstate = self.buffer.update_priority(
                 bstate, mb["index"], metrics[self.priority_key]
+            )
+            # update_priority only touches the sampler substate; gate just
+            # that (O(sampler) select, not O(storage)) so NaN priorities
+            # never enter the PER tree while the sample's own state
+            # advance (step counter) is preserved
+            bstate = new_bstate.set(
+                "sampler", tree_where(ok, new_bstate["sampler"], bstate["sampler"])
             )
         # per-sample tensors don't reduce across the scan: drop them
         scalar_metrics = ArrayDict(
@@ -126,17 +152,25 @@ class _GradUpdateMixin:
         ).set("loss", loss_val)
         return (params, opt_state, bstate, dm), scalar_metrics
 
-    def _record_update_metrics(self, dm, params, loss_val, grads, metrics, mb):
-        """Accumulate into the on-device metrics state (traced, pure)."""
+    def _record_update_metrics(self, dm, params, loss_val, grads, metrics, mb, ok=None):
+        """Accumulate into the on-device metrics state (traced, pure).
+        ``ok`` (scalar bool) gates the write-side of a guarded update: a
+        bad step counts in ``bad_steps`` instead of ``updates`` and zeroes
+        the loss/grad gauges rather than publishing NaN."""
         spec = self.device_metrics
-        dm = spec.inc(dm, "updates")
-        dm = spec.set_gauge(dm, "loss", loss_val)
-        dm = spec.set_gauge(dm, "grad_norm", optax.global_norm(grads))
+        okf = jnp.float32(1.0) if ok is None else ok.astype(jnp.float32)
+        safe = (lambda v: v) if ok is None else (lambda v: jnp.where(ok, v, 0.0))
+        dm = spec.inc(dm, "updates", okf)
+        if "bad_steps" in spec.counters:
+            dm = spec.inc(dm, "bad_steps", 1.0 - okf)
+        dm = spec.set_gauge(dm, "loss", safe(loss_val))
+        dm = spec.set_gauge(dm, "grad_norm", safe(optax.global_norm(grads)))
         dm = spec.set_gauge(
             dm, "param_norm", optax.global_norm(self.loss.trainable(params))
         )
         if "td_error" in spec.histograms and "td_error" in metrics:
-            dm = spec.observe(dm, "td_error", jnp.abs(metrics["td_error"]))
+            td = jnp.abs(metrics["td_error"])
+            dm = spec.observe(dm, "td_error", jnp.where(jnp.isfinite(td), td, 0.0))
         if "staleness" in spec.histograms and "staleness" in mb:
             dm = spec.observe(dm, "staleness", mb["staleness"])
         return dm
@@ -388,6 +422,9 @@ class AsyncOffPolicyTrainer(_GradUpdateMixin):
         # reference to the last published params for its policy calls, and
         # donating them would hand XLA buffers another thread is reading
         self._k_updates = jax.jit(self._k_updates_impl, donate_argnums=(1, 2))
+        # cached device zero for the chaos poison arg: one extra jit trace
+        # when an injector is armed, no per-dispatch host->device transfer
+        self._poison_zero = None
 
     # -- state ----------------------------------------------------------------
 
@@ -434,14 +471,23 @@ class AsyncOffPolicyTrainer(_GradUpdateMixin):
 
     # -- device side -----------------------------------------------------------
 
-    def _k_updates_impl(self, params, opt_state, bstate, rng, update_count, dm=None):
+    def _k_updates_impl(self, params, opt_state, bstate, rng, update_count, dm=None,
+                        poison=None):
         k = self.config.utd_ratio
         rng, *upd_keys = jax.random.split(rng, k + 1)
         upd_idx = update_count + jnp.arange(k)
+        if poison is None:
+            xs = (jnp.stack(upd_keys), upd_idx)
+        else:
+            # chaos: the injector's f32 scalar poisons the FIRST update of
+            # this dispatch (zeros elsewhere keep the trace shape stable)
+            xs = (
+                jnp.stack(upd_keys),
+                upd_idx,
+                jnp.zeros((k,), jnp.float32).at[0].set(poison),
+            )
         (params, opt_state, bstate, dm), metrics = jax.lax.scan(
-            self._update_body,
-            (params, opt_state, bstate, dm),
-            (jnp.stack(upd_keys), upd_idx),
+            self._update_body, (params, opt_state, bstate, dm), xs
         )
         out = (params, opt_state, bstate, rng, update_count + k, dm)
         return out, jax.tree.map(lambda x: x.mean(), metrics)
@@ -453,10 +499,25 @@ class AsyncOffPolicyTrainer(_GradUpdateMixin):
         ts: dict,
         total_frames: int,
         min_frames_before_update: int | None = None,
+        preemption=None,
+        emergency=None,
+        guard=None,
     ):
         """Generator driving the overlapped loop; yields ``(ts, metrics)``
         per consumed batch (``metrics is None`` during warmup). Starts and
-        stops the collector; the caller owns the env pool."""
+        stops the collector; the caller owns the env pool.
+
+        Resilience hooks (all optional): ``preemption``
+        (:class:`~rl_tpu.trainers.resilience.PreemptionHandler`) stops the
+        loop at the next batch boundary and — with ``emergency``
+        (:class:`rl_tpu.resilience.EmergencyCheckpointer`) — writes the
+        whole train state (params, opt, replay ring, rng, counters) after
+        blocking on the in-flight dispatch, so :meth:`emergency_restore`
+        resumes exactly. ``guard``
+        (:class:`rl_tpu.resilience.LastGoodState`) is fed the lagged
+        ``bad_steps`` total from the metrics drain; a rollback swaps
+        params/opt back to the last good snapshot and republishes weights.
+        """
         coll = self.collector
         fpb = coll.frames_per_batch
         min_frames = (
@@ -472,8 +533,14 @@ class AsyncOffPolicyTrainer(_GradUpdateMixin):
 
             registry = get_registry()
         pending_obs = None  # previous dispatch's dm, copy already in flight
+        step_i = 0
         try:
             while frames < total_frames:
+                fault_point("trainer.preempt")  # chaos site (synthetic preemption)
+                if preemption is not None and preemption.preempted:
+                    if emergency is not None:
+                        self.emergency_save(emergency, ts, frames)
+                    break
                 batch = coll.get_batch()
                 if batch is None:
                     break
@@ -481,6 +548,17 @@ class AsyncOffPolicyTrainer(_GradUpdateMixin):
                 frames += fpb
                 metrics = None
                 if frames >= min_frames:
+                    inj = get_injector()
+                    if inj is None:
+                        poison = None
+                    else:
+                        p = inj.poison("offpolicy.update")
+                        if self._poison_zero is None:
+                            self._poison_zero = jnp.zeros((), jnp.float32)
+                        poison = (
+                            self._poison_zero if p == 0.0
+                            else jnp.asarray(p, jnp.float32)
+                        )
                     out, metrics = self._k_updates(
                         ts["params"],
                         ts["opt"],
@@ -488,6 +566,7 @@ class AsyncOffPolicyTrainer(_GradUpdateMixin):
                         ts["rng"],
                         ts["update_count"],
                         ts.get("obs"),
+                        poison,
                     )
                     params, opt_state, bstate, rng, update_count, dm = out
                     ts = {
@@ -505,11 +584,25 @@ class AsyncOffPolicyTrainer(_GradUpdateMixin):
                         # in-flight K-update program
                         DeviceMetrics.drain_async(dm)
                         if pending_obs is not None:
-                            self.device_metrics.publish(
-                                DeviceMetrics.drain(pending_obs), registry
-                            )
+                            snap = DeviceMetrics.drain(pending_obs)
+                            self.device_metrics.publish(snap, registry)
+                            if guard is not None:
+                                flat = self.device_metrics.to_flat(snap)
+                                restored = guard.observe(
+                                    step_i,
+                                    flat.get("bad_steps", 0.0),
+                                    ts["params"],
+                                    ts["opt"],
+                                )
+                                if restored is not None:
+                                    ts = {
+                                        **ts,
+                                        "params": restored[0],
+                                        "opt": restored[1],
+                                    }
                         pending_obs = dm
-                    coll.update_params(params)
+                    coll.update_params(ts["params"])
+                step_i += 1
                 yield ts, metrics
             if pending_obs is not None:
                 self.device_metrics.publish(
@@ -517,3 +610,19 @@ class AsyncOffPolicyTrainer(_GradUpdateMixin):
                 )
         finally:
             coll.stop()
+
+    # -- emergency checkpoints -------------------------------------------
+
+    def emergency_save(self, emergency, ts: dict, frames: int) -> str:
+        """Block on the in-flight dispatch (the collector is the only other
+        worker, and it only READS params) and write the entire train state
+        — replay ring included — for exact resume."""
+        jax.block_until_ready(ts["params"])
+        return emergency.save(int(frames), ts, {"frames": int(frames)})
+
+    def emergency_restore(self, emergency, ts_template: dict, step=None):
+        """Load ``(ts, frames)`` from the latest (or given) emergency
+        checkpoint; ``ts_template`` is a same-structure state, e.g. from
+        :meth:`init` with matching config."""
+        arrays, meta, step = emergency.restore(ts_template, step)
+        return arrays, int(meta.get("frames", step))

@@ -1,0 +1,377 @@
+"""Seeded chaos integration tests (ISSUE 5 satellite d).
+
+Short real training runs under deterministic fault injection: a collector
+crash mid-run restarts under supervision within budget, a NaN-poisoned
+GRPO gradient step is skipped in-program with exact parity (params across
+the poisoned step are bit-identical), a crashed rollout producer restarts
+without leaking pipeline tickets, and a synthetic preemption's emergency
+checkpoint resumes to the uninterrupted run's parameters."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from rl_tpu.collectors import AsyncHostCollector, ThreadedEnvPool
+from rl_tpu.data.specs import Bounded, Composite, Unbounded
+from rl_tpu.obs import MetricsRegistry
+from rl_tpu.resilience import (
+    EmergencyCheckpointer,
+    Fault,
+    FaultInjector,
+    LastGoodState,
+    Supervisor,
+    injection,
+)
+from rl_tpu.trainers.resilience import PreemptionHandler
+
+
+class _HostEnv:
+    """Pure-host toy env (the test_async_offpolicy fixture shape)."""
+
+    def __init__(self, delay: float = 0.0, horizon: int = 64, seed: int = 0):
+        self.delay = delay
+        self.horizon = horizon
+        self._rng = np.random.default_rng(seed)
+        self._t = 0
+        self.observation_spec = Composite(observation=Unbounded((2,)))
+        self.action_spec = Bounded(shape=(1,), low=-1.0, high=1.0)
+
+    def _obs(self):
+        return {"observation": self._rng.normal(size=2).astype(np.float32)}
+
+    def reset(self, seed=None):
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self._t = 0
+        return self._obs()
+
+    def step(self, action):
+        if self.delay:
+            time.sleep(self.delay)
+        self._t += 1
+        a = float(np.asarray(action).reshape(-1)[0])
+        reward = 1.0 - (a - 0.3) ** 2
+        return self._obs(), np.float32(reward), False, self._t >= self.horizon
+
+    def close(self):
+        pass
+
+
+def _sup(**kw):
+    kw.setdefault("backoff_base_s", 0.005)
+    kw.setdefault("backoff_max_s", 0.05)
+    kw.setdefault("registry", MetricsRegistry())
+    return Supervisor(**kw)
+
+
+def _make_offpolicy(pool, supervisor=None, registry=None, fpb=32, utd=4):
+    from rl_tpu.data import DeviceStorage, PrioritizedSampler, ReplayBuffer
+    from rl_tpu.modules import (
+        MLP,
+        ConcatMLP,
+        NormalParamExtractor,
+        ProbabilisticActor,
+        TanhNormal,
+        TDModule,
+        TDSequential,
+    )
+    from rl_tpu.objectives import SACLoss
+    from rl_tpu.trainers import AsyncOffPolicyTrainer, OffPolicyConfig
+
+    net = TDSequential(
+        TDModule(MLP(out_features=2, num_cells=(32, 32)),
+                 ["observation"], ["raw"]),
+        TDModule(NormalParamExtractor(), ["raw"], ["loc", "scale"]),
+    )
+    sac = SACLoss(ProbabilisticActor(net, TanhNormal),
+                  ConcatMLP(out_features=1, num_cells=(32, 32)), gamma=0.5)
+
+    def policy(params, td, key):
+        return sac.actor(params["actor"], td, key)
+
+    coll = AsyncHostCollector(pool, policy, frames_per_batch=fpb, seed=0,
+                              supervisor=supervisor)
+    cfg = OffPolicyConfig(batch_size=32, utd_ratio=utd, learning_rate=3e-3,
+                          init_random_frames=fpb)
+    buffer = ReplayBuffer(DeviceStorage(2048), PrioritizedSampler())
+    return AsyncOffPolicyTrainer(
+        coll, sac, buffer, cfg, priority_key="td_error",
+        device_metrics=True, metrics_registry=registry,
+    )
+
+
+def _tiny_grpo(**kw):
+    from rl_tpu.envs.llm import arithmetic_dataset
+
+    ds = arithmetic_dataset(n=64, max_operand=2)
+    defaults = dict(num_prompts=2, group_repeats=4, max_prompt_len=8,
+                    max_new_tokens=4, learning_rate=3e-3, kl_coeff=0.005)
+    defaults.update(kw)
+    cls = defaults.pop("cls", None)
+    if cls is None:
+        from rl_tpu.trainers.grpo import GRPOTrainer as cls
+    return cls(ds, **defaults)
+
+
+def _leaves(tree):
+    return [np.asarray(jax.device_get(x)) for x in jax.tree.leaves(tree)]
+
+
+class TestCollectorChaos:
+    def test_injected_crash_restarts_within_budget(self):
+        reg = MetricsRegistry()
+        sup = _sup(max_restarts=3, registry=reg)
+        pool = ThreadedEnvPool([lambda i=i: _HostEnv(seed=i) for i in range(2)])
+        coll = AsyncHostCollector(pool, None, frames_per_batch=16, seed=0,
+                                  supervisor=sup)
+        inj = FaultInjector(
+            {"collector.actor_loop": Fault("crash", at=(3,))},
+            registry=MetricsRegistry(),
+        )
+        try:
+            with injection(inj):
+                coll.start()
+                batches = [coll.get_batch(timeout=30) for _ in range(3)]
+        finally:
+            coll.stop()
+            sup.stop()
+            pool.close()
+        assert all(b is not None for b in batches)
+        assert all(b.batch_shape == (16,) for b in batches)
+        # exactly the planned crash fired; one restart, within budget
+        assert inj.fired == [("collector.actor_loop", "crash", 3)]
+        assert sup.restarts("async-collector") == 1
+        assert reg.counter(
+            "rl_tpu_resilience_restarts_total", labels=("child",)
+        ).value({"child": "async-collector"}) == 1
+
+    def test_budget_exhaustion_surfaces_to_get_batch(self):
+        sup = _sup(max_restarts=1)
+        pool = ThreadedEnvPool([lambda: _HostEnv() for _ in range(2)])
+        coll = AsyncHostCollector(pool, None, frames_per_batch=16,
+                                  supervisor=sup)
+        # crash every iteration: restart budget (1) exhausts immediately
+        inj = FaultInjector(
+            {"collector.actor_loop": Fault("crash", prob=1.0)},
+            registry=MetricsRegistry(),
+        )
+        try:
+            with injection(inj):
+                coll.start()
+                with pytest.raises(RuntimeError, match="actor thread failed"):
+                    while True:
+                        if coll.get_batch(timeout=0.2) is None and \
+                                coll._error is None and not coll._alive():
+                            raise AssertionError("collector died silently")
+        finally:
+            coll.stop()
+            sup.stop()
+            pool.close()
+        assert sup.restarts("async-collector") == 1
+
+
+class TestOffPolicyChaos:
+    def test_nan_poisoned_update_skipped_and_counted(self):
+        reg = MetricsRegistry()
+        pool = ThreadedEnvPool([lambda i=i: _HostEnv(seed=i) for i in range(2)])
+        tr = _make_offpolicy(pool, registry=reg)
+        ts = tr.init(jax.random.key(1))
+        # poison the 2nd K-update dispatch (first update of its scan)
+        inj = FaultInjector(
+            {"offpolicy.update": Fault("nan", at=(2,))},
+            registry=MetricsRegistry(),
+        )
+        losses = []
+        try:
+            with injection(inj):
+                for ts, m in tr.train(ts, total_frames=8 * 32):
+                    if m is not None:
+                        losses.append(float(m["loss_qvalue"]))
+        finally:
+            pool.close()
+        assert len(losses) >= 4
+        # params stayed finite through the poisoned dispatch
+        for leaf in _leaves(ts["params"]):
+            assert np.isfinite(leaf).all()
+        from rl_tpu.obs import DeviceMetrics
+
+        flat = tr.device_metrics.to_flat(DeviceMetrics.drain(ts["obs"]))
+        assert flat["bad_steps"] == 1.0
+        # every non-poisoned update in every dispatch was applied
+        assert flat["updates"] == len(losses) * 4 - 1
+
+    def test_guard_rolls_back_under_sustained_nan(self):
+        reg = MetricsRegistry()
+        pool = ThreadedEnvPool([lambda i=i: _HostEnv(seed=i) for i in range(2)])
+        tr = _make_offpolicy(pool, registry=reg)
+        ts = tr.init(jax.random.key(2))
+        guard = LastGoodState(rollback_after=2, snapshot_interval=1,
+                              registry=reg)
+        # three clean dispatches seed the last-good snapshot, then every
+        # dispatch poisons its first update: a sustained bad streak
+        inj = FaultInjector(
+            {"offpolicy.update": Fault("nan", at=tuple(range(4, 13)))},
+            registry=MetricsRegistry(),
+        )
+        try:
+            with injection(inj):
+                for ts, _m in tr.train(ts, total_frames=10 * 32, guard=guard):
+                    pass
+        finally:
+            pool.close()
+        assert guard.rollbacks >= 1
+        assert reg.counter("rl_tpu_resilience_rollbacks_total").value() >= 1
+        for leaf in _leaves(ts["params"]):
+            assert np.isfinite(leaf).all()
+
+    def test_synthetic_preemption_emergency_roundtrip(self, tmp_path):
+        pool = ThreadedEnvPool([lambda i=i: _HostEnv(seed=i) for i in range(2)])
+        tr = _make_offpolicy(pool)
+        ts = tr.init(jax.random.key(3))
+        handler = PreemptionHandler()
+        ec = EmergencyCheckpointer(str(tmp_path / "emg"),
+                                   registry=MetricsRegistry())
+        inj = FaultInjector(
+            {"trainer.preempt": Fault("preempt", at=(4,), target=handler)},
+            registry=MetricsRegistry(),
+        )
+        try:
+            with injection(inj):
+                seen = sum(
+                    1 for _ in tr.train(ts, total_frames=20 * 32,
+                                        preemption=handler, emergency=ec)
+                )
+        finally:
+            pool.close()
+        assert seen == 3  # the 4th loop iteration preempted before its batch
+        assert ec.latest_step() == 3 * 32
+
+        # a fresh trainer restores the exact state and keeps training
+        pool2 = ThreadedEnvPool([lambda i=i: _HostEnv(seed=i) for i in range(2)])
+        tr2 = _make_offpolicy(pool2)
+        ts2, frames = tr2.emergency_restore(ec, tr2.init(jax.random.key(9)))
+        assert frames == 3 * 32
+        saved_params = _leaves(ts2["params"])
+        try:
+            for ts2, _m in tr2.train(ts2, total_frames=2 * 32):
+                pass
+        finally:
+            pool2.close()
+        for a, b in zip(saved_params, _leaves(ts2["params"])):
+            assert a.shape == b.shape  # structure restored intact
+        for leaf in _leaves(ts2["params"]):
+            assert np.isfinite(leaf).all()
+
+
+class TestGRPOChaos:
+    def test_nan_step_skipped_with_parity(self):
+        # the reference run arms the SAME injector code path (a fault that
+        # never fires) so both runs share one jitted update trace and the
+        # pre-injection parity check is bit-exact
+        t_ref = _tiny_grpo()
+        ref_params = []
+        inj_ref = FaultInjector(
+            {"grpo.update": Fault("nan", at=(999,))},
+            registry=MetricsRegistry(),
+        )
+        with injection(inj_ref):
+            outs_ref = []
+            for _ in range(4):
+                outs_ref.append(t_ref.step())
+                ref_params.append(_leaves(t_ref.params))
+
+        t = _tiny_grpo()
+        inj = FaultInjector(
+            {"grpo.update": Fault("nan", at=(3,))},
+            registry=MetricsRegistry(),
+        )
+        chaos_params = []
+        with injection(inj):
+            outs = []
+            for _ in range(4):
+                outs.append(t.step())
+                chaos_params.append(_leaves(t.params))
+
+        # (1) pre-injection steps are bit-identical to the clean run
+        for a, b in zip(ref_params[1], chaos_params[1]):
+            np.testing.assert_array_equal(a, b)
+        # (2) the poisoned step is an exact no-op on params
+        for a, b in zip(chaos_params[1], chaos_params[2]):
+            np.testing.assert_array_equal(a, b)
+        # ...while the clean run moved
+        assert any(
+            not np.array_equal(a, b)
+            for a, b in zip(ref_params[1], ref_params[2])
+        )
+        # (3) training continues finite after the skipped step
+        assert any(
+            not np.array_equal(a, b)
+            for a, b in zip(chaos_params[2], chaos_params[3])
+        )
+        for leaf in chaos_params[3]:
+            assert np.isfinite(leaf).all()
+        # (4) the skip is counted once (lagged drain: visible by step 4)
+        assert outs[3]["bad_steps"] == 1.0
+        assert outs_ref[3]["bad_steps"] == 0.0
+
+    def test_pipelined_producer_crash_restarts_and_run_completes(self):
+        from rl_tpu.trainers.grpo import PipelinedGRPOTrainer
+
+        reg = MetricsRegistry()
+        sup = _sup(max_restarts=3, registry=reg)
+        t = _tiny_grpo(cls=PipelinedGRPOTrainer, supervisor=sup)
+        inj = FaultInjector(
+            {"grpo.rollout": Fault("crash", at=(2,))},
+            registry=MetricsRegistry(),
+        )
+        try:
+            with injection(inj):
+                for _ in range(4):
+                    out = t.step()
+                    assert np.isfinite(out["loss"])
+        finally:
+            t.close()
+            sup.stop()
+        # the producer crashed once and was restarted; the ticket the
+        # crashed iteration might have held was re-released (no hang)
+        assert ("grpo.rollout", "crash", 2) in inj.fired
+        assert sup.restarts("grpo-rollout") == 1
+
+    def test_preemption_emergency_resume_reproduces_uninterrupted_run(
+        self, tmp_path
+    ):
+        # every run arms an injector (with a fault that never fires where
+        # needed) so all updates share the poison-carrying trace and the
+        # resumed params can be compared bit-exactly
+        benign = {"grpo.update": Fault("nan", at=(999,))}
+
+        # run A: 4 uninterrupted steps
+        t_a = _tiny_grpo()
+        with injection(FaultInjector(benign, registry=MetricsRegistry())):
+            t_a.train(4, log_interval=100)
+        params_a = _leaves(t_a.params)
+
+        # run B: preempted at the start of step 2 -> emergency checkpoint
+        handler = PreemptionHandler()
+        ec = EmergencyCheckpointer(str(tmp_path / "emg"),
+                                   registry=MetricsRegistry())
+        t_b = _tiny_grpo()
+        plan_b = dict(benign)
+        plan_b["trainer.preempt"] = Fault("preempt", at=(3,), target=handler)
+        with injection(FaultInjector(plan_b, registry=MetricsRegistry())):
+            t_b.train(4, log_interval=100, preemption=handler, emergency=ec)
+        assert len(t_b.history["loss"]) == 2  # steps 0 and 1 ran
+        assert ec.latest_step() == 2
+
+        # run C: a fresh process restores and finishes the remaining steps
+        t_c = _tiny_grpo()
+        resumed = t_c.emergency_restore(ec)
+        assert resumed == 2
+        with injection(FaultInjector(benign, registry=MetricsRegistry())):
+            t_c.train(2, log_interval=100, start_step=resumed)
+        assert len(t_c.history["loss"]) == 4
+        for a, c in zip(params_a, _leaves(t_c.params)):
+            np.testing.assert_array_equal(a, c)

@@ -153,6 +153,41 @@ def test_rlhf_pipeline_subresult_distilled(tmp_path):
     assert runner.commits[0][0] == [art, mart]
 
 
+def test_chaos_subresult_distilled(tmp_path):
+    """ISSUE-5: the chaos sub-bench (recovery latency + enabled-but-idle
+    injector overhead) rides the committed METRICS json like every other
+    sub-bench "metrics" section — no special-casing in the watcher."""
+
+    class ChaosRunner(FakeRunner):
+        def bench_all(self, timeout):
+            self.bench_calls.append(timeout)
+            lines = [
+                {"metric": "ppo", "value": 123.0},
+                {"chaos": {"value": 0.21,
+                           "metrics": {"injector_overhead_frac": 0.004,
+                                       "overhead_ok": True,
+                                       "recovery_latency_s": 0.21,
+                                       "clean_batch_s": 0.03,
+                                       "restarts": 1,
+                                       "idle_faults_fired": 0}}},
+            ]
+            return 0, "".join(json.dumps(ln) + "\n" for ln in lines)
+
+    runner = ChaosRunner([_healthy()])
+    art = str(tmp_path / "bench.jsonl")
+    mart = str(tmp_path / "METRICS.json")
+    watch(runner, lambda s: None, max_probes=1, artifact=art,
+          metrics_artifact=mart, sleep=lambda s: None)
+    doc = json.loads(open(mart).read())
+    chaos = doc["bench_metrics"]["chaos"]
+    assert chaos["injector_overhead_frac"] == 0.004
+    assert chaos["overhead_ok"] is True
+    assert chaos["recovery_latency_s"] == 0.21
+    assert chaos["restarts"] == 1
+    assert chaos["idle_faults_fired"] == 0
+    assert runner.commits[0][0] == [art, mart]
+
+
 def test_no_metrics_sections_no_metrics_file(tmp_path):
     """A bench stream without metrics sections (old format) must not grow a
     stale METRICS file or change the commit set."""

@@ -1,0 +1,717 @@
+"""Unit tests for the resilience subsystem's four pillars.
+
+Each pillar is tested against injectable clocks/sleeps/registries so every
+assertion is deterministic: supervisor restart/budget/escalation, fault
+injector determinism (`at` indices and seeded prob), RetryPolicy backoff/
+deadline/idempotency, CircuitBreaker transitions + obs gauge, the
+last-good-state guard, the emergency checkpointer roundtrip, and the
+retry-aware TCP transport (dropped replies, load shedding, wait_all
+backoff)."""
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from rl_tpu.obs import MetricsRegistry
+from rl_tpu.resilience import (
+    CircuitBreaker,
+    CircuitOpenError,
+    Deadline,
+    EmergencyCheckpointer,
+    Fault,
+    FaultInjector,
+    InjectedFault,
+    LastGoodState,
+    RetryPolicy,
+    Supervisor,
+    fault_point,
+    get_injector,
+    injection,
+    poison_scalar,
+    should_drop,
+    tree_where,
+)
+
+
+# -- fault injector -----------------------------------------------------------
+
+
+class TestFaultInjector:
+    def test_at_indices_fire_deterministically(self):
+        reg = MetricsRegistry()
+        inj = FaultInjector(
+            {"collector.actor_loop": Fault("crash", at=(2, 4))}, registry=reg
+        )
+        inj.fire("collector.actor_loop")  # invocation 1: no fault
+        with pytest.raises(InjectedFault, match="invocation 2"):
+            inj.fire("collector.actor_loop")
+        inj.fire("collector.actor_loop")  # 3
+        with pytest.raises(InjectedFault, match="invocation 4"):
+            inj.fire("collector.actor_loop")
+        assert inj.counts() == {"collector.actor_loop": 4}
+        assert inj.fired == [
+            ("collector.actor_loop", "crash", 2),
+            ("collector.actor_loop", "crash", 4),
+        ]
+        c = reg.counter("rl_tpu_faults_injected_total", labels=("site", "kind"))
+        assert c.value({"site": "collector.actor_loop", "kind": "crash"}) == 2
+
+    def test_prob_trigger_is_seed_reproducible(self):
+        def run(seed):
+            inj = FaultInjector(
+                {"grpo.rollout": Fault("drop", prob=0.3)},
+                seed=seed, registry=MetricsRegistry(),
+            )
+            return [inj.fire("grpo.rollout") for _ in range(50)]
+
+        assert run(7) == run(7)
+        assert run(7) != run(8)  # different seed, different schedule
+
+    def test_poison_scalar_nan_only_at_index(self):
+        inj = FaultInjector(
+            {"grpo.update": Fault("nan", at=(2,))}, registry=MetricsRegistry()
+        )
+        vals = [inj.poison("grpo.update") for _ in range(3)]
+        assert vals[0] == 0.0 and vals[2] == 0.0
+        assert np.isnan(vals[1])
+
+    def test_delay_sleeps(self):
+        inj = FaultInjector(
+            {"serving.stepper": Fault("delay", at=(1,), seconds=0.05)},
+            registry=MetricsRegistry(),
+        )
+        t0 = time.monotonic()
+        inj.fire("serving.stepper")
+        assert time.monotonic() - t0 >= 0.05
+
+    def test_preempt_raises_target_flag(self):
+        from rl_tpu.trainers.resilience import PreemptionHandler
+
+        handler = PreemptionHandler()
+        inj = FaultInjector(
+            {"trainer.preempt": Fault("preempt", at=(2,), target=handler)},
+            registry=MetricsRegistry(),
+        )
+        inj.fire("trainer.preempt")
+        assert not handler.preempted
+        inj.fire("trainer.preempt")
+        assert handler.preempted
+
+    def test_unknown_site_and_kind_fail_loudly(self):
+        with pytest.raises(ValueError, match="unknown fault site"):
+            FaultInjector(
+                {"collector.actorloop": Fault("crash", at=(1,))},
+                registry=MetricsRegistry(),
+            )
+        with pytest.raises(ValueError, match="unknown fault kind"):
+            Fault("explode", at=(1,))
+        with pytest.raises(ValueError, match="`at` indices or a `prob`"):
+            Fault("crash")
+
+    def test_disabled_hooks_are_noops(self):
+        # no injector installed: the module hooks are one None check
+        assert get_injector() is None
+        fault_point("collector.actor_loop")
+        assert should_drop("comm.server.reply") is False
+        assert poison_scalar("grpo.update") == 0.0
+
+    def test_armed_but_idle_site_is_not_counted(self):
+        # enabled-but-idle: visiting a site outside the plan is a dict miss
+        inj = FaultInjector(
+            {"grpo.update": Fault("nan", at=(1,))}, registry=MetricsRegistry()
+        )
+        with injection(inj):
+            for _ in range(10):
+                fault_point("collector.actor_loop")
+        assert inj.counts() == {}
+        assert inj.fired == []
+
+    def test_injection_context_restores_previous(self):
+        inj = FaultInjector({}, registry=MetricsRegistry())
+        with injection(inj):
+            assert get_injector() is inj
+            inner = FaultInjector({}, registry=MetricsRegistry())
+            with injection(inner):
+                assert get_injector() is inner
+            assert get_injector() is inj
+        assert get_injector() is None
+
+
+# -- retry / deadline / circuit breaker ---------------------------------------
+
+
+class TestRetryPolicy:
+    def _policy(self, **kw):
+        kw.setdefault("registry", MetricsRegistry())
+        kw.setdefault("sleep", lambda s: None)
+        return RetryPolicy(**kw)
+
+    def test_retries_transport_errors_then_succeeds(self):
+        reg = MetricsRegistry()
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 3:
+                raise ConnectionError("boom")
+            return "ok"
+
+        p = self._policy(max_attempts=5, registry=reg)
+        assert p.call(flaky) == "ok"
+        assert len(calls) == 3
+        assert reg.counter("rl_tpu_retries_total").value() == 2
+
+    def test_non_idempotent_never_retries(self):
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            raise TimeoutError("slow")
+
+        p = self._policy(max_attempts=5)
+        with pytest.raises(TimeoutError):
+            p.call(flaky, idempotent=False)
+        assert len(calls) == 1
+
+    def test_server_side_errors_not_retried(self):
+        calls = []
+
+        def handler_error():
+            calls.append(1)
+            raise RuntimeError("remote handler failed")
+
+        with pytest.raises(RuntimeError):
+            self._policy(max_attempts=5).call(handler_error)
+        assert len(calls) == 1
+
+    def test_deadline_bounds_retries(self):
+        clk = [0.0]
+        slept = []
+
+        def sleep(s):
+            slept.append(s)
+            clk[0] += s
+
+        def failing():
+            clk[0] += 0.4
+            raise ConnectionError("down")
+
+        p = RetryPolicy(
+            max_attempts=100, base_delay_s=0.1, jitter=0.0, deadline_s=1.0,
+            clock=lambda: clk[0], sleep=sleep, registry=MetricsRegistry(),
+        )
+        with pytest.raises(ConnectionError):
+            p.call(failing)
+        # the budget (1s) bounds attempts far below max_attempts
+        assert 1 < len(slept) + 1 < 10
+
+    def test_backoff_is_capped_exponential_and_seeded(self):
+        p = self._policy(base_delay_s=0.05, max_delay_s=0.4, jitter=0.0)
+        assert [p.backoff_delay(a) for a in range(5)] == [
+            0.05, 0.1, 0.2, 0.4, 0.4
+        ]
+        a = self._policy(jitter=0.5, seed=3)
+        b = self._policy(jitter=0.5, seed=3)
+        assert [a.backoff_delay(i) for i in range(4)] == [
+            b.backoff_delay(i) for i in range(4)
+        ]
+
+    def test_deadline_none_never_expires(self):
+        dl = Deadline(None)
+        assert dl.remaining() == float("inf") and not dl.expired
+        clk = [0.0]
+        dl2 = Deadline(0.5, clock=lambda: clk[0])
+        assert not dl2.expired
+        clk[0] = 0.6
+        assert dl2.expired
+
+
+class TestCircuitBreaker:
+    def _breaker(self, clk, **kw):
+        kw.setdefault("failure_threshold", 3)
+        kw.setdefault("reset_timeout_s", 10.0)
+        return CircuitBreaker(
+            "unit", clock=lambda: clk[0], registry=kw.pop(
+                "registry", MetricsRegistry()
+            ), **kw,
+        )
+
+    def test_full_transition_cycle_with_gauge(self):
+        clk = [0.0]
+        reg = MetricsRegistry()
+        br = self._breaker(clk, registry=reg)
+        g = reg.gauge("rl_tpu_circuit_state", labels=("name",))
+        assert br.state == "closed" and g.value({"name": "unit"}) == 0.0
+
+        for _ in range(3):
+            br.allow()
+            br.on_failure()
+        assert br.state == "open" and g.value({"name": "unit"}) == 2.0
+        with pytest.raises(CircuitOpenError, match="open"):
+            br.allow()
+
+        clk[0] = 11.0  # past reset timeout: half-open with one probe
+        br.allow()
+        assert br.state == "half_open" and g.value({"name": "unit"}) == 1.0
+        with pytest.raises(CircuitOpenError, match="probe quota"):
+            br.allow()  # quota spent until the probe reports back
+        br.on_success()
+        assert br.state == "closed" and g.value({"name": "unit"}) == 0.0
+
+    def test_half_open_probe_failure_reopens(self):
+        clk = [0.0]
+        br = self._breaker(clk)
+        for _ in range(3):
+            br.on_failure()
+        clk[0] = 11.0
+        br.allow()
+        br.on_failure()  # probe failed: straight back to open
+        assert br.state == "open"
+        with pytest.raises(CircuitOpenError):
+            br.allow()
+
+    def test_open_breaker_fails_fast_inside_retry_policy(self):
+        clk = [0.0]
+        br = self._breaker(clk)
+        calls = []
+
+        def fn():
+            calls.append(1)
+            raise ConnectionError("down")
+
+        p = RetryPolicy(
+            max_attempts=10, breaker=br, sleep=lambda s: None,
+            registry=MetricsRegistry(),
+        )
+        with pytest.raises(ConnectionError):
+            p.call(fn)
+        # threshold=3: fn ran until the circuit opened, then failed fast
+        assert len(calls) == 3
+        with pytest.raises(CircuitOpenError):
+            p.call(fn)
+        assert len(calls) == 3  # fail-fast never reached fn
+
+
+# -- supervisor ---------------------------------------------------------------
+
+
+def _sup(**kw):
+    kw.setdefault("backoff_base_s", 0.002)
+    kw.setdefault("backoff_max_s", 0.01)
+    kw.setdefault("registry", MetricsRegistry())
+    return Supervisor(**kw)
+
+
+def _wait(pred, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while not pred():
+        if time.monotonic() > deadline:
+            raise AssertionError("condition not reached in time")
+        time.sleep(0.005)
+
+
+class TestSupervisor:
+    def test_crash_restarts_then_clean_exit(self):
+        reg = MetricsRegistry()
+        sup = _sup(max_restarts=5, registry=reg)
+        attempts = []
+
+        def run():
+            attempts.append(1)
+            if len(attempts) <= 2:
+                raise ValueError("transient")
+
+        child = sup.spawn("worker", run)
+        _wait(lambda: not child.is_alive())
+        assert len(attempts) == 3
+        assert child.restarts == 2 and not child.gave_up
+        assert sup.restarts("worker") == 2 and not sup.escalated
+        c = reg.counter("rl_tpu_resilience_restarts_total", labels=("child",))
+        assert c.value({"child": "worker"}) == 2
+        sup.stop()
+
+    def test_budget_exhaustion_gives_up_and_escalates(self):
+        reg = MetricsRegistry()
+        sibling_stop = threading.Event()
+        escalations = []
+
+        def on_escalate(sup, child, exc):
+            escalations.append((child.name, exc))
+            sibling_stop.set()  # owner-side stop flag for the sibling loop
+
+        sup = _sup(max_restarts=2, on_escalate=on_escalate, registry=reg)
+
+        def always_crash():
+            raise InjectedFault("hopeless")
+
+        def sibling():
+            while not sibling_stop.is_set():
+                time.sleep(0.002)
+
+        sib = sup.spawn("sibling", sibling)
+        bad = sup.spawn("doomed", always_crash)
+        _wait(lambda: bad.gave_up)
+        _wait(lambda: not sib.is_alive())
+        assert bad.restarts == 2  # budget spent before the giveup
+        assert sup.escalated
+        assert escalations and escalations[0][0] == "doomed"
+        assert isinstance(bad.error, InjectedFault)
+        assert reg.counter(
+            "rl_tpu_resilience_giveups_total", labels=("child",)
+        ).value({"child": "doomed"}) == 1
+        assert reg.counter("rl_tpu_resilience_escalations_total").value() == 1
+        sup.stop()
+
+    def test_on_giveup_hook_receives_error_without_escalation(self):
+        seen = []
+        sup = _sup(max_restarts=1)
+
+        def run():
+            raise RuntimeError("dead on arrival")
+
+        child = sup.spawn(
+            "quiet", run, on_giveup=lambda e: seen.append(e), escalate=False
+        )
+        _wait(lambda: child.gave_up)
+        assert len(seen) == 1 and isinstance(seen[0], RuntimeError)
+        assert not sup.escalated
+        sup.stop()
+
+    def test_backoff_deterministic_per_seed(self):
+        a = _sup(seed=11, jitter=0.5)
+        b = _sup(seed=11, jitter=0.5)
+        assert [a._backoff(i) for i in range(4)] == [
+            b._backoff(i) for i in range(4)
+        ]
+        flat = _sup(jitter=0.0, backoff_base_s=0.05, backoff_max_s=0.2)
+        assert [flat._backoff(i) for i in range(4)] == [0.05, 0.1, 0.2, 0.2]
+
+    def test_stop_interrupts_backoff_sleep(self):
+        sup = _sup(max_restarts=50, backoff_base_s=5.0, backoff_max_s=5.0,
+                   jitter=0.0)
+
+        def crash():
+            raise ValueError("again")
+
+        child = sup.spawn("sleeper", crash)
+        _wait(lambda: child.restarts >= 1)
+        t0 = time.monotonic()
+        sup.stop()  # must not wait out the 5 s backoff
+        assert time.monotonic() - t0 < 2.0
+        assert not child.is_alive()
+
+
+# -- last-good-state guard ----------------------------------------------------
+
+
+class TestLastGoodState:
+    def _params(self, v):
+        return {"w": jnp.full((3,), float(v))}
+
+    def test_skip_count_and_rollback_after_k_consecutive(self):
+        reg = MetricsRegistry()
+        guard = LastGoodState(rollback_after=2, snapshot_interval=1,
+                              registry=reg)
+        # two good steps: snapshot tracks the latest good state
+        assert guard.observe(0, 0.0, self._params(0), self._params(100)) is None
+        assert guard.observe(1, 0.0, self._params(1), self._params(101)) is None
+        assert guard.snapshot_version == 1
+        # two consecutive bad steps (lagged totals 1.0 then 2.0) -> rollback
+        assert guard.observe(2, 1.0, self._params(2), self._params(102)) is None
+        restored = guard.observe(3, 2.0, self._params(3), self._params(103))
+        assert restored is not None
+        params, opt, version = restored
+        assert version == 1 and guard.rollbacks == 1
+        np.testing.assert_array_equal(np.asarray(params["w"]), 1.0)
+        np.testing.assert_array_equal(np.asarray(opt["w"]), 101.0)
+        assert reg.counter("rl_tpu_resilience_rollbacks_total").value() == 1
+        assert reg.counter(
+            "rl_tpu_resilience_bad_steps_skipped_total"
+        ).value() == 2.0
+
+    def test_non_consecutive_bad_steps_do_not_roll_back(self):
+        guard = LastGoodState(rollback_after=2, snapshot_interval=1,
+                              registry=MetricsRegistry())
+        guard.observe(0, 0.0, self._params(0), self._params(0))
+        assert guard.observe(1, 1.0, self._params(1), self._params(1)) is None
+        # delta 0: the bad streak broke
+        assert guard.observe(2, 1.0, self._params(2), self._params(2)) is None
+        assert guard.observe(3, 2.0, self._params(3), self._params(3)) is None
+        assert guard.rollbacks == 0
+
+    def test_snapshot_interval_limits_copy_rate(self):
+        guard = LastGoodState(rollback_after=3, snapshot_interval=10,
+                              registry=MetricsRegistry())
+        for step in range(8):
+            guard.observe(step, 0.0, self._params(step), self._params(step))
+        assert guard.snapshot_version == 0  # next refresh at step 10
+        guard.observe(10, 0.0, self._params(10), self._params(10))
+        assert guard.snapshot_version == 10
+
+    def test_rollback_returns_fresh_copies(self):
+        guard = LastGoodState(rollback_after=1, snapshot_interval=1,
+                              registry=MetricsRegistry())
+        guard.observe(0, 0.0, self._params(7), self._params(7))
+        r1 = guard.observe(1, 1.0, self._params(8), self._params(8))
+        r2 = guard.observe(3, 2.0, self._params(9), self._params(9))
+        assert r1 is not None and r2 is not None
+        # distinct buffers each time: safe to hand to a donating dispatch
+        assert r1[0]["w"] is not r2[0]["w"]
+        np.testing.assert_array_equal(np.asarray(r2[0]["w"]), 7.0)
+
+
+class TestTreeWhere:
+    def test_selects_and_blocks_nan_propagation(self):
+        good = {"a": jnp.ones((2,)), "b": jnp.zeros(())}
+        bad = {"a": jnp.full((2,), jnp.nan), "b": jnp.asarray(jnp.inf)}
+        kept = tree_where(jnp.asarray(False), bad, good)
+        assert np.isfinite(np.asarray(kept["a"])).all()
+        taken = tree_where(jnp.asarray(True), good, bad)
+        np.testing.assert_array_equal(np.asarray(taken["a"]), 1.0)
+
+
+# -- emergency checkpointer ---------------------------------------------------
+
+
+class TestEmergencyCheckpointer:
+    def test_roundtrip_arrays_meta_and_typed_keys(self, tmp_path):
+        reg = MetricsRegistry()
+        ec = EmergencyCheckpointer(str(tmp_path / "emg"), registry=reg)
+        arrays = {"w": jnp.arange(3.0), "key": jax.random.key(7)}
+        ec.save(5, arrays, {"step": 5, "note": "preempted"})
+        assert ec.latest_step() == 5
+        assert reg.counter(
+            "rl_tpu_resilience_emergency_checkpoints_total"
+        ).value() == 1
+
+        ec2 = EmergencyCheckpointer(str(tmp_path / "emg"),
+                                    registry=MetricsRegistry())
+        template = {"w": jnp.zeros(3), "key": jax.random.key(0)}
+        got, meta, step = ec2.restore(template)
+        assert step == 5 and meta["note"] == "preempted"
+        np.testing.assert_array_equal(np.asarray(got["w"]), [0.0, 1.0, 2.0])
+        # the typed PRNG key survives: same downstream randomness
+        np.testing.assert_array_equal(
+            np.asarray(jax.random.bits(got["key"], (4,))),
+            np.asarray(jax.random.bits(jax.random.key(7), (4,))),
+        )
+
+    def test_restore_without_checkpoint_raises(self, tmp_path):
+        ec = EmergencyCheckpointer(str(tmp_path / "none"),
+                                   registry=MetricsRegistry())
+        with pytest.raises(FileNotFoundError):
+            ec.restore({"w": jnp.zeros(1)})
+
+
+# -- retry-aware TCP transport ------------------------------------------------
+
+
+class TestRetryingTransport:
+    def test_dropped_reply_survives_idempotent_retry(self):
+        from rl_tpu.comm import TCPCommandClient, TCPCommandServer
+
+        server = TCPCommandServer().start()
+        calls = []
+        server.register_handler("echo", lambda p: (calls.append(1), p)[1])
+        try:
+            host, port = server.address
+            retry = RetryPolicy(max_attempts=3, base_delay_s=0.01,
+                                registry=MetricsRegistry())
+            client = TCPCommandClient(host, port, timeout=5.0, retry=retry)
+            inj = FaultInjector(
+                {"comm.server.reply": Fault("drop", at=(1,))},
+                registry=MetricsRegistry(),
+            )
+            with injection(inj):
+                assert client.call("echo", {"x": 1}) == {"x": 1}
+            # the dropped reply did NOT mean a dropped request: the handler
+            # ran for the original call AND the retry
+            assert len(calls) == 2
+        finally:
+            server.shutdown()
+
+    def test_dropped_reply_fails_non_idempotent_call(self):
+        from rl_tpu.comm import TCPCommandClient, TCPCommandServer
+
+        server = TCPCommandServer().start()
+        server.register_handler("mutate", lambda p: "done")
+        try:
+            host, port = server.address
+            retry = RetryPolicy(max_attempts=3, base_delay_s=0.01,
+                                registry=MetricsRegistry())
+            client = TCPCommandClient(host, port, timeout=5.0, retry=retry)
+            inj = FaultInjector(
+                {"comm.server.reply": Fault("drop", at=(1,))},
+                registry=MetricsRegistry(),
+            )
+            with injection(inj):
+                with pytest.raises(ConnectionError, match="empty reply"):
+                    client.call("mutate", None, idempotent=False)
+        finally:
+            server.shutdown()
+
+
+class _Fin:
+    def __init__(self, rid):
+        self.rid = rid
+        self.tokens = np.asarray([1, 2, 3])
+        self.log_probs = np.asarray([0.0, 0.0, 0.0])
+        self.finished_reason = "length"
+
+
+class _ToyEngine:
+    """Minimal engine surface for service-level tests: submit queues a rid,
+    step finishes the oldest one. ``stuck=True`` never finishes anything."""
+
+    def __init__(self, stuck=False):
+        self.stuck = stuck
+        self._q: list[int] = []
+        self._rid = 0
+        self.finished: list[_Fin] = []
+        self.free_blocks: list[int] = []
+        self.decode_steps = 0
+
+    def pending(self):
+        return len(self._q)
+
+    def submit(self, prompt, max_new_tokens):
+        self._rid += 1
+        self._q.append(self._rid)
+        return self._rid
+
+    def step(self):
+        if self.stuck:
+            time.sleep(0.001)
+            return
+        if self._q:
+            self.finished.append(_Fin(self._q.pop(0)))
+        self.decode_steps += 1
+
+
+class TestLoadShedding:
+    def test_saturated_submit_gets_retry_after_sentinel(self):
+        from rl_tpu.comm import TCPCommandClient
+        from rl_tpu.models.serving import ServingService
+
+        svc = ServingService(_ToyEngine(stuck=True), metrics_port=None,
+                             registry=MetricsRegistry(), max_queue=2,
+                             retry_after_s=0.01).start()
+        try:
+            host, port = svc.address
+            client = TCPCommandClient(host, port, timeout=5.0)
+            assert client.call("submit", {"prompt": [1], "max_new_tokens": 2}) == 1
+            assert client.call("submit", {"prompt": [2], "max_new_tokens": 2}) == 2
+            out = client.call("submit", {"prompt": [3], "max_new_tokens": 2})
+            assert out == {"saturated": True, "retry_after": 0.01}
+            assert svc._m_shed.value() == 1
+        finally:
+            svc.shutdown()
+
+    def test_remote_engine_backs_off_then_raises_service_saturated(self):
+        from rl_tpu.models.serving import RemoteEngine, ServiceSaturated, ServingService
+
+        eng = _ToyEngine(stuck=True)
+        svc = ServingService(eng, metrics_port=None, max_queue=1,
+                             retry_after_s=0.01).start()
+        try:
+            host, port = svc.address
+            client = RemoteEngine(host, port, max_shed_retries=2)
+            assert client.submit([1], 2) == 1  # fills the queue
+            with pytest.raises(ServiceSaturated) as ei:
+                client.submit([2], 2)
+            assert ei.value.retry_after == 0.01
+            # shed replies are retryable once the service drains (shed
+            # submits never reached the engine, so the next rid is 2)
+            with svc._lock:
+                eng._q.clear()
+            assert client.submit([3], 2) == 2
+        finally:
+            svc.shutdown()
+
+    def test_supervised_stepper_restarts_after_injected_crash(self):
+        from rl_tpu.models.serving import RemoteEngine, ServingService
+
+        sup = _sup(max_restarts=3)
+        svc = ServingService(_ToyEngine(), metrics_port=None,
+                             supervisor=sup).start()
+        try:
+            host, port = svc.address
+            client = RemoteEngine(host, port)
+            inj = FaultInjector(
+                {"serving.stepper": Fault("crash", at=(2,))},
+                registry=MetricsRegistry(),
+            )
+            with injection(inj):
+                rid = client.submit([5, 6], 3)
+                out = client.wait_all([rid], poll_s=0.01, timeout=30.0)
+            assert out[rid]["tokens"] == [1, 2, 3]
+            assert sup.restarts("serving-stepper") == 1
+            assert svc._error is None  # restarted, not wedged
+        finally:
+            svc.shutdown()
+            sup.stop()
+
+
+class TestWaitAllBackoff:
+    def test_poll_interval_doubles_to_cap(self, monkeypatch):
+        from rl_tpu.comm import TCPCommandServer
+        from rl_tpu.models.serving import RemoteEngine
+
+        server = TCPCommandServer().start()
+        collects = []
+
+        def collect(payload):
+            collects.append(1)
+            # finish rid 1 only on the 7th poll
+            return {"1": {"tokens": [9], "log_probs": [0.0],
+                          "finished_reason": "length"}} if len(collects) >= 7 else {}
+
+        server.register_handler("collect", collect)
+        slept = []
+        monkeypatch.setattr(time, "sleep", lambda s: slept.append(s))
+        try:
+            host, port = server.address
+            eng = RemoteEngine(host, port)
+            out = eng.wait_all([1], poll_s=0.2, timeout=120.0)
+            assert out[1]["tokens"] == [9]
+            # exponential from poll_s, capped at 1 s
+            assert slept == [0.2, 0.4, 0.8, 1.0, 1.0, 1.0]
+        finally:
+            server.shutdown()
+
+    def test_deadline_expiry_raises_timeout(self, monkeypatch):
+        from rl_tpu.comm import TCPCommandServer
+        from rl_tpu.models.serving import RemoteEngine
+
+        server = TCPCommandServer().start()
+        server.register_handler("collect", lambda p: {})
+        monkeypatch.setattr(time, "sleep", lambda s: None)
+        try:
+            host, port = server.address
+            eng = RemoteEngine(host, port)
+            with pytest.raises(TimeoutError, match="not finished"):
+                eng.wait_all([1], poll_s=0.01, timeout=0.2)
+        finally:
+            server.shutdown()
+
+
+class TestKVUtilizationAccounting:
+    def test_kv_utilization_is_free_list_accounting(self):
+        from rl_tpu.models.serving import LoadBalancer
+
+        class _E:
+            def __init__(self, total, free):
+                self._n_pool_blocks = total
+                self.free_blocks = list(range(free))
+
+            def pending(self):
+                return 0
+
+        lb = LoadBalancer([_E(8, 4), _E(8, 7)], "kv-cache")
+        assert lb._kv_utilization(lb.engines[0]) == pytest.approx(0.5)
+        assert lb._kv_utilization(lb.engines[1]) == pytest.approx(1 / 8)
+        assert lb.select_engine() == 1  # least-utilized KV pool wins
