@@ -8,6 +8,8 @@ from .serving import (
     Request,
     ServingService,
 )
+from .serving import ServiceSaturated
+from .fleet import ServingFleet, ShedRequest
 from .act import ACTConfig, ACTModel
 from .rssm import RSSM, DreamerModelLoss, RSSMConfig, dreamer_lambda_returns
 from .rssm_v3 import (
@@ -43,6 +45,9 @@ __all__ = [
     "ContinuousBatchingEngine",
     "LoadBalancer",
     "ServingService",
+    "ServingFleet",
+    "ShedRequest",
+    "ServiceSaturated",
     "RemoteEngine",
     "FinishedRequest",
     "Request",

@@ -1,0 +1,833 @@
+"""Fault-tolerant serving fleet: health-checked membership, failover
+re-dispatch, and SLO-aware admission (ROADMAP item 2; ISSUE 6 tentpole).
+
+One :class:`~rl_tpu.models.serving.ContinuousBatchingEngine` behind a
+``LoadBalancer`` raises when the engine dies. RLAX (arXiv 2512.06392)
+puts disaggregated generate replicas behind a routing layer, and Podracer
+(arXiv 2104.06272) argues the SCHEDULER — not the chip — is what makes a
+large run survivable. :class:`ServingFleet` is that scheduler for the
+serving tier:
+
+- **Health-checked membership.** Every member engine is driven by a
+  supervised stepper thread (PR 5's :class:`~rl_tpu.resilience.Supervisor`)
+  that beats a :class:`~rl_tpu.comm.liveness.Watchdog` each iteration. A
+  monitor thread probes each member every ``probe_interval_s`` (thread
+  alive + fresh beat + the ``fleet.probe_drop`` chaos site); after
+  ``quarantine_after`` CONSECUTIVE failures the member is quarantined —
+  routed around, never removed. Re-admission is supervised and backed
+  off: a crashed stepper restarts under the Supervisor's backoff, and a
+  quarantined member rejoins only after ``readmit_probes`` consecutive
+  healthy probes past an exponential per-member backoff gate.
+- **Failover re-dispatch, exactly once.** Every fleet request carries a
+  fleet-level id (``frid``); each dispatch maps the member engine's rid
+  back to it. When a member crashes (``fleet.engine_crash`` raising in
+  its stepper) or is quarantined, its outstanding requests are re-queued
+  at the FRONT of their lane and re-dispatched to survivors. Generation
+  restarts from the prompt — re-dispatch is idempotent by replay — and
+  the first completion to arrive wins: a quarantined-but-alive member
+  that later finishes its copy (the classic false-positive probe case)
+  has that duplicate SUPPRESSED by frid, so an admitted request
+  completes exactly once, never zero times and never twice.
+- **KV-aware admission.** ``submit`` sheds with an explicit
+  :class:`~rl_tpu.models.serving.ServiceSaturated` (``retry_after``)
+  when the fleet-wide free-KV-block fraction across non-dead members
+  drops below ``admission_watermark`` (each member's utilization is the
+  ``LoadBalancer``'s O(1) free-list accounting) or when ``max_queue``
+  outstanding requests are already admitted. Shed-or-finish is the
+  invariant: an admitted request is never silently lost.
+- **SLO-aware routing.** Two priority lanes — ``interactive`` is always
+  dispatched before ``batch`` (rollout generation is a tenant, not a
+  peer). Interactive picks the member minimizing a tail-latency score
+  (queue depth x an EMA of that member's recent per-request completion
+  latency, plus a KV-pressure term — the same per-engine gauges the obs
+  subsystem exports); batch routes through the embedded ``LoadBalancer``
+  strategy chain over the currently-healthy members.
+
+Chaos surface: ``fleet.engine_crash`` (+ a per-member
+``fleet.engine_crash.<idx>`` registered via
+:func:`~rl_tpu.resilience.faults.register_site`, because per-site
+invocation counters are shared across threads and a plan must be able to
+kill a SPECIFIC replica deterministically), ``fleet.probe_drop``, and
+``fleet.dispatch_delay``. ``bench.py fleet`` replays seeded open-loop
+Poisson + burst traffic against a 3-engine fleet across an injected
+mid-run crash and asserts the completed-or-shed accounting balances
+exactly (see ``docs/serving_fleet.md``).
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import threading
+import time
+from typing import Any
+
+import numpy as np
+
+from ..comm.liveness import Watchdog
+from ..resilience.faults import fault_point, register_site, should_drop
+from .serving import (
+    ContinuousBatchingEngine,
+    FinishedRequest,
+    LoadBalancer,
+    ServiceSaturated,
+)
+
+__all__ = ["HEALTHY", "QUARANTINED", "DEAD", "ServingFleet", "ShedRequest"]
+
+HEALTHY = "healthy"
+QUARANTINED = "quarantined"
+DEAD = "dead"
+_STATE_VALUE = {HEALTHY: 0.0, QUARANTINED: 1.0, DEAD: 2.0}
+
+# tracked-request states
+_QUEUED, _DISPATCHING, _DISPATCHED, _DONE, _SHED = (
+    "queued", "dispatching", "dispatched", "done", "shed",
+)
+
+
+@dataclasses.dataclass
+class ShedRequest:
+    """A post-admission shed, delivered through ``harvest`` — the explicit
+    counterpart of a completion (the caller backs off ``retry_after``
+    seconds and resubmits). Only issued when a request exhausts its
+    re-dispatch budget or the last live member is gone; admission-time
+    sheds raise :class:`ServiceSaturated` instead and are never
+    admitted."""
+
+    frid: int
+    retry_after: float
+    reason: str
+
+
+@dataclasses.dataclass
+class _Tracked:
+    frid: int
+    prompt: np.ndarray
+    max_new_tokens: int
+    lane: str
+    state: str
+    submitted_at: float
+    member: int = -1
+    erid: int = -1
+    dispatches: int = 0
+    first_token_at: float | None = None
+    done_at: float | None = None
+    result: Any = None  # FinishedRequest | ShedRequest
+
+
+class _Member:
+    """One engine replica plus its routing-side bookkeeping. ``lock``
+    guards the ENGINE object only; every other field is guarded by the
+    fleet lock (lock order: fleet lock may take ``lock``, never the
+    reverse)."""
+
+    def __init__(self, idx: int, engine: ContinuousBatchingEngine):
+        self.idx = idx
+        self.name = f"engine-{idx}"
+        self.engine = engine
+        self.lock = threading.Lock()
+        self.state = HEALTHY
+        self.assigned: dict[int, int] = {}  # engine rid -> frid
+        self.admit_events: list[tuple[int, float]] = []  # stepper-thread only
+        self.probe_failures = 0
+        self.probe_successes = 0
+        self.quarantines = 0  # lifetime count -> re-admission backoff exponent
+        self.readmit_at = 0.0
+        self.lat_ema: float | None = None  # per-request completion latency
+        self.child = None  # Supervisor child
+
+
+class ServingFleet:
+    """N continuous-batching engines behind health-checked, SLO-aware
+    routing that survives member death (module docstring has the design).
+
+    Args:
+        engines: the member replicas (homogeneous configs assumed — the
+            first engine's limits validate submissions for all).
+        supervisor: optional :class:`rl_tpu.resilience.Supervisor`; the
+            fleet creates (and owns) one when omitted.
+        registry: optional :class:`rl_tpu.obs.MetricsRegistry`; defaults
+            to the process registry.
+        probe_interval_s / probe_timeout_s: monitor sweep cadence and the
+            watchdog staleness bound a beat must stay inside. The stepper
+            cannot beat while blocked inside ``engine.step()``, so the
+            timeout must exceed the worst single step INCLUDING first-use
+            XLA compiles — warm the engines (one request through each)
+            before ``start()`` when using a tight timeout. A stale-probe
+            quarantine of a merely-slow member is SAFE (its late
+            completions dedup) but wastes duplicated decode work.
+        quarantine_after: consecutive probe failures before quarantine.
+        readmit_probes: consecutive probe successes (past the backoff
+            gate) before a quarantined member rejoins.
+        readmit_backoff_s / readmit_backoff_max_s: re-admission gate —
+            doubles per lifetime quarantine of that member, capped.
+        admission_watermark: shed admission when fleet-wide free KV
+            blocks (across non-dead members) fall below this fraction.
+        max_queue: cap on outstanding admitted requests (None = no cap).
+        max_pending_per_engine: dispatcher capacity gate per member
+            (default ``2 * n_slots`` of the first engine).
+        max_dispatches: re-dispatch budget per request; exceeding it
+            sheds the request through ``harvest`` with ``retry_after``.
+        retry_after_s: the explicit back-off hint carried by every shed.
+    """
+
+    LANES = ("interactive", "batch")
+
+    def __init__(
+        self,
+        engines,
+        *,
+        supervisor=None,
+        registry=None,
+        probe_interval_s: float = 0.02,
+        probe_timeout_s: float = 5.0,
+        quarantine_after: int = 3,
+        readmit_probes: int = 2,
+        readmit_backoff_s: float = 0.05,
+        readmit_backoff_max_s: float = 2.0,
+        admission_watermark: float = 0.05,
+        max_queue: int | None = None,
+        max_pending_per_engine: int | None = None,
+        max_dispatches: int = 5,
+        retry_after_s: float = 0.25,
+        idle_sleep_s: float = 0.002,
+        batch_strategy="requests",
+    ):
+        engines = list(engines)
+        if not engines:
+            raise ValueError("ServingFleet needs at least one engine")
+        self._members = [_Member(i, e) for i, e in enumerate(engines)]
+        self.probe_interval_s = probe_interval_s
+        self.quarantine_after = quarantine_after
+        self.readmit_probes = readmit_probes
+        self.readmit_backoff_s = readmit_backoff_s
+        self.readmit_backoff_max_s = readmit_backoff_max_s
+        self.admission_watermark = admission_watermark
+        self.max_queue = max_queue
+        self.max_pending_per_engine = (
+            max_pending_per_engine
+            if max_pending_per_engine is not None
+            else 2 * engines[0].n_slots
+        )
+        self.max_dispatches = max_dispatches
+        self.retry_after_s = retry_after_s
+        self.idle_sleep_s = idle_sleep_s
+
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._started = False
+        self._error: str | None = None
+        self._next_frid = 0
+        self._tracked: dict[int, _Tracked] = {}
+        self._lanes: dict[int, Any] = {
+            lane: collections.deque() for lane in self.LANES
+        }
+        self._ready: dict[int, Any] = {}  # frid -> result, drained by harvest
+
+        # the embedded balancer IS the O(1) KV accounting + the batch-lane
+        # strategy chain; its engine list is swapped to the healthy set per
+        # selection (allow_empty: an all-quarantined moment must shed, not
+        # raise ValueError — the satellite fix this fleet depends on)
+        self._lb = LoadBalancer(
+            engines, batch_strategy, retry_after_s=retry_after_s, allow_empty=True
+        )
+        self._watchdog = Watchdog(timeout=probe_timeout_s)
+        if supervisor is None:
+            from ..resilience.supervisor import Supervisor
+
+            supervisor = Supervisor(name="fleet", max_restarts=8, window_s=60.0,
+                                    backoff_base_s=0.01, backoff_max_s=0.25)
+            self._own_sup = True
+        else:
+            self._own_sup = False
+        self._sup = supervisor
+
+        for m in self._members:
+            register_site(
+                f"fleet.engine_crash.{m.idx}",
+                f"ServingFleet member {m.idx} stepper, per busy iteration",
+            )
+            m.engine.on_admit = self._make_on_admit(m)
+
+        # fleet-level accounting (guarded by the fleet lock); the invariant
+        # the chaos bench asserts is admitted == done + shed + outstanding
+        # at every instant, with outstanding == 0 once drained
+        self.admitted = 0
+        self.completed = 0
+        self.shed: dict[str, int] = {}
+        self.redispatched = 0
+        self.duplicates_suppressed = 0
+        self.crashes = 0
+        self.quarantines_total = 0
+        self.readmissions = 0
+
+        if registry is None:
+            from ..obs import get_registry
+
+            registry = get_registry()
+        self.registry = registry
+        from ..obs import get_tracer
+
+        self._tracer = get_tracer()
+        self._init_metrics(registry)
+
+    # -- obs wiring ------------------------------------------------------------
+
+    def _init_metrics(self, reg):
+        p = "rl_tpu_fleet"
+        self._c_admitted = reg.counter(f"{p}_admitted_total", "requests admitted")
+        self._c_completed = reg.counter(f"{p}_completions_total",
+                                        "admitted requests completed exactly once")
+        self._c_shed = reg.counter(f"{p}_shed_total",
+                                   "requests shed with an explicit retry-after",
+                                   labels=("reason",))
+        self._c_redispatched = reg.counter(
+            f"{p}_redispatched_total", "failover re-dispatches onto survivors")
+        self._c_dups = reg.counter(
+            f"{p}_duplicates_suppressed_total",
+            "late duplicate completions suppressed by request-id dedup")
+        self._c_crashes = reg.counter(f"{p}_engine_crashes_total",
+                                      "member stepper crashes")
+        self._c_quarantines = reg.counter(f"{p}_quarantines_total",
+                                          "members quarantined")
+        self._c_readmissions = reg.counter(f"{p}_readmissions_total",
+                                           "quarantined members re-admitted")
+        self._g_health = reg.gauge(
+            f"{p}_engine_health",
+            "member health (0=healthy, 1=quarantined, 2=dead)",
+            labels=("engine",))
+        self._g_free_kv = reg.gauge(f"{p}_free_kv_blocks",
+                                    "fleet-wide free KV blocks (non-dead members)")
+        self._g_total_kv = reg.gauge(f"{p}_kv_blocks_total",
+                                     "fleet-wide KV pool size (non-dead members)")
+        self._g_lane = reg.gauge(f"{p}_lane_queue_depth",
+                                 "requests waiting for dispatch", labels=("lane",))
+        self._g_outstanding = reg.gauge(f"{p}_outstanding",
+                                        "admitted requests not yet done or shed")
+        for m in self._members:
+            self._g_health.set(0.0, {"engine": str(m.idx)})
+        reg.register_collector(self._update_gauges)
+
+    def _update_gauges(self):
+        with self._lock:
+            free, total = self._kv_blocks_locked()
+            lanes = {lane: len(q) for lane, q in self._lanes.items()}
+            outstanding = self._outstanding_locked()
+            states = [(m.idx, m.state) for m in self._members]
+        self._g_free_kv.set(float(free))
+        self._g_total_kv.set(float(total))
+        for lane, depth in lanes.items():
+            self._g_lane.set(float(depth), {"lane": lane})
+        self._g_outstanding.set(float(outstanding))
+        for idx, state in states:
+            self._g_health.set(_STATE_VALUE[state], {"engine": str(idx)})
+
+    # -- lifecycle -------------------------------------------------------------
+
+    def start(self) -> "ServingFleet":
+        if self._started:
+            return self
+        self._started = True
+        for m in self._members:
+            self._watchdog.register(m.name)
+            m.child = self._sup.spawn(
+                m.name, lambda m=m: self._member_loop(m),
+                escalate=False,
+                on_giveup=lambda exc, m=m: self._on_member_giveup(m, exc),
+            )
+        self._dispatcher = self._sup.spawn(
+            "fleet-dispatcher", self._dispatch_loop, escalate=False,
+            on_giveup=self._on_control_giveup,
+        )
+        self._monitor = self._sup.spawn(
+            "fleet-monitor", self._monitor_loop, escalate=False,
+            on_giveup=self._on_control_giveup,
+        )
+        return self
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        if self._started:
+            if self._own_sup:
+                self._sup.stop()
+            else:
+                for m in self._members:
+                    if m.child is not None:
+                        m.child.stop()
+                self._dispatcher.stop()
+                self._monitor.stop()
+        if self.registry is not None:
+            self.registry.unregister_collector(self._update_gauges)
+
+    # -- admission (the SLO-aware front door) ----------------------------------
+
+    def submit(self, prompt, max_new_tokens: int, lane: str = "interactive") -> int:
+        """Admit a request into ``lane`` and return its fleet id, or shed
+        with :class:`ServiceSaturated` when the KV watermark or queue cap
+        says the fleet cannot absorb it. Validation errors (bad lane,
+        oversize prompt) raise ``ValueError`` BEFORE admission so the
+        dispatcher never meets a request no engine can serve."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if lane not in self._lanes:
+            raise ValueError(f"unknown lane {lane!r}; want one of {self.LANES}")
+        # pre-validate against the (homogeneous) engine limits: an invalid
+        # request must fail the CALLER, not crash the dispatcher later
+        eng0 = self._members[0].engine
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if len(prompt) + max_new_tokens > eng0.max_seq_len:
+            raise ValueError(
+                f"prompt ({len(prompt)}) + max_new_tokens ({max_new_tokens}) "
+                f"exceeds max_seq_len ({eng0.max_seq_len})"
+            )
+        if len(prompt) > eng0.buckets[-1]:
+            raise ValueError(
+                f"prompt length {len(prompt)} exceeds the largest prefill "
+                f"bucket {eng0.buckets[-1]}"
+            )
+        with self._lock:
+            if self._error is not None:
+                raise RuntimeError(f"fleet control plane died:\n{self._error}")
+            alive = [m for m in self._members if m.state != DEAD]
+            if not alive:
+                self._count_shed_locked("no_members")
+                raise ServiceSaturated(self.retry_after_s)
+            free, total = self._kv_blocks_locked()
+            if total > 0 and free < self.admission_watermark * total:
+                self._count_shed_locked("kv_watermark")
+                raise ServiceSaturated(self.retry_after_s)
+            if self.max_queue is not None and self._outstanding_locked() >= self.max_queue:
+                self._count_shed_locked("queue_full")
+                raise ServiceSaturated(self.retry_after_s)
+            frid = self._next_frid
+            self._next_frid += 1
+            self._tracked[frid] = _Tracked(
+                frid, prompt, int(max_new_tokens), lane, _QUEUED, time.monotonic()
+            )
+            self._lanes[lane].append(frid)
+            self.admitted += 1
+            self._c_admitted.inc()
+            return frid
+
+    def _count_shed_locked(self, reason: str) -> None:
+        self.shed[reason] = self.shed.get(reason, 0) + 1
+        self._c_shed.inc(1, {"reason": reason})
+        self._tracer.instant("fleet_shed", {"reason": reason})
+
+    def _kv_blocks_locked(self) -> tuple[int, int]:
+        """Fleet-wide (free, total) KV blocks over non-dead members —
+        each term is the LoadBalancer's O(1) free-list accounting."""
+        free = total = 0
+        for m in self._members:
+            if m.state == DEAD:
+                continue
+            n = m.engine._n_pool_blocks
+            total += n
+            free += n - int(round(self._lb._kv_utilization(m.engine) * n))
+        return free, total
+
+    _ADMISSION_SHEDS = ("kv_watermark", "queue_full", "no_members")
+
+    def _outstanding_locked(self) -> int:
+        return self.admitted - self.completed - self._post_shed_locked()
+
+    def _post_shed_locked(self) -> int:
+        """Sheds of ADMITTED requests (admission-time sheds were never
+        admitted, so they don't reduce the outstanding count)."""
+        return sum(n for r, n in self.shed.items() if r not in self._ADMISSION_SHEDS)
+
+    # -- results ---------------------------------------------------------------
+
+    def harvest(self) -> dict[int, Any]:
+        """Pop results ready so far: ``{frid: FinishedRequest | ShedRequest}``.
+        Every admitted request eventually appears here exactly once."""
+        with self._lock:
+            out = self._ready
+            self._ready = {}
+            return out
+
+    def wait(self, frids=None, timeout: float = 120.0, poll_s: float = 0.005) -> dict:
+        """Collect until every frid (default: everything outstanding at
+        call time) is done-or-shed; raises ``TimeoutError`` otherwise."""
+        deadline = time.monotonic() + timeout
+        with self._lock:
+            want = (
+                set(int(f) for f in frids)
+                if frids is not None
+                else {f for f, t in self._tracked.items()
+                      if t.state not in (_DONE, _SHED)}
+            )
+            got = {f: self._tracked[f].result
+                   for f in want if f in self._tracked
+                   and self._tracked[f].state in (_DONE, _SHED)}
+        self.harvest()  # results also stay in _tracked; drain the buffer
+        want -= set(got)
+        while want:
+            if time.monotonic() > deadline:
+                raise TimeoutError(f"requests {sorted(want)[:8]}... not settled "
+                                   f"in {timeout}s")
+            time.sleep(poll_s)
+            with self._lock:
+                if self._error is not None:
+                    raise RuntimeError(
+                        f"fleet control plane died:\n{self._error}")
+                for f in list(want):
+                    t = self._tracked.get(f)
+                    if t is not None and t.state in (_DONE, _SHED):
+                        got[f] = t.result
+                        want.discard(f)
+            self.harvest()
+        return got
+
+    def pending(self) -> int:
+        with self._lock:
+            return self._outstanding_locked()
+
+    def request_stats(self) -> list[dict]:
+        """Per-request timing/routing snapshot (the bench's TTFT source)."""
+        with self._lock:
+            return [
+                {
+                    "frid": t.frid, "lane": t.lane, "state": t.state,
+                    "submitted_at": t.submitted_at,
+                    "first_token_at": t.first_token_at,
+                    "done_at": t.done_at, "dispatches": t.dispatches,
+                    "tokens": (len(t.result.tokens)
+                               if isinstance(t.result, FinishedRequest) else 0),
+                }
+                for t in self._tracked.values()
+            ]
+
+    def metrics_snapshot(self) -> dict:
+        with self._lock:
+            free, total = self._kv_blocks_locked()
+            return {
+                "admitted": self.admitted,
+                "completed": self.completed,
+                "shed": dict(self.shed),
+                "redispatched": self.redispatched,
+                "duplicates_suppressed": self.duplicates_suppressed,
+                "crashes": self.crashes,
+                "quarantines": self.quarantines_total,
+                "readmissions": self.readmissions,
+                "outstanding": self._outstanding_locked(),
+                "free_kv_blocks": free,
+                "kv_blocks_total": total,
+                "lane_depth": {lane: len(q) for lane, q in self._lanes.items()},
+                "members": [
+                    {"idx": m.idx, "state": m.state,
+                     "pending": m.engine.pending(),
+                     "quarantines": m.quarantines,
+                     "restarts": (m.child.restarts if m.child else 0)}
+                    for m in self._members
+                ],
+            }
+
+    def accounting(self) -> dict:
+        """The invariant, as numbers: ``lost`` must be zero always."""
+        with self._lock:
+            post = self._post_shed_locked()
+            adm = sum(self.shed.get(r, 0) for r in self._ADMISSION_SHEDS)
+            outstanding = self._outstanding_locked()
+            return {
+                "admitted": self.admitted,
+                "completed": self.completed,
+                "shed_admission": adm,
+                "shed_post_admission": post,
+                "outstanding": outstanding,
+                "redispatched": self.redispatched,
+                "duplicates_suppressed": self.duplicates_suppressed,
+                "lost": self.admitted - self.completed - post - outstanding,
+            }
+
+    # -- member stepper (supervised) -------------------------------------------
+
+    def _make_on_admit(self, m: _Member):
+        # runs on m's stepper thread inside engine.step() -> _admit, under
+        # m.lock: appending is safe because admit_events is only ever
+        # touched from that thread (settle + crash paths included)
+        def on_admit(erid: int, m=m):
+            m.admit_events.append((erid, time.monotonic()))
+
+        return on_admit
+
+    def _member_loop(self, m: _Member) -> None:
+        eng = m.engine
+        while not self._stop.is_set():
+            self._watchdog.beat(m.name)
+            try:
+                with m.lock:
+                    busy = eng.pending() > 0
+                    if busy:
+                        # chaos sites fire only when there is work to lose:
+                        # an idle replica cannot crash mid-decode
+                        fault_point("fleet.engine_crash")
+                        fault_point(f"fleet.engine_crash.{m.idx}")
+                        eng.step()
+                    fin = list(eng.finished)
+                    eng.finished.clear()
+            except BaseException as e:
+                self._on_member_crash(m, e)
+                raise  # the Supervisor restarts this loop after backoff
+            if fin or m.admit_events:
+                self._settle(m, fin)
+            if not busy:
+                self._stop.wait(self.idle_sleep_s)
+
+    def _settle(self, m: _Member, fin) -> None:
+        """Attribute admissions (TTFT) and completions back to fleet
+        requests; first completion wins, duplicates are suppressed."""
+        events, m.admit_events = m.admit_events, []
+        now = time.monotonic()
+        with self._lock:
+            for erid, t in events:
+                frid = m.assigned.get(erid)
+                tr = self._tracked.get(frid) if frid is not None else None
+                if tr is not None and tr.first_token_at is None:
+                    tr.first_token_at = t
+            for f in fin:
+                frid = m.assigned.pop(f.rid, None)
+                if frid is None:
+                    continue  # assignment was cleared by a crash reset
+                tr = self._tracked[frid]
+                if tr.state in (_DONE, _SHED):
+                    self.duplicates_suppressed += 1
+                    self._c_dups.inc()
+                    self._tracer.instant(
+                        "fleet_duplicate_suppressed",
+                        {"frid": frid, "engine": m.idx})
+                    continue
+                tr.state, tr.result, tr.done_at = _DONE, f, now
+                self._ready[frid] = f
+                self.completed += 1
+                self._c_completed.inc()
+                lat = now - tr.submitted_at
+                m.lat_ema = lat if m.lat_ema is None else 0.7 * m.lat_ema + 0.3 * lat
+
+    def _on_member_crash(self, m: _Member, exc: BaseException) -> None:
+        """Stepper-thread crash path: salvage finished-but-unsettled
+        completions, reset the engine in place, fail outstanding work over
+        to survivors, quarantine the member until probes re-admit it."""
+        fin: list = []
+        try:
+            with m.lock:
+                fin = list(m.engine.finished)
+                m.engine.finished.clear()
+                m.engine.reset()
+        except Exception:
+            pass  # a wedged engine still fails over; reset retried on restart
+        self._settle(m, fin)
+        with self._lock:
+            self.crashes += 1
+            self._c_crashes.inc()
+            self._tracer.instant(
+                "fleet_engine_crash", {"engine": m.idx, "error": repr(exc)})
+            if m.state == HEALTHY:
+                self._quarantine_locked(m, reason="crash")
+            else:
+                # crashed while already quarantined: push the gate out again
+                m.readmit_at = time.monotonic() + self._readmit_backoff(m)
+            self._failover_locked(m, clear_assignments=True)
+
+    def _on_member_giveup(self, m: _Member, exc: BaseException) -> None:
+        """Restart budget exhausted: the member is beyond saving. Mark it
+        DEAD (permanent), fail its work over; if it was the LAST live
+        member, shed everything still queued — an explicit retry_after
+        beats a queue that waits forever."""
+        with self._lock:
+            m.state = DEAD
+            self._tracer.instant("fleet_engine_dead", {"engine": m.idx})
+            self._failover_locked(m, clear_assignments=True)
+            if all(mm.state == DEAD for mm in self._members):
+                for lane, q in self._lanes.items():
+                    while q:
+                        frid = q.popleft()
+                        tr = self._tracked[frid]
+                        if tr.state != _QUEUED:
+                            continue
+                        self._shed_tracked_locked(tr, "all_members_dead")
+
+    def _on_control_giveup(self, exc: BaseException) -> None:
+        import traceback as _tb
+
+        with self._lock:
+            self._error = "".join(
+                _tb.format_exception(type(exc), exc, exc.__traceback__, limit=5))
+
+    def _shed_tracked_locked(self, tr: _Tracked, reason: str) -> None:
+        tr.state = _SHED
+        tr.done_at = time.monotonic()
+        tr.result = ShedRequest(tr.frid, self.retry_after_s, reason)
+        self._ready[tr.frid] = tr.result
+        self._count_shed_locked(reason)
+
+    # -- failover --------------------------------------------------------------
+
+    def _failover_locked(self, m: _Member, clear_assignments: bool) -> None:
+        """Re-queue (front of lane) every request currently attributed to
+        ``m``. ``clear_assignments`` distinguishes a crash-reset (the
+        engine will NEVER finish those rids — drop the map) from a
+        quarantine of a possibly-alive member (keep the map so a late
+        completion is recognized and deduped instead of orphaned)."""
+        moved = 0
+        for erid, frid in list(m.assigned.items()):
+            tr = self._tracked.get(frid)
+            if tr is None or tr.state != _DISPATCHED or tr.member != m.idx:
+                continue
+            if tr.dispatches >= self.max_dispatches:
+                self._shed_tracked_locked(tr, "dispatch_budget")
+                continue
+            tr.state, tr.member, tr.erid = _QUEUED, -1, -1
+            self._lanes[tr.lane].appendleft(frid)  # failover beats new work
+            moved += 1
+        if clear_assignments:
+            m.assigned.clear()
+        if moved:
+            self.redispatched += moved
+            self._c_redispatched.inc(moved)
+            self._tracer.instant(
+                "fleet_failover", {"engine": m.idx, "redispatched": moved})
+
+    # -- dispatcher ------------------------------------------------------------
+
+    def _dispatch_loop(self) -> None:
+        while not self._stop.is_set():
+            fault_point("fleet.dispatch_delay")
+            if not self._dispatch_once():
+                self._stop.wait(self.idle_sleep_s)
+
+    def _dispatch_once(self) -> bool:
+        """Move ONE request from the lanes onto an engine: interactive
+        strictly before batch. Two-phase so the (possibly slow) engine
+        submit never runs under the fleet lock."""
+        with self._lock:
+            pick = None
+            for lane in self.LANES:
+                q = self._lanes[lane]
+                while q:
+                    tr = self._tracked[q[0]]
+                    if tr.state != _QUEUED:  # settled late or shed: stale entry
+                        q.popleft()
+                        continue
+                    m = self._select_member_locked(tr)
+                    if m is None:
+                        break  # no capacity for this lane's head right now
+                    q.popleft()
+                    tr.state = _DISPATCHING
+                    tr.dispatches += 1
+                    pick = (tr, m)
+                    break
+                if pick is not None:
+                    break
+            if pick is None:
+                return False
+        tr, m = pick
+        try:
+            with m.lock:
+                erid = m.engine.submit(tr.prompt, tr.max_new_tokens)
+        except Exception:
+            # pre-validated at submit(), so this is an engine in a bad
+            # place — shed explicitly rather than wedge the dispatcher
+            with self._lock:
+                self._shed_tracked_locked(tr, "dispatch_error")
+            return True
+        with self._lock:
+            m.assigned[erid] = tr.frid
+            if tr.state == _DISPATCHING:
+                tr.state, tr.member, tr.erid = _DISPATCHED, m.idx, erid
+                if m.state != HEALTHY:
+                    # the member sickened between the two phases; requeue —
+                    # the assignment stays so a late completion still dedups
+                    tr.state, tr.member, tr.erid = _QUEUED, -1, -1
+                    self._lanes[tr.lane].appendleft(tr.frid)
+            # else: a late duplicate completion settled it mid-submit;
+            # the new assignment stays and will be suppressed on arrival
+        return True
+
+    def _select_member_locked(self, tr: _Tracked):
+        cands = [
+            m for m in self._members
+            if m.state == HEALTHY
+            and m.engine.pending() < self.max_pending_per_engine
+        ]
+        if not cands:
+            return None
+        if tr.lane == "batch":
+            # the LoadBalancer strategy chain over the healthy members
+            self._lb.engines = [m.engine for m in cands]
+            try:
+                return cands[self._lb.select_engine(tr.prompt)]
+            except ServiceSaturated:
+                return None
+        # interactive: tail-latency-aware — expected wait is queue depth
+        # times this member's recent per-request latency, plus KV pressure
+        fallback = max((m.lat_ema for m in cands if m.lat_ema is not None),
+                       default=1.0)
+
+        def score(m: _Member) -> float:
+            lat = m.lat_ema if m.lat_ema is not None else fallback
+            return ((m.engine.pending() + 1) * lat
+                    + self._lb._kv_utilization(m.engine))
+
+        return min(cands, key=score)
+
+    # -- health monitor --------------------------------------------------------
+
+    def _monitor_loop(self) -> None:
+        while not self._stop.wait(self.probe_interval_s):
+            self._watchdog.check()
+            for m in self._members:
+                with self._lock:
+                    state = m.state
+                if state == DEAD:
+                    continue
+                ok = self._probe(m)
+                self._on_probe(m, ok)
+
+    def _probe(self, m: _Member) -> bool:
+        """One liveness probe: supervised thread alive, watchdog beat
+        fresh, and the probe itself not chaos-dropped. Runs OUTSIDE the
+        fleet lock (the drop site may sleep under a delay fault)."""
+        alive = m.child.is_alive() if m.child is not None else True
+        fresh = m.name in self._watchdog.alive
+        dropped = should_drop("fleet.probe_drop")
+        return alive and fresh and not dropped
+
+    def _on_probe(self, m: _Member, ok: bool) -> None:
+        now = time.monotonic()
+        with self._lock:
+            if ok:
+                m.probe_failures = 0
+                m.probe_successes += 1
+                if (m.state == QUARANTINED
+                        and now >= m.readmit_at
+                        and m.probe_successes >= self.readmit_probes):
+                    m.state = HEALTHY
+                    self.readmissions += 1
+                    self._c_readmissions.inc()
+                    self._g_health.set(0.0, {"engine": str(m.idx)})
+                    self._tracer.instant("fleet_readmit", {"engine": m.idx})
+            else:
+                m.probe_successes = 0
+                m.probe_failures += 1
+                if (m.state == HEALTHY
+                        and m.probe_failures >= self.quarantine_after):
+                    self._quarantine_locked(m, reason="probe")
+                    # the member may well still be alive (false positive):
+                    # keep its assignments so late completions dedup
+                    self._failover_locked(m, clear_assignments=False)
+
+    def _readmit_backoff(self, m: _Member) -> float:
+        return min(self.readmit_backoff_s * (2.0 ** max(m.quarantines - 1, 0)),
+                   self.readmit_backoff_max_s)
+
+    def _quarantine_locked(self, m: _Member, reason: str) -> None:
+        m.state = QUARANTINED
+        m.quarantines += 1
+        m.probe_successes = 0
+        m.readmit_at = time.monotonic() + self._readmit_backoff(m)
+        self.quarantines_total += 1
+        self._c_quarantines.inc()
+        self._g_health.set(1.0, {"engine": str(m.idx)})
+        self._tracer.instant("fleet_quarantine", {"engine": m.idx, "reason": reason})

@@ -58,6 +58,7 @@ __all__ = [
     "LoadBalancer",
     "Request",
     "FinishedRequest",
+    "ServiceSaturated",
     "ServingService",
     "RemoteEngine",
 ]
@@ -229,6 +230,9 @@ class ContinuousBatchingEngine:
         self.queue: list[Request] = []
         self.finished: list[FinishedRequest] = []
         self._next_rid = 0
+        # fleet hook: called with each admitted rid right after its prefill
+        # sampled the first token (TTFT instrumentation without polling)
+        self.on_admit: Any = None
         # instrumentation for throughput + host-sync accounting
         self.decode_steps = 0
         self.prefill_token_slots = 0
@@ -553,6 +557,9 @@ class ContinuousBatchingEngine:
             else:
                 surv[s] = True
                 new_lens[s], new_budget[s], new_last[s] = P, b, t0
+        if self.on_admit is not None:
+            for _s, req in batch:
+                self.on_admit(req.rid)
         if surv.any():
             (
                 self.dev_lens,
@@ -788,6 +795,37 @@ class ContinuousBatchingEngine:
         self.finished.clear()
         return out
 
+    def reset(self) -> None:
+        """Return the engine to an empty state IN PLACE: every slot freed,
+        every block back in the pool, queue/finished/in-flight dropped.
+
+        Compiled programs, the KV pools themselves (stale contents are
+        unreachable once every table row is cleared and every len is 0),
+        the RNG stream, and the monotone counters (``_next_rid``,
+        completions, token totals) all survive — this is how the fleet
+        recycles a crashed replica without paying recompilation, and why a
+        request id never collides across a crash."""
+        n = self.n_slots
+        self.free_blocks = list(range(1, self._n_pool_blocks + 1))
+        self.table[:] = -1
+        self.lens[:] = 0
+        self.slot_rid[:] = -1
+        self.slot_budget[:] = 0
+        self.sched_lens[:] = 0
+        self.sched_budget[:] = 0
+        self.slot_tokens = [[] for _ in range(n)]
+        self.slot_lps = [[] for _ in range(n)]
+        self.slot_prompt.clear()
+        self.dev_table = jnp.full_like(self.dev_table, -1)
+        self.dev_lens = jnp.zeros_like(self.dev_lens)
+        self.dev_active = jnp.zeros_like(self.dev_active)
+        self.dev_budget = jnp.zeros_like(self.dev_budget)
+        self.dev_last = jnp.zeros_like(self.dev_last)
+        self._pending_table_writes.clear()
+        self._inflight.clear()
+        self.queue.clear()
+        self.finished.clear()
+
 
 def _admit_update_fn(lens, active, budget, last, mask, new_lens, new_budget, new_last):
     """Masked full-width merge of freshly-prefilled slots into the device
@@ -820,6 +858,15 @@ class LoadBalancer:
 
     ``submit`` forwards to the chosen replica and returns
     ``(replica_index, rid)``; ``run_all`` drains every replica.
+
+    Membership may change at runtime (the fleet swaps ``engines`` as
+    replicas sicken and recover). Losing the LAST engine is a degraded
+    service, not a programming error: ``select_engine``/``submit`` on an
+    empty replica set raise :class:`ServiceSaturated` with
+    ``retry_after_s`` — an explicit shed the routing thread survives —
+    instead of the ``ValueError``/``ZeroDivisionError`` the old code hit.
+    Constructing with zero engines still raises unless ``allow_empty``
+    (an empty fleet at startup is usually a config bug).
     """
 
     STRATEGIES = ("prefix-aware", "requests", "kv-cache", "round-robin")
@@ -830,10 +877,13 @@ class LoadBalancer:
         strategy="prefix-aware",
         prefix_length: int = 8,
         overload_threshold: float = 1.5,
+        retry_after_s: float = 0.25,
+        allow_empty: bool = False,
     ):
         self.engines = list(engines)
-        if not self.engines:
+        if not self.engines and not allow_empty:
             raise ValueError("LoadBalancer needs at least one engine")
+        self.retry_after_s = retry_after_s
         strategies = [strategy] if isinstance(strategy, str) else list(strategy)
         for st in strategies:
             if st not in self.STRATEGIES:
@@ -860,6 +910,8 @@ class LoadBalancer:
     # -- selection -------------------------------------------------------------
 
     def select_engine(self, prompt=None) -> int:
+        if not self.engines:
+            raise ServiceSaturated(self.retry_after_s)
         loads = [self._pending(e) for e in self.engines]
         mean_load = sum(loads) / len(loads)
         for st in self.strategies:

@@ -24,6 +24,7 @@ _EXPORTS = {
     "FaultInjector": "faults",
     "InjectedFault": "faults",
     "fault_point": "faults",
+    "register_site": "faults",
     "should_drop": "faults",
     "poison_scalar": "faults",
     "get_injector": "faults",

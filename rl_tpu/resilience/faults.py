@@ -36,6 +36,18 @@ site                        where / supported kinds
                             — poisons the first update of the dispatch)
 ``trainer.preempt``         trainer step boundary (``preempt`` — raises the
                             target PreemptionHandler's flag)
+``fleet.engine_crash``      ServingFleet member stepper, per BUSY iteration —
+                            an idle replica cannot crash mid-decode
+                            (``crash``, ``delay``); the fleet also registers
+                            a ``fleet.engine_crash.<idx>`` site per member
+                            via :func:`register_site` so a plan can kill a
+                            SPECIFIC replica deterministically (per-site
+                            invocation counters are shared across threads,
+                            so the generic site alone cannot)
+``fleet.probe_drop``        ServingFleet health monitor, one visit per member
+                            per sweep in member order (``drop`` = that probe
+                            reads as a failure)
+``fleet.dispatch_delay``    ServingFleet dispatcher iteration (``delay``)
 ==========================  =================================================
 """
 
@@ -54,6 +66,7 @@ __all__ = [
     "FaultInjector",
     "InjectedFault",
     "fault_point",
+    "register_site",
     "should_drop",
     "poison_scalar",
     "get_injector",
@@ -69,9 +82,20 @@ SITES: dict[str, str] = {
     "grpo.update": "GRPOTrainer update dispatch (NaN poison)",
     "offpolicy.update": "AsyncOffPolicyTrainer K-update dispatch (NaN poison)",
     "trainer.preempt": "trainer step boundary (synthetic preemption)",
+    "fleet.engine_crash": "ServingFleet member stepper, per busy iteration",
+    "fleet.probe_drop": "ServingFleet health-monitor probe (drop = failure)",
+    "fleet.dispatch_delay": "ServingFleet dispatcher iteration",
 }
 
 KINDS = ("crash", "delay", "drop", "nan", "preempt")
+
+
+def register_site(name: str, description: str) -> None:
+    """Register a dynamically-named site (e.g. the fleet's per-member
+    ``fleet.engine_crash.<idx>``) so strict plan validation accepts it.
+    Idempotent — re-registering an existing name keeps the first
+    description, so repeated construction of the owning object is safe."""
+    SITES.setdefault(name, description)
 
 
 class InjectedFault(RuntimeError):

@@ -1,0 +1,322 @@
+"""ServingFleet: health-checked membership, failover re-dispatch, and
+SLO-aware admission (ISSUE 6).
+
+Strategy: every chaos path is driven through the seeded FaultInjector
+sites (``fleet.engine_crash[.<idx>]``, ``fleet.probe_drop``), never
+ad-hoc thread kills, so each scenario replays deterministically. The
+invariant asserted everywhere: an ADMITTED request completes exactly
+once or is shed with an explicit ``retry_after`` — ``accounting()['lost']``
+is zero at every checkpoint."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from rl_tpu.models import (
+    ContinuousBatchingEngine,
+    FinishedRequest,
+    ServiceSaturated,
+    ServingFleet,
+    ShedRequest,
+    TransformerConfig,
+    TransformerLM,
+)
+from rl_tpu.models.fleet import DEAD, HEALTHY, QUARANTINED
+from rl_tpu.obs import MetricsRegistry
+from rl_tpu.resilience import SITES, Fault, FaultInjector, injection
+
+KEY = jax.random.key(0)
+
+
+def small_model():
+    cfg = TransformerConfig(
+        vocab_size=97, d_model=64, n_layers=2, n_heads=4, d_ff=128,
+        max_seq_len=128, dtype=jnp.float32,
+    )
+    m = TransformerLM(cfg)
+    params = m.init(KEY, jnp.zeros((1, 8), jnp.int32))["params"]
+    return m, params
+
+
+_MODEL = small_model()  # one compile cache for the whole module
+
+
+def _engines(n=2, n_slots=2, warm=True):
+    m, params = _MODEL
+    engines = [
+        ContinuousBatchingEngine(
+            m, params, n_slots=n_slots, block_size=8, n_blocks=65,
+            prompt_buckets=(16,), greedy=True, seed=i,
+        )
+        for i in range(n)
+    ]
+    if warm:  # compile outside the fleet so a slow first step cannot
+        for e in engines:  # trip the liveness probes
+            e.submit(np.arange(8), 4)
+            e.run()
+    return engines
+
+
+def _fleet(engines, **kw):
+    kw.setdefault("registry", MetricsRegistry())
+    kw.setdefault("probe_interval_s", 0.01)
+    return ServingFleet(engines, **kw)
+
+
+def _wait_until(pred, timeout=30.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while not pred():
+        if time.monotonic() > deadline:
+            raise AssertionError(f"timed out waiting for {msg}")
+        time.sleep(0.005)
+
+
+class TestFleetBasics:
+    def test_no_chaos_every_lane_completes(self):
+        fleet = _fleet(_engines(2)).start()
+        try:
+            rng = np.random.default_rng(0)
+            frids = [
+                fleet.submit(rng.integers(0, 97, 8), 6,
+                             lane="interactive" if i % 3 else "batch")
+                for i in range(8)
+            ]
+            got = fleet.wait(frids, timeout=60)
+            assert sorted(got) == sorted(frids)
+            assert all(isinstance(r, FinishedRequest) for r in got.values())
+            acc = fleet.accounting()
+            assert acc == {
+                "admitted": 8, "completed": 8, "shed_admission": 0,
+                "shed_post_admission": 0, "outstanding": 0,
+                "redispatched": 0, "duplicates_suppressed": 0, "lost": 0,
+            }
+            # TTFT source: every request got an admission timestamp
+            stats = fleet.request_stats()
+            assert all(s["first_token_at"] is not None for s in stats)
+            assert all(s["first_token_at"] >= s["submitted_at"] for s in stats)
+        finally:
+            fleet.shutdown()
+
+    def test_fleet_matches_single_engine_output(self):
+        # same prompt, greedy, same params -> the fleet's answer is the
+        # engine's answer regardless of which replica served it
+        m, params = _MODEL
+        ref_eng = ContinuousBatchingEngine(
+            m, params, n_slots=2, block_size=8, n_blocks=65,
+            prompt_buckets=(16,), greedy=True,
+        )
+        prompt = np.arange(3, 11)
+        rid = ref_eng.submit(prompt, 8)
+        ref = ref_eng.run()[rid]
+        fleet = _fleet(_engines(2)).start()
+        try:
+            frid = fleet.submit(prompt, 8)
+            got = fleet.wait([frid], timeout=60)[frid]
+            np.testing.assert_array_equal(got.tokens, ref.tokens)
+        finally:
+            fleet.shutdown()
+
+    def test_submit_validation_fails_caller_not_dispatcher(self):
+        fleet = _fleet(_engines(1, warm=False))  # never started: pure checks
+        with pytest.raises(ValueError, match="lane"):
+            fleet.submit(np.arange(4), 4, lane="bulk")
+        with pytest.raises(ValueError, match="max_seq_len"):
+            fleet.submit(np.arange(8), 1000)
+        with pytest.raises(ValueError, match="bucket"):
+            fleet.submit(np.arange(40), 4)
+        assert fleet.accounting()["admitted"] == 0
+        fleet.shutdown()
+
+    def test_sites_registered(self):
+        for site in ("fleet.engine_crash", "fleet.probe_drop",
+                     "fleet.dispatch_delay"):
+            assert site in SITES
+        _fleet(_engines(2, warm=False)).shutdown()
+        assert "fleet.engine_crash.0" in SITES
+        assert "fleet.engine_crash.1" in SITES
+
+
+class TestAdmissionControl:
+    def test_kv_watermark_sheds_with_retry_after(self):
+        # watermark above 1.0: even an idle fleet is "below watermark",
+        # so the FIRST submit must shed with the explicit retry hint
+        fleet = _fleet(_engines(1, warm=False), admission_watermark=2.0,
+                       retry_after_s=0.125)
+        with pytest.raises(ServiceSaturated) as ei:
+            fleet.submit(np.arange(4), 4)
+        assert ei.value.retry_after == 0.125
+        acc = fleet.accounting()
+        assert acc["admitted"] == 0 and acc["shed_admission"] == 1
+        assert fleet.metrics_snapshot()["shed"] == {"kv_watermark": 1}
+        fleet.shutdown()
+
+    def test_max_queue_sheds_with_retry_after(self):
+        fleet = _fleet(_engines(1, warm=False), max_queue=1)  # not started:
+        fleet.submit(np.arange(4), 4)  # stays queued, holding the cap
+        with pytest.raises(ServiceSaturated) as ei:
+            fleet.submit(np.arange(4), 4)
+        assert ei.value.retry_after == fleet.retry_after_s
+        assert fleet.metrics_snapshot()["shed"] == {"queue_full": 1}
+        assert fleet.accounting()["lost"] == 0
+        fleet.shutdown()
+
+    def test_interactive_lane_dispatches_before_batch(self):
+        fleet = _fleet(_engines(1, warm=False))  # not started: manual pump
+        b = fleet.submit(np.arange(4), 4, lane="batch")
+        i = fleet.submit(np.arange(4), 4, lane="interactive")
+        assert fleet._dispatch_once()  # the LATER interactive submit wins
+        assert fleet._tracked[i].state == "dispatched"
+        assert fleet._tracked[b].state == "queued"
+        assert fleet._dispatch_once()
+        assert fleet._tracked[b].state == "dispatched"
+        fleet.shutdown()
+
+
+class TestFailover:
+    def test_crash_mid_decode_exactly_once(self):
+        """Satellite 3: kill a SPECIFIC engine mid-decode via its per-member
+        site; every re-dispatched request completes exactly once — no
+        drops, no duplicated completions."""
+        engines = _engines(2)
+        fleet = _fleet(engines).start()
+        try:
+            rng = np.random.default_rng(1)
+            frids = [fleet.submit(rng.integers(0, 97, 8), 24)
+                     for _ in range(6)]
+            _wait_until(lambda: engines[0].pending() > 0, msg="engine 0 busy")
+            inj = FaultInjector(
+                {"fleet.engine_crash.0": Fault("crash", at=(1,))},
+                registry=MetricsRegistry(),
+            )
+            with injection(inj):
+                got = fleet.wait(frids, timeout=90)
+            assert [(s, k) for s, k, _ in inj.fired] == [
+                ("fleet.engine_crash.0", "crash")
+            ]
+            # exactly once: every admitted frid has ONE FinishedRequest
+            assert sorted(got) == sorted(frids)
+            assert all(isinstance(r, FinishedRequest) for r in got.values())
+            acc = fleet.accounting()
+            assert acc["completed"] == len(frids)
+            assert acc["lost"] == 0
+            assert acc["redispatched"] >= 1  # engine 0 WAS mid-decode
+            # crash-reset clears assignments, so no duplicate can complete
+            assert acc["duplicates_suppressed"] == 0
+            snap = fleet.metrics_snapshot()
+            assert snap["crashes"] == 1
+            m0 = snap["members"][0]
+            assert m0["restarts"] == 1 and m0["quarantines"] == 1
+        finally:
+            fleet.shutdown()
+
+    def test_quarantine_readmission_and_duplicate_suppression(self):
+        """Satellite 3 (second half): a probe false-positive quarantines a
+        STILL-ALIVE member; its in-flight work is re-dispatched, the
+        original copy later completes and is suppressed by frid dedup, and
+        the member is re-admitted after consecutive healthy probes."""
+        engines = _engines(2)
+        fleet = _fleet(engines, quarantine_after=1, readmit_probes=2,
+                       readmit_backoff_s=0.01).start()
+        try:
+            rng = np.random.default_rng(2)
+            # long decodes so both members are mid-request at the probe drop
+            frids = [fleet.submit(rng.integers(0, 97, 8), 100)
+                     for _ in range(2)]
+            _wait_until(
+                lambda: engines[0].pending() > 0 and engines[1].pending() > 0,
+                msg="both members busy",
+            )
+            inj = FaultInjector({"fleet.probe_drop": Fault("drop", at=(1,))},
+                                registry=MetricsRegistry())
+            with injection(inj):
+                # exactly one probe dropped -> whichever member it hit is
+                # quarantined while alive and mid-decode
+                _wait_until(
+                    lambda: fleet.metrics_snapshot()["quarantines"] == 1,
+                    msg="quarantine",
+                )
+            got = fleet.wait(frids, timeout=90)
+            assert sorted(got) == sorted(frids)
+            assert all(isinstance(r, FinishedRequest) for r in got.values())
+            acc = fleet.accounting()
+            assert acc["completed"] == 2 and acc["lost"] == 0
+            assert acc["redispatched"] >= 1
+            # quarantine keeps the rid map, so the alive member's copy
+            # lands as a DUPLICATE, not a double count
+            _wait_until(
+                lambda: fleet.accounting()["duplicates_suppressed"] >= 1,
+                msg="late duplicate suppressed",
+            )
+            assert fleet.accounting()["completed"] == 2  # still exactly once
+            _wait_until(
+                lambda: fleet.metrics_snapshot()["readmissions"] == 1,
+                msg="re-admission",
+            )
+            assert all(m["state"] == HEALTHY
+                       for m in fleet.metrics_snapshot()["members"])
+            # the re-admitted member serves new traffic again
+            frid = fleet.submit(rng.integers(0, 97, 8), 4)
+            assert isinstance(fleet.wait([frid], timeout=60)[frid],
+                              FinishedRequest)
+            assert fleet.accounting()["lost"] == 0
+        finally:
+            fleet.shutdown()
+
+    def test_all_members_dead_sheds_queue(self):
+        """Restart budgets exhausted on every member: queued work is shed
+        with retry_after (explicit), submit sheds, nothing is lost."""
+        from rl_tpu.resilience import Supervisor
+
+        engines = _engines(1)
+        sup = Supervisor(name="t", max_restarts=1, backoff_base_s=0.001,
+                         backoff_max_s=0.002, registry=MetricsRegistry())
+        fleet = _fleet(engines, supervisor=sup, max_pending_per_engine=1)
+        fleet.start()
+        try:
+            rng = np.random.default_rng(3)
+            # capacity gate (max_pending_per_engine=1) keeps the extras
+            # QUEUED, so the giveup has a queue to shed
+            frids = [fleet.submit(rng.integers(0, 97, 8), 30)
+                     for _ in range(3)]
+            _wait_until(lambda: engines[0].pending() > 0, msg="busy")
+            inj = FaultInjector(
+                {"fleet.engine_crash.0": Fault("crash", at=(1, 2))},
+                registry=MetricsRegistry(),
+            )
+            with injection(inj):  # crash, restart, crash -> budget gone
+                got = fleet.wait(frids, timeout=90)
+            assert sorted(got) == sorted(frids)
+            sheds = [r for r in got.values() if isinstance(r, ShedRequest)]
+            assert sheds and all(s.retry_after == fleet.retry_after_s
+                                 for s in sheds)
+            acc = fleet.accounting()
+            assert acc["completed"] + acc["shed_post_admission"] == 3
+            assert acc["lost"] == 0
+            assert fleet.metrics_snapshot()["members"][0]["state"] == DEAD
+            with pytest.raises(ServiceSaturated):
+                fleet.submit(rng.integers(0, 97, 8), 4)
+        finally:
+            fleet.shutdown()
+            sup.stop()
+
+
+class TestFleetObservability:
+    def test_gauges_exported_through_registry(self):
+        reg = MetricsRegistry()
+        fleet = _fleet(_engines(2, warm=False), registry=reg)
+        fleet.submit(np.arange(4), 4, lane="batch")  # not started: queued
+        text = reg.render()
+        assert 'rl_tpu_fleet_engine_health{engine="0"} 0' in text
+        assert 'rl_tpu_fleet_engine_health{engine="1"} 0' in text
+        assert 'rl_tpu_fleet_lane_queue_depth{lane="batch"} 1' in text
+        assert 'rl_tpu_fleet_lane_queue_depth{lane="interactive"} 0' in text
+        assert "rl_tpu_fleet_free_kv_blocks" in text
+        assert "rl_tpu_fleet_kv_blocks_total" in text
+        assert "rl_tpu_fleet_outstanding 1" in text
+        assert "rl_tpu_fleet_admitted_total 1" in text
+        fleet.shutdown()
+        # collector unregistered on shutdown: render must not blow up
+        reg.render()

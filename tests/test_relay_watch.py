@@ -188,6 +188,47 @@ def test_chaos_subresult_distilled(tmp_path):
     assert runner.commits[0][0] == [art, mart]
 
 
+def test_fleet_subresult_distilled(tmp_path):
+    """ISSUE-6: the fleet chaos-traffic sub-bench (p50/p99 TTFT across the
+    injected crash, tokens/s, shed/re-dispatched/lost accounting) rides
+    the committed METRICS json through the same generic "metrics"-section
+    distillation as every other sub-bench."""
+
+    class FleetRunner(FakeRunner):
+        def bench_all(self, timeout):
+            self.bench_calls.append(timeout)
+            lines = [
+                {"metric": "ppo", "value": 123.0},
+                {"fleet": {"value": 215.1,
+                           "metrics": {"fleet_tokens_per_sec": 215.1,
+                                       "p50_ttft_pre_s": 0.0086,
+                                       "p99_ttft_pre_s": 0.4538,
+                                       "p50_ttft_post_s": 0.0087,
+                                       "p99_ttft_post_s": 0.0211,
+                                       "admitted": 125, "completed": 125,
+                                       "shed": 0, "redispatched": 1,
+                                       "duplicates_suppressed": 0,
+                                       "lost": 0, "invariant_ok": True,
+                                       "crashes": 1, "quarantines": 1,
+                                       "readmissions": 1}}},
+            ]
+            return 0, "".join(json.dumps(ln) + "\n" for ln in lines)
+
+    runner = FleetRunner([_healthy()])
+    art = str(tmp_path / "bench.jsonl")
+    mart = str(tmp_path / "METRICS.json")
+    watch(runner, lambda s: None, max_probes=1, artifact=art,
+          metrics_artifact=mart, sleep=lambda s: None)
+    doc = json.loads(open(mart).read())
+    fleet = doc["bench_metrics"]["fleet"]
+    assert fleet["fleet_tokens_per_sec"] == 215.1
+    assert fleet["p99_ttft_post_s"] == 0.0211
+    assert fleet["lost"] == 0
+    assert fleet["invariant_ok"] is True
+    assert fleet["redispatched"] == 1
+    assert runner.commits[0][0] == [art, mart]
+
+
 def test_no_metrics_sections_no_metrics_file(tmp_path):
     """A bench stream without metrics sections (old format) must not grow a
     stale METRICS file or change the commit set."""

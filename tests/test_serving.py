@@ -446,6 +446,24 @@ class TestLoadBalancer:
         with pytest.raises(ValueError, match="unknown strategy"):
             LoadBalancer(self._engines(1), "magic")
 
+    def test_losing_last_engine_sheds_not_crashes(self):
+        """ISSUE-6 satellite: runtime loss of the LAST engine surfaces
+        ServiceSaturated/retry_after — a graceful shed the routing thread
+        survives — not the constructor's ValueError (or a
+        ZeroDivisionError from the mean-load math)."""
+        from rl_tpu.models import LoadBalancer, ServiceSaturated
+
+        lb = LoadBalancer(self._engines(1), "requests", retry_after_s=0.5)
+        assert lb.select_engine() == 0
+        lb.engines.clear()  # the fleet removed the last sick replica
+        with pytest.raises(ServiceSaturated) as ei:
+            lb.select_engine()
+        assert ei.value.retry_after == 0.5
+        with pytest.raises(ServiceSaturated):
+            lb.submit(np.arange(4), 2)
+        # an empty set is constructible when asked for (fleet startup)
+        assert LoadBalancer([], allow_empty=True).engines == []
+
 
 class TestChunkedDecode:
     def test_chunked_equals_single_step_greedy(self):

@@ -51,7 +51,10 @@ def _extract_metrics(stdout: str) -> dict:
     The rlhf sub-bench's ``pipeline`` sub-result (overlapped-cycle
     throughput, overlap_frac, staleness bound) is distilled the same way —
     it lands under the sub-bench's key as a ``pipeline`` entry, like the
-    PER/async_collect timing splits."""
+    PER/async_collect timing splits. The fleet sub-bench (ISSUE-6 chaos
+    traffic: pre/post-crash p50/p99 TTFT, tokens/s, shed/re-dispatched/
+    lost accounting and its ``invariant_ok``) needs no special-casing —
+    its ``metrics`` section rides through here like every other mode's."""
 
     def _section(v: dict) -> dict:
         sec: dict = {}
