@@ -2019,6 +2019,270 @@ def bench_fleet(report: bool = True) -> dict:
     return out
 
 
+def _force_host_devices_flags(n: int) -> str:
+    """XLA_FLAGS with the host-platform device count forced to ``n`` (any
+    pre-existing force dropped). Only affects the cpu backend — on real
+    chips the flag is inert and the worker uses the hardware topology."""
+    base = os.environ.get("XLA_FLAGS", "")
+    parts = [p for p in base.split() if "xla_force_host_platform_device_count" not in p]
+    parts.append(f"--xla_force_host_platform_device_count={n}")
+    return " ".join(parts)
+
+
+def _multichip_worker(report: bool = True) -> dict:
+    """One topology point of BENCH_MODE=multichip: MULTICHIP_DEVICES names
+    the device count; the process builds the ``(batch, fsdp)`` mesh, times
+    the donated gradient-accumulation GRPO update under (a) fully
+    replicated params (the pre-sharding baseline) and (b) per-leaf FSDP
+    placements with explicit in/out shardings, plus a sharded-params
+    KV-cache rollout, and reports train MFU + tokens/s for each."""
+    jax = _setup_jax()
+    import jax.numpy as jnp
+    import optax
+
+    from rl_tpu.models import TransformerConfig, TransformerLM, generate, token_log_probs
+    from rl_tpu.models.generate import generate_flops, train_step_flops
+    from rl_tpu.objectives.llm.grpo import GRPOLoss, mc_advantage
+    from rl_tpu.parallel import data_sharding, fsdp_sharding, make_fsdp_mesh, replicated
+
+    n = int(os.environ["MULTICHIP_DEVICES"])
+    avail = len(jax.devices())
+    if avail < n:
+        out = {"metric": "multichip_worker", "n_devices": n, "value": 0.0,
+               "error": f"only {avail} devices available (wanted {n})"}
+        out.update(_platform_tag(jax))
+        if report:
+            print(json.dumps(out), flush=True)
+        return out
+    batch_ax, fsdp_ax = {1: (1, 1), 2: (1, 2), 4: (2, 2), 8: (2, 4)}.get(n, (1, n))
+    mesh = make_fsdp_mesh(fsdp=fsdp_ax, batch=batch_ax)
+
+    if _TIER == "smoke":
+        B, Tp, Tn = 8, 16, 16
+        cfg = TransformerConfig(vocab_size=256, d_model=64, n_layers=2, n_heads=2,
+                                d_ff=256, max_seq_len=Tp + Tn, dtype=jnp.float32)
+    elif _TIER == "cpu":
+        B, Tp, Tn = 16, 32, 32
+        cfg = TransformerConfig(vocab_size=512, d_model=128, n_layers=4, n_heads=4,
+                                d_ff=512, max_seq_len=Tp + Tn, dtype=jnp.float32)
+    else:
+        B, Tp, Tn = 32, 128, 128
+        cfg = TransformerConfig(vocab_size=8192, d_model=512, n_layers=8, n_heads=8,
+                                d_ff=2048, max_seq_len=Tp + Tn, dtype=jnp.bfloat16)
+    T = Tp + Tn
+    model = TransformerLM(cfg)
+    key = jax.random.key(0)
+    params = model.init(key, jnp.zeros((1, 8), jnp.int32))["params"]
+    n_params = sum(x.size for x in jax.tree.leaves(params))
+    opt = optax.adam(3e-5)
+    loss = GRPOLoss(
+        lambda p, b: token_log_probs(model, p, b["tokens"]), clip_epsilon=0.2
+    )
+    mbs = max(1, B // 2)
+    n_mb = B // mbs
+
+    def _update_impl(params, opt_state, tokens, slp, amask, adv):
+        full = dict(tokens=tokens, sample_log_prob=slp,
+                    assistant_mask=amask, advantage=adv)
+        xs = jax.tree.map(lambda x: x.reshape((n_mb, mbs) + x.shape[1:]), full)
+
+        def body(carry, mb):
+            gsum, vsum, wsum = carry
+            w = loss.microbatch_weight(mb)
+            (v, _), g = jax.value_and_grad(
+                lambda p: loss(p, mb), has_aux=True
+            )(params)
+            gsum = jax.tree.map(lambda a, b: a + w * b, gsum, g)
+            return (gsum, vsum + w * v, wsum + w), None
+
+        zero = jnp.zeros((), jnp.float32)
+        (gsum, vsum, wsum), _ = jax.lax.scan(
+            body, (jax.tree.map(jnp.zeros_like, params), zero, zero), xs
+        )
+        wsum = jnp.maximum(wsum, 1e-8)
+        g = jax.tree.map(lambda a: a / wsum, gsum)
+        upd, opt_state = opt.update(g, opt_state)
+        return optax.apply_updates(params, upd), opt_state, vsum / wsum
+
+    # fixed rollout-shaped inputs (one batch reused across reps: this bench
+    # times the UPDATE dispatch, not collection)
+    k1, k2 = jax.random.split(key)
+    tokens = jax.random.randint(k1, (B, T), 0, cfg.vocab_size)
+    slp = -jnp.abs(jax.random.normal(k2, (B, T))) * 0.1
+    amask = jnp.concatenate(
+        [jnp.zeros((B, Tp), bool), jnp.ones((B, Tn), bool)], axis=1
+    )
+    reward = jax.random.normal(k2, (B,))
+    adv = mc_advantage(reward, jnp.arange(B) // 4, max(1, (B + 3) // 4))
+    reps = 2 if _TIER == "smoke" else 3
+    train_flops = train_step_flops(cfg, n_params, B, T)
+    peak = _peak_flops(jax) * n
+
+    def _time_update(upd_fn, p0, o0):
+        p, o = p0, o0
+        tc0 = time.perf_counter()
+        p, o, v = upd_fn(p, o, tokens, slp, amask, adv)
+        jax.block_until_ready(v)
+        compile_s = time.perf_counter() - tc0
+        v0 = float(v)
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            p, o, v = upd_fn(p, o, tokens, slp, amask, adv)
+        jax.block_until_ready(v)
+        dt = (time.perf_counter() - t0) / reps
+        return {
+            "train_s": round(dt, 4),
+            "train_tokens_per_sec": round(B * T / dt, 1),
+            "train_mfu": round(train_flops / dt / peak, 6),
+            "compile_s": round(compile_s, 2),
+        }, v0
+
+    # (a) replicated baseline: the pre-sharding layout (every device holds
+    # a full replica; grads all-reduce)
+    repl = replicated(mesh)
+    p_r = jax.device_put(params, repl)
+    o_r = jax.device_put(opt.init(params), repl)
+    upd_r = jax.jit(_update_impl, donate_argnums=(1,))
+    res_r, v_r = _time_update(upd_r, p_r, o_r)
+
+    # (b) FSDP-sharded: per-leaf placements, batch split over every data
+    # axis, explicit in/out shardings on the donated dispatch
+    psh = fsdp_sharding(params, mesh, min_size_mbytes=0.0)
+    p_s = jax.tree.map(jax.device_put, params, psh)
+    opt_state = opt.init(p_s)
+    osh = fsdp_sharding(opt_state, mesh, min_size_mbytes=0.0)
+    o_s = jax.tree.map(jax.device_put, opt_state, osh)
+    bsh = data_sharding(mesh)
+    upd_s = jax.jit(
+        _update_impl,
+        donate_argnums=(1,),
+        in_shardings=(psh, osh, bsh, bsh, bsh, bsh),
+        out_shardings=(psh, osh, repl),
+    )
+    res_s, v_s = _time_update(
+        upd_s,
+        p_s,
+        o_s,
+    )
+    parity = abs(v_r - v_s)
+
+    # sharded-params rollout: GSPMD derives the generation collectives
+    # from the param placements alone
+    prompts = jax.random.randint(k1, (B, Tp), 0, cfg.vocab_size)
+    pmask = jnp.ones((B, Tp), jnp.float32)
+    rollout = jax.jit(
+        lambda p, k: generate(
+            model, p, prompts, pmask, k, max_new_tokens=Tn, eos_id=None
+        ).tokens
+    )
+    out_toks = rollout(p_s, jax.random.key(3))
+    jax.block_until_ready(out_toks)
+    gen_reps = max(1, reps - 1)
+    t0 = time.perf_counter()
+    for i in range(gen_reps):
+        out_toks = rollout(p_s, jax.random.key(4 + i))
+    jax.block_until_ready(out_toks)
+    t_gen = (time.perf_counter() - t0) / gen_reps
+    res_s["gen_tokens_per_sec"] = round(B * Tn / t_gen, 1)
+    res_s["gen_mfu"] = round(
+        generate_flops(cfg, n_params, B, Tp, Tn) / t_gen / peak, 6
+    )
+
+    out = {
+        "metric": "multichip_worker",
+        "value": res_s["train_tokens_per_sec"],
+        "unit": "tokens/s",
+        "n_devices": n,
+        "mesh": [batch_ax, fsdp_ax],
+        "replicated": res_r,
+        "sharded": res_s,
+        "loss_parity_absdiff": round(parity, 6),
+        "n_params": n_params,
+        "shape": [B, Tp, Tn],
+        "error": None,
+    }
+    out.update(_platform_tag(jax))
+    if report:
+        print(json.dumps(out), flush=True)
+    return out
+
+
+def bench_multichip(report: bool = True) -> dict:
+    """BENCH_MODE=multichip: scaling-efficiency sweep over device counts.
+
+    The default multichip tier forces the 8-device host topology
+    (``--xla_force_host_platform_device_count=8``) and runs one worker
+    subprocess per point (1, 4, 8 devices; the count must be pinned
+    before JAX initializes, so each point owns a process). Each worker
+    times the donated FSDP-sharded GRPO update against the replicated
+    baseline; this orchestrator (which never imports jax) distills train
+    MFU + tokens/s per point, scaling efficiency vs 1 device, and the
+    sharded-vs-replicated ratio at 1 device (the no-regression gate)."""
+    if os.environ.get("MULTICHIP_DEVICES"):
+        return _multichip_worker(report)
+    points = (1, 8) if _TIER == "smoke" else (1, 4, 8)
+    deadline = _START + _TIMEOUT - 20.0
+    results: dict = {}
+    for i, n in enumerate(points):
+        remaining = deadline - time.monotonic()
+        if remaining <= 10.0:
+            results[str(n)] = {"error": "skipped: BENCH_TIMEOUT budget exhausted"}
+            continue
+        extra = {
+            "MULTICHIP_DEVICES": str(n),
+            "XLA_FLAGS": _force_host_devices_flags(n),
+        }
+        if not os.environ.get("BENCH_PLATFORM") and _TIER != "full":
+            extra["BENCH_PLATFORM"] = "cpu"  # forced topology is a cpu-tier run
+        results[str(n)] = _run_sub_bench(
+            "multichip", remaining / (len(points) - i), extra
+        )
+
+    def _tps(n, layout="sharded"):
+        return (results.get(str(n), {}).get(layout) or {}).get("train_tokens_per_sec")
+
+    metrics: dict = {}
+    scaling: dict = {}
+    base = _tps(1)
+    for n in points:
+        r = results.get(str(n), {})
+        sh = r.get("sharded") or {}
+        if not sh:
+            continue
+        metrics[f"train_tokens_per_sec_{n}dev"] = sh.get("train_tokens_per_sec")
+        metrics[f"train_mfu_{n}dev"] = sh.get("train_mfu")
+        metrics[f"gen_tokens_per_sec_{n}dev"] = sh.get("gen_tokens_per_sec")
+        if base and sh.get("train_tokens_per_sec") is not None:
+            scaling[str(n)] = round(sh["train_tokens_per_sec"] / base / n, 3)
+    r1 = results.get("1", {})
+    ratio = None
+    if _tps(1) and _tps(1, "replicated"):
+        ratio = round(_tps(1) / _tps(1, "replicated"), 3)
+        metrics["sharded_vs_replicated_1dev"] = ratio
+    metrics["scaling_efficiency"] = scaling
+    top = max((n for n in points if _tps(n)), default=None)
+    errors = [f"{k}: {v['error']}" for k, v in results.items() if v.get("error")]
+    out = {
+        "metric": "multichip_train_tokens_per_sec",
+        "value": _tps(top) if top else 0.0,
+        "unit": "tokens/s",
+        "top_devices": top,
+        "devices": results,
+        "scaling_efficiency": scaling,
+        "sharded_vs_replicated_1dev": ratio,
+        # same-program-different-annotations at fsdp=1: anything beyond
+        # timer noise is a real regression in the sharded dispatch
+        "sharded_ok_1dev": (ratio is not None and ratio >= 0.9),
+        "metrics": metrics,
+        "platform": r1.get("platform"),
+        "shapes": _TIER,
+        "error": "; ".join(errors) or None,
+    }
+    if report:
+        print(json.dumps(out), flush=True)
+    return out
+
+
 def _parse_last_json(text: str) -> dict | None:
     for ln in reversed((text or "").strip().splitlines()):
         try:
@@ -2118,7 +2382,7 @@ def bench_all():
 
     weights = {"ppo": 2.0, "rlhf": 1.4, "pixel": 1.2, "hopper": 1.0,
                "sac": 1.0, "per": 1.0, "async_collect": 0.8, "serve": 0.8,
-               "fleet": 0.8, "chaos": 0.6}
+               "fleet": 0.8, "multichip": 0.8, "chaos": 0.6}
     deadline = _START + _TIMEOUT - 30.0  # safety margin for the final print
     pending = list(weights)
     results: dict = {}
@@ -2260,6 +2524,7 @@ if __name__ == "__main__":
             "async_collect": bench_async_collect,
             "chaos": bench_chaos,
             "fleet": bench_fleet,
+            "multichip": bench_multichip,
         }[mode]()
         timer.cancel()
         _maybe_write_metrics(_result)

@@ -43,6 +43,7 @@ class LLMCollector:
         engine_slots: int | None = None,
         engine_block_size: int = 16,
         engine_decode_chunk: int | str = 1,
+        engine_params_sharding: Any = None,
     ):
         self.env = env
         self.model = model
@@ -63,6 +64,9 @@ class LLMCollector:
         # path; "auto" lets the engine tune its chunk from measured chunk
         # wall-time vs sync overhead (throughput over reproducibility)
         self.engine_decode_chunk = engine_decode_chunk
+        # shardings the engine pins pushed params to (FSDP rollouts: the
+        # sharded trainer passes its per-leaf param placements through)
+        self.engine_params_sharding = engine_params_sharding
         self._engine = None
         # (rewards, batch_arrays) -> rewards, applied BEFORE group advantages
         # (KLRewardTransform / PolicyVersion — reference envs/llm/transforms/)
@@ -116,6 +120,7 @@ class LLMCollector:
                 eos_id=self.eos_id,
                 temperature=self.temperature,
                 decode_chunk=self.engine_decode_chunk,
+                params_sharding=self.engine_params_sharding,
             )
         eng = self._engine
         eng.params = params  # fresh policy weights each collect

@@ -164,6 +164,10 @@ class ContinuousBatchingEngine:
             every K (the stop rule is applied on device per step); for
             non-greedy sampling the RNG stream depends on K, so
             reproducibility-sensitive callers should pin an int.
+        params_sharding: optional pytree of shardings (params' structure,
+            e.g. from :func:`rl_tpu.parallel.fsdp_sharding`) every params
+            assignment is pinned to — weight pushes that already match
+            alias buffers instead of copying.
     """
 
     def __init__(
@@ -181,7 +185,11 @@ class ContinuousBatchingEngine:
         greedy: bool = False,
         seed: int = 0,
         decode_chunk: int | str = 1,
+        params_sharding: Any = None,
     ):
+        # placement is applied by the params setter, so it must exist
+        # before the first assignment below
+        self.params_sharding = params_sharding
         self.model, self.params = model, params
         self.n_slots, self.block = n_slots, block_size
         self.max_seq_len = max_seq_len or model.cfg.max_seq_len
@@ -252,6 +260,24 @@ class ContinuousBatchingEngine:
         self._decode_progs: dict[int, Any] = {}  # chunk K -> jitted program
         self._prefills: dict[tuple, Any] = {}  # (A, bucket) -> jitted prefill
         self._admit_update = jax.jit(_admit_update_fn)
+
+    @property
+    def params(self):
+        return self._params
+
+    @params.setter
+    def params(self, value):
+        # pin incoming weights to the engine's mesh layout: when the
+        # trainer pushes FSDP-sharded params that already match, device_put
+        # aliases the buffers (zero copy); a mismatched layout is reshard-
+        # on-device once here rather than at every prefill/decode dispatch
+        if self.params_sharding is not None:
+            sh = self.params_sharding
+            if jax.tree_util.treedef_is_leaf(jax.tree_util.tree_structure(sh)):
+                value = jax.device_put(value, sh)  # one sharding, all leaves
+            else:
+                value = jax.tree.map(jax.device_put, value, sh)
+        self._params = value
 
     # -- jitted programs -------------------------------------------------------
 

@@ -8,6 +8,14 @@ component speaks):
 - ``context``: sequence/context parallelism (ring attention KV rotation);
 - ``expert``: MoE expert parallelism (reserved).
 
+The RLHF stack speaks a second, 2-D layout — the ``(batch, fsdp)`` mesh
+(:func:`make_fsdp_mesh`): rollout batches shard their leading dim over
+both axes (:func:`data_sharding`), while params and optimizer state shard
+per-leaf over ``fsdp`` (:func:`fsdp_sharding`, with a min-size cutoff and
+a replicated fallback for small/indivisible leaves). XLA then derives the
+FSDP all-gathers on the forward and the reduce-scatter on the gradients
+from the placements alone — the trainers never issue a collective.
+
 Replaces the reference's process-group plumbing
 (reference: torchrl/collectors/distributed/generic.py:490 init_process_group,
 torchrl/trainers/_distributed.py:63 ``_DDPProcessGroup``): on TPU the mesh +
@@ -27,10 +35,16 @@ __all__ = [
     "AXIS_MODEL",
     "AXIS_CONTEXT",
     "AXIS_EXPERT",
+    "AXIS_BATCH",
+    "AXIS_FSDP",
+    "DATA_AXES",
     "make_mesh",
+    "make_fsdp_mesh",
     "replicated",
     "sharded",
     "shard_batch",
+    "data_sharding",
+    "fsdp_sharding",
     "shard_train_state",
 ]
 
@@ -38,6 +52,11 @@ AXIS_DATA = "data"
 AXIS_MODEL = "model"
 AXIS_CONTEXT = "context"
 AXIS_EXPERT = "expert"
+
+# the RLHF (batch, fsdp) mesh axes: data shards over BOTH, params over fsdp
+AXIS_BATCH = "batch"
+AXIS_FSDP = "fsdp"
+DATA_AXES = (AXIS_BATCH, AXIS_FSDP)
 
 
 def make_mesh(
@@ -67,6 +86,30 @@ def make_mesh(
     return Mesh(arr, (AXIS_DATA, AXIS_CONTEXT, AXIS_EXPERT, AXIS_MODEL))
 
 
+def make_fsdp_mesh(fsdp: int = 1, batch: int = -1, devices=None) -> Mesh:
+    """Build the 2-D ``(batch, fsdp)`` mesh the sharded RLHF cycle runs on.
+
+    ``batch=-1`` absorbs the remaining devices. ``fsdp`` is the innermost
+    axis: the per-layer param all-gathers and gradient reduce-scatters are
+    the latency-critical collectives, so they ride the fastest ICI
+    neighbors. With ``fsdp=1`` the mesh degenerates to pure data
+    parallelism; with ``batch=1`` it is pure FSDP.
+    """
+    devices = devices if devices is not None else jax.devices()
+    n = len(devices)
+    if fsdp < 1:
+        raise ValueError(f"fsdp axis size must be >= 1, got {fsdp}")
+    if batch == -1:
+        if n % fsdp:
+            raise ValueError(f"{n} devices not divisible by fsdp={fsdp}")
+        batch = n // fsdp
+    total = batch * fsdp
+    if total > n:
+        raise ValueError(f"mesh needs {total} devices, have {n}")
+    arr = np.asarray(devices[:total]).reshape(batch, fsdp)
+    return Mesh(arr, DATA_AXES)
+
+
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, PartitionSpec())
 
@@ -87,19 +130,91 @@ def shard_batch(batch, mesh: Mesh, axis: str = AXIS_DATA, batch_dim: int = 0):
     return jax.tree.map(put, batch)
 
 
-def shard_train_state(ts: dict, mesh: Mesh, num_envs: int, env_axis: str = AXIS_DATA) -> dict:
-    """Standard data-parallel placement of a Program train state:
-    params/opt/rng replicated; collector env state sharded over envs.
+def data_sharding(mesh: Mesh, batch_dim: int = 0) -> NamedSharding:
+    """Rollout-batch sharding: the leading (batch) dim split over every
+    data-parallel axis the mesh has — ``(batch, fsdp)`` on the FSDP mesh,
+    ``batch`` or ``data`` alone on 1-D meshes. Trailing dims replicate."""
+    axes = tuple(a for a in (*DATA_AXES, AXIS_DATA) if a in mesh.axis_names)
+    if not axes:
+        return replicated(mesh)
+    spec = [None] * batch_dim + [axes]
+    return NamedSharding(mesh, PartitionSpec(*spec))
 
-    This is the whole "DistributedDataParallel" setup — XLA derives the
-    gradient ``psum`` from these placements (no wrapper module, reference
-    trainers/_distributed.py:138 DDP-wrap becomes a no-op).
+
+def _is_prng_key(x) -> bool:
+    try:
+        return jax.dtypes.issubdtype(x.dtype, jax.dtypes.prng_key)
+    except (AttributeError, TypeError):
+        return False
+
+
+def fsdp_sharding(pytree, mesh: Mesh, *, min_size_mbytes: float = 4.0):
+    """Per-leaf FSDP shardings for a params/optimizer pytree.
+
+    Each array leaf shards its LARGEST dim that the ``fsdp`` axis size
+    divides; leaves smaller than ``min_size_mbytes`` (the all-gather
+    latency floor — tiny layers cost more to gather than they save in
+    HBM), scalars, PRNG keys, and leaves with no divisible dim fall back
+    to replicated. Applying this to an optax state works unchanged: the
+    adam moments mirror the param shapes, so they land on the same specs,
+    and step counters replicate.
+
+    Returns a pytree of :class:`NamedSharding` with the input's structure
+    — feed it to ``jax.device_put`` / ``in_shardings`` / ``out_shardings``.
+    """
+    n_fsdp = mesh.shape[AXIS_FSDP] if AXIS_FSDP in mesh.axis_names else 1
+    repl = replicated(mesh)
+    min_bytes = min_size_mbytes * 2**20
+
+    def rule(x):
+        if n_fsdp <= 1 or not hasattr(x, "shape") or x.ndim == 0 or _is_prng_key(x):
+            return repl
+        itemsize = getattr(getattr(x, "dtype", None), "itemsize", 4)
+        if x.size * itemsize < min_bytes:
+            return repl
+        divisible = [i for i in range(x.ndim) if x.shape[i] % n_fsdp == 0]
+        if not divisible:
+            return repl
+        dim = max(divisible, key=lambda i: x.shape[i])
+        spec = [None] * x.ndim
+        spec[dim] = AXIS_FSDP
+        return NamedSharding(mesh, PartitionSpec(*spec))
+
+    return jax.tree.map(rule, pytree)
+
+
+def shard_train_state(
+    ts: dict,
+    mesh: Mesh,
+    num_envs: int,
+    env_axis: str | None = None,
+    *,
+    min_size_mbytes: float = 4.0,
+) -> dict:
+    """Standard placement of a Program train state onto ``mesh``.
+
+    - collector env state (leaves with a ``num_envs`` leading dim) shards
+      over the env axis (``data`` on the classic mesh, ``(batch, fsdp)``
+      on the FSDP mesh);
+    - params and optimizer state replicate on meshes without an ``fsdp``
+      axis (the classic DDP setup — XLA derives the gradient ``psum``
+      from the placements, reference trainers/_distributed.py:138 becomes
+      a no-op) and FSDP-shard per leaf (:func:`fsdp_sharding`, min-size
+      cutoff, replicated fallback) when the mesh has one;
+    - PRNG keys and counters always replicate — every device must draw
+      the same randomness for the program to stay SPMD.
     """
     repl = replicated(mesh)
+    has_fsdp = AXIS_FSDP in mesh.axis_names and mesh.shape[AXIS_FSDP] > 1
+    if env_axis is None:
+        env_axis = AXIS_DATA if AXIS_DATA in mesh.axis_names else DATA_AXES
     env_sharded = NamedSharding(mesh, PartitionSpec(env_axis))
 
     def put_collector(x):
-        if hasattr(x, "shape") and x.ndim >= 1 and x.shape[0] == num_envs:
+        if (
+            hasattr(x, "shape") and x.ndim >= 1 and x.shape[0] == num_envs
+            and not _is_prng_key(x)
+        ):
             return jax.device_put(x, env_sharded)
         return jax.device_put(x, repl)
 
@@ -107,6 +222,10 @@ def shard_train_state(ts: dict, mesh: Mesh, num_envs: int, env_axis: str = AXIS_
     for k, v in ts.items():
         if k == "collector":
             out[k] = jax.tree.map(put_collector, v)
+        elif has_fsdp and k in ("params", "opt", "opt_state"):
+            shardings = fsdp_sharding(v, mesh, min_size_mbytes=min_size_mbytes)
+            out[k] = jax.tree.map(jax.device_put, v, shardings)
         else:
+            # rng keys, step counters, anything else: replicated
             out[k] = jax.device_put(v, repl)
     return out

@@ -41,6 +41,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
+from jax.sharding import NamedSharding, PartitionSpec
 
 from ..collectors.llm import LLMCollector
 from ..data import ArrayDict
@@ -58,9 +59,10 @@ from ..models import (
 )
 from ..obs import DeviceMetrics
 from ..objectives.llm.grpo import GRPOLoss
+from ..parallel.mesh import AXIS_CONTEXT, AXIS_FSDP, DATA_AXES, data_sharding, fsdp_sharding
 from ..resilience.faults import fault_point, get_injector
 from ..resilience.guard import tree_where
-from ..weight_update.schemes import DevicePutScheme
+from ..weight_update.schemes import DevicePutScheme, ShardedSyncScheme
 
 __all__ = ["GRPOTrainer", "PipelinedGRPOTrainer", "RolloutPipeline"]
 
@@ -70,10 +72,20 @@ class GRPOTrainer:
 
     Args:
         dataset: (question, answer) pairs; tokenizer trains on its corpus.
-        mesh: optional ``jax.sharding.Mesh`` with a "context" axis — the
-            training forward then runs ring attention with the sequence
-            sharded over it (the axis size must divide prompt+response
-            length).
+        mesh: optional ``jax.sharding.Mesh``. With a "context" axis the
+            training forward runs ring attention with the sequence sharded
+            over it (the axis size must divide prompt+response length).
+            With the ``(batch, fsdp)`` mesh
+            (:func:`rl_tpu.parallel.make_fsdp_mesh`) the trainer instead
+            FSDP-shards params and optimizer state per leaf
+            (:func:`rl_tpu.parallel.fsdp_sharding`), shards rollout
+            batches over every data axis, pins the donated update dispatch
+            with explicit ``in_shardings``/``out_shardings``, and syncs
+            weights through a :class:`ShardedSyncScheme` — only each
+            device's shard ever moves.
+        fsdp_min_size_mb: min-size cutoff (MB) below which a param leaf
+            replicates instead of FSDP-sharding (only used on an ``fsdp``
+            mesh). Tests pass 0.0 so tiny models actually shard.
         kl_coeff: KL(π‖π_ref) reward-shaping coefficient (π_ref = init).
         scorer: reward override; default exact-match + dense arithmetic
             credit against ``dataset.answers``.
@@ -110,6 +122,7 @@ class GRPOTrainer:
         microbatch_size: int | None = None,
         remat: bool = False,
         remat_policy: str = "none",
+        fsdp_min_size_mb: float = 4.0,
     ):
         self.tokenizer = tokenizer or SimpleTokenizer(dataset.corpus())
         self.dataset = dataset
@@ -140,8 +153,9 @@ class GRPOTrainer:
             train_cfg = dataclasses.replace(
                 train_cfg, remat=True, remat_policy=remat_policy
             )
-        if mesh is not None:
-            ctx = mesh.shape["context"]
+        self._fsdp = mesh is not None and AXIS_FSDP in mesh.axis_names
+        if mesh is not None and AXIS_CONTEXT in mesh.axis_names:
+            ctx = mesh.shape[AXIS_CONTEXT]
             if total_len % ctx:
                 raise ValueError(
                     f"context axis size ({ctx}) must divide prompt+response "
@@ -157,16 +171,33 @@ class GRPOTrainer:
         self.params = self.gen_model.init(
             key, jnp.zeros((1, 4), jnp.int32)
         )["params"]
-        if mesh is not None:
+        self._mesh_replicated = None
+        self._param_shardings = None
+        self._batch_placement = None
+        if self._fsdp:
+            # (batch, fsdp) mesh: per-leaf FSDP placement (min-size cutoff,
+            # replicated fallback) instead of the old blanket replicated
+            # device_put; rollout batches split their leading dim over every
+            # data axis. XLA derives the forward all-gathers and gradient
+            # reduce-scatters from these placements alone.
+            n_dp = int(np.prod([mesh.shape[a] for a in DATA_AXES if a in mesh.axis_names]))
+            if B % n_dp:
+                raise ValueError(
+                    f"batch (num_prompts * group_repeats = {B}) must be "
+                    f"divisible by the mesh's data-parallel extent ({n_dp})"
+                )
+            self._param_shardings = fsdp_sharding(
+                self.params, mesh, min_size_mbytes=fsdp_min_size_mb
+            )
+            self.params = jax.tree.map(jax.device_put, self.params, self._param_shardings)
+            self._batch_placement = data_sharding(mesh)
+        elif mesh is not None:
             # the ring forward is a shard_map over the whole mesh: params and
             # batch must live on the mesh's device set (replicated; the
             # sequence axis is split inside ring_attention)
-            from jax.sharding import NamedSharding, PartitionSpec
-
             self._mesh_replicated = NamedSharding(mesh, PartitionSpec())
             self.params = jax.device_put(self.params, self._mesh_replicated)
-        else:
-            self._mesh_replicated = None
+            self._batch_placement = self._mesh_replicated
         self.ref_params = jax.tree.map(jnp.copy, self.params)
 
         scorer = scorer or combine_scorers(
@@ -181,7 +212,13 @@ class GRPOTrainer:
             max_prompt_len=max_prompt_len,
             seed=seed,
         )
-        self.scheme = DevicePutScheme(jax.devices()[0])
+        if self._fsdp:
+            # shard-local publication: push re-places onto the SAME
+            # per-leaf shardings the update emits, so it aliases buffers —
+            # no full-replica gather anywhere on the sync path
+            self.scheme = ShardedSyncScheme(self._param_shardings)
+        else:
+            self.scheme = DevicePutScheme(jax.devices()[0])
         self.scheme.push(self.params)
         self.policy_version = PolicyVersion()
         kl = KLRewardTransform(coeff=kl_coeff)
@@ -200,6 +237,7 @@ class GRPOTrainer:
             weight_scheme=self.scheme,
             reward_transform=reward_transform,
             continuous_batching=continuous_batching,
+            engine_params_sharding=self._param_shardings,
         )
         # MoE configs score through the aux-returning path so the Switch
         # load-balancing term trains by default (routing collapses without it)
@@ -217,6 +255,16 @@ class GRPOTrainer:
         )
         self.opt = optax.adam(learning_rate)
         self.opt_state = self.opt.init(self.params)
+        self._opt_shardings = None
+        if self._fsdp:
+            # adam moments mirror the param shapes, so the same per-leaf
+            # rule lands them on the param specs; step counters replicate
+            self._opt_shardings = fsdp_sharding(
+                self.opt_state, mesh, min_size_mbytes=fsdp_min_size_mb
+            )
+            self.opt_state = jax.tree.map(
+                jax.device_put, self.opt_state, self._opt_shardings
+            )
         self._key = jax.random.key(seed + 1)
 
         # step metrics accumulate ON DEVICE inside the update program and
@@ -236,7 +284,29 @@ class GRPOTrainer:
         # donate the rotating optimizer state, NOT the params: the weight
         # scheme (and a pipelined generator thread pulling from it) may
         # alias the same device buffers a same-device device_put returns
-        self._update = jax.jit(self._update_impl, donate_argnums=(1,))
+        if self._fsdp:
+            # explicit in/out shardings pin the donated dispatch to the FSDP
+            # layout: XLA overlaps the param all-gathers / grad
+            # reduce-scatters with compute instead of inserting resharding
+            # copies at the jit boundary. The fixed arity means every call
+            # passes the poison scalar (the cached device zero when the
+            # chaos injector is idle or absent).
+            _repl = NamedSharding(mesh, PartitionSpec())
+            self._update = jax.jit(
+                self._update_impl,
+                donate_argnums=(1,),
+                in_shardings=(
+                    self._param_shardings,
+                    self._opt_shardings,
+                    self._batch_placement,
+                    _repl,
+                    _repl,
+                ),
+                out_shardings=(self._param_shardings, self._opt_shardings, _repl),
+            )
+            self._poison_zero = jax.device_put(jnp.zeros((), jnp.float32), _repl)
+        else:
+            self._update = jax.jit(self._update_impl, donate_argnums=(1,))
         self._eval_gen = jax.jit(
             lambda p, t, m, k: generate(
                 self.gen_model, p, t, m, k,
@@ -325,12 +395,12 @@ class GRPOTrainer:
     def _consume(self, batch: ArrayDict) -> dict[str, float]:
         """Update on a collected batch, publish weights, drain metrics."""
         inj = get_injector()
-        if inj is None:
+        if inj is None and not self._fsdp:
             self.params, self.opt_state, self._dm = self._update(
                 self.params, self.opt_state, batch, self._dm
             )
         else:
-            p = inj.poison("grpo.update")
+            p = inj.poison("grpo.update") if inj is not None else 0.0
             if self._poison_zero is None:
                 self._poison_zero = jnp.zeros((), jnp.float32)
             pv = self._poison_zero if p == 0.0 else jnp.asarray(p, jnp.float32)
@@ -376,8 +446,8 @@ class GRPOTrainer:
         """collect → update → push weights. Returns step metrics."""
         self._key, k = jax.random.split(self._key)
         batch = self.collector.collect(None, k)  # scheme snapshot
-        if self._mesh_replicated is not None:
-            batch = jax.device_put(batch, self._mesh_replicated)
+        if self._batch_placement is not None:
+            batch = jax.device_put(batch, self._batch_placement)
         return self._consume(batch)
 
     def train(
@@ -465,7 +535,14 @@ class GRPOTrainer:
         self._key = arrays["key"]
         self._dm = arrays["dm"]
         self._pending_dm = None
-        if self._mesh_replicated is not None:
+        if self._fsdp:
+            self.params = jax.tree.map(
+                jax.device_put, self.params, self._param_shardings
+            )
+            self.opt_state = jax.tree.map(
+                jax.device_put, self.opt_state, self._opt_shardings
+            )
+        elif self._mesh_replicated is not None:
             self.params = jax.device_put(self.params, self._mesh_replicated)
         self.history = {k: list(v) for k, v in meta.get("history", {}).items()}
         if "env_rng" in meta:
@@ -692,8 +769,8 @@ class PipelinedGRPOTrainer(GRPOTrainer):
         batch = batch.set(
             "policy_version", np.full(B, version, np.int32)
         )
-        if self._mesh_replicated is not None:
-            batch = jax.device_put(batch, self._mesh_replicated)
+        if self._batch_placement is not None:
+            batch = jax.device_put(batch, self._batch_placement)
         out = self._consume(batch)
         out["staleness"] = float(staleness)
         return out

@@ -239,6 +239,18 @@ class OffPolicyProgram(_GradUpdateMixin):
             ts["obs"] = self.device_metrics.init()
         return ts
 
+    def shard_state(self, ts: dict, mesh, *, min_size_mb: float = 4.0) -> dict:
+        """Place a train state onto ``mesh`` with the framework's standard
+        layout (:func:`rl_tpu.parallel.shard_train_state`): collector env
+        leaves shard over the data axes, params/opt FSDP-shard per leaf
+        when the mesh has an ``fsdp`` axis (replicated otherwise), PRNG
+        keys and counters replicate. ``jax.jit(program.train_step)`` then
+        derives every collective from the placements."""
+        from ..parallel.mesh import shard_train_state
+
+        num_envs = self.collector.env.batch_shape[0] if self.collector.env.batch_shape else 1
+        return shard_train_state(ts, mesh, num_envs, min_size_mbytes=min_size_mb)
+
     def _flatten(self, batch: ArrayDict) -> ArrayDict:
         """[T, *env_batch, …] -> [T*prod(env_batch), …], **env-major**: each
         env's T steps stay contiguous so SliceSampler windows (and any

@@ -2,6 +2,7 @@ from .schemes import (
     DevicePutScheme,
     DoubleBufferScheme,
     SharedProgramScheme,
+    ShardedSyncScheme,
     WeightSyncScheme,
 )
 
@@ -9,5 +10,6 @@ __all__ = [
     "WeightSyncScheme",
     "SharedProgramScheme",
     "DevicePutScheme",
+    "ShardedSyncScheme",
     "DoubleBufferScheme",
 ]

@@ -30,7 +30,13 @@ from typing import Any
 import jax
 import numpy as np
 
-__all__ = ["WeightSyncScheme", "SharedProgramScheme", "DevicePutScheme", "DoubleBufferScheme"]
+__all__ = [
+    "WeightSyncScheme",
+    "SharedProgramScheme",
+    "DevicePutScheme",
+    "ShardedSyncScheme",
+    "DoubleBufferScheme",
+]
 
 
 class WeightSyncScheme:
@@ -114,6 +120,61 @@ class DevicePutScheme(WeightSyncScheme):
             )
         else:
             placed = jax.device_put(params, self.target_sharding)
+        with self._lock:
+            self._params = placed
+            self._version += 1
+
+    def pull(self):
+        if self._params is None:
+            raise RuntimeError("no params pushed yet")
+        return self._params
+
+    def pull_versioned(self):
+        with self._lock:
+            return self.pull(), self._version
+
+    @property
+    def version(self):
+        return self._version
+
+
+class ShardedSyncScheme(WeightSyncScheme):
+    """Shard-local publication on a shared mesh: the sync path moves only
+    each device's shard — never a full-replica gather.
+
+    ``target_shardings`` is a pytree of :class:`~jax.sharding.NamedSharding`
+    matching the params' structure (produce it with
+    :func:`rl_tpu.parallel.fsdp_sharding`). When the learner's update
+    already emits its params in exactly these shardings (the
+    ``out_shardings`` path in :class:`~rl_tpu.trainers.grpo.GRPOTrainer`),
+    ``jax.device_put`` recognises the placement as identical and aliases
+    the buffers — the push is zero-copy. When the shardings differ but
+    live on the same devices, XLA lowers the put to an on-device reshard
+    over ICI; no leaf is gathered to one device and nothing crosses the
+    host boundary (``jax.transfer_guard("disallow")`` stays quiet around
+    the whole push/pull cycle — tests/test_sharded_training.py holds the
+    sync path to that bound).
+
+    Versioned-snapshot semantics are identical to
+    :class:`DevicePutScheme`: ``push`` dispatches placement outside the
+    lock, publication of ``(params, version)`` is atomic, and
+    ``pull_versioned`` takes the same lock so the off-by-one staleness
+    invariant from the pipelined trainer carries over unchanged.
+    """
+
+    def __init__(self, target_shardings):
+        self.target_shardings = target_shardings
+        self._params = None
+        self._version = 0
+        self._lock = threading.Lock()
+
+    def push(self, params):
+        # dispatch outside the lock, like DevicePutScheme; a single Sharding
+        # (rather than a params-shaped pytree of them) broadcasts over leaves
+        if jax.tree_util.treedef_is_leaf(jax.tree_util.tree_structure(self.target_shardings)):
+            placed = jax.device_put(params, self.target_shardings)
+        else:
+            placed = jax.tree.map(jax.device_put, params, self.target_shardings)
         with self._lock:
             self._params = placed
             self._version += 1

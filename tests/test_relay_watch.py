@@ -13,7 +13,7 @@ import pytest
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
 
-from relay_watch import watch  # noqa: E402
+from relay_watch import REPO, watch  # noqa: E402
 
 
 class FakeRunner:
@@ -120,6 +120,52 @@ def test_metrics_sections_extracted_and_committed(tmp_path):
     # both files land in ONE commit
     assert len(runner.commits) == 1
     assert runner.commits[0][0] == [art, mart]
+
+
+def test_multichip_sweep_distilled_to_own_artifact(tmp_path):
+    """PR-7: the multichip sub-bench's scaling sweep (tokens/s + MFU at
+    1/4/8 devices, sharded-vs-replicated ratio) lands in its own committed
+    MULTICHIP json — whole, not flattened into the metrics sections — and
+    rides the same single commit as the raw artifact."""
+
+    class MCRunner(FakeRunner):
+        def bench_all(self, timeout):
+            self.bench_calls.append(timeout)
+            mc = {
+                "metric": "multichip_train_tokens_per_sec",
+                "value": 2684.7,
+                "top_devices": 8,
+                "devices": {"1": {"train_tokens_per_sec": 6302.4},
+                            "4": {"train_tokens_per_sec": 3864.3},
+                            "8": {"train_tokens_per_sec": 2684.7}},
+                "scaling_efficiency": {"1": 1.0, "4": 0.153, "8": 0.053},
+                "sharded_vs_replicated_1dev": 1.041,
+                "sharded_ok_1dev": True,
+                "metrics": {"train_mfu_8dev": 0.001},
+            }
+            lines = [
+                {"metric": "ppo", "value": 123.0},
+                {"per": {"value": 1.5, "metrics": {"overhead_frac": 0.01}}},
+                {"multichip": mc},
+            ]
+            return 0, "".join(json.dumps(ln) + "\n" for ln in lines)
+
+    runner = MCRunner([_healthy()])
+    art = str(tmp_path / "bench.jsonl")
+    mart = str(tmp_path / "METRICS.json")
+    mcart = str(tmp_path / "MULTICHIP.json")
+    watch(runner, lambda s: None, max_probes=1, artifact=art,
+          metrics_artifact=mart, multichip_artifact=mcart,
+          sleep=lambda s: None)
+    doc = json.loads(open(mcart).read())
+    mc = doc["multichip"]
+    assert mc["sharded_vs_replicated_1dev"] == 1.041
+    assert mc["scaling_efficiency"]["8"] == 0.053
+    assert mc["devices"]["4"]["train_tokens_per_sec"] == 3864.3
+    assert doc["artifact"] == os.path.relpath(art, REPO)
+    # all three files land in ONE commit
+    assert len(runner.commits) == 1
+    assert runner.commits[0][0] == [art, mart, mcart]
 
 
 def test_rlhf_pipeline_subresult_distilled(tmp_path):

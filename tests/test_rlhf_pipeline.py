@@ -49,6 +49,26 @@ class TestStaleness:
         assert t.staleness_history[-1] == 1
         assert t.policy_version.version == 5
 
+    @pytest.mark.mesh
+    def test_off_by_one_invariant_under_sharded_scheme(self):
+        """PR-7: on a (batch, fsdp) mesh the pipeline publishes through
+        ShardedSyncScheme (per-device shards, no full-replica gather) —
+        the versioned-snapshot staleness semantics must be unchanged."""
+        from rl_tpu.parallel import make_fsdp_mesh
+        from rl_tpu.weight_update import ShardedSyncScheme
+
+        mesh = make_fsdp_mesh(fsdp=4, batch=2)
+        with _tiny(PipelinedGRPOTrainer, continuous_batching=False,
+                   mesh=mesh, fsdp_min_size_mb=0.0) as t:
+            assert isinstance(t.scheme, ShardedSyncScheme)
+            for _ in range(5):
+                m = t.step()
+                assert np.isfinite(m["loss"])
+        assert max(t.staleness_history) <= 1
+        assert t.staleness_history[0] == 0
+        assert t.staleness_history[-1] == 1
+        assert t.policy_version.version == 5
+
     @pytest.mark.slow
     def test_engine_backed_pipeline_steps(self):
         """Default PipelinedGRPOTrainer rides the continuous-batching

@@ -1,0 +1,263 @@
+"""Pod-scale sharded RLHF on the virtual 8-device CPU mesh.
+
+The PR-7 invariants:
+
+- ``make_fsdp_mesh``/``fsdp_sharding``/``data_sharding`` implement the
+  ``(batch, fsdp)`` layout: params shard their largest divisible dim over
+  ``fsdp`` (min-size cutoff, replicated fallback), rollout batches shard
+  their leading dim over both axes;
+- the FSDP-sharded donated GRPO update is NUMERICALLY the single-device
+  update (same seed, same collected batch → loss/param maxdiff bound);
+- the weight-sync path moves only each device's shard: the push/pull
+  cycle stays inside ``jax.transfer_guard("disallow")`` and no pulled
+  leaf ever costs a full-replica gather;
+- ``shard_train_state`` covers optimizer state and PRNG keys, and the
+  off-policy program runs jitted on the FSDP mesh from those placements.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.mesh
+from jax.sharding import PartitionSpec as P
+
+from rl_tpu.envs.llm import arithmetic_dataset
+from rl_tpu.obs import DeviceMetrics
+from rl_tpu.parallel import (
+    AXIS_FSDP,
+    data_sharding,
+    fsdp_sharding,
+    make_fsdp_mesh,
+    make_mesh,
+    replicated,
+    shard_train_state,
+)
+from rl_tpu.trainers.grpo import GRPOTrainer, PipelinedGRPOTrainer
+from rl_tpu.weight_update import ShardedSyncScheme
+
+KEY = jax.random.key(0)
+N_DEV = 8
+
+
+def _tiny(cls=GRPOTrainer, **kw):
+    ds = arithmetic_dataset(n=64, max_operand=2)
+    defaults = dict(num_prompts=4, group_repeats=4, max_prompt_len=8,
+                    max_new_tokens=4, learning_rate=3e-3, kl_coeff=0.005)
+    defaults.update(kw)
+    return cls(ds, **defaults)
+
+
+class TestFsdpMesh:
+    def test_absorb_and_axis_order(self):
+        mesh = make_fsdp_mesh(fsdp=4)
+        assert mesh.shape["batch"] == 2 and mesh.shape["fsdp"] == 4
+        assert mesh.axis_names == ("batch", "fsdp")
+
+    def test_degenerate_data_parallel(self):
+        mesh = make_fsdp_mesh(fsdp=1)
+        assert mesh.shape["batch"] == N_DEV
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            make_fsdp_mesh(fsdp=0)
+        with pytest.raises(ValueError):
+            make_fsdp_mesh(fsdp=3)  # 8 % 3
+        with pytest.raises(ValueError):
+            make_fsdp_mesh(fsdp=4, batch=4)  # 16 > 8 devices
+
+
+class TestFsdpSharding:
+    def test_leaf_rules(self):
+        mesh = make_fsdp_mesh(fsdp=4, batch=2)
+        tree = {
+            "w": jnp.ones((16, 8)),       # largest divisible dim -> dim0
+            "tall": jnp.ones((3, 64)),    # dim0 indivisible -> dim1
+            "odd": jnp.ones((7, 5)),      # no divisible dim -> replicated
+            "scalar": jnp.float32(1.0),   # -> replicated
+            "key": jax.random.key(0),     # PRNG -> replicated
+        }
+        sh = fsdp_sharding(tree, mesh, min_size_mbytes=0.0)
+        assert sh["w"].spec == P(AXIS_FSDP, None)
+        assert sh["tall"].spec == P(None, AXIS_FSDP)
+        assert sh["odd"].spec == P()
+        assert sh["scalar"].spec == P()
+        assert sh["key"].spec == P()
+
+    def test_min_size_cutoff_replicates_small_leaves(self):
+        mesh = make_fsdp_mesh(fsdp=4, batch=2)
+        small = {"w": jnp.ones((16, 8))}  # 512 B << 4 MB default cutoff
+        assert fsdp_sharding(small, mesh)["w"].spec == P()
+        big = {"w": jnp.ones((1024, 1536))}  # 6 MB
+        assert fsdp_sharding(big, mesh)["w"].spec == P(None, AXIS_FSDP)
+
+    def test_no_fsdp_axis_replicates(self):
+        mesh = make_mesh()  # classic (data, context, expert, model)
+        sh = fsdp_sharding({"w": jnp.ones((16, 8))}, mesh, min_size_mbytes=0.0)
+        assert sh["w"].spec == P()
+
+    def test_data_sharding_axes(self):
+        assert data_sharding(make_fsdp_mesh(fsdp=4)).spec == P(("batch", "fsdp"))
+        assert data_sharding(make_mesh()).spec == P(("data",))
+
+
+class TestShardTrainState:
+    def test_covers_opt_state_and_prng(self):
+        mesh = make_fsdp_mesh(fsdp=4, batch=2)
+        ts = {
+            "params": {"w": jnp.ones((16, 8))},
+            "opt": {"mu": jnp.ones((16, 8)), "count": jnp.int32(0)},
+            "collector": {"obs": jnp.ones((8, 3)), "rng": jax.random.key(2)},
+            "rng": jax.random.key(1),
+            "update_count": jnp.int32(0),
+        }
+        out = shard_train_state(ts, mesh, num_envs=8, min_size_mbytes=0.0)
+        assert out["params"]["w"].sharding.spec == P(AXIS_FSDP, None)
+        assert out["opt"]["mu"].sharding.spec == P(AXIS_FSDP, None)
+        assert out["opt"]["count"].sharding.is_fully_replicated
+        # env state splits over BOTH data axes; PRNG keys always replicate
+        assert out["collector"]["obs"].sharding.spec == P(("batch", "fsdp"))
+        assert out["collector"]["rng"].sharding.is_fully_replicated
+        assert out["rng"].sharding.is_fully_replicated
+
+    def test_classic_mesh_unchanged(self):
+        mesh = make_mesh()
+        ts = {"params": {"w": jnp.ones((16, 8))},
+              "collector": {"obs": jnp.ones((8, 3))}, "rng": jax.random.key(1)}
+        out = shard_train_state(ts, mesh, num_envs=8)
+        assert out["params"]["w"].sharding.is_fully_replicated
+        assert out["collector"]["obs"].sharding.spec == P("data")
+
+    def test_offpolicy_program_shard_state_runs_on_fsdp_mesh(self):
+        from rl_tpu.collectors import Collector
+        from rl_tpu.data import DeviceStorage, ReplayBuffer
+        from rl_tpu.envs import CartPoleEnv, VmapEnv
+        from rl_tpu.modules import MLP, TDModule
+        from rl_tpu.objectives import DQNLoss
+        from rl_tpu.trainers import OffPolicyConfig, OffPolicyProgram
+
+        mesh = make_fsdp_mesh(fsdp=2, batch=4)
+        num_envs = 8
+        env = VmapEnv(CartPoleEnv(), num_envs)
+        qnet = TDModule(MLP(out_features=2), ["observation"], ["action_value"])
+        loss = DQNLoss(qnet, gamma=0.99)
+
+        def policy(params, td, key):
+            q = qnet(params["qvalue"], td)["action_value"]
+            return td.set("action", jnp.argmax(q, axis=-1))
+
+        coll = Collector(env, policy, frames_per_batch=64)
+        program = OffPolicyProgram(
+            coll, loss, ReplayBuffer(DeviceStorage(4096)),
+            OffPolicyConfig(batch_size=32, utd_ratio=1),
+        )
+        ts = program.init(KEY)
+        ts = program.shard_state(ts, mesh, min_size_mb=0.0)
+        assert any(
+            not x.sharding.is_fully_replicated
+            for x in jax.tree.leaves(ts["params"])
+        )
+        with mesh:
+            ts2, m = jax.jit(program.train_step)(ts)
+        assert np.isfinite(float(m["loss"]))
+
+
+class TestShardedSyncScheme:
+    def test_versioned_pull_and_guard(self):
+        mesh = make_fsdp_mesh(fsdp=4, batch=2)
+        params = {"w": jnp.ones((16, 8)), "b": jnp.ones((8,))}
+        sh = fsdp_sharding(params, mesh, min_size_mbytes=0.0)
+        placed = jax.tree.map(jax.device_put, params, sh)
+        scheme = ShardedSyncScheme(sh)
+        with pytest.raises(RuntimeError):
+            scheme.pull()
+        # the whole publish/consume cycle is device-side only
+        with jax.transfer_guard("disallow"):
+            scheme.push(placed)
+            p1, v1 = scheme.pull_versioned()
+            scheme.push(p1)
+            p2, v2 = scheme.pull_versioned()
+        assert (v1, v2) == (1, 2)
+        assert not p2["w"].sharding.is_fully_replicated
+
+    def test_single_sharding_broadcasts(self):
+        mesh = make_fsdp_mesh(fsdp=4, batch=2)
+        scheme = ShardedSyncScheme(replicated(mesh))
+        scheme.push({"w": jnp.ones((4, 4)), "b": jnp.ones((2,))})
+        assert scheme.pull()["w"].sharding.is_fully_replicated
+
+
+class TestShardedGRPO:
+    def test_batch_divisibility_validated(self):
+        with pytest.raises(ValueError):
+            _tiny(mesh=make_fsdp_mesh(fsdp=4, batch=2),
+                  num_prompts=3, group_repeats=2)  # B=6, extent 8
+
+    def test_update_parity_vs_single_device(self):
+        """Same seed, same collected batch: the FSDP-sharded donated
+        update must produce the single-device loss and params to within
+        reduction-reorder noise."""
+        t0 = _tiny()
+        mesh = make_fsdp_mesh(fsdp=4, batch=2)
+        t1 = _tiny(mesh=mesh, fsdp_min_size_mb=0.0)
+        for a, b in zip(jax.tree.leaves(t0.params), jax.tree.leaves(t1.params)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+        t0._key, k = jax.random.split(t0._key)
+        batch = t0.collector.collect(None, k)
+        p0, o0, dm0 = t0._update(t0.params, t0.opt_state, batch, t0._dm)
+        b1 = jax.device_put(batch, t1._batch_placement)
+        p1, o1, dm1 = t1._update(
+            t1.params, t1.opt_state, b1, t1._dm, t1._poison_zero
+        )
+        maxdiff = max(
+            float(np.max(np.abs(np.asarray(a) - np.asarray(b))))
+            for a, b in zip(jax.tree.leaves(p0), jax.tree.leaves(p1))
+        )
+        # Adam's first-step normalization (m/(sqrt(v)+eps) with v ~ g^2)
+        # amplifies f32 reduction-reorder noise toward O(lr); observed
+        # ~0.06*lr, so lr/3 is 5x headroom while a real bug (dropped
+        # microbatch, wrong advantage shard) lands at O(lr) or worse.
+        assert maxdiff < 1e-3, f"sharded update diverged: maxdiff={maxdiff}"
+        l0 = float(t0._dm_spec.to_flat(DeviceMetrics.drain(dm0))["loss"])
+        l1 = float(t1._dm_spec.to_flat(DeviceMetrics.drain(dm1))["loss"])
+        assert abs(l0 - l1) < 1e-5
+
+    def test_fsdp_trainer_steps_and_params_stay_sharded(self):
+        mesh = make_fsdp_mesh(fsdp=4, batch=2)
+        t = _tiny(mesh=mesh, fsdp_min_size_mb=0.0)
+        assert isinstance(t.scheme, ShardedSyncScheme)
+        for _ in range(2):
+            m = t.step()
+            assert np.isfinite(m["loss"])
+        assert any(
+            not x.sharding.is_fully_replicated for x in jax.tree.leaves(t.params)
+        )
+        assert any(
+            not x.sharding.is_fully_replicated
+            for x in jax.tree.leaves(t.opt_state)
+        )
+
+    def test_sync_path_moves_only_shards(self):
+        """The acceptance bound: weight sync transfers per-device shards
+        only. (a) the push/pull cycle runs under
+        ``jax.transfer_guard("disallow")`` — nothing crosses the host
+        boundary; (b) every FSDP-sharded leaf's total addressable bytes
+        equal global_bytes x batch_axis (replication over the batch axis
+        only) — a full-replica gather would cost global_bytes x n_devices."""
+        mesh = make_fsdp_mesh(fsdp=4, batch=2)
+        t = _tiny(mesh=mesh, fsdp_min_size_mb=0.0)
+        with jax.transfer_guard("disallow"):
+            t.scheme.push(t.params)
+            pulled, _ = t.scheme.pull_versioned()
+        n_batch = mesh.shape["batch"]
+        sharded = [
+            x for x in jax.tree.leaves(pulled)
+            if not x.sharding.is_fully_replicated
+        ]
+        assert sharded, "no leaf is FSDP-sharded at min_size=0"
+        for x in sharded:
+            total = sum(s.data.nbytes for s in x.addressable_shards)
+            assert total == x.nbytes * n_batch
+            assert total < x.nbytes * N_DEV

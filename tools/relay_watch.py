@@ -86,6 +86,27 @@ def _extract_metrics(stdout: str) -> dict:
     return sections
 
 
+def _extract_multichip(stdout: str) -> dict | None:
+    """Find the multichip sub-bench result (the scaling-efficiency sweep:
+    train MFU + tokens/s at 1/4/8 devices, sharded-vs-replicated ratio) in
+    a bench stdout JSONL stream. Unlike the flat ``metrics`` sections, the
+    sweep carries structure worth keeping whole — per-device-count worker
+    dicts — so it lands in its own committed MULTICHIP artifact. Last
+    match wins (the final aggregate line repeats the sub-results)."""
+    found = None
+    for ln in (stdout or "").strip().splitlines():
+        try:
+            d = json.loads(ln)
+        except ValueError:
+            continue
+        if not isinstance(d, dict):
+            continue
+        v = d.get("multichip")
+        if isinstance(v, dict) and ("devices" in v or "scaling_efficiency" in v):
+            found = v
+    return found
+
+
 class Runner:
     """Real subprocess/git backend. Tests replace this with a fake that
     implements the same three methods."""
@@ -133,6 +154,7 @@ def watch(
     max_probes: int | None = None,
     artifact: str | None = None,
     metrics_artifact: str | None = None,
+    multichip_artifact: str | None = None,
     commit: bool = True,
     require_tpu: bool = True,
     sleep=time.sleep,
@@ -188,6 +210,21 @@ def watch(
                 f.write("\n")
             paths.append(mpath)
             log(f"{_utcnow()} metrics -> {os.path.relpath(mpath, REPO)}")
+        mc = _extract_multichip(bout)
+        if mc is not None:
+            mcpath = multichip_artifact or os.path.join(REPO, "MULTICHIP_r06.json")
+            with open(mcpath, "w") as f:
+                json.dump(
+                    {
+                        "artifact": os.path.relpath(path, REPO),
+                        "generated": _utcnow(),
+                        "multichip": mc,
+                    },
+                    f, indent=2, sort_keys=True,
+                )
+                f.write("\n")
+            paths.append(mcpath)
+            log(f"{_utcnow()} multichip -> {os.path.relpath(mcpath, REPO)}")
         if commit:
             crc = runner.commit(
                 paths,
@@ -211,6 +248,8 @@ def main(argv=None) -> int:
                     help="artifact path (default logs/bench_<ts>.jsonl)")
     ap.add_argument("--metrics-artifact", default=None,
                     help="metrics-sections path (default METRICS_pr3.json)")
+    ap.add_argument("--multichip-artifact", default=None,
+                    help="multichip scaling-sweep path (default MULTICHIP_r06.json)")
     ap.add_argument("--no-commit", action="store_true")
     ap.add_argument("--log-file", default=os.path.join(REPO, "logs", "relay_watch.log"))
     args = ap.parse_args(argv)
@@ -230,6 +269,7 @@ def main(argv=None) -> int:
         max_probes=args.max_probes,
         artifact=args.artifact,
         metrics_artifact=args.metrics_artifact,
+        multichip_artifact=args.multichip_artifact,
         commit=not args.no_commit,
     )
     return 0 if path is not None else 1
