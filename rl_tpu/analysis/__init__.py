@@ -1,0 +1,126 @@
+"""rlint: JAX/thread-discipline static analysis + runtime lock sanitizer.
+
+Static rules (see :mod:`.rules` and :mod:`.lockorder`):
+
+=====  =======================================================================
+R001   host sync (``.item()``/``float()``/``np.asarray``/``jax.device_get``/
+       ``.block_until_ready()``) reachable from a jit/lax body or ``@hot_path``
+R002   buffer referenced after passing through a ``donate_argnums`` dispatch
+R003   PRNG key consumed twice without an intervening split/rebind
+R004   recompile hazards: tracer-dependent Python branches, jit-in-loop
+R005   lock-order cycles over the package-wide lock-acquisition graph
+=====  =======================================================================
+
+CLI: ``python tools/rlint.py rl_tpu/`` — findings are gated by the
+checked-in ``.rlint-baseline.json`` (every suppression carries a reason)
+and ``tests/test_rlint.py`` holds the package at zero unsuppressed
+findings as part of tier-1.
+
+Runtime: :class:`LockWitness` patches ``threading.Lock``/``RLock``
+construction to record the observed lock-order graph and flag
+inversions; armed under the chaos/fleet suites via the ``lock_witness``
+conftest fixture.
+"""
+
+from __future__ import annotations
+
+import os
+
+from .baseline import Baseline, DEFAULT_BASELINE
+from .core import ModuleIndex, PackageIndex, hot_path
+from .findings import Finding
+from .lockorder import lock_edges, run_lockorder
+from .rules import run_rules
+from .witness import LockWitness, WitnessedLock
+
+__all__ = [
+    "ALL_RULES",
+    "Baseline",
+    "DEFAULT_BASELINE",
+    "Finding",
+    "LockWitness",
+    "WitnessedLock",
+    "analyze_paths",
+    "analyze_sources",
+    "build_index",
+    "hot_path",
+    "lock_edges",
+]
+
+ALL_RULES = ("R001", "R002", "R003", "R004", "R005")
+
+
+def _module_name(path: str, root: str) -> str:
+    """Dotted module name for a file: relative to the directory *containing*
+    the package root, so ``rl_tpu/obs/trace.py`` → ``rl_tpu.obs.trace``."""
+    rel = os.path.relpath(os.path.abspath(path), os.path.abspath(root))
+    parts = rel.replace(os.sep, "/").split("/")
+    if parts[-1].endswith(".py"):
+        parts[-1] = parts[-1][:-3]
+    if parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(p for p in parts if p not in ("", "."))
+
+
+def _iter_py_files(path: str):
+    if os.path.isfile(path):
+        yield path
+        return
+    for dirpath, dirnames, filenames in os.walk(path):
+        dirnames[:] = sorted(
+            d for d in dirnames if d != "__pycache__" and not d.startswith(".")
+        )
+        for f in sorted(filenames):
+            if f.endswith(".py"):
+                yield os.path.join(dirpath, f)
+
+
+def build_index(paths, root: str | None = None) -> PackageIndex:
+    """Index every .py under ``paths``. ``root`` is the directory module
+    names are computed relative to (default: parent of the first path)."""
+    if isinstance(paths, str):
+        paths = [paths]
+    if root is None:
+        first = os.path.abspath(paths[0])
+        root = os.path.dirname(first if os.path.isdir(first) else os.path.dirname(first))
+    modules = []
+    for p in paths:
+        for f in _iter_py_files(p):
+            with open(f, encoding="utf-8") as fh:
+                src = fh.read()
+            rel = os.path.relpath(os.path.abspath(f), os.path.abspath(root))
+            modules.append(ModuleIndex(_module_name(f, root), rel.replace(os.sep, "/"), src))
+    return PackageIndex(modules)
+
+
+def analyze_paths(paths, rules=None, root: str | None = None) -> list[Finding]:
+    """Run the selected rules (default: all) over files/directories."""
+    index = build_index(paths, root=root)
+    return _run(index, rules)
+
+
+def analyze_sources(sources: dict, rules=None) -> list[Finding]:
+    """Analyze in-memory sources: ``{module_name: source}`` (tests use
+    this for fixture snippets; file = ``<module>.py``)."""
+    modules = [
+        ModuleIndex(name, f"{name.replace('.', '/')}.py", src)
+        for name, src in sources.items()
+    ]
+    return _run(PackageIndex(modules), rules)
+
+
+def _run(index: PackageIndex, rules) -> list[Finding]:
+    ruleset = set(rules) if rules is not None else None
+    out = run_rules(index, ruleset)
+    if ruleset is None or "R005" in ruleset:
+        out.extend(run_lockorder(index))
+    # two syncs in one expression (``int(x) / float(y)``) produce one
+    # finding each with the same line+snippet — collapse exact repeats
+    seen: set = set()
+    uniq = []
+    for f in out:
+        k = (f.rule, f.file, f.line, f.message, f.fingerprint)
+        if k not in seen:
+            seen.add(k)
+            uniq.append(f)
+    return sorted(uniq, key=lambda f: (f.file, f.line, f.rule))

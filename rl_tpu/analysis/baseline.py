@@ -1,0 +1,99 @@
+"""Checked-in suppression baseline for rlint.
+
+The baseline is the triage ledger: every *intentional* violation lives
+here with a one-line reason, every genuine one gets fixed instead. The
+gate (tests/test_rlint.py) holds the analyzer at zero unsuppressed
+findings, so a new finding either gets a fix or a reviewed reason — it
+cannot land silently.
+
+Matching is by :attr:`Finding.fingerprint` (rule + file + qualname +
+snippet — line-number independent). Entries that no longer match any
+finding are *stale*: reported as warnings so the file shrinks as code
+improves, but never a failure (deleting code must not break the gate).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+
+from .findings import Finding
+
+__all__ = ["Baseline", "DEFAULT_BASELINE"]
+
+DEFAULT_BASELINE = ".rlint-baseline.json"
+
+
+@dataclass
+class Baseline:
+    suppressions: list = field(default_factory=list)  # dicts with fingerprint+reason
+    fixed: list = field(default_factory=list)         # ledger of violations fixed in PRs
+    path: str | None = None
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        if not os.path.exists(path):
+            return cls(path=path)
+        with open(path) as f:
+            data = json.load(f)
+        bl = cls(
+            suppressions=list(data.get("suppressions", [])),
+            fixed=list(data.get("fixed", [])),
+            path=path,
+        )
+        missing = [s for s in bl.suppressions if not s.get("reason")]
+        if missing:
+            fps = ", ".join(s.get("fingerprint", "?") for s in missing)
+            raise ValueError(
+                f"baseline {path}: every suppression needs a non-empty 'reason' "
+                f"(missing on: {fps})"
+            )
+        return bl
+
+    def save(self, path: str | None = None) -> None:
+        path = path or self.path or DEFAULT_BASELINE
+        data = {
+            "version": 1,
+            "tool": "rlint",
+            "suppressions": sorted(
+                self.suppressions, key=lambda s: (s.get("rule", ""), s.get("file", ""),
+                                                  s.get("fingerprint", "")),
+            ),
+            "fixed": self.fixed,
+        }
+        with open(path, "w") as f:
+            json.dump(data, f, indent=2, sort_keys=False)
+            f.write("\n")
+
+    @property
+    def fingerprints(self) -> set:
+        return {s["fingerprint"] for s in self.suppressions if "fingerprint" in s}
+
+    def split(self, findings: list[Finding]):
+        """(unsuppressed, suppressed, stale_entries)."""
+        fps = self.fingerprints
+        hit: set = set()
+        unsup, sup = [], []
+        for f in findings:
+            if f.fingerprint in fps:
+                hit.add(f.fingerprint)
+                sup.append(f)
+            else:
+                unsup.append(f)
+        stale = [s for s in self.suppressions if s.get("fingerprint") not in hit]
+        return unsup, sup, stale
+
+    def add(self, finding: Finding, reason: str) -> None:
+        if not reason:
+            raise ValueError("a suppression reason is required")
+        if finding.fingerprint in self.fingerprints:
+            return
+        self.suppressions.append({
+            "fingerprint": finding.fingerprint,
+            "rule": finding.rule,
+            "file": finding.file,
+            "qualname": finding.qualname,
+            "snippet": finding.snippet,
+            "reason": reason,
+        })

@@ -1,0 +1,408 @@
+"""AST indexing for rlint: functions, aliases, call graph, hot-path reachability.
+
+The analyzer never imports the code under analysis — everything is
+derived from the AST, so a module with heavyweight import side effects
+(or one that would initialize a JAX backend) costs nothing to lint.
+
+Identity model
+--------------
+Every function/method (including nested defs) becomes a
+:class:`FunctionInfo` keyed by a dotted qualname
+``<module>.<Class>.<method>`` / ``<module>.<func>.<locals>.<inner>``.
+Import statements are folded into a per-module alias map so call
+expressions canonicalize to full dotted paths (``jnp.asarray`` →
+``jax.numpy.asarray``, ``fault_point`` → ``rl_tpu.resilience.faults
+.fault_point``) — cross-module edges fall out of ordinary name lookup.
+
+Hot roots
+---------
+A function is a *hot root* when it is (a) decorated ``@jax.jit`` /
+``@partial(jax.jit, ...)``, (b) passed into ``jax.jit``/``pjit`` or a
+``lax`` control-flow combinator (``scan``/``while_loop``/``fori_loop``/
+``cond``/``switch``/``map``/``associative_scan``) anywhere in its module,
+or (c) decorated :func:`hot_path` — the explicit marker for *host-side*
+dispatch loops (serving decode, collector actor loops) where a stray
+``.item()``/``float()`` stalls the device pipeline even though no tracer
+is in sight. Reachability is the transitive closure over resolved call
+edges; function references passed to other ``jax.*`` transforms
+(``vmap``, ``grad``, ``remat``, …) count as call edges.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+__all__ = ["hot_path", "FunctionInfo", "ModuleIndex", "PackageIndex"]
+
+
+def hot_path(fn=None, *, reason: str = ""):
+    """Mark a host-side function as a hot path for rlint.
+
+    No-op at runtime (returns ``fn`` unchanged); the static analyzer
+    treats decorated functions as R001 roots — anything reachable from
+    them must not host-sync. Usable bare (``@hot_path``) or with a
+    reason (``@hot_path(reason="decode loop")``).
+    """
+    def mark(f):
+        f.__rl_tpu_hot_path__ = reason or True
+        return f
+    return mark(fn) if fn is not None else mark
+
+
+# jax/lax combinators whose function-valued args are traced (arg positions)
+_TRACED_ARG_POSITIONS = {
+    "jax.jit": (0,),
+    "jax.pjit": (0,),
+    "jax.experimental.pjit.pjit": (0,),
+    "jax.lax.scan": (0,),
+    "jax.lax.map": (0,),
+    "jax.lax.associative_scan": (0,),
+    "jax.lax.while_loop": (0, 1),
+    "jax.lax.fori_loop": (2,),
+    "jax.lax.cond": (1, 2),
+}
+# transforms where a function arg becomes callable from the enclosing scope
+_TRANSFORM_PREFIXES = ("jax.",)
+
+_JIT_NAMES = {"jax.jit", "jax.pjit", "jax.experimental.pjit.pjit"}
+_PARTIAL_NAMES = {"functools.partial", "partial"}
+_HOT_PATH_NAMES = {
+    "hot_path",
+    "rl_tpu.analysis.hot_path",
+    "rl_tpu.analysis.core.hot_path",
+    "analysis.hot_path",
+}
+
+
+def canon(node: ast.AST, aliases: dict[str, str]) -> str | None:
+    """Canonical dotted name of a Name/Attribute chain, folding import
+    aliases (``jnp`` → ``jax.numpy``). None for non-name expressions."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(aliases.get(node.id, node.id))
+        return ".".join(reversed(parts))
+    return None
+
+
+def _target_names(target: ast.AST) -> list[str]:
+    """Flat trackable names assigned by a target: ``x``, ``self.x``."""
+    out: list[str] = []
+    if isinstance(target, ast.Name):
+        out.append(target.id)
+    elif isinstance(target, ast.Attribute) and isinstance(target.value, ast.Name):
+        out.append(f"{target.value.id}.{target.attr}")
+    elif isinstance(target, (ast.Tuple, ast.List)):
+        for elt in target.elts:
+            out.extend(_target_names(elt))
+    elif isinstance(target, ast.Starred):
+        out.extend(_target_names(target.value))
+    return out
+
+
+@dataclass
+class FunctionInfo:
+    qualname: str                     # dotted: module.Class.method
+    display: str                      # Class.method (module-relative)
+    file: str
+    node: ast.AST                     # FunctionDef / AsyncFunctionDef / Lambda
+    module: str
+    class_name: str | None = None
+    calls: set = field(default_factory=set)        # resolved callee qualnames
+    hot_root: bool = False
+    hot_kind: str = ""                # "jit" | "scan" | "hot_path" | ...
+    hot_detail: str = ""
+    static_params: set = field(default_factory=set)
+    params: list = field(default_factory=list)
+
+    @property
+    def is_traced_root(self) -> bool:
+        """True for roots whose body runs under a tracer (jit/lax bodies),
+        as opposed to host-side @hot_path loops."""
+        return self.hot_root and self.hot_kind != "hot_path"
+
+
+class ModuleIndex:
+    """Single-file index: aliases, function defs (incl. nested/methods)."""
+
+    def __init__(self, modname: str, path: str, source: str):
+        self.modname = modname
+        self.path = path
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        self.aliases: dict[str, str] = {}
+        self.functions: dict[str, FunctionInfo] = {}      # qualname -> info
+        self.toplevel: dict[str, str] = {}                # simple name -> qualname
+        self.methods: dict[str, dict[str, str]] = {}      # class -> {method: qualname}
+        self._collect_imports()
+        self._collect_functions()
+
+    def snippet(self, node: ast.AST) -> str:
+        ln = getattr(node, "lineno", 0)
+        return self.lines[ln - 1].strip() if 0 < ln <= len(self.lines) else ""
+
+    # -- imports ---------------------------------------------------------------
+
+    def _resolve_relative(self, level: int, module: str | None) -> str:
+        parts = self.modname.split(".")
+        # level=1 → current package (strip the module leaf), 2 → parent, ...
+        base = parts[: max(0, len(parts) - level)]
+        if module:
+            base = base + module.split(".")
+        return ".".join(base)
+
+    def _collect_imports(self) -> None:
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.asname:
+                        self.aliases[a.asname] = a.name
+                    else:
+                        root = a.name.split(".")[0]
+                        self.aliases.setdefault(root, root)
+            elif isinstance(node, ast.ImportFrom):
+                mod = (
+                    self._resolve_relative(node.level, node.module)
+                    if node.level
+                    else (node.module or "")
+                )
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    self.aliases[a.asname or a.name] = f"{mod}.{a.name}" if mod else a.name
+
+    # -- function defs ---------------------------------------------------------
+
+    def _collect_functions(self) -> None:
+        mod = self.modname
+
+        def visit(node: ast.AST, scope: list[str], cls: str | None):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    qual_parts = scope + [child.name]
+                    qualname = ".".join([mod] + qual_parts)
+                    info = FunctionInfo(
+                        qualname=qualname,
+                        display=".".join(qual_parts),
+                        file=self.path,
+                        node=child,
+                        module=mod,
+                        class_name=cls,
+                        params=[a.arg for a in (
+                            child.args.posonlyargs + child.args.args + child.args.kwonlyargs
+                        )],
+                    )
+                    self.functions[qualname] = info
+                    if not scope:
+                        self.toplevel[child.name] = qualname
+                    if cls is not None and len(scope) == 1:
+                        self.methods.setdefault(cls, {})[child.name] = qualname
+                    self._mark_decorator_roots(info)
+                    visit(child, qual_parts, cls)
+                elif isinstance(child, ast.ClassDef):
+                    visit(child, scope + [child.name], child.name)
+                else:
+                    visit(child, scope, cls)
+
+        visit(self.tree, [], None)
+
+    def _mark_decorator_roots(self, info: FunctionInfo) -> None:
+        for dec in getattr(info.node, "decorator_list", []):
+            name = canon(dec, self.aliases)
+            if name in _JIT_NAMES:
+                info.hot_root, info.hot_kind = True, "jit"
+                info.hot_detail = "@jax.jit"
+            elif name in _HOT_PATH_NAMES:
+                info.hot_root, info.hot_kind = True, "hot_path"
+                info.hot_detail = "@hot_path"
+            elif isinstance(dec, ast.Call):
+                cname = canon(dec.func, self.aliases)
+                if cname in _HOT_PATH_NAMES:
+                    info.hot_root, info.hot_kind = True, "hot_path"
+                    info.hot_detail = "@hot_path(...)"
+                elif cname in _JIT_NAMES:
+                    info.hot_root, info.hot_kind = True, "jit"
+                    info.hot_detail = "@jax.jit(...)"
+                    info.static_params |= self._static_names(dec, info)
+                elif cname in _PARTIAL_NAMES and dec.args:
+                    inner = canon(dec.args[0], self.aliases)
+                    if inner in _JIT_NAMES:
+                        info.hot_root, info.hot_kind = True, "jit"
+                        info.hot_detail = "@partial(jax.jit, ...)"
+                        info.static_params |= self._static_names(dec, info)
+
+    def _static_names(self, call: ast.Call, info: FunctionInfo) -> set:
+        out: set = set()
+        for kw in call.keywords:
+            if kw.arg == "static_argnames":
+                for n in ast.walk(kw.value):
+                    if isinstance(n, ast.Constant) and isinstance(n.value, str):
+                        out.add(n.value)
+            elif kw.arg == "static_argnums":
+                for n in ast.walk(kw.value):
+                    if isinstance(n, ast.Constant) and isinstance(n.value, int):
+                        if 0 <= n.value < len(info.params):
+                            out.add(info.params[n.value])
+        return out
+
+
+class PackageIndex:
+    """Whole-package index + call graph + hot-path reachability."""
+
+    def __init__(self, modules: list[ModuleIndex]):
+        self.modules = modules
+        self.functions: dict[str, FunctionInfo] = {}
+        self.methods_by_name: dict[str, list[str]] = {}
+        for m in modules:
+            self.functions.update(m.functions)
+        for m in modules:
+            for cls, meths in m.methods.items():
+                for name, qual in meths.items():
+                    self.methods_by_name.setdefault(name, []).append(qual)
+        for m in modules:
+            self._link_module(m)
+        self.hot_from: dict[str, str] = {}
+        self._compute_reachability()
+
+    # -- resolution ------------------------------------------------------------
+
+    def resolve_call(self, m: ModuleIndex, fn: FunctionInfo | None,
+                     func_node: ast.AST) -> str | None:
+        """Resolve a call expression to a known function qualname."""
+        name = canon(func_node, m.aliases)
+        if name is not None:
+            if name in self.functions:
+                return name
+            # module-local bare name (possibly nested sibling)
+            if "." not in name and name in m.toplevel:
+                return m.toplevel[name]
+            if fn is not None and "." not in name:
+                # nested def inside the same enclosing function
+                nested = f"{fn.qualname}.{name}"
+                if nested in self.functions:
+                    return nested
+        if isinstance(func_node, ast.Attribute):
+            attr = func_node.attr
+            if isinstance(func_node.value, ast.Name) and func_node.value.id == "self":
+                if fn is not None and fn.class_name and fn.class_name in m.methods:
+                    q = m.methods[fn.class_name].get(attr)
+                    if q:
+                        return q
+            # unique-method heuristic: exactly one definition package-wide
+            cands = self.methods_by_name.get(attr, [])
+            if len(cands) == 1 and not attr.startswith("__"):
+                return cands[0]
+        return None
+
+    def resolve_func_ref(self, m: ModuleIndex, fn: FunctionInfo | None,
+                         node: ast.AST) -> str | None:
+        """Resolve a *function reference* (not a call): Name / self.attr.
+        ``self._f`` also tries the ``_f_impl``-style method directly."""
+        if isinstance(node, ast.Lambda):
+            return None
+        return self.resolve_call(m, fn, node)
+
+    # -- linking ---------------------------------------------------------------
+
+    def _enclosing_fn(self, m: ModuleIndex, stack: list[FunctionInfo]) -> FunctionInfo | None:
+        return stack[-1] if stack else None
+
+    def _link_module(self, m: ModuleIndex) -> None:
+        """Populate call edges and usage-site hot roots for one module."""
+
+        index = self
+
+        class Linker(ast.NodeVisitor):
+            def __init__(self):
+                self.stack: list[FunctionInfo] = []
+
+            def _info_for(self, node):
+                for info in m.functions.values():
+                    if info.node is node:
+                        return info
+                return None
+
+            def visit_FunctionDef(self, node):
+                info = self._info_for(node)
+                if info is None:
+                    return
+                self.stack.append(info)
+                self.generic_visit(node)
+                self.stack.pop()
+
+            visit_AsyncFunctionDef = visit_FunctionDef
+
+            def visit_Call(self, node):
+                fn = self.stack[-1] if self.stack else None
+                callee = index.resolve_call(m, fn, node.func)
+                if callee is not None and fn is not None:
+                    fn.calls.add(callee)
+                cname = canon(node.func, m.aliases)
+                if cname is not None:
+                    positions = _TRACED_ARG_POSITIONS.get(cname)
+                    if positions is not None:
+                        for pos in positions:
+                            if pos < len(node.args):
+                                index._mark_usage_root(m, fn, node.args[pos], cname, node)
+                        if cname in _JIT_NAMES and node.args:
+                            index._attach_static(m, fn, node)
+                    elif cname.startswith(_TRANSFORM_PREFIXES):
+                        # other jax transforms: function args become call edges
+                        for a in node.args:
+                            ref = index.resolve_func_ref(m, fn, a)
+                            if ref is not None and fn is not None:
+                                fn.calls.add(ref)
+                    elif cname in _PARTIAL_NAMES and node.args:
+                        inner = canon(node.args[0], m.aliases)
+                        if inner in _JIT_NAMES and len(node.args) > 1:
+                            index._mark_usage_root(m, fn, node.args[1], inner, node)
+                self.generic_visit(node)
+
+        Linker().visit(m.tree)
+
+    def _mark_usage_root(self, m: ModuleIndex, fn: FunctionInfo | None,
+                         arg: ast.AST, via: str, call: ast.Call) -> None:
+        if isinstance(arg, (ast.List, ast.Tuple)):        # lax.switch branches
+            for elt in arg.elts:
+                self._mark_usage_root(m, fn, elt, via, call)
+            return
+        ref = self.resolve_func_ref(m, fn, arg)
+        if ref is None:
+            # a lambda or unresolvable expression; treat lambda body as an
+            # extension of the enclosing function (already visited)
+            return
+        info = self.functions[ref]
+        if not info.hot_root:
+            info.hot_root = True
+            info.hot_kind = "jit" if via in _JIT_NAMES else "scan"
+            info.hot_detail = f"passed to {via} at {m.path}:{call.lineno}"
+        if via in _JIT_NAMES:
+            info.static_params |= m._static_names(call, info)
+
+    def _attach_static(self, m: ModuleIndex, fn: FunctionInfo | None,
+                       call: ast.Call) -> None:
+        ref = self.resolve_func_ref(m, fn, call.args[0])
+        if ref is not None:
+            info = self.functions[ref]
+            info.static_params |= m._static_names(call, info)
+
+    # -- reachability ----------------------------------------------------------
+
+    def _compute_reachability(self) -> None:
+        frontier = [q for q, f in self.functions.items() if f.hot_root]
+        for q in frontier:
+            self.hot_from[q] = self.functions[q].hot_detail or self.functions[q].hot_kind
+        while frontier:
+            q = frontier.pop()
+            for callee in self.functions[q].calls:
+                if callee not in self.hot_from:
+                    src = self.functions[q]
+                    self.hot_from[callee] = f"called from hot {src.display} ({src.module})"
+                    frontier.append(callee)
+
+    def is_hot(self, qualname: str) -> bool:
+        return qualname in self.hot_from

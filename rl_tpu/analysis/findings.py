@@ -1,0 +1,47 @@
+"""Finding record + stable fingerprints for baseline suppression.
+
+A finding's fingerprint deliberately ignores the line number: baselines
+must survive unrelated edits above the flagged site. Identity is the
+(rule, file, enclosing qualname, normalized source snippet) tuple — the
+same violation moving a few lines keeps its suppression; a *new* call
+site with identical text inside the same function is (correctly) treated
+as already-triaged, because the reviewer's reason applies to it verbatim.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import asdict, dataclass, field
+
+__all__ = ["Finding"]
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str          # "R001".."R005"
+    file: str          # repo-relative path ("rl_tpu/x/y.py")
+    line: int
+    qualname: str      # enclosing function ("Class.method", "func.<locals>.g") or lock-cycle id
+    message: str
+    snippet: str = ""  # stripped source line of the flagged node
+    col: int = 0
+    extra: dict = field(default_factory=dict, compare=False, hash=False)
+
+    @property
+    def fingerprint(self) -> str:
+        h = hashlib.sha1(
+            "|".join((self.rule, self.file, self.qualname, self.snippet)).encode()
+        )
+        return h.hexdigest()[:12]
+
+    def to_dict(self) -> dict:
+        d = asdict(self)
+        d.pop("extra", None)
+        d["fingerprint"] = self.fingerprint
+        return d
+
+    def format(self) -> str:
+        return (
+            f"{self.file}:{self.line}: {self.rule} [{self.qualname}] "
+            f"{self.message}  [{self.fingerprint}]"
+        )

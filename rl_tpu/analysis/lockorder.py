@@ -1,0 +1,285 @@
+"""R005: static lock-order analysis.
+
+Builds the package-wide lock-acquisition graph and fails on cycles.
+
+Lock identity is the *creation site class/module attribute*
+(``Supervisor._lock``, ``MetricsRegistry._lock``) — every instance of a
+class shares one node, which is exactly the granularity a lock-order
+discipline is stated at ("never take the registry lock while holding a
+scheme lock"). Edges come from two sources:
+
+- **lexical nesting**: a ``with self._b:`` inside a ``with self._a:``
+  block adds a→b;
+- **one-hop-closed calls**: a call inside a ``with a:`` block to a
+  function whose *transitive* lock summary contains b adds a→b (the
+  summary is a fixpoint over the resolved call graph, so chains through
+  helpers are caught).
+
+Self-edges are only reported for *lexically* nested acquisitions of a
+non-reentrant ``threading.Lock`` (same attribute under itself is a
+guaranteed deadlock); call-derived self-edges are ignored because two
+*instances* of the same class may legitimately nest (e.g. a fleet
+iterating its members).
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+from .core import FunctionInfo, ModuleIndex, PackageIndex, canon
+from .findings import Finding
+
+__all__ = ["run_lockorder", "collect_locks"]
+
+_LOCK_CTORS = {"threading.Lock": "Lock", "threading.RLock": "RLock"}
+
+
+@dataclass
+class LockDef:
+    lock_id: str       # "Class.attr" or "module.NAME"
+    kind: str          # "Lock" | "RLock"
+    file: str
+    line: int
+
+
+@dataclass
+class _Graph:
+    edges: dict = field(default_factory=dict)  # a -> {b: (file, line, snippet)}
+
+    def add(self, a: str, b: str, site: tuple) -> None:
+        self.edges.setdefault(a, {}).setdefault(b, site)
+
+
+def collect_locks(modules: list[ModuleIndex]) -> dict[str, LockDef]:
+    """All threading.Lock/RLock creation sites, keyed by lock id."""
+    locks: dict[str, LockDef] = {}
+
+    def ctor_kind(value: ast.AST, m: ModuleIndex) -> str | None:
+        if isinstance(value, ast.Call):
+            return _LOCK_CTORS.get(canon(value.func, m.aliases))
+        return None
+
+    for m in modules:
+        # class attributes + self.<attr> = Lock() inside methods
+        for node in ast.walk(m.tree):
+            if isinstance(node, ast.ClassDef):
+                cls = node.name
+                for sub in ast.walk(node):
+                    if not isinstance(sub, ast.Assign):
+                        continue
+                    kind = ctor_kind(sub.value, m)
+                    if kind is None:
+                        continue
+                    for t in sub.targets:
+                        attr = None
+                        if isinstance(t, ast.Attribute) and isinstance(t.value, ast.Name) \
+                                and t.value.id == "self":
+                            attr = t.attr
+                        elif isinstance(t, ast.Name):
+                            attr = t.id
+                        if attr is not None:
+                            lid = f"{cls}.{attr}"
+                            locks.setdefault(
+                                lid, LockDef(lid, kind, m.path, sub.lineno)
+                            )
+        # module-level locks
+        for node in m.tree.body:
+            if isinstance(node, ast.Assign):
+                kind = ctor_kind(node.value, m)
+                if kind is None:
+                    continue
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        lid = f"{m.modname}.{t.id}"
+                        locks.setdefault(lid, LockDef(lid, kind, m.path, node.lineno))
+    return locks
+
+
+class _LockPass:
+    def __init__(self, index: PackageIndex):
+        self.index = index
+        self.locks = collect_locks(index.modules)
+        # attr name -> lock ids defining it (for cross-class binding)
+        self.by_attr: dict[str, list[str]] = {}
+        for lid in self.locks:
+            attr = lid.rsplit(".", 1)[-1]
+            self.by_attr.setdefault(attr, []).append(lid)
+        self.direct: dict[str, set] = {}       # fn qualname -> lock ids acquired directly
+        self.summary: dict[str, set] = {}      # transitive (fixpoint)
+        self.graph = _Graph()
+        self.sites: dict[str, tuple] = {}      # lock id -> example acquisition site
+
+    # -- binding ---------------------------------------------------------------
+
+    def bind(self, expr: ast.AST, m: ModuleIndex, fn: FunctionInfo) -> str | None:
+        """Lock id for an acquisition expression (with-context or
+        .acquire() receiver)."""
+        if isinstance(expr, ast.Attribute):
+            attr = expr.attr
+            if isinstance(expr.value, ast.Name) and expr.value.id == "self" and fn.class_name:
+                lid = f"{fn.class_name}.{attr}"
+                if lid in self.locks:
+                    return lid
+            name = canon(expr, m.aliases)
+            if name is not None:
+                # Class.ATTR or module.NAME reference
+                tail2 = ".".join(name.split(".")[-2:])
+                if tail2 in self.locks:
+                    return tail2
+                if name in self.locks:
+                    return name
+            cands = self.by_attr.get(attr, [])
+            if len(cands) == 1:
+                return cands[0]
+        elif isinstance(expr, ast.Name):
+            cands = self.by_attr.get(expr.id, [])
+            if len(cands) == 1:
+                return cands[0]
+        return None
+
+    # -- per-function direct info ---------------------------------------------
+
+    def _acquisitions(self, m: ModuleIndex, fn: FunctionInfo):
+        """Yield (lock_id, with_node | call_node, kind) for every acquisition
+        in fn: kind 'with' (scoped) or 'acquire' (unscoped)."""
+        def walk(node):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                    continue
+                if isinstance(child, (ast.With, ast.AsyncWith)):
+                    for item in child.items:
+                        lid = self.bind(item.context_expr, m, fn)
+                        if lid is not None:
+                            yield lid, child, "with"
+                elif isinstance(child, ast.Call) and isinstance(child.func, ast.Attribute) \
+                        and child.func.attr == "acquire":
+                    lid = self.bind(child.func.value, m, fn)
+                    if lid is not None:
+                        yield lid, child, "acquire"
+                yield from walk(child)
+        yield from walk(fn.node)
+
+    def compute_direct(self) -> None:
+        for m in self.index.modules:
+            for fn in m.functions.values():
+                acq = set()
+                for lid, node, _kind in self._acquisitions(m, fn):
+                    acq.add(lid)
+                    self.sites.setdefault(lid, (m.path, node.lineno, m.snippet(node)))
+                if acq:
+                    self.direct[fn.qualname] = acq
+
+    def compute_summaries(self) -> None:
+        self.summary = {q: set(v) for q, v in self.direct.items()}
+        changed = True
+        while changed:
+            changed = False
+            for q, fn in self.index.functions.items():
+                cur = self.summary.get(q, set())
+                new = set(cur)
+                for callee in fn.calls:
+                    new |= self.summary.get(callee, set())
+                if new != cur:
+                    self.summary[q] = new
+                    changed = True
+
+    # -- edges -----------------------------------------------------------------
+
+    def compute_edges(self) -> list[Finding]:
+        lexical_self: list[Finding] = []
+        for m in self.index.modules:
+            for fn in m.functions.values():
+                self._edges_in(m, fn, fn.node, held=[], out=lexical_self)
+        return lexical_self
+
+    def _edges_in(self, m: ModuleIndex, fn: FunctionInfo, node: ast.AST,
+                  held: list[str], out: list[Finding]) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                continue
+            if isinstance(child, (ast.With, ast.AsyncWith)):
+                acquired = []
+                for item in child.items:
+                    lid = self.bind(item.context_expr, m, fn)
+                    if lid is None:
+                        continue
+                    site = (m.path, child.lineno, m.snippet(child))
+                    for h in held:
+                        if h == lid:
+                            if self.locks[lid].kind == "Lock":
+                                out.append(Finding(
+                                    rule="R005", file=m.path, line=child.lineno,
+                                    qualname=fn.display, snippet=m.snippet(child),
+                                    message=(
+                                        f"non-reentrant lock {lid} re-acquired while "
+                                        "held (self-deadlock)"
+                                    ),
+                                ))
+                        else:
+                            self.graph.add(h, lid, site)
+                    acquired.append(lid)
+                self._edges_in(m, fn, child, held + acquired, out)
+                continue
+            if held and isinstance(child, ast.Call):
+                callee = self.index.resolve_call(m, fn, child.func)
+                if callee is not None:
+                    for lid in self.summary.get(callee, ()):  # transitive
+                        for h in held:
+                            if h != lid:
+                                self.graph.add(
+                                    h, lid,
+                                    (m.path, child.lineno, m.snippet(child)),
+                                )
+            self._edges_in(m, fn, child, held, out)
+
+    # -- cycles ----------------------------------------------------------------
+
+    def find_cycles(self) -> list[list[str]]:
+        """Elementary cycles via DFS over SCCs (graph is tiny)."""
+        edges = {a: set(bs) for a, bs in self.graph.edges.items()}
+        cycles: list[list[str]] = []
+        seen_keys: set = set()
+
+        def dfs(start, node, path, visited):
+            for nxt in sorted(edges.get(node, ())):
+                if nxt == start and len(path) > 0:
+                    cyc = path + [start]
+                    lo = min(range(len(cyc) - 1), key=lambda i: cyc[i])
+                    norm = tuple(cyc[lo:-1] + cyc[:lo])
+                    if norm not in seen_keys:
+                        seen_keys.add(norm)
+                        cycles.append(list(norm) + [norm[0]])
+                elif nxt not in visited and nxt > start:
+                    dfs(start, nxt, path + [nxt], visited | {nxt})
+
+        for a in sorted(edges):
+            dfs(a, a, [a], {a})
+        return cycles
+
+
+def run_lockorder(index: PackageIndex) -> list[Finding]:
+    p = _LockPass(index)
+    p.compute_direct()
+    p.compute_summaries()
+    findings = p.compute_edges()       # lexical self-deadlocks
+    for cyc in p.find_cycles():
+        pairs = list(zip(cyc, cyc[1:]))
+        sites = [p.graph.edges[a][b] for a, b in pairs]
+        where = "; ".join(f"{a}->{b} at {s[0]}:{s[1]}" for (a, b), s in zip(pairs, sites))
+        first = sites[0]
+        findings.append(Finding(
+            rule="R005", file=first[0], line=first[1],
+            qualname="lock-order", snippet=" -> ".join(cyc),
+            message=f"lock-order cycle: {where}",
+        ))
+    return findings
+
+
+def lock_edges(index: PackageIndex) -> dict:
+    """Debug/introspection: the full lock graph ({a: {b: site}})."""
+    p = _LockPass(index)
+    p.compute_direct()
+    p.compute_summaries()
+    p.compute_edges()
+    return p.graph.edges

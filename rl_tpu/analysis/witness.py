@@ -1,0 +1,221 @@
+"""LockWitness: runtime lock-order sanitizer.
+
+The static pass (R005) sees what the AST can prove; the witness sees
+what the program actually does. While armed, ``threading.Lock`` /
+``threading.RLock`` construction returns witnessed wrappers that record,
+per creation site, the observed held-while-acquiring graph across every
+thread. Acquiring B while holding A adds the edge A→B; the moment both
+A→B and B→A have been observed (by any two threads), the pair is flagged
+as an *inversion* — a latent deadlock, even if this run got lucky with
+the interleaving. This is the ThreadSanitizer lock-order idea scoped to
+CPython's threading module.
+
+Identity is the creation *site* (``file:line``), matching the static
+pass's class-attribute granularity: every ``self._lock =
+threading.Lock()`` in a class maps to one node no matter how many
+instances exist. Reentrant re-acquisition of the same site is ignored,
+as are sibling *instances* from one site acquired together (fleet
+iterating members) — only cross-site order flips are inversions.
+
+Wrappers keep ``threading.Condition`` (and thus ``queue.Queue``)
+working: ``_release_save``/``_acquire_restore``/``_is_owned`` are
+implemented so a condition wait keeps the per-thread held-stack in sync
+with the real lock state.
+"""
+
+from __future__ import annotations
+
+import threading
+import traceback
+
+__all__ = ["LockWitness", "WitnessedLock"]
+
+
+class WitnessedLock:
+    """Wrapper around a real Lock/RLock recording acquisition order."""
+
+    def __init__(self, witness: "LockWitness", inner, site: str):
+        self._witness = witness
+        self._inner = inner
+        self._site = site
+
+    # -- core protocol ---------------------------------------------------------
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            self._witness._note_acquire(self._site)
+        return ok
+
+    def release(self):
+        self._inner.release()
+        self._witness._note_release(self._site)
+
+    def locked(self):
+        return self._inner.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def __repr__(self):
+        return f"<WitnessedLock {self._site} wrapping {self._inner!r}>"
+
+    # -- threading.Condition integration --------------------------------------
+    # Condition lifts these from its lock when present; implementing them
+    # keeps the witness's held-stack consistent across cond.wait().
+
+    def _release_save(self):
+        if hasattr(self._inner, "_release_save"):
+            state = self._inner._release_save()
+        else:
+            self._inner.release()
+            state = None
+        self._witness._note_release(self._site)
+        return state
+
+    def _acquire_restore(self, state):
+        if hasattr(self._inner, "_acquire_restore"):
+            self._inner._acquire_restore(state)
+        else:
+            self._inner.acquire()
+        self._witness._note_acquire(self._site)
+
+    def _is_owned(self):
+        if hasattr(self._inner, "_is_owned"):
+            return self._inner._is_owned()
+        if self._inner.acquire(False):
+            self._inner.release()
+            return False
+        return True
+
+
+class LockWitness:
+    """Observes lock-acquisition order process-wide while armed.
+
+    >>> w = LockWitness()
+    >>> w.arm()
+    >>> try: ...        # run the threaded workload
+    ... finally: w.disarm()
+    >>> assert not w.inversions()
+    """
+
+    def __init__(self, capture_stacks: bool = False):
+        self._meta = threading.Lock()    # guards graph/inversions (created pre-arm)
+        self._tls = threading.local()
+        self._graph: dict[str, dict[str, str]] = {}   # a -> {b: example}
+        self._inversions: list[dict] = []
+        self._inversion_keys: set = set()
+        self._armed = False
+        self._orig: tuple | None = None
+        self._capture_stacks = capture_stacks
+        self._n_locks = 0
+
+    # -- arming ----------------------------------------------------------------
+
+    def _creation_site(self) -> str:
+        # nearest frame outside this module and the stdlib lock plumbing
+        for fr in reversed(traceback.extract_stack()[:-2]):
+            fn = fr.filename
+            if fn != __file__ and not fn.endswith(("threading.py", "queue.py")):
+                return f"{fn}:{fr.lineno}"
+        return "unknown:0"
+
+    def make_lock(self):
+        self._n_locks += 1
+        return WitnessedLock(self, self._orig_lock(), self._creation_site())
+
+    def make_rlock(self):
+        self._n_locks += 1
+        return WitnessedLock(self, self._orig_rlock(), self._creation_site())
+
+    def _orig_lock(self):
+        return (self._orig[0] if self._orig else threading.Lock)()
+
+    def _orig_rlock(self):
+        return (self._orig[1] if self._orig else threading.RLock)()
+
+    def arm(self) -> "LockWitness":
+        if self._armed:
+            return self
+        self._orig = (threading.Lock, threading.RLock)
+        threading.Lock = self.make_lock        # type: ignore[assignment]
+        threading.RLock = self.make_rlock      # type: ignore[assignment]
+        self._armed = True
+        return self
+
+    def disarm(self) -> None:
+        if not self._armed:
+            return
+        threading.Lock, threading.RLock = self._orig  # type: ignore[assignment]
+        self._orig = None
+        self._armed = False
+
+    def __enter__(self):
+        return self.arm()
+
+    def __exit__(self, *exc):
+        self.disarm()
+        return False
+
+    # -- recording -------------------------------------------------------------
+
+    def _held(self) -> list:
+        stack = getattr(self._tls, "stack", None)
+        if stack is None:
+            stack = self._tls.stack = []
+        return stack
+
+    def _note_acquire(self, site: str) -> None:
+        held = self._held()
+        new_edges = [h for h in held if h != site]
+        if new_edges:
+            tname = threading.current_thread().name
+            where = (
+                "".join(traceback.format_stack(limit=8)[:-2])
+                if self._capture_stacks else tname
+            )
+            with self._meta:
+                for h in new_edges:
+                    self._graph.setdefault(h, {}).setdefault(site, where)
+                    back = self._graph.get(site, {})
+                    if h in back:
+                        key = frozenset((h, site))
+                        if key not in self._inversion_keys:
+                            self._inversion_keys.add(key)
+                            self._inversions.append({
+                                "locks": tuple(sorted((h, site))),
+                                "a_then_b": back[h],
+                                "b_then_a": where,
+                            })
+        held.append(site)
+
+    def _note_release(self, site: str) -> None:
+        held = self._held()
+        # out-of-order release: drop the most recent matching entry
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] == site:
+                del held[i]
+                return
+
+    # -- reporting -------------------------------------------------------------
+
+    def inversions(self) -> list[dict]:
+        with self._meta:
+            return list(self._inversions)
+
+    def edges(self) -> dict:
+        with self._meta:
+            return {a: dict(bs) for a, bs in self._graph.items()}
+
+    def stats(self) -> dict:
+        with self._meta:
+            return {
+                "locks_witnessed": self._n_locks,
+                "edges": sum(len(b) for b in self._graph.values()),
+                "inversions": len(self._inversions),
+            }

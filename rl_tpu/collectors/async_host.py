@@ -40,6 +40,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..analysis import hot_path
 from ..data import ArrayDict
 from ..obs import get_registry, get_tracer
 from ..utils.seeding import seed_generator
@@ -261,6 +262,7 @@ class AsyncHostCollector:
         except BaseException as e:  # surfaced to the trainer via get_batch
             self._error = e
 
+    @hot_path(reason="background env-stepping actor thread")
     def _collect_loop(self) -> None:
         from ..resilience.faults import fault_point
 

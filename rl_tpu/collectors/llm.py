@@ -16,6 +16,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..analysis import hot_path
 from ..data import ArrayDict
 from ..envs.llm.chat import DatasetChatEnv
 from ..models import generate
@@ -91,6 +92,7 @@ class LLMCollector:
                 lambda toks, mask: token_log_probs(model, ref_params, toks, mask)
             )
 
+    @hot_path(reason="drives the engine decode loop per rollout batch")
     def _engine_generate(self, params, toks, pmask, key, on_row_done=None):
         """Continuous-batching rollout shaped like ``generate``'s output:
         the G requests stream through engine slots; early-eos rows free
@@ -127,6 +129,9 @@ class LLMCollector:
         # the per-call key drives sampling (key-deterministic, like the
         # fixed-batch path): fold it into the engine's stream
         eng._key = jax.random.fold_in(key, 0)
+        # env batches arrive host-side; np.asarray is a no-op there. A
+        # device array here would mean a blocking d2h of data the caller
+        # just uploaded — keep prompts on the host until the final concat.
         toks_np = np.asarray(toks)
         mask_np = np.asarray(pmask) > 0
         rids = [
@@ -159,7 +164,7 @@ class LLMCollector:
         _absorb(eng.harvest())
         if rid_row:
             raise RuntimeError(f"engine lost requests: {sorted(rid_row)}")
-        full = jnp.concatenate([toks, jnp.asarray(resp)], axis=1)
+        full = jnp.concatenate([jnp.asarray(toks_np), jnp.asarray(resp)], axis=1)
         full_mask = jnp.concatenate(
             [jnp.asarray(mask_np), jnp.asarray(rmask)], axis=1
         )
@@ -214,12 +219,16 @@ class LLMCollector:
                 raise ValueError("params=None requires a weight_scheme to pull from")
             params = self.weight_scheme.pull()
         state, group_ids = self.env.sample_batch(self.num_prompts)
-        toks = jnp.asarray(state["tokens"])
-        pmask = jnp.asarray(state["attention_mask"], jnp.float32)
+        toks = np.asarray(state["tokens"])
+        pmask = np.asarray(state["attention_mask"], np.float32)
         if self.continuous_batching:
+            # the engine consumes prompts on the host (slot-packing and
+            # submit copies) — handing it a device array would round-trip
+            # the freshly-uploaded batch straight back through a blocking
+            # transfer, so the upload happens once, inside _engine_generate
             out, rewards = self._engine_collect(params, toks, pmask, key, state, group_ids)
         else:
-            out = self._gen(params, toks, pmask, key)
+            out = self._gen(params, jnp.asarray(toks), jnp.asarray(pmask), key)
             rewards = None
 
         resp = np.asarray(out.response_tokens)

@@ -351,9 +351,10 @@ class StalenessAwareSampler(Sampler):
         csum = jnp.cumsum(w)
         # fall back to uniform-over-filled when everything is gated out
         any_mass = csum[-1] > 0
-        u = jax.random.uniform(key, (batch_size,)) * jnp.where(any_mass, csum[-1], 1.0)
+        k_w, k_u = jax.random.split(key)
+        u = jax.random.uniform(k_w, (batch_size,)) * jnp.where(any_mass, csum[-1], 1.0)
         idx_w = jnp.clip(jnp.searchsorted(csum, u, side="right"), 0, capacity - 1)
-        idx_u = jax.random.randint(key, (batch_size,), 0, jnp.maximum(size, 1))
+        idx_u = jax.random.randint(k_u, (batch_size,), 0, jnp.maximum(size, 1))
         idx = jnp.where(any_mass, idx_w, idx_u)
         info = ArrayDict(staleness=stal_all[idx])
         return idx, info, sstate

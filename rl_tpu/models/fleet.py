@@ -64,6 +64,7 @@ from typing import Any
 
 import numpy as np
 
+from ..analysis import hot_path
 from ..comm.liveness import Watchdog
 from ..resilience.faults import fault_point, register_site, should_drop
 from .serving import (
@@ -552,6 +553,7 @@ class ServingFleet:
 
         return on_admit
 
+    @hot_path(reason="per-replica decode loop thread")
     def _member_loop(self, m: _Member) -> None:
         eng = m.engine
         while not self._stop.is_set():

@@ -51,6 +51,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..analysis import hot_path
 from ..obs.device import DeviceMetrics
 
 __all__ = [
@@ -761,6 +762,7 @@ class ContinuousBatchingEngine:
         except Exception:
             return True  # no readiness probe: treat as ready (drain early)
 
+    @hot_path(reason="continuous-batching decode dispatch loop")
     def step(self) -> bool:
         """Admit + dispatch one decode chunk, then accept the PREVIOUS
         chunk's tokens while the new one runs (double buffering). Returns
@@ -1135,6 +1137,7 @@ class ServingService:
 
     # -- stepper ---------------------------------------------------------------
 
+    @hot_path(reason="serving stepper thread")
     def _loop(self):
         import time as _time
         import traceback as _tb
@@ -1160,6 +1163,7 @@ class ServingService:
             if not busy:
                 _time.sleep(0.005)
 
+    @hot_path(reason="serving stepper thread (supervised)")
     def _loop_supervised(self):
         """Supervised variant: let exceptions escape so the supervisor
         restarts the stepper instead of recording-and-wedging."""

@@ -43,6 +43,7 @@ import numpy as np
 import optax
 from jax.sharding import NamedSharding, PartitionSpec
 
+from ..analysis import hot_path
 from ..collectors.llm import LLMCollector
 from ..data import ArrayDict
 from ..data.llm.tokenizer import SimpleTokenizer
@@ -442,6 +443,7 @@ class GRPOTrainer:
             out["engine"] = eng.metrics_snapshot()
         return out
 
+    @hot_path(reason="per-iteration GRPO train step")
     def step(self) -> dict[str, float]:
         """collect → update → push weights. Returns step metrics."""
         self._key, k = jax.random.split(self._key)
@@ -644,6 +646,7 @@ class RolloutPipeline:
         except BaseException as e:  # surfaced on the consumer's next get
             self._error = e
 
+    @hot_path(reason="pipelined rollout producer thread")
     def _produce(self):
         from ..resilience.faults import fault_point
 
@@ -752,6 +755,7 @@ class PipelinedGRPOTrainer(GRPOTrainer):
             self._key = self._pipeline._key
         self.close()
 
+    @hot_path(reason="pipelined GRPO consumer step")
     def step(self) -> dict[str, float]:
         batch, version = self._ensure_pipeline().get()
         staleness = self.scheme.version - version

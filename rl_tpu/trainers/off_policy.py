@@ -18,6 +18,7 @@ import jax
 import jax.numpy as jnp
 import optax
 
+from ..analysis import hot_path
 from ..data import ArrayDict, ReplayBuffer
 from ..collectors.single import Collector
 from ..objectives.common import LossModule, SoftUpdate
@@ -506,6 +507,7 @@ class AsyncOffPolicyTrainer(_GradUpdateMixin):
 
     # -- host loop -------------------------------------------------------------
 
+    @hot_path(reason="async off-policy train loop")
     def train(
         self,
         ts: dict,

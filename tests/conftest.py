@@ -67,6 +67,45 @@ def pytest_collection_modifyitems(items):
             it.add_marker(pytest.mark.smoke)
 
 
+@pytest.fixture(autouse=True)
+def _hot_path_transfer_guard(request):
+    """``@pytest.mark.hot_path_guard``: run the test body under
+    ``jax.transfer_guard("disallow")`` so any implicit device↔host
+    transfer (the runtime shadow of rlint's R001) raises instead of
+    silently serializing. Explicit ``jax.device_get``/``device_put``
+    stay allowed — the guard targets *implicit* syncs."""
+    if request.node.get_closest_marker("hot_path_guard") is None:
+        yield
+        return
+    with jax.transfer_guard("disallow"):
+        yield
+
+
+@pytest.fixture
+def lock_witness():
+    """Arm the rlint LockWitness for the duration of a test: every
+    ``threading.Lock``/``RLock`` *created during the test* is wrapped to
+    record the observed lock-order graph. Teardown disarms and fails the
+    test on any observed lock-order inversion (latent deadlock)."""
+    from rl_tpu.analysis import LockWitness
+
+    w = LockWitness()
+    w.arm()
+    try:
+        yield w
+    finally:
+        w.disarm()
+        inv = w.inversions()
+        assert not inv, (
+            "lock-order inversion(s) observed (latent deadlock): "
+            + "; ".join(
+                f"{a} vs {b} (A→B on {i['a_then_b']}, B→A on {i['b_then_a']})"
+                for i in inv
+                for a, b in [i["locks"]]
+            )
+        )
+
+
 @pytest.fixture
 def rng():
     return jax.random.key(0)

@@ -27,6 +27,10 @@ from rl_tpu.resilience import (
 )
 from rl_tpu.trainers.resilience import PreemptionHandler
 
+# rlint runtime sanitizer: every lock created inside these tests is
+# witnessed; any observed lock-order inversion fails the test at teardown
+pytestmark = pytest.mark.usefixtures("lock_witness")
+
 
 class _HostEnv:
     """Pure-host toy env (the test_async_offpolicy fixture shape)."""

@@ -28,6 +28,10 @@ from rl_tpu.models.fleet import DEAD, HEALTHY, QUARANTINED
 from rl_tpu.obs import MetricsRegistry
 from rl_tpu.resilience import SITES, Fault, FaultInjector, injection
 
+# rlint runtime sanitizer: every lock created inside these tests is
+# witnessed; any observed lock-order inversion fails the test at teardown
+pytestmark = pytest.mark.usefixtures("lock_witness")
+
 KEY = jax.random.key(0)
 
 

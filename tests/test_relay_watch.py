@@ -287,6 +287,51 @@ def test_no_metrics_sections_no_metrics_file(tmp_path):
     assert runner.commits[0][0] == [art]
 
 
+def test_rlint_artifact_refreshed_and_committed(tmp_path):
+    """PR-8: a runner exposing ``rlint`` gets the static-analysis summary
+    refreshed after the bench and committed in the SAME commit as the perf
+    artifacts — the findings ledger always matches the measured tree. A
+    nonzero rlint rc (unsuppressed findings) still commits the artifact so
+    the regression is visible in-tree."""
+
+    class RlintRunner(FakeRunner):
+        def __init__(self, probes, rc=0):
+            super().__init__(probes)
+            self.rlint_calls = []
+            self.rc = rc
+
+        def rlint(self, artifact, timeout=300.0):
+            self.rlint_calls.append(artifact)
+            with open(artifact, "w") as f:
+                json.dump({"tool": "rlint",
+                           "total": {"unsuppressed": 1 if self.rc else 0}}, f)
+            return self.rc, "rlint: ..."
+
+    for rc in (0, 1):
+        runner = RlintRunner([_healthy()], rc=rc)
+        art = str(tmp_path / f"bench_{rc}.jsonl")
+        rlart = str(tmp_path / f"RLINT_{rc}.json")
+        lines = []
+        watch(runner, lines.append, max_probes=1, artifact=art,
+              rlint_artifact=rlart, sleep=lambda s: None)
+        assert runner.rlint_calls == [rlart]
+        assert json.loads(open(rlart).read())["tool"] == "rlint"
+        assert len(runner.commits) == 1
+        assert runner.commits[0][0] == [art, rlart]
+        flagged = any("UNSUPPRESSED FINDINGS" in ln for ln in lines)
+        assert flagged == (rc != 0)
+
+
+def test_runner_without_rlint_unchanged(tmp_path):
+    """Older/minimal runners (no ``rlint`` method) keep the pre-PR-8
+    commit set: the watcher feature-detects instead of requiring it."""
+    runner = FakeRunner([_healthy()])
+    art = str(tmp_path / "bench.jsonl")
+    watch(runner, lambda s: None, max_probes=1, artifact=art,
+          sleep=lambda s: None)
+    assert runner.commits[0][0] == [art]
+
+
 def test_probe_crash_rc_nonzero_keeps_waiting():
     runner = FakeRunner([(1, "Traceback ..."), _healthy()])
     lines = []

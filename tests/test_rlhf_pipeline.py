@@ -24,6 +24,10 @@ import pytest
 from rl_tpu.envs.llm import arithmetic_dataset
 from rl_tpu.trainers.grpo import GRPOTrainer, PipelinedGRPOTrainer
 
+# rlint runtime sanitizer: every lock created inside these tests is
+# witnessed; any observed lock-order inversion fails the test at teardown
+pytestmark = pytest.mark.usefixtures("lock_witness")
+
 
 def _tiny(cls=GRPOTrainer, **kw):
     ds = arithmetic_dataset(n=64, max_operand=2)

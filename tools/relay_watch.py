@@ -138,6 +138,26 @@ class Runner:
             return 124, out
         return p.returncode, p.stdout
 
+    def rlint(self, artifact: str, timeout: float = 300.0) -> tuple[int, str]:
+        """Refresh the rlint summary artifact (PR-8): re-run the static
+        analyzer over rl_tpu/ and rewrite ``artifact`` (findings by rule,
+        fixed vs suppressed). rc!=0 means unsuppressed findings — the
+        artifact is still written so the regression is visible in-tree."""
+        try:
+            p = subprocess.run(
+                [
+                    sys.executable,
+                    os.path.join(REPO, "tools", "rlint.py"),
+                    "rl_tpu/",
+                    "--artifact",
+                    artifact,
+                ],
+                cwd=REPO, capture_output=True, text=True, timeout=timeout,
+            )
+        except subprocess.TimeoutExpired:
+            return 124, ""
+        return p.returncode, p.stdout
+
     def commit(self, paths: list[str], message: str) -> int:
         rc = subprocess.run(["git", "-C", REPO, "add", *paths]).returncode
         if rc != 0:
@@ -155,6 +175,7 @@ def watch(
     artifact: str | None = None,
     metrics_artifact: str | None = None,
     multichip_artifact: str | None = None,
+    rlint_artifact: str | None = None,
     commit: bool = True,
     require_tpu: bool = True,
     sleep=time.sleep,
@@ -225,6 +246,18 @@ def watch(
                 f.write("\n")
             paths.append(mcpath)
             log(f"{_utcnow()} multichip -> {os.path.relpath(mcpath, REPO)}")
+        if hasattr(runner, "rlint"):
+            # PR-8: keep the static-analysis summary current alongside the
+            # perf artifacts — the same commit that records a measurement
+            # re-records the findings ledger it was measured under
+            rlpath = rlint_artifact or os.path.join(REPO, "RLINT_pr8.json")
+            rrc, _ = runner.rlint(rlpath)
+            if os.path.exists(rlpath):
+                paths.append(rlpath)
+            log(
+                f"{_utcnow()} rlint rc={rrc} -> {os.path.relpath(rlpath, REPO)}"
+                + (" (UNSUPPRESSED FINDINGS)" if rrc != 0 else "")
+            )
         if commit:
             crc = runner.commit(
                 paths,
@@ -250,6 +283,8 @@ def main(argv=None) -> int:
                     help="metrics-sections path (default METRICS_pr3.json)")
     ap.add_argument("--multichip-artifact", default=None,
                     help="multichip scaling-sweep path (default MULTICHIP_r06.json)")
+    ap.add_argument("--rlint-artifact", default=None,
+                    help="rlint findings-summary path (default RLINT_pr8.json)")
     ap.add_argument("--no-commit", action="store_true")
     ap.add_argument("--log-file", default=os.path.join(REPO, "logs", "relay_watch.log"))
     args = ap.parse_args(argv)
@@ -270,6 +305,7 @@ def main(argv=None) -> int:
         artifact=args.artifact,
         metrics_artifact=args.metrics_artifact,
         multichip_artifact=args.multichip_artifact,
+        rlint_artifact=args.rlint_artifact,
         commit=not args.no_commit,
     )
     return 0 if path is not None else 1

@@ -1,0 +1,137 @@
+#!/usr/bin/env python
+"""rlint CLI: JAX/thread-discipline static analysis for rl_tpu.
+
+Usage::
+
+    python tools/rlint.py rl_tpu/                 # gate: exit 1 on unsuppressed
+    python tools/rlint.py rl_tpu/ --list          # show suppressed findings too
+    python tools/rlint.py rl_tpu/ --no-baseline   # raw findings, no gating
+    python tools/rlint.py rl_tpu/ --rule R001     # one rule only
+    python tools/rlint.py rl_tpu/ --write-baseline --reason "cold path: ..."
+    python tools/rlint.py rl_tpu/ --artifact RLINT_pr8.json
+
+The baseline (``.rlint-baseline.json`` at the repo root) is the triage
+ledger: suppressions need a reason, stale entries are warnings. The
+``--artifact`` mode writes the bench.py-style committed summary
+(findings by rule, fixed vs suppressed) that tools/relay_watch.py keeps
+current.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from rl_tpu.analysis import (  # noqa: E402
+    ALL_RULES,
+    Baseline,
+    DEFAULT_BASELINE,
+    analyze_paths,
+)
+
+
+def build_artifact(findings, unsup, sup, baseline: Baseline, paths) -> dict:
+    by_rule = {}
+    for rid in ALL_RULES:
+        found = [f for f in findings if f.rule == rid]
+        by_rule[rid] = {
+            "found": len(found),
+            "suppressed": sum(1 for f in sup if f.rule == rid),
+            "unsuppressed": sum(1 for f in unsup if f.rule == rid),
+        }
+    fixed_by_rule: dict = {}
+    for entry in baseline.fixed:
+        fixed_by_rule[entry.get("rule", "?")] = fixed_by_rule.get(entry.get("rule", "?"), 0) + 1
+    return {
+        "tool": "rlint",
+        "paths": list(paths),
+        "rules": list(ALL_RULES),
+        "by_rule": by_rule,
+        "total": {
+            "found": len(findings),
+            "suppressed": len(sup),
+            "unsuppressed": len(unsup),
+            "fixed_in_prs": len(baseline.fixed),
+        },
+        "fixed_by_rule": fixed_by_rule,
+        "fixed": baseline.fixed,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[1].strip())
+    ap.add_argument("paths", nargs="+", help="files or directories to analyze")
+    ap.add_argument("--baseline", default=os.path.join(REPO, DEFAULT_BASELINE))
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every finding; no suppression, no gating exit code")
+    ap.add_argument("--rule", action="append", default=None,
+                    help="restrict to a rule id (repeatable)")
+    ap.add_argument("--list", action="store_true",
+                    help="also print suppressed findings (with their reasons)")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="add current unsuppressed findings to the baseline")
+    ap.add_argument("--reason", default="TODO: triage",
+                    help="reason recorded for --write-baseline additions")
+    ap.add_argument("--json", default=None, help="dump findings as JSON to a file")
+    ap.add_argument("--artifact", default=None,
+                    help="write the committed summary artifact (e.g. RLINT_pr8.json)")
+    args = ap.parse_args(argv)
+
+    findings = analyze_paths(args.paths, rules=args.rule, root=REPO)
+
+    if args.no_baseline:
+        for f in findings:
+            print(f.format())
+        print(f"rlint: {len(findings)} finding(s), baseline not applied")
+        return 0
+
+    baseline = Baseline.load(args.baseline)
+    unsup, sup, stale = baseline.split(findings)
+
+    if args.write_baseline:
+        for f in unsup:
+            baseline.add(f, args.reason)
+        baseline.save(args.baseline)
+        print(f"rlint: baseline updated with {len(unsup)} suppression(s) -> {args.baseline}")
+        unsup, sup, stale = baseline.split(findings)
+
+    if args.list:
+        reasons = {s["fingerprint"]: s.get("reason", "") for s in baseline.suppressions}
+        for f in sup:
+            print(f"SUPPRESSED {f.format()}  reason: {reasons.get(f.fingerprint, '?')}")
+    for f in unsup:
+        print(f.format())
+    for s in stale:
+        print(
+            f"rlint: warning: stale suppression {s.get('fingerprint')} "
+            f"({s.get('rule')} {s.get('file')} [{s.get('qualname')}]) — "
+            "the finding no longer fires; consider removing it",
+            file=sys.stderr,
+        )
+
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump([x.to_dict() for x in findings], f, indent=2)
+            f.write("\n")
+    if args.artifact:
+        art = build_artifact(findings, unsup, sup, baseline, args.paths)
+        with open(args.artifact, "w") as f:
+            json.dump(art, f, indent=2, sort_keys=False)
+            f.write("\n")
+        print(f"rlint: artifact -> {args.artifact}")
+
+    n_sup = len(sup)
+    print(
+        f"rlint: {len(findings)} finding(s): {len(unsup)} unsuppressed, "
+        f"{n_sup} suppressed, {len(stale)} stale suppression(s)"
+    )
+    return 1 if unsup else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
