@@ -2283,6 +2283,301 @@ def bench_multichip(report: bool = True) -> dict:
     return out
 
 
+def _anakin_flops_per_train_step(frames: int, num_epochs: int = 4) -> float:
+    """Analytic matmul FLOPs of one fused Anakin train step — the same
+    actor/critic MLPs as the ppo headline (``_model_flops_per_train_step``)
+    parameterized by batch size."""
+    actor_macs = 4 * 64 + 64 * 64 + 64 * 2
+    critic_macs = 4 * 64 + 64 * 64 + 64 * 1
+    fwd = 2 * (actor_macs + critic_macs)
+    rollout = 2 * actor_macs * frames
+    gae = 2 * critic_macs * frames
+    train = 3 * fwd * frames * num_epochs
+    return float(rollout + gae + train)
+
+
+def _anakin_worker(report: bool = True) -> dict:
+    """One device-count point of BENCH_MODE=anakin: ANAKIN_DEVICES names
+    the device count; the process builds a pure batch-parallel
+    ``(batch=n, fsdp=1)`` mesh and sweeps num_envs, timing the fully
+    fused env+policy+learner dispatch (AnakinProgram). At the smallest
+    num_envs it also times the same math dispatched the host way —
+    (a) Collector dispatch + update dispatch (two programs per step) and
+    (b) one jitted env-step dispatched per frame from Python (the
+    AsyncHostCollector pattern Anakin exists to kill) — so the committed
+    artifact carries the fused-vs-host ratio the ISSUE-9 acceptance asks
+    for."""
+    jax = _setup_jax()
+    import jax.numpy as jnp
+
+    from rl_tpu.modules import (
+        MLP,
+        Categorical,
+        ProbabilisticActor,
+        TDModule,
+        ValueOperator,
+    )
+    from rl_tpu.objectives import ClipPPOLoss
+    from rl_tpu.parallel import make_fsdp_mesh
+    from rl_tpu.trainers import AnakinConfig, AnakinProgram
+
+    n = int(os.environ["ANAKIN_DEVICES"])
+    avail = len(jax.devices())
+    if avail < n:
+        out = {"metric": "anakin_worker", "n_devices": n, "value": 0.0,
+               "error": f"only {avail} devices available (wanted {n})"}
+        out.update(_platform_tag(jax))
+        if report:
+            print(json.dumps(out), flush=True)
+        return out
+    mesh = make_fsdp_mesh(fsdp=1, batch=n)
+    on_cpu = jax.devices()[0].platform == "cpu"
+
+    sweep_envs = _T(smoke=[64], cpu=[256, 1024, 4096], full=[4096, 16384, 65536])
+    unroll = _T(smoke=4, cpu=16, full=32)
+    spd = _T(smoke=1, cpu=2, full=4)  # train steps fused per dispatch
+    dispatches = _T(smoke=10, cpu=10, full=8)
+    deadline = _START + _TIMEOUT - 15.0
+
+    def build(num_envs):
+        actor = ProbabilisticActor(
+            TDModule(MLP(out_features=2, num_cells=(64, 64)),
+                     ["observation"], ["logits"]),
+            Categorical,
+            dist_keys=("logits",),
+        )
+        critic = ValueOperator(MLP(out_features=1, num_cells=(64, 64)))
+        loss = ClipPPOLoss(actor, critic, normalize_advantage=True)
+        loss.make_value_estimator(gamma=0.99, lmbda=0.95)
+        frames = num_envs * unroll
+        cfg = AnakinConfig(
+            num_envs=num_envs,
+            unroll_length=unroll,
+            steps_per_dispatch=spd,
+            num_epochs=NUM_EPOCHS,
+            minibatch_size=min(8192, frames // 2),
+            # the axon TPU backend rejects donated inputs (see main());
+            # donation is the steady-state win, so keep it where accepted
+            donate=on_cpu,
+        )
+        return AnakinProgram(
+            "cartpole", lambda p, td, k: actor(p["actor"], td, k), loss, cfg,
+            mesh=mesh,
+        )
+
+    peak = _peak_flops(jax) * n
+    sweep: list = []
+    host_baselines: dict = {}
+    for i, num_envs in enumerate(sweep_envs):
+        if deadline - time.monotonic() <= 10.0:
+            sweep.append({"num_envs": num_envs,
+                          "error": "skipped: BENCH_TIMEOUT budget exhausted"})
+            continue
+        prog = build(num_envs)
+        frames = prog.frames_per_step
+        ts = prog.init(jax.random.key(0))
+        dm = prog.init_metrics()
+
+        tc0 = time.perf_counter()
+        ts, dm, m = prog.dispatch(ts, dm)
+        jax.block_until_ready(m)
+        compile_s = time.perf_counter() - tc0
+        # second warmup: the donated outputs carry committed layouts that
+        # differ from init()'s fresh arrays, triggering one more compile —
+        # steady state starts at call 3
+        ts, dm, m = prog.dispatch(ts, dm)
+        jax.block_until_ready(m)
+        t0 = time.perf_counter()
+        for _ in range(dispatches):
+            ts, dm, m = prog.dispatch(ts, dm)
+        jax.block_until_ready(m)
+        dt = time.perf_counter() - t0
+        fused_sps = dispatches * prog.env_steps_per_dispatch / dt
+        point = {
+            "num_envs": num_envs,
+            "frames_per_step": frames,
+            "env_steps_per_sec": round(fused_sps, 1),
+            "env_steps_per_sec_per_chip": round(fused_sps / n, 1),
+            "mfu": round(
+                _anakin_flops_per_train_step(frames, NUM_EPOCHS)
+                * dispatches * spd / dt / peak, 6,
+            ),
+            "compile_s": round(compile_s, 2),
+        }
+
+        if i == 0 and deadline - time.monotonic() > 10.0:
+            # host path (a): Collector dispatch + update dispatch per step
+            inner = prog.inner
+            collect = jax.jit(inner.collector.collect)
+            update = jax.jit(inner.update_from_batch)
+            hts = prog.init(jax.random.key(0))
+            params, opt, cstate, rng = (
+                hts["params"], hts["opt"], hts["collector"], hts["rng"],
+            )
+
+            def host_collector_step(params, opt, cstate, rng):
+                batch, cstate = collect(params, cstate)
+                params, opt, rng, hm = update(params, opt, rng, batch)
+                return params, opt, cstate, rng, hm
+
+            steps = dispatches * spd
+            for _ in range(2):  # two warmups: layout-change recompile on call 2
+                params, opt, cstate, rng, hm = host_collector_step(
+                    params, opt, cstate, rng
+                )
+            jax.block_until_ready(hm)
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                params, opt, cstate, rng, hm = host_collector_step(params, opt, cstate, rng)
+            jax.block_until_ready(hm)
+            host_sps = steps * frames / (time.perf_counter() - t0)
+
+            # host path (b): one jitted env-step dispatch PER FRAME
+            env = prog.env
+            policy = inner.collector.policy
+
+            def one_step(params, state, td, key):
+                td = policy(params, td, key)
+                state, full_td, carry_td = env.step_and_reset(state, td)
+                return state, full_td, carry_td
+
+            one = jax.jit(one_step)
+            upd = jax.jit(inner.update_from_batch)
+            state, td = env.reset(jax.random.key(1))
+            params2, opt2, rng2 = hts["params"], hts["opt"], hts["rng"]
+
+            def per_step_train(params, opt, state, td, rng, seed):
+                fulls = []
+                for t in range(unroll):
+                    state, full_td, td = one(
+                        params, state, td, jax.random.fold_in(jax.random.key(seed), t)
+                    )
+                    fulls.append(full_td)
+                batch = jax.tree.map(lambda *xs: jnp.stack(xs), *fulls)
+                params, opt, rng, hm = upd(params, opt, rng, batch)
+                return params, opt, state, td, rng, hm
+
+            for s in (0, 1):  # two warmups: layout-change recompile on call 2
+                params2, opt2, state, td, rng2, hm = per_step_train(
+                    params2, opt2, state, td, rng2, 10_000 + s
+                )
+            jax.block_until_ready(hm)
+            ps_steps = max(1, steps // 2)
+            t0 = time.perf_counter()
+            for s in range(ps_steps):
+                params2, opt2, state, td, rng2, hm = per_step_train(
+                    params2, opt2, state, td, rng2, s + 1
+                )
+            jax.block_until_ready(hm)
+            per_step_sps = ps_steps * frames / (time.perf_counter() - t0)
+
+            point["host_collector_env_steps_per_sec"] = round(host_sps, 1)
+            point["host_per_step_env_steps_per_sec"] = round(per_step_sps, 1)
+            point["fused_vs_host_collector"] = round(fused_sps / host_sps, 3)
+            point["fused_vs_per_step"] = round(fused_sps / per_step_sps, 3)
+            host_baselines = {
+                "num_envs": num_envs,
+                "fused_vs_host_collector": point["fused_vs_host_collector"],
+                "fused_vs_per_step": point["fused_vs_per_step"],
+            }
+        sweep.append(point)
+
+    per_chip = [p.get("env_steps_per_sec_per_chip") for p in sweep
+                if p.get("env_steps_per_sec_per_chip")]
+    best = max(per_chip, default=0.0)
+    out = {
+        "metric": "anakin_worker",
+        "value": best,
+        "unit": "env_steps/s/chip",
+        "n_devices": n,
+        "mesh": [n, 1],
+        "unroll_length": unroll,
+        "steps_per_dispatch": spd,
+        "sweep": sweep,
+        "host_baseline": host_baselines or None,
+        "error": "; ".join(p["error"] for p in sweep if p.get("error")) or None,
+    }
+    out.update(_platform_tag(jax))
+    if report:
+        print(json.dumps(out), flush=True)
+    return out
+
+
+def bench_anakin(report: bool = True) -> dict:
+    """BENCH_MODE=anakin: the fused env+policy+learner program (ISSUE 9,
+    Podracer "Anakin") swept over num_envs x {1,4,8} forced-host devices.
+
+    Mirrors the multichip orchestration: the device count must be pinned
+    before JAX initializes, so each point owns a worker subprocess
+    (``ANAKIN_DEVICES``). Distills env-steps/s/chip + MFU per point, the
+    per-chip scaling across num_envs (flat-to-rising = no host sync in
+    the fused step), and the fused-vs-host-Collector ratio from the
+    1-device worker."""
+    if os.environ.get("ANAKIN_DEVICES"):
+        return _anakin_worker(report)
+    points = (1, 8) if _TIER == "smoke" else (1, 4, 8)
+    deadline = _START + _TIMEOUT - 20.0
+    results: dict = {}
+    for i, n in enumerate(points):
+        remaining = deadline - time.monotonic()
+        if remaining <= 10.0:
+            results[str(n)] = {"error": "skipped: BENCH_TIMEOUT budget exhausted"}
+            continue
+        extra = {
+            "ANAKIN_DEVICES": str(n),
+            "XLA_FLAGS": _force_host_devices_flags(n),
+        }
+        if not os.environ.get("BENCH_PLATFORM") and _TIER != "full":
+            extra["BENCH_PLATFORM"] = "cpu"  # forced topology is a cpu-tier run
+        results[str(n)] = _run_sub_bench(
+            "anakin", remaining / (len(points) - i), extra
+        )
+
+    metrics: dict = {}
+    num_envs_scaling: dict = {}
+    top = None
+    best = 0.0
+    for n in points:
+        r = results.get(str(n), {})
+        v = r.get("value") or 0.0
+        if v:
+            metrics[f"env_steps_per_sec_per_chip_{n}dev"] = v
+            if v >= best:
+                best, top = v, n
+    top_sweep = (results.get(str(top), {}) or {}).get("sweep") or []
+    for p in top_sweep:
+        if p.get("env_steps_per_sec_per_chip"):
+            num_envs_scaling[str(p["num_envs"])] = p["env_steps_per_sec_per_chip"]
+    r1 = results.get("1", {})
+    hb = r1.get("host_baseline") or {}
+    if hb.get("fused_vs_host_collector"):
+        metrics["fused_vs_host_collector"] = hb["fused_vs_host_collector"]
+        metrics["fused_vs_per_step"] = hb.get("fused_vs_per_step")
+    metrics["num_envs_scaling_per_chip"] = num_envs_scaling
+    errors = [f"{k}: {v['error']}" for k, v in results.items() if v.get("error")]
+    out = {
+        "metric": "anakin_env_steps_per_sec_per_chip",
+        "value": best,
+        "unit": "env_steps/s/chip",
+        "vs_target": round(best / PER_CHIP_TARGET, 3),
+        "top_devices": top,
+        "devices": results,
+        "num_envs_scaling": num_envs_scaling,
+        "fused_vs_host_collector": hb.get("fused_vs_host_collector"),
+        "fused_beats_host": (
+            hb.get("fused_vs_host_collector") is not None
+            and hb["fused_vs_host_collector"] > 1.0
+        ),
+        "metrics": metrics,
+        "platform": r1.get("platform"),
+        "shapes": _TIER,
+        "error": "; ".join(errors) or None,
+    }
+    if report:
+        print(json.dumps(out), flush=True)
+    return out
+
+
 def _parse_last_json(text: str) -> dict | None:
     for ln in reversed((text or "").strip().splitlines()):
         try:
@@ -2382,7 +2677,7 @@ def bench_all():
 
     weights = {"ppo": 2.0, "rlhf": 1.4, "pixel": 1.2, "hopper": 1.0,
                "sac": 1.0, "per": 1.0, "async_collect": 0.8, "serve": 0.8,
-               "fleet": 0.8, "multichip": 0.8, "chaos": 0.6}
+               "fleet": 0.8, "multichip": 0.8, "anakin": 0.8, "chaos": 0.6}
     deadline = _START + _TIMEOUT - 30.0  # safety margin for the final print
     pending = list(weights)
     results: dict = {}
@@ -2525,6 +2820,7 @@ if __name__ == "__main__":
             "chaos": bench_chaos,
             "fleet": bench_fleet,
             "multichip": bench_multichip,
+            "anakin": bench_anakin,
         }[mode]()
         timer.cancel()
         _maybe_write_metrics(_result)

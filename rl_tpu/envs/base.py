@@ -173,11 +173,12 @@ class EnvBase:
         if rng.shape == ():
             reset_key, carry_key = jax.random.split(rng)
         else:
-            # batched carry keys (a wrapped VmapEnv): advance each, derive a
-            # single reset key (reset() re-splits it per sub-env)
+            # batched carry keys (a wrapped VmapEnv): advance each stream and
+            # derive each sub-env's reset key from its OWN stream — a single
+            # fleet-wide reset key would correlate every post-done re-seed
             pairs = jax.vmap(jax.random.split)(rng.reshape(-1))
             carry_key = pairs[:, 1].reshape(rng.shape)
-            reset_key = pairs[0, 0]
+            reset_key = pairs[:, 0].reshape(rng.shape)
         reset_state, reset_td = self.reset(reset_key)
 
         done = full_td["next", "done"]
@@ -344,7 +345,18 @@ class VmapEnv(EnvBase):
     def reset(self, key: jax.Array) -> tuple[EnvState, ArrayDict]:
         from ..utils.seeding import ensure_typed_key
 
-        keys = jax.random.split(ensure_typed_key(key), self.num_envs)
+        key = ensure_typed_key(key)
+        if key.shape == ():
+            # split ONCE at init: from here on every sub-env owns an
+            # independent stream, advanced per step inside its own state
+            keys = jax.random.split(key, self.num_envs)
+        else:
+            # pre-split per-env streams (auto-reset re-seeds, Anakin fleets)
+            if key.shape != (self.num_envs,):
+                raise ValueError(
+                    f"batched reset key shape {key.shape} != ({self.num_envs},)"
+                )
+            keys = key
         return jax.vmap(self.env.reset)(keys)
 
     def step(self, state: EnvState, td: ArrayDict) -> tuple[EnvState, ArrayDict]:

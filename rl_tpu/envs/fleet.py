@@ -1,0 +1,108 @@
+"""On-device env fleets: the Anakin collection substrate.
+
+The classic/custom envs are pure-array state machines, so a "parallel env"
+is just ``VmapEnv`` — N identical envs stepped as one XLA program. This
+module is the one-call factory that turns an env *name* into a
+fleet ready for the fused Anakin trainer (trainers/anakin.py):
+
+    env = make_fleet("cartpole", num_envs=4096)
+
+The fleet is ``TransformedEnv(VmapEnv(base, num_envs), RewardSum())``:
+``RewardSum`` accumulates per-env episode returns under
+``("next", "episode_reward")`` — the key the trainers' episode-return
+metrics (and Anakin's in-program ``DeviceMetrics``) read at done edges.
+
+Adding a new array env to the fleet = registering its constructor here
+(see ``register_fleet_env``); the only contract is the ``EnvBase`` one —
+pure ``_reset``/``_step``, fixed shapes, ``lax`` control flow — which
+``check_env_specs`` + ``check_vmap_autoreset`` (envs/utils.py) validate.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from .base import EnvBase, VmapEnv
+from .transforms.base import TransformedEnv
+from .transforms.common import RewardSum
+
+__all__ = ["make_fleet", "register_fleet_env", "fleet_env_names"]
+
+
+def _registry() -> dict[str, Callable[..., EnvBase]]:
+    # built lazily so importing rl_tpu.envs.fleet never pays for env modules
+    # the caller doesn't use
+    from .classic.acrobot import AcrobotEnv
+    from .classic.cartpole import CartPoleEnv
+    from .classic.mountain_car import MountainCarContinuousEnv, MountainCarEnv
+    from .classic.pendulum import PendulumEnv
+    from .custom import (
+        ChessEnv,
+        HopperEnv,
+        NavigationEnv,
+        TicTacToeEnv,
+        ToyVLAEnv,
+        TradingEnv,
+        Walker2dEnv,
+    )
+
+    return {
+        "acrobot": AcrobotEnv,
+        "cartpole": CartPoleEnv,
+        "chess": ChessEnv,
+        "hopper": HopperEnv,
+        "mountain_car": MountainCarEnv,
+        "mountain_car_continuous": MountainCarContinuousEnv,
+        "navigation": NavigationEnv,
+        "pendulum": PendulumEnv,
+        "tictactoe": TicTacToeEnv,
+        "toy_vla": ToyVLAEnv,
+        "trading": TradingEnv,
+        "walker2d": Walker2dEnv,
+    }
+
+
+_EXTRA: dict[str, Callable[..., EnvBase]] = {}
+
+
+def register_fleet_env(name: str, ctor: Callable[..., EnvBase]) -> None:
+    """Register a constructor for :func:`make_fleet` (``ctor(**kwargs)`` must
+    return a scalar, pure-array :class:`EnvBase`)."""
+    _EXTRA[name] = ctor
+
+
+def fleet_env_names() -> tuple[str, ...]:
+    return tuple(sorted({**_registry(), **_EXTRA}))
+
+
+def make_fleet(
+    env: str | EnvBase,
+    num_envs: int,
+    *,
+    episode_return: bool = True,
+    **env_kwargs,
+) -> TransformedEnv | VmapEnv:
+    """Build an on-device fleet of ``num_envs`` identical array envs.
+
+    ``env`` is a registry name (see :func:`fleet_env_names`) or a scalar
+    ``EnvBase`` instance (then ``env_kwargs`` must be empty). With
+    ``episode_return=True`` (default) the fleet is wrapped in ``RewardSum``
+    so done-edge episode returns are available to metrics.
+    """
+    if isinstance(env, EnvBase):
+        if env_kwargs:
+            raise TypeError("env_kwargs only apply when env is a registry name")
+        base = env
+    else:
+        reg = {**_registry(), **_EXTRA}
+        if env not in reg:
+            raise KeyError(
+                f"unknown fleet env {env!r}; known: {', '.join(sorted(reg))}"
+            )
+        base = reg[env](**env_kwargs)
+    if base.batch_shape != ():
+        raise ValueError("make_fleet wraps scalar (unbatched) envs")
+    fleet = VmapEnv(base, num_envs)
+    if episode_return:
+        return TransformedEnv(fleet, RewardSum())
+    return fleet

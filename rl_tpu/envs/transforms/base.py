@@ -260,9 +260,11 @@ class TransformedEnv(EnvBase):
         if rng.shape == ():
             reset_key, carry_key = jax.random.split(rng)
         else:
+            # per-env reset keys from each env's own stream (see
+            # EnvBase.step_and_reset): no shared-key correlation at re-seeds
             pairs = jax.vmap(jax.random.split)(rng.reshape(-1))
             carry_key = pairs[:, 1].reshape(rng.shape)
-            reset_key = pairs[0, 0]
+            reset_key = pairs[:, 0].reshape(rng.shape)
         reset_state, reset_td = self.reset(reset_key)
 
         done = full_td["next", "done"]

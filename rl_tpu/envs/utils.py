@@ -19,7 +19,13 @@ import jax.numpy as jnp
 from ..data import ArrayDict, Composite
 from .base import EnvBase, rollout
 
-__all__ = ["check_env_specs", "ExplorationType", "exploration_type", "set_exploration_type"]
+__all__ = [
+    "check_env_specs",
+    "check_vmap_autoreset",
+    "ExplorationType",
+    "exploration_type",
+    "set_exploration_type",
+]
 
 
 def check_env_specs(env: EnvBase, key: jax.Array | None = None, num_steps: int = 8) -> None:
@@ -93,6 +99,75 @@ def check_env_specs(env: EnvBase, key: jax.Array | None = None, num_steps: int =
         )
         vals = steps["next"][path].reshape((n,) + leaf_spec.shape)
         assert leaf_spec.is_in(vals), f"rollout obs {path} violates spec"
+
+
+def check_vmap_autoreset(
+    env: EnvBase, key: jax.Array | None = None, num_envs: int = 4
+) -> None:
+    """Assert a scalar env's auto-reset composes correctly under ``vmap``.
+
+    The Anakin fleet admission check (fleet.py): an env is fleet-ready iff
+    the vmapped ``step_and_reset`` is the structural image of the scalar one.
+    Checks (AssertionError with a precise message on mismatch):
+
+    - the fleet's per-env PRNG streams are pairwise distinct after the one
+      init-time split (no shared-key correlation across the fleet);
+    - vmapped ``step_and_reset`` outputs have the scalar path's tree
+      structure and dtypes, with every leaf shape ``(num_envs,) + scalar``;
+    - the carried state keeps distinct per-env streams across the masked
+      reset merge (the fixed-shape ``where_done`` path).
+    """
+    import numpy as np
+
+    from .base import VmapEnv
+
+    assert env.batch_shape == (), "check_vmap_autoreset takes a scalar env"
+    key = jax.random.key(0) if key is None else key
+    k_fleet, k_scalar, k_act = jax.random.split(key, 3)
+
+    fleet = VmapEnv(env, num_envs)
+    vstate, vtd = fleet.reset(k_fleet)
+
+    def _distinct_streams(state, when: str) -> None:
+        raw = np.asarray(jax.random.key_data(state[fleet._rng_path]))
+        raw = raw.reshape(num_envs, -1)
+        uniq = {tuple(r.tolist()) for r in raw}
+        assert len(uniq) == num_envs, (
+            f"{when}: only {len(uniq)}/{num_envs} distinct per-env PRNG "
+            "streams — sub-envs share a key"
+        )
+
+    _distinct_streams(vstate, "after fleet reset")
+
+    sstate, std = env.reset(k_scalar)
+    vtd = fleet.rand_action(vtd, k_act)
+    std = std.set("action", jax.tree.map(lambda x: x[0], vtd["action"]))
+
+    vstate2, vfull, vcarry = jax.jit(fleet.step_and_reset)(vstate, vtd)
+    sstate2, sfull, scarry = env.step_and_reset(sstate, std)
+
+    for name, v, s in (
+        ("full_td", vfull, sfull),
+        ("carry_td", vcarry, scarry),
+        ("carry_state", vstate2, sstate2),
+    ):
+        vs, ss = jax.tree.structure(v), jax.tree.structure(s)
+        assert vs == ss, (
+            f"vmapped step_and_reset {name} structure drift:\n{vs}\nvs {ss}"
+        )
+        for (path, vl), (_, sl) in zip(
+            jax.tree_util.tree_leaves_with_path(v),
+            jax.tree_util.tree_leaves_with_path(s),
+        ):
+            p = jax.tree_util.keystr(path)
+            assert vl.dtype == sl.dtype, (
+                f"{name}{p}: dtype {vl.dtype} != scalar path {sl.dtype}"
+            )
+            assert vl.shape == (num_envs,) + sl.shape, (
+                f"{name}{p}: shape {vl.shape} != (num_envs,)+{sl.shape}"
+            )
+
+    _distinct_streams(vstate2, "after step_and_reset")
 
 
 class ExplorationType(enum.Enum):

@@ -45,6 +45,7 @@ __all__ = [
     "shard_batch",
     "data_sharding",
     "fsdp_sharding",
+    "train_state_shardings",
     "shard_train_state",
 ]
 
@@ -183,6 +184,57 @@ def fsdp_sharding(pytree, mesh: Mesh, *, min_size_mbytes: float = 4.0):
     return jax.tree.map(rule, pytree)
 
 
+def train_state_shardings(
+    ts: dict,
+    mesh: Mesh,
+    num_envs: int,
+    env_axis: str | None = None,
+    *,
+    min_size_mbytes: float = 4.0,
+) -> dict:
+    """Per-leaf :class:`NamedSharding` tree for a Program train state.
+
+    The placement rules of :func:`shard_train_state`, without the
+    ``device_put`` — feed the result to ``in_shardings``/``out_shardings``
+    on a donated dispatch (the Anakin fused step pins its layout this way
+    so donation can't silently resharded-copy).
+
+    - collector env state (leaves with a ``num_envs`` leading dim) shards
+      over the env axis (``data`` on the classic mesh, ``(batch, fsdp)``
+      on the FSDP mesh). Batched per-env PRNG key arrays shard too: one
+      independent stream per env is *data* (the Anakin fleet), unlike the
+      scalar program keys;
+    - params and optimizer state replicate on meshes without an ``fsdp``
+      axis (the classic DDP setup — XLA derives the gradient ``psum``
+      from the placements, reference trainers/_distributed.py:138 becomes
+      a no-op) and FSDP-shard per leaf (:func:`fsdp_sharding`, min-size
+      cutoff, replicated fallback) when the mesh has one;
+    - scalar PRNG keys and counters always replicate — every device must
+      draw the same randomness for the program to stay SPMD.
+    """
+    repl = replicated(mesh)
+    has_fsdp = AXIS_FSDP in mesh.axis_names and mesh.shape[AXIS_FSDP] > 1
+    if env_axis is None:
+        env_axis = AXIS_DATA if AXIS_DATA in mesh.axis_names else DATA_AXES
+    env_sharded = NamedSharding(mesh, PartitionSpec(env_axis))
+
+    def collector_rule(x):
+        if hasattr(x, "shape") and x.ndim >= 1 and x.shape[0] == num_envs:
+            return env_sharded
+        return repl
+
+    out = {}
+    for k, v in ts.items():
+        if k == "collector":
+            out[k] = jax.tree.map(collector_rule, v)
+        elif has_fsdp and k in ("params", "opt", "opt_state"):
+            out[k] = fsdp_sharding(v, mesh, min_size_mbytes=min_size_mbytes)
+        else:
+            # scalar rng keys, step counters, anything else: replicated
+            out[k] = jax.tree.map(lambda _: repl, v)
+    return out
+
+
 def shard_train_state(
     ts: dict,
     mesh: Mesh,
@@ -191,41 +243,9 @@ def shard_train_state(
     *,
     min_size_mbytes: float = 4.0,
 ) -> dict:
-    """Standard placement of a Program train state onto ``mesh``.
-
-    - collector env state (leaves with a ``num_envs`` leading dim) shards
-      over the env axis (``data`` on the classic mesh, ``(batch, fsdp)``
-      on the FSDP mesh);
-    - params and optimizer state replicate on meshes without an ``fsdp``
-      axis (the classic DDP setup — XLA derives the gradient ``psum``
-      from the placements, reference trainers/_distributed.py:138 becomes
-      a no-op) and FSDP-shard per leaf (:func:`fsdp_sharding`, min-size
-      cutoff, replicated fallback) when the mesh has one;
-    - PRNG keys and counters always replicate — every device must draw
-      the same randomness for the program to stay SPMD.
-    """
-    repl = replicated(mesh)
-    has_fsdp = AXIS_FSDP in mesh.axis_names and mesh.shape[AXIS_FSDP] > 1
-    if env_axis is None:
-        env_axis = AXIS_DATA if AXIS_DATA in mesh.axis_names else DATA_AXES
-    env_sharded = NamedSharding(mesh, PartitionSpec(env_axis))
-
-    def put_collector(x):
-        if (
-            hasattr(x, "shape") and x.ndim >= 1 and x.shape[0] == num_envs
-            and not _is_prng_key(x)
-        ):
-            return jax.device_put(x, env_sharded)
-        return jax.device_put(x, repl)
-
-    out = {}
-    for k, v in ts.items():
-        if k == "collector":
-            out[k] = jax.tree.map(put_collector, v)
-        elif has_fsdp and k in ("params", "opt", "opt_state"):
-            shardings = fsdp_sharding(v, mesh, min_size_mbytes=min_size_mbytes)
-            out[k] = jax.tree.map(jax.device_put, v, shardings)
-        else:
-            # rng keys, step counters, anything else: replicated
-            out[k] = jax.device_put(v, repl)
-    return out
+    """Standard placement of a Program train state onto ``mesh`` — the
+    ``device_put`` application of :func:`train_state_shardings`."""
+    shardings = train_state_shardings(
+        ts, mesh, num_envs, env_axis, min_size_mbytes=min_size_mbytes
+    )
+    return jax.tree.map(jax.device_put, ts, shardings)

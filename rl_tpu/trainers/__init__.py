@@ -1,3 +1,4 @@
+from .anakin import AnakinConfig, AnakinProgram, default_anakin_metrics
 from .off_policy import (
     AsyncOffPolicyTrainer,
     OffPolicyConfig,
@@ -17,6 +18,9 @@ from .trainer import (
 )
 
 __all__ = [
+    "AnakinConfig",
+    "AnakinProgram",
+    "default_anakin_metrics",
     "OnPolicyConfig",
     "OnPolicyProgram",
     "AsyncOffPolicyTrainer",

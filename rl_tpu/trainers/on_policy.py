@@ -106,6 +106,19 @@ class OnPolicyProgram:
     def train_step(self, ts: dict) -> tuple[dict, ArrayDict]:
         params = ts["params"]
         batch, cstate = self.collector.collect(params, ts["collector"])
+        params, opt_state, rng, mean_metrics = self.update_from_batch(
+            params, ts["opt"], ts["rng"], batch
+        )
+        new_ts = {"params": params, "opt": opt_state, "collector": cstate, "rng": rng}
+        return new_ts, mean_metrics
+
+    def update_from_batch(
+        self, params: Any, opt_state: Any, rng: jax.Array, batch: ArrayDict
+    ) -> tuple[Any, Any, jax.Array, ArrayDict]:
+        """The learner half of the fused step: advantage + epochs×minibatch
+        SGD on one rollout batch. Split out so programs that produce the
+        batch differently (AnakinProgram's in-scan fleet rollouts) reuse the
+        exact same update — same key usage, same op order."""
         if not self.recompute_advantage:
             batch = self.advantage(params, batch)
 
@@ -134,16 +147,15 @@ class OnPolicyProgram:
             (params, opt_state), metrics = jax.lax.scan(mb_body, (params, opt_state), mb_idx)
             return (params, opt_state), metrics
 
-        all_keys = jax.random.split(ts["rng"], self.config.num_epochs + 1)
+        all_keys = jax.random.split(rng, self.config.num_epochs + 1)
         rng, epoch_keys = all_keys[0], all_keys[1:]
         (params, opt_state), metrics = jax.lax.scan(
-            epoch_body, (params, ts["opt"]), epoch_keys
+            epoch_body, (params, opt_state), epoch_keys
         )
         mean_metrics = jax.tree.map(lambda x: x.mean(), metrics)
         mean_metrics = mean_metrics.set("episode_reward_mean", _episode_reward(batch))
         mean_metrics = mean_metrics.set("reward_mean", jnp.mean(batch["next", "reward"]))
-        new_ts = {"params": params, "opt": opt_state, "collector": cstate, "rng": rng}
-        return new_ts, mean_metrics
+        return params, opt_state, rng, mean_metrics
 
 
 def _episode_reward(batch: ArrayDict) -> jax.Array:

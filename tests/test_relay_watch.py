@@ -168,6 +168,66 @@ def test_multichip_sweep_distilled_to_own_artifact(tmp_path):
     assert runner.commits[0][0] == [art, mart, mcart]
 
 
+def test_anakin_sweep_distilled_to_own_artifact(tmp_path):
+    """ISSUE-9: the anakin sub-bench's fused-fleet sweep (env-steps/s/chip
+    across num_envs x {1,4,8} devices, MFU, fused-vs-host-Collector ratio)
+    lands whole in its own committed ANAKIN json, riding the same single
+    commit as the raw artifact and the metrics/multichip distillations."""
+
+    class AnakinRunner(FakeRunner):
+        def bench_all(self, timeout):
+            self.bench_calls.append(timeout)
+            ak = {
+                "metric": "anakin_env_steps_per_sec_per_chip",
+                "value": 48211.0,
+                "top_devices": 8,
+                "devices": {
+                    "1": {"value": 31950.0,
+                          "sweep": [{"num_envs": 256,
+                                     "env_steps_per_sec_per_chip": 31950.0,
+                                     "mfu": 0.002,
+                                     "fused_vs_host_collector": 1.37}],
+                          "host_baseline": {"num_envs": 256,
+                                            "fused_vs_host_collector": 1.37,
+                                            "fused_vs_per_step": 11.2}},
+                    "8": {"value": 48211.0,
+                          "sweep": [{"num_envs": 1024,
+                                     "env_steps_per_sec_per_chip": 48211.0,
+                                     "mfu": 0.003}]},
+                },
+                "num_envs_scaling": {"256": 21903.0, "1024": 48211.0},
+                "fused_vs_host_collector": 1.37,
+                "fused_beats_host": True,
+                "metrics": {"env_steps_per_sec_per_chip_8dev": 48211.0},
+            }
+            lines = [
+                {"metric": "ppo", "value": 123.0},
+                {"anakin": ak},
+            ]
+            return 0, "".join(json.dumps(ln) + "\n" for ln in lines)
+
+    runner = AnakinRunner([_healthy()])
+    art = str(tmp_path / "bench.jsonl")
+    mart = str(tmp_path / "METRICS.json")
+    akart = str(tmp_path / "ANAKIN.json")
+    watch(runner, lambda s: None, max_probes=1, artifact=art,
+          metrics_artifact=mart, anakin_artifact=akart,
+          sleep=lambda s: None)
+    doc = json.loads(open(akart).read())
+    ak = doc["anakin"]
+    assert ak["fused_beats_host"] is True
+    assert ak["num_envs_scaling"]["1024"] == 48211.0
+    assert ak["devices"]["1"]["host_baseline"]["fused_vs_per_step"] == 11.2
+    assert ak["devices"]["8"]["sweep"][0]["mfu"] == 0.003
+    assert doc["artifact"] == os.path.relpath(art, REPO)
+    # the flat metrics section still rides the METRICS distillation
+    mdoc = json.loads(open(mart).read())
+    assert mdoc["bench_metrics"]["anakin"]["env_steps_per_sec_per_chip_8dev"] == 48211.0
+    # all three files land in ONE commit
+    assert len(runner.commits) == 1
+    assert runner.commits[0][0] == [art, mart, akart]
+
+
 def test_rlhf_pipeline_subresult_distilled(tmp_path):
     """PR-4: the rlhf sub-bench reports an overlapped-cycle ``pipeline``
     sub-result; the watcher must split it into the committed METRICS json

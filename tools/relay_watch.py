@@ -107,6 +107,27 @@ def _extract_multichip(stdout: str) -> dict | None:
     return found
 
 
+def _extract_anakin(stdout: str) -> dict | None:
+    """Find the anakin sub-bench result (ISSUE-9 fused env+policy+learner:
+    env-steps/s/chip across the num_envs x device-count sweep, MFU per
+    point, fused-vs-host-Collector ratio) in a bench stdout JSONL stream.
+    Like the multichip sweep, the per-device worker dicts carry structure
+    worth keeping whole, so they get their own committed ANAKIN artifact.
+    Last match wins (the final aggregate line repeats the sub-results)."""
+    found = None
+    for ln in (stdout or "").strip().splitlines():
+        try:
+            d = json.loads(ln)
+        except ValueError:
+            continue
+        if not isinstance(d, dict):
+            continue
+        v = d.get("anakin")
+        if isinstance(v, dict) and ("devices" in v or "num_envs_scaling" in v):
+            found = v
+    return found
+
+
 class Runner:
     """Real subprocess/git backend. Tests replace this with a fake that
     implements the same three methods."""
@@ -175,6 +196,7 @@ def watch(
     artifact: str | None = None,
     metrics_artifact: str | None = None,
     multichip_artifact: str | None = None,
+    anakin_artifact: str | None = None,
     rlint_artifact: str | None = None,
     commit: bool = True,
     require_tpu: bool = True,
@@ -246,6 +268,21 @@ def watch(
                 f.write("\n")
             paths.append(mcpath)
             log(f"{_utcnow()} multichip -> {os.path.relpath(mcpath, REPO)}")
+        ak = _extract_anakin(bout)
+        if ak is not None:
+            akpath = anakin_artifact or os.path.join(REPO, "ANAKIN_pr9.json")
+            with open(akpath, "w") as f:
+                json.dump(
+                    {
+                        "artifact": os.path.relpath(path, REPO),
+                        "generated": _utcnow(),
+                        "anakin": ak,
+                    },
+                    f, indent=2, sort_keys=True,
+                )
+                f.write("\n")
+            paths.append(akpath)
+            log(f"{_utcnow()} anakin -> {os.path.relpath(akpath, REPO)}")
         if hasattr(runner, "rlint"):
             # PR-8: keep the static-analysis summary current alongside the
             # perf artifacts — the same commit that records a measurement
@@ -283,6 +320,8 @@ def main(argv=None) -> int:
                     help="metrics-sections path (default METRICS_pr3.json)")
     ap.add_argument("--multichip-artifact", default=None,
                     help="multichip scaling-sweep path (default MULTICHIP_r06.json)")
+    ap.add_argument("--anakin-artifact", default=None,
+                    help="anakin fused-fleet sweep path (default ANAKIN_pr9.json)")
     ap.add_argument("--rlint-artifact", default=None,
                     help="rlint findings-summary path (default RLINT_pr8.json)")
     ap.add_argument("--no-commit", action="store_true")
@@ -305,6 +344,7 @@ def main(argv=None) -> int:
         artifact=args.artifact,
         metrics_artifact=args.metrics_artifact,
         multichip_artifact=args.multichip_artifact,
+        anakin_artifact=args.anakin_artifact,
         rlint_artifact=args.rlint_artifact,
         commit=not args.no_commit,
     )
