@@ -113,6 +113,44 @@ def _platform_tag(jax) -> dict:
     return {"platform": d.platform, "shapes": _TIER}
 
 
+def bench_warmup(step, *, calls=2, assert_no_recompile=False):
+    """Shared warm-up timing — ONE helper instead of a per-mode copy of
+    the "two warmups" pattern (serve / anakin / multichip grew three).
+
+    Calls ``step()`` ``calls`` times. Call 1 is timed (blocked on) as the
+    returned ``compile_s`` — trace+compile for a raw ``jax.jit`` step, or
+    an AOT store/memory hit for a :class:`rl_tpu.compile.CachedProgram`,
+    which is exactly the cold-start number the compile bench tracks. The
+    remaining calls run under :class:`rl_tpu.compile.CompileDelta`:
+
+    * raw-jit callers keep ``calls=2`` — the historical second warmup
+      that absorbs the donated-layout recompile before timing starts;
+    * registry-backed callers pass ``assert_no_recompile=True`` — AOT
+      executables commit layouts at compile time, so call 2 recompiling
+      is a hard bug (a silent 2x cold-start tax), not noise to absorb.
+
+    The assertion is skipped when compile counting is unsupported or AOT
+    dispatch is disabled (``RL_TPU_NO_AOT`` falls back to plain jit,
+    where the layout recompile is expected). Returns
+    ``(compile_s, last_result)``; steady state starts at the next call.
+    """
+    import jax
+
+    from rl_tpu.compile import CompileDelta
+
+    t0 = time.perf_counter()
+    out = step()
+    jax.block_until_ready(out)
+    compile_s = time.perf_counter() - t0
+    with CompileDelta() as d:
+        for _ in range(calls - 1):
+            out = step()
+        jax.block_until_ready(out)
+    if assert_no_recompile and d.supported and not os.environ.get("RL_TPU_NO_AOT"):
+        assert d.delta == 0, f"post-warmup recompile: {d.explain()}"
+    return compile_s, out
+
+
 def _model_flops_per_train_step() -> float:
     """Analytic matmul FLOPs of one fused train step.
 
@@ -476,9 +514,14 @@ def bench_serve(report: bool = True) -> dict:
         out = eng.run()
         return time.perf_counter() - t0, len(out)
 
-    t_warm, _ = run_engine()  # compile prefill buckets + decode ladder
+    # compile prefill buckets + decode ladder (one traffic round; first-round
+    # host-glue ops compile here too, so the timed round is steady state)
+    t_warm, _ = bench_warmup(run_engine, calls=1)
     steps0 = eng.decode_steps
-    t_engine, n_done = run_engine()
+    from rl_tpu.compile import CompileDelta
+
+    with CompileDelta() as steady:
+        t_engine, n_done = run_engine()
     assert n_done == len(reqs)
     # token-slot work accounting: every decode step computes n_slots rows
     engine_token_slots = (eng.decode_steps - steps0) * S
@@ -515,6 +558,10 @@ def bench_serve(report: bool = True) -> dict:
             fixed_token_slots / max(1, engine_token_slots), 3
         ),
         "decode_chunk": eng.decode_chunk_last,
+        # 0 == no silent recompile inside the timed pass; the auto decode
+        # chunk tuner MAY legitimately re-chunk here, which this field makes
+        # visible instead of reading as latency noise
+        "steady_state_compile_delta": steady.delta if steady.supported else None,
         "engine_decode_steps": int(eng.decode_steps - steps0),
         "fixed_tokens_per_sec": round(useful / t_fixed, 1),
         "compile_s": round(t_warm + t_fixed_warm, 2),
@@ -523,6 +570,205 @@ def bench_serve(report: bool = True) -> dict:
         "error": None,
     }
     out.update(_platform_tag(jax))
+    if report:
+        print(json.dumps(out), flush=True)
+    return out
+
+
+def _compile_worker(report: bool = True) -> dict:
+    """One process lifetime of the serving cold-start path (COMPILE_ROLE
+    names it ``cold`` or ``warm``): build a 2-engine serving set, run the
+    registry AOT warm-up over the full program ladder, then prove fleet
+    steady state. The orchestrator runs this twice against ONE sandboxed
+    executable store + compilation cache — run 1 populates them (cold),
+    run 2 is the supervised-restart scenario where ``lower()`` is skipped
+    and executables deserialize from the store (warm)."""
+    jax = _setup_jax()
+    # the orchestrator sandboxes the jax compilation cache alongside the
+    # executable store: the repo-level .jax_cache would otherwise leak
+    # warmth from earlier bench invocations into the "cold" run
+    cache = os.environ.get("COMPILE_BENCH_CACHE")
+    if cache:
+        try:
+            jax.config.update("jax_compilation_cache_dir", cache)
+        except Exception:
+            pass
+    import jax.numpy as jnp
+    import numpy as np
+
+    from rl_tpu.compile import CompileDelta
+    from rl_tpu.models import (
+        ContinuousBatchingEngine,
+        ServingFleet,
+        TransformerConfig,
+        TransformerLM,
+    )
+
+    role = os.environ.get("COMPILE_ROLE", "cold")
+    if _TIER == "smoke":
+        cfg = TransformerConfig(vocab_size=256, d_model=64, n_layers=2,
+                                n_heads=4, d_ff=128, max_seq_len=128,
+                                dtype=jnp.float32)
+        S, bucket, pmax = 4, 16, 12
+    elif _TIER == "cpu":
+        cfg = TransformerConfig(vocab_size=1024, d_model=128, n_layers=2,
+                                n_heads=4, d_ff=512, max_seq_len=128,
+                                dtype=jnp.float32)
+        S, bucket, pmax = 4, 16, 12
+    else:
+        cfg = TransformerConfig(vocab_size=32768, d_model=768, n_layers=12,
+                                n_heads=12, d_ff=3072, max_seq_len=256,
+                                dtype=jnp.bfloat16)
+        S, bucket, pmax = 8, 32, 24
+    model = TransformerLM(cfg)
+    params = model.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32))["params"]
+
+    def mk_engine(i):
+        return ContinuousBatchingEngine(
+            model, params, n_slots=S, block_size=16,
+            n_blocks=S * (cfg.max_seq_len // 16) + 1,
+            prompt_buckets=(bucket,), greedy=True, decode_chunk=4, seed=i,
+        )
+
+    engines = [mk_engine(i) for i in range(2)]
+    t0 = time.perf_counter()
+    programs: dict = {}
+    for e in engines:
+        for name, runs in e.aot_warmup().items():
+            rec = programs.setdefault(name, {"s": 0.0, "sources": {}})
+            for src, s in runs:
+                rec["s"] += s
+                rec["sources"][src] = rec["sources"].get(src, 0) + 1
+    warmup_s = time.perf_counter() - t0
+    for rec in programs.values():
+        rec["s"] = round(rec["s"], 4)
+    compiles = sum(r["sources"].get("compile", 0) for r in programs.values())
+    loads = sum(r["sources"].get("store", 0) for r in programs.values())
+
+    # fleet traffic: warm-up rounds absorb one-time host-glue compiles
+    # (tiny unattributed ops on first dispatch). The fleet groups
+    # admissions by arrival timing, so a single warm-up round can miss an
+    # admit-size-shaped glue op a later round then hits — loop until one
+    # full round is compile-free, then the measured round must be too
+    # (the ISSUE-10 steady-state acceptance gate).
+    rng = np.random.default_rng(0)
+    reqs = [(rng.integers(0, cfg.vocab_size, int(rng.integers(4, pmax))),
+             int(rng.integers(4, 10))) for _ in range(3 * S)]
+    wait_s = _T(smoke=120, cpu=300, full=300)
+    warmup_rounds = 0
+    fleet = ServingFleet(engines, max_queue=4 * len(reqs)).start()
+    try:
+        for _ in range(4):
+            warmup_rounds += 1
+            with CompileDelta() as glue:
+                ids = [fleet.submit(p, n) for p, n in reqs]
+                fleet.wait(ids, timeout=wait_s)
+            if not glue.supported or glue.delta == 0:
+                break
+        with CompileDelta() as steady:
+            ids = [fleet.submit(p, n) for p, n in reqs]
+            done = fleet.wait(ids, timeout=wait_s)
+    finally:
+        fleet.shutdown()
+
+    steady_ok = (steady.delta == 0) if steady.supported else None
+    err = None
+    if len(done) != len(ids):
+        err = f"fleet completed {len(done)}/{len(ids)} requests"
+    elif steady_ok is False:
+        err = "steady-state recompile: " + steady.explain()
+    out = {
+        "metric": "compile_warmup_seconds",
+        "value": round(warmup_s, 3),
+        "unit": "s",
+        "role": role,
+        "warmup_s": round(warmup_s, 3),
+        "n_programs": len(programs),
+        "compiles": compiles,
+        "store_loads": loads,
+        "programs": programs,
+        "steady_state_compile_delta": steady.delta if steady.supported else None,
+        "steady_state_ok": steady_ok,
+        "traffic_warmup_rounds": warmup_rounds,
+        "n_requests": len(reqs),
+        "error": err,
+    }
+    out.update(_platform_tag(jax))
+    if report:
+        print(json.dumps(out), flush=True)
+    return out
+
+
+def bench_compile(report: bool = True) -> dict:
+    """BENCH_MODE=compile: cold vs warm process startup over one sandboxed
+    executable store — the ISSUE-10 cold-start headline.
+
+    Two ``_compile_worker`` subprocesses share a fresh store + compilation
+    cache: the ``cold`` run pays ``lower().compile()`` for every serving
+    program and serializes the executables; the ``warm`` run models a
+    supervised restart, deserializing the same programs instead of
+    recompiling. Distills ``cold_s`` / ``warm_s`` / the warm speedup
+    (acceptance: >= 3x on the cpu tier) and the warm run's fleet
+    steady-state compile delta (acceptance: 0)."""
+    if os.environ.get("COMPILE_ROLE"):
+        return _compile_worker(report)
+    import shutil
+    import tempfile
+
+    sandbox = tempfile.mkdtemp(prefix="rl_tpu_compile_bench_")
+    deadline = _START + _TIMEOUT - 20.0
+    roles = ("cold", "warm")
+    results: dict = {}
+    try:
+        for i, role in enumerate(roles):
+            remaining = deadline - time.monotonic()
+            if remaining <= 10.0:
+                results[role] = {"error": "skipped: BENCH_TIMEOUT budget exhausted"}
+                continue
+            results[role] = _run_sub_bench(
+                "compile", remaining / (len(roles) - i), {
+                    "COMPILE_ROLE": role,
+                    "RL_TPU_EXEC_STORE_DIR": os.path.join(sandbox, "exec_store"),
+                    "COMPILE_BENCH_CACHE": os.path.join(sandbox, "jax_cache"),
+                },
+            )
+    finally:
+        shutil.rmtree(sandbox, ignore_errors=True)
+
+    cold, warm = results.get("cold", {}), results.get("warm", {})
+    cold_s, warm_s = cold.get("warmup_s"), warm.get("warmup_s")
+    speedup = round(cold_s / warm_s, 2) if cold_s and warm_s else None
+    errors = [f"{k}: {v['error']}" for k, v in results.items() if v.get("error")]
+    metrics = {
+        "cold_warmup_s": cold_s,
+        "warm_warmup_s": warm_s,
+        "warm_speedup": speedup,
+        "compiles_cold": cold.get("compiles"),
+        "store_loads_warm": warm.get("store_loads"),
+        "steady_state_compile_delta": warm.get("steady_state_compile_delta"),
+    }
+    out = {
+        "metric": "compile_warm_vs_cold_speedup",
+        "value": speedup or 0.0,
+        "unit": "x",
+        "vs_baseline": speedup or 0.0,
+        "cold_s": cold_s,
+        "warm_s": warm_s,
+        # acceptance gates: warm restart >= 3x and ZERO lower() calls on
+        # the warm path (every program deserializes or memory-hits)
+        "warm_ok": bool(speedup is not None and speedup >= 3.0),
+        "warm_skipped_lowering": (warm.get("compiles") == 0
+                                  if "compiles" in warm else None),
+        "steady_state_ok": warm.get("steady_state_ok"),
+        "steady_state_compile_delta": warm.get("steady_state_compile_delta"),
+        "n_programs": warm.get("n_programs") or cold.get("n_programs"),
+        "cold": cold,
+        "warm": warm,
+        "metrics": metrics,
+        "platform": warm.get("platform") or cold.get("platform"),
+        "shapes": _TIER,
+        "error": "; ".join(errors) or None,
+    }
     if report:
         print(json.dumps(out), flush=True)
     return out
@@ -1888,7 +2134,12 @@ def bench_fleet(report: bool = True) -> dict:
 
     engines = [mk_engine(i) for i in range(3)]
     t0 = time.perf_counter()
-    for e in engines:  # compile prefill + decode per replica, outside timing
+    for e in engines:
+        # warm the FULL program ladder (every admit count x prompt bucket),
+        # not just what two probe requests happen to hit — a mid-traffic
+        # admit-shape compile would bleed straight into the TTFT tail
+        e.aot_warmup()
+    for e in engines:  # one traffic round: first-round host-glue ops compile
         for _ in range(2):
             e.submit(rng.integers(0, cfg.vocab_size, 8), 4)
         e.run()
@@ -1934,11 +2185,14 @@ def bench_fleet(report: bool = True) -> dict:
     inj = FaultInjector(
         {"fleet.engine_crash.1": Fault("crash", at=(1,))}, registry=reg)
 
+    from rl_tpu.compile import CompileDelta
+
     admitted, rejected = [], 0
     crash_wall = None
+    steady = CompileDelta()
     t_start = time.monotonic()
     try:
-        with injection(inj):
+        with steady, injection(inj):
             for a, lane, prompt, n_new in plan:
                 now = time.monotonic() - t_start
                 if crash_wall is None and now >= crash_at:
@@ -1992,6 +2246,9 @@ def bench_fleet(report: bool = True) -> dict:
                              == len(admitted)),
         "crashes": snap["crashes"], "quarantines": snap["quarantines"],
         "readmissions": snap["readmissions"],
+        # 0 == the whole chaos window (crash, failover re-dispatch,
+        # re-admission included) ran on warmed executables
+        "steady_state_compile_delta": steady.delta if steady.supported else None,
     }
     out = {
         "metric": "fleet_tokens_per_sec",
@@ -2120,10 +2377,15 @@ def _multichip_worker(report: bool = True) -> dict:
 
     def _time_update(upd_fn, p0, o0):
         p, o = p0, o0
-        tc0 = time.perf_counter()
-        p, o, v = upd_fn(p, o, tokens, slp, amask, adv)
-        jax.block_until_ready(v)
-        compile_s = time.perf_counter() - tc0
+
+        def upd_step():  # raw jit + donation: one layout warmup after compile
+            nonlocal p, o
+            p, o, v = upd_fn(p, o, tokens, slp, amask, adv)
+            return v
+
+        compile_s, v = bench_warmup(upd_step, calls=2)
+        # loss after TWO identical updates on both layouts: still an exact
+        # replicated-vs-sharded parity probe
         v0 = float(v)
         t0 = time.perf_counter()
         for _ in range(reps):
@@ -2378,15 +2640,16 @@ def _anakin_worker(report: bool = True) -> dict:
         ts = prog.init(jax.random.key(0))
         dm = prog.init_metrics()
 
-        tc0 = time.perf_counter()
-        ts, dm, m = prog.dispatch(ts, dm)
-        jax.block_until_ready(m)
-        compile_s = time.perf_counter() - tc0
-        # second warmup: the donated outputs carry committed layouts that
-        # differ from init()'s fresh arrays, triggering one more compile —
-        # steady state starts at call 3
-        ts, dm, m = prog.dispatch(ts, dm)
-        jax.block_until_ready(m)
+        def fused_step():
+            nonlocal ts, dm
+            ts, dm, m = prog.dispatch(ts, dm)
+            return m
+
+        # the fused dispatch is registry-backed (anakin.dispatch), so its
+        # AOT layouts are committed at compile time: call 2 recompiling
+        # would be a silent cold-start regression, and bench_warmup asserts
+        # it does not happen
+        compile_s, m = bench_warmup(fused_step, assert_no_recompile=True)
         t0 = time.perf_counter()
         for _ in range(dispatches):
             ts, dm, m = prog.dispatch(ts, dm)
@@ -2421,11 +2684,15 @@ def _anakin_worker(report: bool = True) -> dict:
                 return params, opt, cstate, rng, hm
 
             steps = dispatches * spd
-            for _ in range(2):  # two warmups: layout-change recompile on call 2
+
+            def host_warm():  # raw jit: layout-change recompile on call 2
+                nonlocal params, opt, cstate, rng
                 params, opt, cstate, rng, hm = host_collector_step(
                     params, opt, cstate, rng
                 )
-            jax.block_until_ready(hm)
+                return hm
+
+            bench_warmup(host_warm, calls=2)
             t0 = time.perf_counter()
             for _ in range(steps):
                 params, opt, cstate, rng, hm = host_collector_step(params, opt, cstate, rng)
@@ -2457,11 +2724,16 @@ def _anakin_worker(report: bool = True) -> dict:
                 params, opt, rng, hm = upd(params, opt, rng, batch)
                 return params, opt, state, td, rng, hm
 
-            for s in (0, 1):  # two warmups: layout-change recompile on call 2
+            warm_seed = iter((10_000, 10_001))
+
+            def per_step_warm():  # raw jit: layout-change recompile on call 2
+                nonlocal params2, opt2, state, td, rng2
                 params2, opt2, state, td, rng2, hm = per_step_train(
-                    params2, opt2, state, td, rng2, 10_000 + s
+                    params2, opt2, state, td, rng2, next(warm_seed)
                 )
-            jax.block_until_ready(hm)
+                return hm
+
+            bench_warmup(per_step_warm, calls=2)
             ps_steps = max(1, steps // 2)
             t0 = time.perf_counter()
             for s in range(ps_steps):
@@ -2677,7 +2949,8 @@ def bench_all():
 
     weights = {"ppo": 2.0, "rlhf": 1.4, "pixel": 1.2, "hopper": 1.0,
                "sac": 1.0, "per": 1.0, "async_collect": 0.8, "serve": 0.8,
-               "fleet": 0.8, "multichip": 0.8, "anakin": 0.8, "chaos": 0.6}
+               "fleet": 0.8, "multichip": 0.8, "anakin": 0.8,
+               "compile": 0.8, "chaos": 0.6}
     deadline = _START + _TIMEOUT - 30.0  # safety margin for the final print
     pending = list(weights)
     results: dict = {}
@@ -2821,6 +3094,7 @@ if __name__ == "__main__":
             "fleet": bench_fleet,
             "multichip": bench_multichip,
             "anakin": bench_anakin,
+            "compile": bench_compile,
         }[mode]()
         timer.cancel()
         _maybe_write_metrics(_result)

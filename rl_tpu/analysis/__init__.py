@@ -9,6 +9,9 @@ R002   buffer referenced after passing through a ``donate_argnums`` dispatch
 R003   PRNG key consumed twice without an intervening split/rebind
 R004   recompile hazards: tracer-dependent Python branches, jit-in-loop
 R005   lock-order cycles over the package-wide lock-acquisition graph
+R006   raw ``jax.jit``/``jax.pjit`` in rl_tpu/models/ or rl_tpu/trainers/
+       bypassing the ProgramRegistry (not AOT-warmable, invisible to the
+       executable store and compile metrics)
 =====  =======================================================================
 
 CLI: ``python tools/rlint.py rl_tpu/`` — findings are gated by the
@@ -47,7 +50,7 @@ __all__ = [
     "lock_edges",
 ]
 
-ALL_RULES = ("R001", "R002", "R003", "R004", "R005")
+ALL_RULES = ("R001", "R002", "R003", "R004", "R005", "R006")
 
 
 def _module_name(path: str, root: str) -> str:

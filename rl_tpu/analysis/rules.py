@@ -23,6 +23,16 @@ R004 recompile hazards — tracer-dependent Python branches inside traced
     roots (``if`` on a non-static parameter retraces or crashes), and
     ``jax.jit`` calls constructed inside a loop (a fresh jit wrapper per
     iteration defeats the compile cache).
+
+R006 registry bypass — a literal ``jax.jit``/``jax.pjit`` (call or
+    decorator) inside ``rl_tpu/models/`` or ``rl_tpu/trainers/``. Hot
+    programs in those packages are expected to go through
+    :class:`rl_tpu.compile.ProgramRegistry`: a raw jit wrapper is
+    invisible to ``aot_warmup()``, the persistent executable store, and
+    the per-program compile metrics, so it silently re-pays the
+    cold-start tax this subsystem exists to kill. Intentional raw sites
+    (docstring examples, cold-path eval helpers) live in the baseline
+    with a reason.
 """
 
 from __future__ import annotations
@@ -471,7 +481,61 @@ def _r004(index: PackageIndex, m: ModuleIndex) -> list[Finding]:
     return out
 
 
-_RULES = {"R001": _r001, "R002": _r002, "R003": _r003, "R004": _r004}
+# -- R006 ---------------------------------------------------------------------
+
+# the packages whose hot programs must dispatch through the ProgramRegistry
+# (rl_tpu/compile/); matched against the module's repo-relative path
+_R006_SCOPE = ("rl_tpu/models/", "rl_tpu/trainers/")
+
+
+def _r006(index: PackageIndex, m: ModuleIndex) -> list[Finding]:
+    if not any(seg in m.path for seg in _R006_SCOPE):
+        return []
+    out: list[Finding] = []
+    seen: set = set()
+
+    def add(node, display: str, label: str) -> None:
+        if id(node) in seen:
+            return
+        seen.add(id(node))
+        out.append(Finding(
+            rule="R006", file=m.path, line=node.lineno,
+            qualname=display, snippet=m.snippet(node),
+            message=(
+                f"{label} bypasses the ProgramRegistry — the executable is "
+                "invisible to aot_warmup(), the persistent store, and the "
+                "compile metrics; register it via "
+                "rl_tpu.compile.get_program_registry().register(...)"
+            ),
+        ))
+
+    for fn in _iter_functions(m):
+        for dec in fn.node.decorator_list:
+            if isinstance(dec, ast.Call):
+                cname = canon(dec.func, m.aliases)
+                if cname in _JIT_NAMES:
+                    add(dec, fn.display, f"@{cname}(...) decorator")
+                elif (cname in {"functools.partial", "partial"} and dec.args
+                        and canon(dec.args[0], m.aliases) in _JIT_NAMES):
+                    add(dec, fn.display, "@partial(jax.jit, ...) decorator")
+            else:
+                cname = canon(dec, m.aliases)
+                if cname in _JIT_NAMES:
+                    add(dec, fn.display, f"@{cname} decorator")
+        for node in _body_nodes(fn):
+            if (isinstance(node, ast.Call)
+                    and canon(node.func, m.aliases) in _JIT_NAMES):
+                add(node, fn.display, canon(node.func, m.aliases))
+    # module/class-level sites outside any function body
+    for node in ast.walk(m.tree):
+        if (isinstance(node, ast.Call) and id(node) not in seen
+                and canon(node.func, m.aliases) in _JIT_NAMES):
+            add(node, "<module>", canon(node.func, m.aliases))
+    return out
+
+
+_RULES = {"R001": _r001, "R002": _r002, "R003": _r003, "R004": _r004,
+          "R006": _r006}
 
 
 def run_rules(index: PackageIndex, rules: set | None = None) -> list[Finding]:

@@ -1,0 +1,69 @@
+"""rl_tpu.compile — kill cold-start: AOT warm-up, persistent executables,
+shape-buckets, and compile observability (ROADMAP item 5).
+
+Four pieces, layered:
+
+- :mod:`~rl_tpu.compile.registry` — :class:`ProgramRegistry` /
+  :class:`CachedProgram`: named hot programs with explicit executable
+  tables, ``aot_warmup()`` (optionally backgrounded), and store-load →
+  lower+compile resolution.
+- :mod:`~rl_tpu.compile.store` — :class:`ExecutableStore`: serialized XLA
+  executables keyed by abstract call signature; a warm restart loads in
+  milliseconds instead of re-lowering for seconds.
+- :mod:`~rl_tpu.compile.buckets` — :class:`ShapeBuckets`: the shared
+  serving ladder (prompt lengths + admitted counts) that keeps request
+  dynamism inside a fixed, warmable program set.
+- :mod:`~rl_tpu.compile.metrics` — per-compile attribution
+  (``compiles_total{program}``, ``compile_seconds``, tracer spans) and
+  :class:`CompileDelta`, the steady-state no-recompile assertion.
+
+The JAX persistent compilation cache is enabled by the first registry via
+:func:`rl_tpu.config.enable_compile_cache` (opt-out
+``RL_TPU_NO_COMPILE_CACHE``); the executable store and AOT dispatch have
+their own opt-outs (``RL_TPU_NO_EXEC_STORE``, ``RL_TPU_NO_AOT``).
+"""
+
+from .buckets import ShapeBuckets, pow2ceil
+from .metrics import (
+    CompileDelta,
+    compile_counts,
+    compile_scope,
+    compile_seconds_total,
+    compiles_total,
+    install_compile_listener,
+)
+from .registry import (
+    CachedProgram,
+    ProgramRegistry,
+    WarmupHandle,
+    get_program_registry,
+    set_program_registry,
+)
+from .store import (
+    ExecutableStore,
+    abstract_like,
+    default_store,
+    set_default_store,
+    signature_of,
+)
+
+__all__ = [
+    "CachedProgram",
+    "abstract_like",
+    "CompileDelta",
+    "ExecutableStore",
+    "ProgramRegistry",
+    "ShapeBuckets",
+    "WarmupHandle",
+    "compile_counts",
+    "compile_scope",
+    "compile_seconds_total",
+    "compiles_total",
+    "default_store",
+    "get_program_registry",
+    "install_compile_listener",
+    "pow2ceil",
+    "set_default_store",
+    "set_program_registry",
+    "signature_of",
+]

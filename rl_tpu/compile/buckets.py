@@ -1,0 +1,110 @@
+"""Shape-bucketing: make request-shaped dynamism hit a FIXED program set.
+
+XLA compiles one executable per distinct input signature, so serving has
+two silent program multipliers:
+
+- **prompt length**: every new length is a new prefill shape. The engine
+  already rounds lengths up a *prompt ladder* (one prefill per bucket);
+  this module makes that ladder a first-class shared config instead of a
+  per-engine tuple, so :class:`~rl_tpu.models.fleet.ServingFleet` members
+  can never drift apart.
+- **admitted count**: the compact prefill batches only the slots admitted
+  this round, so its leading dim ``A`` ranges over ``1..n_slots`` — up to
+  ``n_slots x len(prompt ladder)`` programs from admission alone.
+  :meth:`ShapeBuckets.admit_bucket` rounds ``A`` up a power-of-two ladder
+  (capped at ``n_slots``); pad rows carry an all-False token mask, so the
+  paged cache routes their writes to the reserved scratch block and the
+  host simply never reads their sampled tokens. O(n_slots) admit shapes
+  become O(log n_slots).
+
+With both ladders warmed by ``aot_warmup()``, steady-state traffic is
+*provably* recompile-free — :class:`~rl_tpu.compile.metrics.CompileDelta`
+around a traffic window asserts the compile counter did not move.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import operator
+
+__all__ = ["ShapeBuckets", "pow2ceil"]
+
+
+def pow2ceil(n: int) -> int:
+    """Smallest power of two >= n (1 for n <= 1)."""
+    # operator.index, not int(): accepts np integer scalars but can never
+    # force a device sync, so the hot admit path stays sync-free (R001).
+    # n <= 1 handled explicitly: (-1).bit_length() is 1, not 0.
+    n = operator.index(n)
+    return 1 if n <= 1 else 1 << (n - 1).bit_length()
+
+
+@dataclasses.dataclass(frozen=True)
+class ShapeBuckets:
+    """The shared serving bucket config (engine + fleet use ONE instance).
+
+    Args:
+        prompt: ascending prompt-length ladder; admission rounds each
+            prompt length up to the next rung (one prefill program per
+            rung instead of one per length).
+        admit_pow2: round the admitted-count dim of the compact prefill
+            up a power-of-two ladder (False keeps the legacy exact-count
+            behavior: more programs, no pad rows).
+    """
+
+    prompt: tuple = (32, 128, 512)
+    admit_pow2: bool = True
+
+    def __post_init__(self):
+        p = tuple(int(b) for b in self.prompt)
+        if not p or any(b <= 0 for b in p) or list(p) != sorted(set(p)):
+            raise ValueError(
+                f"prompt ladder must be ascending positive ints, got {self.prompt}"
+            )
+        object.__setattr__(self, "prompt", p)
+
+    # -- prompt ladder ---------------------------------------------------
+
+    @property
+    def max_prompt(self) -> int:
+        return self.prompt[-1]
+
+    def fits(self, length: int) -> bool:
+        return length <= self.prompt[-1]
+
+    def prompt_bucket(self, length: int) -> int:
+        """Round a prompt length up to its ladder rung."""
+        for b in self.prompt:
+            if length <= b:
+                return b
+        raise ValueError(
+            f"prompt length {length} exceeds the largest bucket {self.prompt[-1]}"
+        )
+
+    # -- admit ladder ----------------------------------------------------
+
+    def admit_bucket(self, count: int, cap: int) -> int:
+        """Round an admitted count up its ladder rung (never past ``cap``,
+        the engine's slot count)."""
+        if count < 1 or count > cap:
+            raise ValueError(f"admit count {count} outside 1..{cap}")
+        if not self.admit_pow2:
+            return count
+        return min(pow2ceil(count), cap)
+
+    def admit_sizes(self, cap: int) -> tuple:
+        """Every admit-dim size programs can see (the warm-up set)."""
+        if not self.admit_pow2:
+            return tuple(range(1, cap + 1))
+        sizes = []
+        s = 1
+        while s < cap:
+            sizes.append(s)
+            s *= 2
+        sizes.append(cap)
+        return tuple(sizes)
+
+    def program_count(self, cap: int) -> int:
+        """Prefill programs a fully-warmed engine holds (steady-state
+        ceiling: the compile counter must not move past this set)."""
+        return len(self.admit_sizes(cap)) * len(self.prompt)

@@ -1,0 +1,422 @@
+"""ProgramRegistry: every hot jitted program, registered, AOT-compiled,
+and persistently cached.
+
+``jax.jit`` hides three costs behind the first call: trace, lower, and
+backend-compile — 20-40s per fused program on the cpu tier (ROADMAP item
+5), multiplied by every mesh topology, fleet member, and chunk size. The
+registry replaces anonymous ``jax.jit(fn)`` sites with *named* programs:
+
+- :meth:`ProgramRegistry.register` returns a :class:`CachedProgram` that
+  is called exactly like the jitted function, but routes every dispatch
+  through an explicit executable table instead of jit's hidden dispatch
+  cache. A signature miss resolves store-load → lower+compile (never the
+  reverse), so a warm process *loads* serialized executables and skips
+  ``lower()`` entirely.
+- :meth:`CachedProgram.add_signature` records the program's abstract call
+  signature (``jax.ShapeDtypeStruct`` pytrees); :meth:`aot_warmup` then
+  drives ``jit.lower().compile()`` (or the store load) for the whole
+  registered set — optionally on a background thread, so warm-up overlaps
+  host setup (env construction, checkpoint IO, TCP binds).
+- every compile is attributed to its program name via
+  :func:`~rl_tpu.compile.metrics.compile_scope`, feeding the
+  ``rl_tpu_compiles_total{program}`` counter and the per-compile tracer
+  span (observability satellite).
+
+The registry holds programs by *weak* reference: a ``CachedProgram``
+usually closes over its trainer/engine (bound methods), and a process
+that constructs many short-lived engines (the test suite, a fleet churn
+bench) must not leak every one of them through a global table.
+
+Opt-outs: ``RL_TPU_NO_AOT=1`` keeps registration (names, metrics) but
+dispatches through plain ``jax.jit``; the persistent layers have their
+own knobs (``RL_TPU_NO_EXEC_STORE``, ``RL_TPU_NO_COMPILE_CACHE``).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+import weakref
+from typing import Any, Callable, Iterable
+
+from .metrics import compile_scope, install_compile_listener
+from .store import ExecutableStore, default_store
+
+__all__ = [
+    "CachedProgram",
+    "ProgramRegistry",
+    "WarmupHandle",
+    "get_program_registry",
+    "set_program_registry",
+]
+
+_ENV_NO_AOT = "RL_TPU_NO_AOT"
+
+
+def _memkey(args: tuple) -> tuple:
+    """Cheap per-call signature: tree structure + per-leaf shape/dtype.
+
+    This is the in-memory executable-table key, computed on EVERY
+    dispatch — so no hashing, no sharding reprs, just the tuple jit's own
+    dispatch would build. Shardings are deliberately excluded: one
+    CachedProgram belongs to one trainer/engine, which pins placements at
+    construction (the persistent-store key DOES include them)."""
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(args)
+    return (
+        treedef,
+        tuple(
+            (getattr(x, "shape", None), str(getattr(x, "dtype", type(x).__name__)))
+            for x in leaves
+        ),
+    )
+
+
+class CachedProgram:
+    """A registered program: called like ``jax.jit(fn)``, dispatched via
+    an explicit executable table with store-load → compile resolution.
+
+    ``stats`` counts the events the cold-start tests assert on:
+    ``compiles`` (entered ``lower()``), ``loads`` (deserialized from the
+    store), ``aot_hits`` (dispatched straight to a cached executable).
+    """
+
+    def __init__(
+        self,
+        name: str,
+        fn: Callable,
+        *,
+        registry: "ProgramRegistry",
+        fingerprint: str = "",
+        **jit_kwargs: Any,
+    ):
+        import jax
+
+        self.name = name
+        self.fn = fn
+        self.fingerprint = fingerprint
+        self.jit_kwargs = jit_kwargs
+        self._registry = registry
+        self._jit = jax.jit(fn, **jit_kwargs)
+        self._lock = threading.Lock()
+        self._compiled: dict[tuple, Any] = {}
+        self._unvalidated: set[tuple] = set()  # store-loads before 1st call
+        self._signatures: list[tuple] = []
+        self.stats = {
+            "calls": 0,
+            "aot_hits": 0,
+            "compiles": 0,
+            "loads": 0,
+            "jit_calls": 0,
+            "compile_s": 0.0,
+            "load_s": 0.0,
+        }
+
+    # -- keys ------------------------------------------------------------
+
+    def _store_extra(self) -> str:
+        # donation/shardings change the executable; they are part of the
+        # persistent identity (sorted for dict-order stability)
+        return repr(sorted((k, repr(v)) for k, v in self.jit_kwargs.items()))
+
+    def store_key(self, args: tuple) -> str:
+        return self._registry.store.key_for(
+            self.name, args, fingerprint=self.fingerprint, extra=self._store_extra()
+        )
+
+    # -- warm-up ---------------------------------------------------------
+
+    def add_signature(self, *abstract_args: Any) -> "CachedProgram":
+        """Record an abstract call signature (``ShapeDtypeStruct`` trees)
+        for :meth:`warmup` / registry-level ``aot_warmup``. Idempotent on
+        shape/dtype, so re-warming (restart paths call it again) doesn't
+        grow the list."""
+        mk = _memkey(abstract_args)
+        with self._lock:
+            if all(_memkey(s) != mk for s in self._signatures):
+                self._signatures.append(abstract_args)
+        return self
+
+    @property
+    def signatures(self) -> list[tuple]:
+        with self._lock:
+            return list(self._signatures)
+
+    def warmup(self, *args: Any) -> tuple[str, float]:
+        """Materialize the executable for one signature (abstract or
+        concrete args — only shapes/dtypes are read). Returns
+        ``(source, seconds)`` with source one of ``"memory"``/``"store"``
+        /``"compile"``."""
+        mk = _memkey(args)
+        with self._lock:
+            if mk in self._compiled:
+                return ("memory", 0.0)
+        key = self.store_key(args)
+        t0 = time.perf_counter()
+        prog = self._registry.store.load(key)
+        if prog is not None:
+            dt = time.perf_counter() - t0
+            with self._lock:
+                self._compiled[mk] = prog
+                self._unvalidated.add(mk)
+                self.stats["loads"] += 1
+                self.stats["load_s"] += dt
+            return ("store", dt)
+        prog, dt = self._compile(args)
+        return ("compile", dt)
+
+    def _compile(self, args: tuple) -> tuple[Any, float]:
+        mk = _memkey(args)
+        t0 = time.perf_counter()
+        with compile_scope(self.name):
+            prog = self._jit.lower(*args).compile()
+        dt = time.perf_counter() - t0
+        with self._lock:
+            self._compiled[mk] = prog
+            self._unvalidated.discard(mk)
+            self.stats["compiles"] += 1
+            self.stats["compile_s"] += dt
+        self._registry.store.save(
+            key=self.store_key(args), compiled=prog, meta={"name": self.name}
+        )
+        return prog, dt
+
+    # -- dispatch --------------------------------------------------------
+
+    def __call__(self, *args: Any):
+        self.stats["calls"] += 1
+        if self._registry.aot_disabled:
+            self.stats["jit_calls"] += 1
+            with compile_scope(self.name):
+                return self._jit(*args)
+        mk = _memkey(args)
+        with self._lock:
+            prog = self._compiled.get(mk)
+            fresh_load = mk in self._unvalidated
+        if prog is None:
+            src, _ = self.warmup(*args)
+            fresh_load = src == "store"
+            with self._lock:
+                prog = self._compiled[mk]
+        else:
+            self.stats["aot_hits"] += 1
+        if not fresh_load:
+            return prog(*args)
+        # first call of a deserialized executable: an incompatible entry
+        # (stale jax/XLA, foreign topology) surfaces here — evict it and
+        # fall back to a real compile rather than wedging the caller
+        try:
+            out = prog(*args)
+        except Exception:
+            self._registry.store.evict(self.store_key(args))
+            with self._lock:
+                self._compiled.pop(mk, None)
+                self._unvalidated.discard(mk)
+            prog, _ = self._compile(args)
+            return prog(*args)
+        with self._lock:
+            self._unvalidated.discard(mk)
+        return out
+
+    def program_count(self) -> int:
+        with self._lock:
+            return len(self._compiled)
+
+
+class WarmupHandle:
+    """Background ``aot_warmup``: join via :meth:`result` (re-raises any
+    warm-up failure there, never in the worker thread)."""
+
+    def __init__(self, thread: threading.Thread, box: dict):
+        self._thread = thread
+        self._box = box
+
+    def done(self) -> bool:
+        return not self._thread.is_alive()
+
+    def result(self, timeout: float | None = None) -> dict:
+        self._thread.join(timeout)
+        if self._thread.is_alive():
+            raise TimeoutError("aot_warmup still running")
+        if "error" in self._box:
+            raise self._box["error"]
+        return self._box["result"]
+
+
+class ProgramRegistry:
+    """Process-wide table of named hot programs (weakly held).
+
+    Construction wires the two persistent layers: the JAX compilation
+    cache (:func:`rl_tpu.config.enable_compile_cache`, opt-out
+    ``RL_TPU_NO_COMPILE_CACHE``) and the executable store (opt-out
+    ``RL_TPU_NO_EXEC_STORE``), plus the compile-event listener feeding
+    ``/metrics``.
+    """
+
+    def __init__(self, store: ExecutableStore | None = None, aot: bool | None = None):
+        from ..config import enable_compile_cache
+
+        enable_compile_cache()
+        install_compile_listener()
+        self.store = store if store is not None else default_store()
+        if aot is None:
+            aot = os.environ.get(_ENV_NO_AOT, "") in ("", "0")
+        self.aot_disabled = not aot
+        self._lock = threading.Lock()
+        self._programs: dict[str, list] = {}  # name -> [weakref.ref]
+
+    # -- registration ----------------------------------------------------
+
+    def register(
+        self,
+        name: str,
+        fn: Callable,
+        *,
+        fingerprint: str = "",
+        **jit_kwargs: Any,
+    ) -> CachedProgram:
+        """Create a :class:`CachedProgram` for ``fn`` under ``name``.
+        ``jit_kwargs`` go to ``jax.jit`` (donate_argnums, in_shardings,
+        ...); ``fingerprint`` distinguishes same-name/same-shape programs
+        whose Python closures differ (model config, loss flavor)."""
+        prog = CachedProgram(
+            name, fn, registry=self, fingerprint=fingerprint, **jit_kwargs
+        )
+        with self._lock:
+            refs = self._programs.setdefault(name, [])
+            refs.append(weakref.ref(prog))
+        return prog
+
+    def _alive(self, name: str) -> list[CachedProgram]:
+        with self._lock:
+            refs = self._programs.get(name, [])
+            progs = [p for r in refs if (p := r()) is not None]
+            self._programs[name] = [weakref.ref(p) for p in progs]
+        return progs
+
+    def names(self) -> list[str]:
+        with self._lock:
+            names = list(self._programs)
+        return sorted(n for n in names if self._alive(n))
+
+    def program(self, name: str) -> CachedProgram:
+        """The most recently registered live program under ``name``."""
+        progs = self._alive(name)
+        if not progs:
+            raise KeyError(f"no live program registered as {name!r}")
+        return progs[-1]
+
+    def programs(self) -> list[CachedProgram]:
+        return [p for n in self.names() for p in self._alive(n)]
+
+    # -- warm-up ---------------------------------------------------------
+
+    def aot_warmup(
+        self,
+        names: Iterable[str] | None = None,
+        *,
+        programs: Iterable[CachedProgram] | None = None,
+        background: bool = False,
+    ) -> dict | WarmupHandle:
+        """Drive ``lower().compile()`` (or store loads) for every recorded
+        signature of the named programs (default: all live programs), or
+        of an explicit ``programs`` iterable (how an engine warms exactly
+        its own set). Returns ``{name: [(source, seconds), ...]}``, or a
+        :class:`WarmupHandle` when ``background=True`` so warm-up overlaps
+        host setup."""
+        if programs is not None:
+            todo = list(programs)
+        else:
+            want = list(names) if names is not None else self.names()
+            todo = [p for name in want for p in self._alive(name)]
+
+        def work() -> dict:
+            out: dict[str, list] = {}
+            for prog in todo:
+                for sig in prog.signatures:
+                    out.setdefault(prog.name, []).append(prog.warmup(*sig))
+            return out
+
+        if not background:
+            return work()
+        box: dict = {}
+
+        def run():
+            try:
+                box["result"] = work()
+            except BaseException as e:  # surfaced at .result()
+                box["error"] = e
+
+        t = threading.Thread(target=run, name="aot-warmup", daemon=True)
+        t.start()
+        return WarmupHandle(t, box)
+
+    # -- introspection ---------------------------------------------------
+
+    def stats(self) -> dict:
+        """Aggregated per-name stats (all live instances summed)."""
+        out: dict[str, dict] = {}
+        for name in self.names():
+            agg: dict[str, float] = {}
+            n_exec = 0
+            for p in self._alive(name):
+                n_exec += p.program_count()
+                for k, v in p.stats.items():
+                    agg[k] = agg.get(k, 0) + v
+            agg["executables"] = n_exec
+            out[name] = agg
+        return out
+
+
+_default: ProgramRegistry | None = None
+_default_lock = threading.Lock()
+
+
+def get_program_registry() -> ProgramRegistry:
+    """The process-default registry (created on first use)."""
+    global _default
+    with _default_lock:
+        if _default is None:
+            _default = ProgramRegistry()
+            _wire_obs(_default)
+        return _default
+
+
+def set_program_registry(reg: ProgramRegistry | None) -> ProgramRegistry | None:
+    """Swap the process default (tests pair this with a tmpdir store);
+    returns the previous registry."""
+    global _default
+    with _default_lock:
+        prev = _default
+        _default = reg
+        return prev
+
+
+def _wire_obs(reg: ProgramRegistry) -> None:
+    """Publish registry totals as gauges at scrape time (the per-compile
+    counter/histogram are fed by the metrics listener, not here)."""
+    try:
+        from ..obs import get_registry
+
+        obs = get_registry()
+        g_progs = obs.gauge(
+            "rl_tpu_aot_programs", "registered hot programs (live)"
+        )
+        g_exec = obs.gauge(
+            "rl_tpu_aot_executables", "materialized executables across programs"
+        )
+        g_loads = obs.gauge(
+            "rl_tpu_aot_store_loads", "executables deserialized from the store"
+        )
+
+        def collect():
+            stats = reg.stats()
+            g_progs.set(float(len(stats)))
+            g_exec.set(float(sum(s["executables"] for s in stats.values())))
+            g_loads.set(float(sum(s["loads"] for s in stats.values())))
+
+        obs.register_collector(collect)
+    except Exception:
+        pass

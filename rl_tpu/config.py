@@ -22,7 +22,45 @@ import functools
 import importlib
 from typing import Any, Callable, Mapping, Sequence
 
-__all__ = ["register", "get_component", "instantiate", "load_yaml", "to_dict", "REGISTRY"]
+__all__ = [
+    "register", "get_component", "instantiate", "load_yaml", "to_dict",
+    "REGISTRY", "enable_compile_cache",
+]
+
+# -- persistent compilation cache (ROADMAP item 5) --------------------------
+#
+# Wired by default: the first ProgramRegistry (any registered trainer or
+# serving engine) calls enable_compile_cache(), so every XLA backend compile
+# lands in an on-disk cache keyed by optimized HLO and a process restart
+# skips the backend-compile half of cold start. Opt out with
+# RL_TPU_NO_COMPILE_CACHE=1; point the cache elsewhere (CI sandboxes, test
+# tmpdirs) with RL_TPU_COMPILE_CACHE_DIR.
+
+_ENV_NO_CACHE = "RL_TPU_NO_COMPILE_CACHE"
+_ENV_CACHE_DIR = "RL_TPU_COMPILE_CACHE_DIR"
+
+
+def enable_compile_cache() -> str | None:
+    """Idempotently enable JAX's persistent compilation cache. Returns the
+    cache dir in use, or None when opted out. A dir already configured
+    (bench/_setup_jax, tests/conftest) is respected, not overridden."""
+    import os
+
+    if os.environ.get(_ENV_NO_CACHE, "") not in ("", "0"):
+        return None
+    import jax
+
+    current = jax.config.jax_compilation_cache_dir
+    if current:
+        return current
+    path = os.environ.get(_ENV_CACHE_DIR) or os.path.expanduser(
+        "~/.cache/rl_tpu_jax_cache"
+    )
+    jax.config.update("jax_compilation_cache_dir", path)
+    # fused trainer programs are the target; sub-second toy programs churn
+    # the cache for no win
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    return path
 
 REGISTRY: dict[str, Callable] = {}
 

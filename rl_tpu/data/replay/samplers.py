@@ -299,6 +299,55 @@ class PrioritizedSampler(Sampler):
         )
         return idx, info, sstate
 
+    def jit_sample_and_update(
+        self,
+        priority_fn: Callable[[jax.Array, ArrayDict], jax.Array],
+        batch_size: int,
+        capacity: int,
+        *,
+        donate: bool = True,
+        fingerprint: str = "",
+        warmup: bool = False,
+    ):
+        """The fused PER cycle as a registered hot program
+        (``per.sample_and_update`` in the
+        :class:`~rl_tpu.compile.ProgramRegistry`): named compile
+        attribution, ``aot_warmup``, and the persistent executable store,
+        instead of an anonymous ``jax.jit`` at every call site.
+
+        Returns ``prog(sstate, key, size) -> (idx, info, sstate)`` with
+        ``batch_size``/``capacity`` closed over (they are static) and, by
+        default, ``sstate`` donated — XLA updates the tree in place.
+        ``fingerprint`` must distinguish callers whose ``priority_fn``
+        closures differ (e.g. hash of the learner config); ``warmup=True``
+        AOT-compiles eagerly from :meth:`init`'s abstract layout.
+        """
+        from ...compile import abstract_like, get_program_registry
+
+        def fused(sstate, key, size):
+            return self.sample_and_update(
+                sstate, key, batch_size, size, capacity, priority_fn
+            )
+
+        registry = get_program_registry()
+        prog = registry.register(
+            "per.sample_and_update",
+            fused,
+            fingerprint=repr((
+                self.alpha, self.beta0, self.eps, self.beta_annealing_steps,
+                self.fanout, batch_size, capacity, fingerprint,
+            )),
+            donate_argnums=(0,) if donate else (),
+        )
+        if warmup:
+            prog.add_signature(
+                abstract_like(self.init(capacity)),
+                jax.ShapeDtypeStruct((), jax.random.key(0).dtype),
+                jax.ShapeDtypeStruct((), jnp.int32),
+            )
+            registry.aot_warmup(programs=[prog])
+        return prog
+
 
 class StalenessAwareSampler(Sampler):
     """Freshness-weighted sampling (reference StalenessAwareSampler,

@@ -198,6 +198,19 @@ class ServingFleet:
         engines = list(engines)
         if not engines:
             raise ValueError("ServingFleet needs at least one engine")
+        # the fleet's shape contract: every member must run the SAME
+        # bucket ladder, otherwise failover re-dispatch lands a request on
+        # a member whose compiled program set doesn't cover its shape (a
+        # surprise compile inside the stepper — exactly what the AOT
+        # subsystem exists to prevent)
+        b0 = engines[0].shape_buckets
+        for i, e in enumerate(engines[1:], start=1):
+            if e.shape_buckets != b0:
+                raise ValueError(
+                    f"fleet members must share one ShapeBuckets config: "
+                    f"engine 0 has {b0}, engine {i} has {e.shape_buckets}"
+                )
+        self.shape_buckets = b0
         self._members = [_Member(i, e) for i, e in enumerate(engines)]
         self.probe_interval_s = probe_interval_s
         self.quarantine_after = quarantine_after
@@ -346,6 +359,25 @@ class ServingFleet:
             on_giveup=self._on_control_giveup,
         )
         return self
+
+    def aot_warmup(self, *, background: bool = False):
+        """Pre-compile (or reload from the executable store) every member's
+        whole program ladder BEFORE ``start()``, so steppers never hit a
+        first-use XLA compile under the probe watchdog and steady-state
+        traffic stays at compile-delta zero.
+
+        Members share one :class:`~rl_tpu.compile.ShapeBuckets` (enforced
+        at construction), so identical replicas dedup through the store:
+        member 0 pays the compile, members 1..N-1 load the serialized
+        executable. Returns ``{member_index: {program: [(source, s)]}}``,
+        or a list of :class:`~rl_tpu.compile.WarmupHandle` when
+        ``background=True``.
+        """
+        if background:
+            return [m.engine.aot_warmup(background=True) for m in self._members]
+        return {
+            m.idx: m.engine.aot_warmup(background=False) for m in self._members
+        }
 
     def shutdown(self) -> None:
         self._stop.set()

@@ -52,6 +52,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..analysis import hot_path
+from ..compile import ShapeBuckets, get_program_registry
 from ..obs.device import DeviceMetrics
 
 __all__ = [
@@ -169,6 +170,19 @@ class ContinuousBatchingEngine:
             e.g. from :func:`rl_tpu.parallel.fsdp_sharding`) every params
             assignment is pinned to — weight pushes that already match
             alias buffers instead of copying.
+        buckets: a :class:`rl_tpu.compile.ShapeBuckets` shared shape
+            config (supersedes ``prompt_buckets``; a fleet passes ONE
+            instance to every member). Besides the prompt ladder it
+            rounds the compact prefill's admitted-count dim up a
+            power-of-two ladder, so admission shapes come from a fixed,
+            warmable set instead of one program per admitted count.
+        registry: the :class:`rl_tpu.compile.ProgramRegistry` the
+            engine's programs register with (default: the process one).
+            ``aot_warmup()`` pre-compiles — or reloads from the
+            persistent executable store — the whole ladder.
+        warmup: ``True`` runs :meth:`aot_warmup` before construction
+            returns; ``"background"`` runs it on a thread (handle at
+            ``self._warmup_handle``) overlapped with remaining setup.
     """
 
     def __init__(
@@ -187,6 +201,9 @@ class ContinuousBatchingEngine:
         seed: int = 0,
         decode_chunk: int | str = 1,
         params_sharding: Any = None,
+        buckets: ShapeBuckets | None = None,
+        registry: Any = None,
+        warmup: bool | str = False,
     ):
         # placement is applied by the params setter, so it must exist
         # before the first assignment below
@@ -195,7 +212,10 @@ class ContinuousBatchingEngine:
         self.n_slots, self.block = n_slots, block_size
         self.max_seq_len = max_seq_len or model.cfg.max_seq_len
         self.max_blocks = -(-self.max_seq_len // block_size)
-        self.buckets = tuple(sorted(prompt_buckets))
+        if buckets is None:
+            buckets = ShapeBuckets(prompt=tuple(sorted(prompt_buckets)))
+        self.shape_buckets = buckets
+        self.buckets = buckets.prompt
         self.eos_id = eos_id
         self.temperature, self.greedy = temperature, greedy
         self.decode_chunk = decode_chunk
@@ -258,9 +278,29 @@ class ContinuousBatchingEngine:
         self._obs_spec = DeviceMetrics(counters=("tokens",))
         self.dev_obs = self._obs_spec.init()
 
-        self._decode_progs: dict[int, Any] = {}  # chunk K -> jitted program
-        self._prefills: dict[tuple, Any] = {}  # (A, bucket) -> jitted prefill
-        self._admit_update = jax.jit(_admit_update_fn)
+        # every hot program is a registry-named CachedProgram: compiles are
+        # attributed per program on /metrics, executables persist in the
+        # store (a restarted replica loads instead of recompiling), and
+        # aot_warmup() can pre-build the whole ladder
+        self._registry = registry if registry is not None else get_program_registry()
+        # same name + same abstract shapes must not collide across engines
+        # serving different models/sampling configs
+        self._fingerprint = repr((
+            type(model).__name__, getattr(model, "cfg", None),
+            float(temperature), bool(greedy), eos_id,
+        ))
+        self._decode_progs: dict[int, Any] = {}  # chunk K -> CachedProgram
+        self._prefills: dict[tuple, Any] = {}  # (A, bucket) -> CachedProgram
+        self._admit_update = self._registry.register(
+            "serving.admit_update", _admit_update_fn
+        )
+        # warmup=True builds the whole ladder before __init__ returns;
+        # "background" overlaps it with the caller's remaining setup
+        self._warmup_handle = None
+        if warmup == "background":
+            self._warmup_handle = self.aot_warmup(background=True)
+        elif warmup:
+            self.aot_warmup()
 
     @property
     def params(self):
@@ -372,7 +412,19 @@ class ContinuousBatchingEngine:
                 dm,
             )
 
-        prog = self._decode_progs[chunk] = jax.jit(fn)
+        prog = self._decode_progs[chunk] = self._registry.register(
+            f"serving.decode.k{chunk}", fn, fingerprint=self._fingerprint
+        )
+        return prog
+
+    def _get_prefill_prog(self, a: int, bucket: int):
+        prog = self._prefills.get((a, bucket))
+        if prog is None:
+            prog = self._prefills[(a, bucket)] = self._registry.register(
+                f"serving.prefill.a{a}.b{bucket}",
+                self._prefill_fn,
+                fingerprint=self._fingerprint,
+            )
         return prog
 
     def _sample(self, logits, key):
@@ -461,6 +513,83 @@ class ContinuousBatchingEngine:
 
     # -- public surface --------------------------------------------------------
 
+    def aot_warmup(
+        self,
+        *,
+        decode_chunks=None,
+        admit_sizes=None,
+        prompt_buckets=None,
+        background: bool = False,
+    ):
+        """Pre-build the engine's whole program ladder ahead of traffic.
+
+        Every ``(admit size x prompt bucket)`` prefill, every decode-chunk
+        program, and the admit merge get their abstract signatures
+        registered and driven through ``lower().compile()`` — or loaded
+        from the persistent executable store when a previous process
+        already built them. After this, steady-state traffic is
+        recompile-free (assert it with
+        :class:`rl_tpu.compile.CompileDelta`).
+
+        Defaults cover the full ladder: all admit sizes x all prompt
+        buckets, and the fixed decode chunk (or the auto-tuner's whole
+        ladder when ``decode_chunk="auto"``). ``background=True`` returns
+        a :class:`rl_tpu.compile.WarmupHandle` so compilation overlaps
+        host setup (fleet membership, TCP binds, checkpoint IO).
+        """
+
+        def absval(x):
+            return jax.ShapeDtypeStruct(x.shape, x.dtype)
+
+        params_abs = jax.tree.map(absval, self.params)
+        pools_abs = tuple(
+            (absval(layer["pool_k"]), absval(layer["pool_v"]))
+            for layer in self.cache
+        )
+        key_abs = absval(self._key)
+        S = self.n_slots
+        table_abs = jax.ShapeDtypeStruct((S, self.max_blocks), jnp.int32)
+        vec_i32 = jax.ShapeDtypeStruct((S,), jnp.int32)
+        vec_bool = jax.ShapeDtypeStruct((S,), jnp.bool_)
+        dm_abs = jax.tree.map(absval, self.dev_obs)
+        progs = []
+        if decode_chunks is None:
+            decode_chunks = (
+                (self._fixed_chunk,)
+                if self._fixed_chunk is not None
+                else _ChunkTuner.LADDER
+            )
+        for chunk in decode_chunks:
+            prog = self._get_decode_prog(int(chunk))
+            prog.add_signature(
+                params_abs, pools_abs, table_abs, vec_i32, vec_bool,
+                vec_i32, vec_i32, vec_bool, key_abs, dm_abs,
+            )
+            progs.append(prog)
+        if admit_sizes is None:
+            admit_sizes = self.shape_buckets.admit_sizes(S)
+        if prompt_buckets is None:
+            prompt_buckets = self.buckets
+        for a in admit_sizes:
+            for b in prompt_buckets:
+                a, b = int(a), int(b)
+                prog = self._get_prefill_prog(a, b)
+                prog.add_signature(
+                    params_abs,
+                    pools_abs,
+                    jax.ShapeDtypeStruct((a, self.max_blocks), jnp.int32),
+                    jax.ShapeDtypeStruct((a, b), jnp.int32),
+                    jax.ShapeDtypeStruct((a, b), jnp.bool_),
+                    key_abs,
+                )
+                progs.append(prog)
+        self._admit_update.add_signature(
+            vec_i32, vec_bool, vec_i32, vec_i32,
+            vec_bool, vec_i32, vec_i32, vec_i32,
+        )
+        progs.append(self._admit_update)
+        return self._registry.aot_warmup(programs=progs, background=background)
+
     def metrics_snapshot(self) -> dict:
         """Flat host dict of the engine's telemetry. The only device read
         is the on-device token counter (one explicit transfer), so calling
@@ -530,11 +659,19 @@ class ContinuousBatchingEngine:
             batch.append((s, self.queue.pop(0)))
         if not batch:
             return
-        bucket = _bucket(max(len(r.prompt) for _, r in batch), self.buckets)
+        bucket = self.shape_buckets.prompt_bucket(
+            max(len(r.prompt) for _, r in batch)
+        )
         A = len(batch)
         self.admissions += A
-        tokens = np.zeros((A, bucket), np.int32)
-        mask = np.zeros((A, bucket), bool)
+        # round the admitted-count dim up its ladder: the pad rows carry an
+        # all-False token mask, so the paged cache routes their writes to
+        # the reserved scratch block and the host never reads their rows —
+        # admission shapes come from a FIXED set instead of one program per
+        # count (the serving shape-bucket tentpole)
+        pad_a = self.shape_buckets.admit_bucket(A, self.n_slots)
+        tokens = np.zeros((pad_a, bucket), np.int32)
+        mask = np.zeros((pad_a, bucket), bool)
         for i, (s, req) in enumerate(batch):
             P = len(req.prompt)
             tokens[i, :P] = req.prompt
@@ -543,17 +680,18 @@ class ContinuousBatchingEngine:
             self.slot_prompt[req.rid] = req.prompt
             self.slot_tokens[s] = []
             self.slot_lps[s] = []
-        slots = [s for s, _ in batch]
+        # pad rows gather slot 0's (or any) table row — harmless, since an
+        # inactive row never writes through its table and reads are masked
+        slots = np.zeros(pad_a, np.int64)
+        slots[:A] = [s for s, _ in batch]
         self._flush_table_writes()  # prefill reads the new rows on device
         self._key, k = jax.random.split(self._key)
-        fn = self._prefills.get((A, bucket))
-        if fn is None:
-            fn = self._prefills[(A, bucket)] = jax.jit(self._prefill_fn)
+        fn = self._get_prefill_prog(pad_a, bucket)
         pools = tuple((layer["pool_k"], layer["pool_v"]) for layer in self.cache)
         tok, lp, new_pools = fn(
             self.params,
             pools,
-            self.dev_table[jnp.asarray(np.asarray(slots))],
+            self.dev_table[jnp.asarray(slots)],
             jnp.asarray(tokens),
             jnp.asarray(mask),
             k,

@@ -262,9 +262,22 @@ class AnakinProgram:
         return ts, dm, jax.tree.map(lambda x: x.mean(), metrics)
 
     def _build_dispatch(self, ts: dict, dm: dict | None):
+        from ..compile import get_program_registry
+
+        registry = get_program_registry()
+        fingerprint = repr((
+            type(self.env).__name__, self.config,
+            type(self.inner.loss).__name__,
+            None if self.mesh is None else sorted(self.mesh.shape.items()),
+        ))
         donate = (0,) if self.config.donate else ()
         if self.mesh is None:
-            return jax.jit(self._dispatch_impl, donate_argnums=donate)
+            return registry.register(
+                "anakin.dispatch",
+                self._dispatch_impl,
+                fingerprint=fingerprint,
+                donate_argnums=donate,
+            )
         from ..parallel.mesh import replicated, train_state_shardings
 
         ts_sh = train_state_shardings(
@@ -277,8 +290,10 @@ class AnakinProgram:
         dm_sh = jax.tree.map(lambda _: repl, dm)
         # out ts/dm pinned to the in layout: donation reuses buffers in
         # place, no silent reshard copy; metrics placement left to XLA
-        return jax.jit(
+        return registry.register(
+            "anakin.dispatch",
             self._dispatch_impl,
+            fingerprint=fingerprint,
             donate_argnums=donate,
             in_shardings=(ts_sh, dm_sh),
             out_shardings=(ts_sh, dm_sh, None),
@@ -290,6 +305,22 @@ class AnakinProgram:
         if self._jit_dispatch is None:
             self._jit_dispatch = self._build_dispatch(ts, dm)
         return self._jit_dispatch(ts, dm)
+
+    def aot_warmup(self, ts: dict, dm: dict | None = None, *, background: bool = False):
+        """Pre-compile (or reload from the executable store) the fused
+        dispatch program for ``ts``/``dm``'s exact layout before the first
+        :meth:`run` loop. ``ts`` is :meth:`init`'s result and ``dm``
+        :meth:`init_metrics`'s (only shapes/dtypes/shardings are read, so
+        a restored checkpoint works too). Returns the registry report, or
+        a :class:`~rl_tpu.compile.WarmupHandle` when backgrounded."""
+        from ..compile import abstract_like, get_program_registry
+
+        if self._jit_dispatch is None:
+            self._jit_dispatch = self._build_dispatch(ts, dm)
+        self._jit_dispatch.add_signature(abstract_like(ts), abstract_like(dm))
+        return get_program_registry().aot_warmup(
+            programs=[self._jit_dispatch], background=background
+        )
 
     # -- host loop -------------------------------------------------------------
 

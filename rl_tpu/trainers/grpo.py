@@ -45,6 +45,7 @@ from jax.sharding import NamedSharding, PartitionSpec
 
 from ..analysis import hot_path
 from ..collectors.llm import LLMCollector
+from ..compile import abstract_like, get_program_registry
 from ..data import ArrayDict
 from ..data.llm.tokenizer import SimpleTokenizer
 from ..envs.llm.chat import DatasetChatEnv
@@ -100,6 +101,11 @@ class GRPOTrainer:
         remat / remat_policy: per-block activation rematerialization on
             the TRAINING forward (``TransformerConfig.remat``) — pairs
             with small microbatches to fit long sequences.
+        warmup: ``True`` AOT-compiles (or store-loads) the update program
+            before construction returns; ``"background"`` does it on a
+            thread overlapped with the caller's remaining setup
+            (:meth:`aot_warmup` run for you; handle at
+            ``self._warmup_handle``).
     """
 
     def __init__(
@@ -124,6 +130,7 @@ class GRPOTrainer:
         remat: bool = False,
         remat_policy: str = "none",
         fsdp_min_size_mb: float = 4.0,
+        warmup: bool | str = False,
     ):
         self.tokenizer = tokenizer or SimpleTokenizer(dataset.corpus())
         self.dataset = dataset
@@ -285,6 +292,15 @@ class GRPOTrainer:
         # donate the rotating optimizer state, NOT the params: the weight
         # scheme (and a pipelined generator thread pulling from it) may
         # alias the same device buffers a same-device device_put returns
+        # both update programs go through the ProgramRegistry (rlint R006):
+        # named executable tables + aot_warmup() + the persistent store,
+        # so a restarted worker reloads instead of re-lowering
+        self._registry = get_program_registry()
+        self._fingerprint = repr((
+            type(self).__name__, train_cfg, self.microbatch_size,
+            learning_rate, clip_epsilon, self._fsdp,
+            None if mesh is None else sorted(mesh.shape.items()),
+        ))
         if self._fsdp:
             # explicit in/out shardings pin the donated dispatch to the FSDP
             # layout: XLA overlaps the param all-gathers / grad
@@ -293,8 +309,10 @@ class GRPOTrainer:
             # passes the poison scalar (the cached device zero when the
             # chaos injector is idle or absent).
             _repl = NamedSharding(mesh, PartitionSpec())
-            self._update = jax.jit(
+            self._update = self._registry.register(
+                "grpo.update",
                 self._update_impl,
+                fingerprint=self._fingerprint,
                 donate_argnums=(1,),
                 in_shardings=(
                     self._param_shardings,
@@ -307,16 +325,77 @@ class GRPOTrainer:
             )
             self._poison_zero = jax.device_put(jnp.zeros((), jnp.float32), _repl)
         else:
-            self._update = jax.jit(self._update_impl, donate_argnums=(1,))
-        self._eval_gen = jax.jit(
+            self._update = self._registry.register(
+                "grpo.update",
+                self._update_impl,
+                fingerprint=self._fingerprint,
+                donate_argnums=(1,),
+            )
+        self._eval_gen = self._registry.register(
+            "grpo.eval_gen",
             lambda p, t, m, k: generate(
                 self.gen_model, p, t, m, k,
                 max_new_tokens=max_new_tokens,
                 eos_id=self.tokenizer.eos_token_id,
                 greedy=True,
-            )
+            ),
+            fingerprint=repr((model_config, max_new_tokens,
+                              self.tokenizer.eos_token_id)),
         )
+        self._B, self._T = B, total_len
         self.history: dict[str, list[float]] = {"reward": [], "loss": []}
+        # warmup=True compiles the update before __init__ returns;
+        # "background" overlaps it with collector/env setup the caller
+        # still has to do — join via the returned handle's .result() or
+        # just let the first step() hit the warmed table
+        self._warmup_handle = None
+        if warmup == "background":
+            self._warmup_handle = self.aot_warmup(background=True)
+        elif warmup:
+            self.aot_warmup()
+
+    def aot_warmup(self, *, background: bool = False):
+        """Pre-compile (or reload from the executable store) the update
+        program for the exact batch the collector produces, so the first
+        ``step()`` dispatches instead of lowering. Returns the registry's
+        per-program ``[(source, seconds)]`` report, or a
+        :class:`~rl_tpu.compile.WarmupHandle` when backgrounded."""
+        B, T = self._B, self._T
+        f32, i32 = jnp.float32, jnp.int32
+        bt = lambda dt: jax.ShapeDtypeStruct((B, T), dt)  # noqa: E731
+        batch = ArrayDict(
+            advantage=jax.ShapeDtypeStruct((B,), f32),
+            reward=jax.ShapeDtypeStruct((B,), f32),
+            tokens=bt(i32),
+            attention_mask=bt(f32),
+            assistant_mask=bt(jnp.bool_),
+            sample_log_prob=bt(f32),
+            group_id=jax.ShapeDtypeStruct((B,), i32),
+            policy_version=jax.ShapeDtypeStruct((B,), i32),
+            ref_log_prob=bt(f32),
+        )
+        if self._batch_placement is not None:
+            batch = jax.tree.map(
+                lambda a: jax.ShapeDtypeStruct(
+                    a.shape, a.dtype, sharding=self._batch_placement
+                ),
+                batch,
+            )
+        params_abs = abstract_like(self.params)
+        opt_abs = abstract_like(self.opt_state)
+        dm_abs = abstract_like(self._dm)
+        if get_injector() is None and not self._fsdp:
+            self._update.add_signature(params_abs, opt_abs, batch, dm_abs)
+        else:
+            pz = abstract_like(
+                self._poison_zero
+                if self._poison_zero is not None
+                else jnp.zeros((), jnp.float32)
+            )
+            self._update.add_signature(params_abs, opt_abs, batch, dm_abs, pz)
+        return self._registry.aot_warmup(
+            programs=[self._update], background=background
+        )
 
     # -- the donated, microbatched update program ------------------------
 
@@ -550,6 +629,11 @@ class GRPOTrainer:
         if "env_rng" in meta:
             self.env._rng.bit_generator.state = meta["env_rng"]
         self.scheme.push(self.params)
+        # warm restart: start materializing the update executable now (a
+        # restarted process loads it from the persistent store in
+        # milliseconds), overlapped with whatever host setup remains
+        # before the first post-restore step
+        self.aot_warmup(background=True)
         return int(meta.get("step", step))
 
     def evaluate(self, num_prompts: int = 32, key: jax.Array | None = None) -> float:

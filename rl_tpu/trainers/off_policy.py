@@ -19,6 +19,7 @@ import jax.numpy as jnp
 import optax
 
 from ..analysis import hot_path
+from ..compile import abstract_like, get_program_registry
 from ..data import ArrayDict, ReplayBuffer
 from ..collectors.single import Collector
 from ..objectives.common import LossModule, SoftUpdate
@@ -433,8 +434,18 @@ class AsyncOffPolicyTrainer(_GradUpdateMixin):
         # donate the big rotating state (optimizer moments + replay ring)
         # but NOT params: the collector's actor thread keeps a live
         # reference to the last published params for its policy calls, and
-        # donating them would hand XLA buffers another thread is reading
-        self._k_updates = jax.jit(self._k_updates_impl, donate_argnums=(1, 2))
+        # donating them would hand XLA buffers another thread is reading.
+        # Registered (not raw jit): the K-update scan is THE dominant
+        # compile of this trainer, and a supervised worker restart should
+        # reload its executable from the store, not re-lower it.
+        self._registry = get_program_registry()
+        self._k_updates = self._registry.register(
+            "offpolicy.k_updates",
+            self._k_updates_impl,
+            fingerprint=repr((type(loss).__name__, config, priority_key,
+                              type(buffer.storage).__name__)),
+            donate_argnums=(1, 2),
+        )
         # cached device zero for the chaos poison arg: one extra jit trace
         # when an injector is armed, no per-dispatch host->device transfer
         self._poison_zero = None
@@ -481,6 +492,24 @@ class AsyncOffPolicyTrainer(_GradUpdateMixin):
         if self.device_metrics is not None:
             ts["obs"] = self.device_metrics.init()
         return ts
+
+    def aot_warmup(self, ts: dict, *, background: bool = False):
+        """Pre-compile (or reload from the executable store) the K-update
+        program for ``ts``'s exact state layout, so the first post-warmup
+        dispatch of :meth:`train` doesn't block the collector behind a
+        lower+compile. ``ts`` is :meth:`init`'s result (or a restored
+        checkpoint — only shapes/dtypes are read). Returns the registry
+        report, or a :class:`~rl_tpu.compile.WarmupHandle` when
+        backgrounded."""
+        sig = abstract_like((
+            ts["params"], ts["opt"], ts["buffer"], ts["rng"],
+            ts["update_count"], ts.get("obs"),
+        ))
+        # poison=None mirrors the injector-absent dispatch in train()
+        self._k_updates.add_signature(*sig, None)
+        return self._registry.aot_warmup(
+            programs=[self._k_updates], background=background
+        )
 
     # -- device side -----------------------------------------------------------
 
@@ -637,6 +666,10 @@ class AsyncOffPolicyTrainer(_GradUpdateMixin):
     def emergency_restore(self, emergency, ts_template: dict, step=None):
         """Load ``(ts, frames)`` from the latest (or given) emergency
         checkpoint; ``ts_template`` is a same-structure state, e.g. from
-        :meth:`init` with matching config."""
+        :meth:`init` with matching config. Kicks a background
+        :meth:`aot_warmup` on the restored layout so a restarted worker
+        loads the K-update executable from the persistent store instead
+        of re-lowering it before the first dispatch."""
         arrays, meta, step = emergency.restore(ts_template, step)
+        self.aot_warmup(arrays, background=True)
         return arrays, int(meta.get("frames", step))

@@ -47,6 +47,18 @@ _cache_dir = os.environ.get(
 jax.config.update("jax_compilation_cache_dir", _cache_dir)
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
+# The executable store (rl_tpu.compile) is a SECOND persistent layer; tests
+# must never share serialized-executable state across runs or with the
+# user's real cache (a stale entry would mask a cold-path regression), so
+# the tier-1 env pins it to a fresh tmpdir per session.
+import atexit  # noqa: E402
+import shutil  # noqa: E402
+import tempfile  # noqa: E402
+
+_exec_store_dir = tempfile.mkdtemp(prefix="rl_tpu_exec_store_")
+os.environ["RL_TPU_EXEC_STORE_DIR"] = _exec_store_dir
+atexit.register(shutil.rmtree, _exec_store_dir, ignore_errors=True)
+
 import pytest  # noqa: E402
 
 # the <2-min core-coverage tier: one file per load-bearing layer

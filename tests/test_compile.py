@@ -1,0 +1,355 @@
+"""rl_tpu.compile: AOT registry, persistent executable store, shape
+buckets, and the compile-observability layer (ISSUE-10).
+
+Strategy: (1) the ShapeBuckets ladders are pinned at their admission
+edges (len == bucket stays, len == bucket + 1 climbs a rung) because an
+off-by-one there silently doubles the program set; (2) the executable
+store must round-trip through a FRESH store instance — the supervised-
+restart scenario — with ``stats["compiles"] == 0`` proving the warm
+process never entered ``lower()``; (3) ``CompileDelta`` and
+``bench_warmup`` are exercised both ways: steady state asserts clean,
+and a deliberately shape-shifting step must trip the no-recompile
+assertion.
+"""
+
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from rl_tpu.compile import (
+    CompileDelta,
+    ExecutableStore,
+    ProgramRegistry,
+    ShapeBuckets,
+    abstract_like,
+    compile_counts,
+    compile_scope,
+    get_program_registry,
+    install_compile_listener,
+    pow2ceil,
+    set_program_registry,
+    signature_of,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+# ---------------------------------------------------------------------------
+# ShapeBuckets: the serving ladders
+# ---------------------------------------------------------------------------
+
+
+class TestShapeBuckets:
+    def test_pow2ceil(self):
+        assert [pow2ceil(n) for n in (0, 1, 2, 3, 4, 5, 7, 8, 9)] == [
+            1, 1, 2, 4, 4, 8, 8, 8, 16,
+        ]
+        # np integer scalars must work without a host-sync int() cast
+        assert pow2ceil(np.int32(5)) == 8
+
+    def test_prompt_bucket_edges(self):
+        b = ShapeBuckets(prompt=(8, 16, 64))
+        # len == bucket stays on its rung; len == bucket + 1 climbs
+        assert b.prompt_bucket(8) == 8
+        assert b.prompt_bucket(9) == 16
+        assert b.prompt_bucket(16) == 16
+        assert b.prompt_bucket(17) == 64
+        assert b.prompt_bucket(1) == 8
+        assert b.fits(64) and not b.fits(65)
+        with pytest.raises(ValueError):
+            b.prompt_bucket(65)
+
+    def test_admit_bucket_edges(self):
+        b = ShapeBuckets(prompt=(16,))
+        cap = 6
+        # count == pow2 stays; count == pow2 + 1 climbs; the cap clips
+        assert b.admit_bucket(1, cap) == 1
+        assert b.admit_bucket(2, cap) == 2
+        assert b.admit_bucket(3, cap) == 4
+        assert b.admit_bucket(4, cap) == 4
+        assert b.admit_bucket(5, cap) == 6
+        assert b.admit_bucket(6, cap) == 6
+        for bad in (0, 7):
+            with pytest.raises(ValueError):
+                b.admit_bucket(bad, cap)
+
+    def test_admit_sizes_and_program_count(self):
+        b = ShapeBuckets(prompt=(8, 32))
+        assert b.admit_sizes(6) == (1, 2, 4, 6)
+        assert b.admit_sizes(8) == (1, 2, 4, 8)
+        assert b.program_count(6) == 4 * 2
+        exact = ShapeBuckets(prompt=(8, 32), admit_pow2=False)
+        assert exact.admit_sizes(4) == (1, 2, 3, 4)
+        assert exact.admit_bucket(3, 4) == 3
+
+    def test_ladder_validation(self):
+        for bad in ((), (0,), (-4, 8), (16, 8), (8, 8, 16)):
+            with pytest.raises(ValueError):
+                ShapeBuckets(prompt=bad)
+        # floats coerce, order and uniqueness still enforced
+        assert ShapeBuckets(prompt=(8.0, 16)).prompt == (8, 16)
+
+
+# ---------------------------------------------------------------------------
+# ExecutableStore: persistent round-trip + supervised restart
+# ---------------------------------------------------------------------------
+
+
+def _fresh_registry(tmp_path):
+    return ProgramRegistry(store=ExecutableStore(str(tmp_path)))
+
+
+def _register(reg):
+    # prime-sized shape: unlikely to collide with any other test's
+    # dispatch cache entries
+    prog = reg.register(
+        "t.double_sum", lambda x, y: (x * 2 + y).sum(),
+        fingerprint="test-fingerprint-v1",
+    )
+    sig = (jax.ShapeDtypeStruct((5, 7), jnp.float32),
+           jax.ShapeDtypeStruct((5, 7), jnp.float32))
+    prog.add_signature(*sig)
+    return prog, sig
+
+
+class TestExecutableStore:
+    def test_cold_compile_populates_store(self, tmp_path):
+        reg = _fresh_registry(tmp_path)
+        prog, sig = _register(reg)
+        src, secs = prog.warmup(*sig)
+        assert src == "compile" and prog.stats["compiles"] == 1
+        if not reg.store.has(prog.store_key(sig)):
+            pytest.skip("executable serialization unavailable on this jax")
+        # second warmup of the same signature is a memory hit
+        assert prog.warmup(*sig)[0] == "memory"
+
+    def test_restart_loads_without_lowering(self, tmp_path):
+        reg = _fresh_registry(tmp_path)
+        prog, sig = _register(reg)
+        assert prog.warmup(*sig)[0] == "compile"
+        if not reg.store.has(prog.store_key(sig)):
+            pytest.skip("executable serialization unavailable on this jax")
+        x = jnp.arange(35, dtype=jnp.float32).reshape(5, 7)
+        want = float(prog(x, x))
+
+        # "restart": fresh store instance (empty memory cache), fresh
+        # registry, fresh registration — only the directory survives
+        reg2 = _fresh_registry(tmp_path)
+        prog2, _ = _register(reg2)
+        warm = reg2.aot_warmup()
+        assert [s for runs in warm.values() for s, _ in runs] == ["store"]
+        assert prog2.stats["compiles"] == 0
+        assert prog2.stats["loads"] == 1
+        # the deserialized executable actually runs, still without lower()
+        assert float(prog2(x, x)) == want
+        assert prog2.stats["compiles"] == 0
+
+    def test_corrupt_entry_falls_back_to_compile(self, tmp_path):
+        reg = _fresh_registry(tmp_path)
+        prog, sig = _register(reg)
+        prog.warmup(*sig)
+        key = prog.store_key(sig)
+        if not reg.store.has(key):
+            pytest.skip("executable serialization unavailable on this jax")
+        payloads = [p for p in tmp_path.rglob("*") if p.is_file()]
+        assert payloads
+        for p in payloads:
+            p.write_bytes(b"\x00garbage\x00")
+        reg2 = _fresh_registry(tmp_path)
+        prog2, sig2 = _register(reg2)
+        src, _ = prog2.warmup(*sig2)
+        assert src == "compile"  # corrupt entry evicted, not wedged
+
+    def test_fingerprint_separates_store_keys(self, tmp_path):
+        store = ExecutableStore(str(tmp_path))
+        reg = ProgramRegistry(store=store)
+        a = reg.register("t.same_name", lambda x: x + 1, fingerprint="cfg-a")
+        b = reg.register("t.same_name", lambda x: x + 2, fingerprint="cfg-b")
+        sig = (jax.ShapeDtypeStruct((3,), jnp.float32),)
+        assert a.store_key(sig) != b.store_key(sig)
+
+    def test_signature_of_is_stable_and_shape_sensitive(self):
+        x = {"a": jnp.zeros((2, 3)), "b": jnp.zeros((4,), jnp.int32)}
+        assert signature_of((x,)) == signature_of((x,))
+        y = {"a": jnp.zeros((2, 4)), "b": jnp.zeros((4,), jnp.int32)}
+        assert signature_of((x,)) != signature_of((y,))
+
+    def test_abstract_like_matches_concrete_dispatch_key(self, tmp_path):
+        # warming with abstract_like(concrete) must hit the SAME executable
+        # the real call dispatches to — the bug class behind double compiles
+        reg = _fresh_registry(tmp_path)
+        prog = reg.register("t.abs_like", lambda t: t["a"] + t["b"])
+        tree = {"a": jnp.ones((3, 11)), "b": jnp.ones((3, 11))}
+        prog.add_signature(abstract_like(tree))
+        assert reg.aot_warmup(programs=[prog])["t.abs_like"][0][0] == "compile"
+        prog(tree)
+        assert prog.stats["aot_hits"] == 1
+        assert prog.stats["compiles"] == 1
+
+
+class TestRegistry:
+    def test_default_registry_swap(self):
+        prev = set_program_registry(None)
+        try:
+            reg = get_program_registry()
+            assert get_program_registry() is reg
+        finally:
+            set_program_registry(prev)
+
+    def test_weakly_held(self, tmp_path):
+        reg = _fresh_registry(tmp_path)
+        prog, _ = _register(reg)
+        name = prog.name
+        assert name in reg.names()
+        del prog
+        assert name not in reg.names()
+
+    def test_add_signature_idempotent(self, tmp_path):
+        reg = _fresh_registry(tmp_path)
+        prog, sig = _register(reg)
+        prog.add_signature(*sig)  # restart paths re-add; must not grow
+        assert len(prog.signatures) == 1
+
+    def test_background_warmup(self, tmp_path):
+        reg = _fresh_registry(tmp_path)
+        prog, _ = _register(reg)
+        handle = reg.aot_warmup(background=True)
+        res = handle.result(timeout=120)
+        assert handle.done()
+        assert res["t.double_sum"][0][0] in ("compile", "store")
+        assert prog.program_count() == 1
+
+    def test_no_aot_env_falls_back_to_jit(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("RL_TPU_NO_AOT", "1")
+        reg = _fresh_registry(tmp_path)
+        prog = reg.register("t.no_aot", lambda x: x - 1)
+        out = prog(jnp.ones((2,)))
+        assert float(out[0]) == 0.0
+        assert prog.stats["jit_calls"] == 1 and prog.stats["compiles"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Compile observability: attribution, CompileDelta, bench_warmup
+# ---------------------------------------------------------------------------
+
+
+class TestCompileObservability:
+    def test_compile_scope_attributes_counter(self):
+        assert install_compile_listener()
+        before = compile_counts().get("test.attr_scope", 0)
+        with compile_scope("test.attr_scope"):
+            jax.jit(lambda x: x * 3 + 1)(jnp.ones((13, 3)))
+        # one dispatch can emit >1 backend-compile events (main program +
+        # subcomputations) — attribution, not exact arity, is under test
+        assert compile_counts().get("test.attr_scope", 0) > before
+
+    def test_compile_delta_steady_state(self):
+        f = jax.jit(lambda x: x * 5)
+        x = jnp.ones((17, 2))
+        f(x)  # compile outside the window
+        with CompileDelta() as d:
+            f(x)
+        assert d.supported and d.delta == 0 and d.explain() == "no compiles"
+        with CompileDelta() as d2:
+            f(jnp.ones((18, 2)))  # new shape: compiles, named in explain
+        assert d2.delta >= 1
+        assert "steady-state" in d2.explain()
+
+    def test_bench_warmup_registered_program_asserts_clean(self, tmp_path):
+        import bench
+
+        reg = _fresh_registry(tmp_path)
+        prog = reg.register("t.bw", lambda x: x + 1)
+        x = jnp.ones((19, 3))
+        compile_s, out = bench.bench_warmup(
+            lambda: prog(x), calls=3, assert_no_recompile=True
+        )
+        assert compile_s > 0.0
+        assert float(out[0, 0]) == 2.0
+        assert prog.stats["compiles"] == 1 and prog.stats["aot_hits"] == 2
+
+    def test_bench_warmup_trips_on_recompile(self):
+        import bench
+
+        if not install_compile_listener():
+            pytest.skip("no jax.monitoring on this jax")
+        jf = jax.jit(lambda x: x * 2)
+        n = {"i": 20}
+
+        def shape_shifting_step():
+            n["i"] += 1  # every call is a fresh shape -> a fresh compile
+            return jf(jnp.zeros((n["i"], 3)))
+
+        with pytest.raises(AssertionError, match="post-warmup recompile"):
+            bench.bench_warmup(shape_shifting_step, assert_no_recompile=True)
+
+
+# ---------------------------------------------------------------------------
+# Serving integration: bucket admission edges + fleet config guard
+# ---------------------------------------------------------------------------
+
+
+def _small_engine(prompt_buckets=(16,), **kw):
+    from rl_tpu.models import ContinuousBatchingEngine, TransformerConfig, TransformerLM
+
+    cfg = TransformerConfig(
+        vocab_size=97, d_model=64, n_layers=2, n_heads=4, d_ff=128,
+        max_seq_len=128, dtype=jnp.float32,
+    )
+    m = TransformerLM(cfg)
+    params = m.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    return ContinuousBatchingEngine(
+        m, params, n_slots=2, block_size=16, n_blocks=2 * (128 // 16) + 1,
+        prompt_buckets=prompt_buckets, greedy=True, decode_chunk=2, **kw,
+    )
+
+
+class TestServingBuckets:
+    def test_submit_admission_edges(self):
+        eng = _small_engine(prompt_buckets=(8, 16))
+        rng = np.random.default_rng(0)
+        # len == largest bucket admitted, len == bucket + 1 rejected
+        eng.submit(rng.integers(0, 97, 16), 2)
+        with pytest.raises(ValueError):
+            eng.submit(rng.integers(0, 97, 17), 2)
+        out = eng.run()
+        assert len(out) == 1
+
+    def test_prompt_edge_lengths_share_bucket_programs(self):
+        eng = _small_engine(prompt_buckets=(8, 16))
+        rng = np.random.default_rng(1)
+        eng.aot_warmup()
+        with CompileDelta():
+            pass  # install the listener before the traffic window
+        eng.submit(rng.integers(0, 97, 8), 2)    # exactly rung 1
+        eng.submit(rng.integers(0, 97, 9), 2)    # rung 1 + 1 -> rung 2
+        first = eng.run()
+        with CompileDelta() as d:
+            eng.submit(rng.integers(0, 97, 8), 2)
+            eng.submit(rng.integers(0, 97, 9), 2)
+            second = eng.run()
+        assert len(first) == 2 and len(second) == 2
+        # warmed ladder + one glue round: the edge lengths dispatch onto
+        # existing bucket programs, zero new compiles
+        assert not d.supported or d.delta == 0
+
+    def test_fleet_rejects_mismatched_buckets(self):
+        from rl_tpu.models import ServingFleet
+
+        engines = [_small_engine(prompt_buckets=(16,)),
+                   _small_engine(prompt_buckets=(8, 16))]
+        with pytest.raises(ValueError, match="share one ShapeBuckets"):
+            ServingFleet(engines, max_queue=4)
+
+    def test_fleet_shares_bucket_config(self):
+        from rl_tpu.models import ServingFleet
+
+        engines = [_small_engine(prompt_buckets=(8, 16)) for _ in range(2)]
+        fleet = ServingFleet(engines, max_queue=4)
+        assert fleet.shape_buckets == engines[0].shape_buckets

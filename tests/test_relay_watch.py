@@ -228,6 +228,58 @@ def test_anakin_sweep_distilled_to_own_artifact(tmp_path):
     assert runner.commits[0][0] == [art, mart, akart]
 
 
+def test_compile_split_distilled_to_own_artifact(tmp_path):
+    """ISSUE-10: the compile sub-bench's cold/warm startup split (warmup
+    wall-clock with an empty vs populated executable store, per-program
+    warmup sources, steady-state compile-delta assertion) lands whole in
+    its own committed COMPILE json, riding the same single commit as the
+    raw artifact and the metrics distillation."""
+
+    class CompileRunner(FakeRunner):
+        def bench_all(self, timeout):
+            self.bench_calls.append(timeout)
+            cp = {
+                "metric": "compile_warm_vs_cold_speedup",
+                "value": 28.9,
+                "cold_s": 4.72,
+                "warm_s": 0.16,
+                "warm_ok": True,
+                "warm_skipped_lowering": True,
+                "steady_state_ok": True,
+                "steady_state_compile_delta": 0,
+                "cold": {"role": "cold", "compiles": 10, "store_loads": 0},
+                "warm": {"role": "warm", "compiles": 0, "store_loads": 10},
+                "metrics": {"compile_warm_vs_cold_speedup": 28.9},
+            }
+            lines = [
+                {"metric": "ppo", "value": 123.0},
+                {"compile": cp},
+            ]
+            return 0, "".join(json.dumps(ln) + "\n" for ln in lines)
+
+    runner = CompileRunner([_healthy()])
+    art = str(tmp_path / "bench.jsonl")
+    mart = str(tmp_path / "METRICS.json")
+    cpart = str(tmp_path / "COMPILE.json")
+    watch(runner, lambda s: None, max_probes=1, artifact=art,
+          metrics_artifact=mart, compile_artifact=cpart,
+          sleep=lambda s: None)
+    doc = json.loads(open(cpart).read())
+    cp = doc["compile"]
+    assert cp["warm_ok"] is True
+    assert cp["value"] == 28.9
+    assert cp["warm"]["compiles"] == 0
+    assert cp["warm"]["store_loads"] == 10
+    assert cp["steady_state_compile_delta"] == 0
+    assert doc["artifact"] == os.path.relpath(art, REPO)
+    # the flat metrics section still rides the METRICS distillation
+    mdoc = json.loads(open(mart).read())
+    assert mdoc["bench_metrics"]["compile"]["compile_warm_vs_cold_speedup"] == 28.9
+    # all three files land in ONE commit
+    assert len(runner.commits) == 1
+    assert runner.commits[0][0] == [art, mart, cpart]
+
+
 def test_rlhf_pipeline_subresult_distilled(tmp_path):
     """PR-4: the rlhf sub-bench reports an overlapped-cycle ``pipeline``
     sub-result; the watcher must split it into the committed METRICS json

@@ -268,6 +268,54 @@ def train(xs):
 
 
 # ---------------------------------------------------------------------------
+# R006: ProgramRegistry bypass in models/ and trainers/
+# ---------------------------------------------------------------------------
+
+
+class TestR006:
+    SRC = """
+import jax
+from functools import partial
+
+def build(fn):
+    step = jax.jit(fn, donate_argnums=(0,))
+    return step
+
+@jax.jit
+def decorated(x):
+    return x + 1
+
+@partial(jax.jit, static_argnames=("n",))
+def partial_decorated(x, n):
+    return x * n
+"""
+
+    def test_flagged_inside_scope(self):
+        out = analyze_sources({"rl_tpu.models.m": self.SRC}, rules=["R006"])
+        assert len(out) == 3
+        assert all("ProgramRegistry" in f.message for f in out)
+        out = analyze_sources({"rl_tpu.trainers.m": self.SRC}, rules=["R006"])
+        assert len(out) == 3
+
+    def test_other_packages_not_flagged(self):
+        # the rule is scoped: collectors/, ops/, tools keep raw jit freely
+        assert analyze_sources({"rl_tpu.collectors.m": self.SRC},
+                               rules=["R006"]) == []
+        assert analyze_sources({"rl_tpu.ops.m": self.SRC}, rules=["R006"]) == []
+
+    def test_registry_dispatch_not_flagged(self):
+        src = """
+from rl_tpu.compile import get_program_registry
+
+def build(fn, cfg):
+    reg = get_program_registry()
+    return reg.register("m.step", fn, fingerprint=repr(cfg),
+                        donate_argnums=(0,))
+"""
+        assert analyze_sources({"rl_tpu.models.m": src}, rules=["R006"]) == []
+
+
+# ---------------------------------------------------------------------------
 # R005: static lock order
 # ---------------------------------------------------------------------------
 

@@ -128,6 +128,28 @@ def _extract_anakin(stdout: str) -> dict | None:
     return found
 
 
+def _extract_compile(stdout: str) -> dict | None:
+    """Find the compile sub-bench result (ISSUE-10 cold-start kill: cold vs
+    warm startup wall-clock over a shared executable store, per-program
+    warmup sources, and the steady-state compile-delta assertion) in a
+    bench stdout JSONL stream. The cold/warm role splits and per-program
+    source counts carry structure worth keeping whole, so they get their
+    own committed COMPILE artifact. Last match wins (the final aggregate
+    line repeats the sub-results)."""
+    found = None
+    for ln in (stdout or "").strip().splitlines():
+        try:
+            d = json.loads(ln)
+        except ValueError:
+            continue
+        if not isinstance(d, dict):
+            continue
+        v = d.get("compile")
+        if isinstance(v, dict) and ("warm_s" in v or "cold_s" in v):
+            found = v
+    return found
+
+
 class Runner:
     """Real subprocess/git backend. Tests replace this with a fake that
     implements the same three methods."""
@@ -197,6 +219,7 @@ def watch(
     metrics_artifact: str | None = None,
     multichip_artifact: str | None = None,
     anakin_artifact: str | None = None,
+    compile_artifact: str | None = None,
     rlint_artifact: str | None = None,
     commit: bool = True,
     require_tpu: bool = True,
@@ -283,6 +306,21 @@ def watch(
                 f.write("\n")
             paths.append(akpath)
             log(f"{_utcnow()} anakin -> {os.path.relpath(akpath, REPO)}")
+        cp = _extract_compile(bout)
+        if cp is not None:
+            cppath = compile_artifact or os.path.join(REPO, "COMPILE_pr10.json")
+            with open(cppath, "w") as f:
+                json.dump(
+                    {
+                        "artifact": os.path.relpath(path, REPO),
+                        "generated": _utcnow(),
+                        "compile": cp,
+                    },
+                    f, indent=2, sort_keys=True,
+                )
+                f.write("\n")
+            paths.append(cppath)
+            log(f"{_utcnow()} compile -> {os.path.relpath(cppath, REPO)}")
         if hasattr(runner, "rlint"):
             # PR-8: keep the static-analysis summary current alongside the
             # perf artifacts — the same commit that records a measurement
@@ -322,6 +360,8 @@ def main(argv=None) -> int:
                     help="multichip scaling-sweep path (default MULTICHIP_r06.json)")
     ap.add_argument("--anakin-artifact", default=None,
                     help="anakin fused-fleet sweep path (default ANAKIN_pr9.json)")
+    ap.add_argument("--compile-artifact", default=None,
+                    help="cold/warm startup split path (default COMPILE_pr10.json)")
     ap.add_argument("--rlint-artifact", default=None,
                     help="rlint findings-summary path (default RLINT_pr8.json)")
     ap.add_argument("--no-commit", action="store_true")
@@ -345,6 +385,7 @@ def main(argv=None) -> int:
         metrics_artifact=args.metrics_artifact,
         multichip_artifact=args.multichip_artifact,
         anakin_artifact=args.anakin_artifact,
+        compile_artifact=args.compile_artifact,
         rlint_artifact=args.rlint_artifact,
         commit=not args.no_commit,
     )
