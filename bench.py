@@ -2276,6 +2276,249 @@ def bench_fleet(report: bool = True) -> dict:
     return out
 
 
+def bench_prefix(report: bool = True) -> dict:
+    """BENCH_MODE=prefix: prefix-aware KV reuse (the ISSUE-11 tentpole).
+
+    The workload is the shape prefix caching exists for: a few long
+    shared system prompts with short per-request suffixes, replayed
+    open-loop (seeded Poisson arrivals) against a 2-engine
+    :class:`ServingFleet` twice — once with the legacy allocator, once
+    with ``prefix_cache=True`` — on the SAME seeded plan.  Headline is
+    the measured per-request prefill-compute reduction (prefix-off
+    prefill token positions / prefix-on), the ISSUE-11 acceptance bar
+    being >= 2x; also reported: KV blocks charged per request, hit rate,
+    CoW copies, evictions, and p50/p99 TTFT for both arms.
+
+    Mid-run chaos: a seeded ``kvmem.evict`` crash fires on the first LRU
+    eviction step of the prefix arm — the member quarantines, work fails
+    over, and the accounting must still balance (``lost == 0``).  The
+    prefix arm's traffic window runs under :class:`CompileDelta` after
+    engine-level glue rounds (two consecutive compile-free rounds), so
+    ``steady_state_compile_delta == 0`` proves partial prefill + CoW
+    copies + table flushes all run on warmed shapes.  TTFT tails of the
+    two arms are not directly comparable (only the prefix arm absorbs a
+    crash); the reduction ratio is the headline, the tails are context.
+    """
+    jax = _setup_jax()
+    import jax.numpy as jnp
+    import numpy as np
+
+    from rl_tpu.compile import CompileDelta, ShapeBuckets
+    from rl_tpu.models import (
+        ContinuousBatchingEngine,
+        FinishedRequest,
+        ServiceSaturated,
+        ServingFleet,
+        TransformerConfig,
+        TransformerLM,
+    )
+    from rl_tpu.obs import MetricsRegistry
+    from rl_tpu.resilience import Fault, FaultInjector, injection
+
+    if _TIER == "smoke":
+        cfg = TransformerConfig(vocab_size=256, d_model=64, n_layers=2,
+                                n_heads=4, d_ff=128, max_seq_len=128,
+                                dtype=jnp.float32)
+        S, bucket, sys_len = 4, 32, 22
+        horizon_s, n_lo, n_hi = 3.0, 4, 8
+    elif _TIER == "cpu":
+        cfg = TransformerConfig(vocab_size=1024, d_model=128, n_layers=2,
+                                n_heads=4, d_ff=512, max_seq_len=128,
+                                dtype=jnp.float32)
+        S, bucket, sys_len = 4, 32, 24
+        horizon_s, n_lo, n_hi = 8.0, 6, 12
+    else:
+        cfg = TransformerConfig(vocab_size=32768, d_model=768, n_layers=12,
+                                n_heads=12, d_ff=3072, max_seq_len=256,
+                                dtype=jnp.bfloat16)
+        S, bucket, sys_len = 8, 128, 96
+        horizon_s, n_lo, n_hi = 15.0, 16, 32
+    model = TransformerLM(cfg)
+    params = model.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    rng = np.random.default_rng(0)
+    sysps = [rng.integers(0, cfg.vocab_size, sys_len) for _ in range(3)]
+
+    def mk_prompt():
+        sp = sysps[int(rng.integers(len(sysps)))]
+        return np.concatenate(
+            [sp, rng.integers(0, cfg.vocab_size, int(rng.integers(2, 8)))]
+        )
+
+    buckets = ShapeBuckets(prompt=(bucket,), suffix=(8, 16))
+    n_blocks = S * (cfg.max_seq_len // 16) + 1
+
+    def mk_engines(prefix: bool):
+        return [
+            ContinuousBatchingEngine(
+                model, params, n_slots=S, block_size=16, n_blocks=n_blocks,
+                prompt_buckets=None, buckets=buckets, greedy=True,
+                decode_chunk=4, seed=i, prefix_cache=prefix,
+            )
+            for i in range(2)
+        ]
+
+    def glue(engines):
+        """aot_warmup + engine-level traffic rounds until two CONSECUTIVE
+        rounds are compile-free: the eager host-glue shape set (pending
+        table-write flushes, CoW pad counts, admit pads) is finite but
+        only fully visited once tree growth and eviction reach their
+        steady pattern."""
+        t0 = time.perf_counter()
+        for e in engines:
+            e.aot_warmup()
+        clean = 0
+        for _ in range(12):
+            with CompileDelta() as d:
+                for e in engines:
+                    for _ in range(2 * S):
+                        e.submit(mk_prompt(), int(rng.integers(n_lo, n_hi)))
+                    e.run()
+            clean = clean + 1 if (not d.supported or d.delta == 0) else 0
+            if clean >= 2:
+                break
+        return time.perf_counter() - t0
+
+    def run_arm(engines, faults: bool):
+        # calibrate offered load off this arm's engine 0 (post-glue, warm)
+        cal = [(mk_prompt(), int(rng.integers(n_lo, n_hi)))
+               for _ in range(2 * S)]
+        for p, n in cal:
+            engines[0].submit(p, n)
+        t0 = time.perf_counter()
+        engines[0].run()
+        lam = 0.9 * 2.0 * len(cal) / (time.perf_counter() - t0)
+        arrivals, t = [], 0.0
+        while t < horizon_s:
+            t += rng.exponential(1.0 / lam)
+            if t < horizon_s:
+                arrivals.append(t)
+        plan = [(a, mk_prompt(), int(rng.integers(n_lo, n_hi)))
+                for a in arrivals]
+        pre_computed = sum(e.prefill_tokens_computed for e in engines)
+        pre_cached = sum(e.prefill_tokens_cached for e in engines)
+        pre_charged = sum(e._kvmem.blocks_charged for e in engines
+                          if e._kvmem is not None)
+        reg = MetricsRegistry()
+        fleet = ServingFleet(engines, registry=reg, probe_interval_s=0.02,
+                             max_queue=len(plan)).start()
+        inj = FaultInjector(
+            {"kvmem.evict": Fault("crash", at=(1,))} if faults else {},
+            registry=reg)
+        admitted, rejected = [], 0
+        steady = CompileDelta()
+        t_start = time.monotonic()
+        try:
+            with steady, injection(inj):
+                for a, prompt, n_new in plan:
+                    now = time.monotonic() - t_start
+                    if a > now:
+                        time.sleep(a - now)
+                    try:
+                        admitted.append(fleet.submit(prompt, n_new))
+                    except ServiceSaturated:
+                        rejected += 1
+                results = fleet.wait(
+                    admitted, timeout=_T(smoke=120, cpu=300, full=300))
+        finally:
+            wall = time.monotonic() - t_start
+            acc = fleet.accounting()
+            stats = fleet.request_stats()
+            fleet.shutdown()
+        done = sum(1 for r in results.values()
+                   if isinstance(r, FinishedRequest))
+        ttft = [s["first_token_at"] - s["submitted_at"] for s in stats
+                if s["first_token_at"] is not None]
+
+        def pct(q):
+            return round(float(np.percentile(ttft, q)), 4) if ttft else None
+
+        kv = {}
+        if engines[0]._kvmem is not None:
+            snaps = [e.metrics_snapshot() for e in engines]
+            kv = {
+                "kv_prefix_hit_rate": round(
+                    sum(s["kv_prefill_tokens_cached"] for s in snaps)
+                    / max(1, sum(s["kv_prefill_tokens_cached"]
+                                 + s["kv_prefill_tokens_computed"]
+                                 for s in snaps)), 4),
+                "kv_shared_blocks": sum(s["kv_shared_blocks"] for s in snaps),
+                "kv_cow_copies_total": sum(s["kv_cow_copies_total"] for s in snaps),
+                "kv_evictions_total": sum(s["kv_evictions_total"] for s in snaps),
+                "kv_blocks_per_request": round(
+                    (sum(e._kvmem.blocks_charged for e in engines)
+                     - pre_charged) / max(1, done), 3),
+            }
+        else:
+            # legacy arm: every admission charges the full table row; the
+            # engine pops free_blocks without a counter, but with greedy
+            # decode and no eos the final coverage is exactly
+            # ceil((P + G) / block) per completed request
+            rid_plan = {rid: (p, n) for rid, (_, p, n)
+                        in zip(admitted, plan[:len(admitted)])}
+            kv = {"kv_blocks_per_request": round(sum(
+                -(-(len(rid_plan[rid][0]) + rid_plan[rid][1]) // 16)
+                for rid, r in results.items()
+                if isinstance(r, FinishedRequest) and rid in rid_plan
+            ) / max(1, done), 3)}
+        return {
+            "computed": sum(e.prefill_tokens_computed for e in engines) - pre_computed,
+            "cached": sum(e.prefill_tokens_cached for e in engines) - pre_cached,
+            "done": done, "rejected": rejected, "wall_s": round(wall, 2),
+            "p50_ttft_s": pct(50), "p99_ttft_s": pct(99),
+            "lost": acc["lost"],
+            "invariant_ok": bool(
+                acc["lost"] == 0
+                and acc["completed"] + acc["shed_post_admission"] == len(admitted)),
+            "steady_state_compile_delta": steady.delta if steady.supported else None,
+            "faults_fired": len(inj.fired),
+            **kv,
+        }
+
+    base_eng = mk_engines(False)
+    compile_s = glue(base_eng)
+    base = run_arm(base_eng, faults=False)
+    pfx_eng = mk_engines(True)
+    compile_s += glue(pfx_eng)
+    pfx = run_arm(pfx_eng, faults=True)
+
+    base_per = base["computed"] / max(1, base["done"])
+    pfx_per = pfx["computed"] / max(1, pfx["done"])
+    reduction = round(base_per / max(1e-9, pfx_per), 3)
+    metrics = {
+        "prefill_reduction_x": reduction,
+        "reduction_ok": bool(reduction >= 2.0),
+        "prefill_tokens_per_request_baseline": round(base_per, 2),
+        "prefill_tokens_per_request_prefix": round(pfx_per, 2),
+        "kv_blocks_per_request_baseline": base["kv_blocks_per_request"],
+        "kv_blocks_per_request_prefix": pfx["kv_blocks_per_request"],
+        "kv_prefix_hit_rate": pfx["kv_prefix_hit_rate"],
+        "kv_shared_blocks": pfx["kv_shared_blocks"],
+        "kv_cow_copies_total": pfx["kv_cow_copies_total"],
+        "kv_evictions_total": pfx["kv_evictions_total"],
+        "steady_state_compile_delta": pfx["steady_state_compile_delta"],
+        "lost": pfx["lost"],
+        "invariant_ok": bool(pfx["invariant_ok"] and base["invariant_ok"]),
+        "faults_fired": pfx["faults_fired"],
+    }
+    out = {
+        "metric": "prefix_prefill_reduction_x",
+        "value": reduction,
+        "unit": "x",
+        "vs_baseline": reduction,
+        **metrics,
+        "baseline": base,
+        "prefix": pfx,
+        "compile_s": round(compile_s, 2),
+        "n_slots": S, "n_engines": 2, "horizon_s": horizon_s,
+        "metrics": metrics,
+        "error": None,
+    }
+    out.update(_platform_tag(jax))
+    if report:
+        print(json.dumps(out), flush=True)
+    return out
+
+
 def _force_host_devices_flags(n: int) -> str:
     """XLA_FLAGS with the host-platform device count forced to ``n`` (any
     pre-existing force dropped). Only affects the cpu backend — on real
@@ -2949,7 +3192,7 @@ def bench_all():
 
     weights = {"ppo": 2.0, "rlhf": 1.4, "pixel": 1.2, "hopper": 1.0,
                "sac": 1.0, "per": 1.0, "async_collect": 0.8, "serve": 0.8,
-               "fleet": 0.8, "multichip": 0.8, "anakin": 0.8,
+               "fleet": 0.8, "prefix": 0.8, "multichip": 0.8, "anakin": 0.8,
                "compile": 0.8, "chaos": 0.6}
     deadline = _START + _TIMEOUT - 30.0  # safety margin for the final print
     pending = list(weights)
@@ -3092,6 +3335,7 @@ if __name__ == "__main__":
             "async_collect": bench_async_collect,
             "chaos": bench_chaos,
             "fleet": bench_fleet,
+            "prefix": bench_prefix,
             "multichip": bench_multichip,
             "anakin": bench_anakin,
             "compile": bench_compile,

@@ -45,6 +45,7 @@ class LLMCollector:
         engine_block_size: int = 16,
         engine_decode_chunk: int | str = 1,
         engine_params_sharding: Any = None,
+        engine_prefix_cache: bool = False,
     ):
         self.env = env
         self.model = model
@@ -68,6 +69,13 @@ class LLMCollector:
         # shardings the engine pins pushed params to (FSDP rollouts: the
         # sharded trainer passes its per-leaf param placements through)
         self.engine_params_sharding = engine_params_sharding
+        # prefix-aware KV tier (rl_tpu.kvmem): a GRPO group's G rollouts
+        # share ONE prompt, so every response after the group's first
+        # prefills only the last prompt position via the radix tree's
+        # exact-match fast path. Off by default to keep the engine path
+        # bit-identical with prior behavior; flip on for shared-prompt
+        # rollout workloads.
+        self.engine_prefix_cache = engine_prefix_cache
         self._engine = None
         # (rewards, batch_arrays) -> rewards, applied BEFORE group advantages
         # (KLRewardTransform / PolicyVersion — reference envs/llm/transforms/)
@@ -123,6 +131,7 @@ class LLMCollector:
                 temperature=self.temperature,
                 decode_chunk=self.engine_decode_chunk,
                 params_sharding=self.engine_params_sharding,
+                prefix_cache=self.engine_prefix_cache,
             )
         eng = self._engine
         eng.params = params  # fresh policy weights each collect

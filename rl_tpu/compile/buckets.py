@@ -50,10 +50,17 @@ class ShapeBuckets:
         admit_pow2: round the admitted-count dim of the compact prefill
             up a power-of-two ladder (False keeps the legacy exact-count
             behavior: more programs, no pad rows).
+        suffix: extra rungs for the PARTIAL prefill ladder (prefix-cache
+            engines bucket the uncached suffix, which is usually much
+            shorter than the prompt — e.g. ``(8, 16)`` keeps a mostly-hit
+            workload off the big prompt rungs). The effective suffix
+            ladder is ``sorted(set(suffix) | set(prompt))`` so any suffix
+            a legal prompt can produce always has a rung.
     """
 
     prompt: tuple = (32, 128, 512)
     admit_pow2: bool = True
+    suffix: tuple = ()
 
     def __post_init__(self):
         p = tuple(int(b) for b in self.prompt)
@@ -62,6 +69,12 @@ class ShapeBuckets:
                 f"prompt ladder must be ascending positive ints, got {self.prompt}"
             )
         object.__setattr__(self, "prompt", p)
+        s = tuple(int(b) for b in self.suffix)
+        if any(b <= 0 for b in s) or list(s) != sorted(set(s)):
+            raise ValueError(
+                f"suffix rungs must be ascending positive ints, got {self.suffix}"
+            )
+        object.__setattr__(self, "suffix", s)
 
     # -- prompt ladder ---------------------------------------------------
 
@@ -79,6 +92,22 @@ class ShapeBuckets:
                 return b
         raise ValueError(
             f"prompt length {length} exceeds the largest bucket {self.prompt[-1]}"
+        )
+
+    # -- suffix ladder (partial prefill) ---------------------------------
+
+    def suffix_ladder(self) -> tuple:
+        """The partial-prefill ladder: the prompt rungs plus any extra
+        ``suffix`` rungs (warm-up set for ``serving.pprefill.*``)."""
+        return tuple(sorted(set(self.suffix) | set(self.prompt)))
+
+    def suffix_bucket(self, length: int) -> int:
+        """Round an uncached-suffix length up to its ladder rung."""
+        for b in self.suffix_ladder():
+            if length <= b:
+                return b
+        raise ValueError(
+            f"suffix length {length} exceeds the largest rung {self.prompt[-1]}"
         )
 
     # -- admit ladder ----------------------------------------------------

@@ -1,0 +1,19 @@
+"""Prefix-aware KV memory tier.
+
+A radix/prefix tree over token-block sequences maps shared prompt
+prefixes to refcounted physical KV blocks, with copy-on-write paged
+allocation and leaf-refcounted LRU eviction — the layer that turns
+per-request KV cost from O(context) into O(new tokens).  See
+``docs/kv_prefix.md``.
+"""
+
+from .allocator import DEFER_ROUND, AdmitPlan, PrefixKVAllocator
+from .radix import PrefixTree, RadixNode
+
+__all__ = [
+    "AdmitPlan",
+    "DEFER_ROUND",
+    "PrefixKVAllocator",
+    "PrefixTree",
+    "RadixNode",
+]

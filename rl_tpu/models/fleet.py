@@ -428,8 +428,9 @@ class ServingFleet:
                 raise ServiceSaturated(self.retry_after_s)
             free, total = self._kv_blocks_locked()
             if total > 0 and free < self.admission_watermark * total:
-                self._count_shed_locked("kv_watermark")
-                raise ServiceSaturated(self.retry_after_s)
+                if not self._prefix_covered_locked(prompt, alive):
+                    self._count_shed_locked("kv_watermark")
+                    raise ServiceSaturated(self.retry_after_s)
             if self.max_queue is not None and self._outstanding_locked() >= self.max_queue:
                 self._count_shed_locked("queue_full")
                 raise ServiceSaturated(self.retry_after_s)
@@ -448,9 +449,32 @@ class ServingFleet:
         self._c_shed.inc(1, {"reason": reason})
         self._tracer.instant("fleet_shed", {"reason": reason})
 
+    def _prefix_covered_locked(self, prompt, alive) -> bool:
+        """Watermark-bypass check: admit a below-watermark request anyway
+        when some alive member's prefix cache already holds the ENTIRE
+        prompt prefix (every token but the last, which is always
+        recomputed for its logits) AND that member has the few new blocks
+        the request still needs. A fully-shared prompt adds almost
+        nothing to the pool — shedding it would throw away exactly the
+        traffic the prefix tier makes cheap. Plain engines (no
+        ``kv_admission_probe``) never bypass."""
+        P = len(prompt)
+        if P < 2:
+            return False
+        for m in alive:
+            probe = getattr(m.engine, "kv_admission_probe", None)
+            if probe is None:
+                continue
+            shared, needed = probe(prompt, 1)
+            if shared >= P - 1 and needed <= m.engine.kv_free_blocks():
+                return True
+        return False
+
     def _kv_blocks_locked(self) -> tuple[int, int]:
         """Fleet-wide (free, total) KV blocks over non-dead members —
-        each term is the LoadBalancer's O(1) free-list accounting."""
+        each term is the LoadBalancer's O(1) accounting (sharing-adjusted
+        for prefix-cache engines: unreferenced cached blocks count as
+        free, so a pool full of reusable prefixes is not pressure)."""
         free = total = 0
         for m in self._members:
             if m.state == DEAD:

@@ -44,6 +44,7 @@ from __future__ import annotations
 
 import collections
 import dataclasses
+import operator
 import time
 from typing import Any
 
@@ -53,6 +54,7 @@ import numpy as np
 
 from ..analysis import hot_path
 from ..compile import ShapeBuckets, get_program_registry
+from ..kvmem import DEFER_ROUND, PrefixKVAllocator
 from ..obs.device import DeviceMetrics
 
 __all__ = [
@@ -183,6 +185,18 @@ class ContinuousBatchingEngine:
         warmup: ``True`` runs :meth:`aot_warmup` before construction
             returns; ``"background"`` runs it on a thread (handle at
             ``self._warmup_handle``) overlapped with remaining setup.
+        prefix_cache: enable the prefix-aware KV memory tier
+            (:mod:`rl_tpu.kvmem`): admissions match the prompt against a
+            radix tree of resident blocks, reference the shared prefix's
+            blocks instead of recomputing them, fork at most one block
+            copy-on-write, and prefill ONLY the uncached suffix through
+            partial-prefill programs (``serving.pprefill.*``). Finished
+            sequences donate their blocks back to the tree (multi-turn
+            reuse) and unreferenced blocks are evicted LRU under
+            pressure. Token output is bit-identical for greedy decoding;
+            for sampled decoding the RNG stream differs from the
+            non-cached engine (different program shapes), not the
+            distribution. See ``docs/kv_prefix.md``.
     """
 
     def __init__(
@@ -204,6 +218,7 @@ class ContinuousBatchingEngine:
         buckets: ShapeBuckets | None = None,
         registry: Any = None,
         warmup: bool | str = False,
+        prefix_cache: bool = False,
     ):
         # placement is applied by the params setter, so it must exist
         # before the first assignment below
@@ -232,6 +247,15 @@ class ContinuousBatchingEngine:
         )
         # host mirrors (the allocator's source of truth)
         self.free_blocks = list(range(1, n_blocks))  # 0 = reserved scratch
+        self._kvmem: PrefixKVAllocator | None = None
+        self._slot_lease: list = [None] * n_slots
+        self.prefill_tokens_computed = 0  # suffix token-slots actually run
+        self.prefill_tokens_cached = 0  # prompt tokens served from the tree
+        if prefix_cache:
+            self._kvmem = PrefixKVAllocator(n_blocks, block_size)
+            # ONE list object: the allocator owns it, the engine (and the
+            # fleet's O(1) accounting) alias it — no mirror to reconcile
+            self.free_blocks = self._kvmem.free_blocks
         self.table = np.full((n_slots, self.max_blocks), -1, np.int32)
         self.lens = np.zeros(n_slots, np.int64)  # prompt + ACCEPTED tokens
         self.slot_rid = np.full(n_slots, -1, np.int64)  # -1 = free slot
@@ -291,6 +315,8 @@ class ContinuousBatchingEngine:
         ))
         self._decode_progs: dict[int, Any] = {}  # chunk K -> CachedProgram
         self._prefills: dict[tuple, Any] = {}  # (A, bucket) -> CachedProgram
+        self._pprefills: dict[tuple, Any] = {}  # (A, suffix bucket) -> prog
+        self._cow_progs: dict[int, Any] = {}  # padded pair count -> prog
         self._admit_update = self._registry.register(
             "serving.admit_update", _admit_update_fn
         )
@@ -427,6 +453,77 @@ class ContinuousBatchingEngine:
             )
         return prog
 
+    def _pprefill_fn(self, params, pools, table_rows, tokens, token_mask, start, key):
+        """PARTIAL bucketed prefill (prefix-cache hits): each admitted
+        row's first ``start[i]`` positions already hold valid K/V in
+        shared (or CoW-forked) pool blocks, so only the uncached suffix
+        rides the forward — tokens [A, B] hold ``prompt[start:]`` and the
+        cache ``len`` begins at ``start``, landing the paged writes at
+        the right absolute positions while attention reads the cached
+        prefix through the row's block table (``kv_pos <= pos`` masking
+        makes the suffix attend to prefix + itself causally). Samples
+        each admitted slot's FIRST response token, same as the full
+        prefill."""
+        cache = [
+            {
+                "pool_k": pk,
+                "pool_v": pv,
+                "block_table": table_rows,
+                "len": start,
+                "active": token_mask,
+            }
+            for pk, pv in pools
+        ]
+        logits, cache = self.model.apply({"params": params}, tokens, cache=cache)
+        last = jnp.maximum(token_mask.sum(axis=1) - 1, 0)  # [A], suffix-local
+        last_logits = jnp.take_along_axis(
+            logits, last[:, None, None], axis=1
+        )[:, 0]
+        tok, lp = self._sample(last_logits, key)
+        new_pools = tuple((c["pool_k"], c["pool_v"]) for c in cache)
+        return tok, lp, new_pools
+
+    def _get_pprefill_prog(self, a: int, bucket: int):
+        prog = self._pprefills.get((a, bucket))
+        if prog is None:
+            prog = self._pprefills[(a, bucket)] = self._registry.register(
+                f"serving.pprefill.a{a}.s{bucket}",
+                self._pprefill_fn,
+                fingerprint=self._fingerprint,
+            )
+        return prog
+
+    def _cow_copy_fn(self, pools, src, dst):
+        """Copy-on-write fork: one gather + one scatter per layer pool
+        copies the source blocks' K/V into the writers' fresh private
+        blocks (pool axis 0 is the block axis). Dispatched BEFORE the
+        round's partial prefill, which consumes the returned pools — XLA
+        dataflow orders the prefill's writes after these copies without
+        any host sync."""
+        return tuple(
+            (pk.at[dst].set(pk[src]), pv.at[dst].set(pv[src]))
+            for pk, pv in pools
+        )
+
+    def _get_cow_prog(self, n: int):
+        prog = self._cow_progs.get(n)
+        if prog is None:
+            prog = self._cow_progs[n] = self._registry.register(
+                f"serving.cowcopy.n{n}", self._cow_copy_fn,
+                fingerprint=self._fingerprint,
+            )
+        return prog
+
+    def _dispatch_cow(self, pools, cows):
+        """Run the round's COW copies as one fixed-shape program (pair
+        count padded up the power-of-two ladder by repeating the last
+        pair — re-copying the same src->dst is idempotent)."""
+        n = _pow2ceil(len(cows))
+        cows = cows + [cows[-1]] * (n - len(cows))
+        src = jnp.asarray([c[0] for c in cows], jnp.int32)
+        dst = jnp.asarray([c[1] for c in cows], jnp.int32)
+        return self._get_cow_prog(n)(pools, src, dst)
+
     def _sample(self, logits, key):
         """(token, behavior log-prob of that token) per row."""
         t = jnp.maximum(jnp.asarray(self.temperature, jnp.float32), 1e-6)
@@ -451,6 +548,16 @@ class ContinuousBatchingEngine:
         an exact block multiple), which would overwrite and LEAK a block."""
         have = int((self.table[slot] >= 0).sum())
         need = self._blocks_needed(new_len)
+        if self._kvmem is not None:
+            # decode growth through the allocator: may evict LRU
+            # unreferenced cached blocks to satisfy the request
+            got = self._kvmem.alloc(need - have)
+            if got is None:
+                return False
+            for j, b in zip(range(have, need), got):
+                self.table[slot, j] = b
+                self._pending_table_writes.append((slot, j, b))
+            return True
         if need - have > len(self.free_blocks):
             return False
         for j in range(have, need):
@@ -496,7 +603,22 @@ class ContinuousBatchingEngine:
             )
         )
         used = self.table[slot]
-        self.free_blocks.extend(int(b) for b in used[used >= 0])
+        if self._kvmem is not None:
+            # the lease ends here, BEFORE the host mirrors reset: lens[slot]
+            # still counts exactly the KV-valid positions (prompt + accepted
+            # tokens minus the final sample, which was never fed back), so
+            # the allocator can extend/donate the generated blocks into the
+            # tree for multi-turn reuse and free the rest
+            fin = self.finished[-1]
+            lease, self._slot_lease[slot] = self._slot_lease[slot], None
+            self._kvmem.release(
+                lease,
+                fin.prompt.tolist() + fin.tokens.tolist(),
+                operator.index(self.lens[slot]),
+                [b for b in used.tolist() if b >= 0],
+            )
+        else:
+            self.free_blocks.extend(int(b) for b in used[used >= 0])
         self.table[slot] = -1
         self.lens[slot] = 0
         self.sched_lens[slot] = 0
@@ -569,20 +691,53 @@ class ContinuousBatchingEngine:
         if admit_sizes is None:
             admit_sizes = self.shape_buckets.admit_sizes(S)
         if prompt_buckets is None:
-            prompt_buckets = self.buckets
-        for a in admit_sizes:
-            for b in prompt_buckets:
-                a, b = int(a), int(b)
-                prog = self._get_prefill_prog(a, b)
+            prompt_buckets = (
+                self.buckets
+                if self._kvmem is None
+                else self.shape_buckets.suffix_ladder()
+            )
+        if self._kvmem is None:
+            for a in admit_sizes:
+                for b in prompt_buckets:
+                    a, b = int(a), int(b)
+                    prog = self._get_prefill_prog(a, b)
+                    prog.add_signature(
+                        params_abs,
+                        pools_abs,
+                        jax.ShapeDtypeStruct((a, self.max_blocks), jnp.int32),
+                        jax.ShapeDtypeStruct((a, b), jnp.int32),
+                        jax.ShapeDtypeStruct((a, b), jnp.bool_),
+                        key_abs,
+                    )
+                    progs.append(prog)
+        else:
+            # prefix mode dispatches partial prefills bucketed on SUFFIX
+            # length (the legacy full-prefill family is never called), plus
+            # the COW copy ladder: one program per padded pair count
+            for a in admit_sizes:
+                for b in prompt_buckets:
+                    a, b = int(a), int(b)
+                    prog = self._get_pprefill_prog(a, b)
+                    prog.add_signature(
+                        params_abs,
+                        pools_abs,
+                        jax.ShapeDtypeStruct((a, self.max_blocks), jnp.int32),
+                        jax.ShapeDtypeStruct((a, b), jnp.int32),
+                        jax.ShapeDtypeStruct((a, b), jnp.bool_),
+                        jax.ShapeDtypeStruct((a,), jnp.int32),
+                        key_abs,
+                    )
+                    progs.append(prog)
+            n = 1
+            while n <= _pow2ceil(S):
+                prog = self._get_cow_prog(n)
                 prog.add_signature(
-                    params_abs,
                     pools_abs,
-                    jax.ShapeDtypeStruct((a, self.max_blocks), jnp.int32),
-                    jax.ShapeDtypeStruct((a, b), jnp.int32),
-                    jax.ShapeDtypeStruct((a, b), jnp.bool_),
-                    key_abs,
+                    jax.ShapeDtypeStruct((n,), jnp.int32),
+                    jax.ShapeDtypeStruct((n,), jnp.int32),
                 )
                 progs.append(prog)
+                n *= 2
         self._admit_update.add_signature(
             vec_i32, vec_bool, vec_i32, vec_i32,
             vec_bool, vec_i32, vec_i32, vec_i32,
@@ -596,7 +751,7 @@ class ContinuousBatchingEngine:
         this at scrape cadence costs nothing on the decode path."""
         used = self._n_pool_blocks - len(self.free_blocks)
         tokens = float(jax.device_get(self.dev_obs["counters"]["tokens"]))
-        return {
+        snap = {
             "tokens_generated": tokens,
             "decode_steps": self.decode_steps,
             "decode_launches": self.decode_launches,
@@ -615,6 +770,34 @@ class ContinuousBatchingEngine:
             "kv_blocks_total": self._n_pool_blocks,
             "kv_utilization": used / max(self._n_pool_blocks, 1),
         }
+        snap["prefill_tokens_computed"] = self.prefill_tokens_computed
+        snap["prefill_tokens_cached"] = self.prefill_tokens_cached
+        if self._kvmem is not None:
+            snap.update(self._kvmem.stats())
+            # sharing-adjusted: resident blocks no live sequence references
+            # are one eviction from free, so they don't count as used
+            free_adj = self._kvmem.free_adjusted()
+            snap["kv_free_blocks_adjusted"] = free_adj
+            snap["kv_utilization"] = 1.0 - free_adj / max(self._n_pool_blocks, 1)
+        return snap
+
+    def kv_free_blocks(self) -> int:
+        """Sharing-adjusted free capacity for fleet admission: the free
+        list plus (prefix mode) resident blocks no live sequence
+        references — a fully-shared prompt must not look like pressure."""
+        if self._kvmem is not None:
+            return self._kvmem.free_adjusted()
+        return len(self.free_blocks)
+
+    def kv_admission_probe(self, prompt, max_new_tokens: int = 1):
+        """``(shared_len, new_blocks_needed)`` if ``prompt`` were admitted
+        now — read-only (nothing allocated, no refs taken). The fleet's
+        watermark bypass uses it to recognize fully-shared prompts."""
+        seq = prompt.tolist() if hasattr(prompt, "tolist") else list(prompt)
+        want = len(seq) + max(1, max_new_tokens)
+        if self._kvmem is None:
+            return 0, self._blocks_needed(want)
+        return self._kvmem.probe(seq, want)
 
     def pending(self) -> int:
         """Outstanding work: queued + in-flight requests."""
@@ -650,18 +833,54 @@ class ContinuousBatchingEngine:
         if not free or not self.queue:
             return
         batch: list[tuple[int, Request]] = []
-        for s in free:
-            if not self.queue:
-                break
-            req = self.queue[0]
-            if not self._ensure_blocks(s, len(req.prompt) + 1):
-                break  # pool exhausted: retry after sequences finish
-            batch.append((s, self.queue.pop(0)))
+        starts: list[int] = []  # cached-prefix length per admitted row
+        cows: list[tuple[int, int]] = []  # (src, dst) block copies this round
+        if self._kvmem is not None:
+            for s in free:
+                if not self.queue:
+                    break
+                req = self.queue[0]
+                plan = self._kvmem.admit(
+                    req.prompt.tolist(), len(req.prompt) + 1
+                )
+                if plan is None:
+                    break  # pool exhausted: retry after sequences finish
+                if plan is DEFER_ROUND:
+                    # the match touches blocks published by an EARLIER
+                    # admission in this same round, whose prefill has not
+                    # dispatched yet — stop batching; next round the
+                    # dispatch order makes the share safe
+                    break
+                for j, b in enumerate(plan.blocks):
+                    self.table[s, j] = b
+                    self._pending_table_writes.append((s, j, b))
+                self._slot_lease[s] = plan.lease
+                starts.append(plan.shared_len)
+                if plan.cow is not None:
+                    cows.append(plan.cow)
+                batch.append((s, self.queue.pop(0)))
+        else:
+            for s in free:
+                if not self.queue:
+                    break
+                req = self.queue[0]
+                if not self._ensure_blocks(s, len(req.prompt) + 1):
+                    break  # pool exhausted: retry after sequences finish
+                starts.append(0)
+                batch.append((s, self.queue.pop(0)))
         if not batch:
             return
-        bucket = self.shape_buckets.prompt_bucket(
-            max(len(r.prompt) for _, r in batch)
-        )
+        if self._kvmem is not None:
+            # the compile ladder buckets the SUFFIX, not the prompt: a
+            # 500-token prompt with 480 cached prefills through the same
+            # small program as a 20-token cold prompt
+            bucket = self.shape_buckets.suffix_bucket(
+                max(len(r.prompt) - st for (_, r), st in zip(batch, starts))
+            )
+        else:
+            bucket = self.shape_buckets.prompt_bucket(
+                max(len(r.prompt) for _, r in batch)
+            )
         A = len(batch)
         self.admissions += A
         # round the admitted-count dim up its ladder: the pad rows carry an
@@ -674,8 +893,9 @@ class ContinuousBatchingEngine:
         mask = np.zeros((pad_a, bucket), bool)
         for i, (s, req) in enumerate(batch):
             P = len(req.prompt)
-            tokens[i, :P] = req.prompt
-            mask[i, :P] = True
+            st = starts[i]
+            tokens[i, : P - st] = req.prompt[st:]
+            mask[i, : P - st] = True
             self.slot_rid[s] = req.rid
             self.slot_prompt[req.rid] = req.prompt
             self.slot_tokens[s] = []
@@ -686,16 +906,40 @@ class ContinuousBatchingEngine:
         slots[:A] = [s for s, _ in batch]
         self._flush_table_writes()  # prefill reads the new rows on device
         self._key, k = jax.random.split(self._key)
-        fn = self._get_prefill_prog(pad_a, bucket)
         pools = tuple((layer["pool_k"], layer["pool_v"]) for layer in self.cache)
-        tok, lp, new_pools = fn(
-            self.params,
-            pools,
-            self.dev_table[jnp.asarray(slots)],
-            jnp.asarray(tokens),
-            jnp.asarray(mask),
-            k,
-        )
+        if self._kvmem is not None:
+            if cows:
+                pools = self._dispatch_cow(pools, cows)
+            start_v = np.zeros(pad_a, np.int32)
+            start_v[:A] = starts
+            fn = self._get_pprefill_prog(pad_a, bucket)
+            tok, lp, new_pools = fn(
+                self.params,
+                pools,
+                self.dev_table[jnp.asarray(slots)],
+                jnp.asarray(tokens),
+                jnp.asarray(mask),
+                jnp.asarray(start_v),
+                k,
+            )
+            # the round's published blocks are now behind a dispatched
+            # prefill: safe for next round's admissions to share
+            self._kvmem.end_round()
+            self.prefill_tokens_computed += sum(
+                len(r.prompt) - st for (_, r), st in zip(batch, starts)
+            )
+            self.prefill_tokens_cached += sum(starts)
+        else:
+            fn = self._get_prefill_prog(pad_a, bucket)
+            tok, lp, new_pools = fn(
+                self.params,
+                pools,
+                self.dev_table[jnp.asarray(slots)],
+                jnp.asarray(tokens),
+                jnp.asarray(mask),
+                k,
+            )
+            self.prefill_tokens_computed += sum(len(r.prompt) for _, r in batch)
         for layer, (pk, pv) in zip(self.cache, new_pools):
             layer["pool_k"], layer["pool_v"] = pk, pv
         self.prefill_token_slots += A * bucket
@@ -972,7 +1216,13 @@ class ContinuousBatchingEngine:
         recycles a crashed replica without paying recompilation, and why a
         request id never collides across a crash."""
         n = self.n_slots
-        self.free_blocks = list(range(1, self._n_pool_blocks + 1))
+        if self._kvmem is not None:
+            # in place: self.free_blocks stays the allocator's list object;
+            # the cached tree is dropped (pool contents are unreachable)
+            self._kvmem.reset()
+            self._slot_lease = [None] * n
+        else:
+            self.free_blocks = list(range(1, self._n_pool_blocks + 1))
         self.table[:] = -1
         self.lens[:] = 0
         self.slot_rid[:] = -1
@@ -1069,8 +1319,14 @@ class LoadBalancer:
 
     def _kv_utilization(self, eng) -> float:
         # O(1) from the engine's free-list accounting — select_engine runs
-        # per submit, so an O(blocks) table rescan here was pure overhead
-        used = eng._n_pool_blocks - len(eng.free_blocks)
+        # per submit, so an O(blocks) table rescan here was pure overhead.
+        # Prefix-cache engines report sharing-ADJUSTED free capacity
+        # (cached blocks no live sequence references are one eviction from
+        # free), so a pool full of reusable prefixes doesn't read as
+        # pressure; plain engines fall back to the raw free list
+        probe = getattr(eng, "kv_free_blocks", None)
+        free = probe() if probe is not None else len(eng.free_blocks)
+        used = eng._n_pool_blocks - free
         return used / max(eng._n_pool_blocks, 1)
 
     # -- selection -------------------------------------------------------------
@@ -1198,10 +1454,19 @@ class ServingService:
         self._m_shed = reg.counter(
             f"{p}_shed_total", "submits shed with retry-after (queue saturated)"
         )
+        self._m_kv_cow = reg.counter(
+            f"{p}_kv_cow_copies_total", "copy-on-write KV block forks"
+        )
+        self._m_kv_evictions = reg.counter(
+            f"{p}_kv_evictions_total", "prefix-cache blocks evicted",
+            labels=("reason",),
+        )
         self._m_gauges = {
             name: reg.gauge(f"{p}_{name}", help_)
             for name, help_ in (
                 ("kv_utilization", "fraction of KV pool blocks in use"),
+                ("kv_prefix_hit_rate", "prompt tokens served from the prefix cache"),
+                ("kv_shared_blocks", "resident KV blocks referenced by live sequences"),
                 ("queue_depth", "requests waiting for a slot"),
                 ("active_slots", "slots decoding"),
                 ("pending", "queued + in-flight requests"),
@@ -1224,6 +1489,12 @@ class ServingService:
         for name in ("kv_utilization", "queue_depth", "active_slots", "pending",
                      "decode_chunk"):
             self._m_gauges[name].set(float(snap[name]))
+        if "kv_prefix_hit_rate" in snap:  # engine runs the prefix tier
+            self._m_gauges["kv_prefix_hit_rate"].set(float(snap["kv_prefix_hit_rate"]))
+            self._m_gauges["kv_shared_blocks"].set(float(snap["kv_shared_blocks"]))
+            self._m_kv_cow.set_total(snap["kv_cow_copies_total"])
+            for reason, n in snap["kv_evictions"].items():
+                self._m_kv_evictions.set_total(n, {"reason": reason})
         if snap["tuner_k"] is not None:
             self._m_gauges["tuner_k"].set(float(snap["tuner_k"]))
         now = time.monotonic()

@@ -48,6 +48,11 @@ site                        where / supported kinds
                             per sweep in member order (``drop`` = that probe
                             reads as a failure)
 ``fleet.dispatch_delay``    ServingFleet dispatcher iteration (``delay``)
+``kvmem.evict``             PrefixKVAllocator, before EACH single-block LRU
+                            eviction step (``crash``, ``delay``) — a crash
+                            abandons the allocation between atomic steps,
+                            so refcounts and the free list stay consistent
+                            (degrade, never corrupt)
 ==========================  =================================================
 """
 
@@ -85,6 +90,7 @@ SITES: dict[str, str] = {
     "fleet.engine_crash": "ServingFleet member stepper, per busy iteration",
     "fleet.probe_drop": "ServingFleet health-monitor probe (drop = failure)",
     "fleet.dispatch_delay": "ServingFleet dispatcher iteration",
+    "kvmem.evict": "PrefixKVAllocator single-block LRU eviction step",
 }
 
 KINDS = ("crash", "delay", "drop", "nan", "preempt")

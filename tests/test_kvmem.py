@@ -1,0 +1,481 @@
+"""Prefix-aware KV memory tier (ISSUE 11): radix-tree block reuse with
+copy-on-write paged allocation.
+
+Strategy: (1) the allocator core is property-tested against a naive
+reference model — same hit decisions (``shared_len`` == the clamped
+longest common prefix over resident donor sequences), the refcount
+invariant ``refs == live readers`` re-audited after EVERY operation, and
+the pool partition (free ∪ lent ∪ resident, pairwise disjoint) proven
+exactly, so no block is ever double-freed or freed while referenced;
+(2) the engine integration must produce BIT-IDENTICAL greedy outputs to
+a prefix-cache-off engine while prefilling only the uncached suffix,
+with ``CompileDelta == 0`` in steady state; (3) eviction under the
+seeded ``kvmem.evict`` chaos site degrades (the allocation is abandoned
+between atomic single-block steps) but never corrupts; (4) the fleet's
+KV watermark counts sharing-adjusted free capacity and a fully-shared
+prefix bypasses a breached watermark, keeping ``lost == 0``."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from rl_tpu.compile import CompileDelta, ShapeBuckets
+from rl_tpu.kvmem import DEFER_ROUND, PrefixKVAllocator, PrefixTree
+from rl_tpu.models import (
+    ContinuousBatchingEngine,
+    ServiceSaturated,
+    ServingFleet,
+    TransformerConfig,
+    TransformerLM,
+)
+from rl_tpu.obs import MetricsRegistry
+from rl_tpu.resilience import Fault, FaultInjector, InjectedFault, injection
+
+KEY = jax.random.key(0)
+
+
+def small_model():
+    cfg = TransformerConfig(
+        vocab_size=97, d_model=64, n_layers=2, n_heads=4, d_ff=128,
+        max_seq_len=128, dtype=jnp.float32,
+    )
+    m = TransformerLM(cfg)
+    params = m.init(KEY, jnp.zeros((1, 8), jnp.int32))["params"]
+    return m, params
+
+
+_MODEL = small_model()  # one compile cache for the whole module
+
+
+def _engine(prefix_cache=True, n_slots=4, n_blocks=65, block_size=4, **kw):
+    m, params = _MODEL
+    kw.setdefault("prompt_buckets", (32, 64))
+    return ContinuousBatchingEngine(
+        m, params, n_slots=n_slots, block_size=block_size, n_blocks=n_blocks,
+        eos_id=0, greedy=True, seed=7, prefix_cache=prefix_cache, **kw,
+    )
+
+
+# ---------------------------------------------------------------------------
+# radix tree unit behavior
+
+
+class TestRadixTree:
+    def test_cold_miss_then_whole_block_chain(self):
+        t = PrefixTree(4)
+        chain, cow, lcp, exact = t.match((1, 2, 3, 4, 5, 6))
+        assert chain == [] and cow is None and lcp == 0 and not exact
+        # publish two blocks (a donor's prompt) and re-match an extension
+        a = t.attach(t.root, (1, 2, 3, 4), block=10)
+        b = t.attach(a, (5, 6, 7, 8), block=11)
+        chain, cow, lcp, _ = t.match((1, 2, 3, 4, 5, 6, 9, 9, 9))
+        assert [n.block for n in chain] == [10]
+        assert cow is b and lcp == 2  # mid-block divergence -> CoW fork
+
+    def test_match_never_covers_the_last_position(self):
+        """The final prompt position must be recomputed (its logits sample
+        the first response token), so an exact repeat surrenders the tail
+        block to a CoW fork instead of sharing the whole prompt."""
+        t = PrefixTree(4)
+        a = t.attach(t.root, (1, 2, 3, 4), block=10)
+        b = t.attach(a, (5, 6), block=11)
+        t.register_exact((1, 2, 3, 4, 5, 6), b)
+        chain, cow, lcp, exact = t.match((1, 2, 3, 4, 5, 6))
+        assert exact
+        assert [n.block for n in chain] == [10]
+        assert cow is b and lcp == 1  # positions 4..4 shared, 5 recomputed
+        # block-aligned repeat: the popped tail is a full block
+        chain, cow, lcp, _ = t.match((1, 2, 3, 4))
+        assert chain == [] and cow is a and lcp == 3
+
+    def test_lru_eviction_leaf_order_and_parent_exposure(self):
+        t = PrefixTree(4)
+        a = t.attach(t.root, (1, 2, 3, 4), block=10)
+        b = t.attach(a, (5, 6, 7, 8), block=11)
+        c = t.attach(t.root, (9, 9, 9, 9), block=12)
+        t.match((9, 9, 9, 9, 0))  # touch c: now b is the LRU leaf
+        assert t.pop_lru() is b
+        # evicting b exposed a as a leaf; c was touched later
+        assert t.pop_lru() is a
+        assert t.pop_lru() is c
+        assert t.pop_lru() is None and t.n_nodes == 0
+
+    def test_referenced_nodes_never_evicted(self):
+        t = PrefixTree(4)
+        a = t.attach(t.root, (1, 2, 3, 4), block=10)
+        t.incref(a)
+        assert t.pop_lru() is None  # a live reader holds it
+        t.decref(a)
+        assert t.pop_lru() is a
+
+
+# ---------------------------------------------------------------------------
+# allocator property tests vs a naive reference
+
+
+class NaiveRef:
+    """Reference model: every resident donor as a flat token tuple. The
+    expected hit decision is the longest common prefix over donors,
+    clamped to P-1 (the last position is always recomputed)."""
+
+    def __init__(self):
+        self.donors: list[tuple] = []
+
+    def expected_shared(self, t) -> int:
+        P = len(t)
+        best = 0
+        for d in self.donors:
+            n = min(len(d), P)
+            i = 0
+            while i < n and d[i] == t[i]:
+                i += 1
+            best = max(best, i)
+        return min(best, P - 1)
+
+    def add(self, seq) -> None:
+        self.donors.append(tuple(seq))
+
+
+def _random_prompt(rng, ref, block):
+    """Fresh, prefix-extending, or exact-repeat prompts — the mix that
+    exercises cold miss, chain + CoW, and the exact fast path."""
+    kind = rng.integers(0, 4)
+    if kind >= 2 and ref.donors:
+        d = list(ref.donors[rng.integers(0, len(ref.donors))])
+        if kind == 2:  # exact repeat of a donor's registered coverage
+            return d if len(d) >= 2 else d + [int(rng.integers(0, 50))]
+        cut = int(rng.integers(1, len(d) + 1))  # shared prefix + new tail
+        return d[:cut] + [int(v) for v in rng.integers(0, 50, 4)]
+    n = int(rng.integers(2, 4 * block))
+    return [int(v) for v in rng.integers(0, 50, n)]
+
+
+class TestAllocatorProperties:
+    def test_hit_decisions_and_refcounts_match_reference(self):
+        rng = np.random.default_rng(0)
+        block = 4
+        kv = PrefixKVAllocator(n_blocks=4096, block_size=block)  # no pressure
+        ref = NaiveRef()
+        live: dict[int, tuple[list, list]] = {}  # lease -> (prompt, blocks)
+        for _ in range(300):
+            if live and rng.random() < 0.4:
+                lease = list(live)[rng.integers(0, len(live))]
+                prompt, blocks = live.pop(lease)
+                gen = [int(v) for v in rng.integers(50, 97, int(rng.integers(1, 7)))]
+                seq = prompt + gen
+                n_valid = len(prompt) + len(gen) - 1  # final sample never fed
+                need = -(-(len(seq)) // block) - len(blocks)
+                if need > 0:
+                    blocks = blocks + kv.alloc(need)
+                    kv.audit()
+                kv.release(lease, seq, n_valid, blocks)
+                ref.add(seq[:n_valid])
+                kv.audit()
+                continue
+            prompt = _random_prompt(rng, ref, block)
+            free_before = len(kv.free_blocks)
+            plan = kv.admit(prompt, len(prompt) + 1)
+            kv.end_round()
+            assert plan is not None and plan is not DEFER_ROUND
+            # same hit decision as the naive reference
+            assert plan.shared_len == ref.expected_shared(prompt), prompt
+            # admission charged ONLY the new blocks
+            n_new = len(plan.blocks) - plan.n_shared
+            assert free_before - len(kv.free_blocks) == n_new
+            assert n_new == -(-(len(prompt) + 1) // block) - plan.n_shared
+            ref.add(prompt)  # published at admission
+            live[plan.lease] = (prompt, list(plan.blocks))
+            kv.audit()  # refs == live readers, pool partition exact
+        for lease, (prompt, blocks) in list(live.items()):
+            kv.release(lease, prompt + [99], len(prompt), blocks)
+            kv.audit()
+        assert kv.stats()["kv_evictions_total"] == 0  # pool never pressured
+        # every lease gone: nothing referenced, nothing lent
+        a = kv.audit()
+        assert a["leases"] == 0 and a["lent"] == 0
+
+    def test_under_pressure_evicts_only_unreferenced_never_corrupts(self):
+        rng = np.random.default_rng(1)
+        block = 4
+        kv = PrefixKVAllocator(n_blocks=25, block_size=block)  # 24 usable
+        ref = NaiveRef()  # hit decisions NOT asserted here (eviction
+        live: dict[int, tuple[list, list]] = {}  # invalidates donors)
+        admitted = denied = 0
+        for _ in range(400):
+            if live and (rng.random() < 0.45 or len(live) >= 4):
+                lease = list(live)[rng.integers(0, len(live))]
+                prompt, blocks = live.pop(lease)
+                gen = [int(v) for v in rng.integers(50, 97, int(rng.integers(1, 5)))]
+                seq = prompt + gen
+                need = -(-(len(seq)) // block) - len(blocks)
+                got = kv.alloc(need) if need > 0 else []
+                kv.audit()
+                if got is None:
+                    got = []  # release with what the table has
+                    seq = seq[: len(blocks) * block]
+                kv.release(lease, seq, min(len(seq), len(prompt) + len(gen) - 1),
+                           blocks + got)
+                kv.audit()
+                continue
+            prompt = _random_prompt(rng, ref, block)
+            plan = kv.admit(prompt, len(prompt) + 1)
+            kv.end_round()
+            kv.audit()  # invariants hold whether admitted or denied
+            if plan is None:
+                denied += 1
+                continue
+            admitted += 1
+            ref.add(prompt)
+            live[plan.lease] = (prompt, list(plan.blocks))
+        assert admitted > 50
+        assert kv.stats()["kv_evictions_total"] > 0  # pressure was real
+        for lease, (prompt, blocks) in list(live.items()):
+            kv.release(lease, prompt + [99], len(prompt), blocks)
+            kv.audit()
+
+    def test_same_round_share_defers(self):
+        """A prompt whose match touches blocks published in the SAME
+        admission round (prefill not yet dispatched) must defer — sharing
+        them would read K/V the device has not written yet."""
+        kv = PrefixKVAllocator(n_blocks=64, block_size=4)
+        p = [1, 2, 3, 4, 5, 6]
+        a = kv.admit(p, len(p) + 1)
+        assert a is not None and a is not DEFER_ROUND
+        assert kv.admit(p, len(p) + 1) is DEFER_ROUND  # same round
+        kv.end_round()  # the round's prefill dispatched
+        b = kv.admit(p, len(p) + 1)
+        assert b is not None and b is not DEFER_ROUND
+        assert b.shared_len == len(p) - 1  # now it shares
+        kv.audit()
+
+    def test_release_is_double_free_safe(self):
+        kv = PrefixKVAllocator(n_blocks=64, block_size=4)
+        plan = kv.admit([1, 2, 3, 4, 5], 6)
+        kv.end_round()
+        kv.release(plan.lease, [1, 2, 3, 4, 5, 9], 5, list(plan.blocks))
+        with pytest.raises(KeyError):  # lease gone: cannot release twice
+            kv.release(plan.lease, [1, 2, 3, 4, 5, 9], 5, list(plan.blocks))
+        kv.audit()
+
+
+# ---------------------------------------------------------------------------
+# engine integration
+
+
+class TestEngineIntegration:
+    def test_shared_prompt_prefills_only_suffix_identical_outputs(self):
+        rng = np.random.default_rng(0)
+        sysp = rng.integers(1, 97, size=21)
+        prompts = [np.concatenate([sysp, rng.integers(1, 97, size=5)])
+                   for _ in range(6)]
+        prompts += [sysp.copy(), sysp.copy()]  # exact-repeat fast path
+        e0, e1 = _engine(prefix_cache=False), _engine(prefix_cache=True)
+        for p in prompts:
+            e0.submit(p, 12)
+            e1.submit(p, 12)
+        out0, out1 = e0.run(), e1.run()
+        for rid in out0:
+            assert np.array_equal(out0[rid].tokens, out1[rid].tokens), rid
+            assert out0[rid].finished_reason == out1[rid].finished_reason
+        snap = e1.metrics_snapshot()
+        # the shared system prompt was computed once, then served cached
+        assert snap["kv_prefix_hit_rate"] > 0.5
+        assert snap["kv_prefix_exact_hits"] >= 1
+        assert snap["kv_cow_copies_total"] >= 1
+        assert snap["prefill_tokens_computed"] < e0.prefill_tokens_computed
+        # baseline engine: zero cache, every prompt token computed
+        assert e0.prefill_tokens_cached == 0
+        e1._kvmem.audit()
+
+    def test_compile_free_steady_state(self):
+        eng = _engine(
+            prefix_cache=True, prompt_buckets=None,
+            buckets=ShapeBuckets(prompt=(32, 64), suffix=(8, 16)),
+        )
+        eng.aot_warmup()
+        rng = np.random.default_rng(1)
+        sysp = rng.integers(1, 97, size=21)
+        # ONE fixed request list replayed verbatim every round (bench.py's
+        # steady-state idiom): per-round random suffixes would vary the
+        # admission grouping, so a clean glue round would not prove the
+        # measured round replays only already-glued shapes
+        reqs = [
+            np.concatenate([sysp, rng.integers(1, 97, size=4)])
+            for _ in range(6)
+        ]
+
+        def traffic():
+            for r in reqs:
+                eng.submit(r, 6)
+            eng.run()
+
+        # warm-up rounds absorb one-time host-glue compiles (tiny
+        # unattributed ops, shaped by pending-write/admit counts). One
+        # clean round is not proof: engine state still evolves (donated
+        # blocks fill the pool, then LRU eviction changes suffix lengths
+        # and write counts), and a round can look clean merely because an
+        # earlier test in the process warmed its shapes. The glue shape
+        # set is finite (everything is pow2/ladder-bucketed), so demand
+        # TWO consecutive compile-free rounds before measuring.
+        clean = 0
+        for _ in range(12):
+            with CompileDelta() as glue:
+                traffic()
+            clean = clean + 1 if (not glue.supported or glue.delta == 0) else 0
+            if clean >= 2:
+                break
+        with CompileDelta() as steady:
+            traffic()
+        assert not steady.supported or steady.delta == 0, steady.explain()
+        snap = eng.metrics_snapshot()
+        assert snap["kv_prefix_hit_rate"] > 0.5
+
+    def test_multi_turn_prefix_reuse(self):
+        """A finished sequence donates its generated blocks: re-submitting
+        prompt+response(+more) prefills only past the donated coverage."""
+        eng = _engine(prefix_cache=True)
+        rng = np.random.default_rng(3)
+        p1 = rng.integers(1, 97, size=13)
+        rid = eng.submit(p1, 10)
+        f = eng.run()[rid]
+        cached_before = eng.prefill_tokens_cached
+        turn2 = np.concatenate([p1, f.tokens, rng.integers(1, 97, size=4)])
+        rid2 = eng.submit(turn2, 6)
+        eng.run()
+        gained = eng.prefill_tokens_cached - cached_before
+        # at least the first turn's prompt + most of its response is reused
+        assert gained >= len(p1), gained
+        eng._kvmem.audit()
+
+    def test_eviction_chaos_degrades_never_corrupts(self):
+        eng = _engine(prefix_cache=True, n_blocks=33)  # small pool
+        rng = np.random.default_rng(2)
+
+        def some_traffic(n=5):
+            for _ in range(n):
+                eng.submit(
+                    rng.integers(1, 97, size=int(rng.integers(8, 25))), 8
+                )
+
+        for _ in range(4):  # fill the tree so evictions are constant
+            some_traffic()
+            eng.run()
+            eng._kvmem.audit()
+        assert eng.metrics_snapshot()["kv_evictions_total"] > 0
+        inj = FaultInjector({"kvmem.evict": [Fault("crash", at=(1,))]}, seed=0)
+        with injection(inj):
+            some_traffic()
+            with pytest.raises(InjectedFault):
+                eng.run()
+        # degrade, never corrupt: every invariant still holds, the queue
+        # kept the un-admitted requests, and the engine finishes them
+        eng._kvmem.audit()
+        done = eng.run()
+        assert len(done) == 5
+        eng._kvmem.audit()
+
+    def test_reset_returns_every_block_in_place(self):
+        eng = _engine(prefix_cache=True)
+        rng = np.random.default_rng(4)
+        for _ in range(4):
+            eng.submit(rng.integers(1, 97, size=10), 5)
+        eng.run()
+        alias = eng.free_blocks
+        eng.reset()
+        assert eng.free_blocks is alias  # fleet's O(1) accounting survives
+        assert len(eng.free_blocks) == eng._n_pool_blocks
+        a = eng._kvmem.audit()
+        assert a["resident"] == 0 and a["lent"] == 0
+
+
+# ---------------------------------------------------------------------------
+# fleet admission: sharing-adjusted watermark
+#
+# rlint runtime sanitizer: the allocator lock joins the fleet/engine lock
+# graph here; any observed lock-order inversion fails at teardown
+pytestmark_fleet = pytest.mark.usefixtures("lock_witness")
+
+
+@pytest.mark.usefixtures("lock_witness")
+class TestFleetSharingAdjustedAdmission:
+    def _fleet(self, engines, **kw):
+        kw.setdefault("registry", MetricsRegistry())
+        kw.setdefault("probe_interval_s", 0.01)
+        return ServingFleet(engines, **kw)
+
+    def test_cached_full_pool_does_not_trip_watermark(self):
+        """An engine whose pool is 100% resident-but-unreferenced cache
+        must read as FREE capacity: without sharing adjustment the raw
+        free list is empty and every submit would shed kv_watermark."""
+        eng = _engine(prefix_cache=True)
+        rng = np.random.default_rng(5)
+        while len(eng.free_blocks) > 0:  # push the whole pool into the tree
+            for _ in range(4):
+                eng.submit(rng.integers(1, 97, size=int(rng.integers(8, 25))), 6)
+            eng.run()
+        assert len(eng.free_blocks) == 0  # raw accounting says "full"
+        assert eng.kv_free_blocks() == eng._n_pool_blocks  # adjusted: empty
+        fleet = self._fleet([eng])
+        fleet.start()
+        try:
+            frid = fleet.submit(rng.integers(1, 97, size=10), 4)  # no shed
+            done = fleet.wait([frid], timeout=60)
+            assert set(done) == {frid}
+            acc = fleet.accounting()
+            assert acc["lost"] == 0
+            assert fleet.shed.get("kv_watermark", 0) == 0
+        finally:
+            fleet.shutdown()
+
+    def test_fully_shared_prefix_bypasses_breached_watermark(self):
+        """With the watermark genuinely breached (live sequences hold the
+        blocks), a prompt whose ENTIRE prefix is cached still admits —
+        it adds almost nothing to the pool — while a cold prompt sheds."""
+        eng = _engine(prefix_cache=True)
+        rng = np.random.default_rng(6)
+        shared = rng.integers(1, 97, size=21)
+        eng.submit(shared, 4)
+        eng.run()  # publish + donate the shared prompt into the tree
+        # watermark 1.0: free < total always holds once ANY block is
+        # referenced or lent, so every admission must take the bypass path
+        fleet = self._fleet([eng], admission_watermark=2.0)
+        fleet.start()
+        try:
+            cold = rng.integers(1, 97, size=20)
+            with pytest.raises(ServiceSaturated):
+                fleet.submit(cold, 4)
+            assert fleet.shed.get("kv_watermark", 0) == 1
+            frid = fleet.submit(shared, 4)  # fully cached -> bypass
+            done = fleet.wait([frid], timeout=60)
+            assert set(done) == {frid}
+            assert fleet.accounting()["lost"] == 0
+        finally:
+            fleet.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# GRPO rollout path: a group shares ONE prompt
+
+
+class TestCollectorPrefixReuse:
+    def test_group_shared_prompt_hits_exact_path(self):
+        """G engine requests with the IDENTICAL prompt (a GRPO group):
+        after the first admission publishes the prompt, every later one
+        resolves via the exact-match fast path and prefills only the
+        final position."""
+        eng = _engine(prefix_cache=True)
+        rng = np.random.default_rng(7)
+        prompt = rng.integers(1, 97, size=17)
+        G = 6
+        for _ in range(G):
+            eng.submit(prompt, 8)
+        eng.run()
+        snap = eng.metrics_snapshot()
+        assert snap["kv_prefix_hits"] >= G - 1
+        assert snap["kv_prefix_exact_hits"] >= G - 2  # first hit may be
+        # a plain radix walk (published mid-round), the rest exact
+        assert snap["prefill_tokens_cached"] >= (G - 1) * (len(prompt) - 1)
+        eng._kvmem.audit()

@@ -280,6 +280,64 @@ def test_compile_split_distilled_to_own_artifact(tmp_path):
     assert runner.commits[0][0] == [art, mart, cpart]
 
 
+def test_prefix_result_distilled_to_own_artifact(tmp_path):
+    """ISSUE-11: the prefix sub-bench's measured result (prefill-compute
+    reduction vs the legacy allocator, KV blocks/request, hit-rate/CoW/
+    eviction counters, lost==0 under the mid-run kvmem.evict crash) lands
+    whole in its own committed PREFIX json, riding the same single commit
+    as the raw artifact and the metrics distillation."""
+
+    class PrefixRunner(FakeRunner):
+        def bench_all(self, timeout):
+            self.bench_calls.append(timeout)
+            px = {
+                "metric": "prefix_prefill_reduction_x",
+                "value": 6.169,
+                "prefill_reduction_x": 6.169,
+                "reduction_ok": True,
+                "kv_prefix_hit_rate": 0.8365,
+                "kv_blocks_per_request_baseline": 2.965,
+                "kv_blocks_per_request_prefix": 1.917,
+                "kv_cow_copies_total": 2912,
+                "kv_evictions_total": 5552,
+                "steady_state_compile_delta": 0,
+                "lost": 0,
+                "invariant_ok": True,
+                "faults_fired": 1,
+                "baseline": {"computed": 76324, "done": 2878},
+                "prefix": {"computed": 9859, "cached": 47996, "done": 2183},
+                "metrics": {"prefill_reduction_x": 6.169},
+            }
+            lines = [
+                {"metric": "ppo", "value": 123.0},
+                {"prefix": px},
+            ]
+            return 0, "".join(json.dumps(ln) + "\n" for ln in lines)
+
+    runner = PrefixRunner([_healthy()])
+    art = str(tmp_path / "bench.jsonl")
+    mart = str(tmp_path / "METRICS.json")
+    pxart = str(tmp_path / "PREFIX.json")
+    watch(runner, lambda s: None, max_probes=1, artifact=art,
+          metrics_artifact=mart, prefix_artifact=pxart,
+          sleep=lambda s: None)
+    doc = json.loads(open(pxart).read())
+    px = doc["prefix"]
+    assert px["reduction_ok"] is True
+    assert px["value"] == 6.169
+    assert px["steady_state_compile_delta"] == 0
+    assert px["lost"] == 0 and px["invariant_ok"] is True
+    # the per-arm structure rides whole, not flattened
+    assert px["prefix"]["cached"] == 47996
+    assert doc["artifact"] == os.path.relpath(art, REPO)
+    # the flat metrics section still rides the METRICS distillation
+    mdoc = json.loads(open(mart).read())
+    assert mdoc["bench_metrics"]["prefix"]["prefill_reduction_x"] == 6.169
+    # all three files land in ONE commit
+    assert len(runner.commits) == 1
+    assert runner.commits[0][0] == [art, mart, pxart]
+
+
 def test_rlhf_pipeline_subresult_distilled(tmp_path):
     """PR-4: the rlhf sub-bench reports an overlapped-cycle ``pipeline``
     sub-result; the watcher must split it into the committed METRICS json

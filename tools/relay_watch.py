@@ -150,6 +150,31 @@ def _extract_compile(stdout: str) -> dict | None:
     return found
 
 
+def _extract_prefix(stdout: str) -> dict | None:
+    """Find the prefix sub-bench result (ISSUE-11 prefix-aware KV tier:
+    measured prefill-compute reduction vs the legacy allocator, KV blocks
+    charged per request, hit rate / CoW / eviction counters, and the
+    lost==0 accounting under the mid-run ``kvmem.evict`` crash) in a
+    bench stdout JSONL stream. The per-arm dicts (baseline vs prefix
+    TTFT tails and token totals) carry structure worth keeping whole, so
+    they get their own committed PREFIX artifact. Last match wins (the
+    final aggregate line repeats the sub-results)."""
+    found = None
+    for ln in (stdout or "").strip().splitlines():
+        try:
+            d = json.loads(ln)
+        except ValueError:
+            continue
+        if not isinstance(d, dict):
+            continue
+        v = d.get("prefix")
+        if isinstance(v, dict) and (
+            "prefill_reduction_x" in v or "kv_prefix_hit_rate" in v
+        ):
+            found = v
+    return found
+
+
 class Runner:
     """Real subprocess/git backend. Tests replace this with a fake that
     implements the same three methods."""
@@ -220,6 +245,7 @@ def watch(
     multichip_artifact: str | None = None,
     anakin_artifact: str | None = None,
     compile_artifact: str | None = None,
+    prefix_artifact: str | None = None,
     rlint_artifact: str | None = None,
     commit: bool = True,
     require_tpu: bool = True,
@@ -321,6 +347,21 @@ def watch(
                 f.write("\n")
             paths.append(cppath)
             log(f"{_utcnow()} compile -> {os.path.relpath(cppath, REPO)}")
+        px = _extract_prefix(bout)
+        if px is not None:
+            pxpath = prefix_artifact or os.path.join(REPO, "PREFIX_pr11.json")
+            with open(pxpath, "w") as f:
+                json.dump(
+                    {
+                        "artifact": os.path.relpath(path, REPO),
+                        "generated": _utcnow(),
+                        "prefix": px,
+                    },
+                    f, indent=2, sort_keys=True,
+                )
+                f.write("\n")
+            paths.append(pxpath)
+            log(f"{_utcnow()} prefix -> {os.path.relpath(pxpath, REPO)}")
         if hasattr(runner, "rlint"):
             # PR-8: keep the static-analysis summary current alongside the
             # perf artifacts — the same commit that records a measurement
@@ -362,6 +403,8 @@ def main(argv=None) -> int:
                     help="anakin fused-fleet sweep path (default ANAKIN_pr9.json)")
     ap.add_argument("--compile-artifact", default=None,
                     help="cold/warm startup split path (default COMPILE_pr10.json)")
+    ap.add_argument("--prefix-artifact", default=None,
+                    help="prefix-KV reuse result path (default PREFIX_pr11.json)")
     ap.add_argument("--rlint-artifact", default=None,
                     help="rlint findings-summary path (default RLINT_pr8.json)")
     ap.add_argument("--no-commit", action="store_true")
@@ -386,6 +429,7 @@ def main(argv=None) -> int:
         multichip_artifact=args.multichip_artifact,
         anakin_artifact=args.anakin_artifact,
         compile_artifact=args.compile_artifact,
+        prefix_artifact=args.prefix_artifact,
         rlint_artifact=args.rlint_artifact,
         commit=not args.no_commit,
     )
