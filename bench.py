@@ -2098,7 +2098,12 @@ def bench_fleet(report: bool = True) -> dict:
         TransformerConfig,
         TransformerLM,
     )
-    from rl_tpu.obs import MetricsRegistry
+    from rl_tpu.obs import (
+        FlightRecorder,
+        MetricsRegistry,
+        TraceRecorder,
+        set_tracer,
+    )
     from rl_tpu.resilience import Fault, FaultInjector, injection
 
     if _TIER == "smoke":
@@ -2178,6 +2183,11 @@ def bench_fleet(report: bool = True) -> dict:
     crash_at = 0.5 * horizon_s
 
     reg = MetricsRegistry()
+    # PR-12: arm a fresh recorder so the chaos traffic itself is the
+    # trace-tree sample — fleet.submit roots a trace per request, and the
+    # crash/failover re-dispatch spans link into those trees
+    tracer = TraceRecorder()
+    prev_tracer = set_tracer(tracer)
     fleet = ServingFleet(
         engines, registry=reg, probe_interval_s=0.02,
         max_queue=len(plan),  # shed path exercised by the watermark, not cap
@@ -2212,7 +2222,9 @@ def bench_fleet(report: bool = True) -> dict:
         acc = fleet.accounting()
         snap = fleet.metrics_snapshot()
         stats = fleet.request_stats()
+        slo_snap = fleet.slo.snapshot()
         fleet.shutdown()
+        set_tracer(prev_tracer)
     if crash_wall is None:
         crash_wall = t_start + crash_at  # all arrivals landed pre-0.5T
 
@@ -2250,6 +2262,63 @@ def bench_fleet(report: bool = True) -> dict:
         # re-admission included) ran on warmed executables
         "steady_state_compile_delta": steady.delta if steady.supported else None,
     }
+
+    # PR-12 observability distillation: trace-tree shape from the Perfetto
+    # export, SLO attainment/burn from the fleet's engine, and the size of
+    # a flight-record bundle cut from this very run
+    import shutil
+    import tempfile
+
+    events = tracer.export()["traceEvents"]
+    traced = [e for e in events
+              if e.get("ph") in ("X", "i")
+              and isinstance(e.get("args"), dict)
+              and "trace_id" in e["args"]]
+    spans = [e for e in traced if e["ph"] == "X"]
+    by_id = {e["args"]["span_id"]: e for e in spans if "span_id" in e["args"]}
+
+    def span_depth(e):
+        d = 1
+        while d < 64:
+            pid = e["args"].get("parent_id")
+            parent = by_id.get(pid)
+            if parent is None:
+                # a dangling parent_id is the request's root *context*
+                # (fleet.submit opens a trace, not a span) — still a level
+                return d + (1 if pid is not None else 0)
+            e, d = parent, d + 1
+        return d
+
+    trace_ids = {e["args"]["trace_id"] for e in traced}
+    fdir = tempfile.mkdtemp(prefix="rl_tpu_flight_bench_")
+    flight = {"files": 0, "bytes": 0}
+    try:
+        bundle = FlightRecorder(fdir, tracer=tracer, registry=reg).dump("bench_fleet")
+        if bundle:
+            names = sorted(os.listdir(bundle))
+            flight = {
+                "files": len(names),
+                "bytes": sum(os.path.getsize(os.path.join(bundle, f))
+                             for f in names),
+            }
+    finally:
+        shutil.rmtree(fdir, ignore_errors=True)
+    obs_section = {
+        "trace_spans": len(spans),
+        "trace_instants": len(traced) - len(spans),
+        "trace_trees": len(trace_ids),
+        "trace_depth": max((span_depth(e) for e in by_id.values()), default=0),
+        "trace_threads": len({e["tid"] for e in traced}),
+        "slo": slo_snap,
+        "flight_record": flight,
+    }
+    # headline scalars also ride the flat metrics section so the generic
+    # METRICS distillation picks them up without knowing about "obs"
+    att = slo_snap.get("fleet_ttft", {}).get("attainment")
+    metrics["slo_ttft_attainment"] = round(att, 4) if att is not None else None
+    metrics["slo_availability_burn_60s"] = (
+        slo_snap.get("fleet_availability", {}).get("burn_rate_60s"))
+
     out = {
         "metric": "fleet_tokens_per_sec",
         "value": metrics["fleet_tokens_per_sec"],
@@ -2267,6 +2336,7 @@ def bench_fleet(report: bool = True) -> dict:
         "compile_s": round(compile_s, 2),
         "n_slots": S,
         "n_engines": 3,
+        "obs": obs_section,
         "metrics": metrics,
         "error": None,
     }

@@ -43,6 +43,7 @@ import numpy as np
 from ..analysis import hot_path
 from ..data import ArrayDict
 from ..obs import get_registry, get_tracer
+from ..obs.trace import carry_context
 from ..utils.seeding import seed_generator
 
 __all__ = ["AsyncHostCollector"]
@@ -149,8 +150,11 @@ class AsyncHostCollector:
                 "async-collector", self._collect_loop, on_giveup=self._on_giveup
             )
         else:
+            # unsupervised path: carry the starter's TraceContext onto the
+            # actor thread (the supervised path gets this from spawn())
             self._thread = threading.Thread(
-                target=self._run, name="rl-tpu-async-collector", daemon=True
+                target=carry_context(self._run), name="rl-tpu-async-collector",
+                daemon=True,
             )
             self._thread.start()
         return self

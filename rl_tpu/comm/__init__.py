@@ -278,7 +278,22 @@ class _JSONHandler(socketserver.StreamRequestHandler):
             if fn is None:
                 resp = {"status": "error", "out": f"unknown command {req.get('command')!r}"}
             else:
-                resp = {"status": "ok", "out": fn(req.get("payload"))}
+                from ..obs.trace import TraceContext, get_tracer, use_context
+
+                # optional "trace" key on the frame: old peers never send
+                # it, new peers tolerate its absence — the control plane
+                # stays wire-compatible in both directions
+                wire_ctx = TraceContext.from_wire(req.get("trace"))
+                if wire_ctx is None:
+                    resp = {"status": "ok", "out": fn(req.get("payload"))}
+                else:
+                    # adopt the caller's context on this handler thread:
+                    # everything the handler does (spans, fleet submits,
+                    # fault stamps) parents under the RPC that caused it
+                    with use_context(wire_ctx), get_tracer().ctx_span(
+                        f"comm/handle:{req.get('command')}"
+                    ):
+                        resp = {"status": "ok", "out": fn(req.get("payload"))}
         except Exception as e:  # noqa: BLE001
             resp = {"status": "error", "out": repr(e)}
         # chaos site: the handler already ran — a drop here models a reply
@@ -329,8 +344,24 @@ class TCPCommandClient:
         self.retry = retry
 
     def _call_once(self, command: str, payload: Any) -> Any:
+        from ..obs.trace import current_context, get_tracer
+
+        req = {"command": command, "payload": payload}
+        if current_context() is None:
+            return self._send(req)
+        # inside a traced request: the wire frame carries the RPC span's
+        # context so the server-side handler links under THIS call (the
+        # one TCP hop in the request tree); retried calls each get their
+        # own span/frame, which is what a retry is
+        with get_tracer().ctx_span(f"comm/call:{command}") as span_ctx:
+            if span_ctx is not None:
+                req["trace"] = span_ctx.to_wire()
+            return self._send(req)
+
+    def _send(self, req: Mapping[str, Any]) -> Any:
+        command = req["command"]
         with socket.create_connection((self.host, self.port), timeout=self.timeout) as s:
-            s.sendall((json.dumps({"command": command, "payload": payload}) + "\n").encode())
+            s.sendall((json.dumps(dict(req)) + "\n").encode())
             data = b""
             while not data.endswith(b"\n"):
                 chunk = s.recv(65536)

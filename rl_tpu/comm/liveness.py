@@ -100,6 +100,15 @@ class Watchdog:
             for name in newly:
                 tracer.instant("watchdog_death", {"name": name})
                 counter.inc(1, {"name": name})
+            # a declared-dead actor is a postmortem moment: black-box dump
+            # (single None check when disarmed; the recorder rate-limits
+            # itself, so a mass die-off doesn't flood the disk)
+            from ..obs import get_flight_recorder
+
+            rec = get_flight_recorder()
+            if rec is not None:
+                for name in newly:
+                    rec.dump(f"watchdog_death-{name}")
         for name in newly:
             if self.on_death is not None:
                 self.on_death(name)

@@ -52,6 +52,85 @@ __all__ = [
 ]
 
 _ENV_NO_AOT = "RL_TPU_NO_AOT"
+_ENV_NO_ATTR = "RL_TPU_NO_ATTRIBUTION"
+_ENV_PEAK_FLOPS = "RL_TPU_PEAK_FLOPS"
+_ATTR_SAMPLE_EVERY = 8
+
+
+def _attr_worker(q) -> None:
+    """Attribution drain loop (its own daemon thread, never a dispatch
+    thread): block until the sampled dispatch's first output leaf is
+    device-ready, then credit the elapsed wall time to the program. The
+    host sync lives HERE, off every hot path — dispatch only enqueues."""
+    import jax
+
+    while True:
+        item = q.get()
+        if item is None:
+            return
+        ref, t0, leaf = item
+        try:
+            jax.block_until_ready(leaf)
+        except Exception:
+            continue
+        dt = time.perf_counter() - t0
+        prog = ref()
+        if prog is None:
+            continue
+        with prog._lock:
+            prog.stats["device_s"] += dt
+            prog.stats["device_samples"] += 1
+            prog.stats["device_flops"] += prog.flops_per_call
+
+
+class _Attribution:
+    """Sampled per-program device-time accounting.
+
+    Every ``_ATTR_SAMPLE_EVERY``-th dispatch of a :class:`CachedProgram`
+    enqueues ``(weakref(prog), t0, first_output_leaf)`` on a bounded
+    queue; a lazily-started worker thread waits for the leaf and folds
+    ``device_s`` / ``device_samples`` / ``device_flops`` into the
+    program's ``stats`` (so :meth:`ProgramRegistry.stats` — and the
+    flight recorder's ``programs.json`` — pick them up for free).
+    Holding the leaf briefly pins its buffer; sampling plus the bounded
+    queue keeps that footprint to a handful of arrays. A full queue
+    drops the sample — that is just the sampler running behind, not an
+    error. Opt out entirely with ``RL_TPU_NO_ATTRIBUTION=1``."""
+
+    def __init__(self, maxsize: int = 256):
+        import queue
+
+        self._q: Any = queue.Queue(maxsize=maxsize)
+        self._thread: threading.Thread | None = None
+        self._lock = threading.Lock()
+
+    def submit(self, prog: "CachedProgram", t0: float, out: Any) -> None:
+        if os.environ.get(_ENV_NO_ATTR, "") not in ("", "0"):
+            return
+        import jax
+
+        leaves = jax.tree_util.tree_leaves(out)
+        if not leaves:
+            return
+        self._ensure_thread()
+        try:
+            self._q.put_nowait((weakref.ref(prog), t0, leaves[0]))
+        except Exception:
+            pass
+
+    def _ensure_thread(self) -> None:
+        if self._thread is not None:
+            return
+        with self._lock:
+            if self._thread is None:
+                t = threading.Thread(
+                    target=_attr_worker, args=(self._q,), name="prog-attr", daemon=True
+                )
+                t.start()
+                self._thread = t
+
+
+_ATTR = _Attribution()
 
 
 def _memkey(args: tuple) -> tuple:
@@ -104,6 +183,8 @@ class CachedProgram:
         self._compiled: dict[tuple, Any] = {}
         self._unvalidated: set[tuple] = set()  # store-loads before 1st call
         self._signatures: list[tuple] = []
+        self.flops_per_call = 0.0  # from cost_analysis, when the backend has it
+        self._attr_tick = 0
         self.stats = {
             "calls": 0,
             "aot_hits": 0,
@@ -112,6 +193,9 @@ class CachedProgram:
             "jit_calls": 0,
             "compile_s": 0.0,
             "load_s": 0.0,
+            "device_s": 0.0,
+            "device_samples": 0,
+            "device_flops": 0.0,
         }
 
     # -- keys ------------------------------------------------------------
@@ -163,6 +247,7 @@ class CachedProgram:
                 self._unvalidated.add(mk)
                 self.stats["loads"] += 1
                 self.stats["load_s"] += dt
+            self._note_flops(prog)
             return ("store", dt)
         prog, dt = self._compile(args)
         return ("compile", dt)
@@ -181,16 +266,43 @@ class CachedProgram:
         self._registry.store.save(
             key=self.store_key(args), compiled=prog, meta={"name": self.name}
         )
+        self._note_flops(prog)
         return prog, dt
 
+    def _note_flops(self, prog: Any) -> None:
+        # cost_analysis is backend-dependent (absent on some platforms,
+        # a one-element list on others) — best effort, never raises
+        try:
+            ca = prog.cost_analysis()
+            if isinstance(ca, (list, tuple)):
+                ca = ca[0] if ca else None
+            if isinstance(ca, dict):
+                flops = float(ca.get("flops", 0.0))
+                if flops > 0.0:
+                    self.flops_per_call = flops
+        except Exception:
+            pass
+
     # -- dispatch --------------------------------------------------------
+
+    def _dispatch(self, prog: Callable, args: tuple):
+        """One executable dispatch, sampled for device-time attribution.
+        The sampled path only stamps a timestamp and enqueues the output
+        — the ready-wait happens on the attribution worker thread."""
+        self._attr_tick += 1
+        if self._attr_tick % _ATTR_SAMPLE_EVERY:
+            return prog(*args)
+        t0 = time.perf_counter()
+        out = prog(*args)
+        _ATTR.submit(self, t0, out)
+        return out
 
     def __call__(self, *args: Any):
         self.stats["calls"] += 1
         if self._registry.aot_disabled:
             self.stats["jit_calls"] += 1
             with compile_scope(self.name):
-                return self._jit(*args)
+                return self._dispatch(self._jit, args)
         mk = _memkey(args)
         with self._lock:
             prog = self._compiled.get(mk)
@@ -203,7 +315,7 @@ class CachedProgram:
         else:
             self.stats["aot_hits"] += 1
         if not fresh_load:
-            return prog(*args)
+            return self._dispatch(prog, args)
         # first call of a deserialized executable: an incompatible entry
         # (stale jax/XLA, foreign topology) surfaces here — evict it and
         # fall back to a real compile rather than wedging the caller
@@ -410,12 +522,39 @@ def _wire_obs(reg: ProgramRegistry) -> None:
         g_loads = obs.gauge(
             "rl_tpu_aot_store_loads", "executables deserialized from the store"
         )
+        c_dev = obs.counter(
+            "rl_tpu_program_device_seconds_total",
+            "sampled device time attributed per program",
+            labels=("program",),
+        )
+        c_samp = obs.counter(
+            "rl_tpu_program_sampled_dispatches_total",
+            "dispatches sampled for device-time attribution",
+            labels=("program",),
+        )
+        g_mfu = obs.gauge(
+            "rl_tpu_program_mfu",
+            "model FLOPs utilization per program "
+            "(set RL_TPU_PEAK_FLOPS to the accelerator peak to enable)",
+            labels=("program",),
+        )
 
         def collect():
             stats = reg.stats()
             g_progs.set(float(len(stats)))
             g_exec.set(float(sum(s["executables"] for s in stats.values())))
             g_loads.set(float(sum(s["loads"] for s in stats.values())))
+            try:
+                peak = float(os.environ.get(_ENV_PEAK_FLOPS, "0") or 0.0)
+            except ValueError:
+                peak = 0.0
+            for name, s in stats.items():
+                dev_s = float(s.get("device_s", 0.0))
+                c_dev.set_total(dev_s, {"program": name})
+                c_samp.set_total(float(s.get("device_samples", 0)), {"program": name})
+                if peak > 0.0 and dev_s > 0.0:
+                    mfu = float(s.get("device_flops", 0.0)) / dev_s / peak
+                    g_mfu.set(mfu, {"program": name})
 
         obs.register_collector(collect)
     except Exception:

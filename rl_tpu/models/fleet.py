@@ -66,6 +66,8 @@ import numpy as np
 
 from ..analysis import hot_path
 from ..comm.liveness import Watchdog
+from ..obs.slo import SLOEngine
+from ..obs.trace import ctx_args, current_context, new_trace, use_context
 from ..resilience.faults import fault_point, register_site, should_drop
 from .serving import (
     ContinuousBatchingEngine,
@@ -115,6 +117,9 @@ class _Tracked:
     first_token_at: float | None = None
     done_at: float | None = None
     result: Any = None  # FinishedRequest | ShedRequest
+    # the request's node in the causal trace tree (None when tracing is
+    # off); every dispatch/failover/settle event parents under it
+    ctx: Any = None
 
 
 class _Member:
@@ -194,6 +199,9 @@ class ServingFleet:
         retry_after_s: float = 0.25,
         idle_sleep_s: float = 0.002,
         batch_strategy="requests",
+        slo_ttft_s: float = 1.0,
+        slo_latency_s: float = 10.0,
+        slo_target: float = 0.99,
     ):
         engines = list(engines)
         if not engines:
@@ -284,6 +292,22 @@ class ServingFleet:
         from ..obs import get_tracer
 
         self._tracer = get_tracer()
+        # declarative SLOs over streaming histograms (the Autoscaler's
+        # calibrated signals): TTFT and completion latency are value
+        # objectives fed in _settle; availability counts completed vs
+        # shed-after-admission. The per-objective histograms are ALSO the
+        # export truth for ttft quantile gauges — the member lat_ema
+        # survives only as the router's recency signal.
+        self.slo = SLOEngine(registry=registry)
+        self._slo_ttft = self.slo.objective(
+            "fleet_ttft", threshold=slo_ttft_s, target=slo_target,
+            description="time to first token")
+        self._slo_latency = self.slo.objective(
+            "fleet_latency", threshold=slo_latency_s, target=slo_target,
+            description="submit-to-completion latency")
+        self._slo_avail = self.slo.objective(
+            "fleet_availability", target=slo_target,
+            description="admitted requests completed (vs shed post-admission)")
         self._init_metrics(registry)
 
     # -- obs wiring ------------------------------------------------------------
@@ -319,6 +343,14 @@ class ServingFleet:
                                  "requests waiting for dispatch", labels=("lane",))
         self._g_outstanding = reg.gauge(f"{p}_outstanding",
                                         "admitted requests not yet done or shed")
+        # real quantiles from the streaming histograms (not the EMA): the
+        # ttft_seconds{quantile} satellite the dashboards key on
+        self._g_ttft = reg.gauge(
+            f"{p}_ttft_seconds", "time-to-first-token quantiles",
+            labels=("quantile",))
+        self._g_latency = reg.gauge(
+            f"{p}_latency_seconds", "submit-to-completion latency quantiles",
+            labels=("quantile",))
         for m in self._members:
             self._g_health.set(0.0, {"engine": str(m.idx)})
         reg.register_collector(self._update_gauges)
@@ -336,6 +368,15 @@ class ServingFleet:
         self._g_outstanding.set(float(outstanding))
         for idx, state in states:
             self._g_health.set(_STATE_VALUE[state], {"engine": str(idx)})
+        # histogram quantile reads take only the histogram's own lock —
+        # deliberately outside the fleet lock above
+        for g, hist in ((self._g_ttft, self._slo_ttft.hist),
+                        (self._g_latency, self._slo_latency.hist)):
+            if hist.count:
+                for q in (0.5, 0.99):
+                    v = hist.quantile(q)
+                    if v is not None:
+                        g.set(v, {"quantile": str(q)})
 
     # -- lifecycle -------------------------------------------------------------
 
@@ -392,6 +433,7 @@ class ServingFleet:
                 self._monitor.stop()
         if self.registry is not None:
             self.registry.unregister_collector(self._update_gauges)
+            self.registry.unregister_collector(self.slo._collect)
 
     # -- admission (the SLO-aware front door) ----------------------------------
 
@@ -436,12 +478,27 @@ class ServingFleet:
                 raise ServiceSaturated(self.retry_after_s)
             frid = self._next_frid
             self._next_frid += 1
+            # the request's trace node: child of the caller's context (a
+            # TCP handler span when submit arrives over the wire) or a new
+            # root. Created only when it can be observed — a disabled
+            # tracer with no inherited context keeps submit at zero cost.
+            parent = current_context()
+            ctx = None
+            if parent is not None:
+                ctx = parent.child()
+            elif self._tracer.enabled:
+                ctx = new_trace()
             self._tracked[frid] = _Tracked(
-                frid, prompt, int(max_new_tokens), lane, _QUEUED, time.monotonic()
+                frid, prompt, int(max_new_tokens), lane, _QUEUED,
+                time.monotonic(), ctx=ctx,
             )
             self._lanes[lane].append(frid)
             self.admitted += 1
             self._c_admitted.inc()
+            if ctx is not None:
+                self._tracer.instant(
+                    "fleet_admit", {"frid": frid, "lane": lane, **ctx_args(ctx)}
+                )
             return frid
 
     def _count_shed_locked(self, reason: str) -> None:
@@ -614,15 +671,29 @@ class ServingFleet:
         eng = m.engine
         while not self._stop.is_set():
             self._watchdog.beat(m.name)
+            # a representative request context for this iteration (the
+            # first assigned request's node), so injected faults and crash
+            # events link into the trace of the work they hit. Looked up
+            # BEFORE m.lock: lock order is fleet lock -> m.lock, never the
+            # reverse. Tracing off: one bool check, no lock taken.
+            step_ctx = None
+            if self._tracer.enabled:
+                with self._lock:
+                    for frid in m.assigned.values():
+                        tr = self._tracked.get(frid)
+                        if tr is not None and tr.ctx is not None:
+                            step_ctx = tr.ctx
+                            break
             try:
                 with m.lock:
                     busy = eng.pending() > 0
                     if busy:
                         # chaos sites fire only when there is work to lose:
                         # an idle replica cannot crash mid-decode
-                        fault_point("fleet.engine_crash")
-                        fault_point(f"fleet.engine_crash.{m.idx}")
-                        eng.step()
+                        with use_context(step_ctx):
+                            fault_point("fleet.engine_crash")
+                            fault_point(f"fleet.engine_crash.{m.idx}")
+                            eng.step()
                     fin = list(eng.finished)
                     eng.finished.clear()
             except BaseException as e:
@@ -644,6 +715,15 @@ class ServingFleet:
                 tr = self._tracked.get(frid) if frid is not None else None
                 if tr is not None and tr.first_token_at is None:
                     tr.first_token_at = t
+                    # streaming-histogram TTFT (the exported truth; the
+                    # EMA below only routes). Objective locks nest inside
+                    # the fleet lock, never the reverse.
+                    self._slo_ttft.record(t - tr.submitted_at)
+                    if tr.ctx is not None:
+                        self._tracer.instant(
+                            "fleet_first_token",
+                            {"frid": frid, "engine": m.idx, **ctx_args(tr.ctx)},
+                        )
             for f in fin:
                 frid = m.assigned.pop(f.rid, None)
                 if frid is None:
@@ -662,6 +742,14 @@ class ServingFleet:
                 self._c_completed.inc()
                 lat = now - tr.submitted_at
                 m.lat_ema = lat if m.lat_ema is None else 0.7 * m.lat_ema + 0.3 * lat
+                self._slo_latency.record(lat)
+                self._slo_avail.record_event(True)
+                if tr.ctx is not None:
+                    self._tracer.instant(
+                        "fleet_request_done",
+                        {"frid": frid, "engine": m.idx,
+                         "dispatches": tr.dispatches, **ctx_args(tr.ctx)},
+                    )
 
     def _on_member_crash(self, m: _Member, exc: BaseException) -> None:
         """Stepper-thread crash path: salvage finished-but-unsettled
@@ -719,6 +807,14 @@ class ServingFleet:
         tr.result = ShedRequest(tr.frid, self.retry_after_s, reason)
         self._ready[tr.frid] = tr.result
         self._count_shed_locked(reason)
+        # a post-admission shed is an availability miss (admission-time
+        # sheds never reach this path — they raise before tracking)
+        self._slo_avail.record_event(False)
+        if tr.ctx is not None:
+            self._tracer.instant(
+                "fleet_request_shed",
+                {"frid": tr.frid, "reason": reason, **ctx_args(tr.ctx)},
+            )
 
     # -- failover --------------------------------------------------------------
 
@@ -739,6 +835,14 @@ class ServingFleet:
             tr.state, tr.member, tr.erid = _QUEUED, -1, -1
             self._lanes[tr.lane].appendleft(frid)  # failover beats new work
             moved += 1
+            if tr.ctx is not None:
+                # one node per re-queued request, PARENTED to the request's
+                # own span — the failover leg of the causal tree (the
+                # aggregate fleet_failover instant below stays engine-level)
+                self._tracer.instant(
+                    "fleet_failover_redispatch",
+                    {"frid": frid, "engine": m.idx, **ctx_args(tr.ctx.child())},
+                )
         if clear_assignments:
             m.assigned.clear()
         if moved:
@@ -782,8 +886,16 @@ class ServingFleet:
                 return False
         tr, m = pick
         try:
-            with m.lock:
-                erid = m.engine.submit(tr.prompt, tr.max_new_tokens)
+            # the dispatch span hangs under the request's node and is the
+            # ACTIVE context while the engine admits — engine.submit
+            # captures it onto its Request, linking the engine-side leg
+            with self._tracer.ctx_span(
+                "fleet/dispatch",
+                {"frid": tr.frid, "engine": m.idx, "attempt": tr.dispatches},
+                ctx=tr.ctx,
+            ):
+                with m.lock:
+                    erid = m.engine.submit(tr.prompt, tr.max_new_tokens)
         except Exception:
             # pre-validated at submit(), so this is an engine in a bad
             # place — shed explicitly rather than wedge the dispatcher

@@ -56,6 +56,7 @@ from ..analysis import hot_path
 from ..compile import ShapeBuckets, get_program_registry
 from ..kvmem import DEFER_ROUND, PrefixKVAllocator
 from ..obs.device import DeviceMetrics
+from ..obs.trace import ctx_args, current_context, get_tracer
 
 __all__ = [
     "ContinuousBatchingEngine",
@@ -73,6 +74,9 @@ class Request:
     rid: int
     prompt: np.ndarray  # [P] int32
     max_new_tokens: int
+    # causal link to the submitter (fleet dispatch span, TCP handler, ...);
+    # None outside any traced request
+    ctx: Any = None
 
 
 @dataclasses.dataclass
@@ -819,7 +823,7 @@ class ContinuousBatchingEngine:
             )
         rid = self._next_rid
         self._next_rid += 1
-        self.queue.append(Request(rid, prompt, max_new_tokens))
+        self.queue.append(Request(rid, prompt, max_new_tokens, ctx=current_context()))
         return rid
 
     def _admit(self):
@@ -969,6 +973,18 @@ class ContinuousBatchingEngine:
         if self.on_admit is not None:
             for _s, req in batch:
                 self.on_admit(req.rid)
+        tracer = get_tracer()
+        if tracer.enabled:
+            # one causal node per admitted request, hanging under its
+            # submitter's context: the kvmem-admit/CoW/partial-prefill leg
+            # of the request tree (cached_prefix tells how partial)
+            for (_s, req), st in zip(batch, starts):
+                if req.ctx is not None:
+                    tracer.instant(
+                        "engine_admit",
+                        {"rid": req.rid, "cached_prefix": st,
+                         **ctx_args(req.ctx.child())},
+                    )
         if surv.any():
             (
                 self.dev_lens,
@@ -1420,7 +1436,9 @@ class ServingService:
         self._server.register_handler("submit", self._h_submit)
         self._server.register_handler("collect", self._h_collect)
         self._server.register_handler("stats", self._h_stats)
-        self._thread = threading.Thread(target=self._loop, daemon=True)
+        from ..obs.trace import carry_context
+
+        self._thread = threading.Thread(target=carry_context(self._loop), daemon=True)
         self._metrics_server = None
         self.registry = registry
         if metrics_port is not None:

@@ -22,6 +22,18 @@ _EXPORTS = {
     "TraceRecorder": ("rl_tpu.obs.trace", "TraceRecorder"),
     "get_tracer": ("rl_tpu.obs.trace", "get_tracer"),
     "set_tracer": ("rl_tpu.obs.trace", "set_tracer"),
+    "TraceContext": ("rl_tpu.obs.trace", "TraceContext"),
+    "current_context": ("rl_tpu.obs.trace", "current_context"),
+    "new_trace": ("rl_tpu.obs.trace", "new_trace"),
+    "use_context": ("rl_tpu.obs.trace", "use_context"),
+    "ctx_args": ("rl_tpu.obs.trace", "ctx_args"),
+    "carry_context": ("rl_tpu.obs.trace", "carry_context"),
+    "StreamingHistogram": ("rl_tpu.obs.slo", "StreamingHistogram"),
+    "SLOEngine": ("rl_tpu.obs.slo", "SLOEngine"),
+    "Objective": ("rl_tpu.obs.slo", "Objective"),
+    "FlightRecorder": ("rl_tpu.obs.flight", "FlightRecorder"),
+    "get_flight_recorder": ("rl_tpu.obs.flight", "get_flight_recorder"),
+    "set_flight_recorder": ("rl_tpu.obs.flight", "set_flight_recorder"),
     "Counter": ("rl_tpu.obs.registry", "Counter"),
     "Gauge": ("rl_tpu.obs.registry", "Gauge"),
     "Histogram": ("rl_tpu.obs.registry", "Histogram"),

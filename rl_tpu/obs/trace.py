@@ -14,21 +14,142 @@ of this recorder: every timed block becomes a span here, and (when JAX
 profiling is on) the same name is forwarded to
 ``jax.profiler.TraceAnnotation`` so host spans line up with XLA device
 tracks in a combined capture.
+
+Causal request tracing (PR 12) rides on top: a :class:`TraceContext`
+(``trace_id``/``span_id``/``parent_id``) lives in a ``contextvars``
+variable, crosses thread boundaries explicitly (``carry_context``,
+``Supervisor.spawn`` capture, per-request carry objects) and TCP hops as
+an optional ``"trace"`` key on the wire frame. ``ctx_span`` emits a span
+stamped with those ids AND activates the span's own context for the
+block, so nested ``ctx_span``/``instant(ctx_args())`` calls — on any
+thread, in any process feeding the same recorder — link into one
+parent-chained tree that a Perfetto export renders per-request.
 """
 
 from __future__ import annotations
 
+import contextvars
+import dataclasses
 import json
 import os
 import threading
 import time
+import uuid
 from collections import deque
 from contextlib import contextmanager
-from typing import Any, Iterator, Mapping
+from typing import Any, Callable, Iterator, Mapping
 
-__all__ = ["TraceRecorder", "get_tracer", "set_tracer"]
+__all__ = [
+    "TraceContext",
+    "TraceRecorder",
+    "carry_context",
+    "ctx_args",
+    "current_context",
+    "get_tracer",
+    "new_trace",
+    "set_tracer",
+    "use_context",
+]
 
 DEFAULT_CAPACITY = 16384
+
+
+def _new_id() -> str:
+    return uuid.uuid4().hex[:16]
+
+
+@dataclasses.dataclass(frozen=True)
+class TraceContext:
+    """One node of a causal request tree.
+
+    ``trace_id`` names the whole request tree, ``span_id`` this node, and
+    ``parent_id`` the node it hangs under (None at the root). Immutable:
+    crossing a boundary always *derives* (:meth:`child`) rather than
+    mutates, so two threads holding the same context can fork safely."""
+
+    trace_id: str
+    span_id: str
+    parent_id: str | None = None
+
+    def child(self) -> "TraceContext":
+        """A fresh span id under this one (same trace)."""
+        return TraceContext(self.trace_id, _new_id(), self.span_id)
+
+    def to_wire(self) -> dict:
+        """JSON-safe dict for the TCP frame's optional ``"trace"`` key."""
+        d = {"trace_id": self.trace_id, "span_id": self.span_id}
+        if self.parent_id is not None:
+            d["parent_id"] = self.parent_id
+        return d
+
+    @staticmethod
+    def from_wire(d: Mapping[str, Any] | None) -> "TraceContext | None":
+        """Inverse of :meth:`to_wire`; tolerant of missing/garbage frames
+        (old peers, hand-written clients) — returns None instead of
+        raising so the control plane never fails on trace metadata."""
+        if not isinstance(d, Mapping):
+            return None
+        tid, sid = d.get("trace_id"), d.get("span_id")
+        if not isinstance(tid, str) or not isinstance(sid, str):
+            return None
+        pid = d.get("parent_id")
+        return TraceContext(tid, sid, pid if isinstance(pid, str) else None)
+
+
+_CTX: contextvars.ContextVar[TraceContext | None] = contextvars.ContextVar(
+    "rl_tpu_trace_context", default=None
+)
+
+
+def current_context() -> TraceContext | None:
+    """The active :class:`TraceContext` on this thread (None outside any
+    traced request)."""
+    return _CTX.get()
+
+
+def new_trace() -> TraceContext:
+    """A fresh root context (new trace_id, no parent)."""
+    return TraceContext(_new_id(), _new_id(), None)
+
+
+@contextmanager
+def use_context(ctx: TraceContext | None) -> Iterator[TraceContext | None]:
+    """Activate ``ctx`` for the block (None deactivates tracing context)."""
+    token = _CTX.set(ctx)
+    try:
+        yield ctx
+    finally:
+        _CTX.reset(token)
+
+
+def ctx_args(ctx: TraceContext | None = None) -> dict:
+    """Trace-id args for stamping an ``instant``/``span`` with the active
+    (or given) context; {} when none is active, so callers can always
+    ``{**ctx_args(), ...}`` without a branch."""
+    c = ctx if ctx is not None else _CTX.get()
+    if c is None:
+        return {}
+    out = {"trace_id": c.trace_id, "span_id": c.span_id}
+    if c.parent_id is not None:
+        out["parent_id"] = c.parent_id
+    return out
+
+
+def carry_context(fn: Callable, ctx: TraceContext | None = None) -> Callable:
+    """Wrap a thread target so it runs under the context active *now* (or
+    ``ctx``). contextvars don't cross ``threading.Thread`` boundaries by
+    themselves; every plain-thread spawn that should stay inside the
+    request tree wraps its target with this."""
+    captured = ctx if ctx is not None else _CTX.get()
+
+    def _carried(*args, **kwargs):
+        token = _CTX.set(captured)
+        try:
+            return fn(*args, **kwargs)
+        finally:
+            _CTX.reset(token)
+
+    return _carried
 
 
 class _ThreadRing:
@@ -88,6 +209,12 @@ class TraceRecorder:
     def _now_us(self) -> float:
         return (time.perf_counter_ns() - self._t0_ns) / 1e3
 
+    def now_us(self) -> float:
+        """Current trace-clock time (µs since recorder creation) — the
+        same clock event ``ts`` fields use; lets consumers (flight
+        recorder) window the export without private access."""
+        return self._now_us()
+
     @contextmanager
     def span(self, name: str, args: Mapping[str, Any] | None = None) -> Iterator[None]:
         """Time a block as a complete ("X") event on the calling thread."""
@@ -102,6 +229,39 @@ class TraceRecorder:
             ev = {"ph": "X", "name": name, "ts": start, "dur": end - start}
             if args:
                 ev["args"] = dict(args)
+            self._ring().events.append(ev)
+
+    @contextmanager
+    def ctx_span(
+        self,
+        name: str,
+        args: Mapping[str, Any] | None = None,
+        ctx: TraceContext | None = None,
+    ) -> Iterator[TraceContext | None]:
+        """A span that is a *node in the causal tree*: derives a child of
+        the active (or given) context — or starts a new trace at a root —
+        activates it for the block, and stamps the emitted event with
+        ``trace_id``/``span_id``/``parent_id`` so the export links it.
+
+        Yields the span's own context (e.g. to store on a request object
+        that later threads re-activate). Disabled recorder: no event and
+        no context derivation — propagation overhead is zero when off."""
+        if not self._enabled:
+            yield _CTX.get() if ctx is None else ctx
+            return
+        parent = ctx if ctx is not None else _CTX.get()
+        span_ctx = parent.child() if parent is not None else new_trace()
+        token = _CTX.set(span_ctx)
+        start = self._now_us()
+        try:
+            yield span_ctx
+        finally:
+            end = self._now_us()
+            _CTX.reset(token)
+            ev = {"ph": "X", "name": name, "ts": start, "dur": end - start}
+            a = dict(args) if args else {}
+            a.update(ctx_args(span_ctx))
+            ev["args"] = a
             self._ring().events.append(ev)
 
     def begin_span(self, name: str, args: Mapping[str, Any] | None = None) -> float:
@@ -142,10 +302,13 @@ class TraceRecorder:
         )
 
     # -- export ---------------------------------------------------------
-    def export(self, path: str | None = None) -> dict:
+    def export(self, path: str | None = None, since_us: float | None = None) -> dict:
         """Snapshot all rings as a Chrome ``trace_event`` JSON object
         (``{"traceEvents": [...]}``); optionally also write it to ``path``.
-        Safe to call while other threads keep recording."""
+        Safe to call while other threads keep recording. ``since_us``
+        keeps only events at/after that trace-clock time (a span counts
+        if it *ends* inside the window) — the flight recorder's
+        last-N-seconds cut."""
         with self._lock:
             rings = list(self._rings)
         events: list[dict] = []
@@ -160,12 +323,20 @@ class TraceRecorder:
                 }
             )
             for ev in list(ring.events):
+                if since_us is not None and (
+                    ev.get("ts", 0.0) + ev.get("dur", 0.0) < since_us
+                ):
+                    continue
                 out = dict(ev)
                 out["pid"] = self._pid
                 out["tid"] = ring.tid
                 events.append(out)
-        # Stable ordering helps diffs and makes nesting checks deterministic.
-        events.sort(key=lambda e: (e["tid"], e.get("ts", -1.0)))
+        # Global timestamp order: a request's events span several rings
+        # (threads), and Perfetto renders flow/causality by stream order —
+        # per-ring grouping misordered cross-thread events. "M" metadata
+        # carries no ts and must lead, so it keys as -1.0; tid breaks ties
+        # deterministically for same-ts events.
+        events.sort(key=lambda e: (e.get("ts", -1.0), e["tid"]))
         trace = {"traceEvents": events, "displayTimeUnit": "ms"}
         if path is not None:
             with open(path, "w") as f:

@@ -163,6 +163,10 @@ class FaultInjector:
         self._lock = threading.Lock()
         self._count: dict[str, int] = {}
         self.fired: list[tuple[str, str, int]] = []  # (site, kind, invocation)
+        # parallel to `fired`: the TraceContext args active when each fault
+        # hit (None outside any traced request) — a separate list so the
+        # `fired` tuple shape existing chaos tests assert on never changes
+        self.fired_trace: list[dict | None] = []
         self.last_fire_monotonic: float | None = None  # bench-only, not used in triggers
         self._tracer = tracer
         self._counter = None
@@ -186,6 +190,9 @@ class FaultInjector:
         faults = self._plan.get(site)
         if not faults:
             return (), 0
+        from ..obs.trace import ctx_args
+
+        ca = ctx_args()  # the request this fault is about to hit, if any
         with self._lock:
             n = self._count.get(site, 0) + 1
             self._count[site] = n
@@ -196,11 +203,12 @@ class FaultInjector:
             )
             for f in hit:
                 self.fired.append((site, f.kind, n))
+                self.fired_trace.append(ca or None)
         for f in hit:
             self.last_fire_monotonic = time.monotonic()
             self._counter.inc(1, {"site": site, "kind": f.kind})
             self._tracer.instant(
-                "fault_injected", {"site": site, "kind": f.kind, "n": n}
+                "fault_injected", {"site": site, "kind": f.kind, "n": n, **ca}
             )
         return hit, n
 

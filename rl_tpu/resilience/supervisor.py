@@ -29,6 +29,7 @@ import threading
 import time
 from typing import Any, Callable
 
+from ..obs.trace import carry_context, ctx_args
 from .faults import InjectedFault  # noqa: F401  (re-exported for callers)
 
 __all__ = ["Child", "Supervisor"]
@@ -58,8 +59,11 @@ class Child:
         self._sup = supervisor
         self._stop = threading.Event()
         self._restart_times: list[float] = []
+        # carry the spawner's TraceContext onto the worker thread (and its
+        # restarts — the wrapper re-enters run() on the same thread), so a
+        # supervised loop spawned inside a traced request stays in its tree
         self._thread = threading.Thread(
-            target=supervisor._child_main, args=(self,),
+            target=carry_context(supervisor._child_main), args=(self,),
             name=f"{supervisor.name}/{name}", daemon=True,
         )
 
@@ -212,8 +216,25 @@ class Supervisor:
         child.gave_up = True
         self._c_giveups.inc(1, {"child": child.name})
         self._tracer.instant(
-            "supervisor_giveup", {"child": child.name, "error": repr(exc)}
+            "supervisor_giveup",
+            {"child": child.name, "error": repr(exc), **ctx_args()},
         )
+        # black-box dump BEFORE on_giveup/escalation run: the hooks below
+        # tear the run down, and the postmortem wants the dying state
+        from ..obs.flight import get_flight_recorder
+
+        rec = get_flight_recorder()
+        if rec is not None:
+            path = rec.dump(f"supervisor_giveup-{child.name}", exc)
+            if path is not None:
+                try:
+                    # surface the dump location in the escalation error
+                    # itself — the only artifact that reliably reaches logs
+                    exc.flight_record = path
+                    if hasattr(exc, "add_note"):
+                        exc.add_note(f"flight record: {path}")
+                except Exception:
+                    pass
         if child.on_giveup is not None:
             try:
                 child.on_giveup(exc)
@@ -223,7 +244,8 @@ class Supervisor:
             self.escalated = True
             self._c_escalations.inc()
             self._tracer.instant(
-                "supervisor_escalate", {"supervisor": self.name, "child": child.name}
+                "supervisor_escalate",
+                {"supervisor": self.name, "child": child.name, **ctx_args()},
             )
             # clean shutdown: every sibling's restart loop is signalled; the
             # owners' own stop flags are raised by on_escalate (the

@@ -60,6 +60,7 @@ from ..models import (
     token_log_probs_with_aux,
 )
 from ..obs import DeviceMetrics
+from ..obs.trace import carry_context
 from ..objectives.llm.grpo import GRPOLoss
 from ..parallel.mesh import AXIS_CONTEXT, AXIS_FSDP, DATA_AXES, data_sharding, fsdp_sharding
 from ..resilience.faults import fault_point, get_injector
@@ -709,8 +710,10 @@ class RolloutPipeline:
                 "grpo-rollout", self._produce, on_giveup=self._on_giveup
             )
         else:
+            # unsupervised path: carry the starter's TraceContext onto the
+            # producer thread (the supervised path gets this from spawn())
             self._thread = threading.Thread(
-                target=self._run, name="grpo-rollout", daemon=True
+                target=carry_context(self._run), name="grpo-rollout", daemon=True
             )
             self._thread.start()
         return self

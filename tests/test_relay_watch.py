@@ -338,6 +338,69 @@ def test_prefix_result_distilled_to_own_artifact(tmp_path):
     assert runner.commits[0][0] == [art, mart, pxart]
 
 
+def test_obs_section_distilled_to_own_artifact(tmp_path):
+    """PR-12: the fleet sub-bench's ``obs`` section (trace-tree shape of
+    the chaos traffic, SLO windowed attainment/burn snapshot, flight-
+    record bundle size) lands whole in its own committed OBS json, riding
+    the same single commit as the raw artifact and the metrics
+    distillation."""
+
+    class ObsRunner(FakeRunner):
+        def bench_all(self, timeout):
+            self.bench_calls.append(timeout)
+            ob = {
+                "trace_spans": 412,
+                "trace_instants": 375,
+                "trace_trees": 125,
+                "trace_depth": 4,
+                "trace_threads": 5,
+                "slo": {
+                    "fleet_ttft": {"threshold": 0.5, "target": 0.99,
+                                   "good": 124, "total": 125,
+                                   "attainment": 0.992,
+                                   "attainment_60s": 0.992,
+                                   "burn_rate_60s": 0.8,
+                                   "p50": 0.0087, "p99": 0.4538},
+                    "fleet_availability": {"threshold": None, "target": 0.99,
+                                           "good": 125, "total": 125,
+                                           "attainment": 1.0,
+                                           "burn_rate_60s": 0.0},
+                },
+                "flight_record": {"files": 3, "bytes": 48213},
+            }
+            lines = [
+                {"metric": "ppo", "value": 123.0},
+                {"fleet": {"value": 215.1,
+                           "obs": ob,
+                           "metrics": {"fleet_tokens_per_sec": 215.1,
+                                       "slo_ttft_attainment": 0.992}}},
+            ]
+            return 0, "".join(json.dumps(ln) + "\n" for ln in lines)
+
+    runner = ObsRunner([_healthy()])
+    art = str(tmp_path / "bench.jsonl")
+    mart = str(tmp_path / "METRICS.json")
+    obart = str(tmp_path / "OBS.json")
+    watch(runner, lambda s: None, max_probes=1, artifact=art,
+          metrics_artifact=mart, obs_artifact=obart,
+          sleep=lambda s: None)
+    doc = json.loads(open(obart).read())
+    ob = doc["obs"]
+    assert ob["trace_depth"] == 4
+    assert ob["trace_threads"] == 5
+    # the per-objective SLO structure rides whole, not flattened
+    assert ob["slo"]["fleet_ttft"]["burn_rate_60s"] == 0.8
+    assert ob["slo"]["fleet_availability"]["attainment"] == 1.0
+    assert ob["flight_record"]["files"] == 3
+    assert doc["artifact"] == os.path.relpath(art, REPO)
+    # the flat metrics section still rides the METRICS distillation
+    mdoc = json.loads(open(mart).read())
+    assert mdoc["bench_metrics"]["fleet"]["slo_ttft_attainment"] == 0.992
+    # all three files land in ONE commit
+    assert len(runner.commits) == 1
+    assert runner.commits[0][0] == [art, mart, obart]
+
+
 def test_rlhf_pipeline_subresult_distilled(tmp_path):
     """PR-4: the rlhf sub-bench reports an overlapped-cycle ``pipeline``
     sub-result; the watcher must split it into the committed METRICS json

@@ -175,6 +175,32 @@ def _extract_prefix(stdout: str) -> dict | None:
     return found
 
 
+def _extract_obs(stdout: str) -> dict | None:
+    """Find the fleet sub-bench's ``obs`` section (PR-12 observability:
+    trace-tree shape of the chaos traffic — span count, tree count, max
+    parent-link depth, distinct threads — plus the SLO engine's windowed
+    attainment/burn-rate snapshot and the flight-record bundle size cut
+    from the run) in a bench stdout JSONL stream. Unlike the flat
+    ``metrics`` sections, the per-objective SLO dicts carry structure
+    worth keeping whole, so it lands in its own committed OBS artifact.
+    Last match wins (the final aggregate line repeats the sub-results)."""
+    found = None
+    for ln in (stdout or "").strip().splitlines():
+        try:
+            d = json.loads(ln)
+        except ValueError:
+            continue
+        if not isinstance(d, dict):
+            continue
+        # lines are either {"<name>": {...result...}} wrappers or the
+        # final aggregate with sub-results nested under their names
+        for c in [d] + [v for v in d.values() if isinstance(v, dict)]:
+            v = c.get("obs")
+            if isinstance(v, dict) and ("trace_depth" in v or "slo" in v):
+                found = v
+    return found
+
+
 class Runner:
     """Real subprocess/git backend. Tests replace this with a fake that
     implements the same three methods."""
@@ -246,6 +272,7 @@ def watch(
     anakin_artifact: str | None = None,
     compile_artifact: str | None = None,
     prefix_artifact: str | None = None,
+    obs_artifact: str | None = None,
     rlint_artifact: str | None = None,
     commit: bool = True,
     require_tpu: bool = True,
@@ -362,6 +389,21 @@ def watch(
                 f.write("\n")
             paths.append(pxpath)
             log(f"{_utcnow()} prefix -> {os.path.relpath(pxpath, REPO)}")
+        ob = _extract_obs(bout)
+        if ob is not None:
+            obpath = obs_artifact or os.path.join(REPO, "OBS_pr12.json")
+            with open(obpath, "w") as f:
+                json.dump(
+                    {
+                        "artifact": os.path.relpath(path, REPO),
+                        "generated": _utcnow(),
+                        "obs": ob,
+                    },
+                    f, indent=2, sort_keys=True,
+                )
+                f.write("\n")
+            paths.append(obpath)
+            log(f"{_utcnow()} obs -> {os.path.relpath(obpath, REPO)}")
         if hasattr(runner, "rlint"):
             # PR-8: keep the static-analysis summary current alongside the
             # perf artifacts — the same commit that records a measurement
@@ -405,6 +447,8 @@ def main(argv=None) -> int:
                     help="cold/warm startup split path (default COMPILE_pr10.json)")
     ap.add_argument("--prefix-artifact", default=None,
                     help="prefix-KV reuse result path (default PREFIX_pr11.json)")
+    ap.add_argument("--obs-artifact", default=None,
+                    help="fleet trace/SLO/flight-record path (default OBS_pr12.json)")
     ap.add_argument("--rlint-artifact", default=None,
                     help="rlint findings-summary path (default RLINT_pr8.json)")
     ap.add_argument("--no-commit", action="store_true")
@@ -430,6 +474,7 @@ def main(argv=None) -> int:
         anakin_artifact=args.anakin_artifact,
         compile_artifact=args.compile_artifact,
         prefix_artifact=args.prefix_artifact,
+        obs_artifact=args.obs_artifact,
         rlint_artifact=args.rlint_artifact,
         commit=not args.no_commit,
     )
