@@ -84,6 +84,19 @@ _PEAK_FLOPS = {
     "TPU v6e": 918e12,
 }
 
+# HBM bandwidth by generation (public numbers), for the static roofline's
+# compute-vs-transfer classification. Unknown device kind -> 0.0: the
+# ir_audit section then reports intensity only rather than inventing a
+# bandwidth and mislabeling programs as transfer-bound.
+_PEAK_BW = {
+    "TPU v4": 1228e9,
+    "TPU v5 lite": 819e9,
+    "TPU v5e": 819e9,
+    "TPU v5p": 2765e9,
+    "TPU v6 lite": 1640e9,
+    "TPU v6e": 1640e9,
+}
+
 
 def _setup_jax():
     """Per-process JAX init: platform pin + persistent compilation cache.
@@ -912,6 +925,63 @@ def _peak_flops(jax) -> float:
     return next(
         (v for k, v in _PEAK_FLOPS.items() if k.lower() in kind.lower()), 100e12
     )
+
+
+def _peak_bw(jax) -> float:
+    env = float(os.environ.get("RL_TPU_PEAK_BYTES_PER_S", "0") or 0.0)
+    if env > 0:
+        return env
+    kind = jax.devices()[0].device_kind
+    return next((v for k, v in _PEAK_BW.items() if k.lower() in kind.lower()), 0.0)
+
+
+def _ir_audit_section(jax, prefix: str = "") -> dict:
+    """PR-15 deep-tier roll-up for a bench's output: every program the
+    default ProgramRegistry compiled during this bench was audited
+    (R101-R105) at lowering time; here the static roofline prediction is
+    paired with the PR-12 sampled device-time attribution so the
+    committed AUDIT artifact shows predicted vs measured MFU side by
+    side. ``findings`` must come out 0 — a real finding fails the tier-1
+    gate long before a bench runs; the section records that proof next
+    to the perf numbers it certifies. ``prefix`` scopes to one program
+    family (bench-mode ``all`` runs every sub-bench in one artifact)."""
+    from rl_tpu.analysis.ir import get_ir_auditor, roofline
+    from rl_tpu.compile import get_program_registry
+
+    section: dict = {"programs_audited": 0, "findings": 0, "by_program": {}}
+    aud = get_ir_auditor(create=False)
+    if aud is None:
+        return section
+    peak, bw = _peak_flops(jax), _peak_bw(jax)
+    stats = get_program_registry().stats()
+    reps: dict = {}
+    for rep in aud._snapshot():
+        if prefix and not rep.name.startswith(prefix):
+            continue
+        reps[rep.name] = rep  # last signature wins, one row per program
+    for name, rep in sorted(reps.items()):
+        rec: dict = {"findings": len(rep.findings)}
+        cost = rep.cost
+        if cost is not None:
+            rl = roofline(cost, peak, bw)
+            rec["flops"] = cost.flops
+            rec["bytes"] = cost.bytes
+            rec["intensity"] = round(rl.get("intensity", 0.0), 3)
+            if bw > 0:
+                # the roofline MFU ceiling is trivially 1.0 without a byte
+                # term, so it only rides when the bandwidth is known
+                rec["predicted_mfu"] = round(rl.get("predicted_mfu", 0.0), 6)
+                rec["bound"] = rl.get("bound")
+                rec["transfer_bound"] = bool(rl.get("transfer_bound"))
+        s = stats.get(name) or {}
+        dev_s = float(s.get("device_s") or 0.0)
+        dev_fl = float(s.get("device_flops") or 0.0)
+        if dev_s > 0 and dev_fl > 0:
+            rec["measured_mfu"] = round(dev_fl / dev_s / peak, 6)
+        section["by_program"][name] = rec
+        section["findings"] += rec["findings"]
+    section["programs_audited"] = len(reps)
+    return section
 
 
 def bench_rlhf(report: bool = True) -> dict:
@@ -2337,6 +2407,7 @@ def bench_fleet(report: bool = True) -> dict:
         "n_slots": S,
         "n_engines": 3,
         "obs": obs_section,
+        "ir_audit": _ir_audit_section(jax, prefix="serving."),
         "metrics": metrics,
         "error": None,
     }
@@ -3080,6 +3151,7 @@ def _anakin_worker(report: bool = True) -> dict:
         "steps_per_dispatch": spd,
         "sweep": sweep,
         "host_baseline": host_baselines or None,
+        "ir_audit": _ir_audit_section(jax, prefix="anakin."),
         "error": "; ".join(p["error"] for p in sweep if p.get("error")) or None,
     }
     out.update(_platform_tag(jax))
@@ -3140,6 +3212,13 @@ def bench_anakin(report: bool = True) -> dict:
         metrics["fused_vs_per_step"] = hb.get("fused_vs_per_step")
     metrics["num_envs_scaling_per_chip"] = num_envs_scaling
     errors = [f"{k}: {v['error']}" for k, v in results.items() if v.get("error")]
+    # lift the deep-tier audit from whichever worker carried it (the audit
+    # runs in the subprocess that owns the chip; the parent never compiles)
+    ir_audit = next(
+        (r["ir_audit"] for r in (results.get(str(n), {}) for n in points)
+         if isinstance(r.get("ir_audit"), dict) and r["ir_audit"].get("programs_audited")),
+        None,
+    )
     out = {
         "metric": "anakin_env_steps_per_sec_per_chip",
         "value": best,
@@ -3153,6 +3232,7 @@ def bench_anakin(report: bool = True) -> dict:
             hb.get("fused_vs_host_collector") is not None
             and hb["fused_vs_host_collector"] > 1.0
         ),
+        "ir_audit": ir_audit,
         "metrics": metrics,
         "platform": r1.get("platform"),
         "shapes": _TIER,

@@ -12,7 +12,16 @@ R005   lock-order cycles over the package-wide lock-acquisition graph
 R006   raw ``jax.jit``/``jax.pjit`` in rl_tpu/models/ or rl_tpu/trainers/
        bypassing the ProgramRegistry (not AOT-warmable, invisible to the
        executable store and compile metrics)
+R007   cross-thread shared-state hazard: a field mutated inside a
+       ``Supervisor.spawn``/``threading.Thread`` worker target and read
+       from another method, neither side holding a lock
 =====  =======================================================================
+
+IR rules (R101–R105, see :mod:`.ir` / :mod:`.irrules`) audit the
+*lowered* program — jaxpr + compiled HLO — at ProgramRegistry compile
+time: host callbacks, unhonored donation, shard-local collectives, f64
+creep, dead computation, plus a static FLOPs/bytes cost model feeding a
+roofline-predicted MFU.
 
 CLI: ``python tools/rlint.py rl_tpu/`` — findings are gated by the
 checked-in ``.rlint-baseline.json`` (every suppression carries a reason)
@@ -32,6 +41,8 @@ import os
 from .baseline import Baseline, DEFAULT_BASELINE
 from .core import ModuleIndex, PackageIndex, hot_path
 from .findings import Finding
+from .ir import IRAuditor, IRCost, get_ir_auditor, roofline, set_ir_auditor
+from .irrules import IR_RULES
 from .lockorder import lock_edges, run_lockorder
 from .rules import run_rules
 from .witness import LockWitness, WitnessedLock
@@ -41,16 +52,22 @@ __all__ = [
     "Baseline",
     "DEFAULT_BASELINE",
     "Finding",
+    "IRAuditor",
+    "IRCost",
+    "IR_RULES",
     "LockWitness",
     "WitnessedLock",
     "analyze_paths",
     "analyze_sources",
     "build_index",
+    "get_ir_auditor",
     "hot_path",
     "lock_edges",
+    "roofline",
+    "set_ir_auditor",
 ]
 
-ALL_RULES = ("R001", "R002", "R003", "R004", "R005", "R006")
+ALL_RULES = ("R001", "R002", "R003", "R004", "R005", "R006", "R007")
 
 
 def _module_name(path: str, root: str) -> str:

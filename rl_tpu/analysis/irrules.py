@@ -1,0 +1,147 @@
+"""R100-series rules: semantic checks over lowered programs.
+
+=====  =======================================================================
+R101   host callback primitive (``pure_callback``/``io_callback``/
+       ``debug.*``) inside a registered program — every dispatch re-enters
+       Python, serializing the device pipeline the registry exists to keep
+       full
+R102   donation not honored: the program declares ``donate_argnums`` but the
+       compiled executable's input-output alias map is empty — XLA copied
+       every "donated" buffer, so the program silently pays 2× memory
+R103   unexpected collective: a cross-device primitive (or a partitioner-
+       inserted HLO collective) in a program whose registration declares a
+       ``shard_local`` contract — the gate the cross-shard replay client
+       (ROADMAP item 3) dispatches under
+R104   dtype promotion: f64/c128 values materialize in a program whose
+       inputs are all ≤ 32-bit — a weak-type or accidental upcast that
+       doubles bytes moved (and is unsupported on TPU hardware)
+R105   dead computation: an equation whose outputs feed nothing (or an
+       input buffer nothing reads) above a size threshold — transferred
+       and/or computed, then thrown away
+=====  =======================================================================
+
+Findings carry ``file="program:<name>"`` and a stable snippet (primitive
+/ detail, never a line number), so the sha1 fingerprint survives re-
+registration and the ordinary ``.rlint-baseline.json`` triage flow
+applies unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from .findings import Finding
+from .ir import IRFacts
+
+__all__ = ["IR_RULES", "run_ir_rules"]
+
+IR_RULES = ("R101", "R102", "R103", "R104", "R105")
+
+_NARROW_BITS = 32
+
+
+def _prog_finding(rule: str, name: str, snippet: str, message: str,
+                  extra: dict | None = None) -> Finding:
+    return Finding(
+        rule=rule, file=f"program:{name}", line=0, qualname=name,
+        snippet=snippet, message=message, extra=extra or {},
+    )
+
+
+def _input_is_wide(input_dtypes: list) -> bool:
+    return any(dt in ("float64", "complex128", "int64", "uint64")
+               for dt in input_dtypes)
+
+
+def run_ir_rules(
+    *,
+    name: str,
+    facts: IRFacts | None,
+    donated_leaves: int = 0,
+    donation_declared: bool = False,
+    honored_aliases: int = 0,
+    hlo_collectives: list | None = None,
+    contract: dict | None = None,
+) -> list[Finding]:
+    contract = contract or {}
+    hlo_collectives = hlo_collectives or []
+    out: list[Finding] = []
+
+    # R101 — host callback in a registered (hence hot) program
+    if facts is not None:
+        seen: set = set()
+        for prim, path in facts.callback_sites:
+            if prim in seen:
+                continue
+            seen.add(prim)
+            where = f" (at {path.lstrip('/')})" if path else ""
+            out.append(_prog_finding(
+                "R101", name, f"callback:{prim}",
+                f"host callback primitive '{prim}' in program '{name}'{where} — "
+                "every dispatch re-enters Python and stalls the device queue",
+            ))
+
+    # R102 — declared donation, zero honored aliases
+    if donation_declared and donated_leaves > 0 and honored_aliases == 0:
+        out.append(_prog_finding(
+            "R102", name, "donation:none-honored",
+            f"program '{name}' declares donate_argnums ({donated_leaves} "
+            "donated buffer(s)) but the executable aliases none of them to "
+            "an output — XLA copied every donated buffer (2x memory, "
+            "usually a shape/dtype mismatch between input and output)",
+            extra={"declared": donated_leaves, "honored": honored_aliases},
+        ))
+
+    # R103 — collective in a shard-local program
+    if contract.get("shard_local"):
+        prims = sorted({p for p, _ in facts.collective_sites}) if facts else []
+        for prim in prims:
+            out.append(_prog_finding(
+                "R103", name, f"collective:{prim}",
+                f"collective primitive '{prim}' in program '{name}', whose "
+                "registration declares a shard-local contract — the program "
+                "must never synchronize across shards",
+            ))
+        # HLO-level scan only adds partitioner-inserted collectives that
+        # have no jaxpr primitive to point at; with an explicit primitive
+        # the jaxpr finding above is the precise one
+        for op in hlo_collectives if not prims else []:
+            out.append(_prog_finding(
+                "R103", name, f"collective:{op}",
+                f"partitioner-inserted HLO collective '{op}' in shard-local "
+                f"program '{name}' — an in/out sharding mismatch is forcing "
+                "a resharding exchange",
+            ))
+
+    # R104 — f64/c128 creep with ≤32-bit inputs
+    if facts is not None and facts.wide_sites and not _input_is_wide(facts.input_dtypes):
+        seen = set()
+        for prim, dtype, path in facts.wide_sites:
+            key = (prim, dtype)
+            if key in seen:
+                continue
+            seen.add(key)
+            out.append(_prog_finding(
+                "R104", name, f"promote:{prim}:{dtype}",
+                f"'{prim}' produces {dtype} in program '{name}' whose inputs "
+                f"are all <= {_NARROW_BITS}-bit — a weak-type/accidental "
+                "upcast (2x bytes; unsupported on TPU)",
+            ))
+
+    # R105 — dead computation / dead inputs above threshold
+    if facts is not None:
+        for prim, dead_b, shape in facts.dead_sites:
+            out.append(_prog_finding(
+                "R105", name, f"dead:{prim}:{shape}",
+                f"dead computation in program '{name}': '{prim}' result "
+                f"{shape} ({int(dead_b)} bytes) feeds no output",
+                extra={"bytes": dead_b},
+            ))
+        for pos, dead_b in facts.dead_inputs:
+            out.append(_prog_finding(
+                "R105", name, f"dead-input:{pos}",
+                f"program '{name}' input #{pos} ({int(dead_b)} bytes) is "
+                "never read — transferred to the device for nothing",
+                extra={"bytes": dead_b},
+            ))
+    return out

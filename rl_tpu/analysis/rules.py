@@ -33,6 +33,13 @@ R006 registry bypass — a literal ``jax.jit``/``jax.pjit`` (call or
     cold-start tax this subsystem exists to kill. Intentional raw sites
     (docstring examples, cold-path eval helpers) live in the baseline
     with a reason.
+
+R007 cross-thread shared-state hazard — a ``self.X`` field rebound inside
+    a function reachable from a ``Supervisor.spawn``/``threading.Thread``
+    target and read from a method running on other threads, with neither
+    side inside a ``with <lock>`` (lock identity reuses the R005
+    lock-site index). GIL-atomic flag reads that are *intentionally*
+    lock-free live in the baseline with a reason.
 """
 
 from __future__ import annotations
@@ -534,8 +541,152 @@ def _r006(index: PackageIndex, m: ModuleIndex) -> list[Finding]:
     return out
 
 
+# -- R007 ---------------------------------------------------------------------
+
+# attrs holding these are synchronization/thread-safe objects, not shared
+# mutable state — touching them unlocked is the point of having them
+_THREAD_SAFE_CTORS = {
+    "threading.Lock", "threading.RLock", "threading.Event",
+    "threading.Condition", "threading.Semaphore", "threading.BoundedSemaphore",
+    "threading.Barrier", "threading.local", "queue.Queue", "queue.SimpleQueue",
+    "queue.LifoQueue", "queue.PriorityQueue", "collections.deque",
+}
+
+
+def _r007_state(index: PackageIndex):
+    """Package-level worker-thread reachability, computed once per index:
+    every function passed as a ``threading.Thread(target=...)`` /
+    ``Supervisor.spawn(name, run)`` target, closed over resolved calls."""
+    cached = getattr(index, "_r007_state", None)
+    if cached is not None:
+        return cached
+    from .lockorder import _LockPass
+
+    lp = _LockPass(index)
+    roots: dict[str, str] = {}  # fn qualname -> spawn-site description
+    for m in index.modules:
+        for fn in _iter_functions(m):
+            for node in _body_nodes(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                target = how = None
+                cname = canon(node.func, m.aliases)
+                if cname is not None and (
+                    cname == "threading.Thread" or cname.endswith(".Thread")
+                ):
+                    for kw in node.keywords:
+                        if kw.arg == "target":
+                            target, how = kw.value, "Thread target"
+                elif isinstance(node.func, ast.Attribute) and node.func.attr == "spawn":
+                    if len(node.args) >= 2:
+                        target, how = node.args[1], "spawn target"
+                    else:
+                        for kw in node.keywords:
+                            if kw.arg in ("run", "target"):
+                                target, how = kw.value, "spawn target"
+                if target is None:
+                    continue
+                ref = index.resolve_func_ref(m, fn, target)
+                if ref is not None:
+                    roots.setdefault(ref, f"{how} at {m.path}:{node.lineno}")
+    thread_side = dict(roots)
+    frontier = list(roots)
+    while frontier:
+        q = frontier.pop()
+        info = index.functions.get(q)
+        if info is None:
+            continue
+        for callee in info.calls:
+            if callee not in thread_side:
+                thread_side[callee] = thread_side[q]
+                frontier.append(callee)
+    state = (lp, thread_side)
+    index._r007_state = state
+    return state
+
+
+def _self_accesses(lp, m: ModuleIndex, fn: FunctionInfo):
+    """Yield (attr, node, kind, locked) for every ``self.X`` access in fn.
+    ``locked`` is True when the access sits inside a ``with`` whose
+    context binds to a known lock (the R005 lock-site index)."""
+    def walk(node, locked):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                continue
+            child_locked = locked
+            if isinstance(child, (ast.With, ast.AsyncWith)):
+                if any(lp.bind(i.context_expr, m, fn) for i in child.items):
+                    child_locked = True
+            if (isinstance(child, ast.Attribute)
+                    and isinstance(child.value, ast.Name)
+                    and child.value.id == "self"):
+                kind = "write" if isinstance(child.ctx, (ast.Store, ast.Del)) else "read"
+                yield child.attr, child, kind, locked
+            yield from walk(child, child_locked)
+    yield from walk(fn.node, False)
+
+
+def _r007_safe_attrs(lp, m: ModuleIndex, cls: str) -> set:
+    """Attrs of ``cls`` that are locks or thread-safe containers."""
+    safe = {lid.rsplit(".", 1)[-1] for lid in lp.locks if lid.startswith(f"{cls}.")}
+    for node in ast.walk(m.tree):
+        if not (isinstance(node, ast.ClassDef) and node.name == cls):
+            continue
+        for sub in ast.walk(node):
+            if not (isinstance(sub, ast.Assign) and isinstance(sub.value, ast.Call)):
+                continue
+            if canon(sub.value.func, m.aliases) in _THREAD_SAFE_CTORS:
+                for t in sub.targets:
+                    if (isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == "self"):
+                        safe.add(t.attr)
+    return safe
+
+
+def _r007(index: PackageIndex, m: ModuleIndex) -> list[Finding]:
+    lp, thread_side = _r007_state(index)
+    if not thread_side:
+        return []
+    out: list[Finding] = []
+    for cls in m.methods:
+        safe = None  # computed lazily, only for classes with thread-side writes
+        writes: dict[str, tuple] = {}   # attr -> (fn, node) unlocked thread-side write
+        reads: dict[str, list] = {}     # attr -> [(fn, node)] unlocked foreign reads
+        for fn in _iter_functions(m):
+            if fn.class_name != cls:
+                continue
+            on_thread = fn.qualname in thread_side
+            if not on_thread and fn.node.name == "__init__":
+                continue  # runs before the thread exists
+            for attr, node, kind, locked in _self_accesses(lp, m, fn):
+                if locked:
+                    continue
+                if on_thread and kind == "write":
+                    if safe is None:
+                        safe = _r007_safe_attrs(lp, m, cls)
+                    if attr not in safe:
+                        writes.setdefault(attr, (fn, node))
+                elif not on_thread and kind == "read":
+                    reads.setdefault(attr, []).append((fn, node))
+        for attr in sorted(set(writes) & set(reads)):
+            wfn, wnode = writes[attr]
+            rfn, rnode = min(reads[attr], key=lambda t: t[1].lineno)
+            out.append(Finding(
+                rule="R007", file=m.path, line=rnode.lineno,
+                qualname=rfn.display, snippet=m.snippet(rnode),
+                message=(
+                    f"'{cls}.{attr}' is written by worker thread "
+                    f"{wfn.display} (line {wnode.lineno}, "
+                    f"{thread_side[wfn.qualname]}) and read here with no "
+                    "lock held on either side — torn/stale reads under churn"
+                ),
+            ))
+    return out
+
+
 _RULES = {"R001": _r001, "R002": _r002, "R003": _r003, "R004": _r004,
-          "R006": _r006}
+          "R006": _r006, "R007": _r007}
 
 
 def run_rules(index: PackageIndex, rules: set | None = None) -> list[Finding]:

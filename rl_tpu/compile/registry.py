@@ -53,7 +53,9 @@ __all__ = [
 
 _ENV_NO_AOT = "RL_TPU_NO_AOT"
 _ENV_NO_ATTR = "RL_TPU_NO_ATTRIBUTION"
+_ENV_NO_IR_AUDIT = "RL_TPU_NO_IR_AUDIT"
 _ENV_PEAK_FLOPS = "RL_TPU_PEAK_FLOPS"
+_ENV_PEAK_BW = "RL_TPU_PEAK_BYTES_PER_S"
 _ATTR_SAMPLE_EVERY = 8
 
 
@@ -169,6 +171,7 @@ class CachedProgram:
         *,
         registry: "ProgramRegistry",
         fingerprint: str = "",
+        ir_contract: dict | None = None,
         **jit_kwargs: Any,
     ):
         import jax
@@ -177,6 +180,7 @@ class CachedProgram:
         self.fn = fn
         self.fingerprint = fingerprint
         self.jit_kwargs = jit_kwargs
+        self.ir_contract = dict(ir_contract or {})
         self._registry = registry
         self._jit = jax.jit(fn, **jit_kwargs)
         self._lock = threading.Lock()
@@ -184,6 +188,9 @@ class CachedProgram:
         self._unvalidated: set[tuple] = set()  # store-loads before 1st call
         self._signatures: list[tuple] = []
         self.flops_per_call = 0.0  # from cost_analysis, when the backend has it
+        self.static_flops = 0.0    # from the IR auditor's static cost model
+        self.static_bytes = 0.0
+        self.ir_report: Any = None  # latest rl_tpu.analysis.ir.ProgramAudit
         self._attr_tick = 0
         self.stats = {
             "calls": 0,
@@ -267,7 +274,74 @@ class CachedProgram:
             key=self.store_key(args), compiled=prog, meta={"name": self.name}
         )
         self._note_flops(prog)
+        self._ir_audit(args, mk, prog)
         return prog, dt
+
+    # -- IR audit --------------------------------------------------------
+
+    def _donated_leaf_count(self, args: tuple) -> int:
+        import jax
+
+        nums = self.jit_kwargs.get("donate_argnums")
+        if nums is None:
+            return 0
+        if isinstance(nums, int):
+            nums = (nums,)
+        n = 0
+        for i in nums:
+            if 0 <= i < len(args):
+                n += len(jax.tree_util.tree_leaves(args[i]))
+        return n
+
+    def _ir_audit(self, args: tuple, mk: tuple, compiled: Any) -> None:
+        """Audit the program we just lowered+compiled (rlint deep tier).
+
+        Runs ONLY on the compile path — a store-loaded executable was
+        audited by the process that first built it — so dispatch never
+        pays for this. Extraction is best-effort (``trace``/``as_text``
+        are feature-detected); the rules themselves are pure and the
+        whole thing is fenced so an audit bug can never break a build.
+        Opt out with ``RL_TPU_NO_IR_AUDIT=1``.
+        """
+        if os.environ.get(_ENV_NO_IR_AUDIT, "") not in ("", "0"):
+            return
+        try:
+            auditor = self._registry.auditor
+            if auditor is None:
+                from ..analysis.ir import get_ir_auditor
+
+                auditor = get_ir_auditor()
+            jaxpr = None
+            trace = getattr(self._jit, "trace", None)
+            if callable(trace):
+                try:
+                    jaxpr = trace(*args).jaxpr
+                except Exception:
+                    jaxpr = None
+            try:
+                text = compiled.as_text()
+            except Exception:
+                text = ""
+            donate = self.jit_kwargs.get("donate_argnums")
+            declared = donate is not None and donate != ()
+            declared = declared or bool(self.jit_kwargs.get("donate_argnames"))
+            report = auditor.audit(
+                name=self.name,
+                fingerprint=self.fingerprint,
+                jaxpr=jaxpr,
+                compiled_text=text,
+                donated_leaves=self._donated_leaf_count(args),
+                donation_declared=declared,
+                contract=self.ir_contract,
+                sig_key=mk,
+            )
+            with self._lock:
+                self.ir_report = report
+                if report.cost is not None:
+                    self.static_flops = report.cost.flops
+                    self.static_bytes = report.cost.bytes
+        except Exception:
+            pass
 
     def _note_flops(self, prog: Any) -> None:
         # cost_analysis is backend-dependent (absent on some platforms,
@@ -367,7 +441,12 @@ class ProgramRegistry:
     ``/metrics``.
     """
 
-    def __init__(self, store: ExecutableStore | None = None, aot: bool | None = None):
+    def __init__(
+        self,
+        store: ExecutableStore | None = None,
+        aot: bool | None = None,
+        auditor: Any = None,
+    ):
         from ..config import enable_compile_cache
 
         enable_compile_cache()
@@ -376,6 +455,11 @@ class ProgramRegistry:
         if aot is None:
             aot = os.environ.get(_ENV_NO_AOT, "") in ("", "0")
         self.aot_disabled = not aot
+        # IR auditor receiving every compile's audit; None = the process
+        # default (rl_tpu.analysis.ir.get_ir_auditor), which the tier-1
+        # gate and /metrics read. Tests compiling deliberately-poisoned
+        # fixtures pass an isolated IRAuditor here.
+        self.auditor = auditor
         self._lock = threading.Lock()
         self._programs: dict[str, list] = {}  # name -> [weakref.ref]
 
@@ -387,14 +471,19 @@ class ProgramRegistry:
         fn: Callable,
         *,
         fingerprint: str = "",
+        ir_contract: dict | None = None,
         **jit_kwargs: Any,
     ) -> CachedProgram:
         """Create a :class:`CachedProgram` for ``fn`` under ``name``.
         ``jit_kwargs`` go to ``jax.jit`` (donate_argnums, in_shardings,
         ...); ``fingerprint`` distinguishes same-name/same-shape programs
-        whose Python closures differ (model config, loss flavor)."""
+        whose Python closures differ (model config, loss flavor);
+        ``ir_contract`` declares semantic invariants the IR auditor
+        enforces at compile time (``{"shard_local": True}`` = the program
+        must never emit a collective — R103)."""
         prog = CachedProgram(
-            name, fn, registry=self, fingerprint=fingerprint, **jit_kwargs
+            name, fn, registry=self, fingerprint=fingerprint,
+            ir_contract=ir_contract, **jit_kwargs
         )
         with self._lock:
             refs = self._programs.setdefault(name, [])
@@ -538,6 +627,22 @@ def _wire_obs(reg: ProgramRegistry) -> None:
             "(set RL_TPU_PEAK_FLOPS to the accelerator peak to enable)",
             labels=("program",),
         )
+        c_ir = obs.counter(
+            "rl_tpu_ir_audit_findings_total",
+            "IR-audit findings (R100-series) across audited programs",
+            labels=("rule",),
+        )
+        g_audited = obs.gauge(
+            "rl_tpu_ir_audited_programs",
+            "program signatures audited at compile time",
+        )
+        g_pred = obs.gauge(
+            "rl_tpu_program_predicted_mfu",
+            "roofline-predicted MFU from the static IR cost model "
+            "(needs RL_TPU_PEAK_FLOPS; RL_TPU_PEAK_BYTES_PER_S adds the "
+            "transfer ceiling)",
+            labels=("program",),
+        )
 
         def collect():
             stats = reg.stats()
@@ -548,6 +653,10 @@ def _wire_obs(reg: ProgramRegistry) -> None:
                 peak = float(os.environ.get(_ENV_PEAK_FLOPS, "0") or 0.0)
             except ValueError:
                 peak = 0.0
+            try:
+                bw = float(os.environ.get(_ENV_PEAK_BW, "0") or 0.0)
+            except ValueError:
+                bw = 0.0
             for name, s in stats.items():
                 dev_s = float(s.get("device_s", 0.0))
                 c_dev.set_total(dev_s, {"program": name})
@@ -555,6 +664,23 @@ def _wire_obs(reg: ProgramRegistry) -> None:
                 if peak > 0.0 and dev_s > 0.0:
                     mfu = float(s.get("device_flops", 0.0)) / dev_s / peak
                     g_mfu.set(mfu, {"program": name})
+            try:
+                from ..analysis.ir import get_ir_auditor, roofline
+
+                aud = reg.auditor or get_ir_auditor()
+                for rule, n in aud.counts_by_rule().items():
+                    c_ir.set_total(float(n), {"rule": rule})
+                g_audited.set(float(aud.programs_audited()))
+                if peak > 0.0:
+                    for p in reg.programs():
+                        rep = p.ir_report
+                        if rep is None or rep.cost is None:
+                            continue
+                        rf = roofline(rep.cost, peak, bw)
+                        if "predicted_mfu" in rf:
+                            g_pred.set(rf["predicted_mfu"], {"program": p.name})
+            except Exception:
+                pass
 
         obs.register_collector(collect)
     except Exception:

@@ -338,6 +338,9 @@ class PrioritizedSampler(Sampler):
                 self.fanout, batch_size, capacity, fingerprint,
             )),
             donate_argnums=(0,) if donate else (),
+            # the PER tree lives on one device; a collective in its
+            # lowering means the sampler state was accidentally sharded
+            ir_contract={"shard_local": True},
         )
         if warmup:
             prog.add_signature(

@@ -321,8 +321,14 @@ class ContinuousBatchingEngine:
         self._prefills: dict[tuple, Any] = {}  # (A, bucket) -> CachedProgram
         self._pprefills: dict[tuple, Any] = {}  # (A, suffix bucket) -> prog
         self._cow_progs: dict[int, Any] = {}  # padded pair count -> prog
+        # every serving program is replica-local by design (the engine
+        # parallelizes by running whole replicas); the IR auditor (R103)
+        # holds them to it — a collective appearing in a lowered serving
+        # program means a sharding annotation leaked in
+        self._ir_contract = {"shard_local": True}
         self._admit_update = self._registry.register(
-            "serving.admit_update", _admit_update_fn
+            "serving.admit_update", _admit_update_fn,
+            ir_contract=self._ir_contract,
         )
         # warmup=True builds the whole ladder before __init__ returns;
         # "background" overlaps it with the caller's remaining setup
@@ -443,7 +449,8 @@ class ContinuousBatchingEngine:
             )
 
         prog = self._decode_progs[chunk] = self._registry.register(
-            f"serving.decode.k{chunk}", fn, fingerprint=self._fingerprint
+            f"serving.decode.k{chunk}", fn, fingerprint=self._fingerprint,
+            ir_contract=self._ir_contract,
         )
         return prog
 
@@ -454,6 +461,7 @@ class ContinuousBatchingEngine:
                 f"serving.prefill.a{a}.b{bucket}",
                 self._prefill_fn,
                 fingerprint=self._fingerprint,
+                ir_contract=self._ir_contract,
             )
         return prog
 
@@ -494,6 +502,7 @@ class ContinuousBatchingEngine:
                 f"serving.pprefill.a{a}.s{bucket}",
                 self._pprefill_fn,
                 fingerprint=self._fingerprint,
+                ir_contract=self._ir_contract,
             )
         return prog
 
@@ -515,6 +524,7 @@ class ContinuousBatchingEngine:
             prog = self._cow_progs[n] = self._registry.register(
                 f"serving.cowcopy.n{n}", self._cow_copy_fn,
                 fingerprint=self._fingerprint,
+                ir_contract=self._ir_contract,
             )
         return prog
 

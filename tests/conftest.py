@@ -79,6 +79,35 @@ def pytest_collection_modifyitems(items):
             it.add_marker(pytest.mark.smoke)
 
 
+def pytest_sessionfinish(session, exitstatus):
+    """Tier-1 IR gate: every program the default ProgramRegistry compiled
+    during this test run was audited (R101–R105) against the checked-in
+    baseline; any unsuppressed finding fails the session even if each
+    individual test passed. Tests that deliberately compile poisoned
+    fixture programs use their own ``ProgramRegistry(auditor=...)`` so
+    they never land here."""
+    import sys
+
+    ir = sys.modules.get("rl_tpu.analysis.ir")
+    if ir is None:  # no test compiled through the registry
+        return
+    aud = ir.get_ir_auditor(create=False)
+    if aud is None:
+        return
+    unsup = aud.unsuppressed()
+    if unsup:
+        tr = session.config.pluginmanager.get_plugin("terminalreporter")
+        write = tr.write_line if tr is not None else print
+        write("")
+        write(
+            f"rlint IR gate: {len(unsup)} unsuppressed R10x finding(s) over "
+            f"{aud.programs_audited()} audited program(s):"
+        )
+        for f in unsup:
+            write("  " + f.format())
+        session.exitstatus = 1
+
+
 @pytest.fixture(autouse=True)
 def _hot_path_transfer_guard(request):
     """``@pytest.mark.hot_path_guard``: run the test body under

@@ -401,6 +401,65 @@ def test_obs_section_distilled_to_own_artifact(tmp_path):
     assert runner.commits[0][0] == [art, mart, obart]
 
 
+def test_ir_audit_sections_distilled_to_own_artifact(tmp_path):
+    """PR-15: the fleet and anakin sub-benches' ``ir_audit`` sections
+    (per-program predicted MFU from the static roofline vs measured MFU,
+    zero-findings assertion) land whole, keyed by sub-bench, in their own
+    committed AUDIT json on the same single commit."""
+
+    class AuditRunner(FakeRunner):
+        def bench_all(self, timeout):
+            self.bench_calls.append(timeout)
+            fleet_ia = {
+                "programs_audited": 4,
+                "findings": 0,
+                "by_program": {
+                    "serving.decode.k8": {
+                        "predicted_mfu": 0.41, "measured_mfu": 0.28,
+                        "bound": "compute", "flops": 2.1e9,
+                    },
+                    "serving.prefill.a1.b16": {
+                        "predicted_mfu": 0.12, "measured_mfu": 0.09,
+                        "bound": "transfer", "flops": 4.4e8,
+                    },
+                },
+            }
+            anakin_ia = {
+                "programs_audited": 1,
+                "findings": 0,
+                "by_program": {
+                    "anakin.dispatch": {
+                        "predicted_mfu": 0.55, "measured_mfu": 0.37,
+                        "bound": "compute", "flops": 9.9e9,
+                    },
+                },
+            }
+            lines = [
+                {"fleet": {"value": 215.1, "ir_audit": fleet_ia,
+                           "metrics": {"fleet_tokens_per_sec": 215.1}}},
+                {"anakin": {"value": 1e6, "ir_audit": anakin_ia}},
+            ]
+            return 0, "".join(json.dumps(ln) + "\n" for ln in lines)
+
+    runner = AuditRunner([_healthy()])
+    art = str(tmp_path / "bench.jsonl")
+    mart = str(tmp_path / "METRICS.json")
+    iart = str(tmp_path / "AUDIT.json")
+    watch(runner, lambda s: None, max_probes=1, artifact=art,
+          metrics_artifact=mart, audit_artifact=iart, sleep=lambda s: None)
+    doc = json.loads(open(iart).read())
+    ia = doc["ir_audit"]
+    assert set(ia) == {"fleet", "anakin"}
+    assert ia["fleet"]["findings"] == 0
+    # per-program structure rides whole: the transfer-bound flag is the
+    # actionable output, never flattened away
+    assert ia["fleet"]["by_program"]["serving.prefill.a1.b16"]["bound"] == "transfer"
+    assert ia["anakin"]["by_program"]["anakin.dispatch"]["predicted_mfu"] == 0.55
+    assert doc["artifact"] == os.path.relpath(art, REPO)
+    assert len(runner.commits) == 1
+    assert iart in runner.commits[0][0]
+
+
 def test_rlhf_pipeline_subresult_distilled(tmp_path):
     """PR-4: the rlhf sub-bench reports an overlapped-cycle ``pipeline``
     sub-result; the watcher must split it into the committed METRICS json

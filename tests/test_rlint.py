@@ -1,10 +1,12 @@
-"""rlint: static analyzer (R001–R005), baseline round-trip, LockWitness,
+"""rlint: static analyzer (R001–R007), baseline round-trip, LockWitness,
 and the tier-1 gate holding rl_tpu/ at zero unsuppressed findings.
 
 Rule fixtures are in-memory sources (``analyze_sources``) so each case
 states exactly the code shape it exercises: a positive that must fire
 and a negative that must stay silent. The gate test at the bottom is the
-CI contract from ISSUE 8: ``python tools/rlint.py rl_tpu/`` exits 0.
+CI contract from ISSUE 8: ``python tools/rlint.py rl_tpu/`` exits 0 —
+now under ``--strict`` (stale suppressions fail too). The IR tier
+(R101–R105) has its own fixtures in tests/test_ir_audit.py.
 """
 
 import json
@@ -316,6 +318,132 @@ def build(fn, cfg):
 
 
 # ---------------------------------------------------------------------------
+# R007: cross-thread shared-state hazard
+# ---------------------------------------------------------------------------
+
+
+class TestR007:
+    SRC = """
+import threading
+
+class Worker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._count = 0
+        self._total = 0
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._loop)
+        self._thread.start()
+
+    def _loop(self):
+        while True:
+            self._count += 1
+            with self._lock:
+                self._total += 1
+
+    def stats(self):
+        return {"count": self._count, "total": self._peek()}
+
+    def _peek(self):
+        with self._lock:
+            return self._total
+"""
+
+    def test_unlocked_cross_thread_attr_flagged(self):
+        out = analyze_sources({"m": self.SRC}, rules=["R007"])
+        assert len(out) == 1
+        assert "_count" in out[0].message
+        assert out[0].qualname.startswith("Worker")
+
+    def test_locked_attr_not_flagged(self):
+        out = analyze_sources({"m": self.SRC}, rules=["R007"])
+        assert not any("_total" in f.message for f in out)
+
+    def test_supervisor_spawn_target_flagged(self):
+        src = """
+class Service:
+    def __init__(self, sup):
+        self._sup = sup
+        self._beats = 0
+
+    def start(self):
+        self._sup.spawn("svc", self._run)
+
+    def _run(self):
+        self._beats += 1
+
+    def health(self):
+        return self._beats
+"""
+        out = analyze_sources({"m": src}, rules=["R007"])
+        assert len(out) == 1 and "_beats" in out[0].message
+
+    def test_both_sides_locked_clean(self):
+        src = """
+import threading
+
+class Service:
+    def __init__(self, sup):
+        self._sup = sup
+        self._lock = threading.Lock()
+        self._beats = 0
+
+    def start(self):
+        self._sup.spawn("svc", self._run)
+
+    def _run(self):
+        with self._lock:
+            self._beats += 1
+
+    def health(self):
+        with self._lock:
+            return self._beats
+"""
+        assert analyze_sources({"m": src}, rules=["R007"]) == []
+
+    def test_thread_safe_primitives_excluded(self):
+        src = """
+import queue
+import threading
+
+class Pump:
+    def __init__(self):
+        self._q = queue.Queue()
+        self._stop = threading.Event()
+
+    def start(self):
+        threading.Thread(target=self._loop).start()
+
+    def _loop(self):
+        while not self._stop.is_set():
+            self._q.put(1)
+
+    def drain(self):
+        return self._q.get()
+
+    def stop(self):
+        self._stop.set()
+"""
+        assert analyze_sources({"m": src}, rules=["R007"]) == []
+
+    def test_no_thread_no_finding(self):
+        src = """
+class Plain:
+    def __init__(self):
+        self._n = 0
+
+    def bump(self):
+        self._n += 1
+
+    def read(self):
+        return self._n
+"""
+        assert analyze_sources({"m": src}, rules=["R007"]) == []
+
+
+# ---------------------------------------------------------------------------
 # R005: static lock order
 # ---------------------------------------------------------------------------
 
@@ -612,8 +740,11 @@ class TestPackageGate:
             assert s["reason"] != "PENDING", f"untriaged suppression: {s}"
 
     def test_cli_gate_exits_zero(self):
+        # --strict: stale suppressions are failures, not warnings — the
+        # committed baseline must be exactly the live finding set
         proc = subprocess.run(
-            [sys.executable, os.path.join(REPO, "tools", "rlint.py"), "rl_tpu/"],
+            [sys.executable, os.path.join(REPO, "tools", "rlint.py"),
+             "rl_tpu/", "--strict"],
             cwd=REPO,
             capture_output=True,
             text=True,
@@ -622,7 +753,7 @@ class TestPackageGate:
         assert proc.returncode == 0, proc.stdout + proc.stderr
 
     def test_artifact_counts_consistent(self):
-        path = os.path.join(REPO, "RLINT_pr8.json")
+        path = os.path.join(REPO, "RLINT_pr15.json")
         with open(path) as f:
             art = json.load(f)
         assert art["tool"] == "rlint"
@@ -631,6 +762,73 @@ class TestPackageGate:
         assert total["found"] == total["suppressed"]
         assert total["found"] == sum(r["found"] for r in art["by_rule"].values())
         assert total["fixed_in_prs"] == len(art["fixed"])
-        # the ledger carries this PR's two genuine fixes
+        # the ledger carries PR 8's two genuine fixes forward
         assert any(e["pr"] == 8 and e["rule"] == "R003" for e in art["fixed"])
         assert any(e["pr"] == 8 and e["rule"] == "R001" for e in art["fixed"])
+        # the deep tier is part of the committed summary: AST + IR rules,
+        # every audit-set program accounted for, zero findings
+        for rid in ("R007", "R101", "R102", "R103", "R104", "R105"):
+            assert rid in art["rules"] and rid in art["by_rule"]
+        ir = art["ir"]
+        assert all(v == "ok" for v in ir["status"].values())
+        assert ir["programs_audited"] >= 5
+        assert "offpolicy.k_updates" in ir["by_program"]
+        for name, rec in ir["by_program"].items():
+            assert rec["findings"] == 0, name
+        kup = ir["by_program"]["offpolicy.k_updates"]
+        assert kup["donated_declared"] > 0 and kup["donated_honored"] > 0
+
+
+class TestDiffMode:
+    """--diff gating logic (the IR set itself is exercised in
+    tests/test_ir_audit.py; here the compile is stubbed out)."""
+
+    def _run(self, monkeypatch, changed, argv):
+        import tools.rlint as rlint
+
+        calls = {}
+
+        def fake_run_ir(baseline_path, *, fresh_store):
+            calls["fresh_store"] = fresh_store
+            from rl_tpu.analysis.ir import IRAuditor
+
+            return IRAuditor(baseline_path=baseline_path), {"stub": "ok"}
+
+        monkeypatch.setattr(rlint, "changed_files", lambda rev: changed)
+        monkeypatch.setattr(rlint, "run_ir", fake_run_ir)
+        rc = rlint.main(argv)
+        return rc, calls
+
+    def test_ir_sensitive_change_reruns_ir_with_persistent_store(
+        self, monkeypatch, capsys
+    ):
+        rc, calls = self._run(
+            monkeypatch,
+            ["rl_tpu/trainers/off_policy.py", "docs/static_analysis.md"],
+            ["--diff", "HEAD~1"],
+        )
+        assert rc == 0
+        # persistent store: unchanged-fingerprint programs load + skip
+        assert calls == {"fresh_store": False}
+        assert "IR set" in capsys.readouterr().out
+
+    def test_non_ir_change_skips_ir(self, monkeypatch, capsys):
+        rc, calls = self._run(
+            monkeypatch, ["rl_tpu/obs/metrics.py"], ["--diff", "HEAD~1"]
+        )
+        assert rc == 0
+        assert calls == {}  # run_ir never invoked
+        assert "no IR-sensitive modules touched" in capsys.readouterr().out
+
+    def test_empty_diff_is_clean_and_fast(self, monkeypatch, capsys):
+        rc, calls = self._run(monkeypatch, [], ["--diff", "HEAD"])
+        assert rc == 0 and calls == {}
+        out = capsys.readouterr().out
+        assert "0 finding(s)" in out
+
+    def test_explicit_ir_flag_uses_fresh_store(self, monkeypatch):
+        rc, calls = self._run(
+            monkeypatch, ["rl_tpu/obs/metrics.py"], ["--diff", "HEAD~1", "--ir"]
+        )
+        assert rc == 0
+        assert calls == {"fresh_store": True}

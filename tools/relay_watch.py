@@ -201,6 +201,30 @@ def _extract_obs(stdout: str) -> dict | None:
     return found
 
 
+def _extract_ir_audit(stdout: str) -> dict:
+    """Collect every ``ir_audit`` section (PR-15 deep-tier auditor: per-
+    program predicted-vs-measured MFU from the static roofline, audit
+    findings count — 0, or the gate would have failed) from a bench
+    stdout JSONL stream, keyed by sub-bench name. Structure-preserving
+    like the multichip/obs extractors: per-program dicts go whole into
+    the committed AUDIT artifact. Last match per sub-bench wins (the
+    final aggregate line repeats the sub-results)."""
+    found: dict = {}
+    for ln in (stdout or "").strip().splitlines():
+        try:
+            d = json.loads(ln)
+        except ValueError:
+            continue
+        if not isinstance(d, dict):
+            continue
+        for k, v in d.items():
+            if isinstance(v, dict) and isinstance(v.get("ir_audit"), dict):
+                found[k] = v["ir_audit"]
+        if isinstance(d.get("ir_audit"), dict):
+            found.setdefault(str(d.get("metric", "headline")), d["ir_audit"])
+    return found
+
+
 class Runner:
     """Real subprocess/git backend. Tests replace this with a fake that
     implements the same three methods."""
@@ -232,10 +256,13 @@ class Runner:
             return 124, out
         return p.returncode, p.stdout
 
-    def rlint(self, artifact: str, timeout: float = 300.0) -> tuple[int, str]:
-        """Refresh the rlint summary artifact (PR-8): re-run the static
-        analyzer over rl_tpu/ and rewrite ``artifact`` (findings by rule,
-        fixed vs suppressed). rc!=0 means unsuppressed findings — the
+    def rlint(self, artifact: str, timeout: float = 600.0) -> tuple[int, str]:
+        """Refresh the rlint summary artifact (PR-8, deep tier PR-15):
+        re-run the AST rules over rl_tpu/ AND compile the IR audit set
+        (``--ir``) so the artifact records findings by rule across both
+        tiers plus the per-program audit roll-up; ``--strict`` keeps the
+        committed baseline free of stale suppressions. rc!=0 means
+        unsuppressed findings (or a dead audit-set builder) — the
         artifact is still written so the regression is visible in-tree."""
         try:
             p = subprocess.run(
@@ -243,6 +270,8 @@ class Runner:
                     sys.executable,
                     os.path.join(REPO, "tools", "rlint.py"),
                     "rl_tpu/",
+                    "--ir",
+                    "--strict",
                     "--artifact",
                     artifact,
                 ],
@@ -273,6 +302,7 @@ def watch(
     compile_artifact: str | None = None,
     prefix_artifact: str | None = None,
     obs_artifact: str | None = None,
+    audit_artifact: str | None = None,
     rlint_artifact: str | None = None,
     commit: bool = True,
     require_tpu: bool = True,
@@ -404,11 +434,26 @@ def watch(
                 f.write("\n")
             paths.append(obpath)
             log(f"{_utcnow()} obs -> {os.path.relpath(obpath, REPO)}")
+        ia = _extract_ir_audit(bout)
+        if ia:
+            iapath = audit_artifact or os.path.join(REPO, "AUDIT_pr15.json")
+            with open(iapath, "w") as f:
+                json.dump(
+                    {
+                        "artifact": os.path.relpath(path, REPO),
+                        "generated": _utcnow(),
+                        "ir_audit": ia,
+                    },
+                    f, indent=2, sort_keys=True,
+                )
+                f.write("\n")
+            paths.append(iapath)
+            log(f"{_utcnow()} ir_audit -> {os.path.relpath(iapath, REPO)}")
         if hasattr(runner, "rlint"):
             # PR-8: keep the static-analysis summary current alongside the
             # perf artifacts — the same commit that records a measurement
             # re-records the findings ledger it was measured under
-            rlpath = rlint_artifact or os.path.join(REPO, "RLINT_pr8.json")
+            rlpath = rlint_artifact or os.path.join(REPO, "RLINT_pr15.json")
             rrc, _ = runner.rlint(rlpath)
             if os.path.exists(rlpath):
                 paths.append(rlpath)
@@ -449,8 +494,10 @@ def main(argv=None) -> int:
                     help="prefix-KV reuse result path (default PREFIX_pr11.json)")
     ap.add_argument("--obs-artifact", default=None,
                     help="fleet trace/SLO/flight-record path (default OBS_pr12.json)")
+    ap.add_argument("--audit-artifact", default=None,
+                    help="IR-audit predicted-vs-measured MFU path (default AUDIT_pr15.json)")
     ap.add_argument("--rlint-artifact", default=None,
-                    help="rlint findings-summary path (default RLINT_pr8.json)")
+                    help="rlint findings-summary path (default RLINT_pr15.json)")
     ap.add_argument("--no-commit", action="store_true")
     ap.add_argument("--log-file", default=os.path.join(REPO, "logs", "relay_watch.log"))
     args = ap.parse_args(argv)
@@ -475,6 +522,7 @@ def main(argv=None) -> int:
         compile_artifact=args.compile_artifact,
         prefix_artifact=args.prefix_artifact,
         obs_artifact=args.obs_artifact,
+        audit_artifact=args.audit_artifact,
         rlint_artifact=args.rlint_artifact,
         commit=not args.no_commit,
     )
