@@ -2660,6 +2660,232 @@ def bench_prefix(report: bool = True) -> dict:
     return out
 
 
+def bench_spec(report: bool = True) -> dict:
+    """BENCH_MODE=spec: speculative decoding A/B (the ISSUE-16 tentpole).
+
+    The workload is the shape self-speculation exists for: a small pool
+    of prompts REPLAYED open-loop (seeded Poisson arrivals) against a
+    2-engine ``prefix_cache=True`` fleet — every replay's continuation
+    is already donated into the radix tree, so the draft source proposes
+    the exact tokens greedy decode will accept.  Two arms on the SAME
+    seeded plan and the same decode chunk: ``speculative=False`` vs
+    ``speculative=True`` (PrefixTreeDraft).  Headline is the tokens/s
+    speedup (ISSUE-16 bar: >= 1.3x); also reported: accepted tokens per
+    verify dispatch (bar: > 1.0), draft hit rate, p50/p99 TTFT and
+    end-to-end latency for both arms, and ``steady_state_compile_delta``
+    for both arms (the verify family must ride the warmed decode
+    ladder — the bar is 0).
+
+    Mid-run chaos: a seeded ``fleet.engine_crash.0`` fires on the spec
+    arm while verifies are in flight — the member quarantines, work
+    fails over, and the accounting must still balance (``lost == 0``).
+    """
+    jax = _setup_jax()
+    import jax.numpy as jnp
+    import numpy as np
+
+    from rl_tpu.compile import CompileDelta, ShapeBuckets
+    from rl_tpu.models import (
+        ContinuousBatchingEngine,
+        FinishedRequest,
+        ServiceSaturated,
+        ServingFleet,
+        TransformerConfig,
+        TransformerLM,
+    )
+    from rl_tpu.obs import MetricsRegistry
+    from rl_tpu.resilience import Fault, FaultInjector, injection
+
+    if _TIER == "smoke":
+        cfg = TransformerConfig(vocab_size=256, d_model=64, n_layers=2,
+                                n_heads=4, d_ff=128, max_seq_len=128,
+                                dtype=jnp.float32)
+        S, bucket, sys_len = 4, 32, 22
+        horizon_s, n_new, n_pool = 3.0, 64, 4
+    elif _TIER == "cpu":
+        cfg = TransformerConfig(vocab_size=1024, d_model=128, n_layers=2,
+                                n_heads=4, d_ff=512, max_seq_len=128,
+                                dtype=jnp.float32)
+        S, bucket, sys_len = 4, 32, 24
+        horizon_s, n_new, n_pool = 8.0, 80, 6
+    else:
+        cfg = TransformerConfig(vocab_size=32768, d_model=768, n_layers=12,
+                                n_heads=12, d_ff=3072, max_seq_len=256,
+                                dtype=jnp.bfloat16)
+        S, bucket, sys_len = 8, 128, 96
+        horizon_s, n_new, n_pool = 15.0, 128, 8
+    model = TransformerLM(cfg)
+    params = model.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    rng = np.random.default_rng(0)
+    sysp = rng.integers(0, cfg.vocab_size, sys_len)
+    # the replay pool: shared system prompt + short distinct suffixes;
+    # the SAME prompts recur, so every continuation is a resident donor
+    pool = [np.concatenate([sysp, rng.integers(0, cfg.vocab_size,
+                                               int(rng.integers(2, 8)))])
+            for _ in range(n_pool)]
+
+    def mk_prompt():
+        return pool[int(rng.integers(len(pool)))]
+
+    buckets = ShapeBuckets(prompt=(bucket,), suffix=(8, 16))
+    # 8x the live-slot footprint: headroom for the replay pool's donors
+    # (draft hits keep them LRU-hot; see PrefixTree.lookahead) plus the
+    # per-completion partial-tail churn of the oversaturated backlog
+    n_blocks = 8 * S * (cfg.max_seq_len // 16) + 1
+
+    def mk_engines(spec: bool):
+        return [
+            ContinuousBatchingEngine(
+                model, params, n_slots=S, block_size=16, n_blocks=n_blocks,
+                prompt_buckets=None, buckets=buckets, greedy=True,
+                decode_chunk=4, seed=i, prefix_cache=True,
+                speculative=spec, spec_lookahead=15,
+            )
+            for i in range(2)
+        ]
+
+    def glue(engines):
+        """aot_warmup + replayed traffic rounds until two CONSECUTIVE
+        rounds are compile-free (see bench_prefix.glue); the replays
+        also seed the radix tree so the measured window drafts hot."""
+        t0 = time.perf_counter()
+        for e in engines:
+            e.aot_warmup()
+        clean = 0
+        for _ in range(12):
+            with CompileDelta() as d:
+                for e in engines:
+                    for p in pool:
+                        e.submit(p, n_new)
+                    e.run()
+            clean = clean + 1 if (not d.supported or d.delta == 0) else 0
+            if clean >= 2:
+                break
+        return time.perf_counter() - t0
+
+    def run_arm(engines, plan, faults: bool):
+        pre_acc = sum(e.spec_accepted_tokens for e in engines)
+        pre_disp = sum(e.spec_dispatches for e in engines)
+        reg = MetricsRegistry()
+        fleet = ServingFleet(engines, registry=reg, probe_interval_s=0.02,
+                             max_queue=len(plan)).start()
+        inj = FaultInjector(
+            {"fleet.engine_crash.0": Fault("crash", at=(3,))} if faults
+            else {},
+            registry=reg)
+        admitted, rejected = [], 0
+        steady = CompileDelta()
+        t_start = time.monotonic()
+        try:
+            with steady, injection(inj):
+                for a, prompt, n_new in plan:
+                    now = time.monotonic() - t_start
+                    if a > now:
+                        time.sleep(a - now)
+                    try:
+                        admitted.append(fleet.submit(prompt, n_new))
+                    except ServiceSaturated:
+                        rejected += 1
+                results = fleet.wait(
+                    admitted, timeout=_T(smoke=120, cpu=300, full=300))
+        finally:
+            wall = time.monotonic() - t_start
+            acc = fleet.accounting()
+            stats = fleet.request_stats()
+            fleet.shutdown()
+        done = sum(1 for r in results.values()
+                   if isinstance(r, FinishedRequest))
+        tokens = sum(s["tokens"] for s in stats)
+        ttft = [s["first_token_at"] - s["submitted_at"] for s in stats
+                if s["first_token_at"] is not None]
+        lat = [s["done_at"] - s["submitted_at"] for s in stats
+               if s["done_at"] is not None]
+
+        def pct(xs, q):
+            return round(float(np.percentile(xs, q)), 4) if xs else None
+
+        disp = sum(e.spec_dispatches for e in engines) - pre_disp
+        accepted = sum(e.spec_accepted_tokens for e in engines) - pre_acc
+        snaps = [e.metrics_snapshot() for e in engines]
+        hits = sum(s.get("spec_draft_hits", 0) for s in snaps)
+        misses = sum(s.get("spec_draft_misses", 0) for s in snaps)
+        return {
+            "done": done, "rejected": rejected, "tokens": tokens,
+            "wall_s": round(wall, 2),
+            "tokens_per_s": round(tokens / max(1e-9, wall), 2),
+            "p50_ttft_s": pct(ttft, 50), "p99_ttft_s": pct(ttft, 99),
+            "p50_latency_s": pct(lat, 50), "p99_latency_s": pct(lat, 99),
+            "spec_dispatches": disp,
+            "accepted_tokens_per_dispatch": round(accepted / disp, 3)
+            if disp else None,
+            "spec_draft_hit_rate": round(hits / (hits + misses), 4)
+            if hits + misses else None,
+            "lost": acc["lost"],
+            "invariant_ok": bool(
+                acc["lost"] == 0
+                and acc["completed"] + acc["shed_post_admission"]
+                == len(admitted)),
+            "steady_state_compile_delta": steady.delta if steady.supported
+            else None,
+            "faults_fired": len(inj.fired),
+        }
+
+    off_eng = mk_engines(False)
+    compile_s = glue(off_eng)
+    # calibrate offered load off the vanilla arm (post-glue, warm), then
+    # OVERSATURATE it: both arms see the same backlogged plan, so each
+    # arm's tokens/s measures its service rate, not the arrival process
+    cal = [(mk_prompt(), n_new) for _ in range(2 * S)]
+    for p, n in cal:
+        off_eng[0].submit(p, n)
+    t0 = time.perf_counter()
+    off_eng[0].run()
+    lam = 2.0 * 2.0 * len(cal) / (time.perf_counter() - t0)
+    arrivals, t = [], 0.0
+    while t < horizon_s:
+        t += rng.exponential(1.0 / lam)
+        if t < horizon_s:
+            arrivals.append(t)
+    plan = [(a, mk_prompt(), n_new) for a in arrivals]
+    off = run_arm(off_eng, plan, faults=False)
+    spec_eng = mk_engines(True)
+    compile_s += glue(spec_eng)
+    spec = run_arm(spec_eng, plan, faults=True)
+
+    speedup = round(spec["tokens_per_s"] / max(1e-9, off["tokens_per_s"]), 3)
+    metrics = {
+        "spec_speedup_x": speedup,
+        "speedup_ok": bool(speedup >= 1.3),
+        "accepted_tokens_per_dispatch": spec["accepted_tokens_per_dispatch"],
+        "accept_ok": bool((spec["accepted_tokens_per_dispatch"] or 0) > 1.0),
+        "spec_draft_hit_rate": spec["spec_draft_hit_rate"],
+        "tokens_per_s_off": off["tokens_per_s"],
+        "tokens_per_s_spec": spec["tokens_per_s"],
+        "steady_state_compile_delta_off": off["steady_state_compile_delta"],
+        "steady_state_compile_delta_spec": spec["steady_state_compile_delta"],
+        "lost": spec["lost"],
+        "invariant_ok": bool(spec["invariant_ok"] and off["invariant_ok"]),
+        "faults_fired": spec["faults_fired"],
+    }
+    out = {
+        "metric": "spec_decode_speedup_x",
+        "value": speedup,
+        "unit": "x",
+        "vs_baseline": speedup,
+        **metrics,
+        "baseline": off,
+        "spec": spec,
+        "compile_s": round(compile_s, 2),
+        "n_slots": S, "n_engines": 2, "horizon_s": horizon_s,
+        "metrics": metrics,
+        "error": None,
+    }
+    out.update(_platform_tag(jax))
+    if report:
+        print(json.dumps(out), flush=True)
+    return out
+
+
 def _force_host_devices_flags(n: int) -> str:
     """XLA_FLAGS with the host-platform device count forced to ``n`` (any
     pre-existing force dropped). Only affects the cpu backend — on real
@@ -3342,8 +3568,8 @@ def bench_all():
 
     weights = {"ppo": 2.0, "rlhf": 1.4, "pixel": 1.2, "hopper": 1.0,
                "sac": 1.0, "per": 1.0, "async_collect": 0.8, "serve": 0.8,
-               "fleet": 0.8, "prefix": 0.8, "multichip": 0.8, "anakin": 0.8,
-               "compile": 0.8, "chaos": 0.6}
+               "fleet": 0.8, "prefix": 0.8, "spec": 0.8, "multichip": 0.8,
+               "anakin": 0.8, "compile": 0.8, "chaos": 0.6}
     deadline = _START + _TIMEOUT - 30.0  # safety margin for the final print
     pending = list(weights)
     results: dict = {}
@@ -3486,6 +3712,7 @@ if __name__ == "__main__":
             "chaos": bench_chaos,
             "fleet": bench_fleet,
             "prefix": bench_prefix,
+            "spec": bench_spec,
             "multichip": bench_multichip,
             "anakin": bench_anakin,
             "compile": bench_compile,

@@ -26,7 +26,7 @@ import tempfile
 import traceback
 from typing import Any, Callable, Iterable
 
-__all__ = ["AUDIT_TARGETS", "run_ir_audit"]
+__all__ = ["AUDIT_TARGETS", "check_spec_programs", "run_ir_audit"]
 
 
 def _build_serving() -> None:
@@ -48,6 +48,67 @@ def _build_serving() -> None:
     )
     eng.submit(np.arange(5) % 97, 4)
     eng.run()
+
+
+def check_spec_programs(registry: Any) -> None:
+    """The speculation-stays-compile-free gate: every slot-stream /
+    speculative program family the engine can ever register must ride
+    the EXISTING decode ladder — a verify or sdecode width outside
+    ``_ChunkTuner.LADDER``, or a spec-path program name outside the
+    known families, means the speculative path invented a new program
+    signature and broke the steady-state CompileDelta == 0 contract.
+    Raises ``RuntimeError`` (rlint --ir reports it and exits 1)."""
+    from ..models.serving import _ChunkTuner
+
+    ladder = set(_ChunkTuner.LADDER)
+    known = ("serving.sprefill.", "serving.spprefill.", "serving.sadmit_update")
+    for name in registry.names():
+        if name.startswith(("serving.verify.k", "serving.sdecode.k")):
+            k = name.rsplit("k", 1)[1]
+            if not k.isdigit() or int(k) not in ladder:
+                raise RuntimeError(
+                    f"speculative program {name!r} is off the decode ladder "
+                    f"{sorted(ladder)} — speculation must stay compile-free"
+                )
+        elif name.startswith("serving.s") and not name.startswith(known):
+            raise RuntimeError(
+                f"unknown speculative-path program family: {name!r} — new "
+                "signatures outside the warmed ladder break CompileDelta == 0"
+            )
+
+
+def _build_serving_spec() -> None:
+    """Speculative serving: prefix-cache engine with speculation on, the
+    same prompt served twice so the second pass drafts from the first's
+    donated continuation and dispatches a real ``serving.verify.k{K}``.
+    Ends with the ladder check so rlint --ir gates the compile-free
+    contract, not just the lowered IR."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..models import ContinuousBatchingEngine, TransformerConfig, TransformerLM
+    from .registry import get_program_registry
+
+    cfg = TransformerConfig(
+        vocab_size=97, d_model=32, n_layers=1, n_heads=2, d_ff=64,
+        max_seq_len=64, dtype=jnp.float32,
+    )
+    m = TransformerLM(cfg)
+    params = m.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    eng = ContinuousBatchingEngine(
+        m, params, n_slots=2, block_size=8, n_blocks=17,
+        prompt_buckets=(16,), greedy=True, prefix_cache=True,
+        speculative=True, spec_lookahead=3,
+    )
+    prompt = np.arange(5) % 97
+    eng.submit(prompt, 6)
+    eng.run()  # donates the continuation into the radix tree
+    eng.submit(prompt, 6)
+    eng.run()  # replay: drafts from the tree, dispatches a verify
+    if eng.spec_dispatches < 1:
+        raise RuntimeError("speculative audit build never dispatched a verify")
+    check_spec_programs(get_program_registry())
 
 
 def _build_anakin() -> None:
@@ -138,6 +199,7 @@ def _build_offpolicy() -> None:
 
 AUDIT_TARGETS: dict[str, Callable[[], None]] = {
     "serving": _build_serving,
+    "serving_spec": _build_serving_spec,
     "anakin": _build_anakin,
     "offpolicy": _build_offpolicy,
 }

@@ -113,6 +113,9 @@ class PrefixKVAllocator:
         self.tokens_computed = 0
         self.cow_copies = 0
         self.blocks_charged = 0
+        self.draft_hits = 0
+        self.draft_misses = 0
+        self.draft_tokens = 0
         self.evictions: dict = {}
         self._tracer = get_tracer()
 
@@ -296,6 +299,24 @@ class PrefixKVAllocator:
                 self._lent.discard(b)
                 self.free_blocks.append(b)
 
+    # -- speculative drafts ----------------------------------------------------
+
+    def draft(self, tokens, k: int) -> list:
+        """Up to ``k`` draft tokens continuing ``tokens`` from the tree
+        (the SGLang-style lookahead the speculative decoder verifies).
+        Read-only: no refs taken, no LRU touches, nothing allocated —
+        blocks the proposal came from may be evicted before the verify
+        dispatches, which is fine because the exactness gate makes a
+        stale draft merely unproductive, never wrong."""
+        with self._lock:
+            out = self.tree.lookahead(tuple(tokens), k)
+            if out:
+                self.draft_hits += 1
+                self.draft_tokens += len(out)
+            else:
+                self.draft_misses += 1
+            return out
+
     # -- capacity / probes -----------------------------------------------------
 
     def free_adjusted(self) -> int:
@@ -353,6 +374,9 @@ class PrefixKVAllocator:
                 "kv_reclaimable_blocks": self.tree.reclaimable,
                 "kv_cow_copies_total": self.cow_copies,
                 "kv_blocks_charged_total": self.blocks_charged,
+                "kv_draft_hits": self.draft_hits,
+                "kv_draft_misses": self.draft_misses,
+                "kv_draft_tokens": self.draft_tokens,
                 "kv_evictions": ev,
                 "kv_evictions_total": sum(ev.values()),
             }

@@ -9,6 +9,7 @@ from .serving import (
     ServingService,
 )
 from .serving import ServiceSaturated
+from .speculative import DraftSource, NGramDraft, PrefixTreeDraft
 from .fleet import ServingFleet, ShedRequest
 from .act import ACTConfig, ACTModel
 from .rssm import RSSM, DreamerModelLoss, RSSMConfig, dreamer_lambda_returns
@@ -51,6 +52,9 @@ __all__ = [
     "RemoteEngine",
     "FinishedRequest",
     "Request",
+    "DraftSource",
+    "NGramDraft",
+    "PrefixTreeDraft",
     "GenerateOutput",
     "RSSM",
     "RSSMConfig",

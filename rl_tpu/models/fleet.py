@@ -141,6 +141,10 @@ class _Member:
         self.quarantines = 0  # lifetime count -> re-admission backoff exponent
         self.readmit_at = 0.0
         self.lat_ema: float | None = None  # per-request completion latency
+        # accepted tokens per decode dispatch (speculative members report
+        # their verify-accept EMA; 1.0 — one token per dispatch — for
+        # legacy members, so mixed fleets score on one scale)
+        self.spec_ema = 1.0
         self.child = None  # Supervisor child
 
 
@@ -742,6 +746,7 @@ class ServingFleet:
                 self._c_completed.inc()
                 lat = now - tr.submitted_at
                 m.lat_ema = lat if m.lat_ema is None else 0.7 * m.lat_ema + 0.3 * lat
+                m.spec_ema = float(getattr(m.engine, "spec_accept_ema", 1.0))
                 self._slo_latency.record(lat)
                 self._slo_avail.record_event(True)
                 if tr.ctx is not None:
@@ -931,13 +936,16 @@ class ServingFleet:
             except ServiceSaturated:
                 return None
         # interactive: tail-latency-aware — expected wait is queue depth
-        # times this member's recent per-request latency, plus KV pressure
+        # times this member's recent per-request latency, discounted by
+        # its speculative accept rate (a member accepting 3 tokens per
+        # dispatch clears its queue ~3x faster than its latency EMA alone
+        # suggests while the EMA catches up), plus KV pressure
         fallback = max((m.lat_ema for m in cands if m.lat_ema is not None),
                        default=1.0)
 
         def score(m: _Member) -> float:
             lat = m.lat_ema if m.lat_ema is not None else fallback
-            return ((m.engine.pending() + 1) * lat
+            return ((m.engine.pending() + 1) * lat / max(m.spec_ema, 1e-3)
                     + self._lb._kv_utilization(m.engine))
 
         return min(cands, key=score)

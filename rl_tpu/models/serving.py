@@ -57,6 +57,14 @@ from ..compile import ShapeBuckets, get_program_registry
 from ..kvmem import DEFER_ROUND, PrefixKVAllocator
 from ..obs.device import DeviceMetrics
 from ..obs.trace import ctx_args, current_context, get_tracer
+from .speculative import (
+    DraftSource,
+    NGramDraft,
+    PrefixTreeDraft,
+    sample_tokens,
+    slot_keys,
+    spec_keys,
+)
 
 __all__ = [
     "ContinuousBatchingEngine",
@@ -99,6 +107,10 @@ class _InFlight:
     chunk: int
     fresh_compile: bool  # first launch at this K: exclude from tuning
     dispatch_s: float  # host wall spent dispatching (tuner input)
+    # speculative verify dispatches carry the drafts they proposed so the
+    # host drain can re-derive the device's chain-acceptance rule exactly
+    kind: str = "decode"  # "decode" | "verify"
+    draft: np.ndarray | None = None  # [S, K-1] proposed tokens (verify only)
 
 
 def _bucket(n: int, buckets) -> int:
@@ -201,6 +213,26 @@ class ContinuousBatchingEngine:
             for sampled decoding the RNG stream differs from the
             non-cached engine (different program shapes), not the
             distribution. See ``docs/kv_prefix.md``.
+        speculative: enable speculative decoding — draft up to
+            ``spec_lookahead`` tokens per slot from ``draft_source`` and
+            verify them all in ONE dispatch (``serving.verify.k{K}``,
+            same K-ladder as decode, AOT-warmed: steady-state
+            CompileDelta stays 0). Acceptance is exact equality against
+            what sequential decode would have sampled, so output is
+            BIT-IDENTICAL to ``slot_rng=True`` vanilla decode from the
+            same seed (greedy and temperature alike). Implies
+            ``slot_rng=True``. See ``docs/speculative.md``.
+        slot_rng: sample with per-request streams — response token n of
+            request rid keys ``fold_in(fold_in(key(seed), rid), n)`` —
+            instead of the legacy split-per-dispatch engine stream.
+            Schedule-invariant: the sampled sequence depends only on
+            (seed, rid), not batch composition or chunk sizes. Off by
+            default; the legacy stream is byte-for-byte unchanged.
+        spec_lookahead: max drafted tokens verified per dispatch.
+        draft_source: ``"prefix_tree"`` (the kvmem radix tree; requires
+            ``prefix_cache=True``), ``"ngram"`` (host prompt-lookup), a
+            :class:`~rl_tpu.models.speculative.DraftSource` instance, or
+            None to pick the best available.
     """
 
     def __init__(
@@ -223,6 +255,10 @@ class ContinuousBatchingEngine:
         registry: Any = None,
         warmup: bool | str = False,
         prefix_cache: bool = False,
+        speculative: bool = False,
+        slot_rng: bool = False,
+        spec_lookahead: int = 7,
+        draft_source: Any = None,
     ):
         # placement is applied by the params setter, so it must exist
         # before the first assignment below
@@ -245,6 +281,17 @@ class ContinuousBatchingEngine:
             self._fixed_chunk = max(1, int(decode_chunk))
             self._tuner = None
         self._key = jax.random.key(seed)
+        # per-request RNG streams (speculation requires them; opt-in
+        # without speculation via slot_rng=True): token n of request rid
+        # samples with fold_in(fold_in(base, rid), n), a stream invariant
+        # to batch composition, chunk size, and accept/reject history —
+        # the property that makes speculative output bit-identical to
+        # vanilla slot-stream decode. The legacy split-per-dispatch
+        # stream (self._key) stays byte-for-byte untouched when off.
+        self.speculative = bool(speculative)
+        self.slot_rng = bool(slot_rng or speculative)
+        self.spec_lookahead = int(spec_lookahead)
+        self._base_key = jax.random.key(seed)
 
         self.cache = model.init_paged_cache(
             n_slots, n_blocks, block_size, self.max_blocks
@@ -280,6 +327,11 @@ class ContinuousBatchingEngine:
         self.dev_active = jnp.zeros(n_slots, bool)
         self.dev_budget = jnp.zeros(n_slots, jnp.int32)
         self.dev_last = jnp.zeros(n_slots, jnp.int32)
+        # slot-stream RNG state (slot_rng mode): the request id occupying
+        # each slot and how many response tokens it has sampled so far —
+        # together they derive every sampling key ON DEVICE
+        self.dev_rid = jnp.full(n_slots, -1, jnp.int32)
+        self.dev_ntok = jnp.zeros(n_slots, jnp.int32)
         self._dev_all_slots = jnp.ones(n_slots, bool)
         self._pending_table_writes: list[tuple[int, int, int]] = []
         self._inflight: collections.deque[_InFlight] = collections.deque()
@@ -299,6 +351,16 @@ class ContinuousBatchingEngine:
         self.decode_chunk_last = 1
         self.admissions = 0
         self.completions: dict[str, int] = {"eos": 0, "length": 0}
+        # speculative accounting: dispatches that carried drafts, tokens
+        # proposed/accepted, and the accept-rate EMA the fleet's lane
+        # router reads (accepted tokens PER verify dispatch, >= 1.0 when
+        # speculation is winning)
+        self.spec_dispatches = 0
+        self.spec_draft_tokens = 0
+        self.spec_accepted_tokens = 0
+        self.spec_accept_ema = 1.0
+        self._spec_accept_counts: dict[int, int] = {}  # n_emit -> dispatches
+        self._slot_ctx: dict[int, Any] = {}  # rid -> trace ctx (spec spans)
         self._n_pool_blocks = n_blocks - 1
         # on-device token accounting: the decode scan counts every token
         # generated by an effectively-active slot, so throughput telemetry
@@ -321,6 +383,13 @@ class ContinuousBatchingEngine:
         self._prefills: dict[tuple, Any] = {}  # (A, bucket) -> CachedProgram
         self._pprefills: dict[tuple, Any] = {}  # (A, suffix bucket) -> prog
         self._cow_progs: dict[int, Any] = {}  # padded pair count -> prog
+        # slot-stream variants (slot_rng mode): same ladder rungs, keys
+        # derived in-program from (base_key, rid, ntok) instead of a host
+        # split per dispatch
+        self._sdecode_progs: dict[int, Any] = {}  # chunk K -> prog
+        self._verify_progs: dict[int, Any] = {}  # verify width K -> prog
+        self._sprefills: dict[tuple, Any] = {}
+        self._spprefills: dict[tuple, Any] = {}
         # every serving program is replica-local by design (the engine
         # parallelizes by running whole replicas); the IR auditor (R103)
         # holds them to it — a collective appearing in a lowered serving
@@ -330,6 +399,34 @@ class ContinuousBatchingEngine:
             "serving.admit_update", _admit_update_fn,
             ir_contract=self._ir_contract,
         )
+        self._sadmit_update = (
+            self._registry.register(
+                "serving.sadmit_update", _sadmit_update_fn,
+                ir_contract=self._ir_contract,
+            )
+            if self.slot_rng
+            else None
+        )
+        # draft source: explicit instance > named source > best available
+        # (the prefix tree already holds every served continuation when
+        # prefix_cache is on; host n-gram prompt-lookup otherwise)
+        self._draft_source: Any = None
+        if self.speculative:
+            if draft_source is None:
+                draft_source = "prefix_tree" if self._kvmem is not None else "ngram"
+            if draft_source == "prefix_tree":
+                if self._kvmem is None:
+                    raise ValueError(
+                        "draft_source='prefix_tree' needs prefix_cache=True "
+                        "(the radix tree IS the draft index)"
+                    )
+                self._draft_source = PrefixTreeDraft(self._kvmem)
+            elif draft_source == "ngram":
+                self._draft_source = NGramDraft()
+            elif isinstance(draft_source, DraftSource):
+                self._draft_source = draft_source
+            else:
+                raise ValueError(f"unknown draft_source: {draft_source!r}")
         # warmup=True builds the whole ladder before __init__ returns;
         # "background" overlaps it with the caller's remaining setup
         self._warmup_handle = None
@@ -539,15 +636,241 @@ class ContinuousBatchingEngine:
         return self._get_cow_prog(n)(pools, src, dst)
 
     def _sample(self, logits, key):
-        """(token, behavior log-prob of that token) per row."""
-        t = jnp.maximum(jnp.asarray(self.temperature, jnp.float32), 1e-6)
-        lps = jax.nn.log_softmax(logits.astype(jnp.float32) / t, axis=-1)
-        if self.greedy:
-            tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        else:
-            tok = jax.random.categorical(key, lps).astype(jnp.int32)
-        lp = jnp.take_along_axis(lps, tok[:, None], axis=-1)[:, 0]
-        return tok, lp
+        """(token, behavior log-prob of that token) per row — ONE source
+        of truth for the temperature clamp + greedy branch, shared by
+        prefill, decode, and the speculative verify
+        (:func:`rl_tpu.models.speculative.sample_tokens`)."""
+        return sample_tokens(
+            logits, key, temperature=self.temperature, greedy=self.greedy
+        )
+
+    # -- slot-stream programs (slot_rng / speculative mode) --------------------
+    #
+    # Same ladder rungs as the legacy families, but every sampling key is
+    # derived IN-PROGRAM from (base_key, rid, ntok) — response token n of
+    # request rid always keys fold_in(fold_in(base, rid), n), whatever
+    # batch, chunk size, or speculative accept history produced it. That
+    # schedule invariance is what lets the verify program reproduce
+    # sequential decode bit-for-bit.
+
+    def _sprefill_fn(self, params, pools, table_rows, tokens, token_mask, rids, base_key):
+        """Compact bucketed prefill, slot-stream RNG: row i samples its
+        FIRST response token (index 0 of rid's stream)."""
+        A = tokens.shape[0]
+        cache = [
+            {
+                "pool_k": pk,
+                "pool_v": pv,
+                "block_table": table_rows,
+                "len": jnp.zeros((A,), jnp.int32),
+                "active": token_mask,
+            }
+            for pk, pv in pools
+        ]
+        logits, cache = self.model.apply({"params": params}, tokens, cache=cache)
+        last = jnp.maximum(token_mask.sum(axis=1) - 1, 0)  # [A]
+        last_logits = jnp.take_along_axis(
+            logits, last[:, None, None], axis=1
+        )[:, 0]
+        keys = slot_keys(base_key, rids, jnp.zeros_like(rids))
+        tok, lp = self._sample(last_logits, keys)
+        new_pools = tuple((c["pool_k"], c["pool_v"]) for c in cache)
+        return tok, lp, new_pools
+
+    def _get_sprefill_prog(self, a: int, bucket: int):
+        prog = self._sprefills.get((a, bucket))
+        if prog is None:
+            prog = self._sprefills[(a, bucket)] = self._registry.register(
+                f"serving.sprefill.a{a}.b{bucket}",
+                self._sprefill_fn,
+                fingerprint=self._fingerprint,
+                ir_contract=self._ir_contract,
+            )
+        return prog
+
+    def _spprefill_fn(self, params, pools, table_rows, tokens, token_mask, start, rids, base_key):
+        """Partial bucketed prefill (prefix-cache hits), slot-stream RNG."""
+        cache = [
+            {
+                "pool_k": pk,
+                "pool_v": pv,
+                "block_table": table_rows,
+                "len": start,
+                "active": token_mask,
+            }
+            for pk, pv in pools
+        ]
+        logits, cache = self.model.apply({"params": params}, tokens, cache=cache)
+        last = jnp.maximum(token_mask.sum(axis=1) - 1, 0)  # [A], suffix-local
+        last_logits = jnp.take_along_axis(
+            logits, last[:, None, None], axis=1
+        )[:, 0]
+        keys = slot_keys(base_key, rids, jnp.zeros_like(rids))
+        tok, lp = self._sample(last_logits, keys)
+        new_pools = tuple((c["pool_k"], c["pool_v"]) for c in cache)
+        return tok, lp, new_pools
+
+    def _get_spprefill_prog(self, a: int, bucket: int):
+        prog = self._spprefills.get((a, bucket))
+        if prog is None:
+            prog = self._spprefills[(a, bucket)] = self._registry.register(
+                f"serving.spprefill.a{a}.s{bucket}",
+                self._spprefill_fn,
+                fingerprint=self._fingerprint,
+                ir_contract=self._ir_contract,
+            )
+        return prog
+
+    def _get_sdecode_prog(self, chunk: int):
+        prog = self._sdecode_progs.get(chunk)
+        if prog is not None:
+            return prog
+
+        eos = self.eos_id
+        obs_spec = self._obs_spec
+
+        def fn(params, pools, table, lens, active, budget, last, run_mask,
+               rids, ntok, base_key, dm):
+            """The decode scan with slot-stream keys: step j of this chunk
+            samples slot s with key (rids[s], ntok[s] + emitted so far).
+            Carries ``ntok`` so the stream survives chunk boundaries and
+            speculative interleaving."""
+
+            def body(carry, _):
+                pools, lens, active, budget, last, ntok, dm = carry
+                eff = active & run_mask
+                dm = obs_spec.inc(dm, "tokens", eff.sum().astype(jnp.float32))
+                cache = [
+                    {
+                        "pool_k": pk,
+                        "pool_v": pv,
+                        "block_table": table,
+                        "len": lens,
+                        "active": eff,
+                    }
+                    for pk, pv in pools
+                ]
+                logits, cache = self.model.apply(
+                    {"params": params}, last[:, None], cache=cache
+                )
+                keys = slot_keys(base_key, rids, ntok)
+                tok, lp = self._sample(logits[:, 0], keys)
+                new_pools = tuple((c["pool_k"], c["pool_v"]) for c in cache)
+                lens = cache[0]["len"]
+                ntok = ntok + eff.astype(ntok.dtype)
+                budget = budget - eff.astype(budget.dtype)
+                stop = budget <= 0
+                if eos is not None:
+                    stop = stop | (tok == eos)
+                active = active & ~(stop & eff)
+                last = jnp.where(eff, tok, last)
+                return (new_pools, lens, active, budget, last, ntok, dm), (tok, lp)
+
+            carry = (tuple(pools), lens, active, budget, last, ntok, dm)
+            (pools, lens, active, budget, last, ntok, dm), (toks, lps) = jax.lax.scan(
+                body, carry, None, length=chunk
+            )
+            return (
+                jnp.moveaxis(toks, 0, 1),
+                jnp.moveaxis(lps, 0, 1),
+                pools,
+                lens,
+                active,
+                budget,
+                last,
+                ntok,
+                dm,
+            )
+
+        prog = self._sdecode_progs[chunk] = self._registry.register(
+            f"serving.sdecode.k{chunk}", fn, fingerprint=self._fingerprint,
+            ir_contract=self._ir_contract,
+        )
+        return prog
+
+    def _get_verify_prog(self, k: int):
+        """The speculative verify: score a chunk of K positions — the
+        true last token plus K-1 drafted continuations — in ONE parallel
+        forward, then accept the longest prefix of drafts that matches
+        what sequential decode would have sampled (chain acceptance).
+        Position j samples with the key token index ntok+j would use, so
+        every accepted token is bit-identical to vanilla slot-stream
+        decode; the first rejected position's sample is itself the
+        corrected (vanilla) token, so a dispatch always advances >= 1."""
+        prog = self._verify_progs.get(k)
+        if prog is not None:
+            return prog
+
+        eos = self.eos_id
+        obs_spec = self._obs_spec
+        msl = self.max_seq_len
+        K = int(k)
+
+        def fn(params, pools, table, lens, active, budget, last, run_mask,
+               drafts, rids, ntok, base_key, dm):
+            S = lens.shape[0]
+            eff = active & run_mask
+            x = jnp.concatenate([last[:, None], drafts], axis=1)  # [S, K]
+            # clamp KV writes inside the slot's allocated room: emitted
+            # tokens never exceed budget (< n_room), so every accepted
+            # position was really written and really attended
+            n_room = jnp.minimum(jnp.minimum(budget + 1, msl - lens), K)
+            posmask = (jnp.arange(K)[None, :] < n_room[:, None]) & eff[:, None]
+            cache = [
+                {
+                    "pool_k": pk,
+                    "pool_v": pv,
+                    "block_table": table,
+                    "len": lens,
+                    "active": posmask,
+                }
+                for pk, pv in pools
+            ]
+            logits, cache = self.model.apply({"params": params}, x, cache=cache)
+            keys = spec_keys(base_key, rids, ntok, K)  # [S, K]
+            tok, lp = self._sample(
+                logits.reshape(S * K, -1), keys.reshape(S * K)
+            )
+            tok, lp = tok.reshape(S, K), lp.reshape(S, K)
+            # chain acceptance: position j's sample is the vanilla token
+            # iff drafts 1..j each equalled the sample before them
+            good = (drafts == tok[:, : K - 1]).astype(jnp.int32)  # [S, K-1]
+            chain = 1 + jnp.cumprod(good, axis=1).sum(axis=1)  # [S]
+            if eos is None:
+                eos_pos = jnp.full((S,), K, jnp.int32)
+            else:
+                is_eos = tok == eos
+                eos_pos = jnp.where(
+                    is_eos.any(axis=1), jnp.argmax(is_eos, axis=1), K
+                ).astype(jnp.int32)
+            n_emit = jnp.minimum(
+                jnp.minimum(chain.astype(jnp.int32), eos_pos + 1),
+                budget,
+            )
+            n_emit = jnp.where(eff, n_emit, 0)
+            dm = obs_spec.inc(dm, "tokens", n_emit.sum().astype(jnp.float32))
+            lens = lens + n_emit
+            ntok = ntok + n_emit
+            budget = budget - n_emit
+            stop = budget <= 0
+            if eos is not None:
+                stop = stop | (eos_pos < n_emit)
+            active = active & ~(stop & eff)
+            idx = jnp.maximum(n_emit - 1, 0)
+            last = jnp.where(
+                eff & (n_emit > 0),
+                jnp.take_along_axis(tok, idx[:, None], axis=1)[:, 0],
+                last,
+            )
+            return tok, lp, tuple(
+                (c["pool_k"], c["pool_v"]) for c in cache
+            ), lens, active, budget, last, ntok, dm
+
+        prog = self._verify_progs[k] = self._registry.register(
+            f"serving.verify.k{K}", fn, fingerprint=self._fingerprint,
+            ir_contract=self._ir_contract,
+        )
+        return prog
 
     # -- allocator -------------------------------------------------------------
 
@@ -598,6 +921,7 @@ class ContinuousBatchingEngine:
     def _free_slot(self, slot: int, reason: str):
         self.completions[reason] = self.completions.get(reason, 0) + 1
         rid = int(self.slot_rid[slot])
+        self._slot_ctx.pop(rid, None)
         chunks = self.slot_tokens[slot]
         self.finished.append(
             FinishedRequest(
@@ -696,12 +1020,38 @@ class ContinuousBatchingEngine:
                 else _ChunkTuner.LADDER
             )
         for chunk in decode_chunks:
-            prog = self._get_decode_prog(int(chunk))
-            prog.add_signature(
-                params_abs, pools_abs, table_abs, vec_i32, vec_bool,
-                vec_i32, vec_i32, vec_bool, key_abs, dm_abs,
-            )
+            if self.slot_rng:
+                prog = self._get_sdecode_prog(int(chunk))
+                prog.add_signature(
+                    params_abs, pools_abs, table_abs, vec_i32, vec_bool,
+                    vec_i32, vec_i32, vec_bool, vec_i32, vec_i32, key_abs,
+                    dm_abs,
+                )
+            else:
+                prog = self._get_decode_prog(int(chunk))
+                prog.add_signature(
+                    params_abs, pools_abs, table_abs, vec_i32, vec_bool,
+                    vec_i32, vec_i32, vec_bool, key_abs, dm_abs,
+                )
             progs.append(prog)
+        if self.speculative:
+            # verify rungs ride the SAME K-ladder as decode chunks: every
+            # width speculation can ever dispatch is warmed here, so the
+            # steady-state CompileDelta is 0 by construction
+            k_max = _pow2ceil(
+                min(self.spec_lookahead, _ChunkTuner.LADDER[-1] - 1) + 1
+            )
+            for k in _ChunkTuner.LADDER:
+                if k < 2 or k > k_max:
+                    continue
+                prog = self._get_verify_prog(k)
+                prog.add_signature(
+                    params_abs, pools_abs, table_abs, vec_i32, vec_bool,
+                    vec_i32, vec_i32, vec_bool,
+                    jax.ShapeDtypeStruct((S, k - 1), jnp.int32),
+                    vec_i32, vec_i32, key_abs, dm_abs,
+                )
+                progs.append(prog)
         if admit_sizes is None:
             admit_sizes = self.shape_buckets.admit_sizes(S)
         if prompt_buckets is None:
@@ -714,15 +1064,27 @@ class ContinuousBatchingEngine:
             for a in admit_sizes:
                 for b in prompt_buckets:
                     a, b = int(a), int(b)
-                    prog = self._get_prefill_prog(a, b)
-                    prog.add_signature(
-                        params_abs,
-                        pools_abs,
-                        jax.ShapeDtypeStruct((a, self.max_blocks), jnp.int32),
-                        jax.ShapeDtypeStruct((a, b), jnp.int32),
-                        jax.ShapeDtypeStruct((a, b), jnp.bool_),
-                        key_abs,
-                    )
+                    if self.slot_rng:
+                        prog = self._get_sprefill_prog(a, b)
+                        prog.add_signature(
+                            params_abs,
+                            pools_abs,
+                            jax.ShapeDtypeStruct((a, self.max_blocks), jnp.int32),
+                            jax.ShapeDtypeStruct((a, b), jnp.int32),
+                            jax.ShapeDtypeStruct((a, b), jnp.bool_),
+                            jax.ShapeDtypeStruct((a,), jnp.int32),
+                            key_abs,
+                        )
+                    else:
+                        prog = self._get_prefill_prog(a, b)
+                        prog.add_signature(
+                            params_abs,
+                            pools_abs,
+                            jax.ShapeDtypeStruct((a, self.max_blocks), jnp.int32),
+                            jax.ShapeDtypeStruct((a, b), jnp.int32),
+                            jax.ShapeDtypeStruct((a, b), jnp.bool_),
+                            key_abs,
+                        )
                     progs.append(prog)
         else:
             # prefix mode dispatches partial prefills bucketed on SUFFIX
@@ -731,16 +1093,29 @@ class ContinuousBatchingEngine:
             for a in admit_sizes:
                 for b in prompt_buckets:
                     a, b = int(a), int(b)
-                    prog = self._get_pprefill_prog(a, b)
-                    prog.add_signature(
-                        params_abs,
-                        pools_abs,
-                        jax.ShapeDtypeStruct((a, self.max_blocks), jnp.int32),
-                        jax.ShapeDtypeStruct((a, b), jnp.int32),
-                        jax.ShapeDtypeStruct((a, b), jnp.bool_),
-                        jax.ShapeDtypeStruct((a,), jnp.int32),
-                        key_abs,
-                    )
+                    if self.slot_rng:
+                        prog = self._get_spprefill_prog(a, b)
+                        prog.add_signature(
+                            params_abs,
+                            pools_abs,
+                            jax.ShapeDtypeStruct((a, self.max_blocks), jnp.int32),
+                            jax.ShapeDtypeStruct((a, b), jnp.int32),
+                            jax.ShapeDtypeStruct((a, b), jnp.bool_),
+                            jax.ShapeDtypeStruct((a,), jnp.int32),
+                            jax.ShapeDtypeStruct((a,), jnp.int32),
+                            key_abs,
+                        )
+                    else:
+                        prog = self._get_pprefill_prog(a, b)
+                        prog.add_signature(
+                            params_abs,
+                            pools_abs,
+                            jax.ShapeDtypeStruct((a, self.max_blocks), jnp.int32),
+                            jax.ShapeDtypeStruct((a, b), jnp.int32),
+                            jax.ShapeDtypeStruct((a, b), jnp.bool_),
+                            jax.ShapeDtypeStruct((a,), jnp.int32),
+                            key_abs,
+                        )
                     progs.append(prog)
             n = 1
             while n <= _pow2ceil(S):
@@ -752,11 +1127,18 @@ class ContinuousBatchingEngine:
                 )
                 progs.append(prog)
                 n *= 2
-        self._admit_update.add_signature(
-            vec_i32, vec_bool, vec_i32, vec_i32,
-            vec_bool, vec_i32, vec_i32, vec_i32,
-        )
-        progs.append(self._admit_update)
+        if self.slot_rng:
+            self._sadmit_update.add_signature(
+                vec_i32, vec_bool, vec_i32, vec_i32, vec_i32, vec_i32,
+                vec_bool, vec_i32, vec_i32, vec_i32, vec_i32,
+            )
+            progs.append(self._sadmit_update)
+        else:
+            self._admit_update.add_signature(
+                vec_i32, vec_bool, vec_i32, vec_i32,
+                vec_bool, vec_i32, vec_i32, vec_i32,
+            )
+            progs.append(self._admit_update)
         return self._registry.aot_warmup(programs=progs, background=background)
 
     def metrics_snapshot(self) -> dict:
@@ -786,6 +1168,19 @@ class ContinuousBatchingEngine:
         }
         snap["prefill_tokens_computed"] = self.prefill_tokens_computed
         snap["prefill_tokens_cached"] = self.prefill_tokens_cached
+        if self.speculative:
+            snap["spec_dispatches"] = self.spec_dispatches
+            snap["spec_draft_tokens"] = self.spec_draft_tokens
+            snap["spec_accepted_tokens"] = self.spec_accepted_tokens
+            snap["spec_accept_ema"] = self.spec_accept_ema
+            snap["spec_accepted_per_dispatch"] = (
+                self.spec_accepted_tokens / self.spec_dispatches
+                if self.spec_dispatches
+                else 0.0
+            )
+            snap["spec_accept_counts"] = dict(self._spec_accept_counts)
+            for k, v in self._draft_source.stats().items():
+                snap[f"spec_draft_{k}"] = v
         if self._kvmem is not None:
             snap.update(self._kvmem.stats())
             # sharing-adjusted: resident blocks no live sequence references
@@ -919,23 +1314,42 @@ class ContinuousBatchingEngine:
         slots = np.zeros(pad_a, np.int64)
         slots[:A] = [s for s, _ in batch]
         self._flush_table_writes()  # prefill reads the new rows on device
-        self._key, k = jax.random.split(self._key)
+        if not self.slot_rng:
+            # the legacy engine stream splits here; slot-stream mode
+            # derives keys in-program from (base_key, rid, 0) instead and
+            # must leave this stream byte-for-byte untouched
+            self._key, k = jax.random.split(self._key)
+        rid_v = np.full(pad_a, -1, np.int32)
+        rid_v[:A] = [req.rid for _, req in batch]
         pools = tuple((layer["pool_k"], layer["pool_v"]) for layer in self.cache)
         if self._kvmem is not None:
             if cows:
                 pools = self._dispatch_cow(pools, cows)
             start_v = np.zeros(pad_a, np.int32)
             start_v[:A] = starts
-            fn = self._get_pprefill_prog(pad_a, bucket)
-            tok, lp, new_pools = fn(
-                self.params,
-                pools,
-                self.dev_table[jnp.asarray(slots)],
-                jnp.asarray(tokens),
-                jnp.asarray(mask),
-                jnp.asarray(start_v),
-                k,
-            )
+            if self.slot_rng:
+                fn = self._get_spprefill_prog(pad_a, bucket)
+                tok, lp, new_pools = fn(
+                    self.params,
+                    pools,
+                    self.dev_table[jnp.asarray(slots)],
+                    jnp.asarray(tokens),
+                    jnp.asarray(mask),
+                    jnp.asarray(start_v),
+                    jnp.asarray(rid_v),
+                    self._base_key,
+                )
+            else:
+                fn = self._get_pprefill_prog(pad_a, bucket)
+                tok, lp, new_pools = fn(
+                    self.params,
+                    pools,
+                    self.dev_table[jnp.asarray(slots)],
+                    jnp.asarray(tokens),
+                    jnp.asarray(mask),
+                    jnp.asarray(start_v),
+                    k,
+                )
             # the round's published blocks are now behind a dispatched
             # prefill: safe for next round's admissions to share
             self._kvmem.end_round()
@@ -944,15 +1358,27 @@ class ContinuousBatchingEngine:
             )
             self.prefill_tokens_cached += sum(starts)
         else:
-            fn = self._get_prefill_prog(pad_a, bucket)
-            tok, lp, new_pools = fn(
-                self.params,
-                pools,
-                self.dev_table[jnp.asarray(slots)],
-                jnp.asarray(tokens),
-                jnp.asarray(mask),
-                k,
-            )
+            if self.slot_rng:
+                fn = self._get_sprefill_prog(pad_a, bucket)
+                tok, lp, new_pools = fn(
+                    self.params,
+                    pools,
+                    self.dev_table[jnp.asarray(slots)],
+                    jnp.asarray(tokens),
+                    jnp.asarray(mask),
+                    jnp.asarray(rid_v),
+                    self._base_key,
+                )
+            else:
+                fn = self._get_prefill_prog(pad_a, bucket)
+                tok, lp, new_pools = fn(
+                    self.params,
+                    pools,
+                    self.dev_table[jnp.asarray(slots)],
+                    jnp.asarray(tokens),
+                    jnp.asarray(mask),
+                    k,
+                )
             self.prefill_tokens_computed += sum(len(r.prompt) for _, r in batch)
         for layer, (pk, pv) in zip(self.cache, new_pools):
             layer["pool_k"], layer["pool_v"] = pk, pv
@@ -963,6 +1389,7 @@ class ContinuousBatchingEngine:
         new_lens = np.zeros(self.n_slots, np.int32)
         new_budget = np.zeros(self.n_slots, np.int32)
         new_last = np.zeros(self.n_slots, np.int32)
+        new_rid = np.zeros(self.n_slots, np.int32)
         for i, (s, req) in enumerate(batch):
             P = len(req.prompt)
             t0, l0 = int(tok_host[i]), float(lp_host[i])
@@ -973,6 +1400,8 @@ class ContinuousBatchingEngine:
             b = req.max_new_tokens - 1  # prefill emitted the first token
             self.slot_budget[s] = b
             self.sched_budget[s] = b
+            if self.speculative:
+                self._slot_ctx[req.rid] = req.ctx
             if self.eos_id is not None and t0 == self.eos_id:
                 self._free_slot(s, "eos")
             elif b <= 0:
@@ -980,6 +1409,7 @@ class ContinuousBatchingEngine:
             else:
                 surv[s] = True
                 new_lens[s], new_budget[s], new_last[s] = P, b, t0
+                new_rid[s] = req.rid
         if self.on_admit is not None:
             for _s, req in batch:
                 self.on_admit(req.rid)
@@ -996,21 +1426,43 @@ class ContinuousBatchingEngine:
                          **ctx_args(req.ctx.child())},
                     )
         if surv.any():
-            (
-                self.dev_lens,
-                self.dev_active,
-                self.dev_budget,
-                self.dev_last,
-            ) = self._admit_update(
-                self.dev_lens,
-                self.dev_active,
-                self.dev_budget,
-                self.dev_last,
-                jnp.asarray(surv),
-                jnp.asarray(new_lens),
-                jnp.asarray(new_budget),
-                jnp.asarray(new_last),
-            )
+            if self.slot_rng:
+                (
+                    self.dev_lens,
+                    self.dev_active,
+                    self.dev_budget,
+                    self.dev_last,
+                    self.dev_rid,
+                    self.dev_ntok,
+                ) = self._sadmit_update(
+                    self.dev_lens,
+                    self.dev_active,
+                    self.dev_budget,
+                    self.dev_last,
+                    self.dev_rid,
+                    self.dev_ntok,
+                    jnp.asarray(surv),
+                    jnp.asarray(new_lens),
+                    jnp.asarray(new_budget),
+                    jnp.asarray(new_last),
+                    jnp.asarray(new_rid),
+                )
+            else:
+                (
+                    self.dev_lens,
+                    self.dev_active,
+                    self.dev_budget,
+                    self.dev_last,
+                ) = self._admit_update(
+                    self.dev_lens,
+                    self.dev_active,
+                    self.dev_budget,
+                    self.dev_last,
+                    jnp.asarray(surv),
+                    jnp.asarray(new_lens),
+                    jnp.asarray(new_budget),
+                    jnp.asarray(new_last),
+                )
 
     # -- the de-synced decode loop ---------------------------------------------
 
@@ -1077,33 +1529,62 @@ class ContinuousBatchingEngine:
                 )
             break
         self._flush_table_writes()
-        fresh = chunk not in self._decode_progs
-        prog = self._get_decode_prog(chunk)
         run_dev = self._dev_all_slots if run.all() else jnp.asarray(run)
-        self._key, k = jax.random.split(self._key)
         pools = tuple((layer["pool_k"], layer["pool_v"]) for layer in self.cache)
-        t0 = time.perf_counter()
-        (
-            toks,
-            lps,
-            new_pools,
-            self.dev_lens,
-            self.dev_active,
-            self.dev_budget,
-            self.dev_last,
-            self.dev_obs,
-        ) = prog(
-            self.params,
-            pools,
-            self.dev_table,
-            self.dev_lens,
-            self.dev_active,
-            self.dev_budget,
-            self.dev_last,
-            run_dev,
-            k,
-            self.dev_obs,
-        )
+        if self.slot_rng:
+            fresh = chunk not in self._sdecode_progs
+            prog = self._get_sdecode_prog(chunk)
+            t0 = time.perf_counter()
+            (
+                toks,
+                lps,
+                new_pools,
+                self.dev_lens,
+                self.dev_active,
+                self.dev_budget,
+                self.dev_last,
+                self.dev_ntok,
+                self.dev_obs,
+            ) = prog(
+                self.params,
+                pools,
+                self.dev_table,
+                self.dev_lens,
+                self.dev_active,
+                self.dev_budget,
+                self.dev_last,
+                run_dev,
+                self.dev_rid,
+                self.dev_ntok,
+                self._base_key,
+                self.dev_obs,
+            )
+        else:
+            fresh = chunk not in self._decode_progs
+            prog = self._get_decode_prog(chunk)
+            self._key, k = jax.random.split(self._key)
+            t0 = time.perf_counter()
+            (
+                toks,
+                lps,
+                new_pools,
+                self.dev_lens,
+                self.dev_active,
+                self.dev_budget,
+                self.dev_last,
+                self.dev_obs,
+            ) = prog(
+                self.params,
+                pools,
+                self.dev_table,
+                self.dev_lens,
+                self.dev_active,
+                self.dev_budget,
+                self.dev_last,
+                run_dev,
+                k,
+                self.dev_obs,
+            )
         for layer, (pk, pv) in zip(self.cache, new_pools):
             layer["pool_k"], layer["pool_v"] = pk, pv
         try:  # start the device->host copy early; the drain just awaits it
@@ -1123,6 +1604,112 @@ class ContinuousBatchingEngine:
         self.decode_chunk_last = chunk
         return True
 
+    def _launch_spec(self) -> bool:
+        """Dispatch one speculative verify round: fetch host drafts for
+        every running slot, pad them into ONE [S, K-1] proposal batch at
+        the smallest decode-ladder rung covering the longest draft, and
+        score all positions in one parallel forward
+        (``serving.verify.k{K}``). Slots without a draft ride along with
+        zero-padding — any coincidental match is still the true sampled
+        token (acceptance is exact equality), so padding can only help.
+        Falls back to the plain slot-stream decode scan when no source
+        has a proposal or the block pool is too tight for width K."""
+        host_active = self.slot_rid >= 0
+        run = host_active & (self.sched_budget > 0)
+        if not run.any():
+            return False
+        drafts: dict[int, list] = {}
+        max_d = 0
+        ladder_cap = _ChunkTuner.LADDER[-1] - 1
+        for s in map(int, np.nonzero(run)[0]):
+            cap = min(
+                self.spec_lookahead,
+                int(self.slot_budget[s]) - 1,  # the +1 is the bonus sample
+                self.max_seq_len - int(self.lens[s]) - 1,
+                ladder_cap,
+            )
+            if cap <= 0:
+                continue
+            rid = int(self.slot_rid[s])
+            context = self.slot_prompt[rid].tolist()
+            for ch in self.slot_tokens[s]:
+                context.extend(int(t) for t in ch)
+            d = self._draft_source.propose(context, cap)
+            if d:
+                drafts[s] = list(d)[:cap]
+                max_d = max(max_d, len(drafts[s]))
+        if max_d == 0:
+            return self._launch()  # nothing to verify: plain decode
+        K = next(c for c in _ChunkTuner.LADDER if c >= max_d + 1)
+        for s in map(int, np.nonzero(run)[0]):
+            need = int(self.lens[s]) + min(
+                K, int(self.slot_budget[s]) + 1,
+                self.max_seq_len - int(self.lens[s]),
+            )
+            if not self._ensure_blocks(s, need):
+                # pool too tight for a K-wide verify; the plain launch
+                # has its own degrade ladder (chunk->1, drop slots)
+                return self._launch()
+        draft_np = np.zeros((self.n_slots, K - 1), np.int32)
+        for s, d in drafts.items():
+            draft_np[s, : len(d)] = d
+        self._flush_table_writes()
+        fresh = K not in self._verify_progs
+        prog = self._get_verify_prog(K)
+        run_dev = self._dev_all_slots if run.all() else jnp.asarray(run)
+        pools = tuple((layer["pool_k"], layer["pool_v"]) for layer in self.cache)
+        t0 = time.perf_counter()
+        (
+            toks,
+            lps,
+            new_pools,
+            self.dev_lens,
+            self.dev_active,
+            self.dev_budget,
+            self.dev_last,
+            self.dev_ntok,
+            self.dev_obs,
+        ) = prog(
+            self.params,
+            pools,
+            self.dev_table,
+            self.dev_lens,
+            self.dev_active,
+            self.dev_budget,
+            self.dev_last,
+            run_dev,
+            jnp.asarray(draft_np),
+            self.dev_rid,
+            self.dev_ntok,
+            self._base_key,
+            self.dev_obs,
+        )
+        for layer, (pk, pv) in zip(self.cache, new_pools):
+            layer["pool_k"], layer["pool_v"] = pk, pv
+        try:
+            toks.copy_to_host_async()
+            lps.copy_to_host_async()
+        except Exception:
+            pass
+        dispatch_s = time.perf_counter() - t0
+        # scheduled UPPER bound (the chain length is on device); the
+        # verify drain resyncs sched_* to actuals before the next launch
+        want = np.minimum(K, self.sched_budget) * run
+        self.sched_lens += want
+        self.sched_budget -= want
+        self._inflight.append(
+            _InFlight(
+                toks, lps, self.slot_rid.copy(), run.copy(), K, fresh,
+                dispatch_s, kind="verify", draft=draft_np,
+            )
+        )
+        self.spec_dispatches += 1
+        self.spec_draft_tokens += sum(len(d) for d in drafts.values())
+        self.decode_steps += 1  # one forward, however many positions
+        self.decode_launches += 1
+        self.decode_chunk_last = K
+        return True
+
     def _drain_one(self):
         """Accept the OLDEST in-flight chunk: one blocking transfer, then
         one vectorized pass over all S slots (the device stop rule
@@ -1140,13 +1727,22 @@ class ContinuousBatchingEngine:
         # (a slot freed by an earlier drain — and possibly re-admitted —
         # ran this chunk deactivated on device; its rows are garbage)
         valid = fl.run_mask & (self.slot_rid == fl.rid0) & (fl.rid0 >= 0)
+        if fl.kind == "verify":
+            # re-derive the device's chain-acceptance rule from the SAME
+            # inputs: drafts 1..j accepted iff each equalled the sample
+            # before it (positions past the first mismatch are resampled
+            # next round from the corrected history)
+            good = (tok[:, : K - 1] == fl.draft).astype(np.int64)
+            chain = 1 + np.cumprod(good, axis=1).sum(axis=1)
+        else:
+            chain = np.full(self.n_slots, K, np.int64)
         if self.eos_id is None:
             eos_pos = np.full(self.n_slots, K, np.int64)
         else:
             is_eos = tok == self.eos_id
             has = is_eos.any(axis=1)
             eos_pos = np.where(has, is_eos.argmax(axis=1), K)
-        n_emit = np.minimum(np.minimum(eos_pos + 1, self.slot_budget), K)
+        n_emit = np.minimum(np.minimum(eos_pos + 1, self.slot_budget), chain)
         n_emit = np.where(valid, n_emit, 0)
         self.lens += n_emit
         self.slot_budget -= n_emit
@@ -1156,11 +1752,41 @@ class ContinuousBatchingEngine:
             self.slot_lps[s].append(lp[s, :n])
         fin_eos = valid & (eos_pos < n_emit)
         fin_len = valid & ~fin_eos & (self.slot_budget <= 0)
+        if fl.kind == "verify":
+            emitted = int(n_emit.sum())
+            n_valid = int(valid.sum())
+            self.spec_accepted_tokens += emitted
+            if n_valid:
+                self.spec_accept_ema = (
+                    0.8 * self.spec_accept_ema + 0.2 * (emitted / n_valid)
+                )
+                for s in map(int, np.nonzero(valid)[0]):
+                    n = int(n_emit[s])
+                    self._spec_accept_counts[n] = (
+                        self._spec_accept_counts.get(n, 0) + 1
+                    )
+            tracer = get_tracer()
+            if tracer.enabled:
+                for s in map(int, np.nonzero(valid)[0]):
+                    ctx = self._slot_ctx.get(int(fl.rid0[s]))
+                    if ctx is not None:
+                        tracer.instant(
+                            "spec_verify",
+                            {"rid": int(fl.rid0[s]), "k": K,
+                             "accepted": int(n_emit[s]),
+                             **ctx_args(ctx.child())},
+                        )
         for s in map(int, np.nonzero(fin_eos)[0]):
             self._free_slot(s, "eos")
         for s in map(int, np.nonzero(fin_len)[0]):
             self._free_slot(s, "length")
-        if self._tuner is not None and not fl.fresh_compile:
+        if fl.kind == "verify":
+            # chain breaks emit fewer tokens than were scheduled without
+            # finishing the slot — resync the scheduled bounds to actuals
+            # (safe: spec mode drains before every launch)
+            self.sched_lens[:] = self.lens
+            self.sched_budget[:] = self.slot_budget
+        if self._tuner is not None and fl.kind == "decode" and not fl.fresh_compile:
             host_s = (time.perf_counter() - t1) + fl.dispatch_s
             self._tuner.observe(host_s, wait_s, K)
 
@@ -1175,6 +1801,8 @@ class ContinuousBatchingEngine:
         """Admit + dispatch one decode chunk, then accept the PREVIOUS
         chunk's tokens while the new one runs (double buffering). Returns
         False when all work is done."""
+        if self.speculative:
+            return self._step_spec()
         # if the previous chunk already finished on device, settle it
         # first — admissions and the next launch then see fresh slots
         # instead of riding a known-finished batch for another chunk
@@ -1201,6 +1829,29 @@ class ContinuousBatchingEngine:
                     )
                 return bool(self.queue) or bool((self.slot_rid >= 0).any())
         while len(self._inflight) > 1:
+            self._drain_one()
+        return True
+
+    def _step_spec(self) -> bool:
+        """The speculative step: drafting reads each slot's FULL context
+        on the host, so spec mode drains every in-flight dispatch before
+        launching the next — it trades the legacy double-buffering for
+        multi-token accepts per dispatch (the net win on transfer-bound
+        decode, measured by ``BENCH_MODE=spec``)."""
+        while self._inflight:
+            self._drain_one()
+        self._admit()
+        launched = self._launch_spec()
+        if not launched:
+            if self.queue and not (self.slot_rid >= 0).any():
+                raise RuntimeError(
+                    f"block pool too small: request rid="
+                    f"{self.queue[0].rid} needs "
+                    f"{self._blocks_needed(len(self.queue[0].prompt) + 1)} "
+                    f"blocks, pool has {len(self.free_blocks)} free"
+                )
+            return bool(self.queue) or bool((self.slot_rid >= 0).any())
+        while self._inflight:
             self._drain_one()
         return True
 
@@ -1263,6 +1914,9 @@ class ContinuousBatchingEngine:
         self.dev_active = jnp.zeros_like(self.dev_active)
         self.dev_budget = jnp.zeros_like(self.dev_budget)
         self.dev_last = jnp.zeros_like(self.dev_last)
+        self.dev_rid = jnp.full_like(self.dev_rid, -1)
+        self.dev_ntok = jnp.zeros_like(self.dev_ntok)
+        self._slot_ctx.clear()
         self._pending_table_writes.clear()
         self._inflight.clear()
         self.queue.clear()
@@ -1277,6 +1931,21 @@ def _admit_update_fn(lens, active, budget, last, mask, new_lens, new_budget, new
         active | mask,
         jnp.where(mask, new_budget, budget),
         jnp.where(mask, new_last, last),
+    )
+
+
+def _sadmit_update_fn(lens, active, budget, last, rid, ntok, mask,
+                      new_lens, new_budget, new_last, new_rid):
+    """The slot-stream admit merge: same masked write, plus the per-slot
+    RNG stream state — the occupying rid, and ntok = 1 because the
+    prefill just sampled response token index 0."""
+    return (
+        jnp.where(mask, new_lens, lens),
+        active | mask,
+        jnp.where(mask, new_budget, budget),
+        jnp.where(mask, new_last, last),
+        jnp.where(mask, new_rid, rid),
+        jnp.where(mask, jnp.ones_like(ntok), ntok),
     )
 
 
@@ -1501,8 +2170,24 @@ class ServingService:
                 ("decode_chunk", "last decode chunk size K"),
                 ("tuner_k", "chunk auto-tuner's current K"),
                 ("tokens_per_second", "decode throughput since last scrape"),
+                ("spec_accept_ema", "accepted tokens per verify dispatch (EMA)"),
+                ("spec_draft_hit_rate", "draft-source queries that proposed"),
             )
         }
+        self._m_spec = {
+            name: reg.counter(f"{p}_{name}_total", help_)
+            for name, help_ in (
+                ("spec_dispatches", "speculative verify dispatches"),
+                ("spec_draft_tokens", "tokens proposed by the draft source"),
+                ("spec_accepted_tokens", "drafted tokens accepted by verify"),
+            )
+        }
+        self._m_spec_accepted = reg.histogram(
+            f"{p}_spec_accepted_per_dispatch",
+            "tokens emitted per verify dispatch (chain length incl. bonus)",
+            buckets=(1.0, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0, 16.0, 32.0),
+        )
+        self._spec_counts_seen: dict[int, int] = {}
         self._tps_last: tuple[float, float] | None = None
         reg.register_collector(self._update_metrics)
 
@@ -1525,6 +2210,20 @@ class ServingService:
                 self._m_kv_evictions.set_total(n, {"reason": reason})
         if snap["tuner_k"] is not None:
             self._m_gauges["tuner_k"].set(float(snap["tuner_k"]))
+        if "spec_dispatches" in snap:  # engine runs speculative decoding
+            for name, c in self._m_spec.items():
+                c.set_total(snap[name])
+            self._m_gauges["spec_accept_ema"].set(float(snap["spec_accept_ema"]))
+            self._m_gauges["spec_draft_hit_rate"].set(
+                float(snap.get("spec_draft_hit_rate", 0.0))
+            )
+            # the engine keeps {chain length -> dispatch count}; observe
+            # only the delta since the last scrape
+            for n, total in snap["spec_accept_counts"].items():
+                seen = self._spec_counts_seen.get(n, 0)
+                for _ in range(total - seen):
+                    self._m_spec_accepted.observe(float(n))
+                self._spec_counts_seen[n] = total
         now = time.monotonic()
         if self._tps_last is not None:
             t0, tok0 = self._tps_last

@@ -1,0 +1,190 @@
+"""Speculative decoding for the serving path: draft sources + the
+shared sampling/RNG helpers the verify step is built on.
+
+Decode emits one token per dispatch and `AUDIT_pr15.json` puts
+``serving.decode.k4`` at arithmetic intensity 0.375 — firmly
+transfer-bound.  Speculation raises tokens/dispatch by *verifying* k
+cheaply-drafted tokens in ONE parallel forward instead of k sequential
+single-token steps; whatever the verify rejects costs nothing but the
+(already transfer-bound) dispatch it rode along on.
+
+Three pieces live here:
+
+- :func:`sample_tokens` — THE logits→(token, log-prob) sampling rule,
+  shared by prefill, decode, and verify (``ISSUE 16`` satellite: one
+  source of truth for the temperature clamp + greedy branch).  With a
+  scalar key it is bit-identical to the legacy inline ``_sample``; with
+  a per-row key array each row draws from its own stream.
+- :func:`slot_keys` / :func:`spec_keys` — the per-slot, per-token-index
+  RNG streams: the key for response token ``n`` of request ``rid`` is
+  ``fold_in(fold_in(base, rid), n)``.  The stream depends only on
+  ``(seed, rid, n)`` — never on batch composition, chunk size, or
+  accept/reject history — which is what makes speculative output
+  BIT-IDENTICAL to vanilla slot-stream decode: the verify program and
+  the sequential decode scan derive the SAME key for the same token.
+- :class:`DraftSource` implementations: :class:`PrefixTreeDraft` reads
+  continuations out of the prefix-KV radix tree (PR 11) — every served
+  completion already donated its token blocks there, so the draft is
+  free; :class:`NGramDraft` is the host-side prompt-lookup fallback
+  (propose what followed the last occurrence of the trailing n-gram).
+
+Exactness argument (sample-then-compare self-speculation): the verify
+program feeds ``[t_prev, d_1..d_{K-1}]`` through the model causally and
+samples position ``j`` with the key token-index ``ntok + j`` would use.
+Sample 0 is conditioned on the true history, so it IS the vanilla
+token.  Sample ``j`` is the vanilla token iff positions ``1..j`` fed
+the true tokens, i.e. iff every earlier draft equalled the sample
+before it — the chain-acceptance rule.  Every emitted token is
+therefore exactly the token vanilla decode would have produced from
+the same seed, greedy and temperature alike; a rejected draft's
+position already holds the corrected sample (the "bonus" token), so
+each dispatch always advances at least one token.  There is no
+distribution-level rejection sampling to approximate: acceptance is
+exact equality, proven bitwise in ``tests/test_speculative.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Protocol, Sequence, runtime_checkable
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "DraftSource",
+    "NGramDraft",
+    "PrefixTreeDraft",
+    "sample_tokens",
+    "slot_keys",
+    "spec_keys",
+]
+
+
+def sample_tokens(logits, key, *, temperature, greedy):
+    """(token, behavior log-prob of that token) per row.
+
+    ``key`` is either ONE key (one categorical draw over the whole
+    batch — the legacy engine stream; bit-identical to the historical
+    inline ``_sample``) or a per-row key array (one independent draw
+    per row — the slot-stream mode speculation requires).
+    """
+    t = jnp.maximum(jnp.asarray(temperature, jnp.float32), 1e-6)
+    lps = jax.nn.log_softmax(logits.astype(jnp.float32) / t, axis=-1)
+    if greedy:
+        tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    elif getattr(key, "ndim", 0):
+        tok = jax.vmap(jax.random.categorical)(key, lps).astype(jnp.int32)
+    else:
+        tok = jax.random.categorical(key, lps).astype(jnp.int32)
+    lp = jnp.take_along_axis(lps, tok[:, None], axis=-1)[:, 0]
+    return tok, lp
+
+
+def slot_keys(base_key, rids, ntoks):
+    """Per-row sampling keys ``fold_in(fold_in(base, rid), ntok)`` —
+    the schedule-invariant per-request streams (module docstring)."""
+    def one(r, n):
+        return jax.random.fold_in(jax.random.fold_in(base_key, r), n)
+
+    return jax.vmap(one)(rids, ntoks)
+
+
+def spec_keys(base_key, rids, ntoks, k: int):
+    """[S, K] key grid for the verify program: position ``j`` of slot
+    ``s`` keys token index ``ntoks[s] + j`` of request ``rids[s]`` —
+    exactly the key sequential decode would derive for that token."""
+    def row(r, n0):
+        return jax.vmap(
+            lambda j: jax.random.fold_in(jax.random.fold_in(base_key, r), n0 + j)
+        )(jnp.arange(k, dtype=ntoks.dtype))
+
+    return jax.vmap(row)(rids, ntoks)
+
+
+@runtime_checkable
+class DraftSource(Protocol):
+    """Host-side draft proposer: given a slot's full context (prompt +
+    emitted tokens), guess up to ``k`` continuation tokens.  Drafts are
+    pure data — a wrong draft is rejected by the exactness gate, so a
+    source never needs locks against the device state, only against its
+    own index."""
+
+    def propose(self, context: Sequence[int], k: int) -> list:
+        """Up to ``k`` proposed continuation tokens ([] = no guess)."""
+        ...
+
+    def stats(self) -> dict:
+        """Hit/miss telemetry for the draft-source gauges."""
+        ...
+
+
+class PrefixTreeDraft:
+    """Drafts from the prefix-KV radix tree (``rl_tpu.kvmem``): the
+    best full-context match's stored continuation, read through
+    :meth:`PrefixKVAllocator.draft` (which holds the allocator lock and
+    enforces the pending-eviction guard).  On replayed / shared-prefix
+    traffic the tree already holds every previously served completion,
+    so the draft costs one host tree walk and is usually exact."""
+
+    def __init__(self, allocator):
+        self._alloc = allocator
+
+    def propose(self, context: Sequence[int], k: int) -> list:
+        return self._alloc.draft(context, k)
+
+    def stats(self) -> dict:
+        a = self._alloc
+        with a._lock:
+            hits, misses, toks = a.draft_hits, a.draft_misses, a.draft_tokens
+        total = hits + misses
+        return {
+            "hits": hits,
+            "misses": misses,
+            "hit_rate": hits / total if total else 0.0,
+            "proposed_tokens": toks,
+        }
+
+
+class NGramDraft:
+    """Prompt-lookup drafting (host-side fallback when no prefix tree
+    is available): find the most recent earlier occurrence of the
+    context's trailing ``n``-gram and propose the tokens that followed
+    it.  Cheap, model-free, and effective on repetitive text (code,
+    templated prompts, extraction tasks)."""
+
+    def __init__(self, n: int = 3, max_context: int = 4096):
+        if n < 1:
+            raise ValueError("NGramDraft needs n >= 1")
+        self.n = int(n)
+        self.max_context = int(max_context)
+        self.hits = 0
+        self.misses = 0
+        self.proposed_tokens = 0
+
+    def propose(self, context: Sequence[int], k: int) -> list:
+        c = list(context[-self.max_context:])
+        n = self.n
+        if k <= 0 or len(c) <= n:
+            self.misses += 1
+            return []
+        tail = c[-n:]
+        # most recent match strictly BEFORE the trailing n-gram itself
+        for i in range(len(c) - n - 1, -1, -1):
+            if c[i:i + n] == tail:
+                out = c[i + n:i + n + k]
+                if out:
+                    self.hits += 1
+                    self.proposed_tokens += len(out)
+                    return out
+                break
+        self.misses += 1
+        return []
+
+    def stats(self) -> dict:
+        total = self.hits + self.misses
+        return {
+            "hits": self.hits,
+            "misses": self.misses,
+            "hit_rate": self.hits / total if total else 0.0,
+            "proposed_tokens": self.proposed_tokens,
+        }

@@ -113,6 +113,135 @@ class TestRadixTree:
 
 
 # ---------------------------------------------------------------------------
+# speculative draft query (ISSUE 16)
+
+
+class TestLookaheadDrafts:
+    """``PrefixTree.lookahead`` — the speculative draft probe: whatever
+    it proposes must be spelled by a SURVIVING root-reachable path,
+    never read through a node ``pop_lru`` already detached."""
+
+    @staticmethod
+    def _resident_strings(t):
+        """Every root-reachable token string (one per resident node)."""
+        out = []
+
+        def rec(node, prefix):
+            for cands in node.children.values():
+                for c in cands:
+                    if c.parent is not node:
+                        continue
+                    s = prefix + list(c.key)
+                    out.append(s)
+                    rec(c, s)
+
+        rec(t.root, [])
+        return out
+
+    def test_reads_ahead_along_donated_continuation(self):
+        t = PrefixTree(4)
+        a = t.attach(t.root, (1, 2, 3, 4), block=10)
+        b = t.attach(a, (5, 6, 7, 8), block=11)
+        t.attach(b, (9, 10), block=12)
+        assert t.lookahead((1, 2, 3, 4), 6) == [5, 6, 7, 8, 9, 10]
+        assert t.lookahead((1, 2, 3, 4, 5, 6), 3) == [7, 8, 9]
+        assert t.lookahead((1, 2), 2) == [3, 4]  # context ends mid-block
+        assert t.lookahead((1, 2, 3, 4), 0) == []
+        assert t.lookahead((2, 2), 4) == []  # diverges from everything
+
+    def test_read_ahead_prefers_hottest_candidate(self):
+        t = PrefixTree(4)
+        a = t.attach(t.root, (1, 2, 3, 4), block=10)
+        t.attach(a, (5, 5, 5, 5), block=11)
+        t.attach(a, (6, 6, 6, 6), block=12)
+        t.match((1, 2, 3, 4, 6, 6, 6, 6, 0))  # touch the second branch
+        assert t.lookahead((1, 2, 3, 4), 4) == [6, 6, 6, 6]
+
+    def test_hit_refreshes_lru_but_takes_no_refs(self):
+        # donated continuations are only reachable through lookahead
+        # (match touches the prompt path, never the continuation), so a
+        # HIT must refresh the chain's LRU rank or hot donors age out
+        # under churn — but it takes no refs: the chain stays evictable
+        # the moment capacity demands it.
+        t = PrefixTree(4)
+        a = t.attach(t.root, (1, 2, 3, 4), block=10)
+        b = t.attach(a, (5, 6, 7, 8), block=11)
+        cold = t.attach(t.root, (9, 9, 9, 9), block=12)  # attached last
+        assert t.lookahead((1, 2, 3, 4), 4) == [5, 6, 7, 8]
+        assert b.refs == 0 and a.refs == 0  # still unreferenced
+        # the hit re-ranked the donor chain above the later-attached leaf
+        assert t.pop_lru() is cold
+        # a MISS refreshes nothing: b is still the oldest evictable leaf
+        assert t.lookahead((7, 7), 4) == []
+        assert t.pop_lru() is b
+
+    def test_detached_node_never_proposed(self):
+        # the eviction guard: a stale candidate reference lingering in a
+        # children list is exactly the alias the ``c.parent is not node``
+        # re-check closes — a detached block's content is unowned and may
+        # already be rewritten by the pool's next tenant
+        t = PrefixTree(4)
+        a = t.attach(t.root, (1, 2, 3, 4), block=10)
+        b = t.attach(a, (5, 6, 7, 8), block=11)
+        assert t.pop_lru() is b  # detached, pending block reuse
+        a.children.setdefault(5, []).append(b)  # simulate the stale alias
+        assert b.parent is None
+        assert t.lookahead((1, 2, 3, 4), 4) == []  # read-ahead guard
+        assert t.lookahead((1, 2, 3, 4, 5, 6), 4) == []  # descent guard
+        a.children[5].remove(b)
+
+    def test_property_proposals_spelled_by_surviving_paths(self):
+        """Random attach/incref/decref/pop_lru churn; after every op,
+        random probes (prefixes of resident strings, mutated tails, and
+        pure noise) must only ever propose continuations spelled by a
+        string that is root-reachable RIGHT NOW."""
+        rng = np.random.default_rng(6)
+        t = PrefixTree(4)
+        nodes: list = []
+        held: list = []
+        for step in range(300):
+            op = rng.random()
+            if op < 0.45 or not nodes:
+                # interior nodes must be full blocks: partial keys are
+                # only ever attached as leaves (mirrors the allocator)
+                full = [n for n in nodes
+                        if n.parent is not None and len(n.key) == 4]
+                parent = (t.root if not full or rng.random() < 0.3
+                          else full[rng.integers(len(full))])
+                klen = 4 if rng.random() < 0.8 else int(rng.integers(1, 4))
+                key = tuple(int(v) for v in rng.integers(0, 6, klen))
+                nodes.append(t.attach(parent, key, block=step))
+            elif op < 0.6:
+                n = nodes[rng.integers(len(nodes))]
+                if n.parent is not None:  # held refs pin residency
+                    t.incref(n)
+                    held.append(n)
+            elif op < 0.75 and held:
+                t.decref(held.pop(rng.integers(len(held))))
+            else:
+                t.pop_lru()
+            strings = self._resident_strings(t)
+            for _ in range(3):
+                if strings and rng.random() < 0.8:
+                    s = strings[rng.integers(len(strings))]
+                    ctx = s[: int(rng.integers(0, len(s) + 1))]
+                    if rng.random() < 0.3:
+                        ctx = ctx + [int(rng.integers(0, 6))]
+                else:
+                    ctx = [int(v)
+                           for v in rng.integers(0, 6, int(rng.integers(1, 6)))]
+                k = int(rng.integers(1, 8))
+                out = t.lookahead(tuple(ctx), k)
+                assert len(out) <= k
+                if out:
+                    want = ctx + out
+                    assert any(s[: len(want)] == want for s in strings), \
+                        (ctx, out)
+        while held:
+            t.decref(held.pop())
+
+
+# ---------------------------------------------------------------------------
 # allocator property tests vs a naive reference
 
 

@@ -338,6 +338,67 @@ def test_prefix_result_distilled_to_own_artifact(tmp_path):
     assert runner.commits[0][0] == [art, mart, pxart]
 
 
+def test_spec_result_distilled_to_own_artifact(tmp_path):
+    """ISSUE-16: the spec sub-bench's A/B result (tokens/s speedup vs the
+    spec-off arm on the replayed shared-prefix workload, accepted tokens
+    per verify dispatch, draft hit rate, both arms' compile deltas, and
+    the lost==0 accounting under the mid-run engine crash) lands whole in
+    its own committed SPEC json, riding the same single commit as the raw
+    artifact and the metrics distillation."""
+
+    class SpecRunner(FakeRunner):
+        def bench_all(self, timeout):
+            self.bench_calls.append(timeout)
+            sp = {
+                "metric": "spec_decode_speedup_x",
+                "value": 1.519,
+                "spec_speedup_x": 1.519,
+                "speedup_ok": True,
+                "accepted_tokens_per_dispatch": 24.631,
+                "accept_ok": True,
+                "spec_draft_hit_rate": 0.801,
+                "lost": 0,
+                "invariant_ok": True,
+                "faults_fired": 1,
+                "baseline": {"tokens_per_s": 402.1, "p99_latency_s": 0.91,
+                             "steady_state_compile_delta": 0},
+                "spec": {"tokens_per_s": 610.8, "p99_latency_s": 0.63,
+                         "steady_state_compile_delta": 0,
+                         "spec_dispatches": 188},
+                "metrics": {"spec_speedup_x": 1.519},
+            }
+            lines = [
+                {"metric": "ppo", "value": 123.0},
+                {"spec": sp},
+            ]
+            return 0, "".join(json.dumps(ln) + "\n" for ln in lines)
+
+    runner = SpecRunner([_healthy()])
+    art = str(tmp_path / "bench.jsonl")
+    mart = str(tmp_path / "METRICS.json")
+    spart = str(tmp_path / "SPEC.json")
+    watch(runner, lambda s: None, max_probes=1, artifact=art,
+          metrics_artifact=mart, spec_artifact=spart,
+          sleep=lambda s: None)
+    doc = json.loads(open(spart).read())
+    sp = doc["spec"]
+    assert sp["speedup_ok"] is True
+    assert sp["value"] == 1.519
+    assert sp["accepted_tokens_per_dispatch"] == 24.631
+    assert sp["lost"] == 0 and sp["invariant_ok"] is True
+    # the per-arm structure rides whole, not flattened
+    assert sp["baseline"]["steady_state_compile_delta"] == 0
+    assert sp["spec"]["steady_state_compile_delta"] == 0
+    assert sp["spec"]["spec_dispatches"] == 188
+    assert doc["artifact"] == os.path.relpath(art, REPO)
+    # the flat metrics section still rides the METRICS distillation
+    mdoc = json.loads(open(mart).read())
+    assert mdoc["bench_metrics"]["spec"]["spec_speedup_x"] == 1.519
+    # all three files land in ONE commit
+    assert len(runner.commits) == 1
+    assert runner.commits[0][0] == [art, mart, spart]
+
+
 def test_obs_section_distilled_to_own_artifact(tmp_path):
     """PR-12: the fleet sub-bench's ``obs`` section (trace-tree shape of
     the chaos traffic, SLO windowed attainment/burn snapshot, flight-

@@ -175,6 +175,32 @@ def _extract_prefix(stdout: str) -> dict | None:
     return found
 
 
+def _extract_spec(stdout: str) -> dict | None:
+    """Find the spec sub-bench result (ISSUE-16 speculative decoding:
+    measured tokens/s speedup vs the spec-off arm on the replayed
+    shared-prefix workload, accepted tokens per verify dispatch, draft
+    hit rate, both arms' steady-state compile deltas, and the lost==0
+    accounting under the mid-run ``fleet.engine_crash`` fault) in a
+    bench stdout JSONL stream. The per-arm dicts (TTFT/latency tails and
+    token totals) carry structure worth keeping whole, so they get their
+    own committed SPEC artifact. Last match wins (the final aggregate
+    line repeats the sub-results)."""
+    found = None
+    for ln in (stdout or "").strip().splitlines():
+        try:
+            d = json.loads(ln)
+        except ValueError:
+            continue
+        if not isinstance(d, dict):
+            continue
+        v = d.get("spec")
+        if isinstance(v, dict) and (
+            "spec_speedup_x" in v or "accepted_tokens_per_dispatch" in v
+        ):
+            found = v
+    return found
+
+
 def _extract_obs(stdout: str) -> dict | None:
     """Find the fleet sub-bench's ``obs`` section (PR-12 observability:
     trace-tree shape of the chaos traffic — span count, tree count, max
@@ -301,6 +327,7 @@ def watch(
     anakin_artifact: str | None = None,
     compile_artifact: str | None = None,
     prefix_artifact: str | None = None,
+    spec_artifact: str | None = None,
     obs_artifact: str | None = None,
     audit_artifact: str | None = None,
     rlint_artifact: str | None = None,
@@ -419,6 +446,21 @@ def watch(
                 f.write("\n")
             paths.append(pxpath)
             log(f"{_utcnow()} prefix -> {os.path.relpath(pxpath, REPO)}")
+        sp = _extract_spec(bout)
+        if sp is not None:
+            sppath = spec_artifact or os.path.join(REPO, "SPEC_pr16.json")
+            with open(sppath, "w") as f:
+                json.dump(
+                    {
+                        "artifact": os.path.relpath(path, REPO),
+                        "generated": _utcnow(),
+                        "spec": sp,
+                    },
+                    f, indent=2, sort_keys=True,
+                )
+                f.write("\n")
+            paths.append(sppath)
+            log(f"{_utcnow()} spec -> {os.path.relpath(sppath, REPO)}")
         ob = _extract_obs(bout)
         if ob is not None:
             obpath = obs_artifact or os.path.join(REPO, "OBS_pr12.json")
@@ -492,6 +534,8 @@ def main(argv=None) -> int:
                     help="cold/warm startup split path (default COMPILE_pr10.json)")
     ap.add_argument("--prefix-artifact", default=None,
                     help="prefix-KV reuse result path (default PREFIX_pr11.json)")
+    ap.add_argument("--spec-artifact", default=None,
+                    help="speculative-decoding A/B path (default SPEC_pr16.json)")
     ap.add_argument("--obs-artifact", default=None,
                     help="fleet trace/SLO/flight-record path (default OBS_pr12.json)")
     ap.add_argument("--audit-artifact", default=None,
@@ -521,6 +565,7 @@ def main(argv=None) -> int:
         anakin_artifact=args.anakin_artifact,
         compile_artifact=args.compile_artifact,
         prefix_artifact=args.prefix_artifact,
+        spec_artifact=args.spec_artifact,
         obs_artifact=args.obs_artifact,
         audit_artifact=args.audit_artifact,
         rlint_artifact=args.rlint_artifact,
