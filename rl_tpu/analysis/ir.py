@@ -157,6 +157,41 @@ def _open(jaxpr: Any):
     return inner if hasattr(inner, "eqns") else jaxpr
 
 
+# prims that wrap an opaque device kernel whose body the generic
+# per-equation rules can't price (the inner jaxpr runs once PER GRID
+# STEP, so recursing into it undercounts; a bare custom_call has no body
+# at all) — the kernels.registry formulas price these by call target
+_KERNEL_CALL_PRIMS = ("pallas_call", "custom_call", "tpu_custom_call")
+
+
+def _call_target(params: dict) -> str:
+    """Best-effort call-target name of a kernel-call eqn (pallas names
+    the kernel body function via ``name_and_src_info``)."""
+    nsi = params.get("name_and_src_info")
+    name = (
+        getattr(nsi, "name", None)
+        or params.get("name")
+        or params.get("call_target_name")
+    )
+    return str(name) if name else ""
+
+
+def _price_kernel_call(target: str, eqn: Any) -> dict | None:
+    """Registered-kernel cost for one call eqn, or None (lazy import —
+    kernels.registry never imports jax, so this keeps the millisecond
+    import budget)."""
+    try:
+        from ..kernels.registry import price_call
+
+        return price_call(
+            target,
+            [_aval(v) for v in eqn.invars],
+            [_aval(v) for v in eqn.outvars],
+        )
+    except Exception:
+        return None
+
+
 def _eqn_flops(prim: str, eqn: Any) -> float:
     try:
         if prim == "dot_general":
@@ -226,14 +261,26 @@ def summarize_jaxpr(jaxpr: Any, *, dead_bytes_threshold: float = 8192.0) -> "IRF
             prim = getattr(getattr(eqn, "primitive", None), "name", "?")
             cost.eqns += 1
             cost.by_prim[prim] = cost.by_prim.get(prim, 0) + 1
-            cost.flops += mult * _eqn_flops(prim, eqn)
-            try:
-                cost.bytes += mult * (
-                    sum(_nbytes(_aval(v)) for v in eqn.invars)
-                    + sum(_nbytes(_aval(v)) for v in eqn.outvars)
-                )
-            except Exception:
-                pass
+            priced = None
+            if prim in _KERNEL_CALL_PRIMS:
+                target = _call_target(getattr(eqn, "params", None) or {})
+                if target:
+                    priced = _price_kernel_call(target, eqn)
+                    facts.kernel_sites.append(
+                        (target, (priced or {}).get("kernel", ""), path)
+                    )
+            if priced is not None:
+                cost.flops += mult * float(priced.get("flops", 0.0))
+                cost.bytes += mult * float(priced.get("bytes", 0.0))
+            else:
+                cost.flops += mult * _eqn_flops(prim, eqn)
+                try:
+                    cost.bytes += mult * (
+                        sum(_nbytes(_aval(v)) for v in eqn.invars)
+                        + sum(_nbytes(_aval(v)) for v in eqn.outvars)
+                    )
+                except Exception:
+                    pass
             if prim in CALLBACK_PRIMS or prim.startswith("debug_"):
                 facts.callback_sites.append((prim, path))
             if prim in COLLECTIVE_PRIMS:
@@ -250,8 +297,11 @@ def summarize_jaxpr(jaxpr: Any, *, dead_bytes_threshold: float = 8192.0) -> "IRF
                     inner_mult = mult * float(params.get("length", 1) or 1)
                 except Exception:
                     inner_mult = mult
-            for sub in _inner_jaxprs(params):
-                walk(sub, inner_mult, f"{path}/{prim}")
+            if priced is None:
+                # a priced kernel's formula already covers its body;
+                # recursing would double-count (and at 1x, not grid-x)
+                for sub in _inner_jaxprs(params):
+                    walk(sub, inner_mult, f"{path}/{prim}")
 
     walk(top, 1.0, "")
 
@@ -342,6 +392,7 @@ class IRFacts:
     dead_sites: list = field(default_factory=list)       # (prim, bytes, shape)
     dead_inputs: list = field(default_factory=list)      # (argpos, bytes)
     input_dtypes: list = field(default_factory=list)
+    kernel_sites: list = field(default_factory=list)     # (target, kernel, path)
     cost: IRCost = field(default_factory=IRCost)
 
 

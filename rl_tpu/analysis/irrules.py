@@ -18,6 +18,13 @@ R104   dtype promotion: f64/c128 values materialize in a program whose
 R105   dead computation: an equation whose outputs feed nothing (or an
        input buffer nothing reads) above a size threshold — transferred
        and/or computed, then thrown away
+R106   hot path on fallback: the registration declares a
+       ``kernel_hot_path`` contract (serving decode/sampling, PER
+       sum-tree), the kernels registry says the backend supports that
+       Pallas kernel, but the lowered jaxpr contains no matching kernel
+       call target — the hot path silently regressed to the stock-XLA
+       fallback (``RL_TPU_NO_KERNELS`` is the sanctioned opt-out: it
+       turns the registry answer off, so no finding)
 =====  =======================================================================
 
 Findings carry ``file="program:<name>"`` and a stable snippet (primitive
@@ -35,7 +42,7 @@ from .ir import IRFacts
 
 __all__ = ["IR_RULES", "run_ir_rules"]
 
-IR_RULES = ("R101", "R102", "R103", "R104", "R105")
+IR_RULES = ("R101", "R102", "R103", "R104", "R105", "R106")
 
 _NARROW_BITS = 32
 
@@ -144,4 +151,45 @@ def run_ir_rules(
                 "never read — transferred to the device for nothing",
                 extra={"bytes": dead_b},
             ))
+
+    # R106 — declared kernel hot path lowered on the stock-XLA fallback
+    wanted = contract.get("kernel_hot_path") or ()
+    if facts is not None and wanted:
+        lowered = {t for t, _k, _p in getattr(facts, "kernel_sites", ())}
+        for kname in wanted:
+            if not _kernel_expected_active(kname):
+                continue
+            targets = _kernel_targets(kname)
+            if targets and not any(
+                any(t in lt for lt in lowered) for t in targets
+            ):
+                out.append(_prog_finding(
+                    "R106", name, f"fallback:{kname}",
+                    f"program '{name}' declares the '{kname}' Pallas kernel "
+                    "hot path and the backend supports it, but the lowered "
+                    "jaxpr contains no matching kernel call — the hot path "
+                    "silently regressed to the stock-XLA fallback "
+                    "(set RL_TPU_NO_KERNELS to opt out deliberately)",
+                    extra={"kernel": kname, "targets": list(targets)},
+                ))
     return out
+
+
+def _kernel_expected_active(kname: str) -> bool:
+    """Lazy registry query (keeps :mod:`rl_tpu.analysis` import-light);
+    an unimportable registry means no expectation, hence no finding."""
+    try:
+        from ..kernels.registry import expected_active
+
+        return bool(expected_active(kname))
+    except Exception:
+        return False
+
+
+def _kernel_targets(kname: str) -> tuple:
+    try:
+        from ..kernels.registry import kernel_targets
+
+        return tuple(kernel_targets(kname))
+    except Exception:
+        return ()

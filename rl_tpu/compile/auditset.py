@@ -111,6 +111,44 @@ def _build_serving_spec() -> None:
     check_spec_programs(get_program_registry())
 
 
+def _build_serving_kernels() -> None:
+    """Kernel-tier serving: the same tiny engine lowered WITH the Pallas
+    kernels (interpret mode — real kernel lowering without a chip), so
+    ``rlint --ir`` audits the kernel-bearing jaxprs: R106 sees each
+    declared ``kernel_hot_path`` satisfied, and the cost model prices the
+    ``pallas_call`` targets instead of zeroing them. Different model dims
+    than the stock build keep the two engines' program keys distinct in
+    a shared store."""
+    import os
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..models import ContinuousBatchingEngine, TransformerConfig, TransformerLM
+
+    prev = os.environ.get("RL_TPU_KERNELS_INTERPRET")
+    os.environ["RL_TPU_KERNELS_INTERPRET"] = "1"
+    try:
+        cfg = TransformerConfig(
+            vocab_size=97, d_model=48, n_layers=1, n_heads=2, d_ff=64,
+            max_seq_len=64, dtype=jnp.float32,
+        )
+        m = TransformerLM(cfg)
+        params = m.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32))["params"]
+        eng = ContinuousBatchingEngine(
+            m, params, n_slots=2, block_size=8, n_blocks=17,
+            prompt_buckets=(16,), greedy=True,
+        )
+        eng.submit(np.arange(5) % 97, 4)
+        eng.run()
+    finally:
+        if prev is None:
+            os.environ.pop("RL_TPU_KERNELS_INTERPRET", None)
+        else:
+            os.environ["RL_TPU_KERNELS_INTERPRET"] = prev
+
+
 def _build_anakin() -> None:
     import jax
 
@@ -200,6 +238,7 @@ def _build_offpolicy() -> None:
 AUDIT_TARGETS: dict[str, Callable[[], None]] = {
     "serving": _build_serving,
     "serving_spec": _build_serving_spec,
+    "serving_kernels": _build_serving_kernels,
     "anakin": _build_anakin,
     "offpolicy": _build_offpolicy,
 }

@@ -643,6 +643,23 @@ def _wire_obs(reg: ProgramRegistry) -> None:
             "transfer ceiling)",
             labels=("program",),
         )
+        g_bw = obs.gauge(
+            "rl_tpu_program_bandwidth_util",
+            "memory-bandwidth utilization per program: static IR bytes "
+            "per dispatch x sampled dispatch rate over "
+            "RL_TPU_PEAK_BYTES_PER_S",
+            labels=("program",),
+        )
+        # kernel-tier activation gauges (rl_tpu_kernel_active) live in
+        # rl_tpu.kernels.registry; wiring them here keeps every /metrics
+        # process that serves programs also reporting which Pallas
+        # kernels those programs were lowered with
+        try:
+            from ..kernels.registry import wire_kernel_obs
+
+            wire_kernel_obs()
+        except Exception:
+            pass
 
         def collect():
             stats = reg.stats()
@@ -664,6 +681,17 @@ def _wire_obs(reg: ProgramRegistry) -> None:
                 if peak > 0.0 and dev_s > 0.0:
                     mfu = float(s.get("device_flops", 0.0)) / dev_s / peak
                     g_mfu.set(mfu, {"program": name})
+            if bw > 0.0:
+                for p in reg.programs():
+                    st = p.stats
+                    dev_s = float(st.get("device_s", 0.0))
+                    if dev_s > 0.0 and p.static_bytes > 0.0:
+                        bps = (
+                            p.static_bytes
+                            * float(st.get("device_samples", 0))
+                            / dev_s
+                        )
+                        g_bw.set(bps / bw, {"program": p.name})
             try:
                 from ..analysis.ir import get_ir_auditor, roofline
 

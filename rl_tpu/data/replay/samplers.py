@@ -211,10 +211,15 @@ class PrioritizedSampler(Sampler):
             delta = jnp.where(last, pa_new - leaves[idx], 0.0)
         else:
             delta = pa_new - leaves[idx]
-        return sstate.replace(
-            priorities=leaves.at[idx].add(delta),
-            esum=sstate["esum"].at[idx // self.fanout].add(delta),
+        # both tree levels in one pass where the Pallas tier is active;
+        # the fallback inside is the two stock scatter-adds (bit-exact
+        # either way — tests/test_kernels.py gates it)
+        from ...kernels.sumtree import sumtree_update
+
+        priorities, esum = sumtree_update(
+            leaves, sstate["esum"], idx, delta, fanout=self.fanout
         )
+        return sstate.replace(priorities=priorities, esum=esum)
 
     def sample(self, sstate, key, batch_size, size, capacity):
         F = self.fanout
@@ -329,18 +334,26 @@ class PrioritizedSampler(Sampler):
                 sstate, key, batch_size, size, capacity, priority_fn
             )
 
+        from ...kernels.registry import kernels_fingerprint
+
         registry = get_program_registry()
         prog = registry.register(
             "per.sample_and_update",
             fused,
+            # kernels_fingerprint: an executable with the fused sum-tree
+            # kernel baked in must never be store-loaded by a process
+            # running the fallback (or vice versa)
             fingerprint=repr((
                 self.alpha, self.beta0, self.eps, self.beta_annealing_steps,
                 self.fanout, batch_size, capacity, fingerprint,
+                kernels_fingerprint(),
             )),
             donate_argnums=(0,) if donate else (),
             # the PER tree lives on one device; a collective in its
-            # lowering means the sampler state was accidentally sharded
-            ir_contract={"shard_local": True},
+            # lowering means the sampler state was accidentally sharded.
+            # kernel_hot_path: R106 flags this program if the backend
+            # supports the sumtree kernel but the lowering fell back
+            ir_contract={"shard_local": True, "kernel_hot_path": ("sumtree",)},
         )
         if warmup:
             prog.add_signature(

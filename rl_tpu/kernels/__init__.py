@@ -1,0 +1,41 @@
+"""rl_tpu.kernels — the Pallas kernel tier (docs/kernels.md).
+
+Four hot-path kernels behind one feature-detecting registry, each with a
+stock-XLA fallback proven equivalent in tier-1 via interpret mode:
+
+- :mod:`.paged_attention` — gather-free paged-KV decode (+ int8 variant)
+- :mod:`.sampling` — fused top-k/temperature sampling
+- :mod:`.kvcache` — int8 KV pools with per-(block, kv-head) scales
+- :mod:`.sumtree` — fused PER sum-tree leaf + block-sum update
+
+Only :mod:`.registry` is imported eagerly (it must never import jax);
+kernel modules import jax lazily inside their entry points.
+"""
+
+from . import registry
+from .registry import (
+    KernelSpec,
+    expected_active,
+    kernel_targets,
+    kernels_fingerprint,
+    price_call,
+    register_kernel,
+    registered_kernels,
+    selection,
+    status,
+    wire_kernel_obs,
+)
+
+__all__ = [
+    "KernelSpec",
+    "expected_active",
+    "kernel_targets",
+    "kernels_fingerprint",
+    "price_call",
+    "register_kernel",
+    "registered_kernels",
+    "registry",
+    "selection",
+    "status",
+    "wire_kernel_obs",
+]

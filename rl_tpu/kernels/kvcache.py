@@ -1,0 +1,98 @@
+"""int8 paged KV cache with per-(block, kv-head) scales.
+
+Layout: alongside each head-major pool [N, Hk, block, D] (int8 when
+``TransformerConfig.kv_int8``), the cache carries ``scale_k``/``scale_v``
+[N, Hk] f32 — one symmetric scale per (pool block, kv head). A value x
+is stored as ``round(x / scale)`` clipped to ±127 and read back as
+``q * scale``.
+
+Scales are MONOTONE-GROWING per block: quantize-on-write scatter-maxes
+the incoming tokens' |amax|/127 into the block's scale, then requantizes
+the block's existing payload under the new scale (factor = old/new; 1.0
+for untouched blocks, so they round-trip bit-exactly). A block that is
+evicted and reused keeps its inflated scale until overwritten growth —
+that costs precision (quantization step = scale/127), never correctness:
+dequantization always uses the exact scale values were quantized with.
+The per-element round-trip error bound is scale/254 (half a step), which
+is what the property test gates.
+
+Copy-on-write and eviction need no special casing: scales are block-major
+(axis 0 = pool block) exactly like the pools, so the generic
+``a.at[dst].set(a[src])`` CoW copy and the block-table remap carry them.
+
+Capacity: the whole point. Per block, f32 K+V costs ``2·Hk·block·D·4``
+bytes; int8 costs ``2·Hk·block·D + 2·Hk·4`` — ~4x more blocks per chip
+(the ISSUE gate is ≥ 1.8x), multiplying with the prefix cache's sharing.
+"""
+
+from __future__ import annotations
+
+__all__ = [
+    "dequantize",
+    "effective_blocks_ratio",
+    "init_scales",
+    "kv_block_bytes",
+    "quantize_block_write",
+]
+
+
+def init_scales(n_blocks: int, kv_heads: int):
+    import jax.numpy as jnp
+
+    return jnp.zeros((n_blocks, kv_heads), jnp.float32)
+
+
+def quantize_block_write(pool, scale, flat_blk, flat_off, vals):
+    """The int8 twin of ``pool.at[flat_blk, :, flat_off].set(vals)``.
+
+    pool: [N, Hk, block, D] int8; scale: [N, Hk] f32; flat_blk/flat_off:
+    [M] int32 (already clamped to block 0 scratch for inactive rows, as
+    the f32 write path does); vals: [M, Hk, D] float. Returns the updated
+    ``(pool, scale)``.
+
+    Steps: grow each touched block's scale to cover the incoming amax
+    (scatter-max — duplicates resolve to the true max), requantize the
+    pool under the grown scales (factor 1.0 → bit-exact no-op for
+    untouched blocks, so this full-pool pass only ever changes blocks
+    being written), then quantize and scatter the incoming tokens.
+    """
+    import jax.numpy as jnp
+
+    v = vals.astype(jnp.float32)
+    need = jnp.max(jnp.abs(v), axis=-1) / 127.0  # [M, Hk]
+    new_scale = scale.at[flat_blk].max(need, mode="drop")
+    safe = jnp.where(new_scale > 0, new_scale, 1.0)
+    factor = jnp.where(new_scale > 0, scale / safe, 1.0)  # [N, Hk]
+    requant = jnp.clip(
+        jnp.round(pool.astype(jnp.float32) * factor[:, :, None, None]),
+        -127,
+        127,
+    ).astype(jnp.int8)
+    s = safe[flat_blk]  # [M, Hk]
+    q = jnp.clip(jnp.round(v / s[:, :, None]), -127, 127).astype(jnp.int8)
+    new_pool = requant.at[flat_blk, :, flat_off].set(q, mode="drop")
+    return new_pool, new_scale
+
+
+def dequantize(q, scale):
+    """q: [..., Hk, block, D] int8 (pool-gather layout); scale: [..., Hk]
+    f32 broadcast over the trailing (block, D) dims."""
+    import jax.numpy as jnp
+
+    return q.astype(jnp.float32) * scale[..., None, None]
+
+
+def kv_block_bytes(block: int, kv_heads: int, head_dim: int, *, int8: bool) -> int:
+    """HBM bytes one pool block costs for K+V together (+ scales if int8)."""
+    elems = 2 * kv_heads * block * head_dim
+    if int8:
+        return elems + 2 * kv_heads * 4
+    return elems * 4
+
+
+def effective_blocks_ratio(block: int, kv_heads: int, head_dim: int) -> float:
+    """How many int8 blocks fit in the HBM one f32 block occupies —
+    the 'effective blocks/chip' multiplier the capacity bench reports."""
+    return kv_block_bytes(block, kv_heads, head_dim, int8=False) / kv_block_bytes(
+        block, kv_heads, head_dim, int8=True
+    )

@@ -1,0 +1,126 @@
+"""Fused top-k/temperature sampling for the shared ``sample_tokens``.
+
+The stock path (``rl_tpu.models.speculative.sample_tokens``) lowers to a
+full-vocab log-softmax, a separate gumbel materialization, an argmax, and
+a gather — four [S, V] traversals stitched by XLA. The fused kernel does
+scale → (optional) top-k filter → log-softmax → gumbel-argmax → logprob
+gather in ONE pass with the vocab row resident in VMEM.
+
+Bit-exactness contract (the PR 16 guarantee rides on this):
+
+- The **fallback** (``mode is None``) with ``top_k=0`` is literally the
+  legacy ``sample_tokens`` body — same ops, same order — so it is
+  bitwise-identical to every artifact PR 16 committed.
+- The **kernel** consumes the same f32 logits plus gumbel noise computed
+  OUTSIDE with the exact key math ``jax.random.categorical`` uses
+  (categorical(key, lps) ≡ argmax(gumbel(key, lps.shape, lps.dtype) +
+  lps)), and its body is whole-array jnp ops over the same shapes — so
+  interpret mode reproduces the fallback bit for bit. f32 add is
+  commutative bitwise and argmax ties resolve to the first index in
+  both.
+- Greedy argmaxes the UNSCALED f32 logits: bf16→f32 is monotone and
+  injective, so ties (and their first-index resolution) match the legacy
+  ``argmax(logits)`` exactly; dividing by temperature first could round
+  two distinct logits onto the same value and flip a tie.
+
+Top-k keeps the k highest scaled logits (ties at the threshold all
+survive, matching ``lax.top_k``'s value threshold) and sends the rest to
+-inf before the softmax; ``top_k=0`` disables filtering.
+"""
+
+from __future__ import annotations
+
+import functools
+
+from . import registry
+
+
+def _kernel_body(x, g, t, *, greedy, top_k):
+    """Shared math: runs as the Pallas kernel body AND (op-for-op) as the
+    stock-XLA fallback, so parity is by construction. x, g: [S, V] f32;
+    t: f32 scalar. Returns (tok [S] int32, lp [S] f32)."""
+    import jax
+    import jax.numpy as jnp
+
+    xs = x / t
+    if top_k:
+        thr = jax.lax.top_k(xs, top_k)[0][:, -1:]
+        xs = jnp.where(xs >= thr, xs, -jnp.inf)
+    lps = jax.nn.log_softmax(xs, axis=-1)
+    if greedy:
+        tok = jnp.argmax(x, axis=-1).astype(jnp.int32)
+    else:
+        tok = jnp.argmax(g + lps, axis=-1).astype(jnp.int32)
+    lp = jnp.take_along_axis(lps, tok[:, None].astype(jnp.int32), axis=-1)[:, 0]
+    return tok, lp
+
+
+def _fused_sample_kernel(x_ref, g_ref, t_ref, tok_ref, lp_ref, *, greedy, top_k):
+    # grid=1, whole-[S, V] blocks: the body IS the fallback math, so
+    # interpret mode is bitwise the fallback (no per-tile reduction
+    # reordering to reason about)
+    tok, lp = _kernel_body(
+        x_ref[...], g_ref[...], t_ref[0, 0], greedy=greedy, top_k=top_k
+    )
+    tok_ref[...] = tok[:, None]
+    lp_ref[...] = lp[:, None]
+
+
+def _gumbel_like(key, x):
+    """The exact noise ``jax.random.categorical`` would draw for logits
+    of x's shape/dtype — scalar key or per-row key vector (vmapped keys
+    match ``jax.vmap(jax.random.categorical)``)."""
+    import jax
+
+    if getattr(key, "ndim", 0):
+        return jax.vmap(
+            lambda k: jax.random.gumbel(k, (x.shape[-1],), x.dtype)
+        )(key)
+    return jax.random.gumbel(key, x.shape, x.dtype)
+
+
+def fused_sample(logits, key, *, temperature=1.0, greedy=False, top_k=0):
+    """Sample one token per row of ``logits`` [S, V]; returns
+    ``(tok [S] int32, lp [S] f32)``. Drop-in for the legacy
+    ``sample_tokens`` body (bitwise, when ``top_k=0``)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    mode = registry.selection("sampling")
+    x = logits.astype(jnp.float32)
+    t = jnp.maximum(jnp.asarray(temperature, jnp.float32), 1e-6)
+    # top_k is a static Python int (it shapes lax.top_k) — no coercion,
+    # an int() here would read as a host sync on the hot path (R001)
+    top_k = top_k or 0
+    if top_k >= x.shape[-1]:
+        top_k = 0  # keeping the whole vocab = no filter
+
+    if mode is None:
+        # Legacy sample_tokens body, verbatim (top_k=0): PR 16 bit-exact.
+        xs = x / t
+        if top_k:
+            thr = jax.lax.top_k(xs, top_k)[0][:, -1:]
+            xs = jnp.where(xs >= thr, xs, -jnp.inf)
+        lps = jax.nn.log_softmax(xs, axis=-1)
+        if greedy:
+            tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        elif getattr(key, "ndim", 0):
+            tok = jax.vmap(jax.random.categorical)(key, lps).astype(jnp.int32)
+        else:
+            tok = jax.random.categorical(key, lps).astype(jnp.int32)
+        lp = jnp.take_along_axis(lps, tok[:, None], axis=-1)[:, 0]
+        return tok, lp
+
+    S, V = x.shape
+    g = jnp.zeros_like(x) if greedy else _gumbel_like(key, x)
+    kernel = functools.partial(_fused_sample_kernel, greedy=greedy, top_k=top_k)
+    tok, lp = pl.pallas_call(
+        kernel,
+        out_shape=[
+            jax.ShapeDtypeStruct((S, 1), jnp.int32),
+            jax.ShapeDtypeStruct((S, 1), jnp.float32),
+        ],
+        interpret=(mode == "interpret"),
+    )(x, g, t.reshape(1, 1))
+    return tok[:, 0], lp[:, 0]

@@ -1,0 +1,99 @@
+"""Fused PER sum-tree update: leaf write + block-sum propagation.
+
+The device PER sampler (``rl_tpu.data.replay.samplers``) keeps a flat
+two-level tree: ``priorities`` [padded] leaves and ``esum`` [n_blocks]
+per-block sums (fanout leaves each). The stock update lowers to TWO
+scatter-adds — two full passes over the level arrays with separate index
+materializations. The fused kernel streams the update batch once,
+applying the leaf delta and its block-sum propagation together.
+
+Exactness: bit-exact vs the fallback. The kernel applies updates
+sequentially in batch order; XLA's scatter-add also combines duplicate
+indices in operand order. The caller (``_delta_update``) has already
+deduplicated (non-last writers carry delta 0.0), and ``x + 0.0 == x``
+bitwise for the non-negative priorities PER stores, so ordering can't
+diverge even at duplicates.
+"""
+
+from __future__ import annotations
+
+import functools
+
+from . import registry
+
+
+def _sumtree_update_kernel(
+    idx_ref, delta_ref, p_ref, e_ref, po_ref, eo_ref, *, fanout, n_updates
+):
+    """idx (scalar-prefetch, SMEM) [B]; delta [B, 1]; p [P, 1]; e [NB, 1].
+    Copy-through then a sequential read-modify-write per update — one
+    kernel for both tree levels."""
+    import jax
+    from jax.experimental import pallas as pl
+
+    po_ref[...] = p_ref[...]
+    eo_ref[...] = e_ref[...]
+
+    def body(i, carry):
+        j = idx_ref[i]
+        d = pl.load(delta_ref, (pl.dslice(i, 1), slice(None)))
+        leaf = pl.load(po_ref, (pl.dslice(j, 1), slice(None)))
+        pl.store(po_ref, (pl.dslice(j, 1), slice(None)), leaf + d)
+        jb = j // fanout
+        blk = pl.load(eo_ref, (pl.dslice(jb, 1), slice(None)))
+        pl.store(eo_ref, (pl.dslice(jb, 1), slice(None)), blk + d)
+        return carry
+
+    jax.lax.fori_loop(0, n_updates, body, 0)
+
+
+def sumtree_update(priorities, esum, idx, delta, *, fanout):
+    """Apply ``priorities[idx] += delta`` and ``esum[idx // fanout] +=
+    delta`` in one fused pass; returns ``(priorities, esum)`` updated.
+    Falls back to the two stock scatter-adds when the kernel is off."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    mode = registry.selection("sumtree")
+    if mode is None:
+        return (
+            priorities.at[idx].add(delta),
+            esum.at[idx // fanout].add(delta),
+        )
+
+    B = idx.shape[0]
+    P = priorities.shape[0]
+    NB = esum.shape[0]
+    kernel = functools.partial(
+        _sumtree_update_kernel, fanout=int(fanout), n_updates=B
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(1,),
+        in_specs=[
+            pl.BlockSpec((B, 1), lambda g, idx_ref: (0, 0)),
+            pl.BlockSpec((P, 1), lambda g, idx_ref: (0, 0)),
+            pl.BlockSpec((NB, 1), lambda g, idx_ref: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((P, 1), lambda g, idx_ref: (0, 0)),
+            pl.BlockSpec((NB, 1), lambda g, idx_ref: (0, 0)),
+        ],
+    )
+    po, eo = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((P, 1), priorities.dtype),
+            jax.ShapeDtypeStruct((NB, 1), esum.dtype),
+        ],
+        interpret=(mode == "interpret"),
+    )(
+        jnp.asarray(idx, jnp.int32),
+        jnp.asarray(delta, priorities.dtype)[:, None],
+        priorities[:, None],
+        esum[:, None],
+    )
+    return po[:, 0], eo[:, 0]

@@ -124,6 +124,27 @@ def _pow2ceil(n: int) -> int:
     return 1 << max(0, int(n - 1).bit_length())
 
 
+# every per-layer pool-shaped buffer a program threads, in order: int8 KV
+# (TransformerConfig.kv_int8) adds per-block scale arrays that must ride
+# through programs, CoW copies, and warmup signatures exactly like the
+# pools (all are block-major on axis 0). f32 KV yields the legacy
+# 2-tuples — same pytree structure, so executable-store keys and program
+# signatures are unchanged when int8 is off.
+_POOL_FIELDS = ("pool_k", "pool_v", "scale_k", "scale_v")
+
+
+def _pools_from(cache):
+    """Layer cache dicts -> the flat per-layer pool tuples the engine
+    threads through its programs (2-tuples f32, 4-tuples int8+scales)."""
+    return tuple(tuple(c[f] for f in _POOL_FIELDS if f in c) for c in cache)
+
+
+def _pool_caches(pools, **common):
+    """Per-layer cache dicts back from the threaded pool tuples, plus the
+    shared table/len/active fields."""
+    return [dict(zip(_POOL_FIELDS, lp), **common) for lp in pools]
+
+
 class _ChunkTuner:
     """Pick ``decode_chunk`` from measured sync overhead vs chunk compute.
 
@@ -375,9 +396,15 @@ class ContinuousBatchingEngine:
         self._registry = registry if registry is not None else get_program_registry()
         # same name + same abstract shapes must not collide across engines
         # serving different models/sampling configs
+        # kernels_fingerprint() is folded in so an executable baked with a
+        # Pallas kernel active can never store-load into a process where
+        # that kernel is disabled (and vice versa)
+        from ..kernels.registry import kernels_fingerprint
+
         self._fingerprint = repr((
             type(model).__name__, getattr(model, "cfg", None),
             float(temperature), bool(greedy), eos_id,
+            kernels_fingerprint(),
         ))
         self._decode_progs: dict[int, Any] = {}  # chunk K -> CachedProgram
         self._prefills: dict[tuple, Any] = {}  # (A, bucket) -> CachedProgram
@@ -395,6 +422,22 @@ class ContinuousBatchingEngine:
         # holds them to it — a collective appearing in a lowered serving
         # program means a sharding annotation leaked in
         self._ir_contract = {"shard_local": True}
+        # programs that end in a sample must lower the fused sampler when
+        # the backend supports it; decode/verify additionally carry the
+        # paged-attention read. R106 audits both declarations.
+        self._ir_contract_sample = {
+            **self._ir_contract, "kernel_hot_path": ("sampling",)
+        }
+        self._ir_contract_decode = {
+            **self._ir_contract,
+            # an int8 cache satisfies the paged read via the kv_int8
+            # kernel, not the f32 one — declaring the wrong name would
+            # make R106 fire on every int8 decode lowering
+            "kernel_hot_path": (
+                "kv_int8" if model.cfg.kv_int8 else "paged_attention",
+                "sampling",
+            ),
+        }
         self._admit_update = self._registry.register(
             "serving.admit_update", _admit_update_fn,
             ir_contract=self._ir_contract,
@@ -464,24 +507,19 @@ class ContinuousBatchingEngine:
         per-admission cost at A x bucket instead of n_slots x bucket.
         Samples each admitted slot's FIRST response token."""
         A = tokens.shape[0]
-        cache = [
-            {
-                "pool_k": pk,
-                "pool_v": pv,
-                "block_table": table_rows,
-                "len": jnp.zeros((A,), jnp.int32),
-                "active": token_mask,
-            }
-            for pk, pv in pools
-        ]
+        cache = _pool_caches(
+            pools,
+            block_table=table_rows,
+            len=jnp.zeros((A,), jnp.int32),
+            active=token_mask,
+        )
         logits, cache = self.model.apply({"params": params}, tokens, cache=cache)
         last = jnp.maximum(token_mask.sum(axis=1) - 1, 0)  # [A]
         last_logits = jnp.take_along_axis(
             logits, last[:, None, None], axis=1
         )[:, 0]
         tok, lp = self._sample(last_logits, key)
-        new_pools = tuple((c["pool_k"], c["pool_v"]) for c in cache)
-        return tok, lp, new_pools
+        return tok, lp, _pools_from(cache)
 
     def _get_decode_prog(self, chunk: int):
         prog = self._decode_progs.get(chunk)
@@ -505,21 +543,14 @@ class ContinuousBatchingEngine:
                 pools, lens, active, budget, last, dm = carry
                 eff = active & run_mask
                 dm = obs_spec.inc(dm, "tokens", eff.sum().astype(jnp.float32))
-                cache = [
-                    {
-                        "pool_k": pk,
-                        "pool_v": pv,
-                        "block_table": table,
-                        "len": lens,
-                        "active": eff,
-                    }
-                    for pk, pv in pools
-                ]
+                cache = _pool_caches(
+                    pools, block_table=table, len=lens, active=eff
+                )
                 logits, cache = self.model.apply(
                     {"params": params}, last[:, None], cache=cache
                 )
                 tok, lp = self._sample(logits[:, 0], k)
-                new_pools = tuple((c["pool_k"], c["pool_v"]) for c in cache)
+                new_pools = _pools_from(cache)
                 lens = cache[0]["len"]
                 budget = budget - eff.astype(budget.dtype)
                 stop = budget <= 0
@@ -547,7 +578,7 @@ class ContinuousBatchingEngine:
 
         prog = self._decode_progs[chunk] = self._registry.register(
             f"serving.decode.k{chunk}", fn, fingerprint=self._fingerprint,
-            ir_contract=self._ir_contract,
+            ir_contract=self._ir_contract_decode,
         )
         return prog
 
@@ -558,7 +589,7 @@ class ContinuousBatchingEngine:
                 f"serving.prefill.a{a}.b{bucket}",
                 self._prefill_fn,
                 fingerprint=self._fingerprint,
-                ir_contract=self._ir_contract,
+                ir_contract=self._ir_contract_sample,
             )
         return prog
 
@@ -573,24 +604,16 @@ class ContinuousBatchingEngine:
         makes the suffix attend to prefix + itself causally). Samples
         each admitted slot's FIRST response token, same as the full
         prefill."""
-        cache = [
-            {
-                "pool_k": pk,
-                "pool_v": pv,
-                "block_table": table_rows,
-                "len": start,
-                "active": token_mask,
-            }
-            for pk, pv in pools
-        ]
+        cache = _pool_caches(
+            pools, block_table=table_rows, len=start, active=token_mask
+        )
         logits, cache = self.model.apply({"params": params}, tokens, cache=cache)
         last = jnp.maximum(token_mask.sum(axis=1) - 1, 0)  # [A], suffix-local
         last_logits = jnp.take_along_axis(
             logits, last[:, None, None], axis=1
         )[:, 0]
         tok, lp = self._sample(last_logits, key)
-        new_pools = tuple((c["pool_k"], c["pool_v"]) for c in cache)
-        return tok, lp, new_pools
+        return tok, lp, _pools_from(cache)
 
     def _get_pprefill_prog(self, a: int, bucket: int):
         prog = self._pprefills.get((a, bucket))
@@ -599,20 +622,21 @@ class ContinuousBatchingEngine:
                 f"serving.pprefill.a{a}.s{bucket}",
                 self._pprefill_fn,
                 fingerprint=self._fingerprint,
-                ir_contract=self._ir_contract,
+                ir_contract=self._ir_contract_sample,
             )
         return prog
 
     def _cow_copy_fn(self, pools, src, dst):
         """Copy-on-write fork: one gather + one scatter per layer pool
         copies the source blocks' K/V into the writers' fresh private
-        blocks (pool axis 0 is the block axis). Dispatched BEFORE the
+        blocks (pool axis 0 is the block axis). With int8 KV the per-block
+        scale arrays ride the same copy — a forked block keeps the exact
+        scale its payload was quantized with. Dispatched BEFORE the
         round's partial prefill, which consumes the returned pools — XLA
         dataflow orders the prefill's writes after these copies without
         any host sync."""
         return tuple(
-            (pk.at[dst].set(pk[src]), pv.at[dst].set(pv[src]))
-            for pk, pv in pools
+            tuple(a.at[dst].set(a[src]) for a in lp) for lp in pools
         )
 
     def _get_cow_prog(self, n: int):
@@ -657,16 +681,12 @@ class ContinuousBatchingEngine:
         """Compact bucketed prefill, slot-stream RNG: row i samples its
         FIRST response token (index 0 of rid's stream)."""
         A = tokens.shape[0]
-        cache = [
-            {
-                "pool_k": pk,
-                "pool_v": pv,
-                "block_table": table_rows,
-                "len": jnp.zeros((A,), jnp.int32),
-                "active": token_mask,
-            }
-            for pk, pv in pools
-        ]
+        cache = _pool_caches(
+            pools,
+            block_table=table_rows,
+            len=jnp.zeros((A,), jnp.int32),
+            active=token_mask,
+        )
         logits, cache = self.model.apply({"params": params}, tokens, cache=cache)
         last = jnp.maximum(token_mask.sum(axis=1) - 1, 0)  # [A]
         last_logits = jnp.take_along_axis(
@@ -674,8 +694,7 @@ class ContinuousBatchingEngine:
         )[:, 0]
         keys = slot_keys(base_key, rids, jnp.zeros_like(rids))
         tok, lp = self._sample(last_logits, keys)
-        new_pools = tuple((c["pool_k"], c["pool_v"]) for c in cache)
-        return tok, lp, new_pools
+        return tok, lp, _pools_from(cache)
 
     def _get_sprefill_prog(self, a: int, bucket: int):
         prog = self._sprefills.get((a, bucket))
@@ -684,22 +703,15 @@ class ContinuousBatchingEngine:
                 f"serving.sprefill.a{a}.b{bucket}",
                 self._sprefill_fn,
                 fingerprint=self._fingerprint,
-                ir_contract=self._ir_contract,
+                ir_contract=self._ir_contract_sample,
             )
         return prog
 
     def _spprefill_fn(self, params, pools, table_rows, tokens, token_mask, start, rids, base_key):
         """Partial bucketed prefill (prefix-cache hits), slot-stream RNG."""
-        cache = [
-            {
-                "pool_k": pk,
-                "pool_v": pv,
-                "block_table": table_rows,
-                "len": start,
-                "active": token_mask,
-            }
-            for pk, pv in pools
-        ]
+        cache = _pool_caches(
+            pools, block_table=table_rows, len=start, active=token_mask
+        )
         logits, cache = self.model.apply({"params": params}, tokens, cache=cache)
         last = jnp.maximum(token_mask.sum(axis=1) - 1, 0)  # [A], suffix-local
         last_logits = jnp.take_along_axis(
@@ -707,8 +719,7 @@ class ContinuousBatchingEngine:
         )[:, 0]
         keys = slot_keys(base_key, rids, jnp.zeros_like(rids))
         tok, lp = self._sample(last_logits, keys)
-        new_pools = tuple((c["pool_k"], c["pool_v"]) for c in cache)
-        return tok, lp, new_pools
+        return tok, lp, _pools_from(cache)
 
     def _get_spprefill_prog(self, a: int, bucket: int):
         prog = self._spprefills.get((a, bucket))
@@ -717,7 +728,7 @@ class ContinuousBatchingEngine:
                 f"serving.spprefill.a{a}.s{bucket}",
                 self._spprefill_fn,
                 fingerprint=self._fingerprint,
-                ir_contract=self._ir_contract,
+                ir_contract=self._ir_contract_sample,
             )
         return prog
 
@@ -740,22 +751,15 @@ class ContinuousBatchingEngine:
                 pools, lens, active, budget, last, ntok, dm = carry
                 eff = active & run_mask
                 dm = obs_spec.inc(dm, "tokens", eff.sum().astype(jnp.float32))
-                cache = [
-                    {
-                        "pool_k": pk,
-                        "pool_v": pv,
-                        "block_table": table,
-                        "len": lens,
-                        "active": eff,
-                    }
-                    for pk, pv in pools
-                ]
+                cache = _pool_caches(
+                    pools, block_table=table, len=lens, active=eff
+                )
                 logits, cache = self.model.apply(
                     {"params": params}, last[:, None], cache=cache
                 )
                 keys = slot_keys(base_key, rids, ntok)
                 tok, lp = self._sample(logits[:, 0], keys)
-                new_pools = tuple((c["pool_k"], c["pool_v"]) for c in cache)
+                new_pools = _pools_from(cache)
                 lens = cache[0]["len"]
                 ntok = ntok + eff.astype(ntok.dtype)
                 budget = budget - eff.astype(budget.dtype)
@@ -784,7 +788,7 @@ class ContinuousBatchingEngine:
 
         prog = self._sdecode_progs[chunk] = self._registry.register(
             f"serving.sdecode.k{chunk}", fn, fingerprint=self._fingerprint,
-            ir_contract=self._ir_contract,
+            ir_contract=self._ir_contract_decode,
         )
         return prog
 
@@ -816,16 +820,9 @@ class ContinuousBatchingEngine:
             # position was really written and really attended
             n_room = jnp.minimum(jnp.minimum(budget + 1, msl - lens), K)
             posmask = (jnp.arange(K)[None, :] < n_room[:, None]) & eff[:, None]
-            cache = [
-                {
-                    "pool_k": pk,
-                    "pool_v": pv,
-                    "block_table": table,
-                    "len": lens,
-                    "active": posmask,
-                }
-                for pk, pv in pools
-            ]
+            cache = _pool_caches(
+                pools, block_table=table, len=lens, active=posmask
+            )
             logits, cache = self.model.apply({"params": params}, x, cache=cache)
             keys = spec_keys(base_key, rids, ntok, K)  # [S, K]
             tok, lp = self._sample(
@@ -862,13 +859,13 @@ class ContinuousBatchingEngine:
                 jnp.take_along_axis(tok, idx[:, None], axis=1)[:, 0],
                 last,
             )
-            return tok, lp, tuple(
-                (c["pool_k"], c["pool_v"]) for c in cache
-            ), lens, active, budget, last, ntok, dm
+            return tok, lp, _pools_from(cache), lens, active, budget, last, ntok, dm
 
         prog = self._verify_progs[k] = self._registry.register(
+            # verify feeds K>1 positions per dispatch, so the T==1 paged
+            # decode kernel never lowers here — only the sampler is owed
             f"serving.verify.k{K}", fn, fingerprint=self._fingerprint,
-            ir_contract=self._ir_contract,
+            ir_contract=self._ir_contract_sample,
         )
         return prog
 
@@ -1003,7 +1000,7 @@ class ContinuousBatchingEngine:
 
         params_abs = jax.tree.map(absval, self.params)
         pools_abs = tuple(
-            (absval(layer["pool_k"]), absval(layer["pool_v"]))
+            tuple(absval(layer[f]) for f in _POOL_FIELDS if f in layer)
             for layer in self.cache
         )
         key_abs = absval(self._key)
@@ -1321,7 +1318,7 @@ class ContinuousBatchingEngine:
             self._key, k = jax.random.split(self._key)
         rid_v = np.full(pad_a, -1, np.int32)
         rid_v[:A] = [req.rid for _, req in batch]
-        pools = tuple((layer["pool_k"], layer["pool_v"]) for layer in self.cache)
+        pools = _pools_from(self.cache)
         if self._kvmem is not None:
             if cows:
                 pools = self._dispatch_cow(pools, cows)
@@ -1380,8 +1377,8 @@ class ContinuousBatchingEngine:
                     k,
                 )
             self.prefill_tokens_computed += sum(len(r.prompt) for _, r in batch)
-        for layer, (pk, pv) in zip(self.cache, new_pools):
-            layer["pool_k"], layer["pool_v"] = pk, pv
+        for layer, bufs in zip(self.cache, new_pools):
+            layer.update(zip(_POOL_FIELDS, bufs))
         self.prefill_token_slots += A * bucket
         tok_host, lp_host = np.asarray(tok), np.asarray(lp)
         self.host_transfers += 1
@@ -1530,7 +1527,7 @@ class ContinuousBatchingEngine:
             break
         self._flush_table_writes()
         run_dev = self._dev_all_slots if run.all() else jnp.asarray(run)
-        pools = tuple((layer["pool_k"], layer["pool_v"]) for layer in self.cache)
+        pools = _pools_from(self.cache)
         if self.slot_rng:
             fresh = chunk not in self._sdecode_progs
             prog = self._get_sdecode_prog(chunk)
@@ -1585,8 +1582,8 @@ class ContinuousBatchingEngine:
                 k,
                 self.dev_obs,
             )
-        for layer, (pk, pv) in zip(self.cache, new_pools):
-            layer["pool_k"], layer["pool_v"] = pk, pv
+        for layer, bufs in zip(self.cache, new_pools):
+            layer.update(zip(_POOL_FIELDS, bufs))
         try:  # start the device->host copy early; the drain just awaits it
             toks.copy_to_host_async()
             lps.copy_to_host_async()
@@ -1657,7 +1654,7 @@ class ContinuousBatchingEngine:
         fresh = K not in self._verify_progs
         prog = self._get_verify_prog(K)
         run_dev = self._dev_all_slots if run.all() else jnp.asarray(run)
-        pools = tuple((layer["pool_k"], layer["pool_v"]) for layer in self.cache)
+        pools = _pools_from(self.cache)
         t0 = time.perf_counter()
         (
             toks,
@@ -1684,8 +1681,8 @@ class ContinuousBatchingEngine:
             self._base_key,
             self.dev_obs,
         )
-        for layer, (pk, pv) in zip(self.cache, new_pools):
-            layer["pool_k"], layer["pool_v"] = pk, pv
+        for layer, bufs in zip(self.cache, new_pools):
+            layer.update(zip(_POOL_FIELDS, bufs))
         try:
             toks.copy_to_host_async()
             lps.copy_to_host_async()

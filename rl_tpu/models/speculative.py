@@ -60,24 +60,27 @@ __all__ = [
 ]
 
 
-def sample_tokens(logits, key, *, temperature, greedy):
+def sample_tokens(logits, key, *, temperature, greedy, top_k=0):
     """(token, behavior log-prob of that token) per row.
 
     ``key`` is either ONE key (one categorical draw over the whole
     batch — the legacy engine stream; bit-identical to the historical
     inline ``_sample``) or a per-row key array (one independent draw
     per row — the slot-stream mode speculation requires).
+    ``top_k > 0`` restricts sampling to the k highest logits (after the
+    temperature scale; ties at the threshold all survive).
+
+    The body lives in :func:`rl_tpu.kernels.sampling.fused_sample`: one
+    fused Pallas pass where the backend supports it, and a stock-XLA
+    fallback that IS the legacy body op for op (``top_k=0``), so the
+    PR 16 bit-exactness guarantee holds on every path — the kernel is
+    proven bitwise against the fallback in ``tests/test_kernels.py``.
     """
-    t = jnp.maximum(jnp.asarray(temperature, jnp.float32), 1e-6)
-    lps = jax.nn.log_softmax(logits.astype(jnp.float32) / t, axis=-1)
-    if greedy:
-        tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    elif getattr(key, "ndim", 0):
-        tok = jax.vmap(jax.random.categorical)(key, lps).astype(jnp.int32)
-    else:
-        tok = jax.random.categorical(key, lps).astype(jnp.int32)
-    lp = jnp.take_along_axis(lps, tok[:, None], axis=-1)[:, 0]
-    return tok, lp
+    from ..kernels.sampling import fused_sample
+
+    return fused_sample(
+        logits, key, temperature=temperature, greedy=greedy, top_k=top_k
+    )
 
 
 def slot_keys(base_key, rids, ntoks):

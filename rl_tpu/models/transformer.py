@@ -45,6 +45,10 @@ class TransformerConfig:
     attention_impl: str = "local"  # "local" | "ring" | "flash"
     flash_decode: bool = False  # pallas decode kernel for T=1 cache steps
     flash_interpret: bool = False  # pallas interpret mode (CPU testing)
+    # int8 paged KV pools with per-(block, kv-head) scales (quantize on
+    # write, dequantize in the read kernel) — ~4x effective KV blocks per
+    # chip; accuracy-gated, off by default (rl_tpu.kernels.kvcache)
+    kv_int8: bool = False
     mesh: Any = None  # required for "ring"
     context_axis: str = "context"
     # Mixture-of-Experts FFN (0 = dense FFN). Experts shard over the
@@ -87,6 +91,9 @@ def _paged_attention(cfg, q, k, v, cache, active):
     """
     pool_k, pool_v = cache["pool_k"], cache["pool_v"]
     table, lens = cache["block_table"], cache["len"]
+    int8 = "scale_k" in cache
+    scale_k = cache.get("scale_k")
+    scale_v = cache.get("scale_v")
     S, T = q.shape[0], q.shape[1]
     n_blocks, block = pool_k.shape[0], pool_k.shape[2]
     max_blocks = table.shape[1]
@@ -117,27 +124,50 @@ def _paged_attention(cfg, q, k, v, cache, active):
     # pools are HEAD-MAJOR [N, Hk, block, D] (the Pallas kernel views them
     # as [N*Hk, block, D] for free — Mosaic needs (block, D) last dims);
     # separated advanced indices put the gather dim first: value [M, Hk, D]
-    pool_k = pool_k.at[flat_blk, :, flat_off].set(
-        k.reshape(S * T, *k.shape[2:]), mode="drop"
-    )
-    pool_v = pool_v.at[flat_blk, :, flat_off].set(
-        v.reshape(S * T, *v.shape[2:]), mode="drop"
-    )
+    if int8:
+        from ..kernels.kvcache import quantize_block_write
+
+        pool_k, scale_k = quantize_block_write(
+            pool_k, scale_k, flat_blk, flat_off, k.reshape(S * T, *k.shape[2:])
+        )
+        pool_v, scale_v = quantize_block_write(
+            pool_v, scale_v, flat_blk, flat_off, v.reshape(S * T, *v.shape[2:])
+        )
+    else:
+        pool_k = pool_k.at[flat_blk, :, flat_off].set(
+            k.reshape(S * T, *k.shape[2:]), mode="drop"
+        )
+        pool_v = pool_v.at[flat_blk, :, flat_off].set(
+            v.reshape(S * T, *v.shape[2:]), mode="drop"
+        )
 
     # -- read: Pallas paged-decode kernel or the XLA block loop ---------------
-    if cfg.flash_decode and T == 1:
-        # the block table drives the DMA; the pool is read in place
-        from ..ops.attention import paged_flash_decode
+    # kernel selection is registry-driven (rl_tpu.kernels.registry —
+    # backend feature detection + RL_TPU_NO_KERNELS/RL_TPU_KERNELS_INTERPRET);
+    # cfg.flash_decode keeps forcing the kernel for callers that predate it
+    from ..kernels.paged_attention import decode_mode
 
-        o = paged_flash_decode(
-            q,
-            pool_k,
-            pool_v,
-            table,
-            lens + 1,  # decode-after-write: positions 0..len inclusive
-            interpret=cfg.flash_interpret,
-        ).astype(cfg.dtype)
-        return o, _advance_paged_cache(cache, pool_k, pool_v, lens, active_t)
+    mode = decode_mode(int8=int8) if T == 1 else None
+    if T == 1 and (mode is not None or cfg.flash_decode):
+        # the block table drives the DMA; the pool is read in place
+        interpret = (mode == "interpret") or cfg.flash_interpret
+        attend = lens + 1  # decode-after-write: positions 0..len inclusive
+        if int8:
+            from ..kernels.paged_attention import paged_flash_decode_int8
+
+            o = paged_flash_decode_int8(
+                q, pool_k, pool_v, scale_k, scale_v, table, attend,
+                interpret=interpret,
+            ).astype(cfg.dtype)
+        else:
+            from ..ops.attention import paged_flash_decode
+
+            o = paged_flash_decode(
+                q, pool_k, pool_v, table, attend, interpret=interpret
+            ).astype(cfg.dtype)
+        return o, _advance_paged_cache(
+            cache, pool_k, pool_v, lens, active_t, scale_k, scale_v
+        )
 
     # ONE gather materializes every table block, then a single masked
     # softmax attends over the whole [L = max_blocks*block] range. This
@@ -156,6 +186,11 @@ def _paged_attention(cfg, q, k, v, cache, active):
     safe_table = jnp.clip(table, 0, n_blocks - 1)  # -1 (unassigned) -> scratch
     k_all = pool_k[safe_table]  # [S, max_blocks, Hk, block, D]
     v_all = pool_v[safe_table]
+    if int8:
+        from ..kernels.kvcache import dequantize
+
+        k_all = dequantize(k_all, scale_k[safe_table])
+        v_all = dequantize(v_all, scale_v[safe_table])
     k_all = jnp.moveaxis(k_all, 2, 1).reshape(S, Hk, L, -1).astype(jnp.float32)
     v_all = jnp.moveaxis(v_all, 2, 1).reshape(S, Hk, L, -1).astype(jnp.float32)
     # grouped heads: [S, T, H, D] -> [S, Hk, rep, T, D] (no KV repeat)
@@ -172,10 +207,13 @@ def _paged_attention(cfg, q, k, v, cache, active):
     o = jnp.einsum("shrtl,shld->shrtd", p, v_all)
     o = o.reshape(S, cfg.n_heads, T, cfg.head_dim)
     o = jnp.moveaxis(o, 1, 2).astype(cfg.dtype)  # [S, T, H, D]
-    return o, _advance_paged_cache(cache, pool_k, pool_v, lens, active_t)
+    return o, _advance_paged_cache(
+        cache, pool_k, pool_v, lens, active_t, scale_k, scale_v
+    )
 
 
-def _advance_paged_cache(cache, pool_k, pool_v, lens, active_t):
+def _advance_paged_cache(cache, pool_k, pool_v, lens, active_t,
+                         scale_k=None, scale_v=None):
     """The one statement of the cache-advance rule (shared by the kernel
     and XLA read branches)."""
     new_cache = dict(cache)
@@ -184,6 +222,8 @@ def _advance_paged_cache(cache, pool_k, pool_v, lens, active_t):
         pool_v=pool_v,
         len=lens + active_t.sum(axis=1, dtype=lens.dtype),
     )
+    if scale_k is not None:
+        new_cache.update(scale_k=scale_k, scale_v=scale_v)
     return new_cache
 
 
@@ -452,22 +492,32 @@ class TransformerLM(nn.Module):
         table entries. Managed by
         :class:`rl_tpu.models.serving.ContinuousBatchingEngine`."""
         cfg = self.cfg
-        return [
-            {
+        pool_dtype = jnp.int8 if cfg.kv_int8 else cfg.dtype
+
+        def layer():
+            c = {
                 # HEAD-MAJOR [N, Hk, block, D]: the Pallas paged-decode
                 # kernel views the pool as [N*Hk, block, D] without a copy
                 "pool_k": jnp.zeros(
-                    (n_blocks, cfg.kv_heads, block_size, cfg.head_dim), cfg.dtype
+                    (n_blocks, cfg.kv_heads, block_size, cfg.head_dim), pool_dtype
                 ),
                 "pool_v": jnp.zeros(
-                    (n_blocks, cfg.kv_heads, block_size, cfg.head_dim), cfg.dtype
+                    (n_blocks, cfg.kv_heads, block_size, cfg.head_dim), pool_dtype
                 ),
                 "block_table": jnp.full((n_slots, max_blocks), -1, jnp.int32),
                 "len": jnp.zeros((n_slots,), jnp.int32),
                 "active": jnp.zeros((n_slots,), bool),
             }
-            for _ in range(cfg.n_layers)
-        ]
+            if cfg.kv_int8:
+                from ..kernels.kvcache import init_scales
+
+                # per-(block, kv-head) symmetric scales, block-major like
+                # the pools so CoW/eviction carry them with the same indexing
+                c["scale_k"] = init_scales(n_blocks, cfg.kv_heads)
+                c["scale_v"] = init_scales(n_blocks, cfg.kv_heads)
+            return c
+
+        return [layer() for _ in range(cfg.n_layers)]
 
 
 def param_sharding_rules(params, model_axis: str = "model", expert_axis: str = "expert"):

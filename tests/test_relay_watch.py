@@ -399,6 +399,74 @@ def test_spec_result_distilled_to_own_artifact(tmp_path):
     assert runner.commits[0][0] == [art, mart, spart]
 
 
+def test_kernels_result_distilled_to_own_artifact(tmp_path):
+    """ISSUE-17: the kernels sub-bench's A/B result (per-kernel vs
+    stock-XLA fallback on the seeded fleet replay plan — tokens/s both
+    arms, per-dispatch decode device time, both arms' compile deltas,
+    the PER sum-tree rates + bit parity, and the int8-KV capacity
+    multiplier/accuracy delta) lands whole in its own committed KERNELS
+    json, riding the same single commit as the raw artifact and the
+    metrics distillation."""
+
+    class KernelsRunner(FakeRunner):
+        def bench_all(self, timeout):
+            self.bench_calls.append(timeout)
+            kn = {
+                "metric": "kernel_serving_speedup_x",
+                "value": 1.42,
+                "kernel_speedup_x": 1.42,
+                "per_kernel_speedup_x": 2.1,
+                "arms_token_parity": True,
+                "per_state_bit_parity": True,
+                "steady_state_compile_delta_fallback": 0,
+                "steady_state_compile_delta_kernel": 0,
+                "int8_capacity_ratio_x": 3.938,
+                "int8_capacity_ok": True,
+                "fallback": {"tokens_per_s": 400.2,
+                             "decode_dispatch_us": 910.0,
+                             "steady_state_compile_delta": 0},
+                "kernel": {"tokens_per_s": 568.3,
+                           "decode_dispatch_us": 640.0,
+                           "steady_state_compile_delta": 0},
+                "int8_kv": {"capacity_ratio_x": 3.938,
+                            "token_agreement": 1.0,
+                            "mean_abs_lp_delta": 0.002},
+                "ir_audit": {"by_kernel": {"sampling": {"programs": {}}}},
+                "metrics": {"kernel_speedup_x": 1.42},
+            }
+            lines = [
+                {"metric": "ppo", "value": 123.0},
+                {"kernels": kn},
+            ]
+            return 0, "".join(json.dumps(ln) + "\n" for ln in lines)
+
+    runner = KernelsRunner([_healthy()])
+    art = str(tmp_path / "bench.jsonl")
+    mart = str(tmp_path / "METRICS.json")
+    knart = str(tmp_path / "KERNELS.json")
+    aart = str(tmp_path / "AUDIT.json")  # the fake carries an ir_audit too
+    watch(runner, lambda s: None, max_probes=1, artifact=art,
+          metrics_artifact=mart, kernels_artifact=knart,
+          audit_artifact=aart, sleep=lambda s: None)
+    doc = json.loads(open(knart).read())
+    kn = doc["kernels"]
+    assert kn["value"] == 1.42
+    assert kn["arms_token_parity"] is True and kn["per_state_bit_parity"] is True
+    assert kn["int8_capacity_ok"] is True
+    # the per-arm structure rides whole, not flattened
+    assert kn["fallback"]["steady_state_compile_delta"] == 0
+    assert kn["kernel"]["steady_state_compile_delta"] == 0
+    assert kn["kernel"]["decode_dispatch_us"] == 640.0
+    assert kn["ir_audit"]["by_kernel"]["sampling"] == {"programs": {}}
+    assert doc["artifact"] == os.path.relpath(art, REPO)
+    # the flat metrics section still rides the METRICS distillation
+    mdoc = json.loads(open(mart).read())
+    assert mdoc["bench_metrics"]["kernels"]["kernel_speedup_x"] == 1.42
+    # all four files land in ONE commit
+    assert len(runner.commits) == 1
+    assert runner.commits[0][0] == [art, mart, knart, aart]
+
+
 def test_obs_section_distilled_to_own_artifact(tmp_path):
     """PR-12: the fleet sub-bench's ``obs`` section (trace-tree shape of
     the chaos traffic, SLO windowed attainment/burn snapshot, flight-

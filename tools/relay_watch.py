@@ -201,6 +201,33 @@ def _extract_spec(stdout: str) -> dict | None:
     return found
 
 
+def _extract_kernels(stdout: str) -> dict | None:
+    """Find the kernels sub-bench result (ISSUE-17 Pallas kernel tier:
+    per-kernel vs stock-XLA-fallback A/B on the seeded fleet replay plan
+    — tokens/s both arms, per-dispatch decode device time, both arms'
+    steady-state compile deltas, the PER sum-tree cycle rates + bit-
+    parity, and the int8-KV capacity multiplier/accuracy delta) in a
+    bench stdout JSONL stream. The per-arm dicts and the per-kernel
+    ir_audit rows carry structure worth keeping whole, so they get their
+    own committed KERNELS artifact. Last match wins (the final aggregate
+    line repeats the sub-results)."""
+    found = None
+    for ln in (stdout or "").strip().splitlines():
+        try:
+            d = json.loads(ln)
+        except ValueError:
+            continue
+        if not isinstance(d, dict):
+            continue
+        for c in [d] + [v for v in d.values() if isinstance(v, dict)]:
+            v = c.get("kernels")
+            if isinstance(v, dict) and (
+                "kernel_speedup_x" in v or "int8_capacity_ratio_x" in v
+            ):
+                found = v
+    return found
+
+
 def _extract_obs(stdout: str) -> dict | None:
     """Find the fleet sub-bench's ``obs`` section (PR-12 observability:
     trace-tree shape of the chaos traffic — span count, tree count, max
@@ -328,6 +355,7 @@ def watch(
     compile_artifact: str | None = None,
     prefix_artifact: str | None = None,
     spec_artifact: str | None = None,
+    kernels_artifact: str | None = None,
     obs_artifact: str | None = None,
     audit_artifact: str | None = None,
     rlint_artifact: str | None = None,
@@ -461,6 +489,21 @@ def watch(
                 f.write("\n")
             paths.append(sppath)
             log(f"{_utcnow()} spec -> {os.path.relpath(sppath, REPO)}")
+        kn = _extract_kernels(bout)
+        if kn is not None:
+            knpath = kernels_artifact or os.path.join(REPO, "KERNELS_pr17.json")
+            with open(knpath, "w") as f:
+                json.dump(
+                    {
+                        "artifact": os.path.relpath(path, REPO),
+                        "generated": _utcnow(),
+                        "kernels": kn,
+                    },
+                    f, indent=2, sort_keys=True,
+                )
+                f.write("\n")
+            paths.append(knpath)
+            log(f"{_utcnow()} kernels -> {os.path.relpath(knpath, REPO)}")
         ob = _extract_obs(bout)
         if ob is not None:
             obpath = obs_artifact or os.path.join(REPO, "OBS_pr12.json")
@@ -536,6 +579,8 @@ def main(argv=None) -> int:
                     help="prefix-KV reuse result path (default PREFIX_pr11.json)")
     ap.add_argument("--spec-artifact", default=None,
                     help="speculative-decoding A/B path (default SPEC_pr16.json)")
+    ap.add_argument("--kernels-artifact", default=None,
+                    help="Pallas kernel-tier A/B path (default KERNELS_pr17.json)")
     ap.add_argument("--obs-artifact", default=None,
                     help="fleet trace/SLO/flight-record path (default OBS_pr12.json)")
     ap.add_argument("--audit-artifact", default=None,
@@ -566,6 +611,7 @@ def main(argv=None) -> int:
         compile_artifact=args.compile_artifact,
         prefix_artifact=args.prefix_artifact,
         spec_artifact=args.spec_artifact,
+        kernels_artifact=args.kernels_artifact,
         obs_artifact=args.obs_artifact,
         audit_artifact=args.audit_artifact,
         rlint_artifact=args.rlint_artifact,
