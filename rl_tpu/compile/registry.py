@@ -83,6 +83,27 @@ def _attr_worker(q) -> None:
             prog.stats["device_s"] += dt
             prog.stats["device_samples"] += 1
             prog.stats["device_flops"] += prog.flops_per_call
+        _notify_dispatch(prog, dt)
+
+
+def _notify_dispatch(prog: "CachedProgram", dt: float) -> None:
+    """Fan one sampled dispatch timing out to the armed profiler ring
+    and drift detector (PR 18). Disarmed-by-default: each hook is a
+    single None check when off. Runs ONLY on the attribution worker
+    thread — never a dispatch thread — so the EWMA/z-score math and any
+    triggered capture stay off every hot path (R001)."""
+    try:
+        from ..obs.drift import get_drift_detector
+        from ..obs.profiling import get_profiler
+
+        p = get_profiler()
+        if p is not None:
+            p.record_dispatch(prog.name, dt)
+        d = get_drift_detector()
+        if d is not None:
+            d.observe(prog.name, dt, prog=prog)
+    except Exception:
+        pass
 
 
 class _Attribution:

@@ -43,6 +43,7 @@ from typing import Any, Callable
 __all__ = [
     "KernelSpec",
     "expected_active",
+    "fingerprint_selection_drift",
     "kernel_targets",
     "kernels_fingerprint",
     "price_call",
@@ -149,6 +150,39 @@ def kernels_fingerprint() -> str:
     process running the fallback (and vice versa)."""
     sel = {n: selection(n) for n in sorted(_KERNELS)}
     return "kernels:" + ",".join(f"{n}={m or 'off'}" for n, m in sel.items())
+
+
+def fingerprint_selection_drift(fingerprint: str) -> list[str]:
+    """Kernel names whose selection embedded in ``fingerprint`` (via
+    :func:`kernels_fingerprint` at registration time) differs from the
+    CURRENT selection — the runtime complement of R106: a non-empty
+    result means the executable was built under a different kernel
+    regime than this process now runs (a mid-run ``RL_TPU_NO_KERNELS``
+    flip, or a store-loaded stale executable). [] when the fingerprint
+    embeds no kernel state or it matches."""
+    i = fingerprint.find("kernels:")
+    if i < 0:
+        return []
+    # the fragment rides inside a repr() tuple: name=mode pairs, comma
+    # separated, terminated by the first char outside the pair alphabet
+    frag = fingerprint[i + len("kernels:"):]
+    embedded: dict[str, str] = {}
+    for pair in frag.split(","):
+        name, sep, mode = pair.partition("=")
+        name = name.strip()
+        mode = "".join(c for c in mode if c.isalnum() or c == "_")
+        if not sep or not name.replace("_", "").isalnum() or not mode:
+            break  # ran past the fragment into the surrounding repr
+        embedded[name] = mode
+        if not pair.rstrip().endswith(mode):  # terminator inside this pair
+            break
+    drifted = []
+    for name, mode in embedded.items():
+        if name not in _KERNELS:
+            continue
+        if (selection(name) or "off") != mode:
+            drifted.append(name)
+    return sorted(drifted)
 
 
 def status() -> dict:

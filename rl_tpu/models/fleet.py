@@ -58,6 +58,7 @@ from __future__ import annotations
 
 import collections
 import dataclasses
+import os
 import threading
 import time
 from typing import Any
@@ -66,7 +67,7 @@ import numpy as np
 
 from ..analysis import hot_path
 from ..comm.liveness import Watchdog
-from ..obs.slo import SLOEngine
+from ..obs.slo import SLOEngine, StreamingHistogram, merge_histograms
 from ..obs.trace import ctx_args, current_context, new_trace, use_context
 from ..resilience.faults import fault_point, register_site, should_drop
 from .serving import (
@@ -141,6 +142,12 @@ class _Member:
         self.quarantines = 0  # lifetime count -> re-admission backoff exponent
         self.readmit_at = 0.0
         self.lat_ema: float | None = None  # per-request completion latency
+        # per-member streaming histograms: rolled up via merge() into the
+        # fleet-wide TTFT/latency quantile gauges (merged quantiles equal
+        # pooling the raw samples — counts add exactly), while staying
+        # per-member for routing diagnostics and debug_state
+        self.ttft_hist = StreamingHistogram()
+        self.lat_hist = StreamingHistogram()
         # accepted tokens per decode dispatch (speculative members report
         # their verify-accept EMA; 1.0 — one token per dispatch — for
         # legacy members, so mixed fleets score on one scale)
@@ -312,6 +319,17 @@ class ServingFleet:
         self._slo_avail = self.slo.objective(
             "fleet_availability", target=slo_target,
             description="admitted requests completed (vs shed post-admission)")
+        # burn-rate profiler trigger (PR 18): when an armed
+        # TriggeredProfiler exists and the short-window TTFT burn rate
+        # crosses this, the monitor fires a capture — the timeline
+        # complement of the flight recorder's state dump
+        try:
+            from ..obs.profiling import DEFAULT_BURN_THRESHOLD, ENV_BURN_THRESHOLD
+
+            self._profile_burn_threshold = float(
+                os.environ.get(ENV_BURN_THRESHOLD, "") or DEFAULT_BURN_THRESHOLD)
+        except (ValueError, ImportError):
+            self._profile_burn_threshold = 10.0
         self._init_metrics(registry)
 
     # -- obs wiring ------------------------------------------------------------
@@ -358,6 +376,12 @@ class ServingFleet:
         for m in self._members:
             self._g_health.set(0.0, {"engine": str(m.idx)})
         reg.register_collector(self._update_gauges)
+        try:
+            from ..obs.trace import wire_tracer_obs
+
+            wire_tracer_obs(reg)  # ring-lap visibility rides along
+        except Exception:
+            pass
 
     def _update_gauges(self):
         with self._lock:
@@ -372,13 +396,16 @@ class ServingFleet:
         self._g_outstanding.set(float(outstanding))
         for idx, state in states:
             self._g_health.set(_STATE_VALUE[state], {"engine": str(idx)})
-        # histogram quantile reads take only the histogram's own lock —
-        # deliberately outside the fleet lock above
-        for g, hist in ((self._g_ttft, self._slo_ttft.hist),
-                        (self._g_latency, self._slo_latency.hist)):
-            if hist.count:
+        # fleet-wide quantiles from the per-member histograms rolled up
+        # via merge() (exact: counts add, so merged quantiles == pooling
+        # the raw samples). Histogram locks are leaves taken one at a
+        # time — deliberately outside the fleet lock above.
+        for g, pick in ((self._g_ttft, lambda m: m.ttft_hist),
+                        (self._g_latency, lambda m: m.lat_hist)):
+            merged = merge_histograms(pick(m) for m in self._members)
+            if merged is not None and merged.count:
                 for q in (0.5, 0.99):
-                    v = hist.quantile(q)
+                    v = merged.quantile(q)
                     if v is not None:
                         g.set(v, {"quantile": str(q)})
 
@@ -723,6 +750,7 @@ class ServingFleet:
                     # EMA below only routes). Objective locks nest inside
                     # the fleet lock, never the reverse.
                     self._slo_ttft.record(t - tr.submitted_at)
+                    m.ttft_hist.observe(t - tr.submitted_at)
                     if tr.ctx is not None:
                         self._tracer.instant(
                             "fleet_first_token",
@@ -748,6 +776,7 @@ class ServingFleet:
                 m.lat_ema = lat if m.lat_ema is None else 0.7 * m.lat_ema + 0.3 * lat
                 m.spec_ema = float(getattr(m.engine, "spec_accept_ema", 1.0))
                 self._slo_latency.record(lat)
+                m.lat_hist.observe(lat)
                 self._slo_avail.record_event(True)
                 if tr.ctx is not None:
                     self._tracer.instant(
@@ -962,6 +991,32 @@ class ServingFleet:
                     continue
                 ok = self._probe(m)
                 self._on_probe(m, ok)
+            self._profiler_tick()
+
+    def _profiler_tick(self) -> None:
+        """Feed the armed :class:`~rl_tpu.obs.profiling.TriggeredProfiler`
+        once per monitor sweep (one None check when disarmed): fire
+        ``slo_burn`` when the 60s TTFT burn rate crosses
+        ``RL_TPU_PROFILE_BURN_THRESHOLD``, then poll the profiler's own
+        armed triggers (compile-delta, p99 z-score). Runs on the monitor
+        thread — a capture blocking here delays probes by one trace
+        window, which the probe watchdog timeout already tolerates."""
+        try:
+            from ..obs.profiling import get_profiler
+
+            prof = get_profiler()
+            if prof is None:
+                return
+            burn = self._slo_ttft.burn_rate(60.0)
+            if burn > self._profile_burn_threshold:
+                prof.trigger("slo_burn", {
+                    "slo": "fleet_ttft",
+                    "burn_rate_60s": round(burn, 2),
+                    "threshold": self._profile_burn_threshold,
+                })
+            prof.poll()
+        except Exception:
+            pass
 
     def _probe(self, m: _Member) -> bool:
         """One liveness probe: supervised thread alive, watchdog beat

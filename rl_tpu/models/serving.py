@@ -2122,11 +2122,25 @@ class ServingService:
 
             if self.registry is None:
                 self.registry = MetricsRegistry()
+            # the sidecar also serves /healthz, /debug/state (the
+            # engine's snapshot, bounded) and POST /profile (fires the
+            # armed TriggeredProfiler's manual trigger)
             self._metrics_server = MetricsHTTPServer(
-                self.registry, host=host, port=metrics_port
+                self.registry, host=host, port=metrics_port,
+                state_fn=self._debug_state,
             )
         if self.registry is not None:
             self._init_metrics(self.registry)
+
+    def _debug_state(self) -> dict:
+        """``GET /debug/state`` payload: the engine snapshot plus the
+        service-side queue view — the first thing to curl on a replica
+        that is scraping fine but serving slowly."""
+        with self._lock:
+            snap = self.engine.metrics_snapshot()
+            done = len(self._done)
+            error = self._error
+        return {"engine": snap, "finished_unclaimed": done, "error": error}
 
     def _init_metrics(self, reg):
         p = "rl_tpu_serving"

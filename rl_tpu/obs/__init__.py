@@ -28,12 +28,20 @@ _EXPORTS = {
     "use_context": ("rl_tpu.obs.trace", "use_context"),
     "ctx_args": ("rl_tpu.obs.trace", "ctx_args"),
     "carry_context": ("rl_tpu.obs.trace", "carry_context"),
+    "wire_tracer_obs": ("rl_tpu.obs.trace", "wire_tracer_obs"),
     "StreamingHistogram": ("rl_tpu.obs.slo", "StreamingHistogram"),
     "SLOEngine": ("rl_tpu.obs.slo", "SLOEngine"),
     "Objective": ("rl_tpu.obs.slo", "Objective"),
+    "merge_histograms": ("rl_tpu.obs.slo", "merge_histograms"),
     "FlightRecorder": ("rl_tpu.obs.flight", "FlightRecorder"),
     "get_flight_recorder": ("rl_tpu.obs.flight", "get_flight_recorder"),
     "set_flight_recorder": ("rl_tpu.obs.flight", "set_flight_recorder"),
+    "TriggeredProfiler": ("rl_tpu.obs.profiling", "TriggeredProfiler"),
+    "get_profiler": ("rl_tpu.obs.profiling", "get_profiler"),
+    "set_profiler": ("rl_tpu.obs.profiling", "set_profiler"),
+    "DriftDetector": ("rl_tpu.obs.drift", "DriftDetector"),
+    "get_drift_detector": ("rl_tpu.obs.drift", "get_drift_detector"),
+    "set_drift_detector": ("rl_tpu.obs.drift", "set_drift_detector"),
     "Counter": ("rl_tpu.obs.registry", "Counter"),
     "Gauge": ("rl_tpu.obs.registry", "Gauge"),
     "Histogram": ("rl_tpu.obs.registry", "Histogram"),

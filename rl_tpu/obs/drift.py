@@ -1,0 +1,343 @@
+"""Performance-drift detection: measured vs baseline vs predicted.
+
+The stack emits *predictions* (PR 15's static roofline: ``predicted_s``
+/ ``predicted_mfu`` per registered program, with PR 17's ``price_call``
+kernel costs folded into the static model) and *measurements* (PR 12's
+sampled per-dispatch device time). Nothing compared them continuously —
+a program could silently get 3x slower after a deploy and every gauge
+would keep reporting, just with worse numbers. :class:`DriftDetector`
+closes the loop, per program, on three channels:
+
+- **timing** — an EWMA of sampled dispatch seconds against a baseline
+  frozen from the first ``baseline_samples`` observations. Ratio past
+  ``tolerance`` = the program got slower than it was when this process
+  warmed it.
+- **kernel selection** — the *runtime* complement of rlint R106: every
+  kernel-bearing program's fingerprint embeds
+  :func:`~rl_tpu.kernels.registry.kernels_fingerprint` at registration;
+  at observe time the embedded selection is compared against the
+  *current* one. A mismatch means the executable being dispatched was
+  built under a different kernel regime than the process now runs — a
+  silent kernel→fallback regression or a store-loaded stale executable,
+  which static compile-time auditing can't see after deploy.
+- **predicted** — measured EWMA against the static roofline
+  ``predicted_s`` (needs ``RL_TPU_PEAK_FLOPS`` /
+  ``RL_TPU_PEAK_BYTES_PER_S``; silent without them, since a roofline
+  with no peaks predicts nothing).
+
+On drift: the ``rl_tpu_program_drift{program}`` gauge rises above 1.0
+(the value is the worst channel's ratio over its tolerance, so >1 ==
+drifted on any channel), ``rl_tpu_program_drift_events_total
+{program,kind}`` counts the firing, a tracer instant marks the timeline,
+and the armed :class:`~rl_tpu.obs.profiling.TriggeredProfiler` (if any)
+captures a ``drift`` bundle whose meta names the regressed program.
+Firings are rate-limited per (program, kind) by ``refire_s``.
+
+``observe`` runs on the compile registry's attribution worker thread
+(fed from ``_attr_worker``, sampled every 8th dispatch) — never on a
+dispatch thread, so the comparison math is R001-clean by construction.
+
+Env knobs (see ``docs/profiling.md``):
+
+- ``RL_TPU_DRIFT_TOLERANCE`` — drift ratio bound (default 1.5: fire
+  when a program runs 1.5x its baseline / prediction).
+- ``RL_TPU_DRIFT_BASELINE`` — samples frozen into the timing baseline
+  (default 6).
+- ``RL_TPU_DRIFT_REFIRE_S`` — per (program, kind) re-fire interval
+  (default 60s).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Callable
+
+__all__ = ["DriftDetector", "get_drift_detector", "set_drift_detector"]
+
+ENV_TOLERANCE = "RL_TPU_DRIFT_TOLERANCE"
+ENV_BASELINE = "RL_TPU_DRIFT_BASELINE"
+ENV_REFIRE = "RL_TPU_DRIFT_REFIRE_S"
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+class _ProgramDrift:
+    """Per-program comparison state (guarded by the detector's lock)."""
+
+    __slots__ = ("baseline_sum", "baseline_n", "baseline", "ewma",
+                 "last_fire", "events")
+
+    def __init__(self):
+        self.baseline_sum = 0.0
+        self.baseline_n = 0
+        self.baseline: float | None = None  # frozen mean of the first K
+        self.ewma: float | None = None
+        self.last_fire: dict[str, float] = {}  # kind -> clock time
+        self.events: dict[str, int] = {}  # kind -> fire count
+
+
+class DriftDetector:
+    """Continuous measured-vs-predicted comparison per program.
+
+    Disarmed by default; arm process-wide with :func:`set_drift_detector`
+    (the attribution worker's feed is a None check when off). ``profiler``
+    defaults to the process profiler *at fire time*; ``registry``/
+    ``tracer`` likewise, so test swaps are honored."""
+
+    def __init__(
+        self,
+        *,
+        tolerance: float | None = None,
+        baseline_samples: int | None = None,
+        alpha: float = 0.25,
+        refire_s: float | None = None,
+        registry: Any = None,
+        tracer: Any = None,
+        profiler: Any = None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.tolerance = (
+            float(tolerance) if tolerance is not None
+            else _env_float(ENV_TOLERANCE, 1.5)
+        )
+        if self.tolerance <= 1.0:
+            raise ValueError(f"tolerance must be > 1.0, got {self.tolerance}")
+        self.baseline_samples = (
+            int(baseline_samples) if baseline_samples is not None
+            else int(_env_float(ENV_BASELINE, 6))
+        )
+        self.alpha = float(alpha)
+        self.refire_s = (
+            float(refire_s) if refire_s is not None
+            else _env_float(ENV_REFIRE, 60.0)
+        )
+        self._registry = registry
+        self._tracer = tracer
+        self._profiler = profiler
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._programs: dict[str, _ProgramDrift] = {}
+        self.fired: list[dict] = []  # bounded history of firings
+
+    # -- the feed ----------------------------------------------------------
+
+    def observe(self, program: str, seconds: float, prog: Any = None) -> list[dict]:
+        """Fold one sampled dispatch timing in; returns the drift events
+        fired by this observation ([] almost always). ``prog`` is the
+        :class:`~rl_tpu.compile.registry.CachedProgram` when the caller
+        has it — it carries the fingerprint (selection channel) and the
+        IR report (predicted channel). Never raises: this runs on the
+        attribution daemon, and a detector bug must not stop device-time
+        accounting."""
+        try:
+            return self._observe(program, float(seconds), prog)
+        except Exception:
+            return []
+
+    def _observe(self, program: str, dt: float, prog: Any) -> list[dict]:
+        with self._lock:
+            st = self._programs.get(program)
+            if st is None:
+                st = self._programs[program] = _ProgramDrift()
+            if st.baseline is None:
+                st.baseline_sum += dt
+                st.baseline_n += 1
+                if st.baseline_n >= self.baseline_samples:
+                    st.baseline = st.baseline_sum / st.baseline_n
+                st.ewma = dt if st.ewma is None else st.ewma
+                return []
+            st.ewma = self.alpha * dt + (1.0 - self.alpha) * st.ewma
+            ewma, baseline = st.ewma, st.baseline
+
+        fired: list[dict] = []
+        score = 0.0  # worst channel ratio over its tolerance; >1 = drifted
+
+        ratio = ewma / baseline if baseline > 0.0 else 0.0
+        score = max(score, ratio / self.tolerance)
+        if ratio > self.tolerance:
+            fired += self._fire(
+                program, "timing",
+                {"ratio": round(ratio, 3), "ewma_s": ewma, "baseline_s": baseline},
+            )
+
+        stale = self._selection_drift(prog)
+        if stale:
+            score = max(score, 2.0)
+            fired += self._fire(
+                program, "kernel_selection",
+                {"kernels": stale,
+                 "note": "executable built under a different kernel selection "
+                         "than this process now runs"},
+            )
+
+        pred = self._predicted_s(prog)
+        if pred is not None and pred > 0.0:
+            pred_ratio = ewma / pred
+            self._set_gauge(
+                "rl_tpu_program_drift_vs_predicted",
+                "measured dispatch EWMA over the static roofline prediction",
+                pred_ratio, program,
+            )
+            score = max(score, pred_ratio / self.tolerance)
+            if pred_ratio > self.tolerance:
+                fired += self._fire(
+                    program, "predicted",
+                    {"ratio": round(pred_ratio, 3), "ewma_s": ewma,
+                     "predicted_s": pred},
+                )
+
+        self._set_gauge(
+            "rl_tpu_program_drift",
+            "worst drift-channel ratio over its tolerance (>1 = drifted): "
+            "timing EWMA vs frozen baseline, kernel-selection staleness, "
+            "measured vs roofline prediction",
+            score, program,
+        )
+        return fired
+
+    # -- channels ----------------------------------------------------------
+
+    @staticmethod
+    def _selection_drift(prog: Any) -> list[str]:
+        """Kernel names whose selection embedded in the program's
+        fingerprint differs from the current process selection."""
+        fp = getattr(prog, "fingerprint", "") or ""
+        if "kernels:" not in fp:
+            return []
+        try:
+            from ..kernels.registry import fingerprint_selection_drift
+
+            return fingerprint_selection_drift(fp)
+        except Exception:
+            return []
+
+    @staticmethod
+    def _predicted_s(prog: Any) -> float | None:
+        """Static roofline predicted seconds per dispatch, when the
+        program carries an IR cost and the peak env knobs are set."""
+        rep = getattr(prog, "ir_report", None)
+        cost = getattr(rep, "cost", None)
+        if cost is None:
+            return None
+        peak = _env_float("RL_TPU_PEAK_FLOPS", 0.0)
+        if peak <= 0.0:
+            return None
+        bw = _env_float("RL_TPU_PEAK_BYTES_PER_S", 0.0)
+        try:
+            from ..analysis.ir import roofline
+
+            rf = roofline(cost, peak, bw)
+            p = rf.get("predicted_s")
+            return float(p) if p else None
+        except Exception:
+            return None
+
+    # -- firing ------------------------------------------------------------
+
+    def _fire(self, program: str, kind: str, detail: dict) -> list[dict]:
+        now = self._clock()
+        with self._lock:
+            st = self._programs[program]
+            last = st.last_fire.get(kind)
+            if last is not None and now - last < self.refire_s:
+                return []
+            st.last_fire[kind] = now
+            st.events[kind] = st.events.get(kind, 0) + 1
+            event = {"program": program, "kind": kind, **detail}
+            self.fired.append(event)
+            del self.fired[:-64]  # bounded history
+        try:
+            reg = self._resolve_registry()
+            reg.counter(
+                "rl_tpu_program_drift_events_total",
+                "drift firings per program and channel",
+                labels=("program", "kind"),
+            ).inc(labels={"program": program, "kind": kind})
+            self._resolve_tracer().instant("program_drift", dict(event))
+        except Exception:
+            pass
+        try:
+            prof = self._profiler
+            if prof is None:
+                from .profiling import get_profiler
+
+                prof = get_profiler()
+            if prof is not None:
+                prof.trigger("drift", dict(event))
+        except Exception:
+            pass
+        return [event]
+
+    def _set_gauge(self, name: str, help_: str, value: float, program: str) -> None:
+        try:
+            self._resolve_registry().gauge(name, help_, labels=("program",)).set(
+                float(value), {"program": program}
+            )
+        except Exception:
+            pass
+
+    def _resolve_registry(self):
+        if self._registry is not None:
+            return self._registry
+        from .registry import get_registry
+
+        return get_registry()
+
+    def _resolve_tracer(self):
+        if self._tracer is not None:
+            return self._tracer
+        from .trace import get_tracer
+
+        return get_tracer()
+
+    # -- introspection -----------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """Bench-artifact form: per-program comparison state + firings."""
+        with self._lock:
+            progs = {
+                name: {
+                    "baseline_s": st.baseline,
+                    "ewma_s": st.ewma,
+                    "ratio": (
+                        st.ewma / st.baseline
+                        if st.baseline and st.ewma is not None else None
+                    ),
+                    "events": dict(st.events),
+                }
+                for name, st in self._programs.items()
+            }
+            return {
+                "tolerance": self.tolerance,
+                "baseline_samples": self.baseline_samples,
+                "programs": progs,
+                "fired": list(self.fired),
+                "events_total": sum(
+                    n for st in self._programs.values() for n in st.events.values()
+                ),
+            }
+
+
+# -- process-global installation (disarmed by default) -------------------------
+
+_detector: DriftDetector | None = None
+
+
+def get_drift_detector() -> DriftDetector | None:
+    """The armed process-wide detector, or None (default: disarmed)."""
+    return _detector
+
+
+def set_drift_detector(det: DriftDetector | None) -> DriftDetector | None:
+    """Arm ``det`` process-wide; returns the previous detector."""
+    global _detector
+    prev = _detector
+    _detector = det
+    return prev

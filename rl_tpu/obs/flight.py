@@ -177,12 +177,29 @@ class FlightRecorder:
             except Exception as e:
                 failed.append(f"source-{name}: {e!r}")
 
+        # an armed TriggeredProfiler ships the *timeline* next to this
+        # bundle's *state*: fire a forced capture (bypasses the interval
+        # limit — a giveup always rates a profile — but not the hard
+        # capture cap) and cross-reference it from meta.json
+        profile_bundle = None
+        try:
+            from .profiling import get_profiler
+
+            prof = get_profiler()
+            if prof is not None:
+                profile_bundle = prof.trigger(
+                    f"flight:{trigger}", {"flight_bundle": path}, force=True
+                )
+        except Exception as e:
+            failed.append(f"profile: {e!r}")
+
         meta = {
             "trigger": trigger,
             "error": None if error is None else repr(error),
             "wall_time_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
             "window_s": self.window_s,
             "seq": seq,
+            "profile_bundle": profile_bundle,
             "failed_artifacts": failed,
         }
         self._write_json(os.path.join(path, "meta.json"), meta)

@@ -1,4 +1,4 @@
-"""Minimal ``/metrics`` HTTP endpoint (Prometheus text exposition).
+"""Minimal operational HTTP sidecar (Prometheus text + debug surface).
 
 The framework's control plane is line-delimited-JSON TCP
 (``rl_tpu.comm.TCPCommandServer``), which Prometheus can't scrape — so
@@ -6,40 +6,109 @@ services that want scraping (``ServingService``, ``LoggerService``) run
 this tiny stdlib HTTP server alongside their command port. Stdlib only:
 no new dependencies, one daemon thread, content type
 ``text/plain; version=0.0.4``.
+
+Routes:
+
+- ``GET /metrics`` (and ``/``) — Prometheus text exposition.
+- ``GET /healthz`` — liveness: 200 ``ok`` while the server thread runs
+  (what a load balancer or k8s probe polls; scraping /metrics for
+  liveness runs every collector, which is heavier than a probe wants).
+- ``GET /debug/state`` — the owning service's state snapshot
+  (``state_fn``: engine/fleet/allocator metrics_snapshot) as JSON,
+  size-bounded by ``max_state_bytes`` so a pathological snapshot can't
+  OOM a handler thread or a curl. 404 when no ``state_fn`` was wired.
+- ``POST /profile`` — fire the ``manual`` trigger on the armed
+  :class:`~rl_tpu.obs.profiling.TriggeredProfiler` (the instance passed
+  as ``profiler``, else the process-global one). Replies with the
+  capture bundle path, or ``null`` when the rate limiter suppressed it;
+  404 when no profiler is armed. POST-only: a capture has side effects
+  (disk, a device-trace window), so GET /profile is 405.
+
+Anything else: 404 on GET, 405 on POST to a GET-only route.
 """
 
 from __future__ import annotations
 
+import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable
 
 __all__ = ["MetricsHTTPServer"]
 
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+JSON_TYPE = "application/json; charset=utf-8"
+
+_GET_ROUTES = ("/metrics", "/", "/healthz", "/debug/state")
 
 
 class MetricsHTTPServer:
-    """Serve ``GET /metrics`` for one :class:`~rl_tpu.obs.registry.MetricsRegistry`.
+    """Serve ``GET /metrics`` (+ health/debug/profile routes) for one
+    :class:`~rl_tpu.obs.registry.MetricsRegistry`.
 
     ``port=0`` binds an ephemeral port; read it back from ``address``.
+    ``state_fn`` (optional) backs ``/debug/state``; ``profiler``
+    (optional) pins ``POST /profile`` to a specific
+    :class:`~rl_tpu.obs.profiling.TriggeredProfiler` instead of the
+    process-global armed one.
     """
 
-    def __init__(self, registry, host: str = "127.0.0.1", port: int = 0):
+    def __init__(
+        self,
+        registry,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        state_fn: Callable[[], Any] | None = None,
+        profiler: Any = None,
+        max_state_bytes: int = 262144,
+    ):
         self.registry = registry
+        self.state_fn = state_fn
+        self.profiler = profiler
+        self.max_state_bytes = int(max_state_bytes)
         outer = self
 
         class _Handler(BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802 (stdlib API name)
-                if self.path.split("?", 1)[0] not in ("/metrics", "/"):
+                route = self.path.split("?", 1)[0]
+                if route in ("/metrics", "/"):
+                    try:
+                        body = outer.registry.render().encode()
+                    except Exception as e:  # registry bug must not wedge the scraper
+                        self.send_error(500, str(e))
+                        return
+                    self._reply(200, body, CONTENT_TYPE)
+                elif route == "/healthz":
+                    self._reply(200, b"ok\n", CONTENT_TYPE)
+                elif route == "/debug/state":
+                    if outer.state_fn is None:
+                        self.send_error(404, "no state source wired")
+                        return
+                    self._reply(200, outer._state_body(), JSON_TYPE)
+                elif route == "/profile":
+                    # capture has side effects; require POST
+                    self.send_error(405, "use POST /profile")
+                else:
                     self.send_error(404)
-                    return
-                try:
-                    body = outer.registry.render().encode()
-                except Exception as e:  # registry bug must not wedge the scraper
-                    self.send_error(500, str(e))
-                    return
-                self.send_response(200)
-                self.send_header("Content-Type", CONTENT_TYPE)
+
+            def do_POST(self):  # noqa: N802 (stdlib API name)
+                route = self.path.split("?", 1)[0]
+                if route == "/profile":
+                    prof = outer._resolve_profiler()
+                    if prof is None:
+                        self.send_error(404, "no profiler armed")
+                        return
+                    path = prof.trigger("manual", {"source": "http"})
+                    body = json.dumps({"capture": path}).encode() + b"\n"
+                    self._reply(200, body, JSON_TYPE)
+                elif route in _GET_ROUTES:
+                    self.send_error(405, f"use GET {route}")
+                else:
+                    self.send_error(404)
+
+            def _reply(self, code: int, body: bytes, ctype: str) -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
@@ -51,6 +120,35 @@ class MetricsHTTPServer:
         self._server.daemon_threads = True
         self._thread: threading.Thread | None = None
         self._child = None  # supervised-mode handle
+
+    def _resolve_profiler(self):
+        if self.profiler is not None:
+            return self.profiler
+        from .profiling import get_profiler
+
+        return get_profiler()
+
+    def _state_body(self) -> bytes:
+        """``/debug/state`` payload: the snapshot as JSON, with a bounded
+        on-the-wire size — an oversize snapshot degrades to a small
+        explicit error object instead of a multi-MB reply (and a raising
+        state_fn to its repr), so the debug surface is always safe to
+        poll."""
+        try:
+            payload = self.state_fn()
+        except Exception as e:
+            payload = {"error": repr(e)}
+        try:
+            body = json.dumps(payload, default=repr).encode()
+        except Exception as e:
+            body = json.dumps({"error": repr(e)}).encode()
+        if len(body) > self.max_state_bytes:
+            body = json.dumps({
+                "error": "state snapshot too large",
+                "bytes": len(body),
+                "limit": self.max_state_bytes,
+            }).encode()
+        return body + b"\n"
 
     @property
     def address(self) -> tuple[str, int]:

@@ -1,0 +1,421 @@
+"""Adaptive device profiling: trigger-armed ``jax.profiler`` capture.
+
+The flight recorder (PR 12) answers "what was the *state* when it died";
+this module answers "where did the *milliseconds* go" — and it answers
+on anomaly, not on request, because by the time a human attaches, the
+interesting window is gone. Podracer-style TPU stacks (arXiv
+2104.06272) keep dispatch-bound paths honest with exactly this kind of
+always-on timeline attribution.
+
+:class:`TriggeredProfiler` is armed process-wide via
+:func:`set_profiler` (disarmed by default — every hook is a single None
+check when off, the same pattern as the flight recorder and fault
+injection):
+
+- An **always-on ring** of per-dispatch timings, fed by the compile
+  registry's attribution worker (sampled every 8th dispatch, off every
+  hot path per R001 — the feed costs a lock + deque append on a daemon
+  thread, nothing on a dispatch thread).
+- **Named triggers** decide when a ring snapshot is worth a full
+  capture: the fleet fires ``slo_burn`` when a burn rate crosses
+  ``RL_TPU_PROFILE_BURN_THRESHOLD``; :meth:`arm_compile_delta` fires
+  when the steady-state compile count moves (a silent recompile);
+  :meth:`arm_p99_spike` fires when a program's recent p99 z-scores away
+  from its own history; the :class:`~rl_tpu.obs.http.MetricsHTTPServer`
+  sidecar fires ``manual`` on ``POST /profile``; the
+  :class:`~rl_tpu.obs.drift.DriftDetector` fires ``drift``; and a
+  :class:`~rl_tpu.obs.flight.FlightRecorder` dump fires
+  ``flight:<trigger>`` so a Supervisor giveup ships state *and*
+  timeline.
+- Each capture is a **rate-limited postmortem bundle**
+  (``min_interval_s`` between captures, ``max_captures`` per process —
+  a flapping trigger cannot fill the disk)::
+
+      <dir>/profile-<trigger>-<utcstamp>-<seq>/
+          meta.json      trigger, detail, what failed to write
+          timings.json   dispatch-timing ring snapshot per program
+          trace.json     last window_s of host spans (Perfetto file)
+          jax_trace/     device timeline, when jax.profiler supports it
+
+  The ``jax.profiler`` capture is feature-detected and fenced: on a
+  backend/build without profiler support the bundle simply notes
+  ``jax_trace: unsupported`` — capturing must never raise into the
+  trigger's thread (often an escalation path).
+
+Env knobs (all documented in ``docs/profiling.md``):
+
+- ``RL_TPU_PROFILE_TRACE_S`` — device-trace window per capture (default
+  0.25s; the capture thread sleeps this long inside start/stop_trace).
+- ``RL_TPU_PROFILE_BURN_THRESHOLD`` — fleet burn-rate trigger threshold
+  (default 10.0; read by ``ServingFleet``, not here).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Mapping
+
+__all__ = ["TriggeredProfiler", "get_profiler", "set_profiler"]
+
+_ENV_TRACE_S = "RL_TPU_PROFILE_TRACE_S"
+ENV_BURN_THRESHOLD = "RL_TPU_PROFILE_BURN_THRESHOLD"
+DEFAULT_BURN_THRESHOLD = 10.0
+
+
+def _json_default(o: Any) -> str:
+    return repr(o)
+
+
+class _ProgramRing:
+    """Per-program dispatch-timing ring + running moments (Welford).
+
+    Only the profiler's feed lock serializes writers, so plain fields
+    are fine; readers (poll / capture) snapshot under the same lock."""
+
+    __slots__ = ("recent", "count", "mean", "m2")
+
+    def __init__(self, capacity: int):
+        self.recent: deque = deque(maxlen=capacity)
+        self.count = 0
+        self.mean = 0.0
+        self.m2 = 0.0
+
+    def add(self, dt: float) -> None:
+        self.recent.append(dt)
+        self.count += 1
+        delta = dt - self.mean
+        self.mean += delta / self.count
+        self.m2 += delta * (dt - self.mean)
+
+    def std(self) -> float:
+        if self.count < 2:
+            return 0.0
+        return (self.m2 / (self.count - 1)) ** 0.5
+
+    def p99_recent(self) -> float | None:
+        if not self.recent:
+            return None
+        vals = sorted(self.recent)
+        # nearest-rank p99: with few samples this is the max, which is
+        # exactly what the spike trigger wants to see
+        return vals[max(0, -(-99 * len(vals) // 100) - 1)]
+
+
+class TriggeredProfiler:
+    """Profile-on-anomaly capture: ring + triggers + bounded bundles.
+
+    ``registry``/``tracer`` default to the process globals *at event
+    time* (tests swap them mid-process), matching the flight recorder.
+    ``clock`` is injectable so the rate-limit tests don't sleep."""
+
+    def __init__(
+        self,
+        dir: str,
+        *,
+        window_s: float = 30.0,
+        trace_s: float | None = None,
+        ring_capacity: int = 256,
+        min_interval_s: float = 30.0,
+        max_captures: int = 4,
+        registry: Any = None,
+        tracer: Any = None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.dir = str(dir)
+        self.window_s = float(window_s)
+        if trace_s is None:
+            try:
+                trace_s = float(os.environ.get(_ENV_TRACE_S, "0.25") or 0.25)
+            except ValueError:
+                trace_s = 0.25
+        self.trace_s = float(trace_s)
+        self.ring_capacity = int(ring_capacity)
+        self.min_interval_s = float(min_interval_s)
+        self.max_captures = int(max_captures)
+        self._registry = registry
+        self._tracer = tracer
+        self._clock = clock
+        self._lock = threading.Lock()  # rate-limit state + trigger table
+        self._feed_lock = threading.Lock()  # dispatch ring writers
+        self._rings: dict[str, _ProgramRing] = {}
+        self._triggers: dict[str, Callable[[], Mapping | None]] = {}
+        self._seq = 0
+        self._last_capture_t: float | None = None
+        self.captures: list[str] = []
+        self.fired: dict[str, int] = {}
+        self.suppressed: dict[str, int] = {}
+
+    # -- the always-on dispatch-timing ring ------------------------------
+
+    def record_dispatch(self, program: str, seconds: float) -> None:
+        """Feed one sampled dispatch timing. Called from the compile
+        registry's attribution worker thread — never a dispatch thread —
+        so this can take a lock without touching any hot path."""
+        with self._feed_lock:
+            ring = self._rings.get(program)
+            if ring is None:
+                ring = self._rings[program] = _ProgramRing(self.ring_capacity)
+            ring.add(float(seconds))
+
+    def ring_snapshot(self) -> dict:
+        """Per-program timing summary (the ``timings.json`` payload)."""
+        with self._feed_lock:
+            items = list(self._rings.items())
+            out = {}
+            for name, r in items:
+                out[name] = {
+                    "samples": r.count,
+                    "mean_s": r.mean,
+                    "std_s": r.std(),
+                    "p99_recent_s": r.p99_recent(),
+                    "recent_s": list(r.recent)[-32:],
+                }
+        return out
+
+    # -- named triggers ---------------------------------------------------
+
+    def add_trigger(self, name: str, fn: Callable[[], Mapping | None]) -> "TriggeredProfiler":
+        """Register a poll-time condition: ``fn()`` returns a detail dict
+        when the trigger should fire, None otherwise. Evaluated by
+        :meth:`poll` (the fleet monitor's cadence); a raising condition
+        is dropped for that poll, never propagated."""
+        with self._lock:
+            self._triggers[name] = fn
+        return self
+
+    def arm_compile_delta(self) -> "TriggeredProfiler":
+        """Fire when the process compile count moves past the count at
+        arming time — arm *after* warmup, so any hit is a silent
+        steady-state recompile (the CompileDelta>0 condition)."""
+        from ..compile.metrics import compiles_total
+
+        state = {"baseline": compiles_total()}
+
+        def _check() -> Mapping | None:
+            n = compiles_total()
+            if n > state["baseline"]:
+                detail = {"compiles": n - state["baseline"], "total": n}
+                state["baseline"] = n  # re-arm; the rate limiter dedups
+                return detail
+            return None
+
+        return self.add_trigger("compile_delta", _check)
+
+    def arm_p99_spike(self, zscore: float = 4.0, min_samples: int = 16) -> "TriggeredProfiler":
+        """Fire when some program's recent p99 dispatch time z-scores
+        more than ``zscore`` above its own lifetime mean."""
+        z = float(zscore)
+        k = int(min_samples)
+
+        def _check() -> Mapping | None:
+            with self._feed_lock:
+                rings = list(self._rings.items())
+                for name, r in rings:
+                    if r.count < k:
+                        continue
+                    std = r.std()
+                    p99 = r.p99_recent()
+                    if std <= 0.0 or p99 is None:
+                        continue
+                    score = (p99 - r.mean) / std
+                    if score > z:
+                        return {
+                            "program": name,
+                            "zscore": round(score, 2),
+                            "p99_recent_s": p99,
+                            "mean_s": r.mean,
+                        }
+            return None
+
+        return self.add_trigger("p99_spike", _check)
+
+    def poll(self) -> str | None:
+        """Evaluate every armed trigger condition; returns the capture
+        path if one fired (first hit wins per poll). Cheap when nothing
+        trips: one dict snapshot plus the condition lambdas."""
+        with self._lock:
+            triggers = list(self._triggers.items())
+        for name, fn in triggers:
+            try:
+                detail = fn()
+            except Exception:
+                continue
+            if detail is not None:
+                return self.trigger(name, dict(detail))
+        return None
+
+    # -- capture ----------------------------------------------------------
+
+    def trigger(self, name: str, detail: Mapping | None = None, *, force: bool = False) -> str | None:
+        """Request one capture for trigger ``name``. Rate-limited
+        (``min_interval_s`` between captures unless ``force``, hard
+        ``max_captures`` cap always); returns the bundle path or None
+        when suppressed. Never raises — triggers fire from monitor and
+        escalation threads that must survive a profiler bug."""
+        try:
+            with self._lock:
+                now = self._clock()
+                if self._seq >= self.max_captures or (
+                    not force
+                    and self._last_capture_t is not None
+                    and now - self._last_capture_t < self.min_interval_s
+                ):
+                    self.suppressed[name] = self.suppressed.get(name, 0) + 1
+                    self._event(name, captured=False)
+                    return None
+                self._seq += 1
+                seq = self._seq
+                self._last_capture_t = now
+                self.fired[name] = self.fired.get(name, 0) + 1
+            path = self._capture(name, seq, dict(detail or {}))
+            with self._lock:
+                self.captures.append(path)
+            self._event(name, captured=True, path=path)
+            return path
+        except Exception:
+            return None
+
+    def _capture(self, name: str, seq: int, detail: dict) -> str:
+        stamp = time.strftime("%Y%m%dT%H%M%S", time.gmtime())
+        safe = "".join(c if c.isalnum() or c in "-_." else "_" for c in name)
+        path = os.path.join(self.dir, f"profile-{safe}-{stamp}-{seq:03d}")
+        os.makedirs(path, exist_ok=True)
+        failed: list[str] = []
+
+        jax_trace = self._jax_trace(os.path.join(path, "jax_trace"))
+
+        try:
+            with open(os.path.join(path, "timings.json"), "w") as f:
+                json.dump(self.ring_snapshot(), f, indent=2, sort_keys=True,
+                          default=_json_default)
+        except Exception as e:
+            failed.append(f"timings: {e!r}")
+
+        tracer = self._resolve_tracer()
+        try:
+            since = max(0.0, tracer.now_us() - self.window_s * 1e6)
+            tracer.export(os.path.join(path, "trace.json"), since_us=since)
+        except Exception as e:
+            failed.append(f"trace: {e!r}")
+
+        meta = {
+            "trigger": name,
+            "detail": detail,
+            "wall_time_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+            "trace_s": self.trace_s,
+            "window_s": self.window_s,
+            "seq": seq,
+            "jax_trace": jax_trace,
+            "failed_artifacts": failed,
+        }
+        with open(os.path.join(path, "meta.json"), "w") as f:
+            json.dump(meta, f, indent=2, sort_keys=True, default=_json_default)
+            f.write("\n")
+        return path
+
+    def _jax_trace(self, dir: str) -> str:
+        """Feature-detected device-timeline capture: start the profiler,
+        hold the window open ``trace_s``, stop. Any missing API or
+        backend refusal degrades to a note in meta.json — graceful
+        no-op everywhere jax.profiler isn't supported.
+
+        ``trace_s <= 0`` skips the device trace entirely (host-only
+        bundle): on some builds ``start_trace`` lazily imports its whole
+        profiler backend (tens of seconds, on whatever thread fired the
+        trigger — often a monitor or escalation path), so zero must mean
+        *zero*, not "a very short trace"."""
+        if self.trace_s <= 0.0:
+            return "disabled: trace_s=0"
+        try:
+            from jax import profiler as jprof
+        except Exception as e:
+            return f"unsupported: {e!r}"
+        start = getattr(jprof, "start_trace", None)
+        stop = getattr(jprof, "stop_trace", None)
+        if start is None or stop is None:
+            return "unsupported: no start_trace/stop_trace"
+        try:
+            start(dir)
+        except Exception as e:
+            return f"unsupported: {e!r}"
+        try:
+            time.sleep(self.trace_s)
+        finally:
+            try:
+                stop()
+            except Exception as e:
+                return f"stop failed: {e!r}"
+        return "captured"
+
+    # -- obs plumbing ------------------------------------------------------
+
+    def _resolve_tracer(self):
+        if self._tracer is not None:
+            return self._tracer
+        from .trace import get_tracer
+
+        return get_tracer()
+
+    def _event(self, name: str, captured: bool, path: str | None = None) -> None:
+        """Counter + tracer instant per trigger evaluation that fired;
+        fenced — observability about observability must not recurse into
+        a failure."""
+        try:
+            reg = self._registry
+            if reg is None:
+                from .registry import get_registry
+
+                reg = get_registry()
+            if captured:
+                c = reg.counter(
+                    "rl_tpu_profiler_captures_total",
+                    "profiler captures written, by trigger",
+                    labels=("trigger",),
+                )
+            else:
+                c = reg.counter(
+                    "rl_tpu_profiler_suppressed_total",
+                    "profiler triggers suppressed by the rate limit / cap",
+                    labels=("trigger",),
+                )
+            c.inc(labels={"trigger": name})
+            self._resolve_tracer().instant(
+                "profiler_capture" if captured else "profiler_suppressed",
+                {"trigger": name, **({"path": path} if path else {})},
+            )
+        except Exception:
+            pass
+
+    def snapshot(self) -> dict:
+        """Bench-artifact form."""
+        with self._lock:
+            return {
+                "captures": list(self.captures),
+                "fired": dict(self.fired),
+                "suppressed": dict(self.suppressed),
+                "triggers_armed": sorted(self._triggers),
+                "programs_ringed": len(self._rings),
+            }
+
+
+# -- process-global installation (disarmed by default) -------------------------
+
+_profiler: TriggeredProfiler | None = None
+
+
+def get_profiler() -> TriggeredProfiler | None:
+    """The armed process-wide profiler, or None (default: disarmed —
+    every feed/trigger hook is a single None check when off)."""
+    return _profiler
+
+
+def set_profiler(prof: TriggeredProfiler | None) -> TriggeredProfiler | None:
+    """Arm ``prof`` process-wide; returns the previous profiler."""
+    global _profiler
+    prev = _profiler
+    _profiler = prof
+    return prev

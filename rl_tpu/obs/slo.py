@@ -35,7 +35,13 @@ import threading
 import time
 from typing import Sequence
 
-__all__ = ["DEFAULT_LATENCY_EDGES", "Objective", "SLOEngine", "StreamingHistogram"]
+__all__ = [
+    "DEFAULT_LATENCY_EDGES",
+    "Objective",
+    "SLOEngine",
+    "StreamingHistogram",
+    "merge_histograms",
+]
 
 # log-spaced 1ms..60s: wide enough for TTFT and full-completion latency
 # on every tier (the obs registry's default buckets stop at 10s).
@@ -124,6 +130,21 @@ class StreamingHistogram:
                 "sum": self.sum,
                 "count": self.count,
             }
+
+
+def merge_histograms(hists) -> StreamingHistogram | None:
+    """Pool several same-edge :class:`StreamingHistogram`\\ s into a
+    fresh one (inputs untouched). Counts add exactly, so a quantile of
+    the merged histogram equals the quantile of one histogram fed every
+    raw sample — the property the fleet-wide TTFT/latency gauges rely
+    on when rolling up per-member histograms. None for no inputs."""
+    hists = list(hists)
+    if not hists:
+        return None
+    out = StreamingHistogram(hists[0].edges)
+    for h in hists:
+        out.merge(h)
+    return out
 
 
 class Objective:

@@ -49,6 +49,7 @@ __all__ = [
     "new_trace",
     "set_tracer",
     "use_context",
+    "wire_tracer_obs",
 ]
 
 DEFAULT_CAPACITY = 16384
@@ -158,12 +159,17 @@ class _ThreadRing:
     drop-oldest behaviour for free and its append is atomic under the GIL,
     which makes the exporter's snapshot (``list(ring)``) safe too."""
 
-    __slots__ = ("tid", "name", "events")
+    __slots__ = ("tid", "name", "events", "dropped")
 
     def __init__(self, tid: int, name: str, capacity: int):
         self.tid = tid
         self.name = name
         self.events: deque = deque(maxlen=capacity)
+        # events lapped out of the ring (append at maxlen evicts the
+        # oldest silently) — without this count a wrapped ring exports a
+        # truncated trace tree with no signal that events were lost.
+        # Owner-thread-only writes; readers tolerate a stale value.
+        self.dropped = 0
 
 
 class TraceRecorder:
@@ -206,6 +212,14 @@ class TraceRecorder:
             self._local.ring = ring
         return ring
 
+    def _emit(self, ev: dict) -> None:
+        """Append to the calling thread's ring, counting the lap when a
+        full ring is about to evict its oldest event."""
+        ring = self._ring()
+        if len(ring.events) == self.capacity:
+            ring.dropped += 1
+        ring.events.append(ev)
+
     def _now_us(self) -> float:
         return (time.perf_counter_ns() - self._t0_ns) / 1e3
 
@@ -229,7 +243,7 @@ class TraceRecorder:
             ev = {"ph": "X", "name": name, "ts": start, "dur": end - start}
             if args:
                 ev["args"] = dict(args)
-            self._ring().events.append(ev)
+            self._emit(ev)
 
     @contextmanager
     def ctx_span(
@@ -262,7 +276,7 @@ class TraceRecorder:
             a = dict(args) if args else {}
             a.update(ctx_args(span_ctx))
             ev["args"] = a
-            self._ring().events.append(ev)
+            self._emit(ev)
 
     def begin_span(self, name: str, args: Mapping[str, Any] | None = None) -> float:
         """Manual span start for code that can't use a ``with`` block
@@ -277,7 +291,7 @@ class TraceRecorder:
         ev = {"ph": "X", "name": name, "ts": start_us, "dur": self._now_us() - start_us}
         if args:
             ev["args"] = dict(args)
-        self._ring().events.append(ev)
+        self._emit(ev)
 
     def instant(self, name: str, args: Mapping[str, Any] | None = None) -> None:
         """Point event (watchdog death, preemption signal, straggler cut)."""
@@ -286,13 +300,13 @@ class TraceRecorder:
         ev = {"ph": "i", "name": name, "ts": self._now_us(), "s": "t"}
         if args:
             ev["args"] = dict(args)
-        self._ring().events.append(ev)
+        self._emit(ev)
 
     def counter(self, name: str, values: Mapping[str, float]) -> None:
         """Counter track sample (queue depth over time, tokens/s)."""
         if not self._enabled:
             return
-        self._ring().events.append(
+        self._emit(
             {
                 "ph": "C",
                 "name": name,
@@ -319,7 +333,14 @@ class TraceRecorder:
                     "name": "thread_name",
                     "pid": self._pid,
                     "tid": ring.tid,
-                    "args": {"name": ring.name},
+                    # dropped stamps the lap count into the export so a
+                    # truncated tree is self-describing (only when nonzero:
+                    # exact-equality round-trip consumers see no change)
+                    "args": (
+                        {"name": ring.name, "dropped": ring.dropped}
+                        if ring.dropped
+                        else {"name": ring.name}
+                    ),
                 }
             )
             for ev in list(ring.events):
@@ -343,11 +364,23 @@ class TraceRecorder:
                 json.dump(trace, f)
         return trace
 
+    def dropped_events(self) -> dict[str, int]:
+        """Events lapped out of each ring, summed per thread name (two
+        threads with one name — Supervisor restarts — fold together).
+        Zero-drop threads are included so the exporter emits a 0 total."""
+        with self._lock:
+            rings = list(self._rings)
+        out: dict[str, int] = {}
+        for ring in rings:
+            out[ring.name] = out.get(ring.name, 0) + ring.dropped
+        return out
+
     def clear(self) -> None:
         with self._lock:
             rings = list(self._rings)
         for ring in rings:
             ring.events.clear()
+            ring.dropped = 0
 
 
 _TRACER = TraceRecorder()
@@ -365,3 +398,29 @@ def set_tracer(tracer: TraceRecorder) -> TraceRecorder:
     prev = _TRACER
     _TRACER = tracer
     return prev
+
+
+def wire_tracer_obs(registry=None) -> None:
+    """Export ``rl_tpu_trace_dropped_events_total{thread}`` through a
+    scrape-time collector on ``registry`` (default: the process metrics
+    registry). Reads the *current* process tracer at scrape time, so a
+    ``set_tracer`` swap after wiring is honored. Idempotent per registry
+    object — the fleet and the serving service both call this."""
+    if registry is None:
+        from .registry import get_registry
+
+        registry = get_registry()
+    if getattr(registry, "_rl_tpu_trace_drop_wired", False):
+        return
+    c_drop = registry.counter(
+        "rl_tpu_trace_dropped_events_total",
+        "trace events lapped out of a full per-thread ring buffer",
+        labels=("thread",),
+    )
+
+    def _collect():
+        for name, n in get_tracer().dropped_events().items():
+            c_drop.set_total(float(n), {"thread": name})
+
+    registry.register_collector(_collect)
+    registry._rl_tpu_trace_drop_wired = True

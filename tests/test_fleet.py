@@ -324,3 +324,31 @@ class TestFleetObservability:
         fleet.shutdown()
         # collector unregistered on shutdown: render must not blow up
         reg.render()
+
+    def test_fleet_quantile_gauges_merge_member_histograms(self):
+        """PR-18: fleet-wide TTFT/latency quantiles come from merging the
+        per-member StreamingHistograms, so the exported p50/p99 reflect
+        every replica's traffic, not one member's."""
+        reg = MetricsRegistry()
+        fleet = _fleet(_engines(2), registry=reg).start()
+        try:
+            rng = np.random.default_rng(1)
+            frids = [fleet.submit(rng.integers(0, 97, 8), 4)
+                     for _ in range(6)]
+            fleet.wait(frids, timeout=60)
+            text = reg.render()
+            for metric in ("rl_tpu_fleet_ttft_seconds",
+                           "rl_tpu_fleet_latency_seconds"):
+                for q in ("0.5", "0.99"):
+                    line = next(
+                        (ln for ln in text.splitlines()
+                         if ln.startswith(f'{metric}{{quantile="{q}"}}')),
+                        None)
+                    assert line is not None, f"{metric} q={q} missing"
+                    assert float(line.split()[-1]) > 0.0
+            # merged == pooled: both members' samples are represented
+            pooled = sum(
+                m.ttft_hist.snapshot()["count"] for m in fleet._members)
+            assert pooled == 6
+        finally:
+            fleet.shutdown()

@@ -6,6 +6,7 @@ telemetry, DeviceMetrics accumulate-in-jit + single-drain, and the HTTP
 scrape endpoint."""
 
 import json
+import random
 import threading
 import urllib.error
 import urllib.request
@@ -17,9 +18,13 @@ import pytest
 from rl_tpu.obs import (
     MetricsHTTPServer,
     MetricsRegistry,
+    StreamingHistogram,
     TraceRecorder,
+    TriggeredProfiler,
+    merge_histograms,
     set_registry,
     set_tracer,
+    wire_tracer_obs,
 )
 from rl_tpu.obs.device import DeviceMetrics
 
@@ -418,6 +423,229 @@ class TestMetricsHTTP:
             assert "rl_tpu_up_total 3" in body
             with pytest.raises(urllib.error.HTTPError) as ei:
                 urllib.request.urlopen(f"http://{host}:{port}/nope", timeout=10)
+            assert ei.value.code == 404
+        finally:
+            srv.shutdown()
+
+
+# -- trace drop accounting (PR-18) --------------------------------------------
+
+
+class TestTraceDrops:
+    def test_dropped_events_counts_overwrites(self):
+        tracer = TraceRecorder(capacity=8)
+        for i in range(20):
+            tracer.instant(f"e{i}")
+        # 20 events into an 8-slot ring: 12 oldest were overwritten
+        assert tracer.dropped_events() == {"MainThread": 12}
+
+    def test_export_metadata_carries_drop_count_only_when_nonzero(self):
+        tracer = TraceRecorder(capacity=4)
+        for i in range(10):
+            tracer.instant(f"e{i}")
+        metas = [e for e in tracer.export()["traceEvents"] if e["ph"] == "M"]
+        assert metas[0]["args"] == {"name": "MainThread", "dropped": 6}
+        tracer2 = TraceRecorder(capacity=64)
+        tracer2.instant("fits")
+        metas2 = [e for e in tracer2.export()["traceEvents"] if e["ph"] == "M"]
+        assert "dropped" not in metas2[0]["args"]
+
+    def test_clear_resets_drop_counts(self):
+        tracer = TraceRecorder(capacity=2)
+        for i in range(5):
+            tracer.instant(f"e{i}")
+        assert tracer.dropped_events()["MainThread"] == 3
+        tracer.clear()
+        assert tracer.dropped_events() == {"MainThread": 0}
+
+    def test_per_thread_attribution(self):
+        tracer = TraceRecorder(capacity=4)
+
+        def noisy():
+            for i in range(9):
+                tracer.instant(f"n{i}")
+
+        t = threading.Thread(target=noisy, name="noisy")
+        t.start()
+        t.join()
+        tracer.instant("quiet")  # main thread: under capacity, zero drops
+        drops = tracer.dropped_events()
+        assert drops["noisy"] == 5
+        assert drops["MainThread"] == 0  # zero-drop threads still listed
+
+    def test_wire_tracer_obs_exports_counter(self, fresh_obs):
+        reg, tracer = fresh_obs
+        wire_tracer_obs(reg)
+        wire_tracer_obs(reg)  # idempotent: no duplicate-collector explosion
+        for i in range(10):
+            tracer.instant(f"e{i}")
+        # default capacity is large; force the drop path with a tiny ring
+        small = TraceRecorder(capacity=4)
+        prev = set_tracer(small)
+        try:
+            for i in range(10):
+                small.instant(f"e{i}")
+            text = reg.render()
+        finally:
+            set_tracer(prev)
+        assert 'rl_tpu_trace_dropped_events_total{thread="MainThread"} 6' in text
+
+
+# -- fleet-wide quantile merge (PR-18) ----------------------------------------
+
+
+class TestHistogramMerge:
+    def test_merged_quantiles_equal_pooled_raw_samples(self):
+        """The fleet-gauge contract: merging per-member histograms is
+        EXACTLY equivalent to one histogram fed every raw sample —
+        bucket counts add, so every interpolated quantile is identical."""
+        rng = random.Random(18)
+        members = [StreamingHistogram() for _ in range(3)]
+        pooled = StreamingHistogram()
+        for i, h in enumerate(members):
+            for _ in range(200 + 100 * i):  # deliberately uneven loads
+                v = rng.lognormvariate(-2.0, 1.5)
+                h.observe(v)
+                pooled.observe(v)
+        merged = merge_histograms(members)
+        assert merged is not None
+        for q in (0.5, 0.9, 0.99):
+            assert merged.quantile(q) == pooled.quantile(q)
+        assert merged.snapshot()["count"] == pooled.snapshot()["count"]
+
+    def test_merge_requires_matching_edges(self):
+        a = StreamingHistogram(edges=(0.1, 1.0))
+        b = StreamingHistogram(edges=(0.2, 2.0))
+        with pytest.raises(ValueError):
+            a.merge(b)
+
+    def test_merge_histograms_empty_iterable_is_none(self):
+        assert merge_histograms([]) is None
+
+    def test_merge_does_not_mutate_members(self):
+        a, b = StreamingHistogram(), StreamingHistogram()
+        a.observe(0.5)
+        b.observe(0.7)
+        before = (a.snapshot()["count"], b.snapshot()["count"])
+        merge_histograms([a, b])
+        assert (a.snapshot()["count"], b.snapshot()["count"]) == before
+
+
+# -- HTTP debug surface (PR-18) -----------------------------------------------
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return r.status, r.headers["Content-Type"], r.read()
+
+
+def _post(url, data=b""):
+    req = urllib.request.Request(url, data=data, method="POST")
+    with urllib.request.urlopen(req, timeout=10) as r:
+        return r.status, r.read()
+
+
+class TestHTTPDebugSurface:
+    def test_healthz(self):
+        srv = MetricsHTTPServer(MetricsRegistry()).start()
+        try:
+            host, port = srv.address
+            status, ctype, body = _get(f"http://{host}:{port}/healthz")
+            assert status == 200 and body == b"ok\n"
+            assert ctype.startswith("text/plain")
+        finally:
+            srv.shutdown()
+
+    def test_debug_state_round_trips_snapshot(self):
+        snap = {"queued": 3, "members": [{"id": 0, "ok": True}]}
+        srv = MetricsHTTPServer(MetricsRegistry(), state_fn=lambda: snap).start()
+        try:
+            host, port = srv.address
+            status, ctype, body = _get(f"http://{host}:{port}/debug/state")
+            assert status == 200 and ctype.startswith("application/json")
+            assert json.loads(body) == snap
+        finally:
+            srv.shutdown()
+
+    def test_debug_state_404_without_state_fn(self):
+        srv = MetricsHTTPServer(MetricsRegistry()).start()
+        try:
+            host, port = srv.address
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _get(f"http://{host}:{port}/debug/state")
+            assert ei.value.code == 404
+        finally:
+            srv.shutdown()
+
+    def test_debug_state_bounds_oversize_snapshot(self):
+        big = {"blob": "x" * 4096}
+        srv = MetricsHTTPServer(
+            MetricsRegistry(), state_fn=lambda: big, max_state_bytes=256
+        ).start()
+        try:
+            host, port = srv.address
+            _, _, body = _get(f"http://{host}:{port}/debug/state")
+            doc = json.loads(body)
+            assert doc["error"] == "state snapshot too large"
+            assert doc["bytes"] > doc["limit"] == 256
+        finally:
+            srv.shutdown()
+
+    def test_debug_state_raising_state_fn_degrades_to_error(self):
+        def boom():
+            raise RuntimeError("snapshot deadlocked")
+
+        srv = MetricsHTTPServer(MetricsRegistry(), state_fn=boom).start()
+        try:
+            host, port = srv.address
+            _, _, body = _get(f"http://{host}:{port}/debug/state")
+            assert "snapshot deadlocked" in json.loads(body)["error"]
+        finally:
+            srv.shutdown()
+
+    def test_post_profile_fires_manual_trigger(self, tmp_path):
+        prof = TriggeredProfiler(str(tmp_path), trace_s=0.0)
+        srv = MetricsHTTPServer(MetricsRegistry(), profiler=prof).start()
+        try:
+            host, port = srv.address
+            status, body = _post(f"http://{host}:{port}/profile")
+            assert status == 200
+            capture = json.loads(body)["capture"]
+            assert capture is not None
+            meta = json.loads(
+                open(f"{capture}/meta.json").read()
+            )
+            assert meta["trigger"] == "manual"
+            assert meta["detail"] == {"source": "http"}
+        finally:
+            srv.shutdown()
+
+    def test_post_profile_404_when_no_profiler_armed(self):
+        from rl_tpu.obs.profiling import set_profiler
+
+        prev = set_profiler(None)
+        srv = MetricsHTTPServer(MetricsRegistry()).start()
+        try:
+            host, port = srv.address
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _post(f"http://{host}:{port}/profile")
+            assert ei.value.code == 404
+        finally:
+            srv.shutdown()
+            set_profiler(prev)
+
+    def test_method_discipline_405(self):
+        srv = MetricsHTTPServer(MetricsRegistry()).start()
+        try:
+            host, port = srv.address
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _get(f"http://{host}:{port}/profile")  # GET a POST route
+            assert ei.value.code == 405
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _post(f"http://{host}:{port}/metrics")  # POST a GET route
+            assert ei.value.code == 405
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _post(f"http://{host}:{port}/nope")
             assert ei.value.code == 404
         finally:
             srv.shutdown()

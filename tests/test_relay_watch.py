@@ -743,6 +743,88 @@ def test_rlint_artifact_refreshed_and_committed(tmp_path):
         assert flagged == (rc != 0)
 
 
+def test_profiling_section_distilled_to_own_artifact(tmp_path):
+    """PR-18: the fleet sub-bench's ``profiling`` section (armed
+    TriggeredProfiler overhead bound, capture ledger, drift-event
+    summary) lands whole in its own committed PROF json — the file the
+    offline perf sentry gates — riding the same single commit."""
+
+    class ProfRunner(FakeRunner):
+        def bench_all(self, timeout):
+            self.bench_calls.append(timeout)
+            pf = {
+                "armed_overhead_frac": 0.00041,
+                "feed_cost_us": 2.1,
+                "fed_dispatches": 143,
+                "captures": 1,
+                "capture_triggers": {"slo_burn": 1},
+                "suppressed": {},
+                "triggers_armed": ["slo_burn", "compile_delta", "p99_spike"],
+                "programs_ringed": 5,
+                "drift": {"tolerance": 1.5, "events_total": 0,
+                          "programs": 5, "fired": []},
+            }
+            lines = [
+                {"metric": "ppo", "value": 123.0},
+                {"fleet": {"value": 215.1, "profiling": pf,
+                           "metrics": {"fleet_tokens_per_sec": 215.1}}},
+            ]
+            return 0, "".join(json.dumps(ln) + "\n" for ln in lines)
+
+    runner = ProfRunner([_healthy()])
+    art = str(tmp_path / "bench.jsonl")
+    mart = str(tmp_path / "METRICS.json")
+    pfart = str(tmp_path / "PROF.json")
+    watch(runner, lambda s: None, max_probes=1, artifact=art,
+          metrics_artifact=mart, profiling_artifact=pfart,
+          sleep=lambda s: None)
+    doc = json.loads(open(pfart).read())
+    pf = doc["profiling"]
+    assert pf["armed_overhead_frac"] == 0.00041  # the sentry-gated bound
+    assert pf["capture_triggers"] == {"slo_burn": 1}
+    assert pf["drift"]["tolerance"] == 1.5
+    assert doc["artifact"] == os.path.relpath(art, REPO)
+    # all three files land in ONE commit
+    assert len(runner.commits) == 1
+    assert runner.commits[0][0] == [art, mart, pfart]
+
+
+def test_sentry_gate_runs_after_bench_and_commits_history(tmp_path):
+    """PR-18: a runner exposing ``sentry`` gets the offline perf sentry
+    run over the freshly (re)written artifact series, with the
+    PERF_HISTORY roll-up landing in the SAME commit. A nonzero rc (a
+    regression) still commits the history so the failure is in-tree."""
+
+    class SentryRunner(FakeRunner):
+        def __init__(self, probes, rc=0):
+            super().__init__(probes)
+            self.sentry_calls = []
+            self.rc = rc
+
+        def sentry(self, out, timeout=120.0):
+            self.sentry_calls.append(out)
+            with open(out, "w") as f:
+                json.dump({"gate_counts":
+                           {"pass": 14, "fail": 1 if self.rc else 0,
+                            "skip": 2}}, f)
+            return self.rc, "perf_sentry: ..."
+
+    for rc in (0, 1):
+        runner = SentryRunner([_healthy()], rc=rc)
+        art = str(tmp_path / f"bench_{rc}.jsonl")
+        separt = str(tmp_path / f"HIST_{rc}.json")
+        lines = []
+        watch(runner, lines.append, max_probes=1, artifact=art,
+              sentry_artifact=separt, sleep=lambda s: None)
+        assert runner.sentry_calls == [separt]
+        doc = json.loads(open(separt).read())
+        assert doc["gate_counts"]["fail"] == (1 if rc else 0)
+        assert len(runner.commits) == 1
+        assert runner.commits[0][0] == [art, separt]
+        flagged = any("PERF REGRESSION" in ln for ln in lines)
+        assert flagged == (rc != 0)
+
+
 def test_runner_without_rlint_unchanged(tmp_path):
     """Older/minimal runners (no ``rlint`` method) keep the pre-PR-8
     commit set: the watcher feature-detects instead of requiring it."""

@@ -254,6 +254,33 @@ def _extract_obs(stdout: str) -> dict | None:
     return found
 
 
+def _extract_profiling(stdout: str) -> dict | None:
+    """Find the fleet sub-bench's ``profiling`` section (PR-18 adaptive
+    profiling: the armed TriggeredProfiler/DriftDetector's view of the
+    chaos window — measured armed-feed overhead fraction vs the 2%
+    bound, capture counts per trigger, suppressions, and the drift
+    detector's per-program comparison roll-up) in a bench stdout JSONL
+    stream. The per-trigger dicts carry structure worth keeping whole,
+    so they get their own committed PROF artifact — which is also what
+    the offline perf sentry gates. Last match wins (the final aggregate
+    line repeats the sub-results)."""
+    found = None
+    for ln in (stdout or "").strip().splitlines():
+        try:
+            d = json.loads(ln)
+        except ValueError:
+            continue
+        if not isinstance(d, dict):
+            continue
+        for c in [d] + [v for v in d.values() if isinstance(v, dict)]:
+            v = c.get("profiling")
+            if isinstance(v, dict) and (
+                "armed_overhead_frac" in v or "drift" in v
+            ):
+                found = v
+    return found
+
+
 def _extract_ir_audit(stdout: str) -> dict:
     """Collect every ``ir_audit`` section (PR-15 deep-tier auditor: per-
     program predicted-vs-measured MFU from the static roofline, audit
@@ -334,6 +361,26 @@ class Runner:
             return 124, ""
         return p.returncode, p.stdout
 
+    def sentry(self, out: str, timeout: float = 120.0) -> tuple[int, str]:
+        """Run the offline perf sentry (PR-18) over the repo's committed
+        artifact series, refreshing ``PERF_HISTORY.json``. rc!=0 means a
+        declared regression gate failed; the roll-up is still written so
+        the regression is visible in-tree next to the artifact that
+        introduced it."""
+        try:
+            p = subprocess.run(
+                [
+                    sys.executable,
+                    os.path.join(REPO, "tools", "perf_sentry.py"),
+                    "--out",
+                    out,
+                ],
+                cwd=REPO, capture_output=True, text=True, timeout=timeout,
+            )
+        except subprocess.TimeoutExpired:
+            return 124, ""
+        return p.returncode, p.stdout
+
     def commit(self, paths: list[str], message: str) -> int:
         rc = subprocess.run(["git", "-C", REPO, "add", *paths]).returncode
         if rc != 0:
@@ -358,6 +405,8 @@ def watch(
     kernels_artifact: str | None = None,
     obs_artifact: str | None = None,
     audit_artifact: str | None = None,
+    profiling_artifact: str | None = None,
+    sentry_artifact: str | None = None,
     rlint_artifact: str | None = None,
     commit: bool = True,
     require_tpu: bool = True,
@@ -534,6 +583,21 @@ def watch(
                 f.write("\n")
             paths.append(iapath)
             log(f"{_utcnow()} ir_audit -> {os.path.relpath(iapath, REPO)}")
+        pf = _extract_profiling(bout)
+        if pf is not None:
+            pfpath = profiling_artifact or os.path.join(REPO, "PROF_pr18.json")
+            with open(pfpath, "w") as f:
+                json.dump(
+                    {
+                        "artifact": os.path.relpath(path, REPO),
+                        "generated": _utcnow(),
+                        "profiling": pf,
+                    },
+                    f, indent=2, sort_keys=True,
+                )
+                f.write("\n")
+            paths.append(pfpath)
+            log(f"{_utcnow()} profiling -> {os.path.relpath(pfpath, REPO)}")
         if hasattr(runner, "rlint"):
             # PR-8: keep the static-analysis summary current alongside the
             # perf artifacts — the same commit that records a measurement
@@ -545,6 +609,19 @@ def watch(
             log(
                 f"{_utcnow()} rlint rc={rrc} -> {os.path.relpath(rlpath, REPO)}"
                 + (" (UNSUPPRESSED FINDINGS)" if rrc != 0 else "")
+            )
+        if hasattr(runner, "sentry"):
+            # PR-18: gate the artifact series this commit just (re)wrote —
+            # the measurement and the regression verdict it produced land
+            # in the same commit, so a perf regression is never silently
+            # recorded
+            sepath = sentry_artifact or os.path.join(REPO, "PERF_HISTORY.json")
+            src, _ = runner.sentry(sepath)
+            if os.path.exists(sepath):
+                paths.append(sepath)
+            log(
+                f"{_utcnow()} sentry rc={src} -> {os.path.relpath(sepath, REPO)}"
+                + (" (PERF REGRESSION)" if src != 0 else "")
             )
         if commit:
             crc = runner.commit(
@@ -585,6 +662,10 @@ def main(argv=None) -> int:
                     help="fleet trace/SLO/flight-record path (default OBS_pr12.json)")
     ap.add_argument("--audit-artifact", default=None,
                     help="IR-audit predicted-vs-measured MFU path (default AUDIT_pr15.json)")
+    ap.add_argument("--profiling-artifact", default=None,
+                    help="profiler/drift distillation path (default PROF_pr18.json)")
+    ap.add_argument("--sentry-artifact", default=None,
+                    help="perf-sentry gate roll-up path (default PERF_HISTORY.json)")
     ap.add_argument("--rlint-artifact", default=None,
                     help="rlint findings-summary path (default RLINT_pr15.json)")
     ap.add_argument("--no-commit", action="store_true")
@@ -614,6 +695,8 @@ def main(argv=None) -> int:
         kernels_artifact=args.kernels_artifact,
         obs_artifact=args.obs_artifact,
         audit_artifact=args.audit_artifact,
+        profiling_artifact=args.profiling_artifact,
+        sentry_artifact=args.sentry_artifact,
         rlint_artifact=args.rlint_artifact,
         commit=not args.no_commit,
     )
