@@ -2515,6 +2515,433 @@ def bench_fleet(report: bool = True) -> dict:
     return out
 
 
+def bench_autoscale(report: bool = True) -> dict:
+    """BENCH_MODE=autoscale: elastic fleet vs fixed fleet on ONE seeded
+    diurnal+bursty replay (the ISSUE-19 tentpole proof).
+
+    The same open-loop arrival plan — a diurnal rate envelope (lull ->
+    peak -> lull) with a 2.5x burst riding the peak and a seeded member
+    crash mid-burst — is replayed against two arms:
+
+    - **fixed**: the fleet stays at its initial size;
+    - **autoscale**: an :class:`~rl_tpu.models.Autoscaler` grows the
+      member set when fleet_ttft burn crosses its threshold (the warm
+      must be COMPILE-FREE: per-event CompileDelta is asserted in the
+      artifact) and drains one back through the failover path when the
+      free_adjusted KV slack is sustained (``lost == 0`` across the
+      scale-down AND the crash).
+
+    Both arms carry the same batch-lane rollout tenant harvesting
+    whatever capacity the interactive SLO lane leaves idle (with a
+    periodic fleet-wide weight push), so the artifact reports: SLO
+    attainment through the burst window per arm (the autoscale arm must
+    win), rollout tokens/s from slack, and idle-capacity waste (idle
+    slot-seconds over PROVISIONED slot-seconds — shrinking in the lulls
+    is where elasticity pays). A flight-recorder bundle is cut at every
+    scale-down carrying the autoscaler decision trail. Stretch sub-result
+    (RL_TPU_BENCH_DISAGG=0 to skip): a prefill/decode disaggregated pair
+    serving the same prompts via paged-KV handoff."""
+    jax = _setup_jax()
+    import contextlib
+    import shutil
+    import tempfile
+    import threading
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from rl_tpu.compile import CompileDelta
+    from rl_tpu.models import (
+        Autoscaler,
+        AutoscalerConfig,
+        ContinuousBatchingEngine,
+        FinishedRequest,
+        ServiceSaturated,
+        ServingFleet,
+        TransformerConfig,
+        TransformerLM,
+    )
+    from rl_tpu.obs import FlightRecorder, MetricsRegistry
+    from rl_tpu.resilience import Fault, FaultInjector, injection
+
+    if _TIER == "smoke":
+        cfg = TransformerConfig(vocab_size=256, d_model=64, n_layers=2,
+                                n_heads=4, d_ff=128, max_seq_len=128,
+                                dtype=jnp.float32)
+        S, bucket, pmax = 4, 16, 12
+        horizon_s, n_lo, n_hi = 5.0, 4, 10
+    elif _TIER == "cpu":
+        cfg = TransformerConfig(vocab_size=1024, d_model=128, n_layers=2,
+                                n_heads=4, d_ff=512, max_seq_len=128,
+                                dtype=jnp.float32)
+        S, bucket, pmax = 4, 16, 12
+        horizon_s, n_lo, n_hi = 14.0, 6, 16
+    else:
+        cfg = TransformerConfig(vocab_size=32768, d_model=768, n_layers=12,
+                                n_heads=12, d_ff=3072, max_seq_len=256,
+                                dtype=jnp.bfloat16)
+        S, bucket, pmax = 8, 32, 24
+        horizon_s, n_lo, n_hi = 20.0, 16, 48
+    slo_ttft_s = 0.2 if _TIER != "full" else 0.15
+    model = TransformerLM(cfg)
+    params = model.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    rng = np.random.default_rng(0)
+
+    def mk_engine(i):
+        # fixed decode_chunk for the same reason as bench_fleet: the
+        # auto-tuner's chunk ladder would recompile mid-traffic
+        return ContinuousBatchingEngine(
+            model, params, n_slots=S, block_size=16,
+            n_blocks=S * (cfg.max_seq_len // 16) + 1,
+            prompt_buckets=(bucket,), greedy=True, decode_chunk=4, seed=i,
+        )
+
+    # warm the FULL ladder once: every later engine build (both arms AND
+    # every autoscaler scale-up) loads from the in-process registry/store
+    t0 = time.perf_counter()
+    warm0 = mk_engine(0)
+    warm0.aot_warmup()
+    for _ in range(2):
+        warm0.submit(rng.integers(0, cfg.vocab_size, 8), 4)
+    warm0.run()
+    compile_s = time.perf_counter() - t0
+
+    # calibrate offered load: one warm replica's rate x2 members x0.95 —
+    # the diurnal peak + burst is what pushes the FIXED arm over
+    n_cal = 2 * S
+    cal = [(rng.integers(0, cfg.vocab_size, int(rng.integers(4, pmax))),
+            int(rng.integers(n_lo, n_hi))) for _ in range(n_cal)]
+    for p, n in cal:
+        warm0.submit(p, n)
+    t0 = time.perf_counter()
+    warm0.run()
+    lam = 0.95 * 2.0 * n_cal / (time.perf_counter() - t0)  # requests/s
+
+    # seeded diurnal plan by thinning: rate(t) = lam*(0.3 + 0.9*sin^2) is
+    # a lull->peak->lull day in miniature; a 1.5*lam Poisson burst rides
+    # the peak at [0.45T, 0.6T]; the crash lands mid-burst at 0.5T
+    T = horizon_s
+    rate_max = 1.2 * lam
+    arrivals = []
+    t = 0.0
+    while t < T:
+        t += rng.exponential(1.0 / rate_max)
+        rate = lam * (0.3 + 0.9 * float(np.sin(np.pi * t / T)) ** 2)
+        if rng.random() < rate / rate_max:
+            arrivals.append(t)
+    b0, b1 = 0.4 * T, 0.65 * T
+    t = b0
+    while t < b1:
+        t += rng.exponential(1.0 / (3.4 * lam))
+        arrivals.append(t)
+    arrivals = sorted(a for a in arrivals if a < T)
+    # the plan is ALL interactive: the batch lane belongs to the rollout
+    # tenant, which is how lane tenancy is exercised
+    plan = [(a, rng.integers(0, cfg.vocab_size, int(rng.integers(4, pmax))),
+             int(rng.integers(n_lo, n_hi))) for a in arrivals]
+    crash_at = 0.5 * T
+
+    def rollout_tenant(fleet, stop_ev, out, rng_seed):
+        """Batch-lane slack harvester: modest depth so the SLO lane always
+        wins admission, sheds simply yield; a fleet-wide weight push every
+        ~2 s proves a publish never stalls serving."""
+        trng = np.random.default_rng(rng_seed)
+        outstanding: set = set()
+        last_push = time.monotonic()
+        while not stop_ev.is_set():
+            now = time.monotonic()
+            if now - last_push >= 2.0:
+                out["pushes"] += 1
+                out["pushed_members"] += fleet.push_params(params)
+                last_push = now
+            while len(outstanding) < S:
+                try:
+                    outstanding.add(fleet.submit(
+                        trng.integers(0, cfg.vocab_size,
+                                      int(trng.integers(4, pmax))),
+                        int(trng.integers(n_lo, n_hi)), lane="batch"))
+                except (ServiceSaturated, RuntimeError):
+                    break
+            for frid, res in fleet.poll(list(outstanding)).items():
+                outstanding.discard(frid)
+                if isinstance(res, FinishedRequest):
+                    out["tokens"] += len(res.tokens)
+                    out["completed"] += 1
+                else:
+                    out["shed"] += 1
+            stop_ev.wait(0.02)
+        # drain what is still in flight (bounded): the tenant's rows are
+        # real tokens the slack produced
+        deadline = time.monotonic() + 30.0
+        while outstanding and time.monotonic() < deadline:
+            for frid, res in fleet.poll(list(outstanding)).items():
+                outstanding.discard(frid)
+                if isinstance(res, FinishedRequest):
+                    out["tokens"] += len(res.tokens)
+                    out["completed"] += 1
+                else:
+                    out["shed"] += 1
+            time.sleep(0.02)
+
+    def waste_sampler(fleet, stop_ev, samples):
+        """(provisioned_slots, busy_slots) every 50 ms: waste is idle
+        slot-seconds over provisioned slot-seconds."""
+        while not stop_ev.is_set():
+            snap = fleet.metrics_snapshot()
+            alive = [m for m in snap["members"]
+                     if m["state"] not in ("dead", "retired")]
+            slots = S * len(alive)
+            busy = sum(min(m["pending"], S) for m in alive)
+            samples.append((slots, busy))
+            stop_ev.wait(0.05)
+
+    def run_arm(elastic: bool) -> dict:
+        reg = MetricsRegistry()
+        engines = [mk_engine(i) for i in range(2)]
+        with CompileDelta() as arm_warm:
+            for e in engines:
+                e.aot_warmup()  # loads — warm0 already built the ladder
+        for e in engines:  # first-round host-glue ops
+            for _ in range(2):
+                e.submit(rng.integers(0, cfg.vocab_size, 8), 4)
+            e.run()
+        fleet = ServingFleet(
+            engines, registry=reg, probe_interval_s=0.02,
+            slo_ttft_s=slo_ttft_s, max_queue=len(plan) + 4 * S,
+            max_members=3,
+        ).start()
+        fleet.push_params(params)  # warm the weight-push path pre-traffic
+        fdir = tempfile.mkdtemp(prefix="rl_tpu_autoscale_flight_")
+        flight = FlightRecorder(fdir, registry=reg)
+        flight.add_source("fleet_scale_events", lambda: fleet.scale_events)
+        scaler = None
+        if elastic:
+            scaler = Autoscaler(
+                fleet, engine_factory=lambda: mk_engine(
+                    10 + fleet.n_routable()),
+                config=AutoscalerConfig(
+                    min_members=2, max_members=3,
+                    burn_window_s=1.5, scale_up_burn=0.3,
+                    scale_down_free_frac=0.8, scale_down_sustain_s=2.0,
+                    cooldown_s=0.5, poll_interval_s=0.05,
+                ),
+                registry=reg, flight=flight,
+            ).start()
+        inj = FaultInjector(
+            {"fleet.engine_crash": Fault("crash", at=(1,))}, registry=reg)
+        stop_ev = threading.Event()
+        tenant = {"tokens": 0, "completed": 0, "shed": 0,
+                  "pushes": 0, "pushed_members": 0}
+        samples: list = []
+        threads = [
+            threading.Thread(target=rollout_tenant, name="bench-tenant",
+                             args=(fleet, stop_ev, tenant, 999), daemon=True),
+            threading.Thread(target=waste_sampler, name="bench-waste",
+                             args=(fleet, stop_ev, samples), daemon=True),
+        ]
+        admitted, rejected = [], 0
+        steady = CompileDelta()
+        t_start = time.monotonic()
+        crash_wall = None
+        try:
+            with steady, contextlib.ExitStack() as stack:
+                for th in threads:
+                    th.start()
+                for a, prompt, n_new in plan:
+                    now = time.monotonic() - t_start
+                    if crash_wall is None and now >= crash_at:
+                        # arm the injector ONLY now: the generic site fires
+                        # on the next busy stepper iteration — mid-burst
+                        stack.enter_context(injection(inj))
+                        crash_wall = time.monotonic()
+                    if a > now:
+                        time.sleep(a - now)
+                    try:
+                        admitted.append(
+                            fleet.submit(prompt, n_new, lane="interactive"))
+                    except ServiceSaturated:
+                        rejected += 1
+                fleet.wait(admitted, timeout=_T(smoke=120, cpu=300, full=300))
+        finally:
+            wall = time.monotonic() - t_start
+            stop_ev.set()
+            for th in threads:
+                th.join(timeout=45)
+            if scaler is not None:
+                scaler.stop()
+            acc = fleet.accounting()
+            snap = fleet.metrics_snapshot()
+            stats = fleet.request_stats()
+            slo_snap = fleet.slo.snapshot()
+            scale_events = list(fleet.scale_events)
+            counter_slack, recount = fleet.kv_slack(), fleet.kv_recount()
+            fleet.shutdown()
+        if crash_wall is None:
+            crash_wall = t_start + crash_at
+        bundle = flight.dump("bench_autoscale_end")
+        names = sorted(os.listdir(bundle)) if bundle else []
+        flight_section = {
+            "dumps": 1 + sum(1 for e in (scaler.snapshot()["decisions"]
+                                         if scaler else [])
+                             if e["action"] == "scale_down"),
+            "files": len(names),
+            "bytes": sum(os.path.getsize(os.path.join(bundle, f))
+                         for f in names) if bundle else 0,
+        }
+        shutil.rmtree(fdir, ignore_errors=True)
+
+        inter = [s for s in stats if s["lane"] == "interactive"]
+
+        def attainment(lo, hi):
+            win = [s for s in inter
+                   if lo <= s["submitted_at"] - t_start < hi]
+            met = [s for s in win
+                   if s["first_token_at"] is not None
+                   and s["first_token_at"] - s["submitted_at"] <= slo_ttft_s]
+            return round(len(met) / len(win), 4) if win else None
+
+        ttfts = [s["first_token_at"] - s["submitted_at"] for s in inter
+                 if s["first_token_at"] is not None]
+        slots_s = sum(s for s, _ in samples)
+        busy_s = sum(b for _, b in samples)
+        up_deltas = [e.get("compile_delta") for e in scale_events
+                     if e["event"] == "scale_up"]
+        return {
+            "arm": "autoscale" if elastic else "fixed",
+            "slo_ttft_attainment": attainment(0.0, wall),
+            "slo_ttft_attainment_burst": attainment(b0, b1 + 1.0),
+            "p50_ttft_s": (round(float(np.percentile(ttfts, 50)), 4)
+                           if ttfts else None),
+            "p99_ttft_s": (round(float(np.percentile(ttfts, 99)), 4)
+                           if ttfts else None),
+            "interactive_tokens_per_sec": round(
+                sum(s["tokens"] for s in inter) / wall, 1),
+            "rollout_tokens_per_sec": round(tenant["tokens"] / wall, 1),
+            "rollout_completed": tenant["completed"],
+            "rollout_shed": tenant["shed"],
+            "weight_pushes": tenant["pushes"],
+            "weight_pushed_members": tenant["pushed_members"],
+            "waste_frac": (round(1.0 - busy_s / slots_s, 4)
+                           if slots_s else None),
+            "admitted": acc["admitted"], "completed": acc["completed"],
+            "rejected_at_admission": rejected,
+            "shed": acc["shed_admission"] + acc["shed_post_admission"],
+            "redispatched": acc["redispatched"],
+            "lost": acc["lost"],
+            "invariant_ok": bool(acc["lost"] == 0),
+            "crashes": snap["crashes"],
+            "scale_ups": snap["scale_ups"],
+            "scale_downs": snap["scale_downs"],
+            "scale_up_compile_deltas": up_deltas,
+            "scale_events": scale_events,
+            "autoscaler": scaler.snapshot() if scaler else None,
+            "kv_counter_exact": bool(counter_slack == recount),
+            "members_final": snap["members_routable"],
+            "arm_warm_compile_delta": (arm_warm.delta
+                                       if arm_warm.supported else None),
+            "steady_state_compile_delta": (steady.delta
+                                           if steady.supported else None),
+            "flight_record": flight_section,
+            "slo": slo_snap.get("fleet_ttft"),
+            "wall_s": round(wall, 2),
+        }
+
+    fixed = run_arm(elastic=False)
+    auto = run_arm(elastic=True)
+
+    # stretch (flag-gated): prefill/decode disaggregation — a kv_handoff
+    # pair serving the same prompt distribution through the paged-KV
+    # block-table handoff, reported as its own sub-result
+    disagg = None
+    if os.environ.get("RL_TPU_BENCH_DISAGG", "1") != "0":
+        def mk_handoff(i):
+            return ContinuousBatchingEngine(
+                model, params, n_slots=S, block_size=16,
+                n_blocks=S * (cfg.max_seq_len // 16) + 1,
+                prompt_buckets=(bucket,), greedy=True, decode_chunk=4,
+                seed=i, kv_handoff=True,
+            )
+
+        dreg = MetricsRegistry()
+        dengines = [mk_handoff(20), mk_handoff(21)]
+        for e in dengines:
+            e.aot_warmup()
+        dfleet = ServingFleet(
+            dengines, registry=dreg, probe_interval_s=0.02,
+            disaggregate=True, roles=("prefill", "decode"),
+        ).start()
+        n_d = min(len(plan), 8 * S)
+        t0 = time.monotonic()
+        try:
+            frids = [dfleet.submit(p, n) for _, p, n in plan[:n_d]]
+            dres = dfleet.wait(frids, timeout=_T(smoke=120, cpu=300,
+                                                 full=300))
+            dwall = time.monotonic() - t0
+            dacc = dfleet.accounting()
+            dtok = sum(len(r.tokens) for r in dres.values()
+                       if isinstance(r, FinishedRequest))
+            disagg = {
+                "requests": n_d,
+                "completed": dacc["completed"],
+                "lost": dacc["lost"],
+                "tokens_per_sec": round(dtok / dwall, 1),
+                "kv_counter_exact": bool(
+                    dfleet.kv_slack() == dfleet.kv_recount()),
+            }
+        finally:
+            dfleet.shutdown()
+
+    up_deltas = [d for d in auto["scale_up_compile_deltas"] if d is not None]
+    att_fixed = fixed["slo_ttft_attainment_burst"]
+    att_auto = auto["slo_ttft_attainment_burst"]
+    out = {
+        "metric": "slo_ttft_attainment_burst",
+        "value": att_auto if att_auto is not None else 0.0,
+        "unit": "fraction",
+        # >1 = the elastic arm held the SLO better through the burst
+        "vs_baseline": (round(att_auto / att_fixed, 3)
+                        if att_auto and att_fixed else 0.0),
+        "slo_ttft_attainment": auto["slo_ttft_attainment"],
+        "attainment_delta_burst": (round(att_auto - att_fixed, 4)
+                                   if att_auto is not None
+                                   and att_fixed is not None else None),
+        "rollout_tokens_per_sec": auto["rollout_tokens_per_sec"],
+        "waste_frac": auto["waste_frac"],
+        "waste_frac_fixed": fixed["waste_frac"],
+        "lost": auto["lost"] + fixed["lost"],
+        "scale_ups": auto["scale_ups"],
+        "scale_downs": auto["scale_downs"],
+        "scale_up_compile_delta_max": max(up_deltas, default=0),
+        "steady_state_compile_delta": auto["steady_state_compile_delta"],
+        "crashes": auto["crashes"] + fixed["crashes"],
+        "kv_counter_exact": bool(auto["kv_counter_exact"]
+                                 and fixed["kv_counter_exact"]),
+        "offered_rps": round(lam, 2),
+        "n_arrivals": len(plan),
+        "horizon_s": horizon_s,
+        "slo_ttft_threshold_s": slo_ttft_s,
+        "compile_s": round(compile_s, 2),
+        "n_slots": S,
+        "arms": {"fixed": fixed, "autoscale": auto},
+        "disagg": disagg,
+        "ir_audit": _ir_audit_section(jax, prefix="serving."),
+        "metrics": {
+            "slo_ttft_attainment_burst_autoscale": att_auto,
+            "slo_ttft_attainment_burst_fixed": att_fixed,
+            "rollout_tokens_per_sec": auto["rollout_tokens_per_sec"],
+            "waste_frac_autoscale": auto["waste_frac"],
+            "waste_frac_fixed": fixed["waste_frac"],
+            "lost": auto["lost"] + fixed["lost"],
+            "scale_up_compile_delta_max": max(up_deltas, default=0),
+        },
+        "error": None,
+    }
+    out.update(_platform_tag(jax))
+    if report:
+        print(json.dumps(out), flush=True)
+    return out
+
+
 def bench_prefix(report: bool = True) -> dict:
     """BENCH_MODE=prefix: prefix-aware KV reuse (the ISSUE-11 tentpole).
 
@@ -4024,7 +4451,8 @@ def bench_all():
 
     weights = {"ppo": 2.0, "rlhf": 1.4, "pixel": 1.2, "hopper": 1.0,
                "sac": 1.0, "per": 1.0, "async_collect": 0.8, "serve": 0.8,
-               "fleet": 0.8, "prefix": 0.8, "spec": 0.8, "kernels": 0.8,
+               "fleet": 0.8, "autoscale": 0.8, "prefix": 0.8,
+               "spec": 0.8, "kernels": 0.8,
                "multichip": 0.8,
                "anakin": 0.8, "compile": 0.8, "chaos": 0.6}
     deadline = _START + _TIMEOUT - 30.0  # safety margin for the final print
@@ -4168,6 +4596,7 @@ if __name__ == "__main__":
             "async_collect": bench_async_collect,
             "chaos": bench_chaos,
             "fleet": bench_fleet,
+            "autoscale": bench_autoscale,
             "prefix": bench_prefix,
             "spec": bench_spec,
             "kernels": bench_kernels,

@@ -46,6 +46,9 @@ class LLMCollector:
         engine_decode_chunk: int | str = 1,
         engine_params_sharding: Any = None,
         engine_prefix_cache: bool = False,
+        fleet: Any = None,
+        fleet_timeout_s: float = 120.0,
+        fleet_poll_s: float = 0.01,
     ):
         self.env = env
         self.model = model
@@ -76,6 +79,15 @@ class LLMCollector:
         # bit-identical with prior behavior; flip on for shared-prompt
         # rollout workloads.
         self.engine_prefix_cache = engine_prefix_cache
+        # batch-lane tenancy (ISSUE 19): instead of a PRIVATE engine, the
+        # collector rides an existing ServingFleet's "batch" lane —
+        # interactive traffic holds the SLO lane strictly ahead, rollouts
+        # harvest whatever capacity is idle. Admission sheds
+        # (ServiceSaturated) and post-admission sheds both back off and
+        # resubmit: a slack tenant yields, never competes.
+        self.fleet = fleet
+        self.fleet_timeout_s = fleet_timeout_s
+        self.fleet_poll_s = fleet_poll_s
         self._engine = None
         # (rewards, batch_arrays) -> rewards, applied BEFORE group advantages
         # (KLRewardTransform / PolicyVersion — reference envs/llm/transforms/)
@@ -185,6 +197,77 @@ class LLMCollector:
             full_mask=full_mask,
         )
 
+    @hot_path(reason="drives the fleet batch lane per rollout batch")
+    def _fleet_generate(self, params, toks, pmask, key, on_row_done=None):
+        """Batch-lane tenant rollout: the G requests ride an existing
+        :class:`~rl_tpu.models.ServingFleet`'s ``batch`` lane, filling
+        whatever capacity the interactive SLO lane leaves idle. Weight
+        push is the fleet's rolling per-member swap (serving never
+        globally stalls); sheds — admission-time saturation AND
+        post-admission ``ShedRequest`` — back off and resubmit until the
+        deadline. Results come through :meth:`ServingFleet.poll`, which
+        never drains another tenant's rows. The per-call ``key`` is
+        unused here: sampling streams belong to the member engines."""
+        import time as _time
+
+        from ..models.fleet import ShedRequest
+        from ..models.generate import GenerateOutput
+        from ..models.serving import ServiceSaturated
+
+        fleet = self.fleet
+        if params is not None:
+            fleet.push_params(params)
+        G, P = toks.shape
+        toks_np = np.asarray(toks)
+        mask_np = np.asarray(pmask) > 0
+        N = self.max_new_tokens
+        resp = np.zeros((G, N), np.int32)
+        rlp = np.zeros((G, N), np.float32)
+        rmask = np.zeros((G, N), bool)
+        pending_rows = list(range(G))  # not yet admitted (or re-shed)
+        outstanding: dict[int, int] = {}  # frid -> row
+        deadline = _time.monotonic() + self.fleet_timeout_s
+        while pending_rows or outstanding:
+            still: list[int] = []
+            for g in pending_rows:
+                try:
+                    frid = fleet.submit(
+                        toks_np[g][mask_np[g]], N, lane="batch")
+                    outstanding[frid] = g
+                except ServiceSaturated:
+                    still.append(g)  # the SLO lane owns the pool right now
+            pending_rows = still
+            for frid, res in fleet.poll(list(outstanding)).items():
+                g = outstanding.pop(frid)
+                if isinstance(res, ShedRequest):
+                    pending_rows.append(g)  # bounded by the deadline below
+                    continue
+                n = len(res.tokens)
+                resp[g, :n] = res.tokens
+                rlp[g, :n] = res.log_probs
+                rmask[g, :n] = True
+                if on_row_done is not None:
+                    on_row_done(g, resp, rmask)
+            if pending_rows or outstanding:
+                if _time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"fleet batch lane: {len(pending_rows)} unadmitted + "
+                        f"{len(outstanding)} outstanding rollout rows after "
+                        f"{self.fleet_timeout_s}s"
+                    )
+                _time.sleep(self.fleet_poll_s)
+        full = jnp.concatenate(
+            [jnp.asarray(toks_np), jnp.asarray(resp)], axis=1)
+        full_mask = jnp.concatenate(
+            [jnp.asarray(mask_np), jnp.asarray(rmask)], axis=1)
+        return GenerateOutput(
+            tokens=full,
+            response_tokens=jnp.asarray(resp),
+            response_mask=jnp.asarray(rmask),
+            response_log_probs=jnp.asarray(rlp),
+            full_mask=full_mask,
+        )
+
     def _engine_collect(self, params, toks, pmask, key, state, group_ids):
         """Engine rollout with FIRST-COME group scoring: the moment a
         prompt group's last response lands, its rewards are computed on
@@ -209,7 +292,12 @@ class LLMCollector:
                 rows = group_rows[g]
                 rewards[rows] = self.env.score_rows(state, resp, rmask, rows)
 
-        out = self._engine_generate(params, toks, pmask, key, on_row_done)
+        gen = (
+            self._fleet_generate
+            if self.fleet is not None
+            else self._engine_generate
+        )
+        out = gen(params, toks, pmask, key, on_row_done)
         if not can_score:
             return out, None
         return out, rewards
@@ -230,7 +318,7 @@ class LLMCollector:
         state, group_ids = self.env.sample_batch(self.num_prompts)
         toks = np.asarray(state["tokens"])
         pmask = np.asarray(state["attention_mask"], np.float32)
-        if self.continuous_batching:
+        if self.fleet is not None or self.continuous_batching:
             # the engine consumes prompts on the host (slot-packing and
             # submit copies) — handing it a device array would round-trip
             # the freshly-uploaded batch straight back through a blocking

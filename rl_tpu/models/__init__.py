@@ -3,6 +3,7 @@ from .generate import GenerateOutput, generate, token_log_probs, token_log_probs
 from .serving import (
     ContinuousBatchingEngine,
     FinishedRequest,
+    KVHandoff,
     LoadBalancer,
     RemoteEngine,
     Request,
@@ -10,6 +11,7 @@ from .serving import (
 )
 from .serving import ServiceSaturated
 from .speculative import DraftSource, NGramDraft, PrefixTreeDraft
+from .autoscale import Autoscaler, AutoscalerConfig
 from .fleet import ServingFleet, ShedRequest
 from .act import ACTConfig, ACTModel
 from .rssm import RSSM, DreamerModelLoss, RSSMConfig, dreamer_lambda_returns
@@ -43,7 +45,10 @@ __all__ = [
     "generate",
     "token_log_probs",
     "token_log_probs_with_aux",
+    "Autoscaler",
+    "AutoscalerConfig",
     "ContinuousBatchingEngine",
+    "KVHandoff",
     "LoadBalancer",
     "ServingService",
     "ServingFleet",

@@ -67,6 +67,7 @@ import numpy as np
 
 from ..analysis import hot_path
 from ..comm.liveness import Watchdog
+from ..compile import CompileDelta
 from ..obs.slo import SLOEngine, StreamingHistogram, merge_histograms
 from ..obs.trace import ctx_args, current_context, new_trace, use_context
 from ..resilience.faults import fault_point, register_site, should_drop
@@ -77,12 +78,19 @@ from .serving import (
     ServiceSaturated,
 )
 
-__all__ = ["HEALTHY", "QUARANTINED", "DEAD", "ServingFleet", "ShedRequest"]
+__all__ = [
+    "HEALTHY", "QUARANTINED", "DEAD", "RETIRED", "ServingFleet", "ShedRequest",
+]
 
 HEALTHY = "healthy"
 QUARANTINED = "quarantined"
 DEAD = "dead"
-_STATE_VALUE = {HEALTHY: 0.0, QUARANTINED: 1.0, DEAD: 2.0}
+# scale-down terminal state: the member was drained deliberately (its
+# outstanding work re-dispatched through the failover path) and left the
+# routing/accounting sets — unlike DEAD it is a success, not a failure
+RETIRED = "retired"
+_STATE_VALUE = {HEALTHY: 0.0, QUARANTINED: 1.0, DEAD: 2.0, RETIRED: 3.0}
+_OUT = (DEAD, RETIRED)  # states excluded from routing and KV aggregation
 
 # tracked-request states
 _QUEUED, _DISPATCHING, _DISPATCHED, _DONE, _SHED = (
@@ -135,6 +143,17 @@ class _Member:
         self.engine = engine
         self.lock = threading.Lock()
         self.state = HEALTHY
+        # per-member stop flag: scale-down must end ONE stepper loop
+        # without touching the fleet-wide stop event
+        self.stop = threading.Event()
+        # warm-up grace: while True and inside warm_deadline, failed
+        # probes don't count toward quarantine (executables may still be
+        # loading from the store); ends at the first healthy probe
+        self.warming = False
+        self.warm_deadline = 0.0
+        # disaggregation role: "mixed" serves both phases; "prefill"
+        # members only run detached prefills, "decode" members only adopt
+        self.role = "mixed"
         self.assigned: dict[int, int] = {}  # engine rid -> frid
         self.admit_events: list[tuple[int, float]] = []  # stepper-thread only
         self.probe_failures = 0
@@ -213,6 +232,10 @@ class ServingFleet:
         slo_ttft_s: float = 1.0,
         slo_latency_s: float = 10.0,
         slo_target: float = 0.99,
+        warmup_grace_s: float | None = None,
+        max_members: int | None = None,
+        disaggregate: bool = False,
+        roles=None,
     ):
         engines = list(engines)
         if not engines:
@@ -231,7 +254,22 @@ class ServingFleet:
                 )
         self.shape_buckets = b0
         self._members = [_Member(i, e) for i, e in enumerate(engines)]
+        if roles is not None:
+            roles = list(roles)
+            if len(roles) != len(self._members):
+                raise ValueError(
+                    f"roles must name every initial member: got {len(roles)} "
+                    f"roles for {len(self._members)} engines"
+                )
+            for m, r in zip(self._members, roles):
+                if r not in ("mixed", "prefill", "decode"):
+                    raise ValueError(f"unknown member role {r!r}")
+                if r != "mixed" and not disaggregate:
+                    raise ValueError(
+                        "prefill/decode member roles need disaggregate=True")
+                m.role = r
         self.probe_interval_s = probe_interval_s
+        self.probe_timeout_s = probe_timeout_s
         self.quarantine_after = quarantine_after
         self.readmit_probes = readmit_probes
         self.readmit_backoff_s = readmit_backoff_s
@@ -246,6 +284,23 @@ class ServingFleet:
         self.max_dispatches = max_dispatches
         self.retry_after_s = retry_after_s
         self.idle_sleep_s = idle_sleep_s
+        # elastic membership (the Autoscaler's primitives): members are
+        # never REMOVED from the list — retirement is a terminal state —
+        # so indices stay stable for metrics/labels/fault-site names
+        self.warmup_grace_s = (
+            warmup_grace_s
+            if warmup_grace_s is not None
+            else max(5.0, 3.0 * probe_timeout_s)
+        )
+        self.max_members = max_members
+        self.disaggregate = bool(disaggregate)
+        self._next_member_idx = len(engines)
+        self._prefill_rr = 0  # round-robin cursor over prefill-role members
+        self.scale_ups = 0
+        self.scale_downs = 0
+        # the decision trail: one dict per membership change, the flight
+        # recorder's scale-event source
+        self.scale_events: list[dict] = []
 
         self._lock = threading.Lock()
         self._stop = threading.Event()
@@ -353,9 +408,15 @@ class ServingFleet:
                                           "members quarantined")
         self._c_readmissions = reg.counter(f"{p}_readmissions_total",
                                            "quarantined members re-admitted")
+        self._c_scale_ups = reg.counter(f"{p}_scale_ups_total",
+                                        "members added by elastic scale-up")
+        self._c_scale_downs = reg.counter(
+            f"{p}_scale_downs_total", "members drained and retired by scale-down")
+        self._g_members = reg.gauge(
+            f"{p}_members", "routable members (not dead or retired)")
         self._g_health = reg.gauge(
             f"{p}_engine_health",
-            "member health (0=healthy, 1=quarantined, 2=dead)",
+            "member health (0=healthy, 1=quarantined, 2=dead, 3=retired)",
             labels=("engine",))
         self._g_free_kv = reg.gauge(f"{p}_free_kv_blocks",
                                     "fleet-wide free KV blocks (non-dead members)")
@@ -389,6 +450,8 @@ class ServingFleet:
             lanes = {lane: len(q) for lane, q in self._lanes.items()}
             outstanding = self._outstanding_locked()
             states = [(m.idx, m.state) for m in self._members]
+        self._g_members.set(
+            float(sum(1 for _, s in states if s not in _OUT)))
         self._g_free_kv.set(float(free))
         self._g_total_kv.set(float(total))
         for lane, depth in lanes.items():
@@ -495,7 +558,7 @@ class ServingFleet:
         with self._lock:
             if self._error is not None:
                 raise RuntimeError(f"fleet control plane died:\n{self._error}")
-            alive = [m for m in self._members if m.state != DEAD]
+            alive = [m for m in self._members if m.state not in _OUT]
             if not alive:
                 self._count_shed_locked("no_members")
                 raise ServiceSaturated(self.retry_after_s)
@@ -565,7 +628,7 @@ class ServingFleet:
         free, so a pool full of reusable prefixes is not pressure)."""
         free = total = 0
         for m in self._members:
-            if m.state == DEAD:
+            if m.state in _OUT:
                 continue
             n = m.engine._n_pool_blocks
             total += n
@@ -660,8 +723,12 @@ class ServingFleet:
                 "free_kv_blocks": free,
                 "kv_blocks_total": total,
                 "lane_depth": {lane: len(q) for lane, q in self._lanes.items()},
+                "scale_ups": self.scale_ups,
+                "scale_downs": self.scale_downs,
+                "members_routable": sum(
+                    1 for m in self._members if m.state not in _OUT),
                 "members": [
-                    {"idx": m.idx, "state": m.state,
+                    {"idx": m.idx, "state": m.state, "role": m.role,
                      "pending": m.engine.pending(),
                      "quarantines": m.quarantines,
                      "restarts": (m.child.restarts if m.child else 0)}
@@ -686,6 +753,213 @@ class ServingFleet:
                 "lost": self.admitted - self.completed - post - outstanding,
             }
 
+    # -- elastic membership (the Autoscaler's primitives) ----------------------
+
+    def add_member(self, engine, *, warm: bool = True, role: str = "mixed") -> dict:
+        """Join ``engine`` to the fleet mid-flight (scale-up). The engine
+        must run the SAME :class:`~rl_tpu.compile.ShapeBuckets` ladder as
+        the fleet (rejected otherwise — a mismatched member would compile
+        under traffic on its first failover re-dispatch). With ``warm``
+        (default) the whole current program ladder is built — loaded from
+        the :class:`~rl_tpu.compile.ExecutableStore` when an identical
+        replica already paid the compile — BEFORE the member joins
+        routing, and the measured :class:`~rl_tpu.compile.CompileDelta`
+        is returned so callers (the Autoscaler asserts it) can hold
+        scale-up to compile-free. The new member starts inside the
+        warm-up probe grace window so slow first probes while executables
+        page in do not quarantine it. Returns the scale event dict."""
+        if role not in ("mixed", "prefill", "decode"):
+            raise ValueError(f"unknown member role {role!r}")
+        if role != "mixed" and not self.disaggregate:
+            raise ValueError(
+                "prefill/decode member roles need disaggregate=True")
+        if engine.shape_buckets != self.shape_buckets:
+            raise ValueError(
+                f"fleet members must share one ShapeBuckets config: fleet "
+                f"has {self.shape_buckets}, new member has "
+                f"{engine.shape_buckets}"
+            )
+        with self._lock:
+            routable = sum(1 for m in self._members if m.state not in _OUT)
+            if self.max_members is not None and routable >= self.max_members:
+                raise RuntimeError(
+                    f"fleet already at max_members={self.max_members}")
+            idx = self._next_member_idx
+            self._next_member_idx += 1
+        # warm OUTSIDE every lock: compiles/store-loads are slow, and
+        # serving must not pause while a new replica pages its ladder in
+        delta = by_program = None
+        if warm:
+            with CompileDelta() as d:
+                engine.aot_warmup()
+            delta, by_program = d.delta, dict(d.by_program)
+        m = _Member(idx, engine)
+        m.role = role
+        register_site(
+            f"fleet.engine_crash.{m.idx}",
+            f"ServingFleet member {m.idx} stepper, per busy iteration",
+        )
+        m.engine.on_admit = self._make_on_admit(m)
+        now = time.monotonic()
+        # register BEFORE the member becomes routable: a fresh beat, so the
+        # first watchdog sweep cannot see a stale never-beaten entry
+        self._watchdog.register(m.name)
+        with self._lock:
+            m.warming = True
+            m.warm_deadline = now + self.warmup_grace_s
+            self._members.append(m)
+            self.scale_ups += 1
+            ev = {
+                "event": "scale_up", "idx": idx, "role": role,
+                "warm": bool(warm), "compile_delta": delta,
+                "by_program": by_program, "t": now,
+            }
+            self.scale_events.append(ev)
+        self._c_scale_ups.inc()
+        self._g_health.set(0.0, {"engine": str(idx)})
+        self._tracer.instant(
+            "fleet_scale_up",
+            {"engine": idx, "role": role, "compile_delta": delta})
+        if self._started:
+            m.child = self._sup.spawn(
+                m.name, lambda m=m: self._member_loop(m),
+                escalate=False,
+                on_giveup=lambda exc, m=m: self._on_member_giveup(m, exc),
+            )
+        return ev
+
+    def scale_down(self, idx: int | None = None, *, reason: str = "scale_down"):
+        """Retire one member (default: the least-loaded healthy one,
+        newest on ties) and drain its outstanding requests through the
+        existing failover re-dispatch path — the same exactly-once
+        machinery a crash uses, so ``lost == 0`` by construction. The
+        member leaves routing/aggregation immediately (state RETIRED),
+        its stepper thread is joined, salvageable completions are
+        settled, and everything still outstanding is re-queued at the
+        front of its lane. Returns the scale event dict, or ``None`` when
+        no member can be spared (never drains the last routable one)."""
+        with self._lock:
+            routable = [m for m in self._members if m.state not in _OUT]
+            if len(routable) <= 1:
+                return None
+            if idx is None:
+                cands = [m for m in routable if m.state == HEALTHY]
+                if not cands:
+                    return None
+                victim = min(cands, key=lambda m: (len(m.assigned), -m.idx))
+            else:
+                found = [m for m in self._members if m.idx == idx]
+                if not found or found[0].state in _OUT:
+                    raise ValueError(f"no routable member with idx {idx}")
+                victim = found[0]
+            m = victim
+            m.state = RETIRED
+            outstanding_before = len(m.assigned)
+            self.scale_downs += 1
+            self._tracer.instant(
+                "fleet_retire", {"engine": m.idx, "reason": reason,
+                                 "outstanding": outstanding_before})
+        self._c_scale_downs.inc()
+        self._g_health.set(3.0, {"engine": str(m.idx)})
+        # stop the stepper OUTSIDE the fleet lock: the join blocks until
+        # the current step returns, and that step may be waiting on the
+        # fleet lock inside _settle
+        m.stop.set()
+        if m.child is not None:
+            m.child.stop()
+        # salvage finished-but-unsettled completions, then reset the engine
+        # so its KV blocks return to the free list (a RETIRED member no
+        # longer aggregates, keeping the O(1) watermark accounting exact)
+        fin: list = []
+        try:
+            with m.lock:
+                fin = list(m.engine.finished)
+                m.engine.finished.clear()
+                m.engine.reset()
+        except Exception:
+            pass  # a wedged engine still drains through failover
+        self._settle(m, fin)
+        with self._lock:
+            self._failover_locked(m, clear_assignments=True)
+            ev = {
+                "event": "scale_down", "idx": m.idx, "reason": reason,
+                "outstanding_redispatched": outstanding_before,
+                "salvaged": len(fin), "t": time.monotonic(),
+            }
+            self.scale_events.append(ev)
+        self._watchdog.unregister(m.name)
+        return ev
+
+    def push_params(self, params) -> int:
+        """Roll new weights across the routable members, one engine at a
+        time under THAT member's engine lock only — a
+        :class:`~rl_tpu.weight_update.ShardedSyncScheme` publish stalls at
+        most one stepper for one pointer swap, so serving never globally
+        pauses for a weight push. Returns the number of members updated."""
+        with self._lock:
+            members = [m for m in self._members if m.state not in _OUT]
+        n = 0
+        for m in members:
+            try:
+                with m.lock:
+                    m.engine.params = params
+                n += 1
+            except Exception:
+                continue  # a crashing member catches up after its reset
+        return n
+
+    def poll(self, frids) -> dict[int, Any]:
+        """Non-blocking tenant harvest: results for exactly ``frids`` that
+        have settled, removed from the shared ready buffer so an
+        interactive ``harvest()`` loop never sees another tenant's rows."""
+        out: dict[int, Any] = {}
+        with self._lock:
+            for f in frids:
+                f = int(f)
+                t = self._tracked.get(f)
+                if t is not None and t.state in (_DONE, _SHED):
+                    out[f] = t.result
+                    self._ready.pop(f, None)
+        return out
+
+    def ttft_burn_rate(self, window_s: float = 60.0) -> float:
+        """The scale-up signal: fleet_ttft error-budget burn rate over the
+        trailing window (0.0 with no traffic)."""
+        return self._slo_ttft.burn_rate(window_s)
+
+    def kv_slack(self) -> tuple[int, int]:
+        """The scale-down signal: fleet-wide (free, total) KV blocks over
+        routable members — sharing-adjusted ``free_adjusted`` per member."""
+        with self._lock:
+            return self._kv_blocks_locked()
+
+    def n_routable(self) -> int:
+        with self._lock:
+            return sum(1 for m in self._members if m.state not in _OUT)
+
+    def kv_recount(self) -> tuple[int, int]:
+        """Ground-truth recount of :meth:`kv_slack`, bypassing the O(1)
+        free-list counters: per member, a full
+        :meth:`~rl_tpu.kvmem.PrefixKVAllocator.audit` (which asserts the
+        pool partitions exactly) for prefix engines, or a block-table scan
+        for plain ones. The membership property test's oracle — counter ==
+        recount must hold after any join/leave/crash sequence."""
+        with self._lock:
+            members = [m for m in self._members if m.state not in _OUT]
+        free = total = 0
+        for m in members:
+            with m.lock:
+                eng = m.engine
+                n = eng._n_pool_blocks
+                total += n
+                kvmem = getattr(eng, "_kvmem", None)
+                if kvmem is not None:
+                    a = kvmem.audit()
+                    free += a["free"] + a["reclaimable"]
+                else:
+                    free += n - int((eng.table >= 0).sum())
+        return free, total
+
     # -- member stepper (supervised) -------------------------------------------
 
     def _make_on_admit(self, m: _Member):
@@ -700,7 +974,7 @@ class ServingFleet:
     @hot_path(reason="per-replica decode loop thread")
     def _member_loop(self, m: _Member) -> None:
         eng = m.engine
-        while not self._stop.is_set():
+        while not self._stop.is_set() and not m.stop.is_set():
             self._watchdog.beat(m.name)
             # a representative request context for this iteration (the
             # first assigned request's node), so injected faults and crash
@@ -819,7 +1093,7 @@ class ServingFleet:
             m.state = DEAD
             self._tracer.instant("fleet_engine_dead", {"engine": m.idx})
             self._failover_locked(m, clear_assignments=True)
-            if all(mm.state == DEAD for mm in self._members):
+            if all(mm.state in _OUT for mm in self._members):
                 for lane, q in self._lanes.items():
                     while q:
                         frid = q.popleft()
@@ -919,6 +1193,8 @@ class ServingFleet:
             if pick is None:
                 return False
         tr, m = pick
+        if self.disaggregate and m.role == "prefill":
+            return self._dispatch_handoff(tr, m)
         try:
             # the dispatch span hangs under the request's node and is the
             # ACTIVE context while the engine admits — engine.submit
@@ -950,9 +1226,22 @@ class ServingFleet:
         return True
 
     def _select_member_locked(self, tr: _Tracked):
+        if self.disaggregate:
+            # RLAX-style split: route to a prefill-role member only when a
+            # decode-role member has adoption capacity (a handoff with no
+            # adopter is wasted prefill work); otherwise fall through to
+            # whatever mixed members exist
+            pre = [m for m in self._members
+                   if m.state == HEALTHY and m.role == "prefill"]
+            dec = [m for m in self._members
+                   if m.state == HEALTHY and m.role == "decode"
+                   and m.engine.pending() < self.max_pending_per_engine]
+            if pre and dec:
+                self._prefill_rr += 1
+                return pre[self._prefill_rr % len(pre)]
         cands = [
             m for m in self._members
-            if m.state == HEALTHY
+            if m.state == HEALTHY and m.role == "mixed"
             and m.engine.pending() < self.max_pending_per_engine
         ]
         if not cands:
@@ -979,15 +1268,104 @@ class ServingFleet:
 
         return min(cands, key=score)
 
+    def _select_decode_locked(self):
+        cands = [m for m in self._members
+                 if m.state == HEALTHY and m.role == "decode"
+                 and m.engine.pending() < self.max_pending_per_engine]
+        if not cands:
+            return None
+        fallback = max((m.lat_ema for m in cands if m.lat_ema is not None),
+                       default=1.0)
+
+        def score(m: _Member) -> float:
+            lat = m.lat_ema if m.lat_ema is not None else fallback
+            return ((m.engine.pending() + 1) * lat
+                    + self._lb._kv_utilization(m.engine))
+
+        return min(cands, key=score)
+
+    def _dispatch_handoff(self, tr: _Tracked, pm: _Member) -> bool:
+        """Disaggregated dispatch (the ``disaggregate`` flag): run the
+        bucketed prefill on a prefill-role member, then hand its paged KV
+        block contents to a decode-role member that adopts the sequence
+        and continues decoding. The request is attributed to the DECODE
+        member — failover replays from the prompt exactly as in the mixed
+        path — and a prefill that already finished the request (eos first
+        token, or a one-token budget) settles directly."""
+        try:
+            with self._tracer.ctx_span(
+                "fleet/prefill_handoff",
+                {"frid": tr.frid, "engine": pm.idx, "attempt": tr.dispatches},
+                ctx=tr.ctx,
+            ):
+                with pm.lock:
+                    ho = pm.engine.prefill_detached(tr.prompt, tr.max_new_tokens)
+        except Exception:
+            with self._lock:
+                self._shed_tracked_locked(tr, "dispatch_error")
+            return True
+        now = time.monotonic()
+        if ho is None:
+            # the prefill member is out of slots/blocks this instant:
+            # requeue at the front and let the dispatcher idle one beat
+            return self._requeue_dispatching(tr)
+        with self._lock:
+            if tr.state != _DISPATCHING:
+                return True  # settled concurrently by a late duplicate
+            if tr.first_token_at is None:
+                # the first token exists the moment the prefill sampled it
+                tr.first_token_at = now
+                self._slo_ttft.record(now - tr.submitted_at)
+                pm.ttft_hist.observe(now - tr.submitted_at)
+            if ho.finished is not None:
+                tr.state, tr.result, tr.done_at = _DONE, ho.finished, now
+                self._ready[tr.frid] = ho.finished
+                self.completed += 1
+                self._c_completed.inc()
+                lat = now - tr.submitted_at
+                self._slo_latency.record(lat)
+                pm.lat_hist.observe(lat)
+                self._slo_avail.record_event(True)
+                return True
+            dm = self._select_decode_locked()
+        if dm is None:
+            # no adoption capacity: the handoff is self-contained host
+            # state, dropping it leaks nothing — replay from the prompt
+            return self._requeue_dispatching(tr)
+        try:
+            with dm.lock:
+                erid = dm.engine.adopt_handoff(ho)
+        except Exception:
+            with self._lock:
+                self._shed_tracked_locked(tr, "dispatch_error")
+            return True
+        if erid is None:
+            return self._requeue_dispatching(tr)
+        with self._lock:
+            dm.assigned[erid] = tr.frid
+            if tr.state == _DISPATCHING:
+                tr.state, tr.member, tr.erid = _DISPATCHED, dm.idx, erid
+                if dm.state != HEALTHY:
+                    tr.state, tr.member, tr.erid = _QUEUED, -1, -1
+                    self._lanes[tr.lane].appendleft(tr.frid)
+        return True
+
+    def _requeue_dispatching(self, tr: _Tracked) -> bool:
+        with self._lock:
+            if tr.state == _DISPATCHING:
+                tr.state = _QUEUED
+                self._lanes[tr.lane].appendleft(tr.frid)
+        return False
+
     # -- health monitor --------------------------------------------------------
 
     def _monitor_loop(self) -> None:
         while not self._stop.wait(self.probe_interval_s):
             self._watchdog.check()
-            for m in self._members:
+            for m in list(self._members):
                 with self._lock:
                     state = m.state
-                if state == DEAD:
+                if state in _OUT:
                     continue
                 ok = self._probe(m)
                 self._on_probe(m, ok)
@@ -1031,17 +1409,30 @@ class ServingFleet:
         now = time.monotonic()
         with self._lock:
             if ok:
+                # the first healthy round ends the warm-up grace: from here
+                # on the member is held to the normal probe deadline
+                m.warming = False
                 m.probe_failures = 0
                 m.probe_successes += 1
                 if (m.state == QUARANTINED
                         and now >= m.readmit_at
                         and m.probe_successes >= self.readmit_probes):
                     m.state = HEALTHY
+                    # re-admission grace: the restarted stepper may still be
+                    # reloading executables — scale the probe deadline by
+                    # ignoring failures until its first healthy round
+                    m.warming = True
+                    m.warm_deadline = now + self.warmup_grace_s
                     self.readmissions += 1
                     self._c_readmissions.inc()
                     self._g_health.set(0.0, {"engine": str(m.idx)})
                     self._tracer.instant("fleet_readmit", {"engine": m.idx})
             else:
+                if m.warming and now < m.warm_deadline:
+                    # warm-up grace (scale-up / re-admission): slow first
+                    # probes while executables load from the store do NOT
+                    # count toward quarantine
+                    return
                 m.probe_successes = 0
                 m.probe_failures += 1
                 if (m.state == HEALTHY

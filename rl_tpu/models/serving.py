@@ -68,6 +68,7 @@ from .speculative import (
 
 __all__ = [
     "ContinuousBatchingEngine",
+    "KVHandoff",
     "LoadBalancer",
     "Request",
     "FinishedRequest",
@@ -94,6 +95,29 @@ class FinishedRequest:
     tokens: np.ndarray  # [N] generated ids (eos included if hit)
     log_probs: np.ndarray  # [N] behavior log-probs of the sampled tokens
     finished_reason: str  # "eos" | "length"
+
+
+@dataclasses.dataclass
+class KVHandoff:
+    """A detached prefill's transferable result (the ``kv_handoff``
+    disaggregation path): everything a decode-role engine needs to adopt
+    the sequence — the prompt, the first sampled token, the remaining
+    budget, and host copies of the paged KV block contents for positions
+    ``[0, lens)``. Self-contained: the prefill engine frees its blocks
+    before returning, so dropping a handoff leaks nothing anywhere."""
+
+    prompt: np.ndarray  # [P] int32
+    first_token: int
+    first_lp: float
+    budget: int  # tokens still to emit (max_new_tokens - 1)
+    lens: int  # KV-valid positions (== len(prompt))
+    block_size: int
+    # per layer: the engine's pool-field tuple (2 f32 / 4 int8+scales) of
+    # host arrays, each [n_blocks_used, ...] block-major
+    kv: tuple = ()
+    # set when the prefill already finished the request (eos on the first
+    # token, or a one-token budget): nothing to adopt, deliver directly
+    finished: FinishedRequest | None = None
 
 
 @dataclasses.dataclass
@@ -280,6 +304,7 @@ class ContinuousBatchingEngine:
         slot_rng: bool = False,
         spec_lookahead: int = 7,
         draft_source: Any = None,
+        kv_handoff: bool = False,
     ):
         # placement is applied by the params setter, so it must exist
         # before the first assignment below
@@ -310,6 +335,18 @@ class ContinuousBatchingEngine:
         # vanilla slot-stream decode. The legacy split-per-dispatch
         # stream (self._key) stays byte-for-byte untouched when off.
         self.speculative = bool(speculative)
+        # prefill/decode disaggregation: detached prefills hand their KV
+        # block contents to a decode-role engine (fleet ``disaggregate``).
+        # Plain engines only — a kvmem lease cannot cross engines, and the
+        # speculative verify path assumes it owns the sequence end to end.
+        self.kv_handoff = bool(kv_handoff)
+        if self.kv_handoff and speculative:
+            raise ValueError(
+                "kv_handoff does not compose with speculative decoding")
+        if self.kv_handoff and prefix_cache:
+            raise ValueError(
+                "kv_handoff needs prefix_cache=False (a prefix lease "
+                "cannot follow the sequence to another engine)")
         self.slot_rng = bool(slot_rng or speculative)
         self.spec_lookahead = int(spec_lookahead)
         self._base_key = jax.random.key(seed)
@@ -1204,6 +1241,199 @@ class ContinuousBatchingEngine:
         if self._kvmem is None:
             return 0, self._blocks_needed(want)
         return self._kvmem.probe(seq, want)
+
+    # -- prefill/decode disaggregation (kv_handoff) ----------------------------
+
+    def prefill_detached(self, prompt, max_new_tokens: int):
+        """Run ONE bucketed prefill and return a :class:`KVHandoff`
+        instead of occupying a slot: the written KV block contents are
+        read back to host, the borrowed blocks return to the free list,
+        and a decode-role engine continues via :meth:`adopt_handoff`.
+        Uses the same warmed prefill ladder as admission (the admit-size-1
+        rung), so a warmed engine hands off without compiling; the
+        pow2-padded KV gather is the only eager program, steady after its
+        first few widths. Returns ``None`` when no slot or blocks are
+        free this instant (the caller retries)."""
+        if not self.kv_handoff:
+            raise RuntimeError("engine built without kv_handoff=True")
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        P = len(prompt)
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if P + max_new_tokens > self.max_seq_len:
+            raise ValueError(
+                f"prompt ({P}) + max_new_tokens ({max_new_tokens}) "
+                f"exceeds max_seq_len ({self.max_seq_len})"
+            )
+        if P > self.buckets[-1]:
+            raise ValueError(
+                f"prompt length {P} exceeds the largest prefill "
+                f"bucket {self.buckets[-1]}"
+            )
+        free = [s for s in range(self.n_slots) if self.slot_rid[s] < 0]
+        if not free:
+            return None
+        s = free[0]
+        if not self._ensure_blocks(s, P + 1):
+            return None
+        blocks = [int(b) for b in self.table[s] if b >= 0]
+        bucket = self.shape_buckets.prompt_bucket(P)
+        pad_a = self.shape_buckets.admit_bucket(1, self.n_slots)
+        tokens = np.zeros((pad_a, bucket), np.int32)
+        mask = np.zeros((pad_a, bucket), bool)
+        tokens[0, :P] = prompt
+        mask[0, :P] = True
+        slots = np.zeros(pad_a, np.int64)
+        slots[0] = s
+        rid = self._next_rid
+        self._next_rid += 1
+        self._flush_table_writes()
+        pools = _pools_from(self.cache)
+        if self.slot_rng:
+            rid_v = np.full(pad_a, -1, np.int32)
+            rid_v[0] = rid
+            fn = self._get_sprefill_prog(pad_a, bucket)
+            tok, lp, new_pools = fn(
+                self.params, pools, self.dev_table[jnp.asarray(slots)],
+                jnp.asarray(tokens), jnp.asarray(mask),
+                jnp.asarray(rid_v), self._base_key,
+            )
+        else:
+            self._key, k = jax.random.split(self._key)
+            fn = self._get_prefill_prog(pad_a, bucket)
+            tok, lp, new_pools = fn(
+                self.params, pools, self.dev_table[jnp.asarray(slots)],
+                jnp.asarray(tokens), jnp.asarray(mask), k,
+            )
+        for layer, bufs in zip(self.cache, new_pools):
+            layer.update(zip(_POOL_FIELDS, bufs))
+        self.admissions += 1
+        self.prefill_token_slots += pad_a * bucket
+        self.prefill_tokens_computed += P
+        t0, l0 = int(np.asarray(tok)[0]), float(np.asarray(lp)[0])
+        self.host_transfers += 1
+        budget = max_new_tokens - 1
+        hit_eos = self.eos_id is not None and t0 == self.eos_id
+        kv: tuple = ()
+        if not hit_eos and budget > 0:
+            # gather the written KV back to host, padded to a pow2 block
+            # count by repeating the last index (duplicate gathers are
+            # harmless; the pad rows are sliced off host-side)
+            n = len(blocks)
+            pad_n = _pow2ceil(n)
+            gidx = jnp.asarray(
+                np.asarray(blocks + [blocks[-1]] * (pad_n - n), np.int32))
+            kv = tuple(
+                tuple(np.asarray(c[f][gidx])[:n]
+                      for f in _POOL_FIELDS if f in c)
+                for c in self.cache
+            )
+        # the borrowed slot returns immediately: the handoff owns host
+        # copies, nothing on this engine references the sequence anymore
+        self.free_blocks.extend(blocks)
+        self.table[s] = -1
+        if hit_eos or budget <= 0:
+            reason = "eos" if hit_eos else "length"
+            self.completions[reason] = self.completions.get(reason, 0) + 1
+            fin = FinishedRequest(
+                rid=rid, prompt=prompt,
+                tokens=np.asarray([t0], np.int32),
+                log_probs=np.asarray([l0], np.float32),
+                finished_reason=reason,
+            )
+            return KVHandoff(
+                prompt=prompt, first_token=t0, first_lp=l0, budget=0,
+                lens=P, block_size=self.block, finished=fin,
+            )
+        return KVHandoff(
+            prompt=prompt, first_token=t0, first_lp=l0, budget=budget,
+            lens=P, block_size=self.block, kv=kv,
+        )
+
+    def adopt_handoff(self, ho: KVHandoff):
+        """Adopt a :class:`KVHandoff`: allocate a slot and blocks, scatter
+        the handed-off KV contents into this engine's pools, and activate
+        the slot through the same masked admit-update a local admission
+        uses — decode continues from the first token as if the prefill
+        had run here. Returns the engine rid, or ``None`` when no slot or
+        blocks are free this instant."""
+        if not self.kv_handoff:
+            raise RuntimeError("engine built without kv_handoff=True")
+        if ho.finished is not None:
+            raise ValueError("handoff already finished; nothing to adopt")
+        if ho.block_size != self.block:
+            raise ValueError(
+                f"handoff block_size {ho.block_size} != engine block size "
+                f"{self.block}")
+        n = len(ho.kv[0][0])
+        free = [s for s in range(self.n_slots) if self.slot_rid[s] < 0]
+        if not free or n > len(self.free_blocks):
+            return None
+        s = free[0]
+        blocks = [self.free_blocks.pop() for _ in range(n)]
+        for j, b in enumerate(blocks):
+            self.table[s, j] = b
+            self._pending_table_writes.append((s, j, b))
+        # scatter the KV in, padded to a pow2 count with duplicate
+        # index+value pairs (idempotent — the table-flush trick), so the
+        # eager scatter compiles for O(log) distinct widths
+        pad_n = _pow2ceil(n)
+        didx = jnp.asarray(
+            np.asarray(blocks + [blocks[-1]] * (pad_n - n), np.int32))
+        for c, layer_kv in zip(self.cache, ho.kv):
+            fields = [f for f in _POOL_FIELDS if f in c]
+            for f, host in zip(fields, layer_kv):
+                vals = (
+                    np.concatenate(
+                        [host, np.repeat(host[-1:], pad_n - n, axis=0)])
+                    if pad_n > n else host
+                )
+                c[f] = c[f].at[didx].set(jnp.asarray(vals))
+        rid = self._next_rid
+        self._next_rid += 1
+        P = int(ho.lens)
+        self.slot_rid[s] = rid
+        self.slot_prompt[rid] = ho.prompt
+        self.slot_tokens[s] = [np.asarray([ho.first_token], np.int32)]
+        self.slot_lps[s] = [np.asarray([ho.first_lp], np.float32)]
+        self.lens[s] = P
+        self.sched_lens[s] = P
+        self.slot_budget[s] = ho.budget
+        self.sched_budget[s] = ho.budget
+        self.admissions += 1
+        self._flush_table_writes()
+        surv = np.zeros(self.n_slots, bool)
+        surv[s] = True
+        new_lens = np.zeros(self.n_slots, np.int32)
+        new_budget = np.zeros(self.n_slots, np.int32)
+        new_last = np.zeros(self.n_slots, np.int32)
+        new_lens[s], new_budget[s], new_last[s] = P, ho.budget, ho.first_token
+        if self.slot_rng:
+            new_rid = np.zeros(self.n_slots, np.int32)
+            new_rid[s] = rid
+            (
+                self.dev_lens, self.dev_active, self.dev_budget,
+                self.dev_last, self.dev_rid, self.dev_ntok,
+            ) = self._sadmit_update(
+                self.dev_lens, self.dev_active, self.dev_budget,
+                self.dev_last, self.dev_rid, self.dev_ntok,
+                jnp.asarray(surv), jnp.asarray(new_lens),
+                jnp.asarray(new_budget), jnp.asarray(new_last),
+                jnp.asarray(new_rid),
+            )
+        else:
+            (
+                self.dev_lens, self.dev_active, self.dev_budget,
+                self.dev_last,
+            ) = self._admit_update(
+                self.dev_lens, self.dev_active, self.dev_budget,
+                self.dev_last, jnp.asarray(surv), jnp.asarray(new_lens),
+                jnp.asarray(new_budget), jnp.asarray(new_last),
+            )
+        # on_admit deliberately NOT fired: it runs on the caller's thread
+        # (the fleet dispatcher), and admit_events is stepper-thread-only.
+        # The fleet records the handoff TTFT at prefill time instead.
+        return rid
 
     def pending(self) -> int:
         """Outstanding work: queued + in-flight requests."""

@@ -1,0 +1,641 @@
+"""Elastic fleet membership + SLO-burn autoscaler (ISSUE 19).
+
+Strategy mirrors test_fleet.py: tiny CPU engines, deterministic seeds,
+chaos only through registered FaultInjector sites. The tentpole
+invariants asserted here:
+
+- scale-up is COMPILE-FREE (CompileDelta == 0 against the shared
+  ShapeBuckets ladder, speculative ``verify.k*`` + ``suffix_ladder()``
+  families included) and mismatched ladders are rejected;
+- scale-down drains through the exactly-once failover path
+  (``lost == 0``, never the last member);
+- the O(1) KV watermark counters stay EXACT under membership churn
+  (property test: counter == full recount after a seeded
+  join/leave/crash sequence);
+- fresh members get a warm-up probe grace window;
+- the Autoscaler control loop triggers on burn / sustained slack with
+  cooldown gating (driven deterministically via poll_once(now=...));
+- LLMCollector rides the batch lane of a shared fleet and harvests
+  only its own rows.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+# imported at module scope (not inside tests): the lock_witness fixture
+# wraps threading.Lock while armed, and stdlib modules imported mid-test
+# (concurrent.futures.thread via the collectors) break under the wrap
+from rl_tpu.collectors.llm import LLMCollector
+from rl_tpu.models import (
+    Autoscaler,
+    AutoscalerConfig,
+    ContinuousBatchingEngine,
+    FinishedRequest,
+    ServingFleet,
+    TransformerConfig,
+    TransformerLM,
+)
+from rl_tpu.compile import CompileDelta
+from rl_tpu.models.fleet import HEALTHY, QUARANTINED, RETIRED
+from rl_tpu.obs import MetricsRegistry
+from rl_tpu.resilience import Fault, FaultInjector, injection
+
+pytestmark = pytest.mark.usefixtures("lock_witness")
+
+KEY = jax.random.key(0)
+
+
+def small_model():
+    cfg = TransformerConfig(
+        vocab_size=97, d_model=64, n_layers=2, n_heads=4, d_ff=128,
+        max_seq_len=128, dtype=jnp.float32,
+    )
+    m = TransformerLM(cfg)
+    params = m.init(KEY, jnp.zeros((1, 8), jnp.int32))["params"]
+    return m, params
+
+
+_MODEL = small_model()  # one compile cache for the whole module
+
+
+def _mk_engine(seed, **kw):
+    m, params = _MODEL
+    kw.setdefault("n_slots", 2)
+    kw.setdefault("block_size", 8)
+    kw.setdefault("n_blocks", 65)
+    kw.setdefault("prompt_buckets", (16,))
+    kw.setdefault("greedy", True)
+    return ContinuousBatchingEngine(m, params, seed=seed, **kw)
+
+
+def _engines(n=2, warm=True, **kw):
+    engines = [_mk_engine(i, **kw) for i in range(n)]
+    if warm:  # compile outside the fleet so a slow first step cannot
+        for e in engines:  # trip the liveness probes
+            e.submit(np.arange(8), 4)
+            e.run()
+    return engines
+
+
+def _fleet(engines, **kw):
+    kw.setdefault("registry", MetricsRegistry())
+    kw.setdefault("probe_interval_s", 0.01)
+    return ServingFleet(engines, **kw)
+
+
+def _wait_until(pred, timeout=30.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while not pred():
+        if time.monotonic() > deadline:
+            raise AssertionError(f"timed out waiting for {msg}")
+        time.sleep(0.005)
+
+
+class TestElasticMembership:
+    def test_scale_up_mid_traffic_compile_free(self):
+        """Tentpole: a member joins under live traffic with ZERO compiles
+        (the whole ladder loads from the shared registry/store), becomes
+        routable, and nothing in flight is lost."""
+        engines = _engines(2)
+        fleet = _fleet(engines)
+        fleet.aot_warmup()  # the resident members own the full ladder
+        fleet.start()
+        try:
+            rng = np.random.default_rng(0)
+            frids = [fleet.submit(rng.integers(0, 97, 8), 12)
+                     for _ in range(6)]
+            ev = fleet.add_member(_mk_engine(seed=7))
+            assert ev["event"] == "scale_up"
+            # THE contract: an identical replica loads, never compiles
+            assert ev["compile_delta"] == 0, ev["by_program"]
+            assert fleet.n_routable() == 3
+            snap = fleet.metrics_snapshot()
+            assert snap["scale_ups"] == 1 and snap["members_routable"] == 3
+            frids += [fleet.submit(rng.integers(0, 97, 8), 6)
+                      for _ in range(4)]
+            got = fleet.wait(frids, timeout=90)
+            assert sorted(got) == sorted(frids)
+            assert all(isinstance(r, FinishedRequest) for r in got.values())
+            acc = fleet.accounting()
+            assert acc["lost"] == 0 and acc["outstanding"] == 0
+            # the new member is probed like any other and stays healthy
+            _wait_until(
+                lambda: all(m["state"] == HEALTHY
+                            for m in fleet.metrics_snapshot()["members"]),
+                msg="new member healthy",
+            )
+        finally:
+            fleet.shutdown()
+
+    def test_add_member_rejects_mismatched_ladder(self):
+        """Satellite 3: a member on a DIFFERENT ShapeBuckets config would
+        compile under traffic on its first re-dispatch — rejected."""
+        fleet = _fleet(_engines(1))
+        with pytest.raises(ValueError, match="ShapeBuckets"):
+            fleet.add_member(_mk_engine(seed=9, prompt_buckets=(32,)))
+        assert fleet.n_routable() == 1
+        assert fleet.metrics_snapshot()["scale_ups"] == 0
+
+    def test_add_member_respects_max_members(self):
+        fleet = _fleet(_engines(2), max_members=2)
+        with pytest.raises(RuntimeError, match="max_members"):
+            fleet.add_member(_mk_engine(seed=5), warm=False)
+        assert fleet.n_routable() == 2
+
+    def test_spec_ladder_warm_is_compile_free(self):
+        """Satellite 3 (full ladder): speculative + prefix engines carry
+        the ``verify.k*`` programs and the ``suffix_ladder()`` buckets;
+        a dynamically added identical member must warm ALL of them with
+        CompileDelta == 0."""
+        kw = dict(speculative=True, draft_source="ngram", prefix_cache=True)
+        engines = [_mk_engine(0, **kw)]
+        fleet = _fleet(engines)
+        fleet.aot_warmup()
+        assert len(engines[0].shape_buckets.suffix_ladder()) > 0
+        newcomer = _mk_engine(seed=3, **kw)
+        assert newcomer.shape_buckets == fleet.shape_buckets
+        ev = fleet.add_member(newcomer)
+        assert ev["compile_delta"] == 0, ev["by_program"]
+        # verify.k* really was part of what the warm covered (not vacuous)
+        assert newcomer._verify_progs, "aot_warmup built no verify programs"
+
+    def test_scale_down_drains_exactly_once(self):
+        """Tentpole: retiring a member mid-decode re-dispatches its
+        outstanding work through the failover path — every request
+        completes exactly once, lost == 0."""
+        engines = _engines(3)
+        fleet = _fleet(engines).start()
+        try:
+            rng = np.random.default_rng(1)
+            frids = [fleet.submit(rng.integers(0, 97, 8), 24)
+                     for _ in range(9)]
+            _wait_until(
+                lambda: any(e.pending() > 0 for e in engines),
+                msg="fleet busy",
+            )
+            ev = fleet.scale_down()
+            assert ev is not None and ev["event"] == "scale_down"
+            assert fleet.n_routable() == 2
+            victim = next(m for m in fleet.metrics_snapshot()["members"]
+                          if m["idx"] == ev["idx"])
+            assert victim["state"] == RETIRED
+            got = fleet.wait(frids, timeout=90)
+            assert sorted(got) == sorted(frids)
+            assert all(isinstance(r, FinishedRequest) for r in got.values())
+            acc = fleet.accounting()
+            assert acc["completed"] == len(frids)
+            assert acc["lost"] == 0
+            # the retired engine gave its KV blocks back: watermark exact
+            assert fleet.kv_slack() == fleet.kv_recount()
+            # retired members take no new traffic
+            frid = fleet.submit(rng.integers(0, 97, 8), 4)
+            fleet.wait([frid], timeout=60)
+            assert len(engines[ev["idx"]].finished) == 0
+        finally:
+            fleet.shutdown()
+
+    def test_scale_down_never_drains_last_member(self):
+        fleet = _fleet(_engines(1)).start()
+        try:
+            assert fleet.scale_down() is None
+            assert fleet.n_routable() == 1
+            frid = fleet.submit(np.arange(8), 4)
+            assert isinstance(fleet.wait([frid], timeout=60)[frid],
+                              FinishedRequest)
+        finally:
+            fleet.shutdown()
+
+    def test_scale_down_by_idx_validates(self):
+        fleet = _fleet(_engines(2))
+        with pytest.raises(ValueError, match="no routable member"):
+            fleet.scale_down(idx=99)
+
+    def test_push_params_rolls_all_routable(self):
+        """A ShardedSyncScheme-style weight push touches one member lock
+        at a time; retired members are skipped."""
+        m, params = _MODEL
+        engines = _engines(3)
+        fleet = _fleet(engines).start()
+        try:
+            fleet.scale_down()
+            assert fleet.push_params(params) == 2
+            frid = fleet.submit(np.arange(8), 4)
+            assert isinstance(fleet.wait([frid], timeout=60)[frid],
+                              FinishedRequest)
+            assert fleet.accounting()["lost"] == 0
+        finally:
+            fleet.shutdown()
+
+
+class TestWarmupGrace:
+    def test_fresh_member_not_quarantined_by_slow_first_probes(self):
+        """Satellite 1: failed probes during the warm-up window do NOT
+        count toward quarantine; the first healthy round ends the grace
+        and normal deadlines apply from then on."""
+        fleet = _fleet(_engines(2), quarantine_after=2,
+                       warmup_grace_s=60.0)
+        m = fleet._members[0]
+        now = time.monotonic()
+        m.warming = True
+        m.warm_deadline = now + 60.0
+        for _ in range(5):  # way past quarantine_after
+            fleet._on_probe(m, False)
+        assert m.state == HEALTHY and m.probe_failures == 0
+        fleet._on_probe(m, True)  # first healthy round: grace over
+        assert m.warming is False
+        fleet._on_probe(m, False)
+        fleet._on_probe(m, False)
+        assert m.state == QUARANTINED
+
+    def test_expired_grace_counts_failures(self):
+        fleet = _fleet(_engines(1), quarantine_after=2)
+        m = fleet._members[0]
+        m.warming = True
+        m.warm_deadline = time.monotonic() - 1.0  # already expired
+        fleet._on_probe(m, False)
+        fleet._on_probe(m, False)
+        assert m.state == QUARANTINED
+
+    def test_added_member_starts_warming(self):
+        fleet = _fleet(_engines(1), warmup_grace_s=123.0)
+        ev = fleet.add_member(_mk_engine(seed=4), warm=False)
+        m = next(mm for mm in fleet._members if mm.idx == ev["idx"])
+        assert m.warming and m.warm_deadline > time.monotonic()
+
+    def test_readmission_regrants_grace(self):
+        """A re-admitted member is reloading executables too: the same
+        grace window applies until its first healthy probe after it."""
+        fleet = _fleet(_engines(1), quarantine_after=1, readmit_probes=1,
+                       readmit_backoff_s=0.0, warmup_grace_s=60.0)
+        m = fleet._members[0]
+        fleet._on_probe(m, False)
+        assert m.state == QUARANTINED
+        fleet._on_probe(m, True)
+        assert m.state == HEALTHY and m.warming is True
+        fleet._on_probe(m, False)  # inside the regranted grace: ignored
+        assert m.state == HEALTHY and m.probe_failures == 0
+
+
+class TestWatermarkUnderChurn:
+    def test_counter_equals_recount_after_join_leave_crash(self):
+        """Satellite 2 property test: after a SEEDED sequence of
+        traffic + join + leave + crash, the O(1) free-block counters
+        agree exactly with a ground-truth recount (kvmem audit / table
+        scan) — and the accounting invariant holds throughout."""
+        engines = _engines(2)
+        fleet = _fleet(engines)
+        fleet.aot_warmup()
+        fleet.start()
+        rng = np.random.default_rng(42)
+        try:
+            done: list[int] = []
+            # phase 1: traffic, then JOIN mid-flight
+            done += [fleet.submit(rng.integers(0, 97, 8), 16)
+                     for _ in range(4)]
+            fleet.add_member(_mk_engine(seed=11))
+            done += [fleet.submit(rng.integers(0, 97, 8), 8)
+                     for _ in range(4)]
+            fleet.wait(done, timeout=90)
+            assert fleet.kv_slack() == fleet.kv_recount()
+            # phase 2: traffic, then LEAVE mid-flight
+            batch = [fleet.submit(rng.integers(0, 97, 8), 16)
+                     for _ in range(6)]
+            fleet.scale_down()
+            fleet.wait(batch, timeout=90)
+            done += batch
+            assert fleet.kv_slack() == fleet.kv_recount()
+            # phase 3: CRASH one member mid-decode via its seeded site
+            batch = [fleet.submit(rng.integers(0, 97, 8), 24)
+                     for _ in range(6)]
+            alive = [m.idx for m in fleet._members
+                     if m.state == HEALTHY]
+            inj = FaultInjector(
+                {f"fleet.engine_crash.{alive[0]}": Fault("crash", at=(1,))},
+                registry=MetricsRegistry(),
+            )
+            with injection(inj):
+                fleet.wait(batch, timeout=90)
+            done += batch
+            _wait_until(lambda: fleet.accounting()["outstanding"] == 0,
+                        msg="quiesce")
+            assert fleet.kv_slack() == fleet.kv_recount()
+            acc = fleet.accounting()
+            assert acc["completed"] == len(done)
+            assert acc["lost"] == 0
+        finally:
+            fleet.shutdown()
+
+
+class _FakeFleet:
+    """Deterministic fleet double for control-loop logic tests."""
+
+    def __init__(self, burn=0.0, free=100, total=100, n=2):
+        self.burn, self.free, self.total, self.n = burn, free, total, n
+        self.adds, self.downs = 0, 0
+        self.compile_delta = 0
+        self.down_result = True
+
+    def ttft_burn_rate(self, window_s):
+        return self.burn
+
+    def kv_slack(self):
+        return self.free, self.total
+
+    def n_routable(self):
+        return self.n
+
+    def add_member(self, engine, *, warm=True, role="mixed"):
+        self.adds += 1
+        self.n += 1
+        return {"event": "scale_up", "idx": self.n - 1, "role": role,
+                "warm": warm, "compile_delta": self.compile_delta,
+                "by_program": {}, "t": 0.0}
+
+    def scale_down(self, idx=None, *, reason="scale_down"):
+        if not self.down_result:
+            return None
+        self.downs += 1
+        self.n -= 1
+        return {"event": "scale_down", "idx": self.n, "reason": reason,
+                "outstanding_redispatched": 0, "salvaged": 0, "t": 0.0}
+
+
+def _autoscaler(fleet, **cfg_kw):
+    cfg_kw.setdefault("cooldown_s", 5.0)
+    cfg_kw.setdefault("scale_down_sustain_s", 10.0)
+    return Autoscaler(
+        fleet, engine_factory=lambda: object(),
+        config=AutoscalerConfig(**cfg_kw),
+        registry=MetricsRegistry(),
+    )
+
+
+class TestAutoscalerLoop:
+    def test_scale_up_on_burn(self):
+        fl = _FakeFleet(burn=5.0, free=10, total=100, n=1)
+        a = _autoscaler(fl, scale_up_burn=2.0, max_members=4)
+        dec = a.poll_once(now=100.0)
+        assert dec["action"] == "scale_up" and fl.adds == 1
+        assert a.snapshot()["scale_ups"] == 1
+
+    def test_no_scale_up_at_max_members(self):
+        fl = _FakeFleet(burn=5.0, free=10, total=100, n=4)
+        a = _autoscaler(fl, scale_up_burn=2.0, max_members=4)
+        assert a.poll_once(now=100.0) is None and fl.adds == 0
+
+    def test_cooldown_gates_consecutive_actions(self):
+        fl = _FakeFleet(burn=5.0, free=10, total=100, n=1)
+        a = _autoscaler(fl, scale_up_burn=2.0, cooldown_s=5.0)
+        assert a.poll_once(now=100.0)["action"] == "scale_up"
+        assert a.poll_once(now=102.0) is None  # inside cooldown
+        assert a.poll_once(now=106.0)["action"] == "scale_up"
+        assert fl.adds == 2
+
+    def test_scale_down_needs_sustained_slack(self):
+        fl = _FakeFleet(burn=0.0, free=90, total=100, n=3)
+        a = _autoscaler(fl, scale_down_free_frac=0.6,
+                        scale_down_sustain_s=10.0, cooldown_s=0.0)
+        assert a.poll_once(now=100.0) is None  # slack clock just started
+        assert a.poll_once(now=105.0) is None  # not sustained yet
+        # pressure returns: the clock RESETS
+        fl.free = 10
+        assert a.poll_once(now=109.0) is None
+        fl.free = 90
+        assert a.poll_once(now=112.0) is None
+        assert a.poll_once(now=119.0) is None  # only 7s of slack
+        dec = a.poll_once(now=123.0)
+        assert dec["action"] == "scale_down" and fl.downs == 1
+
+    def test_burn_blocks_scale_down_despite_kv_slack(self):
+        """Under overload the queue waits in the admission lanes, not in
+        KV — free blocks look like slack while the SLO burns. The burn
+        guard keeps the slack clock from accumulating."""
+        fl = _FakeFleet(burn=1.0, free=100, total=100, n=3)
+        a = _autoscaler(fl, scale_up_burn=2.0, scale_down_free_frac=0.6,
+                        scale_down_sustain_s=1.0, scale_down_max_burn=0.25,
+                        cooldown_s=0.0)
+        for t in (100.0, 102.0, 104.0):
+            assert a.poll_once(now=t) is None
+        assert fl.downs == 0
+        fl.burn = 0.0  # pressure really gone -> slack clock starts now
+        assert a.poll_once(now=106.0) is None
+        dec = a.poll_once(now=108.0)
+        assert dec["action"] == "scale_down" and fl.downs == 1
+
+    def test_scale_down_respects_min_members(self):
+        fl = _FakeFleet(burn=0.0, free=100, total=100, n=1)
+        a = _autoscaler(fl, min_members=1, scale_down_sustain_s=0.0,
+                        cooldown_s=0.0)
+        a.poll_once(now=100.0)
+        assert a.poll_once(now=101.0) is None and fl.downs == 0
+
+    def test_noncompilefree_scale_up_raises(self):
+        """The ExecutableStore contract regressed -> loud failure, not a
+        silent compile storm under a traffic spike."""
+        fl = _FakeFleet(burn=5.0, free=10, total=100, n=1)
+        fl.compile_delta = 3
+        a = _autoscaler(fl, scale_up_burn=2.0)
+        with pytest.raises(RuntimeError, match="not compile-free"):
+            a.poll_once(now=100.0)
+        # the decision was still recorded for the flight recorder
+        assert a.snapshot()["decisions"][-1]["compile_delta"] == 3
+
+    def test_factory_failure_counts_and_starts_cooldown(self):
+        fl = _FakeFleet(burn=5.0, free=10, total=100, n=1)
+
+        def bad_factory():
+            raise OSError("no capacity")
+
+        a = Autoscaler(fl, engine_factory=bad_factory,
+                       config=AutoscalerConfig(scale_up_burn=2.0,
+                                               cooldown_s=5.0),
+                       registry=MetricsRegistry())
+        dec = a.poll_once(now=100.0)
+        assert dec["action"] == "scale_up_failed"
+        assert a.snapshot()["failures"] == 1
+        # a failing factory must not retry at poll cadence
+        assert a.poll_once(now=101.0) is None
+
+    def test_from_env(self, monkeypatch):
+        monkeypatch.setenv("RL_TPU_AUTOSCALE_UP_BURN", "7.5")
+        monkeypatch.setenv("RL_TPU_AUTOSCALE_MAX", "9")
+        monkeypatch.setenv("RL_TPU_AUTOSCALE_SUSTAIN_S", "bogus")
+        cfg = AutoscalerConfig.from_env(cooldown_s=1.25)
+        assert cfg.scale_up_burn == 7.5
+        assert cfg.max_members == 9
+        assert cfg.cooldown_s == 1.25  # explicit kwarg wins
+        assert cfg.scale_down_sustain_s == 10.0  # bad value ignored
+
+    def test_live_loop_scales_real_fleet_up_and_down(self):
+        """End to end on a REAL fleet: inject TTFT burn -> the control
+        thread adds a member compile-free; then sustained slack -> it
+        drains one back. lost == 0 throughout."""
+        engines = _engines(1)
+        # generous probe budget: a loaded CI box can stall a stepper past
+        # the default deadline, and a contention quarantine would change
+        # n_routable without the autoscaler doing anything
+        fleet = _fleet(engines, slo_ttft_s=1e-4,  # everything breaches
+                       probe_timeout_s=30.0)
+        fleet.aot_warmup()
+        fleet.start()
+        a = Autoscaler(
+            fleet, engine_factory=lambda: _mk_engine(seed=21),
+            config=AutoscalerConfig(
+                scale_up_burn=0.5, burn_window_s=5.0,
+                scale_down_free_frac=0.5, scale_down_sustain_s=0.3,
+                cooldown_s=0.2, poll_interval_s=0.02, max_members=2,
+            ),
+            registry=MetricsRegistry(),
+        )
+        try:
+            rng = np.random.default_rng(3)
+            a.start()
+            # keep breaching traffic flowing until the scale-up lands: a
+            # single up-front batch can age out of the 5 s burn window
+            # before the control thread's first look on a loaded machine
+            deadline = time.monotonic() + 60.0
+            while a.snapshot()["scale_ups"] < 1:
+                assert time.monotonic() < deadline, (
+                    "timed out waiting for autoscaler scale-up")
+                frids = [fleet.submit(rng.integers(0, 97, 8), 8)
+                         for _ in range(2)]
+                fleet.wait(frids, timeout=60)  # every TTFT breaches 1e-4
+            _wait_until(lambda: fleet.n_routable() == 2,
+                        msg="scale-up member routable")
+            # idle fleet: full KV slack, sustained -> drains back down
+            _wait_until(lambda: a.snapshot()["scale_downs"] >= 1,
+                        timeout=60.0, msg="autoscaler scale-down")
+            snap = a.snapshot()
+            up = next(d for d in snap["decisions"]
+                      if d["action"] == "scale_up")
+            assert up["compile_delta"] == 0
+            frid = fleet.submit(rng.integers(0, 97, 8), 4)
+            assert isinstance(fleet.wait([frid], timeout=60)[frid],
+                              FinishedRequest)
+            assert fleet.accounting()["lost"] == 0
+        finally:
+            a.stop()
+            fleet.shutdown()
+
+
+class TestBatchLaneTenancy:
+    def test_collector_rides_batch_lane(self):
+        """LLMCollector as a fleet tenant: rollout rows ride the batch
+        lane, results come back row-exact via poll() (never another
+        tenant's rows), and interactive traffic in flight at the same
+        time is untouched."""
+        m, params = _MODEL
+        engines = _engines(2)
+        fleet = _fleet(engines).start()
+        try:
+            col = LLMCollector(
+                env=None, model=m, num_prompts=2, max_new_tokens=6,
+                eos_id=None, fleet=fleet, fleet_timeout_s=60.0,
+            )
+            rng = np.random.default_rng(5)
+            inter = [fleet.submit(rng.integers(0, 97, 8), 8,
+                                  lane="interactive") for _ in range(3)]
+            G, P = 4, 8
+            toks = rng.integers(0, 97, (G, P)).astype(np.int32)
+            pmask = np.ones((G, P), np.float32)
+            out = col._fleet_generate(params, toks, pmask, KEY)
+            assert out.response_tokens.shape == (G, 6)
+            assert bool(out.response_mask.all())
+            # greedy engines: every row matches a direct single-engine run
+            ref = _mk_engine(seed=33)
+            rids = {ref.submit(toks[g], 6): g for g in range(G)}
+            for rid, fin in ref.run().items():
+                np.testing.assert_array_equal(
+                    np.asarray(out.response_tokens[rids[rid]]), fin.tokens)
+            # the interactive tenant still gets every one of ITS rows
+            got = fleet.wait(inter, timeout=60)
+            assert sorted(got) == sorted(inter)
+            assert fleet.accounting()["lost"] == 0
+        finally:
+            fleet.shutdown()
+
+
+class TestPrefillDecodeHandoff:
+    def _spawn_pair(self):
+        kw = dict(kv_handoff=True, warm=True)
+        return _engines(2, **kw)
+
+    def test_engine_roundtrip_matches_single_engine(self):
+        """prefill_detached on engine A + adopt_handoff on engine B
+        continues the EXACT sequence: greedy tokens equal a single-engine
+        run of the same prompt."""
+        pe, de = self._spawn_pair()
+        ref = _mk_engine(seed=50)
+        prompt = np.arange(3, 11)
+        rid_ref = ref.submit(prompt, 8)
+        expect = ref.run()[rid_ref]
+        ho = pe.prefill_detached(prompt, 8)
+        assert ho is not None and ho.finished is None
+        assert pe.pending() == 0  # nothing stays resident on the prefiller
+        assert int((np.asarray(pe.table) >= 0).sum()) == 0
+        rid = de.adopt_handoff(ho)
+        assert rid is not None
+        fin = de.run()[rid]
+        np.testing.assert_array_equal(fin.tokens, expect.tokens)
+        np.testing.assert_allclose(fin.log_probs, expect.log_probs,
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_one_token_budget_finishes_at_prefill(self):
+        pe, _ = self._spawn_pair()
+        ref = _mk_engine(seed=51)
+        prompt = np.arange(5, 12)
+        rid = ref.submit(prompt, 1)
+        expect = ref.run()[rid]
+        ho = pe.prefill_detached(prompt, 1)
+        assert ho is not None and ho.finished is not None
+        np.testing.assert_array_equal(ho.finished.tokens, expect.tokens)
+
+    def test_handoff_requires_flag_and_plain_engine(self):
+        e = _mk_engine(seed=52)
+        with pytest.raises(RuntimeError, match="kv_handoff"):
+            e.prefill_detached(np.arange(8), 4)
+        with pytest.raises(ValueError, match="prefix_cache"):
+            _mk_engine(seed=53, kv_handoff=True, prefix_cache=True)
+        with pytest.raises(ValueError, match="speculative"):
+            _mk_engine(seed=54, kv_handoff=True, speculative=True,
+                       draft_source="ngram")
+
+    def test_disaggregated_fleet_matches_single_engine(self):
+        """Stretch tentpole: roles=(prefill, decode) — the dispatcher
+        routes prefill to the prefill member, hands the paged KV to the
+        decode member, and the fleet's answer is bit-identical to one
+        engine. lost == 0, and the prefill member never holds residents."""
+        engines = self._spawn_pair()
+        fleet = _fleet(engines, disaggregate=True,
+                       roles=("prefill", "decode")).start()
+        try:
+            ref = _mk_engine(seed=55)
+            rng = np.random.default_rng(6)
+            prompts = [rng.integers(0, 97, 8) for _ in range(5)]
+            expect = {}
+            for i, p in enumerate(prompts):
+                rid = ref.submit(p, 10)
+                expect[i] = ref.run()[rid]
+            frids = [fleet.submit(p, 10) for p in prompts]
+            got = fleet.wait(frids, timeout=90)
+            for i, frid in enumerate(frids):
+                fin = got[frid]
+                assert isinstance(fin, FinishedRequest)
+                np.testing.assert_array_equal(fin.tokens, expect[i].tokens)
+            acc = fleet.accounting()
+            assert acc["completed"] == len(prompts) and acc["lost"] == 0
+            # KV watermark stays exact across the handoffs
+            assert fleet.kv_slack() == fleet.kv_recount()
+            snap = fleet.metrics_snapshot()
+            roles = {m["idx"]: m["role"] for m in snap["members"]}
+            assert roles == {0: "prefill", 1: "decode"}
+        finally:
+            fleet.shutdown()
+
+    def test_roles_need_disaggregate_flag(self):
+        with pytest.raises(ValueError):
+            _fleet(self._spawn_pair(), roles=("prefill", "decode"))

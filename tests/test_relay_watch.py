@@ -789,6 +789,60 @@ def test_profiling_section_distilled_to_own_artifact(tmp_path):
     assert runner.commits[0][0] == [art, mart, pfart]
 
 
+def test_autoscale_result_distilled_to_own_artifact(tmp_path):
+    """PR-19: the autoscale sub-bench result (fixed vs elastic arm
+    attainment through the burst, the scale-up CompileDelta invariant,
+    rollout-lane tokens/s, the scale event trail, and the disagg handoff
+    sub-result) lands whole in its own committed AUTOSCALE json — the
+    file the offline perf sentry gates — riding the same single
+    commit."""
+
+    class AutoscaleRunner(FakeRunner):
+        def bench_all(self, timeout):
+            self.bench_calls.append(timeout)
+            az = {
+                "metric": "slo_ttft_attainment_burst",
+                "value": 0.53,
+                "vs_baseline": 2.46,
+                "lost": 0,
+                "scale_ups": 1,
+                "scale_downs": 1,
+                "scale_up_compile_delta_max": 0,
+                "steady_state_compile_delta": 0,
+                "rollout_tokens_per_sec": 447.8,
+                "waste_frac": 0.52,
+                "events": [{"event": "scale_up", "compile_delta": 0}],
+                "arms": {"fixed": {"lost": 0}, "autoscale": {"lost": 0}},
+                "disagg": {"requests": 32, "lost": 0},
+                "metrics": {"slo_ttft_attainment_burst_autoscale": 0.53},
+            }
+            lines = [
+                {"metric": "ppo", "value": 123.0},
+                {"autoscale": az},
+                # the final aggregate repeats the sub-result; last wins
+                {"probe": {"platform": "tpu"}, "autoscale": az},
+            ]
+            return 0, "".join(json.dumps(ln) + "\n" for ln in lines)
+
+    runner = AutoscaleRunner([_healthy()])
+    art = str(tmp_path / "bench.jsonl")
+    mart = str(tmp_path / "METRICS.json")
+    azart = str(tmp_path / "AUTOSCALE.json")
+    watch(runner, lambda s: None, max_probes=1, artifact=art,
+          metrics_artifact=mart, autoscale_artifact=azart,
+          sleep=lambda s: None)
+    doc = json.loads(open(azart).read())
+    az = doc["autoscale"]
+    assert az["scale_up_compile_delta_max"] == 0  # the sentry invariant
+    assert az["vs_baseline"] == 2.46
+    assert az["arms"]["autoscale"]["lost"] == 0
+    assert az["disagg"]["requests"] == 32
+    assert doc["artifact"] == os.path.relpath(art, REPO)
+    # all three files land in ONE commit
+    assert len(runner.commits) == 1
+    assert runner.commits[0][0] == [art, mart, azart]
+
+
 def test_sentry_gate_runs_after_bench_and_commits_history(tmp_path):
     """PR-18: a runner exposing ``sentry`` gets the offline perf sentry
     run over the freshly (re)written artifact series, with the

@@ -142,6 +142,28 @@ GATES: list[Gate] = [
          "==", 0, "the kernel arm recompiled in steady state"),
     Gate("PROF_pr18.json", "profiling.armed_overhead_frac", "<", 0.02,
          "the armed profiler/drift feed costs more than 2% of wall"),
+    # PR-19 elasticity: the committed run measured burst attainment
+    # 0.53 (autoscale) vs 0.22 (fixed), vs_baseline 2.46 — floors sit
+    # well under that; the ==0 gates are invariants.
+    Gate("AUTOSCALE_pr19.json", "autoscale.lost", "==", 0,
+         "the elastic fleet lost a request across scale-up/down/crash"),
+    Gate("AUTOSCALE_pr19.json", "autoscale.scale_up_compile_delta_max",
+         "==", 0,
+         "a scale-up warm compiled instead of loading from the store"),
+    Gate("AUTOSCALE_pr19.json", "autoscale.steady_state_compile_delta",
+         "==", 0, "the autoscale arm recompiled mid-traffic"),
+    Gate("AUTOSCALE_pr19.json", "autoscale.value", ">=", 0.3,
+         "burst-window SLO attainment under autoscaling collapsed"),
+    Gate("AUTOSCALE_pr19.json", "autoscale.vs_baseline", ">=", 1.2,
+         "the elastic arm no longer beats the fixed fleet through the burst"),
+    Gate("AUTOSCALE_pr19.json", "autoscale.rollout_tokens_per_sec", ">", 0.0,
+         "the batch-lane tenant harvested nothing from fleet slack"),
+    Gate("AUTOSCALE_pr19.json", "autoscale.waste_frac", "<=", 0.65,
+         "idle-capacity waste under autoscaling exceeded its ceiling"),
+    Gate("AUTOSCALE_pr19.json", "autoscale.scale_ups", ">=", 1,
+         "no scale-up fired on the seeded burst"),
+    Gate("AUTOSCALE_pr19.json", "autoscale.scale_downs", ">=", 1,
+         "no scale-down drained the post-burst slack"),
 ]
 
 

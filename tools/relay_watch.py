@@ -281,6 +281,36 @@ def _extract_profiling(stdout: str) -> dict | None:
     return found
 
 
+def _extract_autoscale(stdout: str) -> dict | None:
+    """Find the autoscale sub-bench result (ISSUE-19 elastic fleet: the
+    seeded diurnal+burst replay run through a fixed-fleet arm and an
+    SLO-burn-autoscaled arm — burst-window attainment both arms, the
+    scale-up CompileDelta invariant, rollout batch-lane tokens/s from
+    slack, idle-capacity waste, the scale event trail, and the
+    prefill/decode handoff sub-result) in a bench stdout JSONL stream.
+    The per-arm dicts and the autoscaler decision snapshot carry
+    structure worth keeping whole, so they get their own committed
+    AUTOSCALE artifact — which is also what the offline perf sentry
+    gates. Last match wins (the final aggregate line repeats the
+    sub-results)."""
+    found = None
+    for ln in (stdout or "").strip().splitlines():
+        try:
+            d = json.loads(ln)
+        except ValueError:
+            continue
+        if not isinstance(d, dict):
+            continue
+        for c in [d] + [v for v in d.values() if isinstance(v, dict)]:
+            v = c.get("autoscale")
+            if isinstance(v, dict) and (
+                "scale_up_compile_delta_max" in v
+                or "rollout_tokens_per_sec" in v
+            ):
+                found = v
+    return found
+
+
 def _extract_ir_audit(stdout: str) -> dict:
     """Collect every ``ir_audit`` section (PR-15 deep-tier auditor: per-
     program predicted-vs-measured MFU from the static roofline, audit
@@ -406,6 +436,7 @@ def watch(
     obs_artifact: str | None = None,
     audit_artifact: str | None = None,
     profiling_artifact: str | None = None,
+    autoscale_artifact: str | None = None,
     sentry_artifact: str | None = None,
     rlint_artifact: str | None = None,
     commit: bool = True,
@@ -598,6 +629,23 @@ def watch(
                 f.write("\n")
             paths.append(pfpath)
             log(f"{_utcnow()} profiling -> {os.path.relpath(pfpath, REPO)}")
+        az = _extract_autoscale(bout)
+        if az is not None:
+            azpath = autoscale_artifact or os.path.join(
+                REPO, "AUTOSCALE_pr19.json"
+            )
+            with open(azpath, "w") as f:
+                json.dump(
+                    {
+                        "artifact": os.path.relpath(path, REPO),
+                        "generated": _utcnow(),
+                        "autoscale": az,
+                    },
+                    f, indent=2, sort_keys=True,
+                )
+                f.write("\n")
+            paths.append(azpath)
+            log(f"{_utcnow()} autoscale -> {os.path.relpath(azpath, REPO)}")
         if hasattr(runner, "rlint"):
             # PR-8: keep the static-analysis summary current alongside the
             # perf artifacts — the same commit that records a measurement
@@ -664,6 +712,8 @@ def main(argv=None) -> int:
                     help="IR-audit predicted-vs-measured MFU path (default AUDIT_pr15.json)")
     ap.add_argument("--profiling-artifact", default=None,
                     help="profiler/drift distillation path (default PROF_pr18.json)")
+    ap.add_argument("--autoscale-artifact", default=None,
+                    help="elastic-fleet A/B path (default AUTOSCALE_pr19.json)")
     ap.add_argument("--sentry-artifact", default=None,
                     help="perf-sentry gate roll-up path (default PERF_HISTORY.json)")
     ap.add_argument("--rlint-artifact", default=None,
@@ -696,6 +746,7 @@ def main(argv=None) -> int:
         obs_artifact=args.obs_artifact,
         audit_artifact=args.audit_artifact,
         profiling_artifact=args.profiling_artifact,
+        autoscale_artifact=args.autoscale_artifact,
         sentry_artifact=args.sentry_artifact,
         rlint_artifact=args.rlint_artifact,
         commit=not args.no_commit,
