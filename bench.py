@@ -4406,6 +4406,266 @@ PROBE_BUDGET = float(os.environ.get("BENCH_PROBE_TIMEOUT", "45"))
 UNREACHABLE = "tpu backend unreachable (init hang)"
 
 
+def bench_replay_shard(report: bool = True) -> dict:
+    """BENCH_MODE=replay_shard: sharded experience tier A/B (ISSUE-20).
+
+    Arm A: ONE ``ReplayService`` endpoint owning a device PER sum-tree at
+    capacity C. Arm B: N=4 ``ReplayShard`` endpoints at C/N each behind
+    the ``ShardedReplayBuffer`` mixture coordinator. Same total capacity,
+    same offered write stream (4 writer threads), a sampling thread per
+    arm measuring end-to-end sample latency. The PER write path's exact
+    esum rebuild is O(capacity) per extend, so partitioning buys a real
+    single-core win — the >=2x acceptance bound holds even on a 1-core
+    host; process parallelism across shard servers is upside on top.
+
+    Phase 2 replays the acceptance chaos scenario: a seeded
+    ``replay.shard_crash.1`` kills a shard mid-traffic under supervised
+    keepers — reported: learner-visible errors (must be 0), faults fired,
+    and seconds from the crash to supervisor re-admission."""
+    jax = _setup_jax()
+    import threading
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from rl_tpu.data import (
+        ArrayDict,
+        DeviceStorage,
+        PrioritizedSampler,
+        ReplayBuffer,
+    )
+    from rl_tpu.data.replay import (
+        RemoteReplayBuffer,
+        ReplayService,
+        ReplayShard,
+        ShardedReplayBuffer,
+    )
+    from rl_tpu.resilience import Fault, FaultInjector, injection
+
+    N_SHARDS = 4
+    # capacity picks the regime the subsystem targets (GEAR-scale
+    # buffers): the PER write program carries O(capacity) full-array
+    # work per extend (measured ~33ms/extend at 2^20 vs ~10ms at the
+    # 2^18 shard size on cpu), so the partitioning win is algorithmic,
+    # not core-count-dependent
+    CAP = _T(smoke=1 << 12, cpu=1 << 20, full=1 << 21)
+    ITEMS = _T(smoke=128, cpu=256, full=512)  # items per extend
+    ARM_S = _T(smoke=2.0, cpu=6.0, full=8.0)  # timed window per arm
+    SAMPLE_B = 64
+    N_WRITERS = 4
+
+    example = ArrayDict(
+        observation=jnp.zeros((8,), jnp.float32),
+        action=jnp.zeros((2,), jnp.float32),
+        next=ArrayDict(
+            reward=jnp.asarray(0.0, jnp.float32),
+            done=jnp.asarray(False),
+        ),
+        collector=ArrayDict(policy_version=jnp.asarray(0, jnp.int32)),
+    )
+
+    def mk_batch(n, version=0):
+        return ArrayDict(
+            observation=jnp.zeros((n, 8), jnp.float32),
+            action=jnp.zeros((n, 2), jnp.float32),
+            next=ArrayDict(
+                reward=jnp.zeros((n,), jnp.float32),
+                done=jnp.zeros((n,), bool),
+            ),
+            collector=ArrayDict(
+                policy_version=jnp.full((n,), version, jnp.int32)
+            ),
+        )
+
+    def mk_buffer(cap):
+        return ReplayBuffer(
+            DeviceStorage(cap), PrioritizedSampler(), batch_size=SAMPLE_B
+        )
+
+    batch = jax.block_until_ready(mk_batch(ITEMS))
+
+    def drive_arm(extend_fn, sample_fn, update_fn, warm_fn=None):
+        """4 writers + 1 sampler against one arm for ARM_S seconds.
+        Returns (items_written, sample_latencies_s)."""
+        for _ in range(N_SHARDS):  # prefill + compile the write path
+            extend_fn(batch)  # (round-robin: one batch lands per shard)
+        if warm_fn is not None:
+            warm_fn()  # pre-compile every in-shard draw bucket
+        mb = sample_fn(SAMPLE_B)  # compile the sample path
+        update_fn(
+            np.asarray(mb["index"]).reshape(-1),
+            np.full((SAMPLE_B,), 1.0, np.float32),
+        )
+        stop = time.monotonic() + ARM_S
+        counts = [0] * N_WRITERS
+        lat: list = []
+        errs: list = []
+
+        def writer(i):
+            try:
+                while time.monotonic() < stop:
+                    extend_fn(batch)
+                    counts[i] += ITEMS
+            except Exception as e:  # noqa: BLE001 - surfaced in the result
+                errs.append(repr(e))
+
+        def sampler():
+            # paced like a real learner (fixed consumption rate), not a
+            # spin loop — an unpaced sampler on a small host just steals
+            # writer CPU and the arm with the cheaper sample path wins
+            # the WRITE benchmark for the wrong reason
+            try:
+                while time.monotonic() < stop:
+                    t0 = time.perf_counter()
+                    mb = sample_fn(SAMPLE_B)
+                    lat.append(time.perf_counter() - t0)
+                    update_fn(
+                        np.asarray(mb["index"]).reshape(-1),
+                        np.full((SAMPLE_B,), 1.0, np.float32),
+                    )
+                    time.sleep(max(0.0, 0.1 - (time.perf_counter() - t0)))
+            except Exception as e:  # noqa: BLE001
+                errs.append(repr(e))
+
+        threads = [
+            threading.Thread(target=writer, args=(i,)) for i in range(N_WRITERS)
+        ] + [threading.Thread(target=sampler)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errs:
+            raise RuntimeError(f"arm errors: {errs[:3]}")
+        return sum(counts), lat
+
+    # -- arm A: one endpoint at full capacity ---------------------------------
+    svc = ReplayService(mk_buffer(CAP), example, seed=0).start()
+    clients = [RemoteReplayBuffer(*svc.address) for _ in range(N_WRITERS + 1)]
+    rr = iter(range(1 << 30))
+    try:
+        n_single, lat_single = drive_arm(
+            lambda b: clients[next(rr) % N_WRITERS].extend(b),
+            clients[-1].sample,
+            clients[-1].update_priority,
+        )
+    finally:
+        svc.shutdown()
+
+    # -- arm B: N shards at CAP/N behind the mixture coordinator ---------------
+    shards = [
+        ReplayShard(i, lambda: mk_buffer(CAP // N_SHARDS), example, seed=i).start()
+        for i in range(N_SHARDS)
+    ]
+    coord = ShardedReplayBuffer(
+        [s.address for s in shards], CAP // N_SHARDS,
+        batch_size=SAMPLE_B, seed=0,
+    )
+    try:
+        n_sharded, lat_sharded = drive_arm(
+            coord.extend, coord.sample, coord.update_priority,
+            warm_fn=coord.warm_sample,
+        )
+    finally:
+        coord.close()
+        for s in shards:
+            s.shutdown()
+
+    single_ips = n_single / ARM_S
+    sharded_ips = n_sharded / ARM_S
+    speedup = sharded_ips / max(single_ips, 1e-9)
+
+    def pct(xs, q):
+        return round(float(np.percentile(np.asarray(xs), q)) * 1e3, 2) if xs else None
+
+    # -- phase 2: seeded shard crash under supervised keepers ------------------
+    cap_c = _T(smoke=1 << 10, cpu=1 << 12, full=1 << 12)
+    cshards = [
+        ReplayShard(i, lambda: mk_buffer(cap_c), example, seed=i).start()
+        for i in range(3)
+    ]
+    ccoord = ShardedReplayBuffer(
+        [s.address for s in cshards], cap_c,
+        batch_size=SAMPLE_B, seed=0,
+        mass_refresh_s=0.05, probe_interval_s=0.05,
+        restart_fn=lambda i: cshards[i].restart(),
+    )
+    inj = FaultInjector(
+        {"replay.shard_crash.1": Fault(kind="crash", at=(20,))}, seed=0
+    )
+    learner_errors = 0
+    recovery_s = None
+    try:
+        ccoord.start_keepers()
+        with injection(inj):
+            for step in range(_T(smoke=80, cpu=200, full=200)):
+                try:
+                    ccoord.extend(mk_batch(SAMPLE_B, version=step))
+                    if step > 2:
+                        mb = ccoord.sample(SAMPLE_B)
+                        ccoord.update_priority(
+                            np.asarray(mb["index"]).reshape(-1),
+                            np.full((SAMPLE_B,), 1.0, np.float32),
+                        )
+                except Exception:  # noqa: BLE001 - the count IS the metric
+                    learner_errors += 1
+                # stamp recovery the moment the keeper re-admits — waiting
+                # until after the loop would fold the remaining traffic
+                # time into the number and overstate it by ~10x
+                if (
+                    recovery_s is None
+                    and inj.last_fire_monotonic is not None
+                    and ccoord._c_readmit.value({"shard": "1"}) >= 1
+                ):
+                    recovery_s = round(
+                        time.monotonic() - inj.last_fire_monotonic, 3
+                    )
+                time.sleep(0.002)
+        if recovery_s is None:
+            deadline = time.monotonic() + 15.0
+            while time.monotonic() < deadline:
+                if ccoord._c_readmit.value({"shard": "1"}) >= 1:
+                    recovery_s = round(
+                        time.monotonic() - (inj.last_fire_monotonic or time.monotonic()), 3
+                    )
+                    break
+                time.sleep(0.01)
+    finally:
+        ccoord.close()
+        for s in cshards:
+            try:
+                s.shutdown()
+            except Exception:
+                pass
+
+    out = {
+        "metric": "replay_shard_extend_items_per_sec",
+        "value": round(sharded_ips, 1),
+        "unit": "items/s",
+        # vs the >=2x acceptance bound over the single endpoint
+        "vs_baseline": round(speedup / 2.0, 3),
+        "shard_speedup_x": round(speedup, 2),
+        "single_items_per_sec": round(single_ips, 1),
+        "n_shards": N_SHARDS,
+        "capacity_single": CAP,
+        "capacity_per_shard": CAP // N_SHARDS,
+        "items_per_extend": ITEMS,
+        "sample_p50_ms": pct(lat_sharded, 50),
+        "sample_p99_ms": pct(lat_sharded, 99),
+        "single_sample_p50_ms": pct(lat_single, 50),
+        "single_sample_p99_ms": pct(lat_single, 99),
+        "chaos": {
+            "faults_fired": len(inj.fired),
+            "learner_errors": learner_errors,
+            "readmitted": 1 if recovery_s is not None else 0,
+            "recovery_s": recovery_s,
+        },
+    }
+    out.update(_platform_tag(jax))
+    if report:
+        print(json.dumps({"replay_shard": out}), flush=True)
+    return out
+
+
 def bench_all():
     """Default mode: a pure orchestrator — it never imports jax, because
     the TPU is process-exclusive. Order:
@@ -4451,7 +4711,7 @@ def bench_all():
 
     weights = {"ppo": 2.0, "rlhf": 1.4, "pixel": 1.2, "hopper": 1.0,
                "sac": 1.0, "per": 1.0, "async_collect": 0.8, "serve": 0.8,
-               "fleet": 0.8, "autoscale": 0.8, "prefix": 0.8,
+               "fleet": 0.8, "autoscale": 0.8, "replay_shard": 0.8, "prefix": 0.8,
                "spec": 0.8, "kernels": 0.8,
                "multichip": 0.8,
                "anakin": 0.8, "compile": 0.8, "chaos": 0.6}
@@ -4597,6 +4857,7 @@ if __name__ == "__main__":
             "chaos": bench_chaos,
             "fleet": bench_fleet,
             "autoscale": bench_autoscale,
+            "replay_shard": bench_replay_shard,
             "prefix": bench_prefix,
             "spec": bench_spec,
             "kernels": bench_kernels,

@@ -48,6 +48,8 @@ __all__ = [
     "JaxDistributedRendezvous",
     "Mailbox",
     "CommandChannel",
+    "BinaryReply",
+    "BLOB_KEY",
     "TCPCommandServer",
     "TCPCommandClient",
 ]
@@ -267,13 +269,43 @@ class CommandChannel:
         return out
 
 
+# reserved payload key a binary request's raw frame arrives under — old
+# handlers never see it (old peers never send "nbin"), binary-aware
+# handlers pop it
+BLOB_KEY = "__blob__"
+
+
+class BinaryReply:
+    """A handler return value carrying a raw binary frame alongside the JSON
+    ``out``. The server writes the header line with ``nbin=len(blob)`` and
+    streams the bytes after the newline — no base64, no double copy."""
+
+    __slots__ = ("out", "blob")
+
+    def __init__(self, out: Any, blob: bytes):
+        self.out = out
+        self.blob = blob
+
+
 class _JSONHandler(socketserver.StreamRequestHandler):
     def handle(self):
         line = self.rfile.readline()
         if not line:
             return
+        blob_out = b""
         try:
             req = json.loads(line)
+            # binary frame extension: a request announcing "nbin" is
+            # followed by exactly that many raw bytes after the newline;
+            # old peers never send the field, so this is wire-compatible
+            nbin = int(req.get("nbin") or 0)
+            payload = req.get("payload")
+            if nbin:
+                blob = self.rfile.read(nbin)
+                if len(blob) != nbin:
+                    raise ConnectionError("truncated binary frame")
+                payload = dict(payload or {})
+                payload[BLOB_KEY] = blob
             fn = self.server._handlers.get(req.get("command"))  # type: ignore[attr-defined]
             if fn is None:
                 resp = {"status": "error", "out": f"unknown command {req.get('command')!r}"}
@@ -285,7 +317,7 @@ class _JSONHandler(socketserver.StreamRequestHandler):
                 # stays wire-compatible in both directions
                 wire_ctx = TraceContext.from_wire(req.get("trace"))
                 if wire_ctx is None:
-                    resp = {"status": "ok", "out": fn(req.get("payload"))}
+                    out = fn(payload)
                 else:
                     # adopt the caller's context on this handler thread:
                     # everything the handler does (spans, fleet submits,
@@ -293,16 +325,22 @@ class _JSONHandler(socketserver.StreamRequestHandler):
                     with use_context(wire_ctx), get_tracer().ctx_span(
                         f"comm/handle:{req.get('command')}"
                     ):
-                        resp = {"status": "ok", "out": fn(req.get("payload"))}
+                        out = fn(payload)
+                if isinstance(out, BinaryReply):
+                    blob_out = out.blob
+                    resp = {"status": "ok", "out": out.out, "nbin": len(blob_out)}
+                else:
+                    resp = {"status": "ok", "out": out}
         except Exception as e:  # noqa: BLE001
             resp = {"status": "error", "out": repr(e)}
+            blob_out = b""
         # chaos site: the handler already ran — a drop here models a reply
         # lost on the wire, which only a client-side retry can survive
         from ..resilience.faults import should_drop
 
         if should_drop("comm.server.reply"):
             return
-        self.wfile.write((json.dumps(resp) + "\n").encode())
+        self.wfile.write((json.dumps(resp) + "\n").encode() + blob_out)
 
 
 class TCPCommandServer:
@@ -343,12 +381,15 @@ class TCPCommandClient:
         self.host, self.port, self.timeout = host, port, timeout
         self.retry = retry
 
-    def _call_once(self, command: str, payload: Any) -> Any:
+    def _call_once(self, command: str, payload: Any, blob: bytes | None = None,
+                   binary: bool = False) -> Any:
         from ..obs.trace import current_context, get_tracer
 
         req = {"command": command, "payload": payload}
+        if blob is not None:
+            req["nbin"] = len(blob)
         if current_context() is None:
-            return self._send(req)
+            return self._send(req, blob=blob, binary=binary)
         # inside a traced request: the wire frame carries the RPC span's
         # context so the server-side handler links under THIS call (the
         # one TCP hop in the request tree); retried calls each get their
@@ -356,30 +397,65 @@ class TCPCommandClient:
         with get_tracer().ctx_span(f"comm/call:{command}") as span_ctx:
             if span_ctx is not None:
                 req["trace"] = span_ctx.to_wire()
-            return self._send(req)
+            return self._send(req, blob=blob, binary=binary)
 
-    def _send(self, req: Mapping[str, Any]) -> Any:
+    def _send(self, req: Mapping[str, Any], blob: bytes | None = None,
+              binary: bool = False) -> Any:
         command = req["command"]
         with socket.create_connection((self.host, self.port), timeout=self.timeout) as s:
-            s.sendall((json.dumps(dict(req)) + "\n").encode())
+            wire = (json.dumps(dict(req)) + "\n").encode()
+            if blob is not None:
+                wire += blob
+            s.sendall(wire)
             data = b""
-            while not data.endswith(b"\n"):
+            while b"\n" not in data:
                 chunk = s.recv(65536)
                 if not chunk:
                     break
                 data += chunk
-        if not data:
-            # server accepted the connection but never replied (dropped
-            # reply / handler crash): transport-shaped, hence retryable
+            if not data:
+                # server accepted the connection but never replied (dropped
+                # reply / handler crash): transport-shaped, hence retryable
+                raise ConnectionError(
+                    f"empty reply from {self.host}:{self.port} for {command!r}"
+                )
+            if b"\n" not in data:
+                raise ConnectionError(
+                    f"truncated reply from {self.host}:{self.port} for {command!r}"
+                )
+            head, rest = data.split(b"\n", 1)
+            resp = json.loads(head)
+            nbin = int(resp.get("nbin") or 0)
+            while len(rest) < nbin:
+                chunk = s.recv(65536)
+                if not chunk:
+                    break
+                rest += chunk
+        if len(rest) < nbin:
             raise ConnectionError(
-                f"empty reply from {self.host}:{self.port} for {command!r}"
+                f"truncated binary reply from {self.host}:{self.port} for {command!r}"
             )
-        resp = json.loads(data)
         if resp["status"] != "ok":
             raise RuntimeError(f"remote command {command!r} failed: {resp['out']}")
+        if binary:
+            return resp["out"], rest[:nbin]
         return resp["out"]
 
     def call(self, command: str, payload: Any = None, idempotent: bool = True) -> Any:
         if self.retry is None:
             return self._call_once(command, payload)
         return self.retry.call(self._call_once, command, payload, idempotent=idempotent)
+
+    def call_binary(
+        self, command: str, payload: Any = None, blob: bytes | None = None,
+        idempotent: bool = True,
+    ) -> tuple[Any, bytes]:
+        """Like :meth:`call` but sends ``blob`` as a raw binary frame after
+        the header line and returns ``(out, reply_blob)`` — the replay data
+        plane's framing (33% smaller than base64, no BytesIO double copy)."""
+        if self.retry is None:
+            return self._call_once(command, payload, blob=blob, binary=True)
+        return self.retry.call(
+            self._call_once, command, payload, blob=blob, binary=True,
+            idempotent=idempotent,
+        )

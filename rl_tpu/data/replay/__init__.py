@@ -1,6 +1,7 @@
 from .buffer import ReplayBuffer
 from .host_per import HostPrioritizedSampler
-from .service import RemoteReplayBuffer, ReplayService
+from .service import RemoteReplayBuffer, ReplaySaturated, ReplayService
+from .sharded import ReplayShard, ShardedReplayBuffer, ShardUnavailable
 from .samplers import (
     PrioritizedSampler,
     RandomSampler,
@@ -28,6 +29,10 @@ from .writers import ImmutableDatasetWriter, MaxValueWriter, RoundRobinWriter, W
 __all__ = [
     "ReplayService",
     "RemoteReplayBuffer",
+    "ReplaySaturated",
+    "ReplayShard",
+    "ShardedReplayBuffer",
+    "ShardUnavailable",
     "HostPrioritizedSampler",
     "ReplayBuffer",
     "Storage",

@@ -5,8 +5,24 @@ torchrl/_comm/replay_service.py:102 ``_DistributedReplayService`` /
 ``_DistributedReplayClient``:32 — a ReplayBuffer served to remote trainers
 over the transport): here the server owns the buffer state and exposes
 extend/sample/size/update_priority over the line-JSON TCP channel
-(rl_tpu.comm), with arrays base64-npz encoded. This is the DCN path for
-host-resident buffers; device-resident buffers move with the program.
+(rl_tpu.comm). This is the DCN path for host-resident buffers;
+device-resident buffers move with the program.
+
+Wire format: arrays ride as RAW BINARY FRAMES after the JSON header line
+(``extend_bin``/``sample_bin`` + a ``{"leaves": {key: dtype/shape/offset}}``
+manifest) — one ``tobytes`` copy out, zero-copy ``frombuffer`` views in.
+The original base64-npz handlers (``extend``/``sample``) are kept verbatim
+as the compat fallback: base64 inflates every trajectory 33% and
+double-copies through ``io.BytesIO``, so new peers only fall back to it
+when the far side predates the binary frames. Bytes-on-wire land on
+``/metrics`` (``rl_tpu_replay_wire_bytes_total{direction,encoding}``).
+
+The server sheds load instead of queueing unboundedly: with
+``max_inflight`` set, extend/sample beyond that many concurrent handlers
+get ``{"saturated": True, "retry_after": s}`` — and
+:class:`RemoteReplayBuffer` honors that reply the way ``RemoteEngine``
+does (sleep + resubmit, bounded), rather than treating it as a transport
+error.
 """
 
 from __future__ import annotations
@@ -14,17 +30,34 @@ from __future__ import annotations
 import base64
 import io
 import threading
+import time
 from typing import Any
 
 import numpy as np
 
 import jax
+import jax.numpy as jnp
 
-from ...comm import TCPCommandClient, TCPCommandServer
+from ...comm import BLOB_KEY, BinaryReply, TCPCommandClient, TCPCommandServer
 from ..arraydict import ArrayDict
 from .buffer import ReplayBuffer
 
-__all__ = ["ReplayService", "RemoteReplayBuffer"]
+__all__ = [
+    "ReplayService",
+    "RemoteReplayBuffer",
+    "ReplaySaturated",
+]
+
+
+class ReplaySaturated(RuntimeError):
+    """The replay endpoint kept shedding past the bounded resubmit budget."""
+
+    def __init__(self, retry_after: float):
+        super().__init__(f"replay service saturated; retry after {retry_after}s")
+        self.retry_after = retry_after
+
+
+# -- wire codecs ---------------------------------------------------------------
 
 
 def _encode(td: ArrayDict) -> dict:
@@ -41,25 +74,115 @@ def _decode(payload: dict) -> ArrayDict:
     return flat.unflatten_keys("|")
 
 
+def _encode_frames(td: ArrayDict) -> tuple[dict, bytes]:
+    """ArrayDict -> (manifest, raw bytes): each leaf C-contiguous, laid out
+    back to back. One copy out (``tobytes``); no base64, no npz container."""
+    flat = td.flatten_keys("|")
+    leaves: dict[str, dict] = {}
+    parts: list[bytes] = []
+    off = 0
+    for k, v in flat.items():
+        a = np.ascontiguousarray(np.asarray(v))
+        b = a.tobytes()
+        leaves[k] = {"dtype": str(a.dtype), "shape": list(a.shape), "off": off}
+        parts.append(b)
+        off += len(b)
+    return {"leaves": leaves}, b"".join(parts)
+
+
+def _decode_frames(meta: dict, blob: bytes) -> ArrayDict:
+    """(manifest, raw bytes) -> ArrayDict. ``frombuffer`` views are
+    zero-copy; the device upload in ``jnp.asarray`` is the only copy in."""
+    flat = {}
+    for k, m in meta["leaves"].items():
+        dt = np.dtype(m["dtype"])
+        n = int(np.prod(m["shape"])) if m["shape"] else 1
+        a = np.frombuffer(blob, dtype=dt, count=n, offset=m["off"])
+        flat[k] = jnp.asarray(a.reshape(m["shape"]))
+    return ArrayDict(flat).unflatten_keys("|")
+
+
+_WIRE_COUNTER = None
+
+
+def _count_wire(direction: str, encoding: str, nbytes: int) -> None:
+    global _WIRE_COUNTER
+    if _WIRE_COUNTER is None:
+        from ...obs import get_registry
+
+        _WIRE_COUNTER = get_registry().counter(
+            "rl_tpu_replay_wire_bytes_total",
+            "replay payload bytes on the wire, by direction and encoding",
+            labels=("direction", "encoding"),
+        )
+    _WIRE_COUNTER.inc(nbytes, labels={"direction": direction, "encoding": encoding})
+
+
+# -- server --------------------------------------------------------------------
+
+
 class ReplayService:
-    """Own a buffer + its state; serve it over TCP."""
+    """Own a buffer + its state; serve it over TCP.
+
+    ``max_inflight`` bounds concurrent extend/sample handlers — beyond it
+    the service replies ``{"saturated": True, "retry_after": s}`` instead
+    of queueing (the shed protocol shared with ``ServingService``).
+    """
 
     def __init__(
         self, buffer: ReplayBuffer, example: ArrayDict, host="127.0.0.1", port=0,
-        seed: int = 0,
+        seed: int = 0, max_inflight: int | None = None, retry_after_s: float = 0.05,
     ):
         self.buffer = buffer
         self.state = buffer.init(example)
         self._key = jax.random.key(seed)
+        self._subset_rng = np.random.default_rng(seed ^ 0x5EED)
         # TCPCommandServer is threading: serialize state updates or
         # concurrent extend/sample would read-modify-write the same state
         # and silently drop data
         self._lock = threading.Lock()
+        self.max_inflight = max_inflight
+        self.retry_after_s = retry_after_s
+        self._inflight = 0
+        self._inflight_lock = threading.Lock()
+        self._host = host
         self.server = TCPCommandServer(host, port)
-        self.server.register_handler("extend", self._extend)
-        self.server.register_handler("sample", self._sample)
-        self.server.register_handler("size", lambda p: int(self.buffer.size(self.state)))
-        self.server.register_handler("update_priority", self._update_priority)
+        self._register_handlers(self.server)
+
+    def _register_handlers(self, server: TCPCommandServer) -> None:
+        reg = server.register_handler
+        reg("extend", self._wrap_handler("extend", self._extend, shed=True))
+        reg("extend_bin", self._wrap_handler("extend_bin", self._extend_bin, shed=True))
+        reg("sample", self._wrap_handler("sample", self._sample, shed=True))
+        reg("sample_bin", self._wrap_handler("sample_bin", self._sample_bin, shed=True))
+        reg("size", self._wrap_handler("size", self._size))
+        reg("update_priority",
+            self._wrap_handler("update_priority", self._update_priority))
+        reg("mass", self._wrap_handler("mass", self._mass))
+        reg("evict_stale", self._wrap_handler("evict_stale", self._evict_stale))
+
+    def _wrap_handler(self, name: str, fn, shed: bool = False):
+        """Seam for subclasses (the shard tier adds fault points here);
+        base behavior is the shed guard on the load-bearing handlers."""
+        if shed:
+            return self._shed_guard(fn)
+        return fn
+
+    def _shed_guard(self, fn):
+        def guarded(payload):
+            if self.max_inflight is not None:
+                with self._inflight_lock:
+                    if self._inflight >= self.max_inflight:
+                        return {"saturated": True, "retry_after": self.retry_after_s}
+                    self._inflight += 1
+                try:
+                    return fn(payload)
+                finally:
+                    with self._inflight_lock:
+                        self._inflight -= 1
+            return fn(payload)
+
+        return guarded
 
     @property
     def address(self):
@@ -72,48 +195,246 @@ class ReplayService:
     def shutdown(self):
         self.server.shutdown()
 
-    def _extend(self, payload):
-        items = _decode(payload)
+    # -- handlers --------------------------------------------------------------
+
+    def _size(self, payload):
+        return int(self.buffer.size(self.state))
+
+    def _extend_items(self, items: ArrayDict) -> int:
         with self._lock:
             self.state = self.buffer.extend(self.state, items)
             return int(self.buffer.size(self.state))
 
-    def _sample(self, payload):
+    def _extend(self, payload):
+        _count_wire("received", "base64", len(payload.get("npz", "")))
+        return self._extend_items(_decode(payload))
+
+    def _extend_bin(self, payload):
+        blob = payload.pop(BLOB_KEY)
+        _count_wire("received", "binary", len(blob))
+        return self._extend_items(_decode_frames(payload, blob))
+
+    def _sample_batch(self, payload) -> ArrayDict:
         bs = payload.get("batch_size") if payload else None
+        # bucket the device draw to the next power of two (>=16): shard
+        # coordinators ask for a DIFFERENT count on every request (the
+        # mixture split varies per draw), and each distinct batch size
+        # would otherwise compile a fresh sample program — a recompile
+        # storm that showed up as ~30x sample latency in the A/B bench
+        bucket = None
+        if bs is not None:
+            bs = int(bs)
+            bucket = max(16, 1 << max(0, bs - 1).bit_length())
         with self._lock:
             self._key, k = jax.random.split(self._key)
-            batch, self.state = self.buffer.sample(self.state, k, bs)
-        return _encode(batch)
+            batch, self.state = self.buffer.sample(self.state, k, bucket)
+            sstate = self.state.get("sampler")
+        if bucket is not None and bucket != bs:
+            # a uniformly-random subset of a stratified draw keeps the
+            # PER marginal exact; taking the FIRST bs rows would keep
+            # only the low-CDF strata and skew the distribution
+            keep = np.sort(self._subset_rng.choice(bucket, size=bs, replace=False))
+            batch = batch.apply(lambda x: x[keep])
+        if (
+            isinstance(sstate, ArrayDict)
+            and "priorities" in sstate
+            and "index" in batch
+        ):
+            # the sampled leaves' p^alpha: what a coordinator needs to
+            # recompute GLOBAL importance weights across shards (the
+            # per-shard "_weight" normalizes by the shard-local batch max)
+            batch = batch.set("_p_alpha", jnp.take(sstate["priorities"], batch["index"]))
+        return batch
+
+    def _sample(self, payload):
+        out = self._sample_batch(payload)
+        enc = _encode(out)
+        _count_wire("sent", "base64", len(enc["npz"]))
+        return enc
+
+    def _sample_bin(self, payload):
+        meta, blob = _encode_frames(self._sample_batch(payload))
+        _count_wire("sent", "binary", len(blob))
+        return BinaryReply(meta, blob)
 
     def _update_priority(self, payload):
         idx = np.asarray(payload["index"], np.int32)
         prio = np.asarray(payload["priority"], np.float32)
+        n = int(idx.shape[0])
+        if n:
+            # bucket the length like `_sample_batch` buckets the draw:
+            # shard coordinators route a DIFFERENT index count per draw
+            # and each distinct count would compile a fresh update
+            # program. Pad by repeating the final (index, priority)
+            # pair — the fused update applies only the LAST duplicate's
+            # delta, so the padding is exactly a no-op
+            bucket = max(16, 1 << max(0, n - 1).bit_length())
+            if bucket != n:
+                idx = np.concatenate([idx, np.full(bucket - n, idx[-1], np.int32)])
+                prio = np.concatenate([prio, np.full(bucket - n, prio[-1], np.float32)])
         with self._lock:
             self.state = self.buffer.update_priority(
                 self.state, jax.numpy.asarray(idx), jax.numpy.asarray(prio)
             )
         return True
 
+    def _mass(self, payload):
+        """Shard-tier stats in one hop: total priority mass (the exact
+        sum-tree root, ``sum(esum)``), size, freshest policy-version stamp
+        in storage, and the handler queue depth."""
+        with self._lock:
+            size = int(self.buffer.size(self.state))
+            sstate = self.state.get("sampler")
+            if isinstance(sstate, ArrayDict) and "esum" in sstate:
+                mass = float(np.sum(np.asarray(sstate["esum"])))
+            else:
+                mass = float(size)  # uniform samplers: mass == size
+            max_version = 0
+            data = self.state["storage"].get("data")
+            if (
+                size > 0
+                and isinstance(data, ArrayDict)
+                and ("collector", "policy_version") in data
+            ):
+                stamps = np.asarray(data[("collector", "policy_version")])[:size]
+                max_version = int(stamps.max())
+        return {
+            "mass": mass,
+            "size": size,
+            "max_version": max_version,
+            "inflight": self._inflight,
+        }
+
+    def _evict_stale(self, payload):
+        """Staleness-aware eviction: crush the priority mass of items whose
+        collector policy-version stamp predates ``min_version``. The ring
+        recycles the slots; this removes them from the sampling mixture."""
+        min_version = int(payload["min_version"])
+        floor = float(payload.get("priority_floor", 1e-6))
+        with self._lock:
+            size = int(self.buffer.size(self.state))
+            data = self.state["storage"].get("data")
+            if (
+                size == 0
+                or not isinstance(data, ArrayDict)
+                or ("collector", "policy_version") not in data
+            ):
+                return {"evicted": 0}
+            stamps = np.asarray(data[("collector", "policy_version")])[:size]
+            idx = np.nonzero(stamps < min_version)[0].astype(np.int32)
+            if idx.size == 0:
+                return {"evicted": 0}
+            # pad to a chunk multiple: update_priority lowers per index
+            # count, and eviction batches vary — repeated indices with the
+            # same priority are idempotent
+            chunk = 256
+            padded = int(-(-idx.size // chunk) * chunk)
+            idx_p = np.full((padded,), idx[-1], np.int32)
+            idx_p[: idx.size] = idx
+            self.state = self.buffer.update_priority(
+                self.state,
+                jnp.asarray(idx_p),
+                jnp.full((padded,), floor, jnp.float32),
+            )
+        return {"evicted": int(idx.size)}
+
+
+# -- client --------------------------------------------------------------------
+
 
 class RemoteReplayBuffer:
     """Client view of a served buffer (reference _DistributedReplayClient).
 
-    With ``retry`` set, ``size``/``update_priority`` survive transport
-    failures. ``extend`` and ``sample`` never retry: the server mutates its
-    state before the reply is written, so replaying a call whose reply was
-    lost would double-insert (or burn an extra sampler step).
+    With ``retry`` set, ``size``/``update_priority``/``mass``/``evict_stale``
+    survive transport failures. ``extend`` and ``sample`` never retry at the
+    transport level: the server mutates its state before the reply is
+    written, so replaying a call whose reply was lost would double-insert
+    (or burn an extra sampler step). Shed replies ARE resubmitted — the
+    server explicitly did nothing.
+
+    Binary frames are tried first; an old peer's ``unknown command`` reply
+    flips the client to the base64-npz fallback for the connection's
+    lifetime.
     """
 
-    def __init__(self, host: str, port: int, timeout: float = 30.0, retry: Any = None):
+    def __init__(
+        self, host: str, port: int, timeout: float = 30.0, retry: Any = None,
+        binary: bool = True, max_shed_retries: int = 8,
+    ):
         self.client = TCPCommandClient(host, port, timeout=timeout, retry=retry)
+        self._binary = binary
+        self.max_shed_retries = max_shed_retries
+
+    def _shed_loop(self, once):
+        """Run ``once`` honoring ``{"saturated", "retry_after"}`` replies the
+        way ``RemoteEngine.submit`` does: sleep what the server asked,
+        resubmit, bounded."""
+        retry_after = 0.05
+        for _ in range(self.max_shed_retries + 1):
+            out = once()
+            if isinstance(out, dict) and out.get("saturated"):
+                retry_after = float(out.get("retry_after", retry_after))
+                time.sleep(retry_after)
+                continue
+            return out
+        raise ReplaySaturated(retry_after)
+
+    def _binary_call(self, bin_cmd, legacy_fn, meta=None, blob=None):
+        if self._binary:
+            try:
+                return self.client.call_binary(
+                    bin_cmd, meta, blob=blob, idempotent=False
+                )
+            except RuntimeError as e:
+                if "unknown command" not in str(e):
+                    raise
+                # old peer: no binary handlers — fall back for good
+                self._binary = False
+        return legacy_fn()
 
     def extend(self, items: ArrayDict) -> int:
-        return self.client.call("extend", _encode(items), idempotent=False)
+        if self._binary:
+            meta, blob = _encode_frames(items)
+            _count_wire("sent", "binary", len(blob))
+        else:
+            meta = blob = None
+
+        def once():
+            def legacy():
+                enc = _encode(items)
+                _count_wire("sent", "base64", len(enc["npz"]))
+                return self.client.call("extend", enc, idempotent=False)
+
+            out = self._binary_call("extend_bin", legacy, meta, blob)
+            if isinstance(out, tuple):
+                out = out[0]
+            return out
+
+        return int(self._shed_loop(once))
 
     def sample(self, batch_size: int | None = None) -> ArrayDict:
-        return _decode(
-            self.client.call("sample", {"batch_size": batch_size}, idempotent=False)
-        )
+        def once():
+            def legacy():
+                out = self.client.call(
+                    "sample", {"batch_size": batch_size}, idempotent=False
+                )
+                if isinstance(out, dict) and out.get("saturated"):
+                    return out
+                _count_wire("received", "base64", len(out["npz"]))
+                return _decode(out)
+
+            out = self._binary_call(
+                "sample_bin", legacy, {"batch_size": batch_size}
+            )
+            if isinstance(out, tuple):
+                meta, blob = out
+                if isinstance(meta, dict) and meta.get("saturated"):
+                    return meta
+                _count_wire("received", "binary", len(blob))
+                return _decode_frames(meta, blob)
+            return out
+
+        return self._shed_loop(once)
 
     def size(self) -> int:
         return self.client.call("size")
@@ -124,3 +445,14 @@ class RemoteReplayBuffer:
             "update_priority",
             {"index": np.asarray(index).tolist(), "priority": np.asarray(priority).tolist()},
         )
+
+    def mass(self) -> dict:
+        """Shard stats: {"mass", "size", "max_version", "inflight"}."""
+        return self.client.call("mass")
+
+    def evict_stale(self, min_version: int, priority_floor: float = 1e-6) -> int:
+        out = self.client.call(
+            "evict_stale",
+            {"min_version": int(min_version), "priority_floor": priority_floor},
+        )
+        return int(out["evicted"])

@@ -1,0 +1,13 @@
+"""Sharded experience tier: GEAR-style partitioned replay.
+
+N :class:`ReplayShard` servers (each a ``ReplayService`` owning one
+partition + its device PER sum-tree) behind one
+:class:`ShardedReplayBuffer` coordinator that samples by mixture over the
+exact per-shard priority masses, then in-shard by the existing stratified
+sum-tree descent. See ``docs/sharded_replay.md``.
+"""
+
+from .coordinator import ShardedReplayBuffer, ShardUnavailable
+from .shard import ReplayShard
+
+__all__ = ["ReplayShard", "ShardedReplayBuffer", "ShardUnavailable"]

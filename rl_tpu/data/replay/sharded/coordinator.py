@@ -1,0 +1,576 @@
+"""GEAR-style sharded replay coordinator: mixture-of-shards sampling.
+
+The single-host choke point (`ReplayService` = one endpoint, one sum-tree)
+becomes N :class:`~rl_tpu.data.replay.sharded.shard.ReplayShard` servers,
+each owning a partition of experience plus its own device PER sum-tree.
+The coordinator samples in two stages (GEAR, arXiv 2310.05205):
+
+1. **shard draw** — stratified inverse-CDF over the mixture of per-shard
+   priority masses ``M_s = sum(esum_s)`` (the exact sum-tree roots,
+   refreshed on a staleness budget, exact at refresh);
+2. **in-shard draw** — the shard's existing stratified inverse-CDF
+   sum-tree descent, untouched.
+
+Marginals compose exactly: ``P(i) = (M_s/M) · (p_i/M_s) = p_i/M`` — when
+masses are fresh, the two-stage draw is distribution-identical to one
+PER tree over the union (property-tested in tests/test_sharded_replay.py).
+Importance weights are recomputed GLOBALLY from the shards' returned
+``p^alpha`` leaves (a shard-local ``_weight`` normalizes by the wrong
+batch max), so ``w_i = (N·p_i/M)^-beta / max`` matches the single tree.
+
+Degradation, not failure: every shard call goes through a per-shard
+``RetryPolicy``/``CircuitBreaker``/``Deadline``; a lost shard is dropped
+from the mixture (renormalizing it) and its in-flight batch is redrawn
+over the survivors — the learner never sees the crash. A per-shard keeper
+thread under a ``Supervisor`` probes health; the supervisor's restart of
+a raised keeper is what re-admits the shard (restart → probe → re-admit),
+with fresh client state so stale breakers don't haunt the new endpoint.
+
+Chaos sites: ``replay.shard_crash.<idx>`` (in the shard server) and
+``replay.shard_drop`` (here, before each shard call).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Sequence
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ....comm import TCPCommandClient
+from ....obs import get_registry
+from ....obs.trace import get_tracer
+from ....resilience.faults import should_drop
+from ....resilience.retry import CircuitBreaker, RetryPolicy
+from ....resilience.supervisor import Supervisor
+from ...arraydict import ArrayDict
+from ..service import RemoteReplayBuffer, ReplaySaturated
+
+__all__ = ["ShardedReplayBuffer", "ShardUnavailable"]
+
+# transport-shaped failures (CircuitOpenError subclasses ConnectionError)
+_TRANSPORT = (ConnectionError, TimeoutError, OSError)
+
+
+class ShardUnavailable(ConnectionError):
+    """Every shard is dead (or saturated past the spill budget)."""
+
+
+class _Shard:
+    """One shard's client bundle + last-refreshed stats. Mutable fields are
+    guarded by the coordinator's ``_mix_lock`` (reads AND writes) — the one
+    lock in this tier, never held across an RPC."""
+
+    __slots__ = (
+        "index", "host", "port", "client", "probe",
+        "alive", "mass", "size", "max_version", "inflight", "refreshed_at",
+    )
+
+    def __init__(self, index: int, host: str, port: int):
+        self.index = index
+        self.host = host
+        self.port = port
+        self.client: RemoteReplayBuffer | None = None
+        self.probe: TCPCommandClient | None = None
+        self.alive = True
+        self.mass = 0.0
+        self.size = 0
+        self.max_version = 0
+        self.inflight = 0
+        self.refreshed_at = 0.0
+
+
+class ShardedReplayBuffer:
+    """Coordinator client over N replay shards — a drop-in host-side replay
+    source (``extend``/``sample``/``update_priority``/``size``) for
+    :class:`~rl_tpu.trainers.AsyncOffPolicyTrainer`.
+
+    ``shards`` is a list of ``(host, port)``. All shards share one
+    ``shard_capacity`` — the stride of the global index encoding
+    ``global = shard * capacity + local`` that routes priority updates
+    back to the owning shard.
+    """
+
+    def __init__(
+        self,
+        shards: Sequence[tuple[str, int]],
+        shard_capacity: int,
+        *,
+        batch_size: int | None = None,
+        beta: float = 0.4,
+        mass_refresh_s: float = 0.25,
+        timeout: float = 10.0,
+        probe_timeout_s: float = 2.0,
+        probe_interval_s: float = 0.2,
+        max_shed_retries: int = 8,
+        seed: int = 0,
+        retry_factory: Callable[[int], Any] | None = None,
+        restart_fn: Callable[[int], tuple[str, int]] | None = None,
+        registry=None,
+    ):
+        if not shards:
+            raise ValueError("need at least one shard")
+        self.shard_capacity = int(shard_capacity)
+        self.batch_size = batch_size
+        self.beta = float(beta)
+        self.mass_refresh_s = float(mass_refresh_s)
+        self.timeout = timeout
+        self.probe_timeout_s = probe_timeout_s
+        self.probe_interval_s = probe_interval_s
+        self.max_shed_retries = max_shed_retries
+        self._retry_factory = retry_factory or self._default_retry
+        self._restart_fn = restart_fn
+        self._rng = np.random.default_rng(seed)
+        # the mixture lock: guards shard stats + the RR cursor. Leaf lock
+        # in the R005 graph — nothing is acquired under it (no RPC, no
+        # registry locks beyond the metric cells' own).
+        self._mix_lock = threading.Lock()
+        self._rr = 0
+        self._version = 0
+        self._mass_ts = 0.0
+        self._stop = threading.Event()
+        self._sup: Supervisor | None = None
+        self._shards = [
+            _Shard(i, host, int(port)) for i, (host, port) in enumerate(shards)
+        ]
+        for sh in self._shards:
+            self._build_clients(sh)
+
+        reg = registry if registry is not None else get_registry()
+        self._g_size = reg.gauge(
+            "rl_tpu_replay_shard_size", "items resident per shard", ("shard",))
+        self._g_mass = reg.gauge(
+            "rl_tpu_replay_shard_mass", "priority mass per shard (sum-tree root)",
+            ("shard",))
+        self._g_healthy = reg.gauge(
+            "rl_tpu_replay_shard_healthy", "1 = shard in the mixture", ("shard",))
+        self._g_depth = reg.gauge(
+            "rl_tpu_replay_shard_queue_depth",
+            "in-flight handlers at the shard at last refresh", ("shard",))
+        self._g_age = reg.gauge(
+            "rl_tpu_replay_shard_staleness_s",
+            "age of each shard's mixture mass", ("shard",))
+        self._c_extends = reg.counter(
+            "rl_tpu_replay_shard_extends_total", "extends routed per shard",
+            ("shard",))
+        self._c_samples = reg.counter(
+            "rl_tpu_replay_shard_samples_total", "samples drawn per shard",
+            ("shard",))
+        self._c_failover = reg.counter(
+            "rl_tpu_replay_shard_failovers_total",
+            "times a shard dropped out of the mixture", ("shard",))
+        self._c_readmit = reg.counter(
+            "rl_tpu_replay_shard_readmits_total",
+            "times a shard rejoined the mixture", ("shard",))
+        self._c_drops = reg.counter(
+            "rl_tpu_replay_shard_drops_total",
+            "injected replay.shard_drop link failures", ("shard",))
+        self._c_evicted = reg.counter(
+            "rl_tpu_replay_shard_evicted_total",
+            "stale items evicted across shards")
+        self._age_collector = reg.register_collector(self._collect_ages)
+        self._registry = reg
+
+    # -- wiring ----------------------------------------------------------------
+
+    def _default_retry(self, idx: int) -> RetryPolicy:
+        return RetryPolicy(
+            max_attempts=3,
+            base_delay_s=0.02,
+            max_delay_s=0.2,
+            deadline_s=self.timeout,
+            breaker=CircuitBreaker(
+                f"replay.shard{idx}",
+                failure_threshold=3,
+                reset_timeout_s=max(2 * self.probe_interval_s, 0.5),
+            ),
+            seed=idx,
+        )
+
+    def _build_clients(self, sh: _Shard) -> None:
+        sh.client = RemoteReplayBuffer(
+            sh.host, sh.port, timeout=self.timeout,
+            retry=self._retry_factory(sh.index),
+            max_shed_retries=self.max_shed_retries,
+        )
+        sh.probe = TCPCommandClient(sh.host, sh.port, timeout=self.probe_timeout_s)
+
+    def _collect_ages(self) -> None:
+        now = time.monotonic()
+        with self._mix_lock:
+            snap = [(sh.index, sh.refreshed_at, sh.alive) for sh in self._shards]
+        for idx, ts, alive in snap:
+            age = (now - ts) if (alive and ts) else 0.0
+            self._g_age.set(age, labels={"shard": str(idx)})
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._sup is not None:
+            self._sup.stop(timeout=2.0)
+        self._registry.unregister_collector(self._age_collector)
+
+    # -- failure / health ------------------------------------------------------
+
+    @staticmethod
+    def _is_shard_failure(e: BaseException) -> bool:
+        if isinstance(e, ReplaySaturated):
+            return False  # backpressure, not death
+        if isinstance(e, _TRANSPORT):
+            return True
+        # a crash fault fires INSIDE the handler: the error reply carries
+        # the InjectedFault marker while subsequent connects are refused
+        return isinstance(e, RuntimeError) and (
+            "InjectedFault" in str(e) or "is down" in str(e)
+        )
+
+    def _guarded(self, sh: _Shard, fn, *args):
+        if should_drop("replay.shard_drop"):
+            self._c_drops.inc(labels={"shard": str(sh.index)})
+            raise ConnectionError(f"injected drop: shard {sh.index}")
+        return fn(*args)
+
+    def _on_shard_failure(self, sh: _Shard, e: BaseException) -> None:
+        with self._mix_lock:
+            was_alive = sh.alive
+            sh.alive = False
+        if was_alive:
+            self._c_failover.inc(labels={"shard": str(sh.index)})
+            self._g_healthy.set(0.0, labels={"shard": str(sh.index)})
+            get_tracer().instant(
+                "replay/shard_lost", {"shard": sh.index, "error": repr(e)}
+            )
+
+    def _readmit(self, sh: _Shard) -> None:
+        stats = sh.client.mass()  # raises -> caller (keeper) retries
+        with self._mix_lock:
+            sh.alive = True
+            self._apply_stats(sh, stats)
+        self._c_readmit.inc(labels={"shard": str(sh.index)})
+        self._g_healthy.set(1.0, labels={"shard": str(sh.index)})
+        get_tracer().instant("replay/shard_readmitted", {"shard": sh.index})
+
+    def _rebind(self, sh: _Shard, host: str, port: int) -> None:
+        """Point a shard slot at a restarted endpoint, with FRESH retry/
+        breaker state — the old breaker's open window belongs to the dead
+        host, not this one."""
+        sh.host, sh.port = host, int(port)
+        self._build_clients(sh)
+
+    def _apply_stats(self, sh: _Shard, stats: dict) -> None:
+        # caller holds _mix_lock
+        sh.mass = float(stats.get("mass", 0.0))
+        sh.size = int(stats.get("size", 0))
+        sh.max_version = int(stats.get("max_version", 0))
+        sh.inflight = int(stats.get("inflight", 0))
+        sh.refreshed_at = time.monotonic()
+        lbl = {"shard": str(sh.index)}
+        self._g_size.set(sh.size, labels=lbl)
+        self._g_mass.set(sh.mass, labels=lbl)
+        self._g_depth.set(sh.inflight, labels=lbl)
+        self._g_healthy.set(1.0, labels=lbl)
+
+    # -- mixture refresh -------------------------------------------------------
+
+    def refresh_masses(self) -> None:
+        """Pull every live shard's exact sum-tree root. The mixture is
+        EXACT at this instant; between refreshes it ages within the
+        ``mass_refresh_s`` staleness budget."""
+        with self._mix_lock:
+            live = [sh for sh in self._shards if sh.alive]
+        for sh in live:
+            try:
+                stats = self._guarded(sh, sh.client.mass)
+            except Exception as e:  # noqa: BLE001
+                if self._is_shard_failure(e):
+                    self._on_shard_failure(sh, e)
+                    continue
+                raise
+            with self._mix_lock:
+                self._apply_stats(sh, stats)
+        self._mass_ts = time.monotonic()
+
+    def _maybe_refresh(self) -> None:
+        if time.monotonic() - self._mass_ts > self.mass_refresh_s:
+            self.refresh_masses()
+
+    def warm_sample(self, buckets: tuple = (16, 32, 64), alpha: float = 0.6) -> int:
+        """Compile-warm each live shard's in-shard sample AND priority-
+        update programs for every power-of-two bucket the two-stage
+        split can request. Shards bucket both paths (see
+        ``ReplayService``), but a COLD bucket still compiles on first
+        use — under the shard's service lock, stalling concurrent
+        extends for seconds. The warm update re-asserts the probed
+        leaves' current priority (``p_alpha ** (1/alpha)``), so it is
+        state-neutral when ``alpha`` matches the shard sampler's
+        exponent (0.6 is the ``PrioritizedSampler`` default). Call once
+        after shards hold data; returns the number of warm calls that
+        succeeded. Dead or empty shards are skipped, never fatal."""
+        n = 0
+        with self._mix_lock:
+            live = [sh for sh in self._shards if sh.alive]
+        for sh in live:
+            for b in buckets:
+                try:
+                    mb = self._guarded(sh, sh.client.sample, int(b))
+                    if "index" in mb and "_p_alpha" in mb:
+                        pa = np.asarray(mb["_p_alpha"], np.float64).reshape(-1)
+                        prio = pa ** (1.0 / alpha) if alpha else pa
+                        self._guarded(
+                            sh, sh.client.update_priority,
+                            np.asarray(mb["index"]).reshape(-1),
+                            prio.astype(np.float32),
+                        )
+                    n += 1
+                except Exception as e:  # noqa: BLE001 - warm is best-effort
+                    if self._is_shard_failure(e):
+                        self._on_shard_failure(sh, e)
+                        break
+        return n
+
+    def _mixture(self) -> tuple[list[_Shard], np.ndarray]:
+        with self._mix_lock:
+            live = [sh for sh in self._shards if sh.alive]
+            masses = np.asarray([sh.mass for sh in live], np.float64)
+        return live, masses
+
+    def mixture_probs(self) -> dict[int, float]:
+        """Current shard-draw probabilities (diagnostics + the parity
+        test's exactness assert)."""
+        live, masses = self._mixture()
+        total = float(masses.sum())
+        if total <= 0:
+            return {sh.index: 0.0 for sh in live}
+        return {sh.index: float(m) / total for sh, m in zip(live, masses)}
+
+    def alive_shards(self) -> list[int]:
+        with self._mix_lock:
+            return [sh.index for sh in self._shards if sh.alive]
+
+    # -- data plane ------------------------------------------------------------
+
+    def extend(self, items: ArrayDict) -> int:
+        """Route a batch to the next live shard (round-robin placement —
+        any assignment preserves the two-stage marginal, because the
+        mixture re-weights by wherever the mass actually lands). A
+        saturated shard spills to the next; a dead one fails over."""
+        with get_tracer().ctx_span("replay/shard:extend"):
+            self._maybe_refresh()
+            for _ in range(len(self._shards)):
+                with self._mix_lock:
+                    live = [sh for sh in self._shards if sh.alive]
+                    if not live:
+                        break
+                    sh = live[self._rr % len(live)]
+                    self._rr += 1
+                try:
+                    out = int(self._guarded(sh, sh.client.extend, items))
+                except ReplaySaturated:
+                    continue  # spill to the next shard this round
+                except Exception as e:  # noqa: BLE001
+                    if self._is_shard_failure(e):
+                        self._on_shard_failure(sh, e)
+                        continue
+                    raise
+                self._c_extends.inc(labels={"shard": str(sh.index)})
+                return out
+            raise ShardUnavailable("no live shard accepted the extend")
+
+    def sample(self, batch_size: int | None = None) -> ArrayDict:
+        """Two-stage draw. A shard failing mid-draw renormalizes the
+        mixture and the whole batch is redrawn over the survivors — the
+        caller sees a complete batch or ``ShardUnavailable``, never a
+        partial one."""
+        bs = batch_size if batch_size is not None else self.batch_size
+        if bs is None:
+            raise ValueError("batch_size required (none configured)")
+        with get_tracer().ctx_span("replay/shard:sample"):
+            self._maybe_refresh()
+            for attempt in range(len(self._shards) + 1):
+                live, masses = self._mixture()
+                if not live:
+                    raise ShardUnavailable("no live shard to sample from")
+                total = float(masses.sum())
+                if total <= 0.0:
+                    # stale-zero, not necessarily empty: extends that landed
+                    # within the staleness budget aren't in the mixture yet
+                    # — force one exact refresh before declaring starvation
+                    if attempt == 0:
+                        self.refresh_masses()
+                        continue
+                    raise RuntimeError("sharded replay holds no priority mass")
+                # stage 1: stratified inverse-CDF over the shard mixture —
+                # the same stratification the in-shard descent uses, so
+                # the composed marginal stays p_i / M
+                u = (np.arange(bs) + self._rng.random(bs)) / bs * total
+                sel = np.searchsorted(np.cumsum(masses), u, side="right")
+                counts = np.bincount(
+                    np.clip(sel, 0, len(live) - 1), minlength=len(live)
+                )
+                parts: list[tuple[_Shard, ArrayDict]] = []
+                redraw = False
+                for sh, c in zip(live, counts):
+                    if c == 0:
+                        continue
+                    try:
+                        b = self._guarded(sh, sh.client.sample, int(c))
+                    except Exception as e:  # noqa: BLE001
+                        if self._is_shard_failure(e):
+                            self._on_shard_failure(sh, e)
+                            redraw = True
+                            break
+                        if isinstance(e, ReplaySaturated):
+                            time.sleep(0.01)
+                            redraw = True
+                            break
+                        raise
+                    self._c_samples.inc(int(c), labels={"shard": str(sh.index)})
+                    parts.append((sh, b))
+                if redraw or not parts:
+                    continue
+                return self._merge(parts)
+            raise ShardUnavailable("sampling failed across every redraw")
+
+    def _merge(self, parts: list[tuple[_Shard, ArrayDict]]) -> ArrayDict:
+        stride = self.shard_capacity
+        batches = []
+        for sh, b in parts:
+            b = b.set("index", b["index"] + sh.index * stride)
+            batches.append(b)
+        merged = ArrayDict.concat(batches, axis=0)
+        if "_p_alpha" in merged:
+            # global importance weights: per-shard _weight normalized by
+            # the WRONG (shard-local) max; recompute from the leaves
+            with self._mix_lock:
+                n_total = sum(sh.size for sh in self._shards if sh.alive)
+                m_total = sum(sh.mass for sh in self._shards if sh.alive)
+            pa = np.maximum(np.asarray(merged["_p_alpha"], np.float64), 1e-12)
+            w = (max(n_total, 1) * pa / max(m_total, 1e-12)) ** (-self.beta)
+            w = w / max(float(w.max()), 1e-12)
+            merged = merged.set(
+                "_weight", jnp.asarray(w.astype(np.float32))
+            ).delete("_p_alpha")
+        return merged
+
+    def update_priority(self, index, priority) -> None:
+        """Decode the global stride encoding and route each slice back to
+        its owning shard; updates for a dead shard are dropped (its tree
+        is gone — degrade, don't raise)."""
+        idx = np.asarray(index, np.int64).reshape(-1)
+        prio = np.asarray(priority, np.float32).reshape(-1)
+        with get_tracer().ctx_span("replay/shard:update_priority"):
+            owners = idx // self.shard_capacity
+            for o in np.unique(owners):
+                sh = self._shards[int(o)]
+                with self._mix_lock:
+                    alive = sh.alive
+                if not alive:
+                    continue
+                m = owners == o
+                try:
+                    self._guarded(
+                        sh, sh.client.update_priority,
+                        idx[m] % self.shard_capacity, prio[m],
+                    )
+                except Exception as e:  # noqa: BLE001
+                    if self._is_shard_failure(e):
+                        self._on_shard_failure(sh, e)
+                        continue
+                    raise
+
+    def size(self) -> int:
+        """Total live items, from the last mass refresh (refreshes first
+        when past the staleness budget)."""
+        self._maybe_refresh()
+        with self._mix_lock:
+            return sum(sh.size for sh in self._shards if sh.alive)
+
+    # -- staleness-aware eviction ----------------------------------------------
+
+    def note_policy_version(self, version: int) -> None:
+        """Learner hook: the freshest policy version, for the eviction
+        cutoff (shards also report the freshest stamp they store)."""
+        with self._mix_lock:
+            self._version = max(self._version, int(version))
+
+    def evict_stale(self, max_staleness: int, priority_floor: float = 1e-6) -> int:
+        """Crush the mixture mass of experience older than
+        ``current_version - max_staleness`` on every live shard."""
+        with self._mix_lock:
+            version = max(
+                [self._version]
+                + [sh.max_version for sh in self._shards if sh.alive]
+            )
+        live, _ = self._mixture()
+        total = 0
+        for sh in live:
+            try:
+                n = self._guarded(
+                    sh, sh.client.evict_stale,
+                    version - int(max_staleness), priority_floor,
+                )
+            except Exception as e:  # noqa: BLE001
+                if self._is_shard_failure(e):
+                    self._on_shard_failure(sh, e)
+                    continue
+                raise
+            total += n
+        if total:
+            self._c_evicted.inc(total)
+            self.refresh_masses()  # eviction moved mass; re-exact the mixture
+        return total
+
+    # -- supervision -----------------------------------------------------------
+
+    def start_keepers(self, supervisor: Supervisor | None = None) -> Supervisor:
+        """One keeper per shard under a Supervisor. A keeper that loses its
+        shard marks it dead (mixture renormalizes) and RAISES — the
+        supervisor's backoff-restart re-enters the keeper, which rebuilds
+        the shard via ``restart_fn`` (or just re-probes it, for link-level
+        drops) and re-admits it. ``escalate=False``: a shard that never
+        comes back stays out of the mixture without killing its siblings."""
+        if self._sup is not None:
+            return self._sup
+        self._sup = supervisor or Supervisor(
+            "replay-shards", max_restarts=50,
+            backoff_base_s=0.05, backoff_max_s=0.5, jitter=0.1,
+        )
+        for sh in self._shards:
+            self._sup.spawn(
+                f"shard-keeper-{sh.index}",
+                lambda sh=sh: self._keeper(sh),
+                escalate=False,
+            )
+        return self._sup
+
+    def _keeper(self, sh: _Shard) -> None:
+        while not self._stop.is_set():
+            with self._mix_lock:
+                alive = sh.alive
+            if not alive:
+                try:
+                    # a drop isn't a crash: if the endpoint still answers,
+                    # re-admit without rebuilding
+                    sh.probe.call("size")
+                    self._readmit(sh)
+                except Exception:  # noqa: BLE001
+                    if self._restart_fn is None:
+                        raise RuntimeError(
+                            f"shard {sh.index} down and no restart_fn"
+                        )
+                    host, port = self._restart_fn(sh.index)
+                    self._rebind(sh, host, port)
+                    # raises -> the supervisor backs off and retries us
+                    sh.probe.call("size")
+                    self._readmit(sh)
+            else:
+                try:
+                    sh.probe.call("size")
+                except Exception as e:  # noqa: BLE001
+                    self._on_shard_failure(sh, e)
+                    raise RuntimeError(
+                        f"shard {sh.index} probe failed: {e!r}"
+                    ) from e
+            self._stop.wait(self.probe_interval_s)

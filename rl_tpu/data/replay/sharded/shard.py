@@ -1,0 +1,111 @@
+"""One shard of the partitioned experience tier.
+
+A :class:`ReplayShard` IS a :class:`~rl_tpu.data.replay.service.ReplayService`
+— the same buffer-owning TCP endpoint (device PER sum-tree included) — plus
+the chaos/restart machinery the sharded tier needs:
+
+- a per-shard seeded fault site ``replay.shard_crash.<idx>`` visited on
+  every handled request: a ``crash`` fault marks the shard dead and closes
+  its endpoint, so in-flight callers see the injected fault and subsequent
+  connects are refused — exactly what a lost shard host looks like;
+- :meth:`restart`, the supervisor's re-admission hook: a fresh buffer
+  state on a fresh port (a crashed host's experience is gone; the mixture
+  re-grows its mass as collectors refill it).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable
+
+import jax
+
+from ....comm import TCPCommandServer
+from ....resilience.faults import InjectedFault, fault_point, register_site
+from ...arraydict import ArrayDict
+from ..buffer import ReplayBuffer
+from ..service import ReplayService
+
+__all__ = ["ReplayShard"]
+
+
+class ReplayShard(ReplayService):
+    """A ``ReplayService`` that owns ONE partition of the experience tier.
+
+    ``buffer_factory`` (not a buffer) because a restart rebuilds the
+    buffer from scratch — shard state does not survive a crash.
+    """
+
+    def __init__(
+        self,
+        index: int,
+        buffer_factory: Callable[[], ReplayBuffer],
+        example: ArrayDict,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        seed: int = 0,
+        max_inflight: int | None = None,
+        retry_after_s: float = 0.05,
+    ):
+        self.index = int(index)
+        self.site = f"replay.shard_crash.{self.index}"
+        register_site(
+            self.site,
+            f"replay shard {self.index} handler (crash = this shard dies)",
+        )
+        self._buffer_factory = buffer_factory
+        self._example = example
+        self._seed = seed
+        self._crashed = False
+        super().__init__(
+            buffer_factory(), example, host, port, seed=seed,
+            max_inflight=max_inflight, retry_after_s=retry_after_s,
+        )
+
+    def _wrap_handler(self, name, fn, shed: bool = False):
+        fn = super()._wrap_handler(name, fn, shed)
+
+        def guarded(payload, _fn=fn):
+            if self._crashed:
+                raise InjectedFault(f"shard {self.index} is down")
+            try:
+                # per-shard AND generic site: a plan can kill this specific
+                # shard (deterministic per-site invocation counter) or any
+                # shard probabilistically in a soak
+                fault_point(self.site)
+                fault_point("replay.shard_crash")
+            except InjectedFault:
+                self._crash()
+                raise
+            return _fn(payload)
+
+        return guarded
+
+    @property
+    def crashed(self) -> bool:
+        return self._crashed
+
+    def _crash(self) -> None:
+        """Become a dead host: refuse everything, close the endpoint. The
+        shutdown runs off-thread — it joins the accept loop, and this is a
+        handler thread that still owes the injected-fault reply."""
+        self._crashed = True
+        threading.Thread(target=self.shutdown, daemon=True).start()
+
+    def restart(self, reset_state: bool = True) -> tuple[str, int]:
+        """Re-admission hook for the coordinator's supervisor: rebuild the
+        buffer (crashed hosts lose their experience), bind a fresh port,
+        serve again. Returns the new ``(host, port)``."""
+        try:
+            self.shutdown()
+        except Exception:  # noqa: BLE001 - already-dead endpoints are fine
+            pass
+        if reset_state:
+            self.buffer = self._buffer_factory()
+            self.state = self.buffer.init(self._example)
+            self._key = jax.random.key(self._seed)
+        self._crashed = False
+        self.server = TCPCommandServer(self._host, 0)
+        self._register_handlers(self.server)
+        self.server.start()
+        return self.address

@@ -53,6 +53,17 @@ site                        where / supported kinds
                             abandons the allocation between atomic steps,
                             so refcounts and the free list stay consistent
                             (degrade, never corrupt)
+``replay.shard_crash``      replay shard server, per handled request
+                            (``crash``, ``delay``); each shard also registers
+                            ``replay.shard_crash.<idx>`` via
+                            :func:`register_site` so a plan can kill a
+                            SPECIFIC shard deterministically — a crash marks
+                            the shard dead and closes its endpoint, so the
+                            coordinator renormalizes the mixture instead of
+                            erroring the learner
+``replay.shard_drop``       ShardedReplayBuffer, before each shard call
+                            (``drop`` = that shard's link fails for this op;
+                            the coordinator degrades around it)
 ==========================  =================================================
 """
 
@@ -91,6 +102,10 @@ SITES: dict[str, str] = {
     "fleet.probe_drop": "ServingFleet health-monitor probe (drop = failure)",
     "fleet.dispatch_delay": "ServingFleet dispatcher iteration",
     "kvmem.evict": "PrefixKVAllocator single-block LRU eviction step",
+    "replay.shard_crash": "replay shard server, per handled request "
+                          "(crash = the shard dies and refuses connections)",
+    "replay.shard_drop": "ShardedReplayBuffer shard call (drop = that "
+                         "shard's link fails for this op)",
 }
 
 KINDS = ("crash", "delay", "drop", "nan", "preempt")

@@ -16,6 +16,7 @@ from typing import Callable
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 
 from ..analysis import hot_path
@@ -91,15 +92,12 @@ class _GradUpdateMixin:
 
     device_metrics: DeviceMetrics | None = None
 
-    def _update_body(self, carry, xs):
-        params, opt_state, bstate, dm = carry
-        if len(xs) == 3:  # chaos path: per-update poison scalar rides the scan
-            upd_key, upd_idx, poison = xs
-        else:
-            upd_key, upd_idx = xs
-            poison = None
-        k_sample, k_loss = jax.random.split(upd_key)
-        mb, bstate = self.buffer.sample(bstate, k_sample, self.config.batch_size)
+    def _grad_step(self, params, opt_state, mb, k_loss, upd_idx, dm, poison):
+        """One guarded gradient step on a ready minibatch — the buffer-free
+        core shared by the in-program scan body (device replay) and the
+        host-batch program (sharded/remote replay). Returns the per-sample
+        loss ``metrics`` so callers can route priorities wherever the
+        sampler lives."""
         loss_val, grads, metrics = self.loss.grad(params, mb, k_loss)
         if poison is not None:
             loss_val = loss_val + poison
@@ -137,6 +135,20 @@ class _GradUpdateMixin:
         # jnp.where SELECTS, so NaNs in the rejected branch never propagate
         params = tree_where(ok, new_params, params)
         opt_state = tree_where(ok, new_opt_state, opt_state)
+        return params, opt_state, dm, metrics, loss_val, ok
+
+    def _update_body(self, carry, xs):
+        params, opt_state, bstate, dm = carry
+        if len(xs) == 3:  # chaos path: per-update poison scalar rides the scan
+            upd_key, upd_idx, poison = xs
+        else:
+            upd_key, upd_idx = xs
+            poison = None
+        k_sample, k_loss = jax.random.split(upd_key)
+        mb, bstate = self.buffer.sample(bstate, k_sample, self.config.batch_size)
+        params, opt_state, dm, metrics, loss_val, ok = self._grad_step(
+            params, opt_state, mb, k_loss, upd_idx, dm, poison
+        )
         if self.priority_key is not None and self.priority_key in metrics:
             new_bstate = self.buffer.update_priority(
                 bstate, mb["index"], metrics[self.priority_key]
@@ -430,22 +442,40 @@ class AsyncOffPolicyTrainer(_GradUpdateMixin):
             tx.insert(0, optax.clip_by_global_norm(config.max_grad_norm))
         self.optimizer = optax.chain(*tx)
         self.target_update = SoftUpdate(loss, tau=config.tau)
-        self._extend = buffer.make_extend(collector.frames_per_batch, donate=True)
-        # donate the big rotating state (optimizer moments + replay ring)
-        # but NOT params: the collector's actor thread keeps a live
-        # reference to the last published params for its policy calls, and
-        # donating them would hand XLA buffers another thread is reading.
-        # Registered (not raw jit): the K-update scan is THE dominant
-        # compile of this trainer, and a supervised worker restart should
-        # reload its executable from the store, not re-lower it.
         self._registry = get_program_registry()
-        self._k_updates = self._registry.register(
-            "offpolicy.k_updates",
-            self._k_updates_impl,
-            fingerprint=repr((type(loss).__name__, config, priority_key,
-                              type(buffer.storage).__name__)),
-            donate_argnums=(1, 2),
-        )
+        # host-source mode: any non-ReplayBuffer with the host replay
+        # protocol (extend/sample/update_priority/size) — e.g. a
+        # ShardedReplayBuffer or RemoteReplayBuffer — feeds per-batch
+        # device update programs instead of the in-program sampler
+        self._host_source = not isinstance(buffer, ReplayBuffer)
+        if self._host_source:
+            self._extend = None
+            self._k_updates = None
+            self._host_update = self._registry.register(
+                "offpolicy.update_hostbatch",
+                self._update_hostbatch_impl,
+                fingerprint=repr((type(loss).__name__, config, priority_key,
+                                  "host_source")),
+                donate_argnums=(1,),
+            )
+        else:
+            self._extend = buffer.make_extend(
+                collector.frames_per_batch, donate=True
+            )
+            # donate the big rotating state (optimizer moments + replay ring)
+            # but NOT params: the collector's actor thread keeps a live
+            # reference to the last published params for its policy calls, and
+            # donating them would hand XLA buffers another thread is reading.
+            # Registered (not raw jit): the K-update scan is THE dominant
+            # compile of this trainer, and a supervised worker restart should
+            # reload its executable from the store, not re-lower it.
+            self._k_updates = self._registry.register(
+                "offpolicy.k_updates",
+                self._k_updates_impl,
+                fingerprint=repr((type(loss).__name__, config, priority_key,
+                                  type(buffer.storage).__name__)),
+                donate_argnums=(1, 2),
+            )
         # cached device zero for the chaos poison arg: one extra jit trace
         # when an injector is armed, no per-dispatch host->device transfer
         self._poison_zero = None
@@ -481,14 +511,16 @@ class AsyncOffPolicyTrainer(_GradUpdateMixin):
         example = self.example_item()
         params = self.loss.init_params(k_params, example.unsqueeze(0))
         opt_state = self.optimizer.init(self.loss.trainable(params))
-        bstate = self.buffer.init(example)
         ts = {
             "params": params,
             "opt": opt_state,
-            "buffer": bstate,
             "rng": k_rng,
             "update_count": jnp.asarray(0, jnp.int32),
         }
+        if not self._host_source:
+            # host-source replay owns its own (remote) state; there is no
+            # device ring to thread through the train state
+            ts["buffer"] = self.buffer.init(example)
         if self.device_metrics is not None:
             ts["obs"] = self.device_metrics.init()
         return ts
@@ -501,6 +533,11 @@ class AsyncOffPolicyTrainer(_GradUpdateMixin):
         checkpoint — only shapes/dtypes are read). Returns the registry
         report, or a :class:`~rl_tpu.compile.WarmupHandle` when
         backgrounded."""
+        if self._host_source:
+            # the host-batch program's signature depends on the sampler's
+            # wire schema (which keys ride the minibatch); the first
+            # dispatch compiles it
+            return None
         sig = abstract_like((
             ts["params"], ts["opt"], ts["buffer"], ts["rng"],
             ts["update_count"], ts.get("obs"),
@@ -534,6 +571,29 @@ class AsyncOffPolicyTrainer(_GradUpdateMixin):
         out = (params, opt_state, bstate, rng, update_count + k, dm)
         return out, jax.tree.map(lambda x: x.mean(), metrics)
 
+    def _update_hostbatch_impl(self, params, opt_state, rng, update_count, mb,
+                               dm=None, poison=None):
+        """One gradient update on a HOST-provided minibatch (sharded/remote
+        replay): same guarded core as the scan body, but the sample came
+        over the wire and the per-sample priorities go back over it —
+        returned here instead of written into an in-program sum-tree."""
+        rng, k_loss = jax.random.split(rng)
+        params, opt_state, dm, metrics, loss_val, ok = self._grad_step(
+            params, opt_state, mb, k_loss, update_count, dm, poison
+        )
+        if self.priority_key is not None and self.priority_key in metrics:
+            prio = jnp.abs(metrics[self.priority_key])
+            # the guard that in-program updates get for free: a bad step's
+            # priorities never leave the device
+            prio = jnp.where(ok & jnp.isfinite(prio), prio, 0.0)
+        else:
+            prio = None
+        scalar_metrics = ArrayDict(
+            {k: v for k, v in metrics.items() if jnp.ndim(v) == 0}
+        ).set("loss", loss_val)
+        out = (params, opt_state, rng, update_count + 1, dm)
+        return out, (scalar_metrics, prio, ok)
+
     # -- host loop -------------------------------------------------------------
 
     @hot_path(reason="async off-policy train loop")
@@ -561,6 +621,12 @@ class AsyncOffPolicyTrainer(_GradUpdateMixin):
         ``bad_steps`` total from the metrics drain; a rollback swaps
         params/opt back to the last good snapshot and republishes weights.
         """
+        if self._host_source:
+            yield from self._train_host(
+                ts, total_frames, min_frames_before_update,
+                preemption=preemption, emergency=emergency, guard=guard,
+            )
+            return
         coll = self.collector
         fpb = coll.frames_per_batch
         min_frames = (
@@ -651,6 +717,115 @@ class AsyncOffPolicyTrainer(_GradUpdateMixin):
                 self.device_metrics.publish(
                     DeviceMetrics.drain(pending_obs), registry
                 )
+        finally:
+            coll.stop()
+
+    def _train_host(
+        self,
+        ts: dict,
+        total_frames: int,
+        min_frames_before_update: int | None = None,
+        preemption=None,
+        emergency=None,
+        guard=None,
+    ):
+        """:meth:`train` for a host-side replay source (sharded/remote):
+        collector batches go out over the wire, minibatches come back, and
+        each feeds one ``offpolicy.update_hostbatch`` dispatch whose
+        per-sample priorities are routed back to the owning shard. This
+        path is synchronous per update (the sample RPC gates the dispatch)
+        — the overlap lives in the env threads and the shard servers, not
+        in XLA async dispatch."""
+        coll = self.collector
+        fpb = coll.frames_per_batch
+        min_frames = (
+            min_frames_before_update
+            if min_frames_before_update is not None
+            else max(self.config.init_random_frames, self.config.batch_size)
+        )
+        coll.start(ts["params"])
+        frames = 0
+        registry = self.metrics_registry
+        if registry is None and self.device_metrics is not None:
+            from ..obs import get_registry
+
+            registry = get_registry()
+        step_i = 0
+        try:
+            while frames < total_frames:
+                fault_point("trainer.preempt")
+                if preemption is not None and preemption.preempted:
+                    if emergency is not None:
+                        self.emergency_save(emergency, ts, frames)
+                    break
+                batch = coll.get_batch()
+                if batch is None:
+                    break
+                self.buffer.extend(batch)
+                frames += fpb
+                metrics = None
+                # frames-gated like the device path: extend() is synchronous,
+                # so landed frames ARE sampleable (size() would read the
+                # staleness-budgeted snapshot and lag the truth)
+                if frames >= min_frames:
+                    inj = get_injector()
+                    if inj is None:
+                        poison = None
+                    else:
+                        p = inj.poison("offpolicy.update")
+                        if self._poison_zero is None:
+                            self._poison_zero = jnp.zeros((), jnp.float32)
+                        poison = (
+                            self._poison_zero if p == 0.0
+                            else jnp.asarray(p, jnp.float32)
+                        )
+                    for _ in range(self.config.utd_ratio):
+                        mb = self.buffer.sample(self.config.batch_size)
+                        idx = np.asarray(mb["index"]).reshape(-1)
+                        mb = mb.delete("index")
+                        out, (sm, prio, ok) = self._host_update(
+                            ts["params"], ts["opt"], ts["rng"],
+                            ts["update_count"], mb, ts.get("obs"), poison,
+                        )
+                        params, opt_state, rng, update_count, dm = out
+                        ts = {
+                            "params": params,
+                            "opt": opt_state,
+                            "rng": rng,
+                            "update_count": update_count,
+                        }
+                        if self.device_metrics is not None:
+                            ts["obs"] = dm
+                        # chaos poison targets the FIRST update of a group,
+                        # like the scan path
+                        if poison is not None:
+                            poison = self._poison_zero
+                        if prio is not None and bool(ok):
+                            # one host sync per update — inherent to a
+                            # wire-fed source; the priorities are about to
+                            # cross the wire anyway
+                            self.buffer.update_priority(idx, np.asarray(prio))
+                        metrics = sm
+                    if hasattr(self.buffer, "note_policy_version"):
+                        self.buffer.note_policy_version(coll.policy_version)
+                    if self.device_metrics is not None:
+                        snap = DeviceMetrics.drain(ts["obs"])
+                        self.device_metrics.publish(snap, registry)
+                        if guard is not None:
+                            flat = self.device_metrics.to_flat(snap)
+                            restored = guard.observe(
+                                step_i, flat.get("bad_steps", 0.0),
+                                ts["params"], ts["opt"],
+                            )
+                            if restored is not None:
+                                ts = {
+                                    **ts,
+                                    "params": restored[0],
+                                    "opt": restored[1],
+                                }
+                    coll.update_params(ts["params"])
+                step_i += 1
+                yield ts, metrics
         finally:
             coll.stop()
 

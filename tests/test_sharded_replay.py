@@ -1,0 +1,492 @@
+"""Sharded experience tier tests (PR: GEAR-style partitioned replay).
+
+Covers the two load-bearing claims of the tier:
+
+1. **Distribution identity** — the two-stage draw (mixture over exact
+   per-shard priority masses, then in-shard stratified sum-tree descent)
+   is distribution-identical to one PER tree over the union when masses
+   are fresh, with globally-normalized importance weights;
+2. **Degradation, not failure** — a seeded mid-run shard crash renormalizes
+   the mixture with ZERO learner-facing exceptions, and the Supervisor's
+   keeper re-admits the restarted shard.
+
+Plus the transport satellites: raw binary frames (+ base64 compat
+fallback) and the ``{"saturated", "retry_after"}`` shed protocol.
+"""
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from rl_tpu.data import ArrayDict, DeviceStorage, PrioritizedSampler, ReplayBuffer
+from rl_tpu.data.replay import (
+    RemoteReplayBuffer,
+    ReplaySaturated,
+    ReplayService,
+    ReplayShard,
+    ShardedReplayBuffer,
+)
+from rl_tpu.data.replay.service import _decode_frames, _encode_frames
+from rl_tpu.resilience.faults import Fault, FaultInjector, injection
+
+KEY = jax.random.key(0)
+
+
+def _example(obs_dim=4):
+    return ArrayDict(
+        observation=jnp.zeros((obs_dim,), jnp.float32),
+        action=jnp.zeros((), jnp.int32),
+    )
+
+
+def _batch(n, obs_dim=4, fill=0.0):
+    return ArrayDict(
+        observation=jnp.full((n, obs_dim), fill, jnp.float32),
+        action=jnp.arange(n, dtype=jnp.int32),
+    )
+
+
+def _service(cap=256, batch_size=16, **kw):
+    buf = ReplayBuffer(DeviceStorage(cap), PrioritizedSampler(), batch_size=batch_size)
+    return ReplayService(buf, _example(), seed=0, **kw).start()
+
+
+# -- satellite: raw binary frames ---------------------------------------------
+
+
+class TestBinaryWire:
+    def test_frames_roundtrip_all_dtypes(self):
+        td = ArrayDict(
+            f32=jnp.asarray([[1.5, -2.0], [0.0, 3.25]], jnp.float32),
+            i32=jnp.asarray([7, -1], jnp.int32),
+            flag=jnp.asarray([True, False]),
+            scalar=jnp.asarray(2.5, jnp.float32),
+            nested=ArrayDict(x=jnp.arange(3, dtype=jnp.int32)),
+        )
+        meta, blob = _encode_frames(td)
+        back = _decode_frames(meta, blob)
+        for k in ("f32", "i32", "flag", "scalar"):
+            np.testing.assert_array_equal(np.asarray(back[k]), np.asarray(td[k]))
+            assert back[k].dtype == td[k].dtype
+        np.testing.assert_array_equal(
+            np.asarray(back["nested", "x"]), np.asarray(td["nested", "x"])
+        )
+
+    def test_binary_extend_sample_roundtrip(self):
+        svc = _service()
+        try:
+            rb = RemoteReplayBuffer(*svc.address)
+            assert rb.extend(_batch(32)) == 32
+            mb = rb.sample(16)
+            assert mb["observation"].shape == (16, 4)
+            assert "index" in mb and "_weight" in mb
+            # shards export the sampled leaves' p^alpha for GLOBAL weight
+            # recomputation at the coordinator
+            assert "_p_alpha" in mb
+            assert rb._binary  # never fell back
+        finally:
+            svc.shutdown()
+
+    def test_legacy_fallback_when_peer_lacks_binary(self):
+        svc = _service()
+        # an old peer: binary handlers absent
+        del svc.server._server._handlers["extend_bin"]
+        del svc.server._server._handlers["sample_bin"]
+        try:
+            rb = RemoteReplayBuffer(*svc.address)
+            assert rb.extend(_batch(32)) == 32
+            assert not rb._binary  # flipped to base64 for good
+            mb = rb.sample(8)
+            assert mb["observation"].shape == (8, 4)
+        finally:
+            svc.shutdown()
+
+
+# -- satellite: shed protocol ---------------------------------------------------
+
+
+class TestShedProtocol:
+    def test_saturated_raises_after_budget(self):
+        svc = _service(max_inflight=0, retry_after_s=0.005)
+        try:
+            rb = RemoteReplayBuffer(*svc.address, max_shed_retries=2)
+            with pytest.raises(ReplaySaturated) as ei:
+                rb.extend(_batch(8))
+            assert ei.value.retry_after == pytest.approx(0.005)
+            with pytest.raises(ReplaySaturated):
+                rb.sample(4)
+        finally:
+            svc.shutdown()
+
+    def test_resubmit_succeeds_when_saturation_clears(self):
+        svc = _service(max_inflight=0, retry_after_s=0.02)
+        try:
+            rb = RemoteReplayBuffer(*svc.address, max_shed_retries=20)
+            t = threading.Timer(0.1, lambda: setattr(svc, "max_inflight", None))
+            t.start()
+            try:
+                assert rb.extend(_batch(8)) == 8  # sheds, then lands
+            finally:
+                t.join()
+        finally:
+            svc.shutdown()
+
+
+# -- tentpole: distribution identity -------------------------------------------
+
+
+class TestShardedDistributionParity:
+    def test_two_stage_matches_single_tree(self):
+        """Fill 3 shards with known priorities; the coordinator's empirical
+        sampling frequencies must match BOTH the analytic PER distribution
+        p_i^alpha / M over the union AND a single device tree holding the
+        same union — and the mixture itself must be exact."""
+        n_shards, cap, alpha, beta = 3, 64, 0.6, 0.4
+        n_total = n_shards * cap
+        rng = np.random.default_rng(11)
+        prios = rng.uniform(0.1, 4.0, n_total).astype(np.float32)
+        pa = (np.abs(prios) + 1e-8) ** alpha
+        exact = pa / pa.sum()
+
+        def bf():
+            return ReplayBuffer(
+                DeviceStorage(cap),
+                PrioritizedSampler(alpha=alpha, beta=beta),
+                batch_size=64,
+            )
+
+        shards = [ReplayShard(i, bf, _example(), seed=i).start() for i in range(3)]
+        coord = ShardedReplayBuffer(
+            [s.address for s in shards], cap,
+            batch_size=64, beta=beta, seed=5,
+        )
+        try:
+            for i, s in enumerate(shards):
+                c = RemoteReplayBuffer(*s.address)
+                c.extend(_batch(cap, fill=float(i)))
+                c.update_priority(np.arange(cap), prios[i * cap:(i + 1) * cap])
+            coord.refresh_masses()
+
+            # stage-1 exactness: the mixture IS the per-shard mass fractions
+            shard_mass = pa.reshape(n_shards, cap).sum(axis=1)
+            probs = coord.mixture_probs()
+            for i in range(n_shards):
+                assert probs[i] == pytest.approx(
+                    shard_mass[i] / pa.sum(), rel=1e-4
+                )
+
+            counts = np.zeros(n_total)
+            draws, B = 96, 64
+            for _ in range(draws):
+                mb = coord.sample(B)
+                counts += np.bincount(
+                    np.asarray(mb["index"]).ravel(), minlength=n_total
+                )
+            emp = counts / counts.sum()
+
+            # single tree over the union, same alpha
+            dev = PrioritizedSampler(alpha=alpha, beta=beta)
+            st = dev.init(n_total)
+            st = dev.on_write(st, jnp.arange(n_total), None)
+            st = dev.update_priority(
+                st, jnp.arange(n_total), jnp.asarray(prios), indices_sorted=True
+            )
+            counts_1 = np.zeros(n_total)
+            samp = jax.jit(
+                lambda st, k: dev.sample(st, k, B, jnp.asarray(n_total), n_total)
+            )
+            for i in range(draws):
+                idx, _info, st = samp(st, jax.random.fold_in(KEY, i))
+                counts_1 += np.bincount(np.asarray(idx), minlength=n_total)
+            emp_1 = counts_1 / counts_1.sum()
+
+            # L1 tolerances sized for 6144 draws over 192 cells
+            assert np.abs(emp - exact).sum() < 0.15, np.abs(emp - exact).sum()
+            assert np.abs(emp - emp_1).sum() < 0.2, np.abs(emp - emp_1).sum()
+        finally:
+            coord.close()
+            for s in shards:
+                s.shutdown()
+
+    def test_global_importance_weights(self):
+        """Coordinator weights must be (N_tot · p_i / M_tot)^-beta normalized
+        by the GLOBAL batch max — not the shard-local max the shards reply
+        with."""
+        cap, alpha, beta = 32, 0.7, 0.5
+        rng = np.random.default_rng(3)
+        prios = rng.uniform(0.1, 5.0, 2 * cap).astype(np.float32)
+        pa = (np.abs(prios) + 1e-8) ** alpha
+
+        def bf():
+            return ReplayBuffer(
+                DeviceStorage(cap),
+                PrioritizedSampler(alpha=alpha, beta=beta),
+                batch_size=32,
+            )
+
+        shards = [ReplayShard(i, bf, _example(), seed=i).start() for i in range(2)]
+        coord = ShardedReplayBuffer(
+            [s.address for s in shards], cap, batch_size=32, beta=beta, seed=7,
+        )
+        try:
+            for i, s in enumerate(shards):
+                c = RemoteReplayBuffer(*s.address)
+                c.extend(_batch(cap))
+                c.update_priority(np.arange(cap), prios[i * cap:(i + 1) * cap])
+            coord.refresh_masses()
+            mb = coord.sample(32)
+            idx = np.asarray(mb["index"]).ravel()
+            expect = (2 * cap * pa[idx] / pa.sum()) ** (-beta)
+            expect = expect / expect.max()
+            np.testing.assert_allclose(
+                np.asarray(mb["_weight"]), expect, rtol=2e-3
+            )
+        finally:
+            coord.close()
+            for s in shards:
+                s.shutdown()
+
+    def test_priority_update_routes_to_owning_shard(self):
+        cap = 64
+
+        def bf():
+            return ReplayBuffer(
+                DeviceStorage(cap), PrioritizedSampler(), batch_size=16
+            )
+
+        shards = [ReplayShard(i, bf, _example(), seed=i).start() for i in range(2)]
+        coord = ShardedReplayBuffer(
+            [s.address for s in shards], cap, batch_size=16, seed=0,
+        )
+        try:
+            coord.extend(_batch(cap))
+            coord.extend(_batch(cap))
+            coord.refresh_masses()
+            before = coord.mixture_probs()
+            # boost shard 1's leaves through the GLOBAL index encoding
+            coord.update_priority(
+                cap + np.arange(cap), np.full(cap, 50.0, np.float32)
+            )
+            coord.refresh_masses()
+            after = coord.mixture_probs()
+            assert after[1] > 0.9 > before[1]
+        finally:
+            coord.close()
+            for s in shards:
+                s.shutdown()
+
+
+# -- tentpole: chaos degradation ------------------------------------------------
+
+
+class _ShardFleet:
+    """3 shards + coordinator wired for restarts, torn down reliably."""
+
+    def __init__(self, cap=256, batch_size=16, probe_interval_s=0.05):
+        def bf():
+            return ReplayBuffer(
+                DeviceStorage(cap), PrioritizedSampler(), batch_size=batch_size
+            )
+
+        self.shards = [
+            ReplayShard(i, bf, _example(), seed=i).start() for i in range(3)
+        ]
+        self.coord = ShardedReplayBuffer(
+            [s.address for s in self.shards], cap,
+            batch_size=batch_size, seed=0,
+            mass_refresh_s=0.05,
+            probe_interval_s=probe_interval_s,
+            restart_fn=lambda i: self.shards[i].restart(),
+        )
+
+    def close(self):
+        self.coord.close()
+        for s in self.shards:
+            try:
+                s.shutdown()
+            except Exception:
+                pass
+
+
+class TestChaosDegradation:
+    def test_seeded_crash_degrades_then_readmits(self):
+        """The acceptance chaos scenario: a seeded crash kills shard 1
+        mid-run; the learner-facing loop sees ZERO exceptions, the mixture
+        renormalizes over the survivors, and the Supervisor's keeper
+        restart re-admits the shard."""
+        fleet = _ShardFleet()
+        coord, shards = fleet.coord, fleet.shards
+        inj = FaultInjector(
+            {"replay.shard_crash.1": Fault(kind="crash", at=(12,))}, seed=0
+        )
+        try:
+            coord.start_keepers()
+            errors = []
+            failovers_before = coord._c_failover.value({"shard": "1"})
+            readmits_before = coord._c_readmit.value({"shard": "1"})
+            with injection(inj):
+                for step in range(60):
+                    try:
+                        coord.extend(_batch(16, fill=float(step)))
+                        if coord.size() >= 16:
+                            mb = coord.sample(16)
+                            assert mb["observation"].shape == (16, 4)
+                            coord.update_priority(
+                                np.asarray(mb["index"]),
+                                np.full(16, 1.0, np.float32),
+                            )
+                    except Exception as e:  # noqa: BLE001 - the assertion IS "none"
+                        errors.append(e)
+                    time.sleep(0.005)
+            assert errors == [], errors
+            assert any(s == "replay.shard_crash.1" for s, _k, _n in inj.fired)
+            # the failover counter records the transition durably — polling
+            # alive_shards() can miss it when the keeper re-admits within
+            # one loop tick
+            assert coord._c_failover.value({"shard": "1"}) > failovers_before, (
+                "shard 1 never left the mixture"
+            )
+            # keeper + supervisor re-admission
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline:
+                if 1 in coord.alive_shards():
+                    break
+                time.sleep(0.02)
+            assert 1 in coord.alive_shards(), "shard 1 never re-admitted"
+            assert coord._c_readmit.value({"shard": "1"}) > readmits_before
+            # the restarted shard takes traffic again
+            coord.refresh_masses()
+            for step in range(6):
+                coord.extend(_batch(16))
+            coord.refresh_masses()
+            assert coord.mixture_probs()[1] > 0.0
+        finally:
+            fleet.close()
+
+    def test_mixture_renormalizes_while_degraded(self):
+        """While a shard is down the surviving masses renormalize to 1 and
+        sampling draws only from survivors."""
+        fleet = _ShardFleet()
+        coord, shards = fleet.coord, fleet.shards
+        inj = FaultInjector(
+            {"replay.shard_crash.2": Fault(kind="crash", at=(1,))}, seed=0
+        )
+        try:
+            for _ in range(6):
+                coord.extend(_batch(32))
+            coord.refresh_masses()
+            with injection(inj):
+                # first touch of shard 2 crashes it; NO keepers running, so
+                # it stays out — the degraded steady state
+                try:
+                    coord.refresh_masses()
+                except Exception:  # noqa: BLE001
+                    pass
+                coord.refresh_masses()
+            assert coord.alive_shards() == [0, 1]
+            probs = coord.mixture_probs()
+            assert sum(probs.values()) == pytest.approx(1.0)
+            assert set(probs) == {0, 1}
+            cap = coord.shard_capacity
+            for _ in range(4):
+                mb = coord.sample(16)
+                owners = np.asarray(mb["index"]).ravel() // cap
+                assert set(owners.tolist()) <= {0, 1}
+        finally:
+            fleet.close()
+
+    def test_link_drop_readmits_without_restart(self):
+        """``replay.shard_drop`` severs one call; the keeper's probe finds
+        the endpoint alive and re-admits WITHOUT rebuilding the shard (its
+        experience survives — unlike a crash)."""
+        restarts = []
+        fleet = _ShardFleet(probe_interval_s=0.03)
+        coord = fleet.coord
+        coord._restart_fn = lambda i: (restarts.append(i), fleet.shards[i].restart())[1]
+        inj = FaultInjector(
+            {"replay.shard_drop": Fault(kind="drop", at=(2,))}, seed=0
+        )
+        try:
+            for _ in range(3):
+                coord.extend(_batch(32))
+            size_before = coord.size()
+            coord.start_keepers()
+            with injection(inj):
+                errors = []
+                for step in range(30):
+                    try:
+                        coord.extend(_batch(8, fill=float(step)))
+                    except Exception as e:  # noqa: BLE001
+                        errors.append(e)
+                    time.sleep(0.005)
+            assert errors == []
+            assert any(s == "replay.shard_drop" for s, _k, _n in inj.fired)
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                if len(coord.alive_shards()) == 3:
+                    break
+                time.sleep(0.02)
+            assert len(coord.alive_shards()) == 3
+            assert restarts == []  # drop != crash: no rebuild
+            assert coord.size() >= size_before  # experience survived
+        finally:
+            fleet.close()
+
+
+# -- trainer drop-in -------------------------------------------------------------
+
+
+class TestTrainerHostSource:
+    def test_async_trainer_trains_through_sharded_buffer(self):
+        """AsyncOffPolicyTrainer accepts the sharded buffer as a drop-in
+        source: host-batch update programs run, priorities route back, the
+        experience lands spread across shards, losses stay finite."""
+        from tests.test_async_offpolicy import _HostEnv, _make_sac
+        from rl_tpu.collectors import AsyncHostCollector, ThreadedEnvPool
+        from rl_tpu.trainers import AsyncOffPolicyTrainer, OffPolicyConfig
+
+        sac = _make_sac()
+        pool = ThreadedEnvPool([lambda i=i: _HostEnv(seed=i) for i in range(2)])
+
+        def policy(params, td, key):
+            return sac.actor(params["actor"], td, key)
+
+        coll = AsyncHostCollector(pool, policy, frames_per_batch=32, seed=0)
+        cfg = OffPolicyConfig(
+            batch_size=32, utd_ratio=1, learning_rate=3e-3, init_random_frames=32
+        )
+        cap = 512
+
+        probe = AsyncOffPolicyTrainer.__new__(AsyncOffPolicyTrainer)
+        probe.collector = coll
+        example = AsyncOffPolicyTrainer.example_item(probe)
+
+        def bf():
+            return ReplayBuffer(
+                DeviceStorage(cap), PrioritizedSampler(), batch_size=32
+            )
+
+        shards = [ReplayShard(i, bf, example, seed=i).start() for i in range(2)]
+        coord = ShardedReplayBuffer(
+            [s.address for s in shards], cap, batch_size=32, seed=0
+        )
+        tr = AsyncOffPolicyTrainer(coll, sac, coord, cfg, priority_key="td_error")
+        assert tr._host_source
+        ts = tr.init(jax.random.key(1))
+        assert "buffer" not in ts  # replay state lives in the shards
+        losses = []
+        try:
+            for ts, m in tr.train(ts, total_frames=160):
+                if m is not None:
+                    losses.append(float(m["loss_qvalue"]))
+        finally:
+            pool.close()
+            coord.close()
+            for s in shards:
+                s.shutdown()
+        assert len(losses) >= 3
+        assert np.isfinite(losses).all()

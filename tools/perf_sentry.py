@@ -164,6 +164,24 @@ GATES: list[Gate] = [
          "no scale-up fired on the seeded burst"),
     Gate("AUTOSCALE_pr19.json", "autoscale.scale_downs", ">=", 1,
          "no scale-down drained the post-burst slack"),
+    # PR-20 sharded experience tier (REPLAY_pr20.json). Measured on the
+    # cpu tier: 2.81x aggregate extend throughput over one endpoint at
+    # the same total capacity (the PER write program carries O(capacity)
+    # full-array work per extend, so N shards at C/N each pay 1/N of
+    # it), chaos recovery 0.91s. Floors sit under those; the chaos
+    # gates are invariants of the acceptance scenario.
+    Gate("REPLAY_pr20.json", "replay_shard.shard_speedup_x", ">=", 2.0,
+         "N shards no longer beat one endpoint by the 2x acceptance bound"),
+    Gate("REPLAY_pr20.json", "replay_shard.value", ">", 0.0,
+         "the sharded tier wrote nothing during the timed window"),
+    Gate("REPLAY_pr20.json", "replay_shard.chaos.faults_fired", ">=", 1,
+         "the seeded shard crash never fired — the chaos phase ran empty"),
+    Gate("REPLAY_pr20.json", "replay_shard.chaos.learner_errors", "==", 0,
+         "a shard crash leaked through the mixture to the learner"),
+    Gate("REPLAY_pr20.json", "replay_shard.chaos.readmitted", ">=", 1,
+         "the supervisor never re-admitted the crashed shard"),
+    Gate("REPLAY_pr20.json", "replay_shard.chaos.recovery_s", "<=", 10.0,
+         "crash-to-readmit exceeded the degradation budget"),
 ]
 
 

@@ -311,6 +311,34 @@ def _extract_autoscale(stdout: str) -> dict | None:
     return found
 
 
+def _extract_replay(stdout: str) -> dict | None:
+    """Find the replay_shard sub-bench result (ISSUE-20 sharded
+    experience tier: the N-shard-vs-1-endpoint A/B — aggregate extend
+    throughput both arms, end-to-end sample latency percentiles, and
+    the seeded shard-crash chaos replay with learner-visible error
+    count, re-admission flag, and crash-to-readmit seconds) in a bench
+    stdout JSONL stream. The arm and chaos sub-dicts carry structure
+    worth keeping whole, so they get their own committed REPLAY
+    artifact — which is also what the offline perf sentry gates. Last
+    match wins (the final aggregate line repeats the sub-results)."""
+    found = None
+    for ln in (stdout or "").strip().splitlines():
+        try:
+            d = json.loads(ln)
+        except ValueError:
+            continue
+        if not isinstance(d, dict):
+            continue
+        for c in [d] + [v for v in d.values() if isinstance(v, dict)]:
+            v = c.get("replay_shard")
+            if isinstance(v, dict) and (
+                "shard_speedup_x" in v
+                or v.get("metric") == "replay_shard_extend_items_per_sec"
+            ):
+                found = v
+    return found
+
+
 def _extract_ir_audit(stdout: str) -> dict:
     """Collect every ``ir_audit`` section (PR-15 deep-tier auditor: per-
     program predicted-vs-measured MFU from the static roofline, audit
@@ -437,6 +465,7 @@ def watch(
     audit_artifact: str | None = None,
     profiling_artifact: str | None = None,
     autoscale_artifact: str | None = None,
+    replay_artifact: str | None = None,
     sentry_artifact: str | None = None,
     rlint_artifact: str | None = None,
     commit: bool = True,
@@ -646,6 +675,21 @@ def watch(
                 f.write("\n")
             paths.append(azpath)
             log(f"{_utcnow()} autoscale -> {os.path.relpath(azpath, REPO)}")
+        rp = _extract_replay(bout)
+        if rp is not None:
+            rppath = replay_artifact or os.path.join(REPO, "REPLAY_pr20.json")
+            with open(rppath, "w") as f:
+                json.dump(
+                    {
+                        "artifact": os.path.relpath(path, REPO),
+                        "generated": _utcnow(),
+                        "replay_shard": rp,
+                    },
+                    f, indent=2, sort_keys=True,
+                )
+                f.write("\n")
+            paths.append(rppath)
+            log(f"{_utcnow()} replay_shard -> {os.path.relpath(rppath, REPO)}")
         if hasattr(runner, "rlint"):
             # PR-8: keep the static-analysis summary current alongside the
             # perf artifacts — the same commit that records a measurement
@@ -714,6 +758,8 @@ def main(argv=None) -> int:
                     help="profiler/drift distillation path (default PROF_pr18.json)")
     ap.add_argument("--autoscale-artifact", default=None,
                     help="elastic-fleet A/B path (default AUTOSCALE_pr19.json)")
+    ap.add_argument("--replay-artifact", default=None,
+                    help="sharded replay A/B path (default REPLAY_pr20.json)")
     ap.add_argument("--sentry-artifact", default=None,
                     help="perf-sentry gate roll-up path (default PERF_HISTORY.json)")
     ap.add_argument("--rlint-artifact", default=None,
@@ -747,6 +793,7 @@ def main(argv=None) -> int:
         audit_artifact=args.audit_artifact,
         profiling_artifact=args.profiling_artifact,
         autoscale_artifact=args.autoscale_artifact,
+        replay_artifact=args.replay_artifact,
         sentry_artifact=args.sentry_artifact,
         rlint_artifact=args.rlint_artifact,
         commit=not args.no_commit,
